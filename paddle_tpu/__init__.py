@@ -1,0 +1,51 @@
+"""paddle_tpu — a TPU-native deep learning framework with the capabilities of
+PaddlePaddle (reference: WorgenZhang/Paddle ~v2.3, surveyed in SURVEY.md).
+
+Compute path: jax/XLA (+Pallas kernels); parallelism: pjit/GSPMD/shard_map
+over a device Mesh; the user API mirrors ``import paddle``.
+"""
+from __future__ import annotations
+
+from .framework import (  # noqa: F401
+    CPUPlace, CUDAPlace, Place, TPUPlace, Tensor, Parameter,
+    bfloat16, bool_, complex64, complex128, float16, float32, float64,
+    int8, int16, int32, int64, uint8,
+    get_default_dtype, set_default_dtype,
+    get_device, set_device, is_compiled_with_tpu, current_place,
+    get_flags, set_flags,
+    no_grad, is_grad_enabled,
+)
+from .tensor import *  # noqa: E402,F401,F403
+
+__version__ = "0.1.0"
+
+from . import nn  # noqa: E402,F401
+from .nn.layer.layers import ParamAttr  # noqa: E402,F401
+
+# Subsystems still under construction (SURVEY.md §7 build order) are imported
+# only once their package exists on disk; a module that exists but fails to
+# import raises — real errors are never swallowed.
+import importlib as _importlib
+import importlib.util as _ilu
+
+
+def _import_if_built(name):
+    spec = _ilu.find_spec(f"{__name__}.{name}")
+    if spec is not None and spec.origin is not None:  # not a bare namespace
+        return _importlib.import_module(f"{__name__}.{name}")
+    return None
+
+
+for _m in ("autograd", "optimizer", "amp", "io", "metric", "static", "jit",
+           "vision", "distributed", "hapi", "parallel", "profiler",
+           "incubate", "models", "utils"):
+    globals()[_m] = _import_if_built(_m) or globals().get(_m)
+
+if globals().get("static") is not None:
+    from .static import disable_static, enable_static, in_dynamic_mode  # noqa: F401
+if globals().get("hapi") is not None:
+    from .hapi.model import Model  # noqa: F401
+if globals().get("parallel") is not None:
+    from .parallel.api import DataParallel  # noqa: F401
+if _ilu.find_spec(f"{__name__}.framework.io") is not None:
+    from .framework.io import load, save  # noqa: F401

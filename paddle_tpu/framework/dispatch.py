@@ -1,0 +1,216 @@
+"""Eager op dispatch.
+
+The analog of the reference's generated dygraph forward functions
+(/root/reference/paddle/fluid/eager/auto_code_generator/final_state_generator/
+eager_gen.py:853) + phi kernel selection (phi/api/lib/kernel_dispatch.h). One
+generic path replaces per-op codegen:
+
+  user API  ->  call_op(name, *args, **attrs)
+                  unwrap Tensors -> jax arrays
+                  select impl (registry; Pallas overrides)
+                  jax.jit-cached execution          (kernel launch)
+                  jax.vjp + GradNode when grad needed (node creation)
+                  wrap outputs in Tensors
+
+Caching: one compiled executable per (op, attrs, input avals) — jax.jit's
+cache keyed by our (op, attrs, arg-structure) closure. This plays the role of
+the reference's OpCache/kernel-factory lookups in the eager hot loop.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.registry import get_op
+from . import dtypes as _dtypes
+from .flags import flag_value
+from .tensor import GradNode, Tensor, is_grad_enabled
+
+Array = Any
+
+
+class _Slot:
+    __slots__ = ("idx",)
+
+    def __init__(self, idx):
+        self.idx = idx
+
+
+def _unwrap_args(args) -> Tuple[list, list]:
+    """Replace Tensor leaves (incl. one level of list/tuple nesting) with
+    slots; return (template, tensors)."""
+    tensors: List[Tensor] = []
+
+    def _as_input(a):
+        # Raw jax/numpy arrays must be traced inputs too (NOT closure
+        # constants): the jit cache is keyed by shape/dtype only, so baking
+        # values into the closure would serve stale data.
+        if isinstance(a, Tensor):
+            return a
+        if isinstance(a, (jax.Array, np.ndarray)) or hasattr(a, "aval"):
+            return Tensor(jnp.asarray(a), stop_gradient=True)
+        return None
+
+    template = []
+    for a in args:
+        t = _as_input(a)
+        if t is not None:
+            tensors.append(t)
+            template.append(_Slot(len(tensors) - 1))
+        elif isinstance(a, (list, tuple)) and any(
+                _as_input(x) is not None for x in a):
+            sub = []
+            for x in a:
+                t = _as_input(x)
+                if t is not None:
+                    tensors.append(t)
+                    sub.append(_Slot(len(tensors) - 1))
+                else:
+                    sub.append(x)
+            template.append(type(a)(sub) if isinstance(a, tuple) else sub)
+        else:
+            template.append(a)
+    return template, tensors
+
+
+def _rebuild(template, arrays):
+    out = []
+    for a in template:
+        if isinstance(a, _Slot):
+            out.append(arrays[a.idx])
+        elif isinstance(a, list):
+            out.append([arrays[x.idx] if isinstance(x, _Slot) else x
+                        for x in a])
+        elif isinstance(a, tuple):
+            out.append(tuple(arrays[x.idx] if isinstance(x, _Slot) else x
+                             for x in a))
+        else:
+            out.append(a)
+    return out
+
+
+def _template_key(template):
+    parts = []
+    for a in template:
+        if isinstance(a, _Slot):
+            parts.append(("T", a.idx))
+        elif isinstance(a, (list, tuple)):
+            parts.append((type(a).__name__,
+                          tuple(("T", x.idx) if isinstance(x, _Slot)
+                                else ("C", _const_key(x)) for x in a)))
+        else:
+            parts.append(("C", _const_key(a)))
+    return tuple(parts)
+
+
+def _const_key(v):
+    if isinstance(v, (np.ndarray, jnp.ndarray)):
+        return ("arr", v.shape, str(v.dtype))
+    try:
+        hash(v)
+        return v
+    except TypeError:
+        return repr(v)
+
+
+_fn_cache: Dict[tuple, Any] = {}
+
+
+def _get_callable(name: str, impl, template, attrs_key, attrs, jit_ok=True):
+    key = (name, id(impl), _template_key(template), attrs_key)
+    fn = _fn_cache.get(key)
+    if fn is None:
+        def raw(*arrays):
+            return impl(*_rebuild(template, arrays), **attrs)
+
+        fn = jax.jit(raw) if (jit_ok and flag_value("FLAGS_eager_jit_ops")) \
+            else raw
+        _fn_cache[key] = fn
+    return fn
+
+
+def _attrs_key(attrs: dict):
+    items = []
+    for k in sorted(attrs):
+        items.append((k, _const_key(attrs[k])))
+    return tuple(items)
+
+
+def call_op(name: str, *args, **attrs):
+    """Execute a registered op eagerly on Tensors, recording the tape."""
+    opdef = get_op(name)
+    template, tensors = _unwrap_args(args)
+    arrays = [t._data for t in tensors]
+    impl = opdef.select(args, attrs)
+    fn = _get_callable(name, impl, template, _attrs_key(attrs), attrs,
+                       jit_ok=opdef.jit)
+
+    needs_grad = (is_grad_enabled() and not opdef.nondiff
+                  and any(t._requires_grad() for t in tensors))
+
+    if needs_grad:
+        out, vjp_fn = jax.vjp(fn, *arrays)
+    else:
+        out = fn(*arrays)
+        vjp_fn = None
+
+    flat_out, out_treedef = jax.tree_util.tree_flatten(out)
+    out_tensors = [Tensor(o, stop_gradient=not needs_grad)
+                   for o in flat_out]
+
+    if needs_grad:
+        node = GradNode(
+            op_name=name,
+            vjp_fn=vjp_fn,
+            inputs=tensors,
+            n_outputs=len(flat_out),
+            out_treedef=out_treedef,
+            out_meta=[(o.shape, o.dtype) for o in flat_out],
+        )
+        for i, t in enumerate(out_tensors):
+            t._node = node
+            t._out_idx = i
+            # integer outputs never carry grad
+            if not jnp.issubdtype(t.dtype, jnp.floating) and \
+               not jnp.issubdtype(t.dtype, jnp.complexfloating):
+                t.stop_gradient = True
+
+    if flag_value("FLAGS_check_nan_inf"):
+        _check_nan_inf(name, out_tensors)
+
+    return jax.tree_util.tree_unflatten(out_treedef, out_tensors)
+
+
+def _check_nan_inf(name, out_tensors):
+    """FLAGS_check_nan_inf analog (reference:
+    framework/details/nan_inf_utils_detail.cc) — eager sweep of op outputs."""
+    for t in out_tensors:
+        if jnp.issubdtype(t.dtype, jnp.floating):
+            try:
+                bad = bool(jnp.any(~jnp.isfinite(t._data)))
+            except Exception:
+                return  # tracer — skip under jit
+            if bad:
+                raise FloatingPointError(
+                    f"Operator {name} output contains NaN/Inf "
+                    f"(tensor {t.name}, shape {t.shape})")
+
+
+def to_array(x, dtype=None):
+    """Coerce python/numpy/Tensor input to a jax array."""
+    if isinstance(x, Tensor):
+        a = x._data
+        return a.astype(_dtypes.convert_dtype(dtype)) if dtype else a
+    if dtype is not None:
+        return jnp.asarray(x, dtype=_dtypes.convert_dtype(dtype))
+    if isinstance(x, bool):
+        return jnp.asarray(x)
+    if isinstance(x, int):
+        return jnp.asarray(x, dtype=jnp.int64)
+    if isinstance(x, float):
+        return jnp.asarray(x, dtype=_dtypes.get_default_dtype())
+    return jnp.asarray(x)

@@ -1,0 +1,93 @@
+"""Dtype system.
+
+TPU-native analog of the reference's ``phi::DataType`` enum
+(/root/reference/paddle/phi/common/data_type.h) plus the promotion helpers the
+Python API layer relies on. We deliberately alias dtypes straight to jax/numpy
+dtypes instead of building a parallel enum: XLA is the only backend, so the
+jnp dtype *is* the canonical runtime type.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# Canonical dtype objects (np.dtype instances) -------------------------------
+bool_ = np.dtype("bool")
+uint8 = np.dtype("uint8")
+int8 = np.dtype("int8")
+int16 = np.dtype("int16")
+int32 = np.dtype("int32")
+int64 = np.dtype("int64")
+float16 = np.dtype("float16")
+bfloat16 = jnp.bfloat16.dtype  # np.dtype wrapper over ml_dtypes.bfloat16
+float32 = np.dtype("float32")
+float64 = np.dtype("float64")
+complex64 = np.dtype("complex64")
+complex128 = np.dtype("complex128")
+
+_STR_ALIASES = {
+    "bool": bool_,
+    "uint8": uint8,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "float16": float16,
+    "fp16": float16,
+    "half": float16,
+    "bfloat16": bfloat16,
+    "bf16": bfloat16,
+    "float32": float32,
+    "fp32": float32,
+    "float": float32,
+    "float64": float64,
+    "fp64": float64,
+    "double": float64,
+    "complex64": complex64,
+    "complex128": complex128,
+}
+
+_default_dtype = float32
+
+
+def set_default_dtype(d) -> None:
+    """Analog of ``paddle.set_default_dtype`` (reference:
+    python/paddle/framework/framework.py)."""
+    global _default_dtype
+    d = convert_dtype(d)
+    if d not in (float16, bfloat16, float32, float64):
+        raise TypeError(
+            "set_default_dtype only supports float16/bfloat16/float32/float64, "
+            f"got {d}")
+    _default_dtype = d
+
+
+def get_default_dtype():
+    return _default_dtype
+
+
+def convert_dtype(dtype) -> np.dtype:
+    """Normalize any user-provided dtype spec to a canonical np.dtype."""
+    if dtype is None:
+        return _default_dtype
+    if isinstance(dtype, str):
+        key = dtype.lower()
+        if key in _STR_ALIASES:
+            return _STR_ALIASES[key]
+        raise TypeError(f"Unsupported dtype string: {dtype!r}")
+    return np.dtype(dtype)
+
+
+def is_floating(dtype) -> bool:
+    d = convert_dtype(dtype)
+    return jnp.issubdtype(d, jnp.floating)
+
+
+def is_integer(dtype) -> bool:
+    d = convert_dtype(dtype)
+    return jnp.issubdtype(d, jnp.integer)
+
+
+def is_complex(dtype) -> bool:
+    d = convert_dtype(dtype)
+    return jnp.issubdtype(d, jnp.complexfloating)

@@ -1,0 +1,90 @@
+"""Process-level flag registry.
+
+Analog of the reference's exported gflags
+(/root/reference/paddle/fluid/platform/flags.cc) surfaced to Python through
+``get_flags``/``set_flags`` (python/paddle/fluid/framework.py:7112,7136).
+Flags may be seeded from the environment (``FLAGS_*`` vars) exactly like
+gflags' env fallback.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Iterable
+
+
+class _Flag:
+    __slots__ = ("name", "default", "value", "help", "type")
+
+    def __init__(self, name, default, help_str=""):
+        self.name = name
+        self.default = default
+        self.help = help_str
+        self.type = type(default)
+        env = os.environ.get(name)
+        self.value = self._parse(env) if env is not None else default
+
+    def _parse(self, text: str):
+        if self.type is bool:
+            return text.strip().lower() in ("1", "true", "yes", "on")
+        if self.type in (int, float):
+            return self.type(text)
+        return text
+
+
+_REGISTRY: Dict[str, _Flag] = {}
+
+
+def define_flag(name: str, default, help_str: str = "") -> None:
+    if not name.startswith("FLAGS_"):
+        name = "FLAGS_" + name
+    if name not in _REGISTRY:
+        _REGISTRY[name] = _Flag(name, default, help_str)
+
+
+def _canon(name: str) -> str:
+    return name if name.startswith("FLAGS_") else "FLAGS_" + name
+
+
+def get_flags(flags) -> Dict[str, Any]:
+    if isinstance(flags, str):
+        flags = [flags]
+    out = {}
+    for f in flags:
+        key = _canon(f)
+        if key not in _REGISTRY:
+            raise ValueError(f"Flag {f} not registered")
+        out[key] = _REGISTRY[key].value
+    return out
+
+
+def set_flags(flags: Dict[str, Any]) -> None:
+    for name, value in flags.items():
+        key = _canon(name)
+        if key not in _REGISTRY:
+            raise ValueError(f"Flag {name} not registered")
+        flag = _REGISTRY[key]
+        flag.value = flag.type(value) if flag.type is not type(None) else value
+
+
+def flag_value(name: str):
+    return _REGISTRY[_canon(name)].value
+
+
+def all_flags() -> Iterable[str]:
+    return list(_REGISTRY)
+
+
+# Core flags (subset of the reference's 56, the ones with TPU meaning).
+define_flag("FLAGS_check_nan_inf", False,
+            "Sweep op outputs for NaN/Inf after each eager op "
+            "(reference: framework/details/nan_inf_utils_detail.cc)")
+define_flag("FLAGS_benchmark", False, "Print per-op timing in eager mode")
+define_flag("FLAGS_use_standalone_executor", True,
+            "Kept for API parity; the XLA executor is always standalone")
+define_flag("FLAGS_eager_jit_ops", True,
+            "Route eager op calls through cached jax.jit wrappers")
+define_flag("FLAGS_allocator_strategy", "auto_growth",
+            "Parity flag; HBM allocation is managed by PjRt")
+define_flag("FLAGS_cudnn_deterministic", False, "Parity flag")
+define_flag("FLAGS_embedding_deterministic", False, "Parity flag")
+define_flag("FLAGS_conv_workspace_size_limit", 512, "Parity flag (MB)")

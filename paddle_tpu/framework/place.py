@@ -1,0 +1,110 @@
+"""Device/place abstraction.
+
+Analog of the reference's ``paddle::platform::Place`` hierarchy
+(/root/reference/paddle/fluid/platform/place.h) and
+``paddle.set_device`` (python/paddle/device/__init__.py). Here a Place wraps a
+PjRt device as surfaced by ``jax.devices()``; ``TPUPlace`` is first-class and
+``CPUPlace`` doubles as the test/fake backend (SURVEY.md §4: CPU PjRt backend
+is the fake device).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+
+
+class Place:
+    device_type = "unknown"
+
+    def __init__(self, device_id: int = 0):
+        self._device_id = int(device_id)
+
+    def get_device_id(self) -> int:
+        return self._device_id
+
+    def __eq__(self, other):
+        return (type(self) is type(other)
+                and self._device_id == other._device_id)
+
+    def __hash__(self):
+        return hash((type(self).__name__, self._device_id))
+
+    def __repr__(self):
+        return f"Place({self.device_type}:{self._device_id})"
+
+    def jax_device(self):
+        devs = [d for d in jax.devices() if _platform_of(d) == self.device_type]
+        if not devs:
+            # Fall back to the default backend (e.g. asking for tpu on a
+            # CPU-only test host).
+            devs = jax.devices()
+        return devs[min(self._device_id, len(devs) - 1)]
+
+
+class CPUPlace(Place):
+    device_type = "cpu"
+
+
+class TPUPlace(Place):
+    device_type = "tpu"
+
+
+class CUDAPlace(Place):
+    # Accepted for API parity with the reference; maps onto whatever
+    # accelerator jax exposes.
+    device_type = "gpu"
+
+
+def _platform_of(dev) -> str:
+    p = dev.platform
+    # Experimental transports (e.g. the 'axon' tunnel) still expose TPU chips.
+    if "tpu" in str(getattr(dev, "device_kind", "")).lower():
+        return "tpu"
+    return p
+
+
+@functools.lru_cache(maxsize=None)
+def _default_place() -> Place:
+    for d in jax.devices():
+        if _platform_of(d) == "tpu":
+            return TPUPlace(0)
+        if _platform_of(d) == "gpu":
+            return CUDAPlace(0)
+    return CPUPlace(0)
+
+
+_current_place: Place | None = None
+
+
+def set_device(device) -> Place:
+    """``paddle.set_device('tpu')`` / ``set_device('cpu')`` /
+    ``set_device('tpu:1')``."""
+    global _current_place
+    if isinstance(device, Place):
+        _current_place = device
+        return _current_place
+    name = str(device).lower()
+    idx = 0
+    if ":" in name:
+        name, sidx = name.split(":", 1)
+        idx = int(sidx)
+    cls = {"cpu": CPUPlace, "tpu": TPUPlace, "gpu": CUDAPlace,
+           "cuda": CUDAPlace, "xpu": TPUPlace}.get(name)
+    if cls is None:
+        raise ValueError(f"Unknown device {device!r}")
+    _current_place = cls(idx)
+    return _current_place
+
+
+def get_device() -> str:
+    p = current_place()
+    return f"{p.device_type}:{p.get_device_id()}"
+
+
+def current_place() -> Place:
+    return _current_place if _current_place is not None else _default_place()
+
+
+def is_compiled_with_tpu() -> bool:
+    return any(_platform_of(d) == "tpu" for d in jax.devices())

@@ -1,0 +1,114 @@
+"""RNG state management.
+
+Analog of the reference's ``Generator`` (/root/reference/paddle/phi/core/
+generator.cc) and ``paddle.seed`` (python/paddle/framework/random.py), rebuilt
+on jax's functional PRNG: the "generator state" is a PRNG key plus a split
+counter.
+
+Two regimes:
+
+* **Eager** — a process-global concrete key; every random op consumes a fresh
+  split. Reproducible via ``paddle.seed``.
+* **Traced** (inside a jitted train step) — a traced key is pushed with
+  :func:`rng_guard`; random ops split from it with a Python-side counter so
+  each op site gets a distinct, trace-stable stream. The caller feeds a fresh
+  key per step (e.g. folded from the step index), which keeps dropout masks
+  varying across steps without leaking host state into the trace.
+
+This mirrors the hybrid-parallel RNG tracker
+(python/paddle/distributed/fleet/meta_parallel/parallel_layers/random.py in
+the reference): named, seedable streams that stay deterministic under
+replay/recompute.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+import numpy as np
+
+
+class Generator:
+    """A seedable stream of PRNG keys."""
+
+    def __init__(self, seed: int = 0):
+        self.manual_seed(seed)
+
+    def manual_seed(self, seed: int):
+        self._seed = int(seed) % (2 ** 63)
+        self._key = jax.random.key(self._seed)
+        self._counter = 0
+        return self
+
+    def initial_seed(self) -> int:
+        return self._seed
+
+    def next_key(self):
+        self._counter += 1
+        return jax.random.fold_in(self._key, self._counter)
+
+    def get_state(self):
+        return (self._seed, self._counter)
+
+    def set_state(self, state):
+        self._seed, self._counter = state
+        self._key = jax.random.key(self._seed)
+
+
+class _TracedRng:
+    """Key provider used inside a trace: splits off a pushed traced key."""
+
+    def __init__(self, key):
+        self._key = key
+        self._counter = 0
+
+    def next_key(self):
+        self._counter += 1
+        return jax.random.fold_in(self._key, self._counter)
+
+
+_default_generator = Generator(np.random.randint(0, 2 ** 31 - 1))
+_tls = threading.local()
+
+
+def seed(value: int) -> Generator:
+    """``paddle.seed`` — reseed the global generator."""
+    return _default_generator.manual_seed(value)
+
+
+def default_generator() -> Generator:
+    return _default_generator
+
+
+def _stack():
+    if not hasattr(_tls, "stack"):
+        _tls.stack = []
+    return _tls.stack
+
+
+@contextlib.contextmanager
+def rng_guard(key):
+    """Route all random ops to splits of ``key`` (used by jitted train steps)."""
+    st = _stack()
+    st.append(_TracedRng(key))
+    try:
+        yield
+    finally:
+        st.pop()
+
+
+def next_key():
+    """The key every random op should consume."""
+    st = _stack()
+    if st:
+        return st[-1].next_key()
+    return _default_generator.next_key()
+
+
+def get_rng_state():
+    return _default_generator.get_state()
+
+
+def set_rng_state(state):
+    _default_generator.set_state(state)

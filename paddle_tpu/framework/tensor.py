@@ -1,0 +1,345 @@
+"""The Tensor facade and eager autograd tape.
+
+TPU-native replacement for the reference's dygraph stack: ``DenseTensor``
+(/root/reference/paddle/phi/core/dense_tensor.h:37) + eager autograd
+(``egr::RunBackward`` paddle/fluid/eager/backward.cc:539, ``GradNodeBase``
+eager/grad_node_info.h:162, ``TensorWrapper`` saved-tensor capture).
+
+Design: a Tensor wraps a ``jax.Array``. Every eager op call goes through
+:func:`paddle_tpu.framework.dispatch.call_op`, which (when grad is required)
+obtains the op's VJP via ``jax.vjp`` and records one ``GradNode`` on a tape.
+``Tensor.backward`` is a ready-queue topological walk over GradNodes — the
+same shape as ``RunBackward``'s in-degree walk — except each node's backward
+math is an XLA-compiled vjp closure rather than a hand-written CUDA grad
+kernel. Saved forward residuals live inside the vjp closure (the
+TensorWrapper analog) and are dropped after backward unless
+``retain_graph=True``.
+
+Under ``jax.jit`` tracing the same code paths work with tracer-backed
+Tensors, which is how the jitted train-step path (hapi / fleet) reuses the
+eager op library without a separate "static" op set.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import dtypes as _dtypes
+from .enforce import InvalidArgumentError, PreconditionNotMetError
+
+_no_grad_state = threading.local()
+
+
+def is_grad_enabled() -> bool:
+    return not getattr(_no_grad_state, "off", False)
+
+
+@contextlib.contextmanager
+def no_grad_guard():
+    old = getattr(_no_grad_state, "off", False)
+    _no_grad_state.off = True
+    try:
+        yield
+    finally:
+        _no_grad_state.off = old
+
+
+class no_grad:
+    """``paddle.no_grad`` — usable as context manager and decorator."""
+
+    def __enter__(self):
+        self._cm = no_grad_guard()
+        return self._cm.__enter__()
+
+    def __exit__(self, *exc):
+        return self._cm.__exit__(*exc)
+
+    def __call__(self, fn):
+        def wrapper(*a, **k):
+            with no_grad_guard():
+                return fn(*a, **k)
+
+        return wrapper
+
+
+class GradNode:
+    """One recorded op on the tape (analog of a codegen'd GradNode)."""
+
+    __slots__ = ("op_name", "vjp_fn", "inputs", "n_outputs", "out_treedef",
+                 "out_meta", "__weakref__")
+
+    def __init__(self, op_name, vjp_fn, inputs, n_outputs, out_treedef,
+                 out_meta):
+        self.op_name = op_name
+        self.vjp_fn = vjp_fn
+        self.inputs: List[Tensor] = inputs
+        self.n_outputs = n_outputs
+        self.out_treedef = out_treedef
+        self.out_meta = out_meta  # [(shape, dtype)] per flat output
+
+
+def _is_float_dtype(dt) -> bool:
+    return jnp.issubdtype(dt, jnp.floating) or jnp.issubdtype(
+        dt, jnp.complexfloating)
+
+
+class Tensor:
+    """Eager tensor over a jax.Array."""
+
+    __slots__ = ("_data", "stop_gradient", "grad", "_node", "_out_idx",
+                 "name", "persistable", "_retain_grads", "__weakref__",
+                 "__dict__")
+
+    _next_id = 0
+
+    def __init__(self, data, stop_gradient: bool = True,
+                 name: Optional[str] = None):
+        self._data = data  # jax.Array or tracer
+        self.stop_gradient = stop_gradient
+        self.grad: Optional[Tensor] = None
+        self._node: Optional[GradNode] = None
+        self._out_idx = 0
+        self._retain_grads = False
+        self.persistable = False
+        if name is None:
+            name = f"generated_tensor_{Tensor._next_id}"
+            Tensor._next_id += 1
+        self.name = name
+
+    # -- basic properties ---------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def dtype(self):
+        return self._data.dtype
+
+    @property
+    def size(self):
+        return int(np.prod(self._data.shape)) if self._data.shape else 1
+
+    @property
+    def is_leaf(self) -> bool:
+        return self._node is None
+
+    @property
+    def place(self):
+        from .place import current_place
+        return current_place()
+
+    def _requires_grad(self) -> bool:
+        return ((not self.stop_gradient) or self._node is not None) \
+            and _is_float_dtype(self._data.dtype)
+
+    # -- conversion ---------------------------------------------------------
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._data)
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._data)
+        return a.astype(dtype) if dtype is not None else a
+
+    def item(self):
+        return self._data.item()
+
+    def tolist(self):
+        return np.asarray(self._data).tolist()
+
+    def __float__(self):
+        return float(self._data)
+
+    def __int__(self):
+        return int(self._data)
+
+    def __bool__(self):
+        return bool(self._data)
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-D tensor")
+        return self._data.shape[0]
+
+    def __repr__(self):
+        try:
+            body = np.array2string(np.asarray(self._data), precision=8,
+                                   separator=", ")
+        except Exception:  # tracers
+            body = repr(self._data)
+        return (f"Tensor(shape={self.shape}, dtype={self._data.dtype}, "
+                f"stop_gradient={self.stop_gradient},\n       {body})")
+
+    # -- autograd -----------------------------------------------------------
+    def retain_grads(self):
+        self._retain_grads = True
+
+    def backward(self, grad_tensor=None, retain_graph: bool = False):
+        run_backward(self, grad_tensor, retain_graph)
+
+    def clear_grad(self):
+        self.grad = None
+
+    clear_gradient = clear_grad
+
+    def detach(self) -> "Tensor":
+        return Tensor(self._data, stop_gradient=True)
+
+    def clone(self) -> "Tensor":
+        from .dispatch import call_op
+        return call_op("assign", self)
+
+    def _rebind(self, new_value: "Tensor"):
+        """In-place mutation: take over another tensor's value and tape
+        position (used by setitem / *_ ops).
+
+        The recording op's ``inputs`` list references *this* object; once we
+        point ``self._node`` at that op, backward would route this input's
+        cotangent to the op itself (a cycle) and drop the upstream graph. So
+        the node's references to ``self`` are swapped for a snapshot tensor
+        carrying the pre-mutation tape position.
+        """
+        node = new_value._node
+        if node is not None:
+            if self._node is None and not self.stop_gradient:
+                raise PreconditionNotMetError(
+                    "in-place modification of a leaf tensor that requires "
+                    "grad; wrap the mutation in paddle.no_grad() or operate "
+                    "on a non-leaf result")
+            snapshot = None
+            for i, t in enumerate(node.inputs):
+                if t is self:
+                    if snapshot is None:
+                        snapshot = Tensor(self._data,
+                                          stop_gradient=self.stop_gradient)
+                        snapshot._node = self._node
+                        snapshot._out_idx = self._out_idx
+                        snapshot._retain_grads = self._retain_grads
+                    node.inputs[i] = snapshot
+        self._data = new_value._data
+        self._node = node
+        self._out_idx = new_value._out_idx
+        self.stop_gradient = new_value.stop_gradient
+
+    # pytree: allow Tensors to appear directly in jitted function args
+    def __jax_array__(self):
+        return self._data
+
+
+def _flatten_tensor(t: Tensor):
+    return (t._data,), (t.stop_gradient,)
+
+
+def _unflatten_tensor(aux, children):
+    return Tensor(children[0], stop_gradient=aux[0])
+
+
+jax.tree_util.register_pytree_node(Tensor, _flatten_tensor, _unflatten_tensor)
+
+
+class Parameter(Tensor):
+    """Trainable tensor (analog of framework::Parameter /
+    egr::GradNodeAccumulation leaves)."""
+
+    def __init__(self, data, name=None, trainable=True):
+        super().__init__(data, stop_gradient=not trainable, name=name)
+        self.persistable = True
+
+    @property
+    def trainable(self):
+        return not self.stop_gradient
+
+    @trainable.setter
+    def trainable(self, v):
+        self.stop_gradient = not v
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()
+
+
+# ---------------------------------------------------------------------------
+# backward engine (analog of egr::RunBackward, eager/backward.cc:539)
+# ---------------------------------------------------------------------------
+
+def run_backward(root: Tensor, grad_tensor=None, retain_graph=False):
+    if root._node is None:
+        if root.stop_gradient:
+            raise PreconditionNotMetError(
+                "backward() on a tensor with no grad graph")
+        return  # leaf: nothing to do
+    if grad_tensor is None:
+        if root.size != 1:
+            raise InvalidArgumentError(
+                "grad_tensor must be provided for non-scalar backward()")
+        seed = jnp.ones(root._data.shape, root._data.dtype)
+    else:
+        seed = grad_tensor._data if isinstance(grad_tensor, Tensor) \
+            else jnp.asarray(grad_tensor)
+
+    # topological order via iterative DFS
+    topo: List[GradNode] = []
+    state = {}  # id(node) -> 0 visiting / 1 done
+    stack = [(root._node, False)]
+    while stack:
+        node, processed = stack.pop()
+        if processed:
+            state[id(node)] = 1
+            topo.append(node)
+            continue
+        if id(node) in state:
+            continue
+        state[id(node)] = 0
+        stack.append((node, True))
+        for t in node.inputs:
+            if t._node is not None and id(t._node) not in state:
+                stack.append((t._node, False))
+
+    # cotangent accumulation per (node, out_idx)
+    cots = {id(root._node): [None] * root._node.n_outputs}
+    cots[id(root._node)][root._out_idx] = seed
+
+    for node in reversed(topo):
+        pending = cots.pop(id(node), None)
+        if pending is None or all(c is None for c in pending):
+            continue
+        if node.vjp_fn is None:
+            raise PreconditionNotMetError(
+                f"grad graph for op {node.op_name!r} was already freed; "
+                "pass retain_graph=True to backward() to reuse it")
+        flat_cots = [
+            c if c is not None else jnp.zeros(shape, dtype)
+            for c, (shape, dtype) in zip(pending, node.out_meta)
+        ]
+        out_cot = jax.tree_util.tree_unflatten(node.out_treedef, flat_cots)
+        in_grads = node.vjp_fn(out_cot)
+        if not retain_graph:
+            node.vjp_fn = None
+        for t, g in zip(node.inputs, in_grads):
+            if g is None or not _is_float_dtype(
+                    jnp.result_type(getattr(g, "dtype", jnp.float32))):
+            # float0 cotangents come back for int inputs — skip them
+                continue
+        # distribute
+            if t._node is not None:
+                slot = cots.setdefault(id(t._node), [None] * t._node.n_outputs)
+                slot[t._out_idx] = g if slot[t._out_idx] is None \
+                    else slot[t._out_idx] + g
+                if t._retain_grads:
+                    _accum_grad(t, g)
+            elif not t.stop_gradient:
+                _accum_grad(t, g)
+
+
+def _accum_grad(t: Tensor, g):
+    if t.grad is None:
+        t.grad = Tensor(g, stop_gradient=True, name=t.name + "@GRAD")
+    else:
+        t.grad._data = t.grad._data + g

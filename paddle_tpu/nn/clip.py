@@ -1,0 +1,85 @@
+"""Gradient clipping.
+
+Analog of the reference's ``python/paddle/nn/clip.py`` (ClipGradByValue,
+ClipGradByNorm, ClipGradByGlobalNorm). Clips operate on (param, grad) lists
+inside ``Optimizer.step``; the global-norm reduction is a pure jax reduction,
+so under a sharded train step XLA turns it into the cross-chip psum the
+reference implements by hand in HybridParallelOptimizer.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["ClipGradBase", "ClipGradByValue", "ClipGradByNorm",
+           "ClipGradByGlobalNorm", "clip_by_norm", "clip_by_global_norm"]
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def __call__(self, params_grads):
+        return [(p, jnp.clip(g, self.min, self.max)) for p, g in
+                params_grads]
+
+
+class ClipGradByNorm(ClipGradBase):
+    """Per-tensor L2-norm clip."""
+
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            norm = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+            scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12),
+                                1.0)
+            out.append((p, (g * scale).astype(g.dtype)))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    """Joint L2-norm clip over all grads (the default for LLM training)."""
+
+    def __init__(self, clip_norm, group_name="default_group",
+                 auto_skip_clip=False):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, params_grads):
+        if not params_grads:
+            return params_grads
+        sq = [jnp.sum(jnp.square(g.astype(jnp.float32)))
+              for p, g in params_grads
+              if not getattr(p, "need_clip", True) is False]
+        global_norm = jnp.sqrt(jnp.asarray(sq).sum())
+        scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
+        out = []
+        for p, g in params_grads:
+            if getattr(p, "need_clip", True) is False:
+                out.append((p, g))
+            else:
+                out.append((p, (g * scale).astype(g.dtype)))
+        return out
+
+
+def clip_by_norm(x, max_norm):
+    from ..framework.dispatch import call_op
+    import jax.numpy as jnp  # noqa: F811
+    norm = jnp.sqrt(jnp.sum(jnp.square(x._data)))
+    scale = jnp.minimum(max_norm / jnp.maximum(norm, 1e-12), 1.0)
+    from ..framework.tensor import Tensor
+    return call_op("scale", x, scale=scale, bias=0.0)
+
+
+def clip_by_global_norm(t_list, clip_norm):
+    clip = ClipGradByGlobalNorm(clip_norm)
+    pairs = [(t, t._data) for t in t_list]
+    from ..framework.tensor import Tensor
+    return [Tensor(g) for _, g in clip(pairs)]

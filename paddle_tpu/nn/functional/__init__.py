@@ -1,0 +1,525 @@
+"""``paddle.nn.functional`` — functional neural-net ops.
+
+Analog of the reference's ``python/paddle/nn/functional/`` (activation.py,
+common.py, conv.py, loss.py, norm.py, pooling.py, input.py). Every function
+dispatches through the op registry (framework/dispatch.py), so the same code
+runs eagerly and under jit tracing; XLA fuses what the reference hand-fused.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework import random as _random
+from ...framework.dispatch import call_op as _op
+from ...framework.tensor import Tensor
+
+__all__ = []
+
+
+def _export(fn):
+    __all__.append(fn.__name__)
+    return fn
+
+
+# ---------------------------------------------------------------------------
+# activations (reference: python/paddle/nn/functional/activation.py)
+# ---------------------------------------------------------------------------
+
+def _simple(name):
+    def fn(x, name=None):
+        return _op(name_, x)
+    name_ = name
+    fn.__name__ = name
+    return _export(fn)
+
+
+relu = _simple("relu")
+relu6 = _simple("relu6")
+sigmoid = _simple("sigmoid")
+tanh = _simple("tanh")
+silu = _simple("silu")
+swish = _simple("silu")
+mish = _simple("mish")
+tanhshrink = _simple("tanhshrink")
+log_sigmoid = _simple("log_sigmoid")
+hardswish = _simple("hardswish")
+softsign = _simple("softsign")
+
+
+@_export
+def gelu(x, approximate=False, name=None):
+    return _op("gelu", x, approximate=approximate)
+
+
+@_export
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return _op("leaky_relu", x, negative_slope=negative_slope)
+
+
+@_export
+def elu(x, alpha=1.0, name=None):
+    return _op("elu", x, alpha=alpha)
+
+
+@_export
+def celu(x, alpha=1.0, name=None):
+    return _op("celu", x, alpha=alpha)
+
+
+@_export
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return _op("selu", x, scale=scale, alpha=alpha)
+
+
+@_export
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return _op("hardsigmoid", x, slope=slope, offset=offset)
+
+
+@_export
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return _op("hardtanh", x, min=min, max=max)
+
+
+@_export
+def hardshrink(x, threshold=0.5, name=None):
+    return _op("hardshrink", x, threshold=threshold)
+
+
+@_export
+def softshrink(x, threshold=0.5, name=None):
+    return _op("softshrink", x, threshold=threshold)
+
+
+@_export
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return _op("softplus", x, beta=beta, threshold=threshold)
+
+
+@_export
+def thresholded_relu(x, threshold=1.0, name=None):
+    return _op("thresholded_relu", x, threshold=threshold)
+
+
+@_export
+def prelu(x, weight, data_format="NCHW", name=None):
+    return _op("prelu", x, weight)
+
+
+@_export
+def rrelu(x, lower=1. / 8., upper=1. / 3., training=True, name=None):
+    return _op("rrelu", x, _random.next_key(), lower=lower, upper=upper,
+               training=training)
+
+
+@_export
+def softmax(x, axis=-1, dtype=None, name=None):
+    out = _op("softmax", x, axis=axis)
+    if dtype is not None:
+        out = _op("cast", out, dtype=dtype)
+    return out
+
+
+@_export
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    out = _op("log_softmax", x, axis=axis)
+    if dtype is not None:
+        out = _op("cast", out, dtype=dtype)
+    return out
+
+
+@_export
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    return _op("gumbel_softmax", x, _random.next_key(),
+               temperature=temperature, hard=hard, axis=axis)
+
+
+@_export
+def maxout(x, groups, axis=1, name=None):
+    return _op("maxout", x, groups=groups, axis=axis)
+
+
+@_export
+def glu(x, axis=-1, name=None):
+    return _op("glu", x, axis=axis)
+
+
+# ---------------------------------------------------------------------------
+# common (reference: python/paddle/nn/functional/common.py)
+# ---------------------------------------------------------------------------
+
+@_export
+def linear(x, weight, bias=None, name=None):
+    return _op("linear", x, weight, bias)
+
+
+@_export
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    if not training or p == 0.0:
+        return x if isinstance(x, Tensor) else _op("assign", x)
+    return _op("dropout_raw", x, _random.next_key(), p=float(p), axis=axis,
+               mode=mode)
+
+
+@_export
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+@_export
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+@_export
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    return _op("alpha_dropout", x, _random.next_key(), p=float(p))
+
+
+@_export
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    if not isinstance(pad, (list, tuple)):
+        pad = np.asarray(pad).tolist()
+    return _op("pad", x, pad=tuple(int(p) for p in pad), mode=mode,
+               value=value, data_format=data_format)
+
+
+@_export
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    if isinstance(size, Tensor):
+        size = [int(v) for v in np.asarray(size._data)]
+    elif size is not None and not isinstance(size, (list, tuple)):
+        size = [int(size)]
+    elif size is not None:
+        size = [int(s._data) if isinstance(s, Tensor) else int(s)
+                for s in size]
+    return _op("interpolate", x, size=tuple(size) if size else None,
+               scale_factor=tuple(scale_factor)
+               if isinstance(scale_factor, (list, tuple))
+               else scale_factor,
+               mode=mode, align_corners=align_corners,
+               data_format=data_format)
+
+
+upsample = _export(lambda x, size=None, scale_factor=None, mode="nearest", \
+    align_corners=False, align_mode=0, data_format="NCHW", name=None: \
+    interpolate(x, size, scale_factor, mode, align_corners, align_mode,
+                data_format))
+upsample.__name__ = "upsample"
+
+
+@_export
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    return _op("embedding", x, weight, padding_idx=padding_idx)
+
+
+@_export
+def one_hot(x, num_classes, name=None):
+    return _op("one_hot", x, num_classes=num_classes)
+
+
+@_export
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    return _op("label_smooth", label, prior_dist, epsilon=epsilon)
+
+
+@_export
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    return _op("unfold", x, kernel_sizes=kernel_sizes, strides=strides,
+               paddings=paddings, dilations=dilations)
+
+
+@_export
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    return _op("cosine_similarity", x1, x2, axis=axis, eps=eps)
+
+
+@_export
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    return _op("pixel_shuffle", x, upscale_factor=upscale_factor,
+               data_format=data_format)
+
+
+@_export
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    return _op("normalize_l2", x, p=float(p), axis=axis, epsilon=epsilon)
+
+
+@_export
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    return _op("sequence_mask", x, maxlen=maxlen, dtype=dtype)
+
+
+# ---------------------------------------------------------------------------
+# conv / pooling (reference: conv.py, pooling.py)
+# ---------------------------------------------------------------------------
+
+@_export
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    return _op("conv1d", x, weight, bias, stride=stride, padding=padding,
+               dilation=dilation, groups=groups, data_format=data_format)
+
+
+@_export
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _op("conv2d", x, weight, bias, stride=stride, padding=padding,
+               dilation=dilation, groups=groups, data_format=data_format)
+
+
+@_export
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _op("conv3d", x, weight, bias, stride=stride, padding=padding,
+               dilation=dilation, groups=groups, data_format=data_format)
+
+
+@_export
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCHW", name=None):
+    return _op("conv2d_transpose", x, weight, bias, stride=stride,
+               padding=padding, output_padding=output_padding, groups=groups,
+               dilation=dilation, output_size=output_size,
+               data_format=data_format)
+
+
+@_export
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, name=None):
+    return _op("max_pool1d", x, kernel_size=kernel_size, stride=stride,
+               padding=padding, ceil_mode=ceil_mode)
+
+
+@_export
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, name=None):
+    return _op("avg_pool1d", x, kernel_size=kernel_size, stride=stride,
+               padding=padding, ceil_mode=ceil_mode, exclusive=exclusive)
+
+
+@_export
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    return _op("max_pool2d", x, kernel_size=kernel_size, stride=stride,
+               padding=padding, ceil_mode=ceil_mode, data_format=data_format)
+
+
+@_export
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    return _op("avg_pool2d", x, kernel_size=kernel_size, stride=stride,
+               padding=padding, ceil_mode=ceil_mode, exclusive=exclusive,
+               data_format=data_format)
+
+
+@_export
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    return _op("max_pool3d", x, kernel_size=kernel_size, stride=stride,
+               padding=padding, ceil_mode=ceil_mode, data_format=data_format)
+
+
+@_export
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _op("avg_pool3d", x, kernel_size=kernel_size, stride=stride,
+               padding=padding, ceil_mode=ceil_mode, exclusive=exclusive,
+               data_format=data_format)
+
+
+@_export
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _op("adaptive_avg_pool1d", x, output_size=output_size)
+
+
+@_export
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _op("adaptive_max_pool1d", x, output_size=output_size)
+
+
+@_export
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _op("adaptive_avg_pool2d", x, output_size=output_size,
+               data_format=data_format)
+
+
+@_export
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _op("adaptive_max_pool2d", x, output_size=output_size)
+
+
+# ---------------------------------------------------------------------------
+# norms (reference: norm.py)
+# ---------------------------------------------------------------------------
+
+@_export
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
+               name=None):
+    if isinstance(normalized_shape, (list, tuple)):
+        n_norm = len(normalized_shape)
+    else:
+        n_norm = 1
+    return _op("layer_norm", x, weight, bias, epsilon=epsilon,
+               begin_norm_axis=len(x.shape) - n_norm)
+
+
+@_export
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5,
+               data_format="NCHW", use_global_stats=None, name=None):
+    return _op("batch_norm", x, running_mean, running_var, weight, bias,
+               training=training if use_global_stats is None
+               else not use_global_stats,
+               momentum=momentum, epsilon=epsilon, data_format=data_format)
+
+
+@_export
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9,
+                  eps=1e-5, data_format="NCHW", name=None):
+    return _op("instance_norm", x, weight, bias, epsilon=eps)
+
+
+@_export
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    return _op("group_norm", x, weight, bias, epsilon=epsilon,
+               num_groups=num_groups, data_format=data_format)
+
+
+@_export
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    return _op("rms_norm", x, weight, epsilon=epsilon)
+
+
+@_export
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    return _op("local_response_norm", x, size=size, alpha=alpha, beta=beta,
+               k=k)
+
+
+# ---------------------------------------------------------------------------
+# losses (reference: loss.py)
+# ---------------------------------------------------------------------------
+
+@_export
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0, name=None):
+    return _op("cross_entropy", input, label, weight,
+               soft_label=soft_label, axis=axis, ignore_index=ignore_index,
+               reduction=reduction, use_softmax=use_softmax,
+               label_smoothing=label_smoothing)
+
+
+@_export
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    return _op("softmax_with_cross_entropy", logits, label,
+               soft_label=soft_label, axis=axis, ignore_index=ignore_index,
+               return_softmax=return_softmax)
+
+
+@_export
+def mse_loss(input, label, reduction="mean", name=None):
+    return _op("mse_loss", input, label, reduction=reduction)
+
+
+@_export
+def l1_loss(input, label, reduction="mean", name=None):
+    return _op("l1_loss", input, label, reduction=reduction)
+
+
+@_export
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    return _op("smooth_l1_loss", input, label, reduction=reduction,
+               delta=delta)
+
+
+@_export
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    return _op("nll_loss", input, label, weight, ignore_index=ignore_index,
+               reduction=reduction)
+
+
+@_export
+def binary_cross_entropy(input, label, weight=None, reduction="mean",
+                         name=None):
+    return _op("bce_loss", input, label, weight, reduction=reduction)
+
+
+@_export
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None,
+                                     name=None):
+    return _op("bce_with_logits", logit, label, weight, pos_weight,
+               reduction=reduction)
+
+
+@_export
+def kl_div(input, label, reduction="mean", name=None):
+    return _op("kl_div", input, label, reduction=reduction)
+
+
+@_export
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean",
+                         name=None):
+    return _op("hinge_embedding_loss", input, label, margin=margin,
+               reduction=reduction)
+
+
+@_export
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
+                        name=None):
+    return _op("margin_ranking_loss", input, other, label, margin=margin,
+               reduction=reduction)
+
+
+@_export
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    return _op("sigmoid_focal_loss", logit, label, normalizer, alpha=alpha,
+               gamma=gamma, reduction=reduction)
+
+
+@_export
+def huber_loss(input, label, delta=1.0, reduction="mean", name=None):
+    return _op("huber_loss", input, label, delta=delta, reduction=reduction)
+
+
+@_export
+def square_error_cost(input, label):
+    d = _op("subtract", input, label)
+    return _op("multiply", d, d)
+
+
+# ---------------------------------------------------------------------------
+# attention (reference: fused_attention / sparse_attention; TPU-native flash
+# attention lives behind this one entry point via a Pallas override)
+# ---------------------------------------------------------------------------
+
+@_export
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, name=None):
+    """Inputs are [batch, seq, num_heads, head_dim] (the reference's
+    fused-attention layout)."""
+    key_rng = _random.next_key() if (dropout_p > 0.0 and training) else None
+    return _op("scaled_dot_product_attention", query, key, value, attn_mask,
+               key_rng, dropout_p=dropout_p if training else 0.0,
+               is_causal=is_causal)

@@ -1,0 +1,208 @@
+"""Parameter initializers.
+
+Analog of the reference's ``python/paddle/fluid/initializer.py`` (Constant,
+Uniform, Normal, TruncatedNormal, Xavier, MSRA/Kaiming, Bilinear, Assign) and
+``python/paddle/nn/initializer/``. TPU-native difference: an initializer is a
+pure function ``(shape, dtype) -> jax array`` drawing from the functional PRNG
+(framework/random.py) instead of appending fill ops to a startup program.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework import random as _random
+from ...framework.dtypes import convert_dtype
+
+__all__ = [
+    "Initializer", "Constant", "Uniform", "Normal", "TruncatedNormal",
+    "XavierNormal", "XavierUniform", "KaimingNormal", "KaimingUniform",
+    "Assign", "Orthogonal", "Dirac", "calculate_gain",
+]
+
+
+def calculate_gain(nonlinearity, param=None):
+    recommended = {
+        "sigmoid": 1.0,
+        "linear": 1.0,
+        "conv1d": 1.0, "conv2d": 1.0, "conv3d": 1.0,
+        "conv1d_transpose": 1.0, "conv2d_transpose": 1.0,
+        "conv3d_transpose": 1.0,
+        "tanh": 5.0 / 3.0,
+        "relu": math.sqrt(2.0),
+        "leaky_relu": math.sqrt(2.0 / (1 + (param if param is not None
+                                             else 0.01) ** 2)),
+        "selu": 3.0 / 4.0,
+    }
+    if nonlinearity not in recommended:
+        raise ValueError(f"unsupported nonlinearity: {nonlinearity}")
+    return recommended[nonlinearity]
+
+
+def _fan_in_out(shape):
+    """Fan computation matching the reference's Xavier/MSRA initializers:
+    for conv weights (OIHW), receptive field multiplies the channel fans."""
+    shape = tuple(int(s) for s in shape)
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        # paddle Linear weight is [in_features, out_features]
+        return shape[0], shape[1]
+    receptive = int(np.prod(shape[2:]))
+    fan_in = shape[1] * receptive
+    fan_out = shape[0] * receptive
+    return fan_in, fan_out
+
+
+class Initializer:
+    def __call__(self, shape, dtype):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def __call__(self, shape, dtype):
+        return jnp.full(shape, self.value, dtype=convert_dtype(dtype))
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0, name=None):
+        self.low, self.high = low, high
+
+    def __call__(self, shape, dtype):
+        return jax.random.uniform(
+            _random.next_key(), shape, dtype=jnp.float32,
+            minval=self.low, maxval=self.high).astype(convert_dtype(dtype))
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0, name=None):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype):
+        a = jax.random.normal(_random.next_key(), shape, dtype=jnp.float32)
+        return (a * self.std + self.mean).astype(convert_dtype(dtype))
+
+
+class TruncatedNormal(Initializer):
+    """Normal truncated at 2 std devs (matches the reference's
+    truncated_gaussian_random op)."""
+
+    def __init__(self, mean=0.0, std=1.0, name=None):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype):
+        a = jax.random.truncated_normal(
+            _random.next_key(), -2.0, 2.0, shape, dtype=jnp.float32)
+        return (a * self.std + self.mean).astype(convert_dtype(dtype))
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0, name=None):
+        self._fan_in, self._fan_out, self._gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype):
+        fi, fo = _fan_in_out(shape)
+        fi = self._fan_in if self._fan_in is not None else fi
+        fo = self._fan_out if self._fan_out is not None else fo
+        limit = self._gain * math.sqrt(6.0 / (fi + fo))
+        return jax.random.uniform(
+            _random.next_key(), shape, dtype=jnp.float32,
+            minval=-limit, maxval=limit).astype(convert_dtype(dtype))
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0, name=None):
+        self._fan_in, self._fan_out, self._gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype):
+        fi, fo = _fan_in_out(shape)
+        fi = self._fan_in if self._fan_in is not None else fi
+        fo = self._fan_out if self._fan_out is not None else fo
+        std = self._gain * math.sqrt(2.0 / (fi + fo))
+        a = jax.random.normal(_random.next_key(), shape, dtype=jnp.float32)
+        return (a * std).astype(convert_dtype(dtype))
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu",
+                 name=None):
+        self._fan_in = fan_in
+        self._gain = calculate_gain(nonlinearity, negative_slope)
+
+    def __call__(self, shape, dtype):
+        fi, _ = _fan_in_out(shape)
+        fi = self._fan_in if self._fan_in is not None else fi
+        limit = self._gain * math.sqrt(3.0 / fi)
+        return jax.random.uniform(
+            _random.next_key(), shape, dtype=jnp.float32,
+            minval=-limit, maxval=limit).astype(convert_dtype(dtype))
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu",
+                 name=None):
+        self._fan_in = fan_in
+        self._gain = calculate_gain(nonlinearity, negative_slope)
+
+    def __call__(self, shape, dtype):
+        fi, _ = _fan_in_out(shape)
+        fi = self._fan_in if self._fan_in is not None else fi
+        std = self._gain / math.sqrt(fi)
+        a = jax.random.normal(_random.next_key(), shape, dtype=jnp.float32)
+        return (a * std).astype(convert_dtype(dtype))
+
+
+class Assign(Initializer):
+    def __init__(self, value, name=None):
+        self.value = value
+
+    def __call__(self, shape, dtype):
+        arr = jnp.asarray(np.asarray(self.value),
+                          dtype=convert_dtype(dtype))
+        if tuple(arr.shape) != tuple(shape):
+            arr = arr.reshape(shape)
+        return arr
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain=1.0, name=None):
+        self.gain = gain
+
+    def __call__(self, shape, dtype):
+        shape = tuple(int(s) for s in shape)
+        rows = shape[0]
+        cols = int(np.prod(shape[1:])) if len(shape) > 1 else 1
+        flat = (max(rows, cols), min(rows, cols))
+        a = jax.random.normal(_random.next_key(), flat, dtype=jnp.float32)
+        q, r = jnp.linalg.qr(a)
+        q = q * jnp.sign(jnp.diag(r))
+        if rows < cols:
+            q = q.T
+        return (self.gain * q[:rows, :cols]).reshape(shape).astype(
+            convert_dtype(dtype))
+
+
+class Dirac(Initializer):
+    """Identity-preserving conv init (reference nn/initializer/dirac.py)."""
+
+    def __init__(self, groups=1, name=None):
+        self.groups = groups
+
+    def __call__(self, shape, dtype):
+        shape = tuple(int(s) for s in shape)
+        out_c, in_c = shape[0], shape[1]
+        w = np.zeros(shape, dtype=np.float32)
+        centre = tuple(s // 2 for s in shape[2:])
+        per_group = out_c // self.groups
+        for g in range(self.groups):
+            for i in range(min(per_group, in_c)):
+                w[(g * per_group + i, i) + centre] = 1.0
+        return jnp.asarray(w, dtype=convert_dtype(dtype))

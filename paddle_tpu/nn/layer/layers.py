@@ -1,0 +1,416 @@
+"""Layer base class.
+
+Analog of the reference's ``paddle.nn.Layer``
+(/root/reference/python/paddle/fluid/dygraph/layers.py): parameter/sublayer
+registration, hooks, state_dict, train/eval mode, ``to()`` dtype moves.
+
+TPU-native addition: :func:`functional_state` — temporarily swap a pytree of
+arrays into the layer's parameters/buffers so a pure ``fn(params, batch)``
+can be traced by ``jax.jit``/``jax.grad``. This is the bridge between the
+stateful dygraph API and jax's functional transforms (replacing the
+reference's dygraph→static ProgramTranslator for the common training path).
+"""
+from __future__ import annotations
+
+import contextlib
+from collections import OrderedDict
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.dtypes import convert_dtype, get_default_dtype
+from ...framework.tensor import Parameter, Tensor, no_grad_guard
+
+
+class ParamAttr:
+    """Analog of paddle.ParamAttr (python/paddle/fluid/param_attr.py)."""
+
+    def __init__(self, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, need_clip=True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.need_clip = need_clip
+
+    @staticmethod
+    def _to_attr(attr):
+        if attr is None:
+            return ParamAttr()
+        if isinstance(attr, ParamAttr):
+            return attr
+        if attr is False:
+            return False
+        if isinstance(attr, str):
+            return ParamAttr(name=attr)
+        # an Initializer instance
+        return ParamAttr(initializer=attr)
+
+
+class HookRemoveHelper:
+    def __init__(self, hooks, hook_id):
+        self._hooks = hooks
+        self._hook_id = hook_id
+
+    def remove(self):
+        self._hooks.pop(self._hook_id, None)
+
+
+class Layer:
+    """Base class for all network layers."""
+
+    def __init__(self, name_scope=None, dtype=None):
+        self._dtype = convert_dtype(dtype) if dtype else get_default_dtype()
+        self._parameters: "OrderedDict[str, Parameter]" = OrderedDict()
+        self._sub_layers: "OrderedDict[str, Layer]" = OrderedDict()
+        self._buffers: "OrderedDict[str, Tensor]" = OrderedDict()
+        self._non_persistable_buffer_names = set()
+        self.training = True
+        self._forward_pre_hooks: Dict[int, Callable] = OrderedDict()
+        self._forward_post_hooks: Dict[int, Callable] = OrderedDict()
+        self._next_hook_id = 0
+        self._name_scope = name_scope or self.__class__.__name__.lower()
+
+    # -- attribute magic ----------------------------------------------------
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        buffers = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError(
+                    "call super().__init__() before assigning parameters")
+            for d in (layers, buffers):
+                d.pop(name, None) if d else None
+            params[name] = value
+            object.__getattribute__(self, "__dict__").pop(name, None)
+        elif isinstance(value, Layer):
+            if layers is None:
+                raise RuntimeError(
+                    "call super().__init__() before assigning sublayers")
+            layers[name] = value
+            object.__getattribute__(self, "__dict__").pop(name, None)
+        elif buffers is not None and name in buffers:
+            if value is None:
+                buffers[name] = None
+            else:
+                buffers[name] = value if isinstance(value, Tensor) \
+                    else Tensor(jnp.asarray(value))
+        else:
+            if params is not None and name in params:
+                if value is None or isinstance(value, Tensor):
+                    params.pop(name)
+                    if value is not None:
+                        object.__setattr__(self, name, value)
+                    return
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(
+            f"'{type(self).__name__}' object has no attribute '{name}'")
+
+    def __delattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                del d[name]
+                return
+        object.__delattr__(self, name)
+
+    # -- registration -------------------------------------------------------
+    def add_parameter(self, name, parameter):
+        if parameter is not None and not isinstance(parameter, Parameter):
+            raise TypeError("add_parameter expects a Parameter")
+        self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name, sublayer):
+        self._sub_layers[str(name)] = sublayer
+        return sublayer
+
+    def register_buffer(self, name, tensor, persistable=True):
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names.add(name)
+        return tensor
+
+    def create_parameter(self, shape, attr=None, dtype=None,
+                         is_bias=False, default_initializer=None):
+        from ..initializer import Constant, XavierUniform
+        attr = ParamAttr._to_attr(attr)
+        if attr is False:
+            return None
+        dtype = convert_dtype(dtype) if dtype else self._dtype
+        init = attr.initializer or default_initializer or \
+            (Constant(0.0) if is_bias else XavierUniform())
+        data = init(tuple(shape), dtype)
+        p = Parameter(data, name=attr.name, trainable=attr.trainable)
+        p.optimize_attr = {"learning_rate": attr.learning_rate}
+        p.regularizer = attr.regularizer
+        p.need_clip = attr.need_clip
+        return p
+
+    # -- iteration ----------------------------------------------------------
+    def parameters(self, include_sublayers=True) -> List[Parameter]:
+        return [p for _, p in self.named_parameters(
+            include_sublayers=include_sublayers)]
+
+    def named_parameters(self, prefix="", include_sublayers=True
+                         ) -> Iterator[Tuple[str, Parameter]]:
+        seen = set()
+        for _, layer_prefix, layer in self._walk(prefix, include_sublayers):
+            for pname, p in layer._parameters.items():
+                if p is None or id(p) in seen:
+                    continue
+                seen.add(id(p))
+                yield ((layer_prefix + "." + pname if layer_prefix
+                        else pname), p)
+
+    def _walk(self, prefix="", include_sublayers=True):
+        yield None, prefix, self
+        if include_sublayers:
+            for sname, sub in self._sub_layers.items():
+                if sub is None:
+                    continue
+                sub_prefix = prefix + "." + sname if prefix else sname
+                yield from sub._walk(sub_prefix, True)
+
+    def named_sublayers(self, prefix="", include_self=False):
+        if include_self:
+            yield prefix, self
+        for sname, sub in self._sub_layers.items():
+            if sub is None:
+                continue
+            p = prefix + "." + sname if prefix else sname
+            yield p, sub
+            yield from sub.named_sublayers(p)
+
+    def sublayers(self, include_self=False):
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def children(self):
+        return iter(l for l in self._sub_layers.values() if l is not None)
+
+    def named_children(self):
+        return iter((n, l) for n, l in self._sub_layers.items()
+                    if l is not None)
+
+    def named_buffers(self, prefix="", include_sublayers=True):
+        for _, layer_prefix, layer in self._walk(prefix, include_sublayers):
+            for bname, b in layer._buffers.items():
+                if b is None:
+                    continue
+                yield ((layer_prefix + "." + bname if layer_prefix
+                        else bname), b)
+
+    def buffers(self, include_sublayers=True):
+        return [b for _, b in self.named_buffers(
+            include_sublayers=include_sublayers)]
+
+    # -- mode ---------------------------------------------------------------
+    def train(self):
+        self.training = True
+        for l in self.sublayers():
+            l.training = True
+        return self
+
+    def eval(self):
+        self.training = False
+        for l in self.sublayers():
+            l.training = False
+        return self
+
+    def apply(self, fn):
+        for l in self.sublayers(include_self=True):
+            fn(l)
+        return self
+
+    # -- hooks --------------------------------------------------------------
+    def register_forward_pre_hook(self, hook):
+        self._next_hook_id += 1
+        self._forward_pre_hooks[self._next_hook_id] = hook
+        return HookRemoveHelper(self._forward_pre_hooks, self._next_hook_id)
+
+    def register_forward_post_hook(self, hook):
+        self._next_hook_id += 1
+        self._forward_post_hooks[self._next_hook_id] = hook
+        return HookRemoveHelper(self._forward_post_hooks, self._next_hook_id)
+
+    # -- call ---------------------------------------------------------------
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *inputs, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            result = hook(self, inputs)
+            if result is not None:
+                inputs = result if isinstance(result, tuple) else (result,)
+        out = self.forward(*inputs, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            result = hook(self, inputs, out)
+            if result is not None:
+                out = result
+        return out
+
+    # -- state dict ---------------------------------------------------------
+    def state_dict(self, destination=None, include_sublayers=True,
+                   use_hook=True):
+        dest = OrderedDict() if destination is None else destination
+        for name, p in self.named_parameters(
+                include_sublayers=include_sublayers):
+            dest[name] = p
+        for name, b in self.named_buffers(
+                include_sublayers=include_sublayers):
+            short = name.rsplit(".", 1)[-1]
+            owner = self._locate_owner(name)
+            if owner is not None and \
+                    short in owner._non_persistable_buffer_names:
+                continue
+            dest[name] = b
+        return dest
+
+    def _locate_owner(self, qualified_name):
+        parts = qualified_name.split(".")[:-1]
+        layer = self
+        for p in parts:
+            layer = layer._sub_layers.get(p)
+            if layer is None:
+                return None
+        return layer
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        own = self.state_dict()
+        missing, unexpected = [], []
+        for name, target in own.items():
+            if name in state_dict:
+                value = state_dict[name]
+                arr = value._data if isinstance(value, Tensor) \
+                    else jnp.asarray(value)
+                if tuple(arr.shape) != tuple(target._data.shape):
+                    raise ValueError(
+                        f"shape mismatch for {name}: {arr.shape} vs "
+                        f"{target._data.shape}")
+                target._data = arr.astype(target._data.dtype)
+            else:
+                missing.append(name)
+        for k in state_dict:
+            if k not in own:
+                unexpected.append(k)
+        return missing, unexpected
+
+    set_dict = set_state_dict
+    load_dict = set_state_dict
+
+    # -- dtype / device -----------------------------------------------------
+    def to(self, device=None, dtype=None, blocking=None):
+        if dtype is not None:
+            dt = convert_dtype(dtype)
+            for p in self.parameters():
+                if jnp.issubdtype(p._data.dtype, jnp.floating):
+                    p._data = p._data.astype(dt)
+            for b in self.buffers():
+                if jnp.issubdtype(b._data.dtype, jnp.floating):
+                    b._data = b._data.astype(dt)
+            for l in self.sublayers(include_self=True):
+                l._dtype = dt
+        return self
+
+    def astype(self, dtype):
+        return self.to(dtype=dtype)
+
+    def float(self):
+        return self.to(dtype="float32")
+
+    def half(self):
+        return self.to(dtype="float16")
+
+    def bfloat16(self):
+        return self.to(dtype="bfloat16")
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_grad()
+
+    def full_name(self):
+        return self._name_scope
+
+    def extra_repr(self):
+        return ""
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = []
+        for name, sub in self._sub_layers.items():
+            sub_repr = repr(sub).split("\n")
+            sub_repr = "\n  ".join(sub_repr)
+            lines.append(f"({name}): {sub_repr}")
+        body = ",\n  ".join(lines)
+        if body:
+            return f"{type(self).__name__}({extra}\n  {body}\n)"
+        return f"{type(self).__name__}({extra})"
+
+
+# ---------------------------------------------------------------------------
+# functional bridge (jit/grad over stateful Layers)
+# ---------------------------------------------------------------------------
+
+def get_params_tree(layer: Layer) -> Dict[str, jnp.ndarray]:
+    return {name: p._data for name, p in layer.named_parameters()}
+
+
+def get_buffers_tree(layer: Layer) -> Dict[str, jnp.ndarray]:
+    return {name: b._data for name, b in layer.named_buffers()}
+
+
+@contextlib.contextmanager
+def functional_state(layer: Layer, params: Dict[str, jnp.ndarray],
+                     buffers: Optional[Dict[str, jnp.ndarray]] = None):
+    """Swap arrays into the layer, yield, restore; collect buffer updates.
+
+    Inside the context the layer's parameters/buffers hold (possibly traced)
+    arrays from ``params``/``buffers``. On exit, ``updated_buffers`` holds
+    the final buffer values (e.g. BN running stats written during forward).
+    """
+    param_objs = dict(layer.named_parameters())
+    buffer_objs = dict(layer.named_buffers())
+    old_params = {k: p._data for k, p in param_objs.items()}
+    old_buffers = {k: b._data for k, b in buffer_objs.items()}
+    result = {}
+    try:
+        for k, arr in params.items():
+            if k in param_objs:
+                param_objs[k]._data = arr
+        if buffers:
+            for k, arr in buffers.items():
+                if k in buffer_objs:
+                    buffer_objs[k]._data = arr
+        yield result
+        result["updated_buffers"] = {
+            k: b._data for k, b in layer.named_buffers()}
+    finally:
+        for k, p in param_objs.items():
+            p._data = old_params[k]
+        for k, b in buffer_objs.items():
+            b._data = old_buffers[k]
+
+
+def functional_call(layer: Layer, params, buffers, *inputs, **kwargs):
+    """Pure functional forward: returns (outputs, updated_buffers).
+
+    Gradient tape is disabled inside — jax.grad provides autodiff on the
+    functional path, so tape recording would only waste memory.
+    """
+    with functional_state(layer, params, buffers) as st:
+        with no_grad_guard():
+            wrapped = [Tensor(x, stop_gradient=True)
+                       if isinstance(x, (jax.Array, jnp.ndarray, np.ndarray))
+                       and not isinstance(x, Tensor) else x for x in inputs]
+            out = layer(*wrapped, **kwargs)
+    return out, st["updated_buffers"]

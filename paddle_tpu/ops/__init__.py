@@ -1,0 +1,14 @@
+"""Op library: pure-jax implementations behind the dispatch layer.
+
+The registry is the analog of the reference's phi KernelFactory; the modules
+here are the analog of paddle/phi/kernels/* (reference has 358 op families —
+see SURVEY.md §2.1).
+"""
+from .registry import (get_op, has_op, op_names, register_op,  # noqa: F401
+                       register_override)
+
+from . import math_ops  # noqa: F401
+from . import creation_ops  # noqa: F401
+from . import manipulation_ops  # noqa: F401
+from . import linalg_ops  # noqa: F401
+from . import nn_ops  # noqa: F401

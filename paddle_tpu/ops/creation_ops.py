@@ -1,0 +1,173 @@
+"""Tensor creation & random op implementations.
+
+Analog of phi's full/empty/arange/gaussian/uniform kernels
+(/root/reference/paddle/phi/kernels/full_kernel.h, gaussian_random_kernel.h,
+uniform_random_kernel.h) — jax PRNG keys replace the reference's per-device
+curand generators (phi/core/generator.cc).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+@register_op("full", nondiff=True)
+def _full(shape=(), fill_value=0.0, dtype=None):
+    return jnp.full(tuple(shape), fill_value, dtype=dtype)
+
+
+@register_op("arange", nondiff=True)
+def _arange(start=0, end=None, step=1, dtype=None):
+    return jnp.arange(start, end, step, dtype=dtype)
+
+
+@register_op("linspace", nondiff=True)
+def _linspace(start, stop, num, dtype=None):
+    return jnp.linspace(start, stop, int(num), dtype=dtype)
+
+
+@register_op("logspace", nondiff=True)
+def _logspace(start, stop, num, base=10.0, dtype=None):
+    return jnp.logspace(start, stop, int(num), base=base, dtype=dtype)
+
+
+@register_op("eye", nondiff=True)
+def _eye(num_rows, num_columns=None, dtype=None):
+    return jnp.eye(int(num_rows),
+                   int(num_columns) if num_columns is not None else None,
+                   dtype=dtype)
+
+
+@register_op("full_like", nondiff=True)
+def _full_like(x, fill_value, dtype=None):
+    return jnp.full_like(x, fill_value, dtype=dtype)
+
+
+@register_op("tril")
+def _tril(x, diagonal=0):
+    return jnp.tril(x, k=diagonal)
+
+
+@register_op("triu")
+def _triu(x, diagonal=0):
+    return jnp.triu(x, k=diagonal)
+
+
+@register_op("diag")
+def _diag(x, offset=0, padding_value=0):
+    if x.ndim == 1 and padding_value != 0:
+        d = jnp.diag(x, k=offset)
+        mask = jnp.eye(d.shape[0], d.shape[1], k=offset, dtype=bool)
+        return jnp.where(mask, d, jnp.asarray(padding_value, d.dtype))
+    return jnp.diag(x, k=offset)
+
+
+@register_op("diagflat")
+def _diagflat(x, offset=0):
+    return jnp.diagflat(x, k=offset)
+
+
+@register_op("diag_embed")
+def _diag_embed(x, offset=0, dim1=-2, dim2=-1):
+    out = jnp.zeros(x.shape + (x.shape[-1],), x.dtype)
+    out = jnp.vectorize(lambda v: jnp.diag(v, k=offset),
+                        signature="(n)->(m,m)")(x)
+    if (dim1, dim2) != (-2, -1):
+        out = jnp.moveaxis(out, (-2, -1), (dim1, dim2))
+    return out
+
+
+@register_op("diagonal")
+def _diagonal(x, offset=0, axis1=0, axis2=1):
+    return jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@register_op("meshgrid")
+def _meshgrid(xs):
+    return tuple(jnp.meshgrid(*xs, indexing="ij"))
+
+
+@register_op("assign")
+def _assign(x):
+    return jnp.asarray(x)
+
+
+@register_op("cast")
+def _cast(x, dtype):
+    return x.astype(dtype)
+
+
+# -- random (keys passed explicitly as array args, see framework.random) ----
+
+@register_op("uniform_random", nondiff=True)
+def _uniform(key, shape=(), dtype="float32", min=-1.0, max=1.0):
+    return jax.random.uniform(key, tuple(shape), dtype=jnp.dtype(dtype),
+                              minval=min, maxval=max)
+
+
+@register_op("gaussian_random", nondiff=True)
+def _gaussian(key, shape=(), dtype="float32", mean=0.0, std=1.0):
+    return mean + std * jax.random.normal(key, tuple(shape),
+                                          dtype=jnp.dtype(dtype))
+
+
+@register_op("randint", nondiff=True)
+def _randint(key, low, high=None, shape=(), dtype="int64"):
+    if high is None:
+        low, high = 0, low
+    return jax.random.randint(key, tuple(shape), low, high,
+                              dtype=jnp.dtype(dtype))
+
+
+@register_op("randperm", nondiff=True)
+def _randperm(key, n, dtype="int64"):
+    return jax.random.permutation(key, int(n)).astype(dtype)
+
+
+@register_op("bernoulli", nondiff=True)
+def _bernoulli(key, p):
+    return jax.random.bernoulli(key, p).astype(p.dtype)
+
+
+@register_op("multinomial", nondiff=True)
+def _multinomial(key, x, num_samples=1, replacement=False):
+    logits = jnp.log(jnp.maximum(x, 1e-30))
+    if replacement:
+        return jax.random.categorical(
+            key, logits, axis=-1,
+            shape=x.shape[:-1] + (int(num_samples),)).astype(jnp.int64)
+    # without replacement: gumbel top-k
+    g = jax.random.gumbel(key, x.shape, dtype=jnp.float32)
+    _, idx = jax.lax.top_k(logits + g, int(num_samples))
+    return idx.astype(jnp.int64)
+
+
+@register_op("standard_gamma", nondiff=True)
+def _standard_gamma(key, alpha):
+    return jax.random.gamma(key, alpha)
+
+
+@register_op("poisson", nondiff=True)
+def _poisson(key, x):
+    return jax.random.poisson(key, x).astype(x.dtype)
+
+
+@register_op("exponential", nondiff=True)
+def _exponential(key, x, lam=1.0):
+    return jax.random.exponential(key, x.shape, x.dtype) / lam
+
+
+@register_op("dropout_raw", nondiff=False)
+def _dropout(x, key, p=0.5, training=True, mode="upscale_in_train"):
+    # reference: phi/kernels/dropout_kernel.h semantics
+    if not training or p == 0.0:
+        return x
+    if p == 1.0:
+        return jnp.zeros_like(x)
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    if mode == "upscale_in_train":
+        return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+    return jnp.where(mask, x, 0.0).astype(x.dtype)

@@ -1,0 +1,197 @@
+"""Linear algebra op implementations.
+
+Analog of phi's matmul/blas family (/root/reference/paddle/phi/kernels/
+matmul_kernel.h, funcs/blas/) and the linalg decompositions
+(cholesky_kernel.h, svd_kernel.h, ...). Matmuls lower straight to the MXU via
+``lax.dot_general``; on TPU we prefer bf16 inputs with f32 accumulation
+(``preferred_element_type``), matching cuBLAS TF32/FP16 tensor-core behavior
+in the reference.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op
+
+
+@register_op("matmul")
+def _matmul(x, y, transpose_x=False, transpose_y=False):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    acc = jnp.float32 if x.dtype in (jnp.bfloat16, jnp.float16) else None
+    out = jnp.matmul(x, y, preferred_element_type=acc)
+    return out.astype(x.dtype) if acc is not None else out
+
+
+@register_op("dot")
+def _dot(x, y):
+    return jnp.sum(x * y, axis=-1)
+
+
+@register_op("bmm")
+def _bmm(x, y):
+    return jnp.matmul(x, y)
+
+
+@register_op("mv")
+def _mv(x, vec):
+    return jnp.matmul(x, vec)
+
+
+@register_op("outer")
+def _outer(x, y):
+    return jnp.outer(x, y)
+
+
+@register_op("inner")
+def _inner(x, y):
+    return jnp.inner(x, y)
+
+
+@register_op("cross")
+def _cross(x, y, axis=None):
+    ax = -1
+    if axis is not None:
+        ax = axis
+    else:
+        for i, s in enumerate(x.shape):
+            if s == 3:
+                ax = i
+                break
+    return jnp.cross(x, y, axis=ax)
+
+
+@register_op("kron")
+def _kron(x, y):
+    return jnp.kron(x, y)
+
+
+@register_op("addmm")
+def _addmm(input, x, y, beta=1.0, alpha=1.0):
+    return beta * input + alpha * jnp.matmul(x, y)
+
+
+@register_op("einsum")
+def _einsum(xs, equation=""):
+    return jnp.einsum(equation, *xs)
+
+
+@register_op("p_norm")
+def _p_norm(x, porder=2.0, axis=None, keepdim=False, epsilon=1e-12):
+    if porder == float("inf"):
+        return jnp.max(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if porder == float("-inf"):
+        return jnp.min(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if porder == 0:
+        return jnp.sum((x != 0).astype(x.dtype), axis=axis, keepdims=keepdim)
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    s = jnp.sum(jnp.abs(x) ** porder, axis=ax, keepdims=keepdim)
+    return s ** (1.0 / porder)
+
+
+@register_op("frobenius_norm")
+def _frobenius_norm(x, axis=None, keepdim=False):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return jnp.sqrt(jnp.sum(x * x, axis=ax, keepdims=keepdim))
+
+
+for _name, _fn in {
+    "cholesky": jnp.linalg.cholesky,
+    "inverse": jnp.linalg.inv,
+    "pinv": jnp.linalg.pinv,
+    "matrix_rank": jnp.linalg.matrix_rank,
+    "slogdet": lambda x: tuple(jnp.linalg.slogdet(x)),
+    "det": jnp.linalg.det,
+}.items():
+    register_op(_name)(_fn)
+
+
+@register_op("qr")
+def _qr(x, mode="reduced"):
+    return tuple(jnp.linalg.qr(x, mode=mode))
+
+
+@register_op("svd")
+def _svd(x, full_matrices=False):
+    u, s, vh = jnp.linalg.svd(x, full_matrices=full_matrices)
+    return u, s, vh
+
+
+@register_op("eig")
+def _eig(x):
+    # CPU-only in jax; evaluated via callback on TPU paths if needed
+    return tuple(jnp.linalg.eig(x))
+
+
+@register_op("eigh")
+def _eigh(x, UPLO="L"):
+    return tuple(jnp.linalg.eigh(x, UPLO=UPLO))
+
+
+@register_op("eigvals")
+def _eigvals(x):
+    return jnp.linalg.eigvals(x)
+
+
+@register_op("eigvalsh")
+def _eigvalsh(x, UPLO="L"):
+    return jnp.linalg.eigvalsh(x, UPLO=UPLO)
+
+
+@register_op("matrix_power")
+def _matrix_power(x, n):
+    return jnp.linalg.matrix_power(x, int(n))
+
+
+@register_op("solve")
+def _solve(x, y):
+    return jnp.linalg.solve(x, y)
+
+
+@register_op("triangular_solve")
+def _triangular_solve(x, y, upper=True, transpose=False, unitriangular=False):
+    return jax.scipy.linalg.solve_triangular(
+        x, y, lower=not upper, trans=1 if transpose else 0,
+        unit_diagonal=unitriangular)
+
+
+@register_op("cholesky_solve")
+def _cholesky_solve(x, y, upper=False):
+    return jax.scipy.linalg.cho_solve((y, not upper), x)
+
+
+@register_op("lstsq")
+def _lstsq(x, y, rcond=None):
+    sol, res, rank, sv = jnp.linalg.lstsq(x, y, rcond=rcond)
+    return sol, res, rank, sv
+
+
+@register_op("lu")
+def _lu(x):
+    lu, piv = jax.scipy.linalg.lu_factor(x)
+    return lu, piv.astype(jnp.int32) + 1  # paddle pivots are 1-based
+
+
+@register_op("histogram", nondiff=True)
+def _histogram(x, bins=100, min=0, max=0):
+    if min == 0 and max == 0:
+        lo, hi = jnp.min(x), jnp.max(x)
+    else:
+        lo, hi = min, max
+    h, _ = jnp.histogram(x, bins=int(bins), range=(lo, hi))
+    return h.astype(jnp.int64)
+
+
+# jit=False: output length is max(x)+1, a data-dependent shape.
+@register_op("bincount", nondiff=True, jit=False)
+def _bincount(x, weights=None, minlength=0):
+    return jnp.bincount(x, weights=weights, minlength=int(minlength))
+
+
+@register_op("matrix_nms", nondiff=True, jit=False)
+def _unavailable(*a, **k):
+    raise NotImplementedError("matrix_nms pending detection-op milestone")

@@ -1,0 +1,459 @@
+"""Shape/layout manipulation, indexing, search & sort op implementations.
+
+Analog of phi's manipulation family (/root/reference/paddle/phi/kernels/
+reshape_kernel.h, concat_kernel.h, gather_kernel.h, scatter_kernel.h,
+top_k_kernel.h, ...). Gather/scatter map to XLA gather/scatter which TPU
+executes natively; dynamic-shape ops (unique, nonzero, masked_select) expose
+a ``size``-bounded variant where needed for jit-ability.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op
+
+
+@register_op("reshape")
+def _reshape(x, shape):
+    return jnp.reshape(x, tuple(shape))
+
+
+@register_op("transpose")
+def _transpose(x, perm):
+    return jnp.transpose(x, tuple(perm))
+
+
+@register_op("concat")
+def _concat(xs, axis=0):
+    return jnp.concatenate(xs, axis=int(axis))
+
+
+@register_op("stack")
+def _stack(xs, axis=0):
+    return jnp.stack(xs, axis=int(axis))
+
+
+@register_op("unstack")
+def _unstack(x, axis=0, num=None):
+    n = num if num is not None else x.shape[axis]
+    return tuple(jnp.squeeze(s, axis=axis)
+                 for s in jnp.split(x, n, axis=axis))
+
+
+@register_op("split")
+def _split(x, num_or_sections, axis=0):
+    axis = int(axis)
+    if isinstance(num_or_sections, int):
+        return tuple(jnp.split(x, num_or_sections, axis=axis))
+    sections = list(num_or_sections)
+    # allow one -1 entry like the reference (phi SplitInferMeta)
+    if -1 in sections:
+        known = sum(s for s in sections if s != -1)
+        sections[sections.index(-1)] = x.shape[axis] - known
+    idx = []
+    acc = 0
+    for s in sections[:-1]:
+        acc += s
+        idx.append(acc)
+    return tuple(jnp.split(x, idx, axis=axis))
+
+
+@register_op("squeeze")
+def _squeeze(x, axis=None):
+    if axis is None:
+        return jnp.squeeze(x)
+    if isinstance(axis, (list, tuple)):
+        ax = tuple(a for a in axis if x.shape[a] == 1)
+        return jnp.squeeze(x, axis=ax) if ax else x
+    if x.shape[axis] != 1:
+        return x
+    return jnp.squeeze(x, axis=axis)
+
+
+@register_op("unsqueeze")
+def _unsqueeze(x, axis):
+    if isinstance(axis, (list, tuple)):
+        out = x
+        for a in sorted(axis):
+            out = jnp.expand_dims(out, a)
+        return out
+    return jnp.expand_dims(x, int(axis))
+
+
+@register_op("flatten")
+def _flatten(x, start_axis=0, stop_axis=-1):
+    nd = x.ndim
+    if nd == 0:
+        return x.reshape((1,))
+    s = start_axis % nd
+    e = stop_axis % nd
+    shape = x.shape[:s] + (-1,) + x.shape[e + 1:]
+    return x.reshape(shape)
+
+
+@register_op("gather")
+def _gather(x, index, axis=0):
+    idx = index
+    if idx.ndim == 0:
+        idx = idx[None]
+    return jnp.take(x, idx, axis=int(axis))
+
+
+@register_op("gather_nd")
+def _gather_nd(x, index):
+    # reference: phi/kernels/gather_nd_kernel.h — index[..., k] indexes the
+    # first k dims of x.
+    k = index.shape[-1]
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x[idx]
+
+
+@register_op("scatter")
+def _scatter(x, index, updates, overwrite=True):
+    idx = index.reshape(-1)
+    if overwrite:
+        return x.at[idx].set(updates)
+    # paddle overwrite=False: zero the rows then accumulate
+    zeroed = x.at[idx].set(jnp.zeros_like(updates))
+    return zeroed.at[idx].add(updates)
+
+
+@register_op("scatter_nd_add")
+def _scatter_nd_add(x, index, updates):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x.at[idx].add(updates)
+
+
+@register_op("index_select")
+def _index_select(x, index, axis=0):
+    return jnp.take(x, index, axis=int(axis))
+
+
+@register_op("index_sample")
+def _index_sample(x, index):
+    return jnp.take_along_axis(x, index, axis=1)
+
+
+@register_op("take_along_axis")
+def _take_along_axis(x, indices, axis):
+    return jnp.take_along_axis(x, indices, axis=int(axis))
+
+
+@register_op("put_along_axis")
+def _put_along_axis(x, indices, values, axis, reduce="assign"):
+    if reduce == "add":
+        return jnp.put_along_axis(x, indices, values, axis=int(axis),
+                                  inplace=False, mode="drop") \
+            if hasattr(jnp, "put_along_axis") else \
+            _pa_fallback(x, indices, values, axis, "add")
+    return _pa_fallback(x, indices, values, axis, reduce)
+
+
+def _pa_fallback(x, indices, values, axis, reduce):
+    axis = int(axis)
+    dims = tuple(
+        jnp.broadcast_to(
+            jnp.arange(x.shape[d]).reshape(
+                tuple(-1 if i == d else 1 for i in range(x.ndim))),
+            indices.shape)
+        if d != axis else indices
+        for d in range(x.ndim))
+    v = jnp.broadcast_to(values, indices.shape).astype(x.dtype)
+    if reduce == "add":
+        return x.at[dims].add(v)
+    if reduce == "multiply" or reduce == "mul":
+        return x.at[dims].multiply(v)
+    return x.at[dims].set(v)
+
+
+@register_op("where")
+def _where(cond, x, y):
+    return jnp.where(cond, x, y)
+
+
+@register_op("nonzero", nondiff=True, jit=False)
+def _nonzero(x, as_tuple=False):
+    nz = jnp.nonzero(x)
+    if as_tuple:
+        return tuple(a[:, None].astype(jnp.int64) for a in nz)
+    return jnp.stack(nz, axis=1).astype(jnp.int64)
+
+
+@register_op("masked_select", nondiff=True, jit=False)
+def _masked_select(x, mask):
+    return x[mask]
+
+
+def _leading_mask(mask, ndim):
+    """Expand a leading-dims boolean mask for numpy-style broadcasting:
+    x[mask] aligns mask with x's LEADING axes, while jnp.where aligns
+    trailing — so pad the mask with trailing singleton dims."""
+    return mask.reshape(mask.shape + (1,) * (ndim - mask.ndim))
+
+
+@register_op("masked_fill")
+def _masked_fill(x, mask, value):
+    return jnp.where(_leading_mask(mask, x.ndim),
+                     jnp.asarray(value, x.dtype), x)
+
+
+@register_op("tile")
+def _tile(x, repeat_times):
+    return jnp.tile(x, tuple(repeat_times))
+
+
+@register_op("expand")
+def _expand(x, shape):
+    shape = tuple(s if s != -1 else x.shape[i - (len(shape) - x.ndim)]
+                  for i, s in enumerate(shape))
+    return jnp.broadcast_to(x, shape)
+
+
+@register_op("broadcast_to")
+def _broadcast_to(x, shape):
+    return jnp.broadcast_to(x, tuple(shape))
+
+
+@register_op("expand_as")
+def _expand_as(x, y):
+    return jnp.broadcast_to(x, y.shape)
+
+
+@register_op("flip")
+def _flip(x, axis):
+    if isinstance(axis, int):
+        axis = [axis]
+    return jnp.flip(x, axis=tuple(axis))
+
+
+@register_op("roll")
+def _roll(x, shifts, axis=None):
+    return jnp.roll(x, shifts,
+                    axis=tuple(axis) if isinstance(axis, (list, tuple))
+                    else axis)
+
+
+@register_op("rot90")
+def _rot90(x, k=1, axes=(0, 1)):
+    return jnp.rot90(x, k=k, axes=tuple(axes))
+
+
+@register_op("pad")
+def _pad(x, pad, mode="constant", value=0.0, data_format="NCHW"):
+    # ``pad`` is a flat list in paddle order.
+    nd = x.ndim
+    if len(pad) == 2 * nd:
+        widths = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        # Partial spec applies to trailing spatial dims, LAST dim first:
+        # [left, right, top, bottom] pads W by (left,right) then H — the
+        # convention of the reference's nn/functional pad.
+        n_spatial = len(pad) // 2
+        widths = [(0, 0)] * nd
+        if data_format.startswith("N") and data_format[1] == "C":
+            start = 2
+        elif data_format.startswith("N"):
+            start = 1
+        else:
+            start = nd - n_spatial
+        for i in range(n_spatial):
+            widths[start + n_spatial - 1 - i] = (pad[2 * i], pad[2 * i + 1])
+    mode_map = {"constant": "constant", "reflect": "reflect",
+                "replicate": "edge", "circular": "wrap"}
+    if mode == "constant":
+        return jnp.pad(x, widths, mode="constant", constant_values=value)
+    return jnp.pad(x, widths, mode=mode_map[mode])
+
+
+@register_op("chunk")
+def _chunk(x, chunks, axis=0):
+    return tuple(jnp.array_split(x, int(chunks), axis=int(axis)))
+
+
+@register_op("unique", nondiff=True, jit=False)
+def _unique(x, return_index=False, return_inverse=False,
+            return_counts=False, axis=None):
+    res = jnp.unique(x, return_index=return_index,
+                     return_inverse=return_inverse,
+                     return_counts=return_counts, axis=axis)
+    return res
+
+
+@register_op("unique_consecutive", nondiff=True, jit=False)
+def _unique_consecutive(x, return_inverse=False, return_counts=False):
+    import numpy as np
+    a = np.asarray(x)
+    mask = np.concatenate([[True], a[1:] != a[:-1]]) if a.size else \
+        np.ones((0,), bool)
+    out = [jnp.asarray(a[mask])]
+    if return_inverse:
+        out.append(jnp.asarray(np.cumsum(mask) - 1))
+    if return_counts:
+        idx = np.flatnonzero(mask)
+        out.append(jnp.asarray(np.diff(np.append(idx, a.size))))
+    return out[0] if len(out) == 1 else tuple(out)
+
+
+@register_op("sort")
+def _sort(x, axis=-1, descending=False, stable=True):
+    r = jnp.sort(x, axis=int(axis), stable=stable)
+    return jnp.flip(r, axis=int(axis)) if descending else r
+
+
+@register_op("argsort", nondiff=True)
+def _argsort(x, axis=-1, descending=False, stable=True):
+    r = jnp.argsort(x, axis=int(axis), stable=stable)
+    if descending:
+        r = jnp.flip(r, axis=int(axis))
+    return r.astype(jnp.int64)
+
+
+@register_op("topk")
+def _topk(x, k, axis=-1, largest=True, sorted=True):
+    axis = int(axis) % x.ndim
+    xm = jnp.moveaxis(x, axis, -1)
+    if largest:
+        vals, idx = lax.top_k(xm, int(k))
+    else:
+        vals, idx = lax.top_k(-xm, int(k))
+        vals = -vals
+    return (jnp.moveaxis(vals, -1, axis),
+            jnp.moveaxis(idx, -1, axis).astype(jnp.int64))
+
+
+@register_op("searchsorted", nondiff=True)
+def _searchsorted(sorted_sequence, values, out_int32=False, right=False):
+    side = "right" if right else "left"
+    if sorted_sequence.ndim == 1:
+        r = jnp.searchsorted(sorted_sequence, values, side=side)
+    else:
+        r = jax.vmap(lambda s, v: jnp.searchsorted(s, v, side=side))(
+            sorted_sequence.reshape(-1, sorted_sequence.shape[-1]),
+            values.reshape(-1, values.shape[-1]))
+        r = r.reshape(values.shape)
+    return r.astype(jnp.int32 if out_int32 else jnp.int64)
+
+
+@register_op("bucketize", nondiff=True)
+def _bucketize(x, sorted_sequence, out_int32=False, right=False):
+    side = "right" if right else "left"
+    r = jnp.searchsorted(sorted_sequence, x, side=side)
+    return r.astype(jnp.int32 if out_int32 else jnp.int64)
+
+
+@register_op("one_hot", nondiff=True)
+def _one_hot(x, num_classes, dtype="float32"):
+    return jax.nn.one_hot(x, int(num_classes), dtype=jnp.dtype(dtype))
+
+
+@register_op("repeat_interleave")
+def _repeat_interleave(x, repeats, axis=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    return jnp.repeat(x, repeats, axis=int(axis))
+
+
+@register_op("getitem")
+def _getitem(x, *index_arrays, index_spec=None):
+    idx = _decode_index(index_spec, list(index_arrays))
+    return x[idx]
+
+
+@register_op("setitem")
+def _setitem(x, value, *index_arrays, index_spec=None):
+    idx = _decode_index(index_spec, list(index_arrays))
+    return x.at[idx].set(jnp.asarray(value, x.dtype))
+
+
+def _decode_index(spec, arrays):
+    out = []
+    for item in spec:
+        kind = item[0]
+        if kind == "slice":
+            out.append(slice(item[1], item[2], item[3]))
+        elif kind == "int":
+            out.append(item[1])
+        elif kind == "none":
+            out.append(None)
+        elif kind == "ellipsis":
+            out.append(Ellipsis)
+        elif kind == "array":
+            out.append(arrays.pop(0))
+        elif kind == "tuple":
+            out.append(tuple(item[1]))
+    return tuple(out)
+
+
+@register_op("strided_slice")
+def _strided_slice(x, axes, starts, ends, strides=None):
+    idx = [slice(None)] * x.ndim
+    strides = strides or [1] * len(axes)
+    for a, s, e, st in zip(axes, starts, ends, strides):
+        idx[a] = slice(s, e, st)
+    return x[tuple(idx)]
+
+
+@register_op("slice")
+def _slice(x, axes, starts, ends):
+    idx = [slice(None)] * x.ndim
+    for a, s, e in zip(axes, starts, ends):
+        idx[a] = slice(s, e)
+    return x[tuple(idx)]
+
+
+@register_op("moveaxis")
+def _moveaxis(x, source, destination):
+    return jnp.moveaxis(x, source, destination)
+
+
+@register_op("swapaxes")
+def _swapaxes(x, axis0, axis1):
+    return jnp.swapaxes(x, axis0, axis1)
+
+
+@register_op("as_strided")
+def _as_strided(x, shape, stride, offset=0):
+    flat = x.reshape(-1)
+    idx = jnp.zeros(tuple(shape), dtype=jnp.int32) + offset
+    for d, (s, st) in enumerate(zip(shape, stride)):
+        r = jnp.arange(s) * st
+        idx = idx + r.reshape(tuple(-1 if i == d else 1
+                                    for i in range(len(shape))))
+    return flat[idx]
+
+
+@register_op("tensordot")
+def _tensordot(x, y, axes=2):
+    return jnp.tensordot(x, y, axes=axes)
+
+
+@register_op("crop")
+def _crop(x, shape, offsets):
+    idx = tuple(slice(o, o + s) for o, s in zip(offsets, shape))
+    return x[idx]
+
+
+@register_op("masked_fill_tensor")
+def _masked_fill_tensor(x, mask, value):
+    """numpy-style ``x[mask] = value``.
+
+    * scalar value — broadcast fill of the selected region;
+    * 1-D value of length k — assigned to the k True positions in row-major
+      order (cumsum-gather keeps this jittable; a length mismatch is NOT
+      detected under jit, matching the cost model of dynamic shapes on TPU).
+    """
+    value = value.astype(x.dtype)
+    if value.size == 1:
+        return jnp.where(_leading_mask(mask, x.ndim),
+                         jnp.reshape(value, ()), x)
+    if value.ndim == 1:
+        flat_mask = jnp.broadcast_to(_leading_mask(mask, x.ndim),
+                                     x.shape).ravel()
+        pos = jnp.cumsum(flat_mask) - 1
+        vals = value[jnp.clip(pos, 0, value.shape[0] - 1)]
+        return jnp.where(flat_mask, vals, x.ravel()).reshape(x.shape)
+    return jnp.where(_leading_mask(mask, x.ndim),
+                     jnp.broadcast_to(value, x.shape), x)

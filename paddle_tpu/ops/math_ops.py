@@ -1,0 +1,326 @@
+"""Elementwise math, reduction, and comparison op implementations.
+
+Analog of the reference's phi kernels for the elementwise / reduce / compare
+families (/root/reference/paddle/phi/kernels/elementwise_*.h, reduce_*.h,
+cpu|gpu/*_kernel.cc|cu). Each impl is a pure jax function over arrays; XLA
+fuses chains of these into single kernels, replacing the reference's
+hand-fused variants.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op
+
+# -- binary elementwise -----------------------------------------------------
+
+for _name, _fn in {
+    "add": jnp.add,
+    "subtract": jnp.subtract,
+    "multiply": jnp.multiply,
+    "divide": jnp.divide,
+    "floor_divide": jnp.floor_divide,
+    "mod": jnp.mod,
+    "remainder": jnp.remainder,
+    "maximum": jnp.maximum,
+    "minimum": jnp.minimum,
+    "fmax": jnp.fmax,
+    "fmin": jnp.fmin,
+    "atan2": jnp.arctan2,
+    "logaddexp": jnp.logaddexp,
+    "nextafter": jnp.nextafter,
+    "copysign": jnp.copysign,
+    "heaviside": jnp.heaviside,
+    "hypot": jnp.hypot,
+    "ldexp": jnp.ldexp,
+}.items():
+    register_op(_name)(_fn)
+
+
+@register_op("pow")
+def _pow(x, y):
+    return jnp.power(x, y)
+
+
+@register_op("divide_trunc")
+def _divide_trunc(x, y):
+    return jnp.trunc(jnp.divide(x, y)).astype(jnp.result_type(x, y))
+
+
+# -- unary elementwise ------------------------------------------------------
+
+for _name, _fn in {
+    "exp": jnp.exp,
+    "expm1": jnp.expm1,
+    "log": jnp.log,
+    "log2": jnp.log2,
+    "log10": jnp.log10,
+    "log1p": jnp.log1p,
+    "sqrt": jnp.sqrt,
+    "rsqrt": lax.rsqrt,
+    "abs": jnp.abs,
+    "sign": jnp.sign,
+    "sin": jnp.sin,
+    "cos": jnp.cos,
+    "tan": jnp.tan,
+    "asin": jnp.arcsin,
+    "acos": jnp.arccos,
+    "atan": jnp.arctan,
+    "sinh": jnp.sinh,
+    "cosh": jnp.cosh,
+    "tanh": jnp.tanh,
+    "asinh": jnp.arcsinh,
+    "acosh": jnp.arccosh,
+    "atanh": jnp.arctanh,
+    "floor": jnp.floor,
+    "ceil": jnp.ceil,
+    "round": jnp.round,
+    "trunc": jnp.trunc,
+    "frac": lambda x: x - jnp.trunc(x),
+    "reciprocal": jnp.reciprocal,
+    "square": jnp.square,
+    "neg": jnp.negative,
+    "erf": jax.scipy.special.erf,
+    "erfinv": jax.scipy.special.erfinv,
+    "lgamma": jax.scipy.special.gammaln,
+    "digamma": jax.scipy.special.digamma,
+    "angle": jnp.angle,
+    "conj": jnp.conj,
+    "real": jnp.real,
+    "imag": jnp.imag,
+    "i0": jax.scipy.special.i0,
+    "i1": jax.scipy.special.i1,
+    "sigmoid": jax.nn.sigmoid,
+    "logit_raw": jax.scipy.special.logit,
+}.items():
+    register_op(_name)(_fn)
+
+
+@register_op("scale")
+def _scale(x, scale=1.0, bias=0.0, bias_after_scale=True):
+    # reference: phi/kernels/scale_kernel.h
+    s = jnp.asarray(scale, x.dtype)
+    b = jnp.asarray(bias, x.dtype)
+    return x * s + b if bias_after_scale else (x + b) * s
+
+
+@register_op("clip")
+def _clip(x, min=None, max=None):
+    return jnp.clip(x, min, max)
+
+
+@register_op("logit")
+def _logit(x, eps=None):
+    if eps:
+        x = jnp.clip(x, eps, 1.0 - eps)
+    return jax.scipy.special.logit(x)
+
+
+@register_op("stanh")
+def _stanh(x, scale_a=0.67, scale_b=1.7159):
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+@register_op("rint")
+def _rint(x):
+    return jnp.rint(x)
+
+
+# -- predicates (nondiff) ---------------------------------------------------
+
+for _name, _fn in {
+    "isnan": jnp.isnan,
+    "isinf": jnp.isinf,
+    "isfinite": jnp.isfinite,
+    "equal": jnp.equal,
+    "not_equal": jnp.not_equal,
+    "greater_than": jnp.greater,
+    "greater_equal": jnp.greater_equal,
+    "less_than": jnp.less,
+    "less_equal": jnp.less_equal,
+    "logical_and": jnp.logical_and,
+    "logical_or": jnp.logical_or,
+    "logical_xor": jnp.logical_xor,
+    "logical_not": jnp.logical_not,
+    "bitwise_and": jnp.bitwise_and,
+    "bitwise_or": jnp.bitwise_or,
+    "bitwise_xor": jnp.bitwise_xor,
+    "bitwise_not": jnp.bitwise_not,
+    "signbit": jnp.signbit,
+}.items():
+    register_op(_name, nondiff=True)(_fn)
+
+
+@register_op("isclose", nondiff=True)
+def _isclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False):
+    return jnp.isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+@register_op("allclose", nondiff=True)
+def _allclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False):
+    return jnp.allclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+@register_op("equal_all", nondiff=True)
+def _equal_all(x, y):
+    return jnp.array_equal(x, y)
+
+
+# -- reductions -------------------------------------------------------------
+
+def _norm_axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+@register_op("sum")
+def _sum(x, axis=None, keepdim=False, dtype=None):
+    # paddle sums bool/int to int64 by default
+    if dtype is None and jnp.issubdtype(x.dtype, jnp.bool_):
+        dtype = jnp.int64
+    return jnp.sum(x, axis=_norm_axis(axis), keepdims=keepdim, dtype=dtype)
+
+
+@register_op("mean")
+def _mean(x, axis=None, keepdim=False):
+    return jnp.mean(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+@register_op("max")
+def _max(x, axis=None, keepdim=False):
+    return jnp.max(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+@register_op("min")
+def _min(x, axis=None, keepdim=False):
+    return jnp.min(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+@register_op("prod")
+def _prod(x, axis=None, keepdim=False, dtype=None):
+    return jnp.prod(x, axis=_norm_axis(axis), keepdims=keepdim, dtype=dtype)
+
+
+@register_op("amax")
+def _amax(x, axis=None, keepdim=False):
+    return jnp.amax(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+@register_op("amin")
+def _amin(x, axis=None, keepdim=False):
+    return jnp.amin(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+@register_op("nansum")
+def _nansum(x, axis=None, keepdim=False, dtype=None):
+    return jnp.nansum(x, axis=_norm_axis(axis), keepdims=keepdim, dtype=dtype)
+
+
+@register_op("nanmean")
+def _nanmean(x, axis=None, keepdim=False):
+    return jnp.nanmean(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+@register_op("all", nondiff=True)
+def _all(x, axis=None, keepdim=False):
+    return jnp.all(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+@register_op("any", nondiff=True)
+def _any(x, axis=None, keepdim=False):
+    return jnp.any(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+@register_op("argmax", nondiff=True)
+def _argmax(x, axis=None, keepdim=False, dtype="int64"):
+    r = jnp.argmax(x, axis=axis, keepdims=keepdim if axis is not None
+                   else False)
+    return r.astype(dtype)
+
+
+@register_op("argmin", nondiff=True)
+def _argmin(x, axis=None, keepdim=False, dtype="int64"):
+    r = jnp.argmin(x, axis=axis, keepdims=keepdim if axis is not None
+                   else False)
+    return r.astype(dtype)
+
+
+@register_op("logsumexp")
+def _logsumexp(x, axis=None, keepdim=False):
+    return jax.scipy.special.logsumexp(x, axis=_norm_axis(axis),
+                                       keepdims=keepdim)
+
+
+@register_op("cumsum")
+def _cumsum(x, axis=None):
+    if axis is None:
+        return jnp.cumsum(x.reshape(-1))
+    return jnp.cumsum(x, axis=int(axis))
+
+
+@register_op("cumprod")
+def _cumprod(x, dim=None):
+    if dim is None:
+        return jnp.cumprod(x.reshape(-1))
+    return jnp.cumprod(x, axis=int(dim))
+
+
+@register_op("cummax", nondiff=False)
+def _cummax(x, axis=-1):
+    vals = lax.associative_scan(jnp.maximum, x, axis=axis)
+    return vals
+
+
+@register_op("cummin")
+def _cummin(x, axis=-1):
+    return lax.associative_scan(jnp.minimum, x, axis=axis)
+
+
+@register_op("median")
+def _median(x, axis=None, keepdim=False):
+    return jnp.median(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+@register_op("quantile")
+def _quantile(x, q, axis=None, keepdim=False):
+    return jnp.quantile(x, jnp.asarray(q), axis=_norm_axis(axis),
+                        keepdims=keepdim)
+
+
+@register_op("count_nonzero", nondiff=True)
+def _count_nonzero(x, axis=None, keepdim=False):
+    return jnp.count_nonzero(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+@register_op("var")
+def _var(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.var(x, axis=_norm_axis(axis), ddof=1 if unbiased else 0,
+                   keepdims=keepdim)
+
+
+@register_op("std")
+def _std(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.std(x, axis=_norm_axis(axis), ddof=1 if unbiased else 0,
+                   keepdims=keepdim)
+
+
+@register_op("kthvalue")
+def _kthvalue(x, k, axis=-1, keepdim=False):
+    idx = jnp.argsort(x, axis=axis)
+    vals = jnp.take_along_axis(x, idx, axis=axis)
+    taken = jnp.take(vals, k - 1, axis=axis)
+    itaken = jnp.take(idx, k - 1, axis=axis).astype(jnp.int64)
+    if keepdim:
+        taken = jnp.expand_dims(taken, axis)
+        itaken = jnp.expand_dims(itaken, axis)
+    return taken, itaken
+
+
+@register_op("trace_reduce")
+def _trace(x, offset=0, axis1=0, axis2=1):
+    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)

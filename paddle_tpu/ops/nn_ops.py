@@ -1,0 +1,908 @@
+"""Neural-net op implementations: conv, pooling, normalization, embedding,
+activations, losses, attention.
+
+Analog of the reference's phi nn kernels (/root/reference/paddle/phi/kernels/
+conv_kernel.h, pool_kernel.h, batch_norm_kernel.h, layer_norm_kernel.h,
+embedding_kernel.h, softmax_kernel.h, cross_entropy_kernel.h) and the fused
+CUDA training kernels (paddle/fluid/operators/fused/). On TPU the "fusion" is
+XLA's job; convs map to ``lax.conv_general_dilated`` on the MXU.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op
+
+# -- activations ------------------------------------------------------------
+
+for _name, _fn in {
+    "relu": jax.nn.relu,
+    "relu6": jax.nn.relu6,
+    "silu": jax.nn.silu,
+    "swish": jax.nn.silu,
+    "softplus_raw": jax.nn.softplus,
+    "softsign": jax.nn.soft_sign,
+    "mish": lambda x: x * jnp.tanh(jax.nn.softplus(x)),
+    "hardswish": jax.nn.hard_swish,
+    "tanhshrink": lambda x: x - jnp.tanh(x),
+    "log_sigmoid": jax.nn.log_sigmoid,
+}.items():
+    register_op(_name)(_fn)
+
+
+@register_op("alpha_dropout")
+def _alpha_dropout(x, key, p=0.5):
+    # SELU-preserving dropout (reference: nn/functional/common.py
+    # alpha_dropout): dropped units take alpha' and the output is affinely
+    # rescaled so mean/variance are preserved.
+    alpha = 1.6732632423543772 * 1.0507009873554805
+    keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
+    a = (1.0 / ((1.0 - p) * (1.0 + p * alpha ** 2)) ** 0.5)
+    b = -a * alpha * p
+    return (jnp.where(keep, x, -alpha) * a + b).astype(x.dtype)
+
+
+@register_op("gelu")
+def _gelu(x, approximate=False):
+    return jax.nn.gelu(x, approximate=bool(approximate))
+
+
+@register_op("leaky_relu")
+def _leaky_relu(x, negative_slope=0.01):
+    return jax.nn.leaky_relu(x, negative_slope)
+
+
+@register_op("elu")
+def _elu(x, alpha=1.0):
+    return jax.nn.elu(x, alpha)
+
+
+@register_op("celu")
+def _celu(x, alpha=1.0):
+    return jax.nn.celu(x, alpha)
+
+
+@register_op("selu")
+def _selu(x, scale=1.0507009873554805, alpha=1.6732632423543772):
+    return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+
+@register_op("hardsigmoid")
+def _hardsigmoid(x, slope=0.1666667, offset=0.5):
+    return jnp.clip(slope * x + offset, 0.0, 1.0)
+
+
+@register_op("hardtanh")
+def _hardtanh(x, min=-1.0, max=1.0):
+    return jnp.clip(x, min, max)
+
+
+@register_op("hardshrink")
+def _hardshrink(x, threshold=0.5):
+    return jnp.where(jnp.abs(x) > threshold, x, 0.0).astype(x.dtype)
+
+
+@register_op("softshrink")
+def _softshrink(x, threshold=0.5):
+    return jnp.where(x > threshold, x - threshold,
+                     jnp.where(x < -threshold, x + threshold, 0.0)
+                     ).astype(x.dtype)
+
+
+@register_op("softplus")
+def _softplus(x, beta=1.0, threshold=20.0):
+    bx = beta * x
+    return jnp.where(bx > threshold, x, jax.nn.softplus(bx) / beta)
+
+
+@register_op("thresholded_relu")
+def _thresholded_relu(x, threshold=1.0):
+    return jnp.where(x > threshold, x, 0.0).astype(x.dtype)
+
+
+@register_op("prelu")
+def _prelu(x, alpha):
+    a = alpha
+    if a.ndim == 1 and x.ndim > 1 and a.shape[0] == x.shape[1]:
+        a = a.reshape((1, -1) + (1,) * (x.ndim - 2))
+    return jnp.where(x > 0, x, a * x)
+
+
+@register_op("rrelu")
+def _rrelu(x, key, lower=0.125, upper=0.333333, training=True):
+    if training:
+        a = jax.random.uniform(key, x.shape, x.dtype, lower, upper)
+    else:
+        a = jnp.asarray((lower + upper) / 2, x.dtype)
+    return jnp.where(x >= 0, x, a * x)
+
+
+@register_op("softmax")
+def _softmax(x, axis=-1):
+    return jax.nn.softmax(x, axis=int(axis))
+
+
+@register_op("log_softmax")
+def _log_softmax(x, axis=-1):
+    return jax.nn.log_softmax(x, axis=int(axis))
+
+
+@register_op("gumbel_softmax")
+def _gumbel_softmax(x, key, temperature=1.0, hard=False, axis=-1):
+    g = jax.random.gumbel(key, x.shape, x.dtype)
+    y = jax.nn.softmax((x + g) / temperature, axis=axis)
+    if hard:
+        idx = jnp.argmax(y, axis=axis, keepdims=True)
+        hard_y = jnp.zeros_like(y)
+        hard_y = jnp.put_along_axis(hard_y, idx, 1.0, axis=axis,
+                                    inplace=False) \
+            if hasattr(jnp, "put_along_axis") else \
+            hard_y.at[_axis_idx(idx, axis, y.shape)].set(1.0)
+        y = lax.stop_gradient(hard_y - y) + y
+    return y
+
+
+def _axis_idx(idx, axis, shape):
+    nd = len(shape)
+    axis = axis % nd
+    return tuple(
+        idx.squeeze(axis) if d == axis else
+        jnp.broadcast_to(
+            jnp.arange(shape[d]).reshape(
+                tuple(-1 if i == d else 1 for i in range(nd) if i != axis)),
+            idx.squeeze(axis).shape)
+        for d in range(nd))
+
+
+@register_op("maxout")
+def _maxout(x, groups, axis=1):
+    axis = axis % x.ndim
+    c = x.shape[axis]
+    new_shape = x.shape[:axis] + (c // groups, groups) + x.shape[axis + 1:]
+    return jnp.max(x.reshape(new_shape), axis=axis + 1)
+
+
+@register_op("glu")
+def _glu(x, axis=-1):
+    a, b = jnp.split(x, 2, axis=axis)
+    return a * jax.nn.sigmoid(b)
+
+
+# -- linear / embedding -----------------------------------------------------
+
+@register_op("spectral_norm", nondiff=True)
+def _spectral_norm(weight, u, v, dim=0, power_iters=1, eps=1e-12):
+    w = jnp.moveaxis(weight, dim, 0).reshape(weight.shape[dim], -1)
+    for _ in range(max(1, int(power_iters))):
+        v = w.T @ u
+        v = v / (jnp.linalg.norm(v) + eps)
+        u = w @ v
+        u = u / (jnp.linalg.norm(u) + eps)
+    sigma = u @ w @ v
+    return weight / sigma
+
+
+@register_op("bilinear")
+def _bilinear(x1, x2, weight, bias=None):
+    out = jnp.einsum("bi,kij,bj->bk", x1, weight, x2)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+@register_op("linear")
+def _linear(x, w, b=None):
+    acc = jnp.float32 if x.dtype in (jnp.bfloat16, jnp.float16) else None
+    out = jnp.matmul(x, w, preferred_element_type=acc)
+    if acc is not None:
+        out = out.astype(x.dtype)
+    if b is not None:
+        out = out + b
+    return out
+
+
+@register_op("embedding")
+def _embedding(ids, weight, padding_idx=None):
+    out = jnp.take(weight, ids, axis=0)
+    if padding_idx is not None:
+        if padding_idx < 0:  # negative counts back from vocab size
+            padding_idx += weight.shape[0]
+        mask = (ids == padding_idx)[..., None]
+        out = jnp.where(mask, 0.0, out).astype(weight.dtype)
+    return out
+
+
+# -- conv / pool ------------------------------------------------------------
+
+def _conv_dims(nd, data_format):
+    if data_format in ("NCHW", "NCL", "NCDHW"):
+        spec = ("NCHW", "OIHW", "NCHW") if nd == 2 else \
+               (("NCH", "OIH", "NCH") if nd == 1 else
+                ("NCDHW", "OIDHW", "NCDHW"))
+    else:
+        spec = ("NHWC", "HWIO", "NHWC") if nd == 2 else \
+               (("NHC", "HIO", "NHC") if nd == 1 else
+                ("NDHWC", "DHWIO", "NDHWC"))
+    return spec
+
+
+def _norm_tuple(v, nd):
+    if isinstance(v, int):
+        return (v,) * nd
+    return tuple(v)
+
+
+def _conv_padding(padding, nd):
+    if isinstance(padding, str):
+        return padding.upper()  # SAME / VALID
+    if isinstance(padding, int):
+        return [(padding, padding)] * nd
+    padding = list(padding)
+    if len(padding) == nd and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * nd:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(nd)]
+    return [tuple(p) for p in padding]
+
+
+@register_op("conv2d")
+def _conv2d(x, w, bias=None, stride=1, padding=0, dilation=1, groups=1,
+            data_format="NCHW"):
+    return _convnd(x, w, bias, stride, padding, dilation, groups,
+                   data_format, nd=2)
+
+
+@register_op("conv1d")
+def _conv1d(x, w, bias=None, stride=1, padding=0, dilation=1, groups=1,
+            data_format="NCL"):
+    return _convnd(x, w, bias, stride, padding, dilation, groups,
+                   data_format, nd=1)
+
+
+@register_op("conv3d")
+def _conv3d(x, w, bias=None, stride=1, padding=0, dilation=1, groups=1,
+            data_format="NCDHW"):
+    return _convnd(x, w, bias, stride, padding, dilation, groups,
+                   data_format, nd=3)
+
+
+def _convnd(x, w, bias, stride, padding, dilation, groups, data_format, nd):
+    lhs_spec, rhs_spec, out_spec = _conv_dims(nd, data_format)
+    dn = lax.conv_dimension_numbers(x.shape, w.shape,
+                                    (lhs_spec, rhs_spec, out_spec))
+    acc = jnp.float32 if x.dtype in (jnp.bfloat16, jnp.float16) else None
+    out = lax.conv_general_dilated(
+        x, w,
+        window_strides=_norm_tuple(stride, nd),
+        padding=_conv_padding(padding, nd),
+        rhs_dilation=_norm_tuple(dilation, nd),
+        dimension_numbers=dn,
+        feature_group_count=int(groups),
+        preferred_element_type=acc)
+    if acc is not None:
+        out = out.astype(x.dtype)
+    if bias is not None:
+        if data_format.startswith("NC"):
+            out = out + bias.reshape((1, -1) + (1,) * nd)
+        else:
+            out = out + bias
+    return out
+
+
+@register_op("conv2d_transpose")
+def _conv2d_transpose(x, w, bias=None, stride=1, padding=0,
+                      output_padding=0, dilation=1, groups=1,
+                      data_format="NCHW", output_size=None):
+    nd = 2
+    strides = _norm_tuple(stride, nd)
+    pads = _conv_padding(padding, nd)
+    dil = _norm_tuple(dilation, nd)
+    opad = _norm_tuple(output_padding, nd)
+    if isinstance(pads, str):
+        raise NotImplementedError("string padding for conv_transpose")
+    # w layout: (in, out/groups, kh, kw) in paddle
+    lhs_spec = "NCHW" if data_format == "NCHW" else "NHWC"
+    if groups != 1:
+        # grouped transpose conv via per-group slicing
+        xs = jnp.split(x, groups, axis=1 if data_format == "NCHW" else -1)
+        ws = jnp.split(w, groups, axis=0)
+        outs = [_conv2d_transpose(xg, wg, None, stride, padding,
+                                  output_padding, dilation, 1, data_format)
+                for xg, wg in zip(xs, ws)]
+        out = jnp.concatenate(outs, axis=1 if data_format == "NCHW" else -1)
+    else:
+        dn = lax.conv_dimension_numbers(
+            x.shape, (w.shape[1], w.shape[0], w.shape[2], w.shape[3]),
+            (lhs_spec, "OIHW", lhs_spec))
+        # transpose conv = gradient of conv: use conv_transpose
+        pad_trans = [
+            (d * (k - 1) - p0, d * (k - 1) - p1 + op)
+            for (p0, p1), k, d, op in zip(pads, w.shape[2:], dil, opad)]
+        out = lax.conv_general_dilated(
+            x, jnp.swapaxes(w, 0, 1)[:, :, ::-1, ::-1],
+            window_strides=(1, 1),
+            padding=pad_trans,
+            lhs_dilation=strides,
+            rhs_dilation=dil,
+            dimension_numbers=dn)
+    if bias is not None:
+        out = out + (bias.reshape(1, -1, 1, 1) if data_format == "NCHW"
+                     else bias)
+    return out
+
+
+def _pool(x, ksize, stride, padding, nd, data_format, mode,
+          ceil_mode=False, exclusive=True):
+    ksize = _norm_tuple(ksize, nd)
+    stride = _norm_tuple(stride if stride is not None else ksize, nd)
+    pads = _conv_padding(padding, nd)
+    if ceil_mode and not isinstance(pads, str):
+        # Extend each spatial dim's right padding so a trailing partial
+        # window produces one more output position: out = ceil((L+p0+p1-k)/s)+1.
+        # The extra pad region holds the reduce_window init value (-inf / 0),
+        # so it never contaminates max results or exclusive-avg counts.
+        spatial = x.shape[2:2 + nd] if data_format.startswith("NC") \
+            else x.shape[1:1 + nd]
+        pads = [(p0, p1 + (-(L + p0 + p1 - k)) % s)
+                for (p0, p1), L, k, s in zip(pads, spatial, ksize, stride)]
+    if data_format.startswith("NC"):
+        window = (1, 1) + ksize
+        strides = (1, 1) + stride
+        pad_all = [(0, 0), (0, 0)] + (pads if not isinstance(pads, str)
+                                      else pads)
+    else:
+        window = (1,) + ksize + (1,)
+        strides = (1,) + stride + (1,)
+        pad_all = [(0, 0)] + (pads if not isinstance(pads, str) else pads) \
+            + [(0, 0)]
+    if isinstance(pads, str):
+        pad_all = pads
+    if mode == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
+            jnp.iinfo(x.dtype).min
+        return lax.reduce_window(x, init, lax.max, window, strides, pad_all)
+    # avg
+    ones = jnp.ones_like(x)
+    s = lax.reduce_window(x, 0.0, lax.add, window, strides, pad_all)
+    if exclusive:
+        cnt = lax.reduce_window(ones, 0.0, lax.add, window, strides, pad_all)
+    else:
+        cnt = jnp.asarray(float(jnp.prod(jnp.asarray(ksize))), x.dtype)
+    return s / cnt
+
+
+@register_op("max_pool2d")
+def _max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+                data_format="NCHW"):
+    return _pool(x, kernel_size, stride, padding, 2, data_format, "max",
+                 ceil_mode)
+
+
+@register_op("avg_pool2d")
+def _avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+                exclusive=True, data_format="NCHW"):
+    return _pool(x, kernel_size, stride, padding, 2, data_format, "avg",
+                 ceil_mode, exclusive)
+
+
+@register_op("max_pool1d")
+def _max_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False):
+    return _pool(x, kernel_size, stride, padding, 1, "NCL", "max", ceil_mode)
+
+
+@register_op("avg_pool1d")
+def _avg_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+                exclusive=True):
+    return _pool(x, kernel_size, stride, padding, 1, "NCL", "avg",
+                 ceil_mode, exclusive)
+
+
+@register_op("max_pool3d")
+def _max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+                data_format="NCDHW"):
+    return _pool(x, kernel_size, stride, padding, 3, data_format, "max",
+                 ceil_mode)
+
+
+@register_op("avg_pool3d")
+def _avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+                exclusive=True, data_format="NCDHW"):
+    return _pool(x, kernel_size, stride, padding, 3, data_format, "avg",
+                 ceil_mode, exclusive)
+
+
+@register_op("adaptive_avg_pool2d")
+def _adaptive_avg_pool2d(x, output_size, data_format="NCHW"):
+    return _adaptive_pool(x, output_size, 2, data_format, "avg")
+
+
+@register_op("adaptive_max_pool2d")
+def _adaptive_max_pool2d(x, output_size, data_format="NCHW"):
+    return _adaptive_pool(x, output_size, 2, data_format, "max")
+
+
+@register_op("adaptive_avg_pool1d")
+def _adaptive_avg_pool1d(x, output_size):
+    return _adaptive_pool(x, output_size, 1, "NCL", "avg")
+
+
+@register_op("adaptive_max_pool1d")
+def _adaptive_max_pool1d(x, output_size):
+    return _adaptive_pool(x, output_size, 1, "NCL", "max")
+
+
+def _adaptive_pool(x, output_size, nd, data_format, mode):
+    out_sizes = _norm_tuple(output_size, nd)
+    spatial_off = 2 if data_format.startswith("NC") else 1
+    out = x
+    for d in range(nd):
+        axis = spatial_off + d
+        in_s = out.shape[axis]
+        out_s = out_sizes[d] if out_sizes[d] is not None else in_s
+        if in_s % out_s == 0:
+            k = in_s // out_s
+            shape = (out.shape[:axis] + (out_s, k) + out.shape[axis + 1:])
+            r = out.reshape(shape)
+            out = jnp.max(r, axis=axis + 1) if mode == "max" else \
+                jnp.mean(r, axis=axis + 1)
+        else:
+            # generic: per-output-window segments (torch/paddle formula)
+            starts = (jnp.arange(out_s) * in_s) // out_s
+            ends = ((jnp.arange(out_s) + 1) * in_s + out_s - 1) // out_s
+            idx = jnp.arange(in_s)
+            mask = (idx[None, :] >= starts[:, None]) & \
+                   (idx[None, :] < ends[:, None])
+            moved = jnp.moveaxis(out, axis, -1)
+            if mode == "max":
+                seg = jnp.where(mask[(None,) * (moved.ndim - 1)],
+                                moved[..., None, :], -jnp.inf)
+                res = jnp.max(seg, axis=-1)
+            else:
+                w = mask.astype(out.dtype)
+                res = jnp.einsum("...i,oi->...o", moved, w) / \
+                    jnp.sum(w, axis=1)
+            out = jnp.moveaxis(res, -1, axis)
+    return out
+
+
+# -- normalization ----------------------------------------------------------
+
+@register_op("layer_norm")
+def _layer_norm(x, weight=None, bias=None, epsilon=1e-5,
+                begin_norm_axis=None):
+    axes = tuple(range(begin_norm_axis if begin_norm_axis is not None
+                       else x.ndim - 1, x.ndim))
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=axes, keepdims=True)
+    out = (xf - mean) * lax.rsqrt(var + epsilon)
+    out = out.astype(x.dtype)
+    if weight is not None:
+        out = out * weight
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+@register_op("batch_norm")
+def _batch_norm(x, running_mean, running_var, weight=None, bias=None,
+                training=False, momentum=0.9, epsilon=1e-5,
+                data_format="NCHW"):
+    """Returns (y, new_mean, new_var) — buffer updates are explicit outputs
+    (functional analog of the reference's in-place running stats,
+    phi/kernels/batch_norm_kernel.h)."""
+    c_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    axes = tuple(i for i in range(x.ndim) if i != c_axis)
+    bshape = tuple(-1 if i == c_axis else 1 for i in range(x.ndim))
+    xf = x.astype(jnp.float32)
+    if training:
+        mean = jnp.mean(xf, axis=axes)
+        var = jnp.var(xf, axis=axes)
+        n = x.size // x.shape[c_axis]
+        unbiased = var * (n / max(n - 1, 1))
+        new_mean = momentum * running_mean + (1 - momentum) * mean
+        new_var = momentum * running_var + (1 - momentum) * unbiased
+    else:
+        mean, var = running_mean.astype(jnp.float32), \
+            running_var.astype(jnp.float32)
+        new_mean, new_var = running_mean, running_var
+    out = (xf - mean.reshape(bshape)) * lax.rsqrt(
+        var.reshape(bshape) + epsilon)
+    out = out.astype(x.dtype)
+    if weight is not None:
+        out = out * weight.reshape(bshape)
+    if bias is not None:
+        out = out + bias.reshape(bshape)
+    return out, new_mean.astype(running_mean.dtype), \
+        new_var.astype(running_var.dtype)
+
+
+@register_op("instance_norm")
+def _instance_norm(x, weight=None, bias=None, epsilon=1e-5):
+    axes = tuple(range(2, x.ndim))
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.var(xf, axis=axes, keepdims=True)
+    out = ((xf - mean) * lax.rsqrt(var + epsilon)).astype(x.dtype)
+    bshape = (1, -1) + (1,) * (x.ndim - 2)
+    if weight is not None:
+        out = out * weight.reshape(bshape)
+    if bias is not None:
+        out = out + bias.reshape(bshape)
+    return out
+
+
+@register_op("group_norm")
+def _group_norm(x, weight=None, bias=None, epsilon=1e-5, num_groups=1,
+                data_format="NCHW"):
+    if not data_format.startswith("NC"):
+        x_t = jnp.moveaxis(x, -1, 1)
+        out = _group_norm(x_t, weight, bias, epsilon, num_groups, "NCHW")
+        return jnp.moveaxis(out, 1, -1)
+    n, c = x.shape[0], x.shape[1]
+    g = int(num_groups)
+    xf = x.astype(jnp.float32).reshape((n, g, c // g) + x.shape[2:])
+    axes = tuple(range(2, xf.ndim))
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.var(xf, axis=axes, keepdims=True)
+    out = ((xf - mean) * lax.rsqrt(var + epsilon)).reshape(x.shape) \
+        .astype(x.dtype)
+    bshape = (1, -1) + (1,) * (x.ndim - 2)
+    if weight is not None:
+        out = out * weight.reshape(bshape)
+    if bias is not None:
+        out = out + bias.reshape(bshape)
+    return out
+
+
+@register_op("rms_norm")
+def _rms_norm(x, weight=None, epsilon=1e-6):
+    xf = x.astype(jnp.float32)
+    ms = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    out = (xf * lax.rsqrt(ms + epsilon)).astype(x.dtype)
+    if weight is not None:
+        out = out * weight
+    return out
+
+
+@register_op("local_response_norm")
+def _lrn(x, size=5, alpha=1e-4, beta=0.75, k=1.0):
+    sq = jnp.square(x)
+    half = size // 2
+    pad = [(0, 0), (half, size - 1 - half)] + [(0, 0)] * (x.ndim - 2)
+    padded = jnp.pad(sq, pad)
+    acc = jnp.zeros_like(x)
+    for i in range(size):
+        acc = acc + lax.dynamic_slice_in_dim(padded, i, x.shape[1], axis=1)
+    return x / jnp.power(k + alpha * acc, beta)
+
+
+@register_op("normalize_l2")
+def _normalize(x, p=2.0, axis=1, epsilon=1e-12):
+    norm = jnp.power(jnp.sum(jnp.power(jnp.abs(x), p), axis=axis,
+                             keepdims=True), 1.0 / p)
+    return x / jnp.maximum(norm, epsilon)
+
+
+# -- losses -----------------------------------------------------------------
+
+def _reduce_loss(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+@register_op("softmax_with_cross_entropy")
+def _softmax_ce(logits, label, soft_label=False, axis=-1,
+                ignore_index=-100, return_softmax=False):
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis)
+    if soft_label:
+        loss = -jnp.sum(label * logp, axis=axis, keepdims=True)
+    else:
+        lbl = label
+        squeeze = False
+        if lbl.ndim == logits.ndim:
+            lbl = jnp.squeeze(lbl, axis=axis)
+            squeeze = True
+        gathered = jnp.take_along_axis(
+            logp, jnp.expand_dims(
+                jnp.where(lbl == ignore_index, 0, lbl), axis).astype(
+                    jnp.int32), axis=axis)
+        loss = -jnp.where(jnp.expand_dims(lbl, axis) == ignore_index,
+                          0.0, gathered)
+    loss = loss.astype(logits.dtype)
+    if return_softmax:
+        return loss, jnp.exp(logp).astype(logits.dtype)
+    return loss
+
+
+@register_op("cross_entropy")
+def _cross_entropy(logits, label, weight=None, soft_label=False, axis=-1,
+                   ignore_index=-100, reduction="mean",
+                   use_softmax=True, label_smoothing=0.0):
+    axis = axis % logits.ndim
+    lf = logits.astype(jnp.float32)
+    logp = jax.nn.log_softmax(lf, axis=axis) if use_softmax else \
+        jnp.log(jnp.maximum(lf, 1e-30))
+    n_cls = logits.shape[axis]
+    if soft_label:
+        sl = label.astype(jnp.float32)
+        if label_smoothing > 0:
+            sl = sl * (1 - label_smoothing) + label_smoothing / n_cls
+        loss = -jnp.sum(sl * logp, axis=axis)
+        valid = jnp.ones_like(loss, dtype=bool)
+        w = None if weight is None else jnp.sum(
+            sl * weight.reshape((1,) * axis + (-1,)), axis=axis)
+    else:
+        lbl = label
+        if lbl.ndim == logits.ndim and lbl.shape[axis] == 1:
+            lbl = jnp.squeeze(lbl, axis=axis)
+        lbl = lbl.astype(jnp.int32)
+        valid = lbl != ignore_index
+        safe = jnp.where(valid, lbl, 0)
+        picked = jnp.take_along_axis(logp, jnp.expand_dims(safe, axis),
+                                     axis=axis)
+        nll = -jnp.squeeze(picked, axis=axis)
+        if label_smoothing > 0:
+            smooth = -jnp.mean(logp, axis=axis)
+            nll = (1 - label_smoothing) * nll + label_smoothing * smooth
+        loss = jnp.where(valid, nll, 0.0)
+        w = None if weight is None else jnp.where(valid, weight[safe], 0.0)
+    if w is not None:
+        loss = loss * w
+    if reduction == "mean":
+        if w is not None:
+            return (jnp.sum(loss) / jnp.maximum(jnp.sum(w), 1e-12)) \
+                .astype(logits.dtype)
+        denom = jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
+        return (jnp.sum(loss) / denom).astype(logits.dtype)
+    if reduction == "sum":
+        return jnp.sum(loss).astype(logits.dtype)
+    return loss.astype(logits.dtype)
+
+
+@register_op("mse_loss")
+def _mse_loss(x, y, reduction="mean"):
+    return _reduce_loss(jnp.square(x - y), reduction)
+
+
+@register_op("l1_loss")
+def _l1_loss(x, y, reduction="mean"):
+    return _reduce_loss(jnp.abs(x - y), reduction)
+
+
+@register_op("smooth_l1_loss")
+def _smooth_l1(x, y, reduction="mean", delta=1.0):
+    d = jnp.abs(x - y)
+    loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+    return _reduce_loss(loss, reduction)
+
+
+@register_op("huber_loss")
+def _huber(x, y, reduction="mean", delta=1.0):
+    d = jnp.abs(x - y)
+    loss = jnp.where(d <= delta, 0.5 * d * d, delta * (d - 0.5 * delta))
+    return _reduce_loss(loss, reduction)
+
+
+@register_op("nll_loss")
+def _nll_loss(logp, label, weight=None, ignore_index=-100, reduction="mean"):
+    lbl = label.astype(jnp.int32)
+    valid = lbl != ignore_index
+    safe = jnp.where(valid, lbl, 0)
+    if logp.ndim > 2:  # N,C,d1..  -> move C last
+        moved = jnp.moveaxis(logp, 1, -1)
+    else:
+        moved = logp
+    picked = jnp.take_along_axis(moved, safe[..., None], axis=-1)[..., 0]
+    loss = -jnp.where(valid, picked, 0.0)
+    w = jnp.where(valid, weight[safe], 0.0) if weight is not None else \
+        valid.astype(logp.dtype)
+    loss = loss * (weight[safe] if weight is not None else 1.0)
+    loss = jnp.where(valid, loss, 0.0)
+    if reduction == "mean":
+        return jnp.sum(loss) / jnp.maximum(jnp.sum(w), 1e-12)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+@register_op("bce_loss")
+def _bce(x, label, weight=None, reduction="mean"):
+    eps = 1e-12
+    loss = -(label * jnp.log(jnp.maximum(x, eps)) +
+             (1 - label) * jnp.log(jnp.maximum(1 - x, eps)))
+    if weight is not None:
+        loss = loss * weight
+    return _reduce_loss(loss, reduction)
+
+
+@register_op("bce_with_logits")
+def _bce_logits(x, label, weight=None, pos_weight=None, reduction="mean"):
+    softplus_neg_abs = jnp.log1p(jnp.exp(-jnp.abs(x)))
+    if pos_weight is not None:
+        log_w = (pos_weight - 1) * label + 1
+        loss = (1 - label) * x + log_w * (softplus_neg_abs +
+                                          jnp.maximum(-x, 0.0))
+    else:
+        loss = jnp.maximum(x, 0) - x * label + softplus_neg_abs
+    if weight is not None:
+        loss = loss * weight
+    return _reduce_loss(loss, reduction)
+
+
+@register_op("kl_div")
+def _kl_div(x, target, reduction="mean"):
+    loss = target * (jnp.log(jnp.maximum(target, 1e-12)) - x)
+    loss = jnp.where(target > 0, loss, 0.0)
+    if reduction == "batchmean":
+        return jnp.sum(loss) / x.shape[0]
+    return _reduce_loss(loss, reduction)
+
+
+@register_op("margin_ranking_loss")
+def _margin_ranking(x, y, label, margin=0.0, reduction="mean"):
+    return _reduce_loss(jnp.maximum(0.0, -label * (x - y) + margin),
+                        reduction)
+
+
+@register_op("hinge_embedding_loss")
+def _hinge_embedding(x, label, margin=1.0, reduction="mean"):
+    loss = jnp.where(label == 1, x, jnp.maximum(0.0, margin - x))
+    return _reduce_loss(loss, reduction)
+
+
+@register_op("cosine_similarity")
+def _cosine_similarity(x, y, axis=1, eps=1e-8):
+    dot = jnp.sum(x * y, axis=axis)
+    nx = jnp.sqrt(jnp.sum(x * x, axis=axis))
+    ny = jnp.sqrt(jnp.sum(y * y, axis=axis))
+    return dot / jnp.maximum(nx * ny, eps)
+
+
+@register_op("label_smooth")
+def _label_smooth(label, epsilon=0.1, prior_dist=None):
+    n = label.shape[-1]
+    if prior_dist is not None:
+        return (1 - epsilon) * label + epsilon * prior_dist
+    return (1 - epsilon) * label + epsilon / n
+
+
+@register_op("sigmoid_focal_loss")
+def _sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25,
+                        gamma=2.0, reduction="sum"):
+    p = jax.nn.sigmoid(logit)
+    ce = jnp.maximum(logit, 0) - logit * label + \
+        jnp.log1p(jnp.exp(-jnp.abs(logit)))
+    p_t = p * label + (1 - p) * (1 - label)
+    loss = ce * ((1 - p_t) ** gamma)
+    if alpha >= 0:
+        a_t = alpha * label + (1 - alpha) * (1 - label)
+        loss = a_t * loss
+    if normalizer is not None:
+        loss = loss / normalizer
+    return _reduce_loss(loss, reduction)
+
+
+# -- misc nn ----------------------------------------------------------------
+
+@register_op("interpolate")
+def _interpolate(x, size=None, scale_factor=None, mode="nearest",
+                 align_corners=False, data_format="NCHW"):
+    nchw = data_format.startswith("NC")
+    spatial = x.shape[2:] if nchw else x.shape[1:-1]
+    nd = len(spatial)
+    if size is None:
+        sf = _norm_tuple(scale_factor, nd)
+        size = tuple(int(s * f) for s, f in zip(spatial, sf))
+    else:
+        size = _norm_tuple(size, nd)
+    if nchw:
+        target = x.shape[:2] + tuple(size)
+    else:
+        target = (x.shape[0],) + tuple(size) + (x.shape[-1],)
+    method = {"nearest": "nearest", "bilinear": "linear",
+              "linear": "linear", "trilinear": "linear",
+              "bicubic": "cubic", "area": "linear"}[mode]
+    if align_corners and method != "nearest":
+        # jax.image doesn't support align_corners; emulate with map_coordinates
+        return _interp_align_corners(x, size, method, nchw)
+    return jax.image.resize(x, target, method=method).astype(x.dtype)
+
+
+def _interp_align_corners(x, size, method, nchw):
+    import jax.scipy.ndimage as ndi
+    spatial_axes = list(range(2, x.ndim)) if nchw else \
+        list(range(1, x.ndim - 1))
+    coords = []
+    for ax, out_s in zip(spatial_axes, size):
+        in_s = x.shape[ax]
+        if out_s == 1:
+            c = jnp.zeros((1,))
+        else:
+            c = jnp.linspace(0, in_s - 1, out_s)
+        coords.append(c)
+    grids = jnp.meshgrid(*coords, indexing="ij")
+    order = 1 if method == "linear" else 0
+
+    def per_image(img):  # img: spatial only
+        return ndi.map_coordinates(img, [g for g in grids], order=order)
+
+    batch_axes = tuple(i for i in range(x.ndim) if i not in spatial_axes)
+    moved = jnp.moveaxis(x, batch_axes, tuple(range(len(batch_axes))))
+    lead = moved.shape[:len(batch_axes)]
+    flat = moved.reshape((-1,) + moved.shape[len(batch_axes):])
+    out = jax.vmap(per_image)(flat)
+    out = out.reshape(lead + out.shape[1:])
+    return jnp.moveaxis(out, tuple(range(len(batch_axes))), batch_axes) \
+        .astype(x.dtype)
+
+
+@register_op("pixel_shuffle")
+def _pixel_shuffle(x, upscale_factor, data_format="NCHW"):
+    r = int(upscale_factor)
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        x = x.reshape(n, c // (r * r), r, r, h, w)
+        x = jnp.transpose(x, (0, 1, 4, 2, 5, 3))
+        return x.reshape(n, c // (r * r), h * r, w * r)
+    n, h, w, c = x.shape
+    x = x.reshape(n, h, w, r, r, c // (r * r))
+    x = jnp.transpose(x, (0, 1, 3, 2, 4, 5))
+    return x.reshape(n, h * r, w * r, c // (r * r))
+
+
+@register_op("unfold")
+def _unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1):
+    ks = _norm_tuple(kernel_sizes, 2)
+    st = _norm_tuple(strides, 2)
+    dl = _norm_tuple(dilations, 2)
+    pd = _conv_padding(paddings, 2)
+    n, c = x.shape[:2]
+    patches = lax.conv_general_dilated_patches(
+        x, ks, st, pd, rhs_dilation=dl,
+        dimension_numbers=lax.conv_dimension_numbers(
+            x.shape, (1, 1) + ks, ("NCHW", "OIHW", "NCHW")))
+    return patches.reshape(n, c * ks[0] * ks[1], -1)
+
+
+@register_op("sequence_mask", nondiff=True)
+def _sequence_mask(lengths, maxlen=None, dtype="int64"):
+    m = int(maxlen) if maxlen is not None else None
+    if m is None:
+        raise ValueError("maxlen must be provided under jit")
+    r = jnp.arange(m)
+    return (r[None, :] < lengths.reshape(-1, 1)).reshape(
+        lengths.shape + (m,)).astype(jnp.dtype(dtype))
+
+
+@register_op("scaled_dot_product_attention")
+def _sdpa(q, k, v, mask=None, dropout_key=None, dropout_p=0.0,
+          is_causal=False, scale=None):
+    """Reference analog: fused_attention_op.cu / fmha_ref.h — here one XLA
+    fusion region (Pallas flash-attention override registered separately)."""
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / (d ** 0.5)
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    logits = jnp.einsum("...qhd,...khd->...hqk", qf, kf) * s
+    if is_causal:
+        ql, kl = logits.shape[-2], logits.shape[-1]
+        cm = jnp.tril(jnp.ones((ql, kl), bool), k=kl - ql)
+        logits = jnp.where(cm, logits, -1e30)
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            logits = jnp.where(mask, logits, -1e30)
+        else:
+            logits = logits + mask.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    if dropout_p > 0.0 and dropout_key is not None:
+        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_p,
+                                    probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0)
+    out = jnp.einsum("...hqk,...khd->...qhd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)

@@ -1,0 +1,79 @@
+"""Op registry.
+
+TPU-native analog of the reference's phi ``KernelFactory``
+(/root/reference/paddle/phi/core/kernel_factory.h:261) and
+``PD_REGISTER_KERNEL`` (phi/core/kernel_registry.h). Because XLA is the single
+backend, the (Backend, Layout, DataType) key collapses: one registered impl
+per op, expressed as a pure jax function. Backend selection, layout and fusion
+are the compiler's job; Pallas variants register as *overrides* keyed by a
+predicate (analogous to the reference's gpudnn/ kernels shadowing gpu/ ones).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax
+
+
+class OpDef:
+    __slots__ = ("name", "fn", "overrides", "nondiff", "jit")
+
+    def __init__(self, name: str, fn: Callable, nondiff: bool, jit: bool):
+        self.name = name
+        self.fn = fn
+        # list of (predicate(args, attrs) -> bool, fn) tried in reverse
+        # registration order — the Pallas fast-path hook.
+        self.overrides: List[Tuple[Callable, Callable]] = []
+        self.nondiff = nondiff  # outputs never require grad (e.g. argmax)
+        self.jit = jit
+
+    def select(self, args, attrs) -> Callable:
+        for pred, fn in reversed(self.overrides):
+            try:
+                if pred(args, attrs):
+                    return fn
+            except Exception:
+                continue
+        return self.fn
+
+
+_OPS: Dict[str, OpDef] = {}
+
+
+def register_op(name: str, nondiff: bool = False, jit: bool = True):
+    """Decorator registering a pure-jax op implementation."""
+
+    def deco(fn):
+        if name in _OPS:
+            raise KeyError(f"op {name!r} already registered")
+        _OPS[name] = OpDef(name, fn, nondiff, jit)
+        return fn
+
+    return deco
+
+
+def register_override(name: str, predicate: Callable):
+    """Register a faster impl (e.g. a Pallas kernel) used when ``predicate``
+    holds — the analog of a gpudnn/autotuned kernel shadowing the generic
+    one."""
+
+    def deco(fn):
+        _OPS[name].overrides.append((predicate, fn))
+        return fn
+
+    return deco
+
+
+def get_op(name: str) -> OpDef:
+    try:
+        return _OPS[name]
+    except KeyError:
+        raise NotImplementedError(f"op {name!r} is not registered") from None
+
+
+def has_op(name: str) -> bool:
+    return name in _OPS
+
+
+def op_names():
+    return sorted(_OPS)

@@ -1,0 +1,1034 @@
+"""User-facing tensor functional API (``paddle.add``, ``paddle.matmul``, ...).
+
+Analog of the reference's python/paddle/tensor/ package
+(/root/reference/python/paddle/tensor/__init__.py — creation/math/linalg/
+manipulation/logic/random/search). Where the reference branches per-function
+between eager `_C_ops` and static `append_op` (e.g. tensor/linalg.py:222-247),
+here every function goes through one dispatch path that works both eagerly
+and under jit tracing.
+"""
+from __future__ import annotations
+
+import builtins
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework import random as _random
+from ..framework.dispatch import call_op as _op
+from ..framework.dtypes import convert_dtype, get_default_dtype
+from ..framework.tensor import Parameter, Tensor
+
+__all__ = []  # populated at bottom
+
+
+def _export(fn):
+    __all__.append(fn.__name__)
+    return fn
+
+
+# ---------------------------------------------------------------------------
+# creation
+# ---------------------------------------------------------------------------
+
+@_export
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    if isinstance(data, Tensor):
+        arr = data._data
+        if dtype is not None:
+            arr = arr.astype(convert_dtype(dtype))
+        return Tensor(arr, stop_gradient=stop_gradient)
+    if isinstance(data, (list, tuple)):
+        flat = np.asarray(
+            [x.numpy() if isinstance(x, Tensor) else x for x in data]) \
+            if builtins.any(isinstance(x, Tensor) for x in data) \
+            else np.asarray(data)
+        data = flat
+    if dtype is None:
+        if isinstance(data, (bool, np.bool_)):
+            pass
+        elif isinstance(data, (int, np.integer)):
+            dtype = "int64"
+        elif isinstance(data, (float, np.floating)):
+            dtype = get_default_dtype()
+        elif isinstance(data, np.ndarray) and \
+                data.dtype == np.float64:
+            dtype = get_default_dtype()
+    arr = jnp.asarray(data, dtype=convert_dtype(dtype) if dtype is not None
+                      else None)
+    return Tensor(arr, stop_gradient=stop_gradient)
+
+
+def _shape_list(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in np.asarray(shape._data))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s._data if isinstance(s, Tensor) else s) for s in shape)
+
+
+@_export
+def zeros(shape, dtype=None, name=None):
+    return full(shape, 0.0, dtype)
+
+
+@_export
+def ones(shape, dtype=None, name=None):
+    return full(shape, 1.0, dtype)
+
+
+@_export
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    return _op("full", shape=_shape_list(shape), fill_value=fill_value,
+               dtype=convert_dtype(dtype))
+
+
+@_export
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+@_export
+def zeros_like(x, dtype=None, name=None):
+    return _op("full_like", x, 0,
+               dtype=convert_dtype(dtype) if dtype else None)
+
+
+@_export
+def ones_like(x, dtype=None, name=None):
+    return _op("full_like", x, 1,
+               dtype=convert_dtype(dtype) if dtype else None)
+
+
+@_export
+def full_like(x, fill_value, dtype=None, name=None):
+    return _op("full_like", x, fill_value,
+               dtype=convert_dtype(dtype) if dtype else None)
+
+
+@_export
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+@_export
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    def _v(x):
+        return x.item() if isinstance(x, Tensor) else x
+    start, end, step = _v(start), _v(end), _v(step)
+    if dtype is None:
+        dtype = "int64" if builtins.all(
+            isinstance(v, (int, type(None))) for v in (start, end, step)) \
+            else get_default_dtype()
+    return _op("arange", start=start, end=end, step=step,
+               dtype=convert_dtype(dtype))
+
+
+@_export
+def linspace(start, stop, num, dtype=None, name=None):
+    return _op("linspace", start=float(start), stop=float(stop),
+               num=int(num), dtype=convert_dtype(dtype))
+
+
+@_export
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return _op("logspace", start=float(start), stop=float(stop),
+               num=int(num), base=float(base), dtype=convert_dtype(dtype))
+
+
+@_export
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return _op("eye", num_rows=num_rows, num_columns=num_columns,
+               dtype=convert_dtype(dtype))
+
+
+@_export
+def clone(x, name=None):
+    return _op("assign", x)
+
+
+@_export
+def assign(x, output=None):
+    r = _op("assign", x if isinstance(x, Tensor) else to_tensor(x))
+    if output is not None:
+        output._rebind(r)
+        return output
+    return r
+
+
+@_export
+def numel(x, name=None):
+    return to_tensor(x.size, dtype="int64")
+
+
+# ---------------------------------------------------------------------------
+# random
+# ---------------------------------------------------------------------------
+
+@_export
+def rand(shape, dtype=None, name=None):
+    return uniform(shape, dtype, min=0.0, max=1.0)
+
+
+@_export
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    dt = convert_dtype(dtype)
+    return _op("uniform_random", _random.next_key(),
+               shape=_shape_list(shape), dtype=dt, min=float(min),
+               max=float(max))
+
+
+@_export
+def randn(shape, dtype=None, name=None):
+    return normal(0.0, 1.0, shape, dtype)
+
+
+@_export
+def normal(mean=0.0, std=1.0, shape=None, dtype=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean if isinstance(mean, Tensor) else to_tensor(float(mean))
+        s = std if isinstance(std, Tensor) else to_tensor(float(std))
+        shp = m.shape if isinstance(mean, Tensor) else s.shape
+        g = _op("gaussian_random", _random.next_key(), shape=tuple(shp),
+                dtype=convert_dtype(dtype), mean=0.0, std=1.0)
+        return add(multiply(g, s), m)
+    return _op("gaussian_random", _random.next_key(),
+               shape=_shape_list(shape), dtype=convert_dtype(dtype),
+               mean=float(mean), std=float(std))
+
+
+@_export
+def standard_normal(shape, dtype=None, name=None):
+    return normal(0.0, 1.0, shape, dtype)
+
+
+@_export
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    return _op("randint", _random.next_key(), low=int(low),
+               high=None if high is None else int(high),
+               shape=_shape_list(shape), dtype=convert_dtype(dtype))
+
+
+@_export
+def randperm(n, dtype="int64", name=None):
+    return _op("randperm", _random.next_key(), n=int(n),
+               dtype=convert_dtype(dtype))
+
+
+@_export
+def bernoulli(x, name=None):
+    return _op("bernoulli", _random.next_key(), x)
+
+
+@_export
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    return _op("multinomial", _random.next_key(), x,
+               num_samples=int(num_samples), replacement=bool(replacement))
+
+
+@_export
+def poisson(x, name=None):
+    return _op("poisson", _random.next_key(), x)
+
+
+@_export
+def standard_gamma(x, name=None):
+    return _op("standard_gamma", _random.next_key(), x)
+
+
+@_export
+def seed(value):
+    return _random.seed(value)
+
+
+@_export
+def get_rng_state():
+    return _random.get_rng_state()
+
+
+@_export
+def set_rng_state(state):
+    _random.set_rng_state(state)
+
+
+# ---------------------------------------------------------------------------
+# generated thin wrappers
+# ---------------------------------------------------------------------------
+
+def _unary(opname):
+    def fn(x, name=None):
+        return _op(opname, x)
+    fn.__name__ = opname
+    return _export(fn)
+
+
+def _binary(opname):
+    def fn(x, y, name=None):
+        return _op(opname, x, y)
+    fn.__name__ = opname
+    return _export(fn)
+
+
+_UNARY = """exp expm1 log log2 log10 log1p sqrt rsqrt abs sign sin cos tan
+asin acos atan sinh cosh tanh asinh acosh atanh floor ceil round trunc frac
+reciprocal square erf erfinv lgamma digamma angle conj real imag i0 i1
+isnan isinf isfinite logical_not bitwise_not rint neg sigmoid
+inverse det eigvals""".split()
+for _n in _UNARY:
+    globals()[_n] = _unary(_n)
+
+_BINARY = """add subtract multiply divide floor_divide mod remainder maximum
+minimum fmax fmin pow atan2 logaddexp nextafter copysign heaviside hypot
+ldexp equal not_equal greater_than greater_equal less_than less_equal
+logical_and logical_or logical_xor bitwise_and bitwise_or bitwise_xor
+dot bmm mv outer inner kron equal_all""".split()
+for _n in _BINARY:
+    globals()[_n] = _binary(_n)
+
+floor_mod = mod  # noqa: F821
+__all__.append("floor_mod")
+
+
+@_export
+def divide_trunc(x, y, name=None):
+    return _op("divide_trunc", x, y)
+
+
+# ---------------------------------------------------------------------------
+# math with attrs
+# ---------------------------------------------------------------------------
+
+@_export
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None,
+          name=None):
+    r = _op("scale", x, scale=float(scale) if not isinstance(scale, Tensor)
+            else scale.item(), bias=float(bias),
+            bias_after_scale=bool(bias_after_scale))
+    if act:
+        r = _op(act, r)
+    return r
+
+
+@_export
+def clip(x, min=None, max=None, name=None):
+    def _v(v):
+        return v.item() if isinstance(v, Tensor) else v
+    return _op("clip", x, min=_v(min), max=_v(max))
+
+
+@_export
+def logit(x, eps=None, name=None):
+    return _op("logit", x, eps=eps)
+
+
+@_export
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return _op("stanh", x, scale_a=scale_a, scale_b=scale_b)
+
+
+@_export
+def isclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False, name=None):
+    return _op("isclose", x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+@_export
+def allclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False, name=None):
+    return _op("allclose", x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+@_export
+def cast(x, dtype):
+    return _op("cast", x, dtype=convert_dtype(dtype))
+
+
+# reductions ---------------------------------------------------------------
+
+def _reduction(opname):
+    def fn(x, axis=None, keepdim=False, name=None):
+        return _op(opname, x, axis=_ax(axis), keepdim=keepdim)
+    fn.__name__ = opname
+    return _export(fn)
+
+
+def _ax(axis):
+    if isinstance(axis, Tensor):
+        return int(axis.item())
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return axis
+
+
+for _n in ["mean", "max", "min", "amax", "amin", "nanmean", "logsumexp",
+           "all", "any", "median"]:
+    globals()[_n] = _reduction(_n)
+
+
+@_export
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    return _op("sum", x, axis=_ax(axis), keepdim=keepdim,
+               dtype=convert_dtype(dtype) if dtype else None)
+
+
+@_export
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    return _op("nansum", x, axis=_ax(axis), keepdim=keepdim,
+               dtype=convert_dtype(dtype) if dtype else None)
+
+
+@_export
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    return _op("prod", x, axis=_ax(axis), keepdim=keepdim,
+               dtype=convert_dtype(dtype) if dtype else None)
+
+
+@_export
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return _op("var", x, axis=_ax(axis), unbiased=unbiased, keepdim=keepdim)
+
+
+@_export
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return _op("std", x, axis=_ax(axis), unbiased=unbiased, keepdim=keepdim)
+
+
+@_export
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    return _op("argmax", x, axis=axis, keepdim=keepdim,
+               dtype=convert_dtype(dtype))
+
+
+@_export
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    return _op("argmin", x, axis=axis, keepdim=keepdim,
+               dtype=convert_dtype(dtype))
+
+
+@_export
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return _op("count_nonzero", x, axis=_ax(axis), keepdim=keepdim)
+
+
+@_export
+def cumsum(x, axis=None, name=None):
+    return _op("cumsum", x, axis=axis)
+
+
+@_export
+def cumprod(x, dim=None, name=None):
+    return _op("cumprod", x, dim=dim)
+
+
+@_export
+def cummax(x, axis=-1, name=None):
+    return _op("cummax", x, axis=axis)
+
+
+@_export
+def cummin(x, axis=-1, name=None):
+    return _op("cummin", x, axis=axis)
+
+
+@_export
+def quantile(x, q, axis=None, keepdim=False, name=None):
+    return _op("quantile", x, q=q, axis=_ax(axis), keepdim=keepdim)
+
+
+@_export
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    return _op("kthvalue", x, k=int(k), axis=axis, keepdim=keepdim)
+
+
+@_export
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return _op("trace_reduce", x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+# linalg -------------------------------------------------------------------
+
+@_export
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    return _op("matmul", x, y, transpose_x=transpose_x,
+               transpose_y=transpose_y)
+
+
+mm = matmul
+__all__.append("mm")
+
+
+@_export
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return _op("addmm", input, x, y, beta=float(beta), alpha=float(alpha))
+
+
+@_export
+def einsum(equation, *operands):
+    return _op("einsum", list(operands), equation=equation)
+
+
+@_export
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    if p is None:
+        p = "fro" if axis is None or isinstance(axis, (list, tuple)) else 2.0
+    if p == "fro":
+        return _op("frobenius_norm", x, axis=_ax(axis), keepdim=keepdim)
+    return _op("p_norm", x, porder=float(p), axis=_ax(axis), keepdim=keepdim)
+
+
+@_export
+def cross(x, y, axis=None, name=None):
+    return _op("cross", x, y, axis=axis)
+
+
+@_export
+def cholesky(x, upper=False, name=None):
+    r = _op("cholesky", x)
+    return transpose_last(r) if upper else r
+
+
+def transpose_last(x):
+    perm = list(range(x.ndim))
+    perm[-1], perm[-2] = perm[-2], perm[-1]
+    return _op("transpose", x, perm=tuple(perm))
+
+
+@_export
+def t(x, name=None):
+    if x.ndim < 2:
+        return x
+    return _op("transpose", x, perm=(1, 0))
+
+
+@_export
+def histogram(x, bins=100, min=0, max=0, name=None):
+    return _op("histogram", x, bins=bins, min=min, max=max)
+
+
+@_export
+def bincount(x, weights=None, minlength=0, name=None):
+    if weights is None:
+        return _op("bincount", x, minlength=minlength)
+    return _op("bincount", x, weights, minlength=minlength)
+
+
+# manipulation -------------------------------------------------------------
+
+@_export
+def reshape(x, shape, name=None):
+    return _op("reshape", x, shape=_shape_sig(shape))
+
+
+def _shape_sig(shape):
+    # allow -1 / 0 entries like the reference ReshapeInferMeta
+    return tuple(int(s._data) if isinstance(s, Tensor) else int(s)
+                 for s in (shape if isinstance(shape, (list, tuple))
+                           else [shape]))
+
+
+@_export
+def transpose(x, perm, name=None):
+    return _op("transpose", x, perm=tuple(perm))
+
+
+@_export
+def concat(x, axis=0, name=None):
+    return _op("concat", list(x), axis=_ax(axis))
+
+
+@_export
+def stack(x, axis=0, name=None):
+    return _op("stack", list(x), axis=axis)
+
+
+@_export
+def unstack(x, axis=0, num=None):
+    return list(_op("unstack", x, axis=axis, num=num))
+
+
+@_export
+def split(x, num_or_sections, axis=0, name=None):
+    if isinstance(num_or_sections, (list, tuple)):
+        num_or_sections = tuple(int(s) for s in num_or_sections)
+    return list(_op("split", x, num_or_sections=num_or_sections,
+                    axis=_ax(axis)))
+
+
+@_export
+def chunk(x, chunks, axis=0, name=None):
+    return list(_op("chunk", x, chunks=chunks, axis=_ax(axis)))
+
+
+@_export
+def squeeze(x, axis=None, name=None):
+    return _op("squeeze", x, axis=_ax(axis))
+
+
+@_export
+def unsqueeze(x, axis, name=None):
+    return _op("unsqueeze", x, axis=_ax(axis))
+
+
+@_export
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    return _op("flatten", x, start_axis=start_axis, stop_axis=stop_axis)
+
+
+@_export
+def gather(x, index, axis=0, name=None):
+    return _op("gather", x, index, axis=_ax(axis))
+
+
+@_export
+def gather_nd(x, index, name=None):
+    return _op("gather_nd", x, index)
+
+
+@_export
+def scatter(x, index, updates, overwrite=True, name=None):
+    return _op("scatter", x, index, updates, overwrite=overwrite)
+
+
+@_export
+def scatter_nd_add(x, index, updates, name=None):
+    return _op("scatter_nd_add", x, index, updates)
+
+
+@_export
+def scatter_nd(index, updates, shape, name=None):
+    z = zeros(shape, dtype=updates.dtype)
+    return _op("scatter_nd_add", z, index, updates)
+
+
+@_export
+def index_select(x, index, axis=0, name=None):
+    return _op("index_select", x, index, axis=_ax(axis))
+
+
+@_export
+def index_sample(x, index):
+    return _op("index_sample", x, index)
+
+
+@_export
+def take_along_axis(arr, indices, axis):
+    return _op("take_along_axis", arr, indices, axis=axis)
+
+
+@_export
+def put_along_axis(arr, indices, values, axis, reduce="assign"):
+    if not isinstance(values, Tensor):
+        values = to_tensor(values)
+    return _op("put_along_axis", arr, indices, values, axis=axis,
+               reduce=reduce)
+
+
+@_export
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return _op("where", condition, x, y)
+
+
+@_export
+def nonzero(x, as_tuple=False):
+    r = _op("nonzero", x, as_tuple=as_tuple)
+    return r
+
+
+@_export
+def masked_select(x, mask, name=None):
+    return _op("masked_select", x, mask)
+
+
+@_export
+def masked_fill(x, mask, value, name=None):
+    if isinstance(value, Tensor):
+        value = value.item()
+    return _op("masked_fill", x, mask, value=value)
+
+
+@_export
+def tile(x, repeat_times, name=None):
+    return _op("tile", x, repeat_times=tuple(repeat_times))
+
+
+@_export
+def expand(x, shape, name=None):
+    return _op("expand", x, shape=_shape_sig(shape))
+
+
+@_export
+def broadcast_to(x, shape, name=None):
+    return _op("broadcast_to", x, shape=_shape_sig(shape))
+
+
+@_export
+def expand_as(x, y, name=None):
+    return _op("expand_as", x, y)
+
+
+@_export
+def broadcast_tensors(inputs, name=None):
+    shape = jnp.broadcast_shapes(*[tuple(t.shape) for t in inputs])
+    return [broadcast_to(t, shape) for t in inputs]
+
+
+@_export
+def flip(x, axis, name=None):
+    return _op("flip", x, axis=_ax(axis))
+
+
+@_export
+def roll(x, shifts, axis=None, name=None):
+    return _op("roll", x, shifts=shifts if isinstance(shifts, int)
+               else tuple(shifts), axis=_ax(axis))
+
+
+@_export
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return _op("rot90", x, k=k, axes=tuple(axes))
+
+
+@_export
+def moveaxis(x, source, destination, name=None):
+    return _op("moveaxis", x, source=_ax(source), destination=_ax(destination))
+
+
+@_export
+def swapaxes(x, axis0, axis1, name=None):
+    return _op("swapaxes", x, axis0=axis0, axis1=axis1)
+
+
+transpose_ = swapaxes
+
+
+@_export
+def tril(x, diagonal=0, name=None):
+    return _op("tril", x, diagonal=diagonal)
+
+
+@_export
+def triu(x, diagonal=0, name=None):
+    return _op("triu", x, diagonal=diagonal)
+
+
+@_export
+def diag(x, offset=0, padding_value=0, name=None):
+    return _op("diag", x, offset=offset, padding_value=padding_value)
+
+
+@_export
+def diagflat(x, offset=0, name=None):
+    return _op("diagflat", x, offset=offset)
+
+
+@_export
+def diag_embed(x, offset=0, dim1=-2, dim2=-1):
+    return _op("diag_embed", x, offset=offset, dim1=dim1, dim2=dim2)
+
+
+@_export
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return _op("diagonal", x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@_export
+def meshgrid(*args, **kwargs):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    return list(_op("meshgrid", list(args)))
+
+
+@_export
+def sort(x, axis=-1, descending=False, name=None):
+    return _op("sort", x, axis=axis, descending=descending)
+
+
+@_export
+def argsort(x, axis=-1, descending=False, name=None):
+    return _op("argsort", x, axis=axis, descending=descending)
+
+
+@_export
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    if isinstance(k, Tensor):
+        k = int(k.item())
+    return _op("topk", x, k=k, axis=axis, largest=largest, sorted=sorted)
+
+
+@_export
+def searchsorted(sorted_sequence, values, out_int32=False, right=False,
+                 name=None):
+    return _op("searchsorted", sorted_sequence, values, out_int32=out_int32,
+               right=right)
+
+
+@_export
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return _op("bucketize", x, sorted_sequence, out_int32=out_int32,
+               right=right)
+
+
+@_export
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    return _op("unique", x, return_index=return_index,
+               return_inverse=return_inverse, return_counts=return_counts,
+               axis=axis)
+
+
+@_export
+def unique_consecutive(x, return_inverse=False, return_counts=False,
+                       axis=None, dtype="int64", name=None):
+    return _op("unique_consecutive", x, return_inverse=return_inverse,
+               return_counts=return_counts)
+
+
+@_export
+def one_hot(x, num_classes, name=None):
+    return _op("one_hot", x, num_classes=int(num_classes))
+
+
+@_export
+def repeat_interleave(x, repeats, axis=None, name=None):
+    if isinstance(repeats, Tensor):
+        return _op("repeat_interleave", x, repeats, axis=_ax(axis))
+    return _op("repeat_interleave", x, repeats=int(repeats), axis=_ax(axis))
+
+
+@_export
+def slice(input, axes, starts, ends):
+    return _op("slice", input, axes=tuple(axes), starts=tuple(starts),
+               ends=tuple(ends))
+
+
+@_export
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    return _op("strided_slice", x, axes=tuple(axes), starts=tuple(starts),
+               ends=tuple(ends), strides=tuple(strides))
+
+
+@_export
+def crop(x, shape=None, offsets=None, name=None):
+    return _op("crop", x, shape=tuple(shape), offsets=tuple(offsets))
+
+
+@_export
+def as_strided(x, shape, stride, offset=0, name=None):
+    return _op("as_strided", x, shape=tuple(shape), stride=tuple(stride),
+               offset=offset)
+
+
+@_export
+def tensordot(x, y, axes=2, name=None):
+    if isinstance(axes, (list, tuple)):
+        axes = tuple(tuple(a) if isinstance(a, (list, tuple)) else a
+                     for a in axes)
+    return _op("tensordot", x, y, axes=axes)
+
+
+@_export
+def tolist(x):
+    return x.tolist()
+
+
+@_export
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+@_export
+def rank(x):
+    return to_tensor(x.ndim, dtype="int32")
+
+
+@_export
+def shape(x):
+    return to_tensor(x.shape, dtype="int32")
+
+
+@_export
+def iinfo(dtype):
+    return jnp.iinfo(convert_dtype(dtype))
+
+
+@_export
+def finfo(dtype):
+    return jnp.finfo(convert_dtype(dtype))
+
+
+# ---------------------------------------------------------------------------
+# __getitem__ / __setitem__ support
+# ---------------------------------------------------------------------------
+
+def _encode_index(idx):
+    if not isinstance(idx, tuple):
+        idx = (idx,)
+    spec = []
+    arrays = []
+    for item in idx:
+        if isinstance(item, (int, np.integer)):
+            spec.append(("int", int(item)))
+        elif isinstance(item, builtins.slice):
+            spec.append(("slice",
+                         None if item.start is None else int(item.start),
+                         None if item.stop is None else int(item.stop),
+                         None if item.step is None else int(item.step)))
+        elif item is None:
+            spec.append(("none",))
+        elif item is Ellipsis:
+            spec.append(("ellipsis",))
+        elif isinstance(item, Tensor):
+            if item.ndim == 0 and jnp.issubdtype(item.dtype, jnp.integer):
+                spec.append(("array",))
+                arrays.append(item)
+            elif item.dtype == jnp.bool_:
+                if len(idx) != 1:
+                    raise TypeError(
+                        "a boolean mask combined with other index "
+                        "components is not supported yet; index with the "
+                        "mask alone or use integer arrays")
+                return None, [item]  # boolean mask path
+            else:
+                spec.append(("array",))
+                arrays.append(item)
+        elif isinstance(item, (list, np.ndarray)):
+            arr = np.asarray(item)
+            if arr.dtype == np.bool_:
+                if len(idx) != 1:
+                    raise TypeError(
+                        "a boolean mask combined with other index "
+                        "components is not supported yet; index with the "
+                        "mask alone or use integer arrays")
+                return None, [to_tensor(arr)]
+            spec.append(("array",))
+            arrays.append(to_tensor(arr))
+        else:
+            raise TypeError(f"unsupported index {item!r}")
+    return tuple(spec), arrays
+
+
+def _tensor_getitem(self, idx):
+    spec, arrays = _encode_index(idx)
+    if spec is None:  # boolean mask
+        return _op("masked_select", self, arrays[0])
+    return _op("getitem", self, *arrays, index_spec=spec)
+
+
+def _tensor_setitem(self, idx, value):
+    spec, arrays = _encode_index(idx)
+    if not isinstance(value, Tensor):
+        value = to_tensor(value, dtype=self.dtype)
+    if spec is None:
+        new = _op("masked_fill_tensor", self, arrays[0], value) \
+            if value.size > 1 else _op("masked_fill", self, arrays[0],
+                                       value=value.item())
+    else:
+        new = _op("setitem", self, value, *arrays, index_spec=spec)
+    self._rebind(new)
+
+
+# ---------------------------------------------------------------------------
+# method attachment
+# ---------------------------------------------------------------------------
+
+def _attach_methods():
+    import sys
+    mod = sys.modules[__name__]
+
+    method_names = [n for n in __all__ if n not in (
+        "to_tensor", "seed", "get_rng_state", "set_rng_state", "is_tensor",
+        "meshgrid", "broadcast_tensors", "iinfo", "finfo")]
+    for n in method_names:
+        if not hasattr(Tensor, n):
+            setattr(Tensor, n, getattr(mod, n))
+
+    Tensor.astype = lambda self, dtype: cast(self, dtype)
+    Tensor.cast = Tensor.astype
+    Tensor.dim = lambda self: self.ndim
+    Tensor.numel = lambda self: self.size
+    Tensor.cpu = lambda self: self
+    Tensor.cuda = lambda self: self
+    Tensor.pin_memory = lambda self: self
+    Tensor.contiguous = lambda self: self
+    Tensor.__getitem__ = _tensor_getitem
+    Tensor.__setitem__ = _tensor_setitem
+
+    def _coerce(other, self):
+        return other
+
+    Tensor.__add__ = lambda s, o: add(s, o)
+    Tensor.__radd__ = lambda s, o: add(s, o)
+    Tensor.__sub__ = lambda s, o: subtract(s, o)
+    Tensor.__rsub__ = lambda s, o: subtract(to_tensor(o, dtype=s.dtype)
+                                            if not isinstance(o, Tensor)
+                                            else o, s)
+    Tensor.__mul__ = lambda s, o: multiply(s, o)
+    Tensor.__rmul__ = lambda s, o: multiply(s, o)
+    Tensor.__truediv__ = lambda s, o: divide(s, o)
+    Tensor.__rtruediv__ = lambda s, o: divide(
+        to_tensor(o, dtype=s.dtype) if not isinstance(o, Tensor) else o, s)
+    Tensor.__floordiv__ = lambda s, o: floor_divide(s, o)
+    Tensor.__mod__ = lambda s, o: mod(s, o)
+    Tensor.__pow__ = lambda s, o: globals()["pow"](s, o)
+    Tensor.__rpow__ = lambda s, o: globals()["pow"](
+        to_tensor(o, dtype=s.dtype) if not isinstance(o, Tensor) else o, s)
+    Tensor.__matmul__ = lambda s, o: matmul(s, o)
+    Tensor.__neg__ = lambda s: neg(s)
+    Tensor.__abs__ = lambda s: globals()["abs"](s)
+    Tensor.__invert__ = lambda s: logical_not(s)
+    Tensor.__eq__ = lambda s, o: equal(s, o)
+    Tensor.__ne__ = lambda s, o: not_equal(s, o)
+    Tensor.__lt__ = lambda s, o: less_than(s, o)
+    Tensor.__le__ = lambda s, o: less_equal(s, o)
+    Tensor.__gt__ = lambda s, o: greater_than(s, o)
+    Tensor.__ge__ = lambda s, o: greater_equal(s, o)
+    Tensor.__and__ = lambda s, o: (logical_and if s.dtype == jnp.bool_
+                                   else bitwise_and)(s, o)
+    Tensor.__or__ = lambda s, o: (logical_or if s.dtype == jnp.bool_
+                                  else bitwise_or)(s, o)
+    Tensor.__xor__ = lambda s, o: (logical_xor if s.dtype == jnp.bool_
+                                   else bitwise_xor)(s, o)
+    Tensor.__hash__ = object.__hash__
+
+    # in-place variants (mutate by rebinding, reference: inplace *_ ops)
+    def _make_inplace(fn):
+        def inplace(self, *a, **k):
+            self._rebind(fn(self, *a, **k))
+            return self
+        return inplace
+
+    for base in ["add", "subtract", "multiply", "divide", "clip", "scale",
+                 "floor", "ceil", "exp", "sqrt", "reciprocal", "round",
+                 "tanh", "abs"]:
+        setattr(Tensor, base + "_", _make_inplace(getattr(mod, base)))
+
+    def _fill_(self, value):
+        self._rebind(full_like(self, value))
+        return self
+
+    def _zero_(self):
+        return _fill_(self, 0)
+
+    Tensor.fill_ = _fill_
+    Tensor.zero_ = _zero_
+    Tensor.T = property(lambda self: transpose(
+        self, tuple(reversed(range(self.ndim)))))
+    Tensor.mT = property(lambda self: transpose_last(self)
+                         if self.ndim >= 2 else self)
+
+    def _uniform_(self, min=-1.0, max=1.0, seed=0):
+        self._rebind(uniform(self.shape, dtype=self.dtype, min=min, max=max))
+        return self
+
+    def _normal_(self, mean=0.0, std=1.0):
+        self._rebind(cast(normal(mean, std, self.shape), self.dtype))
+        return self
+
+    Tensor.uniform_ = _uniform_
+    Tensor.normal_ = _normal_
+
+
+_attach_methods()

@@ -1,0 +1,16 @@
+"""Test configuration.
+
+Per SURVEY.md §4's TPU-native translation: tests run on the CPU PjRt backend
+(the "fake device", analog of the reference's fake_cpu_device.h) with 8
+virtual devices so multi-chip sharding paths execute without TPU hardware.
+Must set env before jax initializes.
+"""
+import os
+
+# Hard override: the driver environment pre-sets JAX_PLATFORMS=axon (the
+# remote TPU tunnel); unit tests must run on the local CPU backend.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,85 @@
+"""OpTest harness — the analog of the reference's single most important test
+base (/root/reference/python/paddle/fluid/tests/unittests/op_test.py:309).
+
+``check_output``: run a framework op and compare against a numpy reference.
+``check_grad``: compare tape-computed analytic gradients against numeric
+finite-difference gradients (analog of op_test.py get_numeric_gradient).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def check_output(fn, np_fn, inputs, atol=1e-5, rtol=1e-5, **kwargs):
+    """fn: framework fn taking Tensors; np_fn: numpy reference."""
+    tensors = [paddle.to_tensor(i) for i in inputs]
+    out = fn(*tensors, **kwargs)
+    ref = np_fn(*[np.asarray(i) for i in inputs], **kwargs)
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    refs = ref if isinstance(ref, (list, tuple)) else [ref]
+    assert len(outs) == len(refs), f"{len(outs)} outputs vs {len(refs)} refs"
+    for o, r in zip(outs, refs):
+        np.testing.assert_allclose(
+            np.asarray(o.numpy(), dtype=np.float64)
+            if np.issubdtype(np.asarray(r).dtype, np.floating)
+            else o.numpy(),
+            np.asarray(r), atol=atol, rtol=rtol)
+    return out
+
+
+def numeric_grad(fn, inputs, wrt, eps=1e-3, **kwargs):
+    """Central-difference gradient of sum(fn(inputs)) wrt inputs[wrt]."""
+    inputs = [np.asarray(i, dtype=np.float64) for i in inputs]
+    base = inputs[wrt]
+    grad = np.zeros_like(base)
+    it = np.nditer(base, flags=["multi_index"])
+    while not it.finished:
+        idx = it.multi_index
+        orig = base[idx]
+        base[idx] = orig + eps
+        hi = _eval_sum(fn, inputs, **kwargs)
+        base[idx] = orig - eps
+        lo = _eval_sum(fn, inputs, **kwargs)
+        base[idx] = orig
+        grad[idx] = (hi - lo) / (2 * eps)
+        it.iternext()
+    return grad
+
+
+def _eval_sum(fn, np_inputs, **kwargs):
+    ts = [paddle.to_tensor(i, dtype='float64') for i in np_inputs]
+    out = fn(*ts, **kwargs)
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    total = 0.0
+    for o in outs:
+        if np.issubdtype(np.asarray(o.numpy()).dtype, np.floating):
+            total += float(np.sum(o.numpy()))
+    return total
+
+
+def check_grad(fn, inputs, grad_wrt=None, atol=1e-4, rtol=1e-3, eps=1e-3,
+               **kwargs):
+    """Analytic (tape) vs numeric gradients, fp64 for stability."""
+    np_inputs = [np.asarray(i, dtype=np.float64) for i in inputs]
+    tensors = [paddle.to_tensor(i, dtype='float64', stop_gradient=False)
+               for i in np_inputs]
+    out = fn(*tensors, **kwargs)
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    # sum all float outputs to a scalar loss
+    loss = None
+    for o in outs:
+        if o is None or not np.issubdtype(np.asarray(o.numpy()).dtype,
+                                          np.floating):
+            continue
+        s = o.sum()
+        loss = s if loss is None else loss + s
+    loss.backward()
+    wrt = grad_wrt if grad_wrt is not None else range(len(inputs))
+    for i in wrt:
+        num = numeric_grad(fn, np_inputs, i, eps=eps, **kwargs)
+        ana = tensors[i].grad.numpy() if tensors[i].grad is not None \
+            else np.zeros_like(np_inputs[i])
+        np.testing.assert_allclose(ana, num, atol=atol, rtol=rtol,
+                                   err_msg=f"grad mismatch for input {i}")

@@ -1,0 +1,221 @@
+"""Layer-level tests (reference analog: unittests/test_layers.py and the
+per-layer test_*_op.py files — numpy-parity + shape checks in dygraph)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+rng = np.random.RandomState(7)
+
+
+def t(a, sg=True):
+    return paddle.to_tensor(np.asarray(a, np.float32), stop_gradient=sg)
+
+
+class TestLinearConv:
+    def test_linear_shape_and_grad(self):
+        layer = nn.Linear(4, 3)
+        x = t(rng.randn(5, 4), sg=False)
+        y = layer(x)
+        assert y.shape == [5, 3]
+        paddle.sum(y).backward()
+        assert layer.weight.grad is not None
+        np.testing.assert_allclose(
+            layer.weight.grad.numpy(),
+            np.tile(x.numpy().sum(0)[:, None], (1, 3)), rtol=1e-5)
+
+    def test_conv2d_matches_manual(self):
+        layer = nn.Conv2D(2, 3, 3, padding=1)
+        x = t(rng.randn(1, 2, 8, 8))
+        y = layer(x)
+        assert y.shape == [1, 3, 8, 8]
+
+    def test_sequential_mlp_trains(self):
+        model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 1))
+        x = t(rng.randn(16, 4))
+        target = t(rng.randn(16, 1))
+        loss0 = None
+        for _ in range(5):
+            y = model(x)
+            loss = F.mse_loss(y, target)
+            loss.backward()
+            with paddle.no_grad():
+                for p in model.parameters():
+                    p._data = p._data - 0.05 * p.grad._data
+                    p.clear_grad()
+            if loss0 is None:
+                loss0 = float(loss)
+        assert float(loss) < loss0
+
+
+class TestNorms:
+    def test_layer_norm_stats(self):
+        ln = nn.LayerNorm(16)
+        x = t(rng.randn(4, 16) * 3 + 1)
+        y = ln(x).numpy()
+        np.testing.assert_allclose(y.mean(-1), 0, atol=1e-5)
+        np.testing.assert_allclose(y.std(-1), 1, atol=1e-2)
+
+    def test_layer_norm_multi_dim_normalized_shape(self):
+        ln = nn.LayerNorm([4, 16])
+        x = t(rng.randn(2, 4, 16))
+        y = ln(x).numpy()
+        np.testing.assert_allclose(y.reshape(2, -1).mean(-1), 0, atol=1e-5)
+
+    def test_batch_norm_train_eval(self):
+        bn = nn.BatchNorm2D(3)
+        x = t(rng.randn(4, 3, 5, 5) * 2 + 3)
+        y = bn(x).numpy()
+        np.testing.assert_allclose(y.mean((0, 2, 3)), 0, atol=1e-4)
+        # running stats moved toward batch stats
+        assert not np.allclose(bn._mean.numpy(), 0)
+        bn.eval()
+        y2 = bn(x)
+        assert y2.shape == list(x.shape)
+
+    def test_group_norm(self):
+        gn = nn.GroupNorm(2, 4)
+        x = t(rng.randn(2, 4, 6, 6))
+        assert gn(x).shape == [2, 4, 6, 6]
+
+
+class TestAttention:
+    def test_mha_self_attention(self):
+        mha = nn.MultiHeadAttention(16, 4)
+        x = t(rng.randn(2, 5, 16))
+        y = mha(x)
+        assert y.shape == [2, 5, 16]
+
+    def test_mha_causal_mask_blocks_future(self):
+        mha = nn.MultiHeadAttention(8, 2)
+        mha.eval()
+        x = np.asarray(rng.randn(1, 4, 8), np.float32)
+        mask = np.tril(np.ones((1, 1, 4, 4), bool))
+        y_full = mha(t(x), attn_mask=paddle.to_tensor(mask)).numpy()
+        # changing the last position must not affect position 0 output
+        x2 = x.copy()
+        x2[0, -1] += 100.0
+        y_pert = mha(t(x2), attn_mask=paddle.to_tensor(mask)).numpy()
+        np.testing.assert_allclose(y_full[0, 0], y_pert[0, 0], atol=1e-5)
+
+    def test_encoder_layer_and_stack(self):
+        enc = nn.TransformerEncoder(
+            nn.TransformerEncoderLayer(16, 4, 32, dropout=0.0), 2)
+        x = t(rng.randn(2, 6, 16))
+        assert enc(x).shape == [2, 6, 16]
+
+    def test_decoder_cross_attention(self):
+        dec = nn.TransformerDecoder(
+            nn.TransformerDecoderLayer(16, 4, 32, dropout=0.0), 2)
+        tgt = t(rng.randn(2, 3, 16))
+        mem = t(rng.randn(2, 6, 16))
+        assert dec(tgt, mem).shape == [2, 3, 16]
+
+
+class TestRegressionFixes:
+    """Fixes from review: rebind tape, pad order, masked assignment,
+    ceil_mode, bincount, layer_norm kwarg."""
+
+    def test_setitem_keeps_upstream_graph(self):
+        x = t(rng.randn(3), sg=False)
+        y = x * 2.0
+        y[0] = 0.0
+        paddle.sum(y).backward()
+        # dy/dx = 2 except position 0 which was overwritten -> 0
+        np.testing.assert_allclose(x.grad.numpy(), [0.0, 2.0, 2.0],
+                                   atol=1e-6)
+
+    def test_inplace_on_leaf_requiring_grad_raises(self):
+        x = t(rng.randn(3), sg=False)
+        with pytest.raises(Exception):
+            x[0] = 1.0
+
+    def test_pad_last_dim_first(self):
+        x = t(rng.randn(1, 1, 2, 3))
+        y = F.pad(x, [1, 2, 0, 0]).numpy()  # pads W only
+        assert y.shape == (1, 1, 2, 6)
+        ref = np.pad(x.numpy(), [(0, 0), (0, 0), (0, 0), (1, 2)])
+        np.testing.assert_allclose(y, ref)
+
+    def test_bool_mask_vector_assignment(self):
+        x = t(np.zeros((2, 3)))
+        mask = paddle.to_tensor(
+            np.array([[True, False, True], [False, True, False]]))
+        x[mask] = paddle.to_tensor(np.array([1., 2., 3.], np.float32))
+        np.testing.assert_allclose(
+            x.numpy(), [[1., 0., 2.], [0., 3., 0.]])
+
+    def test_row_mask_fill(self):
+        x = t(np.ones((3, 2)))
+        mask = paddle.to_tensor(np.array([True, False, True]))
+        x[mask] = 5.0
+        np.testing.assert_allclose(x.numpy(),
+                                   [[5., 5.], [1., 1.], [5., 5.]])
+
+    def test_mixed_mask_index_raises(self):
+        x = t(np.ones((3, 2)))
+        mask = paddle.to_tensor(np.array([True, False]))
+        with pytest.raises(TypeError):
+            x[0, mask]
+
+    def test_ceil_mode_pooling(self):
+        x = t(rng.randn(1, 1, 5, 5))
+        y = F.max_pool2d(x, 2, stride=2, ceil_mode=True)
+        assert y.shape == [1, 1, 3, 3]
+        y2 = F.max_pool2d(x, 2, stride=2, ceil_mode=False)
+        assert y2.shape == [1, 1, 2, 2]
+        # ceil corner = max of the 1-element tail window
+        assert float(y.numpy()[0, 0, 2, 2]) == float(x.numpy()[0, 0, 4, 4])
+
+    def test_avg_pool_ceil_exclusive_counts(self):
+        x = t(np.ones((1, 1, 3, 3)))
+        y = F.avg_pool2d(x, 2, stride=2, ceil_mode=True, exclusive=True)
+        # all windows average ones -> exactly 1 even in partial windows
+        np.testing.assert_allclose(y.numpy(), np.ones((1, 1, 2, 2)),
+                                   atol=1e-6)
+
+    def test_bincount_eager(self):
+        x = paddle.to_tensor(np.array([1, 2, 2, 5]), dtype="int64")
+        np.testing.assert_array_equal(paddle.bincount(x).numpy(),
+                                      [0, 1, 2, 0, 0, 1])
+
+    def test_embedding_negative_padding_idx(self):
+        emb = nn.Embedding(10, 4, padding_idx=-1)
+        ids = paddle.to_tensor(np.array([0, 9]), dtype="int64")
+        out = emb(ids).numpy()
+        np.testing.assert_allclose(out[1], 0.0)
+
+
+class TestActivationsAndLosses:
+    def test_activation_layers_run(self):
+        x = t(rng.randn(3, 4))
+        for cls in [nn.ReLU, nn.GELU, nn.Sigmoid, nn.Tanh, nn.Silu,
+                    nn.LeakyReLU, nn.Hardswish, nn.Softplus, nn.Mish]:
+            y = cls()(x)
+            assert y.shape == [3, 4]
+
+    def test_cross_entropy_loss(self):
+        logits = t(rng.randn(8, 5), sg=False)
+        labels = paddle.to_tensor(rng.randint(0, 5, (8,)), dtype="int64")
+        loss = nn.CrossEntropyLoss()(logits, labels)
+        ref = -np.log(
+            np.exp(logits.numpy()) /
+            np.exp(logits.numpy()).sum(-1, keepdims=True))[
+            np.arange(8), labels.numpy()].mean()
+        np.testing.assert_allclose(float(loss), ref, rtol=1e-5)
+        loss.backward()
+        assert logits.grad is not None
+
+    def test_clip_grad_by_global_norm(self):
+        clip = nn.ClipGradByGlobalNorm(1.0)
+        import jax.numpy as jnp
+        g1, g2 = jnp.full((2,), 3.0), jnp.full((2,), 4.0)
+
+        class P:
+            need_clip = True
+        out = clip([(P(), g1), (P(), g2)])
+        total = np.sqrt(sum(float(np.sum(np.square(np.asarray(g))))
+                            for _, g in out))
+        np.testing.assert_allclose(total, 1.0, rtol=1e-5)

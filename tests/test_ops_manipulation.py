@@ -1,0 +1,195 @@
+"""Op parity tests (manipulation/indexing) — OpTest analog.
+Reference pattern: unittests/test_reshape_op.py, test_concat_op.py,
+test_gather_op.py, test_slice_op.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import check_grad, check_output
+
+rng = np.random.RandomState(7)
+
+
+def test_reshape_transpose_flatten():
+    x = rng.randn(2, 3, 4).astype(np.float32)
+    check_output(lambda t: paddle.reshape(t, [4, 6]),
+                 lambda a: a.reshape(4, 6), [x])
+    check_output(lambda t: paddle.reshape(t, [-1, 4]),
+                 lambda a: a.reshape(-1, 4), [x])
+    check_output(lambda t: paddle.transpose(t, [2, 0, 1]),
+                 lambda a: a.transpose(2, 0, 1), [x])
+    check_output(lambda t: paddle.flatten(t, 1, 2),
+                 lambda a: a.reshape(2, 12), [x])
+    check_grad(lambda t: paddle.reshape(t, [12, 2]), [x])
+
+
+def test_concat_stack_split():
+    xs = [rng.randn(2, 3).astype(np.float32) for _ in range(3)]
+    out = paddle.concat([paddle.to_tensor(a) for a in xs], axis=1)
+    np.testing.assert_allclose(out.numpy(), np.concatenate(xs, axis=1))
+    out = paddle.stack([paddle.to_tensor(a) for a in xs], axis=0)
+    np.testing.assert_allclose(out.numpy(), np.stack(xs, axis=0))
+    parts = paddle.split(paddle.to_tensor(xs[0]), 3, axis=1)
+    assert len(parts) == 3 and parts[0].shape == [2, 1]
+    parts = paddle.split(paddle.to_tensor(rng.randn(6, 2).astype("f")),
+                         [1, 2, -1], axis=0)
+    assert [p.shape[0] for p in parts] == [1, 2, 3]
+    # concat grad flows to every input
+    a = paddle.to_tensor(xs[0], stop_gradient=False)
+    b = paddle.to_tensor(xs[1], stop_gradient=False)
+    paddle.concat([a, b], axis=0).sum().backward()
+    np.testing.assert_allclose(a.grad.numpy(), np.ones_like(xs[0]))
+    np.testing.assert_allclose(b.grad.numpy(), np.ones_like(xs[1]))
+
+
+def test_squeeze_unsqueeze_expand():
+    x = rng.randn(3, 1, 4).astype(np.float32)
+    check_output(lambda t: paddle.squeeze(t, 1),
+                 lambda a: a.squeeze(1), [x])
+    check_output(lambda t: paddle.unsqueeze(t, 0),
+                 lambda a: a[None], [x])
+    check_output(lambda t: paddle.expand(t, [3, 5, 4]),
+                 lambda a: np.broadcast_to(a, (3, 5, 4)), [x])
+    check_grad(lambda t: paddle.expand(t, [3, 5, 4]), [x])
+
+
+def test_gather_scatter():
+    x = rng.randn(5, 3).astype(np.float32)
+    idx = np.array([0, 2, 4])
+    check_output(lambda t: paddle.gather(t, paddle.to_tensor(idx), axis=0),
+                 lambda a: a[idx], [x])
+    check_grad(lambda t: paddle.gather(t, paddle.to_tensor(idx), axis=0),
+               [x])
+    upd = rng.randn(3, 3).astype(np.float32)
+    out = paddle.scatter(paddle.to_tensor(x), paddle.to_tensor(idx),
+                         paddle.to_tensor(upd))
+    ref = x.copy()
+    ref[idx] = upd
+    np.testing.assert_allclose(out.numpy(), ref)
+    # gather_nd
+    gx = rng.randn(2, 3, 4).astype(np.float32)
+    gidx = np.array([[0, 1], [1, 2]])
+    check_output(lambda t: paddle.gather_nd(t, paddle.to_tensor(gidx)),
+                 lambda a: a[[0, 1], [1, 2]], [gx])
+
+
+def test_where_masked():
+    x = rng.randn(3, 4).astype(np.float32)
+    y = rng.randn(3, 4).astype(np.float32)
+    cond = x > 0
+    out = paddle.where(paddle.to_tensor(cond), paddle.to_tensor(x),
+                       paddle.to_tensor(y))
+    np.testing.assert_allclose(out.numpy(), np.where(cond, x, y))
+    ms = paddle.masked_select(paddle.to_tensor(x), paddle.to_tensor(cond))
+    np.testing.assert_allclose(ms.numpy(), x[cond])
+    mf = paddle.masked_fill(paddle.to_tensor(x), paddle.to_tensor(cond), 9.0)
+    np.testing.assert_allclose(mf.numpy(), np.where(cond, 9.0, x))
+
+
+def test_indexing():
+    x = rng.randn(4, 5, 6).astype(np.float32)
+    t = paddle.to_tensor(x)
+    np.testing.assert_allclose(t[1].numpy(), x[1])
+    np.testing.assert_allclose(t[1:3, ::2].numpy(), x[1:3, ::2])
+    np.testing.assert_allclose(t[..., -1].numpy(), x[..., -1])
+    np.testing.assert_allclose(t[:, None].numpy(), x[:, None])
+    idx = np.array([0, 2])
+    np.testing.assert_allclose(t[paddle.to_tensor(idx)].numpy(), x[idx])
+    mask = x > 0
+    np.testing.assert_allclose(t[paddle.to_tensor(mask)].numpy(), x[mask])
+    # grad through slicing
+    a = paddle.to_tensor(x, stop_gradient=False)
+    a[1:3].sum().backward()
+    ref = np.zeros_like(x)
+    ref[1:3] = 1
+    np.testing.assert_allclose(a.grad.numpy(), ref)
+
+
+def test_setitem():
+    x = rng.randn(4, 5).astype(np.float32)
+    t = paddle.to_tensor(x)
+    t[1] = 0.0
+    ref = x.copy()
+    ref[1] = 0
+    np.testing.assert_allclose(t.numpy(), ref)
+    t[:, 2] = paddle.to_tensor(np.ones(4, np.float32) * 7)
+    ref[:, 2] = 7
+    np.testing.assert_allclose(t.numpy(), ref)
+
+
+def test_tile_flip_roll_pad():
+    x = rng.randn(2, 3).astype(np.float32)
+    check_output(lambda t: paddle.tile(t, [2, 2]),
+                 lambda a: np.tile(a, (2, 2)), [x])
+    check_output(lambda t: paddle.flip(t, axis=1),
+                 lambda a: np.flip(a, axis=1).copy(), [x])
+    check_output(lambda t: paddle.roll(t, 1, axis=0),
+                 lambda a: np.roll(a, 1, axis=0), [x])
+
+
+def test_sort_unique_searchsorted():
+    x = rng.randn(10).astype(np.float32)
+    check_output(lambda t: paddle.sort(t), lambda a: np.sort(a), [x])
+    u = paddle.unique(paddle.to_tensor(np.array([3, 1, 2, 1, 3])))
+    np.testing.assert_allclose(u.numpy(), [1, 2, 3])
+    ss = paddle.searchsorted(paddle.to_tensor(np.array([1., 3., 5.])),
+                             paddle.to_tensor(np.array([2., 4.])))
+    np.testing.assert_allclose(ss.numpy(), [1, 2])
+
+
+def test_one_hot_take_along():
+    idx = np.array([0, 2, 1])
+    oh = paddle.one_hot(paddle.to_tensor(idx), 4)
+    assert oh.shape == [3, 4]
+    np.testing.assert_allclose(oh.numpy().argmax(1), idx)
+    x = rng.randn(3, 4).astype(np.float32)
+    ind = np.array([[1], [2], [0]])
+    check_output(
+        lambda t: paddle.take_along_axis(t, paddle.to_tensor(ind), 1),
+        lambda a: np.take_along_axis(a, ind, 1), [x])
+
+
+def test_creation():
+    z = paddle.zeros([2, 3])
+    assert z.shape == [2, 3] and str(z.dtype) == "float32"
+    o = paddle.ones([2], dtype="int64")
+    assert o.numpy().tolist() == [1, 1]
+    f = paddle.full([2, 2], 3.5)
+    np.testing.assert_allclose(f.numpy(), np.full((2, 2), 3.5))
+    ar = paddle.arange(0, 10, 2)
+    np.testing.assert_allclose(ar.numpy(), np.arange(0, 10, 2))
+    assert str(ar.dtype) == "int64"
+    lin = paddle.linspace(0, 1, 5)
+    np.testing.assert_allclose(lin.numpy(), np.linspace(0, 1, 5), rtol=1e-6)
+    e = paddle.eye(3)
+    np.testing.assert_allclose(e.numpy(), np.eye(3))
+    zl = paddle.zeros_like(paddle.ones([2, 2]))
+    np.testing.assert_allclose(zl.numpy(), np.zeros((2, 2)))
+    tr = paddle.tril(paddle.ones([3, 3]))
+    np.testing.assert_allclose(tr.numpy(), np.tril(np.ones((3, 3))))
+
+
+def test_random_reproducible():
+    paddle.seed(123)
+    a = paddle.rand([4, 4])
+    paddle.seed(123)
+    b = paddle.rand([4, 4])
+    np.testing.assert_allclose(a.numpy(), b.numpy())
+    c = paddle.rand([4, 4])
+    assert not np.allclose(b.numpy(), c.numpy())
+    r = paddle.randint(0, 10, [100])
+    assert r.numpy().min() >= 0 and r.numpy().max() < 10
+    p = paddle.randperm(16)
+    assert sorted(p.numpy().tolist()) == list(range(16))
+
+
+def test_inplace_ops():
+    x = paddle.to_tensor([1.0, 2.0])
+    y = x.add_(paddle.to_tensor([1.0, 1.0]))
+    assert y is x
+    np.testing.assert_allclose(x.numpy(), [2.0, 3.0])
+    x.scale_(scale=2.0)
+    np.testing.assert_allclose(x.numpy(), [4.0, 6.0])
+    x.zero_()
+    np.testing.assert_allclose(x.numpy(), [0.0, 0.0])

@@ -1,0 +1,182 @@
+"""Op parity tests (math/reduce/compare) — OpTest analog, see tests/op_test.py.
+Reference pattern: unittests/test_activation_op.py, test_elementwise_*_op.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import check_grad, check_output
+
+rng = np.random.RandomState(42)
+
+
+@pytest.mark.parametrize("name,np_fn", [
+    ("add", np.add), ("subtract", np.subtract), ("multiply", np.multiply),
+    ("divide", np.divide), ("maximum", np.maximum), ("minimum", np.minimum),
+    ("pow", np.power), ("atan2", np.arctan2),
+])
+def test_binary_elementwise(name, np_fn):
+    x = rng.rand(3, 4).astype(np.float32) + 0.5
+    y = rng.rand(3, 4).astype(np.float32) + 0.5
+    check_output(getattr(paddle, name), np_fn, [x, y])
+    check_grad(getattr(paddle, name), [x, y])
+
+
+def test_broadcasting():
+    x = rng.rand(3, 1, 4).astype(np.float32)
+    y = rng.rand(5, 1).astype(np.float32)
+    check_output(paddle.add, np.add, [x, y])
+    check_grad(paddle.add, [x, y])
+
+
+@pytest.mark.parametrize("name,np_fn,domain", [
+    ("exp", np.exp, (-1, 1)), ("log", np.log, (0.1, 2)),
+    ("sqrt", np.sqrt, (0.1, 2)), ("tanh", np.tanh, (-2, 2)),
+    ("sin", np.sin, (-2, 2)), ("cos", np.cos, (-2, 2)),
+    ("abs", np.abs, (0.1, 2)), ("square", np.square, (-2, 2)),
+    ("floor", np.floor, (-2, 2)), ("ceil", np.ceil, (-2, 2)),
+    ("reciprocal", np.reciprocal, (0.5, 2)),
+    ("log1p", np.log1p, (-0.5, 2)), ("expm1", np.expm1, (-1, 1)),
+    ("rsqrt", lambda x: 1 / np.sqrt(x), (0.5, 2)),
+])
+def test_unary(name, np_fn, domain):
+    lo, hi = domain
+    x = (rng.rand(4, 5) * (hi - lo) + lo).astype(np.float32)
+    check_output(getattr(paddle, name), np_fn, [x])
+    if name not in ("floor", "ceil", "abs"):
+        check_grad(getattr(paddle, name), [x])
+
+
+def test_scale_clip():
+    x = rng.randn(3, 4).astype(np.float32)
+    check_output(lambda t: paddle.scale(t, scale=2.5, bias=1.0),
+                 lambda a: a * 2.5 + 1.0, [x])
+    check_output(lambda t: paddle.clip(t, -0.5, 0.5),
+                 lambda a: np.clip(a, -0.5, 0.5), [x])
+    check_grad(lambda t: paddle.scale(t, scale=3.0, bias=-1.0), [x])
+
+
+@pytest.mark.parametrize("axis,keepdim", [
+    (None, False), (0, False), (1, True), ((0, 1), False), (-1, False)])
+def test_reductions(axis, keepdim):
+    x = rng.randn(3, 4, 5).astype(np.float32)
+    check_output(lambda t: paddle.sum(t, axis=axis, keepdim=keepdim),
+                 lambda a: np.sum(a, axis=axis, keepdims=keepdim), [x])
+    check_output(lambda t: paddle.mean(t, axis=axis, keepdim=keepdim),
+                 lambda a: np.mean(a, axis=axis, keepdims=keepdim), [x])
+    check_output(lambda t: paddle.max(t, axis=axis, keepdim=keepdim),
+                 lambda a: np.max(a, axis=axis, keepdims=keepdim), [x])
+    check_grad(lambda t: paddle.mean(t, axis=axis, keepdim=keepdim), [x])
+
+
+def test_var_std():
+    x = rng.randn(4, 6).astype(np.float32)
+    check_output(lambda t: paddle.var(t, axis=1),
+                 lambda a: np.var(a, axis=1, ddof=1), [x])
+    check_output(lambda t: paddle.std(t, unbiased=False),
+                 lambda a: np.std(a), [x])
+
+
+def test_argmax_argsort_topk():
+    x = rng.randn(4, 7).astype(np.float32)
+    check_output(lambda t: paddle.argmax(t, axis=1),
+                 lambda a: np.argmax(a, axis=1), [x])
+    check_output(lambda t: paddle.argsort(t, axis=-1),
+                 lambda a: np.argsort(a, axis=-1, kind="stable"), [x])
+    vals, idx = paddle.topk(paddle.to_tensor(x), k=3, axis=1)
+    ref = np.sort(x, axis=1)[:, ::-1][:, :3]
+    np.testing.assert_allclose(vals.numpy(), ref, rtol=1e-6)
+
+
+def test_cumsum_logsumexp():
+    x = rng.randn(3, 5).astype(np.float32)
+    check_output(lambda t: paddle.cumsum(t, axis=1),
+                 lambda a: np.cumsum(a, axis=1), [x])
+    check_grad(lambda t: paddle.cumsum(t, axis=0), [x])
+    from scipy.special import logsumexp as sls
+    check_output(lambda t: paddle.logsumexp(t, axis=1),
+                 lambda a: sls(a, axis=1), [x])
+
+
+def test_compare_logic():
+    x = rng.randn(3, 4).astype(np.float32)
+    y = rng.randn(3, 4).astype(np.float32)
+    check_output(paddle.greater_than, np.greater, [x, y])
+    check_output(paddle.equal, np.equal, [x, x.copy()])
+    a = rng.rand(3, 4) > 0.5
+    b = rng.rand(3, 4) > 0.5
+    check_output(paddle.logical_and, np.logical_and, [a, b])
+    assert bool(paddle.allclose(paddle.to_tensor(x), paddle.to_tensor(x)))
+
+
+def test_matmul_variants():
+    x = rng.randn(3, 4).astype(np.float32)
+    y = rng.randn(4, 5).astype(np.float32)
+    check_output(paddle.matmul, np.matmul, [x, y], atol=1e-4)
+    check_grad(paddle.matmul, [x, y], atol=1e-3)
+    check_output(lambda a, b: paddle.matmul(a, b, transpose_y=True),
+                 lambda a, b: a @ b.T, [x, y.T.copy()], atol=1e-4)
+    # batched
+    bx = rng.randn(2, 3, 4).astype(np.float32)
+    by = rng.randn(2, 4, 5).astype(np.float32)
+    check_output(paddle.bmm, np.matmul, [bx, by], atol=1e-4)
+
+
+def test_einsum_norm():
+    x = rng.randn(3, 4).astype(np.float32)
+    y = rng.randn(4, 5).astype(np.float32)
+    out = paddle.einsum("ij,jk->ik", paddle.to_tensor(x),
+                        paddle.to_tensor(y))
+    np.testing.assert_allclose(out.numpy(), x @ y, rtol=1e-5, atol=1e-5)
+    check_output(lambda t: paddle.norm(t, p=2, axis=1),
+                 lambda a: np.linalg.norm(a, axis=1), [x])
+    check_output(lambda t: paddle.norm(t),
+                 lambda a: np.linalg.norm(a), [x])
+
+
+def test_linalg_decomp():
+    a = rng.randn(4, 4).astype(np.float32)
+    spd = a @ a.T + 4 * np.eye(4, dtype=np.float32)
+    c = paddle.cholesky(paddle.to_tensor(spd))
+    np.testing.assert_allclose(c.numpy() @ c.numpy().T, spd, atol=1e-4)
+    inv = paddle.inverse(paddle.to_tensor(spd))
+    np.testing.assert_allclose(inv.numpy() @ spd, np.eye(4), atol=1e-4)
+
+
+def test_no_grad_and_retain():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+    z = (x * 3).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [3.0, 3.0])
+    # grad accumulation
+    z2 = (x * 2).sum()
+    z2.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0, 5.0])
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_double_use_and_chain():
+    x = paddle.to_tensor(np.array([2.0, 3.0]), stop_gradient=False)
+    y = x * x + x  # d/dx = 2x + 1
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0, 7.0])
+
+
+def test_backward_freed_graph():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = (x * 2).sum()
+    y.backward()
+    with pytest.raises(Exception):
+        y.backward()
+
+
+def test_retain_graph():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = (x * 2).sum()
+    y.backward(retain_graph=True)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0])
