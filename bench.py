@@ -1,0 +1,57 @@
+"""Benchmark harness — prints ONE JSON line for the driver.
+
+Current benchmark: north-star config 1 analog — LeNet/MNIST-shaped training
+throughput (imgs/sec) on a single chip through the full paddle_tpu stack
+(Model.fit's jitted train step: forward, loss, backward, Adam update).
+
+vs_baseline: the reference publishes no numbers (BASELINE.md); 8xA100
+paddlepaddle-gpu LeNet-MNIST throughput is ingest-bound, not compute-bound.
+Until a measured baseline lands, vs_baseline reports throughput normalised
+by the driver-recorded previous round (1.0 = first measurement).
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.metric import Accuracy
+    from paddle_tpu.vision.models import LeNet
+
+    batch = 256
+    model = paddle.Model(LeNet())
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                parameters=model.network.parameters())
+    model.prepare(opt, nn.CrossEntropyLoss())
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(batch, 1, 28, 28).astype(np.float32)
+    y = rng.randint(0, 10, (batch, 1)).astype(np.int64)
+
+    # warmup (compile)
+    for _ in range(3):
+        model.train_batch([x], [y])
+
+    n_steps = 30
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        model.train_batch([x], [y])
+    dt = time.perf_counter() - t0
+
+    imgs_per_sec = batch * n_steps / dt
+    print(json.dumps({
+        "metric": "lenet_mnist_train_imgs_per_sec",
+        "value": round(imgs_per_sec, 1),
+        "unit": "imgs/sec",
+        "vs_baseline": 1.0,
+    }))
+
+
+if __name__ == "__main__":
+    main()

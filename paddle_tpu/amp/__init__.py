@@ -1,0 +1,219 @@
+"""``paddle.amp`` — automatic mixed precision.
+
+Analog of the reference's ``python/paddle/amp/`` (auto_cast.py:21-78 O1/O2
+black/white lists; grad_scaler.py:26 GradScaler with dynamic loss scaling
+backed by check_finite_and_unscale / update_loss_scaling CUDA ops).
+
+TPU-native design: bf16 is the native mixed-precision dtype — it needs NO
+loss scaling (same exponent range as fp32), so ``auto_cast`` with bf16 is a
+pure dtype policy and ``GradScaler`` degenerates to a pass-through unless
+fp16 is explicitly requested. The O1 white/black list maps to a per-op cast
+decision applied in the dispatch layer; O2 casts parameters once.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+
+__all__ = ["auto_cast", "amp_guard", "GradScaler", "decorate",
+           "white_list", "black_list", "is_auto_cast_enabled",
+           "get_amp_dtype"]
+
+# O1 lists (reference amp/auto_cast.py WHITE_LIST/BLACK_LIST): matmul-class
+# ops run in low precision; numerically-sensitive ops stay fp32.
+white_list = {
+    "matmul", "bmm", "mv", "linear", "conv1d", "conv2d", "conv3d",
+    "conv2d_transpose", "einsum", "addmm",
+    "scaled_dot_product_attention",
+}
+black_list = {
+    "softmax", "log_softmax", "layer_norm", "batch_norm", "group_norm",
+    "instance_norm", "rms_norm", "cross_entropy",
+    "softmax_with_cross_entropy", "nll_loss", "bce_loss", "bce_with_logits",
+    "mean", "sum", "p_norm", "frobenius_norm", "logsumexp", "exp", "log",
+    "cumsum", "prod",
+}
+
+_amp_state = threading.local()
+
+
+def is_auto_cast_enabled() -> bool:
+    return getattr(_amp_state, "enabled", False)
+
+
+def get_amp_dtype():
+    return getattr(_amp_state, "dtype", None)
+
+
+def get_amp_level():
+    return getattr(_amp_state, "level", "O0")
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16"):
+    """Context manager enabling per-op autocast in the dispatch layer."""
+    old = (getattr(_amp_state, "enabled", False),
+           getattr(_amp_state, "dtype", None),
+           getattr(_amp_state, "level", "O0"),
+           getattr(_amp_state, "white", None),
+           getattr(_amp_state, "black", None))
+    _amp_state.enabled = enable
+    _amp_state.dtype = jnp.bfloat16 if dtype in ("bfloat16", "bf16") \
+        else jnp.float16
+    _amp_state.level = level
+    _amp_state.white = white_list | set(custom_white_list or ())
+    _amp_state.black = (black_list - set(custom_white_list or ())) | \
+        set(custom_black_list or ())
+    try:
+        yield
+    finally:
+        (_amp_state.enabled, _amp_state.dtype, _amp_state.level,
+         _amp_state.white, _amp_state.black) = old
+
+
+amp_guard = auto_cast
+
+
+def amp_cast_inputs(op_name: str, arrays):
+    """Called from dispatch.call_op: cast op inputs per the active policy."""
+    if not is_auto_cast_enabled():
+        return arrays
+    dtype = get_amp_dtype()
+    level = get_amp_level()
+    white = getattr(_amp_state, "white", white_list)
+    black = getattr(_amp_state, "black", black_list)
+    if op_name in black:
+        target = jnp.float32
+    elif op_name in white or level == "O2":
+        target = dtype
+    else:
+        return arrays
+    out = []
+    for a in arrays:
+        if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating) \
+                and a.dtype != target:
+            out.append(a.astype(target))
+        else:
+            out.append(a)
+    return out
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """O2 decoration: cast model parameters to the AMP dtype (reference
+    amp/auto_cast.py:decorate / fluid contrib decorator.py). With bf16 on
+    TPU, master weights stay fp32 inside optimizer slots."""
+    dt = "bfloat16" if dtype in ("bfloat16", "bf16") else "float16"
+    single = not isinstance(models, (list, tuple))
+    model_list = [models] if single else list(models)
+    if level == "O2":
+        for m in model_list:
+            m.to(dtype=dt)
+    if optimizers is None:
+        return models if single else model_list
+    return (models if single else model_list), optimizers
+
+
+class GradScaler:
+    """Dynamic loss scaling (reference amp/grad_scaler.py:26).
+
+    State machine: scale *= incr_ratio after incr_every_n_steps finite
+    steps; scale *= decr_ratio after decr_every_n_nan_or_inf non-finite
+    steps, which are skipped. For bf16 (enable=False or use_loss_scaling
+    False) this is a transparent pass-through — the TPU-native default.
+    """
+
+    def __init__(self, enable=True, init_loss_scaling=2. ** 15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=2, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling) if enable else 1.0
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._enable and self._dynamic
+
+    def get_loss_scaling(self):
+        return self._scale
+
+    def scale(self, loss):
+        if not self._enable:
+            return loss
+        from ..framework.dispatch import call_op
+        return call_op("scale", loss, scale=self._scale, bias=0.0)
+
+    def unscale_(self, optimizer):
+        """Unscale grads in-place and record found_inf (reference
+        grad_scaler.py:243 _unscale → check_finite_and_unscale op)."""
+        if not self._enable:
+            return
+        params = optimizer._parameter_list or []
+        inv = 1.0 / self._scale
+        found = False
+        for p in params:
+            if p.grad is None:
+                continue
+            g = p.grad._data * inv
+            p.grad._data = g
+            if not bool(jnp.all(jnp.isfinite(g))):
+                found = True
+        self._found_inf = found
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self.update()
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        self.step(optimizer)
+
+    def update(self):
+        if not (self._enable and self._dynamic):
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+        self._found_inf = False
+
+    def state_dict(self):
+        return {"scale": self._scale, "incr_ratio": self._incr_ratio,
+                "decr_ratio": self._decr_ratio,
+                "incr_count": self._good_steps,
+                "decr_count": self._bad_steps,
+                "use_dynamic_loss_scaling": self._dynamic}
+
+    def load_state_dict(self, state):
+        self._scale = state.get("scale", self._scale)
+        self._good_steps = state.get("incr_count", 0)
+        self._bad_steps = state.get("decr_count", 0)

@@ -1,0 +1,232 @@
+"""``paddle.autograd`` — backward, grad, PyLayer, functional jacobian/hessian.
+
+Analog of the reference's ``python/paddle/autograd/`` (backward_mode.py,
+py_layer.py, functional.py). The eager tape lives in framework/tensor.py;
+here are the user-facing entry points. The functional jacobian/hessian are
+direct jax transforms — the reference's 1.5k-LoC double-grad machinery
+collapses into ``jax.jacfwd/jacrev``.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.dispatch import call_op
+from ..framework.tensor import (
+    GradNode, Tensor, is_grad_enabled, no_grad_guard, run_backward,
+)
+
+__all__ = ["backward", "grad", "PyLayer", "PyLayerContext", "no_grad",
+           "jacobian", "hessian", "vjp", "jvp"]
+
+from ..framework.tensor import no_grad  # noqa: F401  (re-export)
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """``paddle.autograd.backward`` (reference backward_mode.py:backward)."""
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif isinstance(grad_tensors, Tensor):
+        grad_tensors = [grad_tensors]
+    for t, g in zip(tensors, grad_tensors):
+        run_backward(t, g, retain_graph=retain_graph)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None):
+    """``paddle.grad`` — grads of outputs w.r.t. inputs without touching
+    ``.grad`` (reference dygraph grad)."""
+    outputs = [outputs] if isinstance(outputs, Tensor) else list(outputs)
+    inputs = [inputs] if isinstance(inputs, Tensor) else list(inputs)
+    # preserve existing .grad, run backward with retain, then harvest
+    saved = [(t, t.grad) for t in inputs]
+    retain = True if retain_graph is None else retain_graph
+    for t in inputs:
+        t.grad = None
+        t._retain_grads = True
+    if grad_outputs is None:
+        grad_outputs = [None] * len(outputs)
+    for o, g in zip(outputs, grad_outputs):
+        run_backward(o, g, retain_graph=retain)
+    results = []
+    for t in inputs:
+        if t.grad is None:
+            if not allow_unused:
+                raise RuntimeError(
+                    f"input tensor {t.name} is unreachable from outputs; "
+                    "pass allow_unused=True to get None instead")
+            results.append(None)
+        else:
+            results.append(t.grad)
+    for t, old in saved:
+        t.grad = old
+        t._retain_grads = False
+    return results
+
+
+class PyLayerContext:
+    """Saved-tensor container handed to PyLayer.forward/backward
+    (reference autograd/py_layer.py PyLayerContext)."""
+
+    def __init__(self):
+        self._saved = ()
+        self._extra = {}
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    def saved_tensor(self):
+        return self._saved
+
+    def __setattr__(self, k, v):
+        object.__setattr__(self, k, v)
+
+
+class PyLayer:
+    """Custom autograd op: subclass with static ``forward(ctx, ...)`` and
+    ``backward(ctx, *grads)``.
+
+    TPU-native note: the backward runs the user's Python, so a PyLayer is an
+    eager-only construct (inside jitted train steps use ``jax.custom_vjp``
+    via ops.registry instead). This mirrors the reference where PyLayer
+    calls back into Python from C++ grad nodes.
+    """
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        with no_grad_guard():
+            outputs = cls.forward(ctx, *args, **kwargs)
+        single = not isinstance(outputs, (list, tuple))
+        out_list = [outputs] if single else list(outputs)
+
+        in_tensors = [a for a in args if isinstance(a, Tensor)]
+        needs_grad = is_grad_enabled() and any(
+            t._requires_grad() for t in in_tensors)
+        if needs_grad:
+            out_meta = [(tuple(o.shape), o.dtype) for o in out_list]
+
+            def vjp_fn(cotangents):
+                cts = [Tensor(c) for c in cotangents]
+                with no_grad_guard():
+                    gin = cls.backward(ctx, *cts)
+                gin = [gin] if isinstance(gin, Tensor) else list(gin or [])
+                flat = []
+                gi = iter(gin)
+                for a in args:
+                    if isinstance(a, Tensor):
+                        g = next(gi, None)
+                        flat.append(None if g is None else g._data)
+                return flat
+
+            node = GradNode(
+                op_name=f"py_layer_{cls.__name__}",
+                vjp_fn=lambda cot: vjp_fn(cot),
+                inputs=in_tensors,
+                n_outputs=len(out_list),
+                out_treedef=jax.tree_util.tree_structure(
+                    tuple(range(len(out_list)))),
+                out_meta=out_meta,
+            )
+            for i, o in enumerate(out_list):
+                o._node = node
+                o._out_idx = i
+                o.stop_gradient = False
+        return out_list[0] if single else tuple(out_list)
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *args):
+        raise NotImplementedError
+
+
+def _as_fn_over_arrays(func, example_inputs):
+    def fn(*arrays):
+        ins = [Tensor(a, stop_gradient=True) for a in arrays]
+        out = func(*ins)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        return tuple(o._data for o in outs)
+    return fn
+
+
+def _wrap_arrays(obj):
+    """Recursively wrap raw arrays in Tensors using plain python lists
+    (Tensor is itself a pytree node, so tree_map would immediately unwrap
+    what it wraps)."""
+    if isinstance(obj, (tuple, list)):
+        out = [_wrap_arrays(o) for o in obj]
+        return out[0] if len(out) == 1 else out
+    return Tensor(obj)
+
+
+def jacobian(func, xs, create_graph=False, allow_unused=False):
+    """Functional jacobian (reference autograd/functional.py:jacobian).
+    Returns a Tensor for single input/output, else nested lists
+    [output][input]."""
+    single = isinstance(xs, Tensor)
+    xs_list = [xs] if single else list(xs)
+    arrays = [x._data for x in xs_list]
+    fn = _as_fn_over_arrays(func, arrays)
+    jac = jax.jacrev(lambda *a: fn(*a), argnums=tuple(range(len(arrays))))(
+        *arrays)
+    return _wrap_arrays(jac)
+
+
+def hessian(func, xs, create_graph=False, allow_unused=False):
+    """Hessian of a scalar-valued func — jax.hessian under the hood,
+    replacing the reference's double-grad engine."""
+    single = isinstance(xs, Tensor)
+    xs_list = [xs] if single else list(xs)
+    arrays = [x._data for x in xs_list]
+
+    def scalar_fn(*a):
+        ins = [Tensor(x, stop_gradient=True) for x in a]
+        out = func(*ins)
+        return jnp.reshape(out._data, ())
+
+    h = jax.hessian(scalar_fn, argnums=tuple(range(len(arrays))))(*arrays)
+    return _wrap_arrays(h)
+
+
+def vjp(func, xs, v=None):
+    single = isinstance(xs, Tensor)
+    xs_list = [xs] if single else list(xs)
+    arrays = [x._data for x in xs_list]
+    fn = _as_fn_over_arrays(func, arrays)
+    out, vjp_fn = jax.vjp(fn, *arrays)
+    if v is None:
+        cot = tuple(jnp.ones_like(o) for o in out)
+    else:
+        v_list = [v] if isinstance(v, Tensor) else list(v)
+        cot = tuple(x._data for x in v_list)
+    grads = vjp_fn(cot)
+    outs = [Tensor(o) for o in out]
+    gs = [Tensor(g) for g in grads]
+    return (outs[0] if len(outs) == 1 else outs,
+            gs[0] if single else gs)
+
+
+def jvp(func, xs, v=None):
+    single = isinstance(xs, Tensor)
+    xs_list = [xs] if single else list(xs)
+    arrays = [x._data for x in xs_list]
+    fn = _as_fn_over_arrays(func, arrays)
+    if v is None:
+        tangents = tuple(jnp.ones_like(a) for a in arrays)
+    else:
+        v_list = [v] if isinstance(v, Tensor) else list(v)
+        tangents = tuple(x._data for x in v_list)
+    out, jout = jax.jvp(fn, tuple(arrays), tangents)
+    outs = [Tensor(o) for o in out]
+    js = [Tensor(j) for j in jout]
+    return (outs[0] if len(outs) == 1 else outs,
+            js[0] if len(js) == 1 else js)

@@ -140,11 +140,25 @@ def _attrs_key(attrs: dict):
     return tuple(items)
 
 
+_amp_mod = None
+
+
+def _amp():
+    global _amp_mod
+    if _amp_mod is None:
+        from .. import amp as _amp_mod_  # deferred: amp imports tensor
+        _amp_mod = _amp_mod_
+    return _amp_mod
+
+
 def call_op(name: str, *args, **attrs):
     """Execute a registered op eagerly on Tensors, recording the tape."""
     opdef = get_op(name)
     template, tensors = _unwrap_args(args)
     arrays = [t._data for t in tensors]
+    amp = _amp()
+    if amp.is_auto_cast_enabled():
+        arrays = amp.amp_cast_inputs(name, arrays)
     impl = opdef.select(args, attrs)
     fn = _get_callable(name, impl, template, _attrs_key(attrs), attrs,
                        jit_ok=opdef.jit)

@@ -1,0 +1,63 @@
+"""``paddle.save`` / ``paddle.load`` — pickled state persistence.
+
+Analog of the reference's ``python/paddle/framework/io.py`` (save:574,
+load:791): nested state dicts of Tensors pickled to disk. Arrays are
+converted to numpy on save (device → host once) and restored as Tensors on
+load. bfloat16 (no numpy dtype) round-trips via a tagged uint16 view.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import jax.numpy as jnp
+import numpy as np
+
+from .tensor import Parameter, Tensor
+
+__all__ = ["save", "load"]
+
+_BF16_TAG = "__bf16__"
+
+
+def _to_picklable(obj):
+    if isinstance(obj, Tensor):
+        arr = obj._data
+        if arr.dtype == jnp.bfloat16:
+            return {_BF16_TAG: True,
+                    "data": np.asarray(arr.view(jnp.uint16)),
+                    "name": obj.name}
+        return np.asarray(arr)
+    if isinstance(obj, jnp.ndarray):
+        return _to_picklable(Tensor(obj))
+    if isinstance(obj, dict):
+        return {k: _to_picklable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_picklable(v) for v in obj)
+    return obj
+
+
+def _from_picklable(obj):
+    if isinstance(obj, dict):
+        if obj.get(_BF16_TAG):
+            arr = jnp.asarray(obj["data"]).view(jnp.bfloat16)
+            return Tensor(arr, stop_gradient=True)
+        return {k: _from_picklable(v) for k, v in obj.items()}
+    if isinstance(obj, np.ndarray):
+        return Tensor(jnp.asarray(obj), stop_gradient=True)
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_from_picklable(v) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_picklable(obj), f, protocol=protocol)
+
+
+def load(path, **configs):
+    with open(path, "rb") as f:
+        return _from_picklable(pickle.load(f))

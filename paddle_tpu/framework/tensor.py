@@ -314,8 +314,12 @@ def run_backward(root: Tensor, grad_tensor=None, retain_graph=False):
             raise PreconditionNotMetError(
                 f"grad graph for op {node.op_name!r} was already freed; "
                 "pass retain_graph=True to backward() to reuse it")
+        # Cast each cotangent to the node's recorded output dtype: AMP
+        # boundaries (white-listed bf16 op feeding a black-listed f32 op)
+        # otherwise hand the pullback a cotangent of the wrong dtype.
         flat_cots = [
-            c if c is not None else jnp.zeros(shape, dtype)
+            (c.astype(dtype) if getattr(c, "dtype", dtype) != dtype else c)
+            if c is not None else jnp.zeros(shape, dtype)
             for c, (shape, dtype) in zip(pending, node.out_meta)
         ]
         out_cot = jax.tree_util.tree_unflatten(node.out_treedef, flat_cots)
@@ -339,6 +343,11 @@ def run_backward(root: Tensor, grad_tensor=None, retain_graph=False):
 
 
 def _accum_grad(t: Tensor, g):
+    # master-weight semantics: the leaf's grad carries the leaf's dtype even
+    # when the op ran in a lower AMP precision
+    if hasattr(g, "astype") and g.dtype != t._data.dtype and \
+            _is_float_dtype(t._data.dtype):
+        g = g.astype(t._data.dtype)
     if t.grad is None:
         t.grad = Tensor(g, stop_gradient=True, name=t.name + "@GRAD")
     else:

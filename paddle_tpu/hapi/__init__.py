@@ -1,0 +1,6 @@
+"""hapi — high-level training API (``paddle.Model``).
+
+Analog of the reference's ``python/paddle/hapi/``.
+"""
+from . import callbacks  # noqa: F401
+from .model import Model  # noqa: F401

@@ -1,0 +1,405 @@
+"""``paddle.Model`` — the Keras-like high-level trainer.
+
+Analog of the reference's ``python/paddle/hapi/model.py:915`` (prepare /
+fit:1574 / evaluate / predict, Dynamic+Static adapters at :704/:290).
+
+TPU-native design replaces both adapters with ONE path: the whole train step
+— forward, loss, backward, grad clip, optimizer update, buffer (BN stat)
+update — is a pure function over (params, opt_state, buffers, rng, lr,
+batch) compiled once by XLA. The stateful Layer API feeds it through the
+``functional_state`` bridge (nn/layer/layers.py). Dropout keys derive from a
+per-step folded PRNG key, so masks vary across steps while the trace stays
+static. Loss scaling (fp16) runs inside the step; with bf16 (TPU default)
+the scaler is inert.
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import random as _random
+from ..framework.io import load as _load, save as _save
+from ..framework.tensor import Tensor, no_grad_guard
+from ..io import DataLoader, Dataset
+from ..metric import Metric
+from ..nn.layer.layers import (
+    Layer, functional_state, get_buffers_tree, get_params_tree,
+)
+from .callbacks import config_callbacks
+
+__all__ = ["Model"]
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _as_arrays(batch):
+    if isinstance(batch, (list, tuple)):
+        return [np.asarray(b.numpy() if isinstance(b, Tensor) else b)
+                for b in batch]
+    return [np.asarray(batch)]
+
+
+class Model:
+    def __init__(self, network: Layer, inputs=None, labels=None):
+        self.network = network
+        self._inputs = _to_list(inputs)
+        self._labels = _to_list(labels)
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self._train_step_fn = None
+        self._eval_step_fn = None
+        self._predict_step_fn = None
+        self._params = None       # dict name -> jnp array (device state)
+        self._opt_state = None
+        self._buffers = None
+        self._step_counter = 0
+        self._amp_level = "O0"
+        self._amp_dtype = "bfloat16"
+        self.stop_training = False
+
+    # -- preparation --------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = _to_list(metrics)
+        for m in self._metrics:
+            if not isinstance(m, Metric):
+                raise TypeError(f"metrics must be paddle.metric.Metric, "
+                                f"got {type(m)}")
+        if amp_configs:
+            if isinstance(amp_configs, str):
+                self._amp_level = amp_configs
+            else:
+                self._amp_level = amp_configs.get("level", "O1")
+                self._amp_dtype = amp_configs.get("dtype", "bfloat16")
+            if self._amp_level == "O2":
+                from ..amp import decorate
+                decorate(self.network, level="O2", dtype=self._amp_dtype)
+        return self
+
+    def _sync_state_from_network(self):
+        self._params = get_params_tree(self.network)
+        self._buffers = get_buffers_tree(self.network)
+        if self._optimizer is not None and self._opt_state is None:
+            self._opt_state = self._optimizer.init_state(self._params)
+
+    def _sync_state_to_network(self):
+        if self._params is None:
+            return
+        for name, p in self.network.named_parameters():
+            p._data = self._params[name]
+        for name, b in self.network.named_buffers():
+            if name in self._buffers:
+                b._data = self._buffers[name]
+
+    def _loss_tensors(self, outputs, labels):
+        if self._loss is None:
+            raise RuntimeError(
+                "no loss configured: call model.prepare(optimizer, loss) "
+                "before fit/train_batch")
+        outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+        loss = self._loss(*outs, *labels)
+        return loss
+
+    def _maybe_amp(self):
+        from ..amp import auto_cast
+        import contextlib
+        if self._amp_level in ("O1", "O2"):
+            return auto_cast(level=self._amp_level, dtype=self._amp_dtype)
+        return contextlib.nullcontext()
+
+    def _build_train_step(self):
+        net, opt = self.network, self._optimizer
+        clip = getattr(opt, "_grad_clip", None)
+
+        def train_step(params, opt_state, buffers, key, lr, n_inputs,
+                       *arrays):
+            inputs = arrays[:n_inputs]
+            label_arrays = arrays[n_inputs:]
+
+            def loss_of(p):
+                with _random.rng_guard(key), self._maybe_amp():
+                    with functional_state(net, p, buffers) as st:
+                        with no_grad_guard():
+                            ins = [Tensor(a, stop_gradient=True)
+                                   for a in inputs]
+                            outputs = net(*ins)
+                            labels = [Tensor(a) for a in label_arrays]
+                            loss = self._loss_tensors(outputs, labels)
+                    new_buffers = st["updated_buffers"]
+                outs = outputs if isinstance(outputs, (list, tuple)) \
+                    else [outputs]
+                return loss._data.astype(jnp.float32), \
+                    ([o._data for o in outs], new_buffers)
+
+            (loss_val, (outs, new_buffers)), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(params)
+            if clip is not None:
+                pairs = clip([(params[k], g) for k, g in grads.items()])
+                grads = {k: g for (k, (_, g)) in zip(grads.keys(), pairs)}
+            new_params, new_opt_state = opt.apply_gradients(
+                params, grads, opt_state, lr)
+            return new_params, new_opt_state, new_buffers, loss_val, outs
+
+        self._train_step_fn = jax.jit(train_step,
+                                      static_argnames=("n_inputs",))
+
+    def _build_eval_step(self):
+        net = self.network
+
+        def eval_step(params, buffers, key, n_inputs, *arrays):
+            inputs = arrays[:n_inputs]
+            label_arrays = arrays[n_inputs:]
+            with _random.rng_guard(key), self._maybe_amp():
+                with functional_state(net, params, buffers):
+                    with no_grad_guard():
+                        ins = [Tensor(a, stop_gradient=True)
+                               for a in inputs]
+                        outputs = net(*ins)
+                        outs = outputs if isinstance(outputs, (list, tuple))\
+                            else [outputs]
+                        if self._loss is not None and label_arrays:
+                            labels = [Tensor(a) for a in label_arrays]
+                            loss = self._loss_tensors(outputs, labels)._data
+                        else:
+                            loss = jnp.zeros((), jnp.float32)
+            return loss, [o._data for o in outs]
+
+        self._eval_step_fn = jax.jit(eval_step,
+                                     static_argnames=("n_inputs",))
+
+    # -- single-batch APIs (reference train_batch/eval_batch/predict_batch) -
+    def train_batch(self, inputs, labels=None, update=True):
+        if self._train_step_fn is None:
+            self.network.train()
+            self._sync_state_from_network()
+            self._build_train_step()
+        ins = _as_arrays(inputs)
+        lbs = _as_arrays(labels) if labels is not None else []
+        self._step_counter += 1
+        key = jax.random.fold_in(jax.random.key(0), self._step_counter)
+        lr = jnp.asarray(self._optimizer.get_lr(), jnp.float32)
+        (self._params, self._opt_state, self._buffers, loss,
+         outs) = self._train_step_fn(
+            self._params, self._opt_state, self._buffers, key, lr,
+            len(ins), *ins, *lbs)
+        metrics = self._update_metrics(outs, lbs)
+        self._dirty = True
+        loss = float(loss)
+        return (loss, metrics) if metrics else loss
+
+    def eval_batch(self, inputs, labels=None):
+        if self._eval_step_fn is None:
+            self._build_eval_step()
+        if self._params is None:
+            self._sync_state_from_network()
+        ins = _as_arrays(inputs)
+        lbs = _as_arrays(labels) if labels is not None else []
+        key = jax.random.key(0)
+        loss, outs = self._eval_step_fn(
+            self._params, self._buffers, key, len(ins), *ins, *lbs)
+        metrics = self._update_metrics(outs, lbs)
+        loss = float(loss)
+        return (loss, metrics) if metrics else loss
+
+    def predict_batch(self, inputs):
+        if self._eval_step_fn is None:
+            self._build_eval_step()
+        if self._params is None:
+            self._sync_state_from_network()
+        ins = _as_arrays(inputs)
+        _, outs = self._eval_step_fn(
+            self._params, self._buffers, jax.random.key(0), len(ins), *ins)
+        return [np.asarray(o) for o in outs]
+
+    def _update_metrics(self, outs, labels):
+        results = []
+        for m in self._metrics:
+            correct = m.compute(*[Tensor(o) for o in outs],
+                                *[Tensor(np.asarray(l)) for l in labels])
+            r = m.update(*(correct if isinstance(correct, tuple)
+                           else (correct,)))
+            results.append(r)
+        return results
+
+    # -- fit/evaluate/predict ------------------------------------------------
+    def _as_loader(self, data, batch_size, shuffle, num_workers, drop_last):
+        if data is None or isinstance(data, DataLoader):
+            return data
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                          num_workers=num_workers, drop_last=drop_last)
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None):
+        loader = self._as_loader(train_data, batch_size, shuffle,
+                                 num_workers, drop_last)
+        eval_loader = self._as_loader(eval_data, batch_size, False,
+                                      num_workers, False)
+        try:
+            steps = len(loader)
+        except TypeError:
+            steps = None
+        cbks = config_callbacks(
+            callbacks, model=self, epochs=epochs, steps=steps,
+            log_freq=log_freq, verbose=verbose, save_freq=save_freq,
+            save_dir=save_dir, metrics=self._metric_names())
+        self.stop_training = False
+        self.network.train()
+        self._sync_state_from_network()
+        if self._train_step_fn is None:
+            self._build_train_step()
+        cbks.on_train_begin()
+        for epoch in range(epochs):
+            if self.stop_training:
+                break
+            cbks.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step, batch in enumerate(loader):
+                cbks.on_train_batch_begin(step)
+                inputs, labels = self._split_batch(batch)
+                result = self.train_batch(inputs, labels)
+                logs = self._pack_logs(result)
+                cbks.on_train_batch_end(step, logs)
+            cbks.on_epoch_end(epoch, logs)
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(eval_loader, batch_size=batch_size,
+                              verbose=verbose, callbacks=cbks,
+                              _inside_fit=True)
+        cbks.on_train_end()
+        self._sync_state_to_network()
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, _inside_fit=False):
+        loader = self._as_loader(eval_data, batch_size, False, num_workers,
+                                 False)
+        self.network.eval()
+        if self._params is None:
+            self._sync_state_from_network()
+        self._eval_step_fn = None  # re-trace in eval mode
+        for m in self._metrics:
+            m.reset()
+        cbks = callbacks if _inside_fit else config_callbacks(
+            callbacks, model=self, verbose=verbose,
+            metrics=self._metric_names())
+        cbks.on_eval_begin()
+        total_loss, n = 0.0, 0
+        for step, batch in enumerate(loader):
+            cbks.on_eval_batch_begin(step)
+            inputs, labels = self._split_batch(batch)
+            result = self.eval_batch(inputs, labels)
+            loss = result[0] if isinstance(result, tuple) else result
+            total_loss += loss
+            n += 1
+            cbks.on_eval_batch_end(step, self._pack_logs(result))
+        logs = {"loss": total_loss / max(1, n)}
+        for m in self._metrics:
+            names = m.name() if isinstance(m.name(), list) else [m.name()]
+            vals = m.accumulate()
+            vals = vals if isinstance(vals, list) else [vals]
+            logs.update(dict(zip(names, vals)))
+        cbks.on_eval_end(logs)
+        self.network.train()
+        self._eval_step_fn = None  # next eval retraces with train=False
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, callbacks=None, verbose=1):
+        loader = self._as_loader(test_data, batch_size, False, num_workers,
+                                 False)
+        self.network.eval()
+        if self._params is None:
+            self._sync_state_from_network()
+        self._eval_step_fn = None
+        outputs = []
+        for batch in loader:
+            inputs, _ = self._split_batch(batch, predict=True)
+            outs = self.predict_batch(inputs)
+            outputs.append(outs)
+        self.network.train()
+        self._eval_step_fn = None
+        if not outputs:
+            return []
+        n_out = len(outputs[0])
+        grouped = [[b[i] for b in outputs] for i in range(n_out)]
+        if stack_outputs:
+            grouped = [np.concatenate(g) for g in grouped]
+        return grouped
+
+    def _split_batch(self, batch, predict=False):
+        if not isinstance(batch, (list, tuple)):
+            return [batch], []
+        batch = list(batch)
+        if predict:
+            # without an explicit inputs spec, a (sample, label) dataset
+            # feeds only the sample (the reference relies on the spec too)
+            n_in = len(self._inputs) if self._inputs else \
+                (1 if len(batch) > 1 else len(batch))
+            return batch[:n_in], []
+        n_in = len(self._inputs) if self._inputs else len(batch) - 1
+        n_in = max(1, n_in)
+        return batch[:n_in], batch[n_in:]
+
+    def _pack_logs(self, result):
+        if isinstance(result, tuple):
+            loss, metrics = result
+        else:
+            loss, metrics = result, []
+        logs = {"loss": float(np.asarray(loss).ravel()[0])}
+        for m, r in zip(self._metrics, metrics):
+            names = m.name() if isinstance(m.name(), list) else [m.name()]
+            vals = r if isinstance(r, list) else [r]
+            logs.update({k: float(np.asarray(v).ravel()[0])
+                         for k, v in zip(names, vals)})
+        return logs
+
+    def _metric_names(self):
+        names = ["loss"]
+        for m in self._metrics:
+            n = m.name()
+            names.extend(n if isinstance(n, list) else [n])
+        return names
+
+    # -- persistence ---------------------------------------------------------
+    def save(self, path, training=True):
+        self._sync_state_to_network()
+        _save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            _save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        state = _load(path + ".pdparams")
+        self.network.set_state_dict(state)
+        self._params = None  # force re-sync
+        self._train_step_fn = None
+        opt_path = path + ".pdopt"
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(opt_path):
+            self._optimizer.set_state_dict(_load(opt_path))
+            self._opt_state = None
+
+    def parameters(self, *args, **kwargs):
+        self._sync_state_to_network()
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        n_params = sum(int(np.prod(p.shape))
+                       for p in self.network.parameters())
+        lines = [repr(self.network),
+                 f"Total params: {n_params:,}"]
+        s = "\n".join(lines)
+        print(s)
+        return {"total_params": n_params}

@@ -1,0 +1,8 @@
+"""Model zoo beyond vision: transformer language models.
+
+Analog of the reference's fleetx/examples GPT + the transformer building
+blocks in python/paddle/nn/layer/transformer.py and
+incubate/nn/layer/fused_transformer.py.
+"""
+from .gpt import GPTConfig, GPTModel, GPTForPretraining  # noqa: F401
+from .bert import BertConfig, BertModel, BertForQuestionAnswering  # noqa: F401

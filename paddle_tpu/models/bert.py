@@ -1,0 +1,137 @@
+"""BERT encoder model (north-star config 3: BERT-base SQuAD fine-tune).
+
+Built on nn.TransformerEncoder; attention flows through the shared
+``scaled_dot_product_attention`` op so the Pallas kernel accelerates it too.
+Reference parity: the reference's ERNIE/BERT stacks built on
+nn/layer/transformer.py.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from .. import nn
+from ..framework.dispatch import call_op
+from ..framework.tensor import Tensor
+from ..nn import functional as F
+
+__all__ = ["BertConfig", "BertModel", "BertForQuestionAnswering",
+           "BertForSequenceClassification"]
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    hidden_dropout_prob: float = 0.1
+    attention_dropout_prob: float = 0.1
+    initializer_range: float = 0.02
+
+    @classmethod
+    def bert_base(cls):
+        return cls()
+
+    @classmethod
+    def tiny(cls):
+        return cls(vocab_size=128, hidden_size=32, num_hidden_layers=2,
+                   num_attention_heads=2, intermediate_size=64,
+                   max_position_embeddings=32, hidden_dropout_prob=0.0,
+                   attention_dropout_prob=0.0)
+
+
+class BertEmbeddings(nn.Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        init = nn.initializer.Normal(0.0, cfg.initializer_range)
+        attr = nn.ParamAttr(initializer=init)
+        self.word_embeddings = nn.Embedding(cfg.vocab_size, cfg.hidden_size,
+                                            weight_attr=attr)
+        self.position_embeddings = nn.Embedding(
+            cfg.max_position_embeddings, cfg.hidden_size, weight_attr=attr)
+        self.token_type_embeddings = nn.Embedding(
+            cfg.type_vocab_size, cfg.hidden_size, weight_attr=attr)
+        self.layer_norm = nn.LayerNorm(cfg.hidden_size)
+        self.dropout = nn.Dropout(cfg.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        import jax.numpy as jnp
+        seq = input_ids.shape[1]
+        if position_ids is None:
+            position_ids = Tensor(jnp.arange(seq, dtype=jnp.int64)[None, :])
+        if token_type_ids is None:
+            token_type_ids = Tensor(
+                jnp.zeros((input_ids.shape[0], seq), jnp.int64))
+        x = self.word_embeddings(input_ids) + \
+            self.position_embeddings(position_ids) + \
+            self.token_type_embeddings(token_type_ids)
+        return self.dropout(self.layer_norm(x))
+
+
+class BertModel(nn.Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.embeddings = BertEmbeddings(cfg)
+        layer = nn.TransformerEncoderLayer(
+            cfg.hidden_size, cfg.num_attention_heads, cfg.intermediate_size,
+            dropout=cfg.hidden_dropout_prob, activation="gelu",
+            attn_dropout=cfg.attention_dropout_prob)
+        self.encoder = nn.TransformerEncoder(layer, cfg.num_hidden_layers)
+        self.pooler = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        import jax.numpy as jnp
+        if attention_mask is not None and attention_mask.ndim == 2:
+            # [B, L] 1/0 padding mask -> additive [B, 1, 1, L]
+            data = attention_mask._data if isinstance(
+                attention_mask, Tensor) else jnp.asarray(attention_mask)
+            attention_mask = Tensor(
+                ((1.0 - data.astype(jnp.float32)) *
+                 -1e9)[:, None, None, :])
+        x = self.embeddings(input_ids, token_type_ids, position_ids)
+        x = self.encoder(x, attention_mask)
+        pooled = call_op("tanh", self.pooler(x[:, 0]))
+        return x, pooled
+
+
+class BertForQuestionAnswering(nn.Layer):
+    """Span-prediction head (SQuAD): start/end logits."""
+
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.bert = BertModel(cfg)
+        self.classifier = nn.Linear(cfg.hidden_size, 2)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None, start_positions=None,
+                end_positions=None):
+        seq, _ = self.bert(input_ids, token_type_ids, position_ids,
+                           attention_mask)
+        logits = self.classifier(seq)  # [B, L, 2]
+        start_logits = logits[:, :, 0]
+        end_logits = logits[:, :, 1]
+        if start_positions is None:
+            return start_logits, end_logits
+        loss = (F.cross_entropy(start_logits, start_positions) +
+                F.cross_entropy(end_logits, end_positions)) / 2.0
+        return loss, start_logits, end_logits
+
+
+class BertForSequenceClassification(nn.Layer):
+    def __init__(self, cfg: BertConfig, num_classes=2):
+        super().__init__()
+        self.bert = BertModel(cfg)
+        self.dropout = nn.Dropout(cfg.hidden_dropout_prob)
+        self.classifier = nn.Linear(cfg.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, labels=None):
+        _, pooled = self.bert(input_ids, token_type_ids)
+        logits = self.classifier(self.dropout(pooled))
+        if labels is None:
+            return logits
+        return F.cross_entropy(logits, labels), logits

@@ -1,0 +1,135 @@
+"""GPT-2 decoder-only language model — the flagship model (north-star
+config 5: GPT-2 124M hybrid-parallel).
+
+Design notes (TPU-first):
+- pre-LN blocks, causal flash-friendly attention through the single
+  ``scaled_dot_product_attention`` op (is_causal=True → no mask tensor is
+  ever materialised; the Pallas override exploits this).
+- weights stay [in, out] for the MXU; LM head ties the embedding matrix.
+- no data-dependent python control flow: one forward is one XLA program.
+
+Reference parity target: the GPT examples built on the reference's
+MultiHeadAttention/TransformerDecoder (python/paddle/nn/layer/transformer.py)
+and fleet meta_parallel GPT models.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from .. import nn
+from ..framework.dispatch import call_op
+from ..framework.tensor import Tensor
+from ..nn import functional as F
+
+__all__ = ["GPTConfig", "GPTBlock", "GPTModel", "GPTForPretraining"]
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304          # 50257 padded to a multiple of 128
+    hidden_size: int = 768           # (MXU-friendly vocab tiling)
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 1024
+    hidden_dropout_prob: float = 0.1
+    attention_dropout_prob: float = 0.1
+    initializer_range: float = 0.02
+
+    @classmethod
+    def gpt2_small(cls):  # 124M
+        return cls()
+
+    @classmethod
+    def tiny(cls):  # for tests/dryrun
+        return cls(vocab_size=256, hidden_size=64, num_hidden_layers=2,
+                   num_attention_heads=4, intermediate_size=128,
+                   max_position_embeddings=64, hidden_dropout_prob=0.0,
+                   attention_dropout_prob=0.0)
+
+
+class GPTBlock(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        h = cfg.hidden_size
+        self.ln_1 = nn.LayerNorm(h)
+        self.attn = nn.MultiHeadAttention(
+            h, cfg.num_attention_heads, dropout=cfg.attention_dropout_prob)
+        self.ln_2 = nn.LayerNorm(h)
+        self.mlp_fc = nn.Linear(h, cfg.intermediate_size)
+        self.mlp_proj = nn.Linear(cfg.intermediate_size, h)
+        self.dropout = nn.Dropout(cfg.hidden_dropout_prob)
+
+    def forward(self, x, cache=None):
+        # attention with implicit causal masking
+        h = self.ln_1(x)
+        q = self.attn._split_heads(self.attn.q_proj(h))
+        if cache is not None:
+            k = self.attn._split_heads(self.attn.k_proj(h))
+            v = self.attn._split_heads(self.attn.v_proj(h))
+            k = call_op("concat", [cache.k, k], axis=1)
+            v = call_op("concat", [cache.v, v], axis=1)
+            cache = nn.MultiHeadAttention.Cache(k, v)
+        else:
+            k = self.attn._split_heads(self.attn.k_proj(h))
+            v = self.attn._split_heads(self.attn.v_proj(h))
+        a = F.scaled_dot_product_attention(
+            q, k, v, is_causal=True,
+            dropout_p=self.attn.dropout if self.training else 0.0,
+            training=self.training)
+        a = self.attn.out_proj(self.attn._merge_heads(a))
+        x = x + self.dropout(a)
+        m = self.mlp_proj(F.gelu(self.mlp_fc(self.ln_2(x)),
+                                 approximate=True))
+        x = x + self.dropout(m)
+        return x if cache is None else (x, cache)
+
+
+class GPTModel(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        init = nn.initializer.Normal(0.0, cfg.initializer_range)
+        self.wte = nn.Embedding(cfg.vocab_size, cfg.hidden_size,
+                                weight_attr=nn.ParamAttr(initializer=init))
+        self.wpe = nn.Embedding(cfg.max_position_embeddings,
+                                cfg.hidden_size,
+                                weight_attr=nn.ParamAttr(initializer=init))
+        self.drop = nn.Dropout(cfg.hidden_dropout_prob)
+        self.blocks = nn.LayerList(
+            [GPTBlock(cfg) for _ in range(cfg.num_hidden_layers)])
+        self.ln_f = nn.LayerNorm(cfg.hidden_size)
+
+    def forward(self, input_ids, position_ids=None):
+        if position_ids is None:
+            import jax.numpy as jnp
+            seq = input_ids.shape[1]
+            position_ids = Tensor(jnp.arange(seq, dtype=jnp.int64)[None, :])
+        x = self.wte(input_ids) + self.wpe(position_ids)
+        x = self.drop(x)
+        for block in self.blocks:
+            x = block(x)
+        return self.ln_f(x)
+
+    def logits(self, hidden):
+        """LM head tied to wte (matmul against the embedding table)."""
+        return call_op("matmul", hidden, self.wte.weight, transpose_y=True)
+
+
+class GPTForPretraining(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.gpt = GPTModel(cfg)
+
+    def forward(self, input_ids, labels=None, position_ids=None):
+        hidden = self.gpt(input_ids, position_ids)
+        logits = self.gpt.logits(hidden)
+        if labels is None:
+            return logits
+        loss = F.cross_entropy(
+            call_op("reshape", logits, shape=(-1, logits.shape[-1])),
+            call_op("reshape", labels, shape=(-1,)),
+            reduction="mean")
+        return loss, logits

@@ -272,17 +272,24 @@ def _convnd(x, w, bias, stride, padding, dilation, groups, data_format, nd):
     lhs_spec, rhs_spec, out_spec = _conv_dims(nd, data_format)
     dn = lax.conv_dimension_numbers(x.shape, w.shape,
                                     (lhs_spec, rhs_spec, out_spec))
-    acc = jnp.float32 if x.dtype in (jnp.bfloat16, jnp.float16) else None
+    # No preferred_element_type here: the MXU accumulates bf16 convs in f32
+    # regardless, and jax's conv transpose rule rejects the mixed-dtype grad
+    # conv that an f32-output/bf16-input conv produces. fp16 (narrow
+    # exponent, real overflow risk in the reduction) computes via f32
+    # casts instead — the cast primitives carry well-defined transposes.
+    fp16 = x.dtype == jnp.float16
+    if fp16:
+        x = x.astype(jnp.float32)
+        w = w.astype(jnp.float32)
     out = lax.conv_general_dilated(
         x, w,
         window_strides=_norm_tuple(stride, nd),
         padding=_conv_padding(padding, nd),
         rhs_dilation=_norm_tuple(dilation, nd),
         dimension_numbers=dn,
-        feature_group_count=int(groups),
-        preferred_element_type=acc)
-    if acc is not None:
-        out = out.astype(x.dtype)
+        feature_group_count=int(groups))
+    if fp16:
+        out = out.astype(jnp.float16)
     if bias is not None:
         if data_format.startswith("NC"):
             out = out + bias.reshape((1, -1) + (1,) * nd)

@@ -1,0 +1,9 @@
+"""``paddle.optimizer`` — optimizers and LR schedulers.
+
+Analog of the reference's ``python/paddle/optimizer/``.
+"""
+from . import lr  # noqa: F401
+from .optimizer import (  # noqa: F401
+    Adadelta, Adagrad, Adam, Adamax, AdamW, L1Decay, L2Decay, Lamb,
+    Momentum, Optimizer, RMSProp, SGD,
+)

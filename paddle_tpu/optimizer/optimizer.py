@@ -1,0 +1,465 @@
+"""Optimizer base and the built-in optimizers.
+
+Analog of the reference's ``python/paddle/optimizer/optimizer.py`` (state
+accumulators, ``_append_optimize_op``, grad-clip integration) and the
+per-optimizer device kernels (paddle/fluid/operators/optimizers/). TPU-native
+design: each optimizer's update rule is one pure function
+``_rule(param, grad, slots, lr) -> (new_param, new_slots)``; the eager
+``step()`` applies it per parameter, while ``apply_gradients`` runs the same
+rule inside a jitted train step where XLA fuses the whole parameter sweep
+(the role of the reference's multi-tensor ``merged_adam``).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import Parameter, Tensor, no_grad_guard
+from ..nn.clip import ClipGradBase
+from .lr import LRScheduler
+
+__all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adamax",
+           "Adagrad", "Adadelta", "RMSProp", "Lamb"]
+
+
+class L2Decay:
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+
+class L1Decay:
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+
+class Optimizer:
+    _slot_names: List[str] = []
+
+    def __init__(self, learning_rate=0.001, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None,
+                 multi_precision=False):
+        self._lr = learning_rate
+        if parameters is not None:
+            parameters = list(parameters)
+        self._parameter_list = parameters
+        if isinstance(weight_decay, float):
+            weight_decay = L2Decay(weight_decay)
+        self._weight_decay = weight_decay
+        self._grad_clip = grad_clip
+        self._multi_precision = multi_precision
+        # slots[param_name][slot_name] -> jnp array; counters separate
+        self._slots: Dict[str, Dict[str, jnp.ndarray]] = {}
+        self._step_count = 0
+
+    # -- lr -----------------------------------------------------------------
+    def get_lr(self) -> float:
+        if isinstance(self._lr, LRScheduler):
+            return float(self._lr())
+        return float(self._lr)
+
+    def set_lr(self, value):
+        if isinstance(self._lr, LRScheduler):
+            raise RuntimeError(
+                "set_lr is not allowed when the lr is an LRScheduler; call "
+                "scheduler.step() instead")
+        self._lr = float(value)
+
+    @property
+    def _learning_rate(self):
+        return self._lr
+
+    # -- state --------------------------------------------------------------
+    def _ensure_slots(self, name: str, param_value: jnp.ndarray):
+        if name not in self._slots:
+            self._slots[name] = {
+                s: jnp.zeros_like(param_value) for s in self._slot_names}
+        return self._slots[name]
+
+    def state_dict(self) -> dict:
+        out = {}
+        for pname, slots in self._slots.items():
+            for sname, arr in slots.items():
+                out[f"{pname}_{sname}"] = Tensor(arr)
+        out["@step"] = self._step_count
+        if isinstance(self._lr, LRScheduler):
+            out["LR_Scheduler"] = self._lr.state_dict()
+        return out
+
+    def set_state_dict(self, state: dict):
+        self._step_count = int(state.get("@step", 0))
+        if isinstance(self._lr, LRScheduler) and "LR_Scheduler" in state:
+            self._lr.set_state_dict(state["LR_Scheduler"])
+        # slots are restored lazily by name on first step; eager restore:
+        for key, value in state.items():
+            if key in ("@step", "LR_Scheduler"):
+                continue
+            for sname in self._slot_names:
+                suffix = "_" + sname
+                if key.endswith(suffix):
+                    pname = key[: -len(suffix)]
+                    arr = value._data if isinstance(value, Tensor) \
+                        else jnp.asarray(value)
+                    self._slots.setdefault(pname, {})[sname] = arr
+                    break
+
+    # -- update rule (pure; subclasses override) ----------------------------
+    def _rule(self, p, g, slots, lr, step):
+        raise NotImplementedError
+
+    def _decay_grad(self, p, g):
+        if isinstance(self._weight_decay, L2Decay) and \
+                self._weight_decay.coeff:
+            return g + self._weight_decay.coeff * p
+        if isinstance(self._weight_decay, L1Decay) and \
+                self._weight_decay.coeff:
+            return g + self._weight_decay.coeff * jnp.sign(p)
+        return g
+
+    # -- eager step ---------------------------------------------------------
+    def step(self):
+        if self._parameter_list is None:
+            raise ValueError(
+                "optimizer was created without a parameter list; pass "
+                "parameters=model.parameters()")
+        params_grads = [(p, p.grad._data) for p in self._parameter_list
+                        if p.grad is not None and not p.stop_gradient]
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        self._step_count += 1
+        with no_grad_guard():
+            for p, g in params_grads:
+                lr = self.get_lr() * getattr(
+                    p, "optimize_attr", {}).get("learning_rate", 1.0)
+                g = self._decay_grad(p._data, g.astype(p._data.dtype)
+                                     if hasattr(g, "astype") else g)
+                slots = self._ensure_slots(p.name, p._data)
+                new_p, new_slots = self._rule(p._data, g, slots, lr,
+                                              self._step_count)
+                p._data = new_p
+                self._slots[p.name] = new_slots
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+        return None, None
+
+    def clear_grad(self, set_to_zero=False):
+        if self._parameter_list is not None:
+            for p in self._parameter_list:
+                p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    # -- functional API for jitted train steps ------------------------------
+    def init_state(self, params: Dict[str, jnp.ndarray]):
+        """Pure optimizer state for `apply_gradients` (step=0)."""
+        return {
+            "step": jnp.zeros((), jnp.int32),
+            "slots": {name: {s: jnp.zeros_like(v)
+                             for s in self._slot_names}
+                      for name, v in params.items()},
+        }
+
+    def apply_gradients(self, params, grads, state, lr=None):
+        """Pure update: (params, grads, state) -> (new_params, new_state).
+
+        Runs under jit; `lr` arrives as a traced scalar so schedulers never
+        retrigger compilation.
+        """
+        lr = lr if lr is not None else self.get_lr()
+        step = state["step"] + 1
+        new_params, new_slots = {}, {}
+        for name, p in params.items():
+            g = grads[name]
+            if g is None:
+                new_params[name] = p
+                new_slots[name] = state["slots"][name]
+                continue
+            g = self._decay_grad(p, g.astype(p.dtype))
+            new_p, ns = self._rule(p, g, state["slots"][name], lr, step)
+            new_params[name] = new_p
+            new_slots[name] = ns
+        return new_params, {"step": step, "slots": new_slots}
+
+
+class SGD(Optimizer):
+    _slot_names: List[str] = []
+
+    def __init__(self, learning_rate=0.001, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None,
+                 multi_precision=False):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+
+    def _rule(self, p, g, slots, lr, step):
+        return (p - lr * g).astype(p.dtype), slots
+
+
+class Momentum(Optimizer):
+    _slot_names = ["velocity"]
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 name=None, multi_precision=False, rescale_grad=1.0):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _rule(self, p, g, slots, lr, step):
+        v = self._momentum * slots["velocity"] + g
+        if self._nesterov:
+            new_p = p - lr * (g + self._momentum * v)
+        else:
+            new_p = p - lr * v
+        return new_p.astype(p.dtype), {"velocity": v}
+
+
+class Adam(Optimizer):
+    _slot_names = ["moment1", "moment2"]
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def _rule(self, p, g, slots, lr, step):
+        gf = g.astype(jnp.float32)
+        m = self._beta1 * slots["moment1"] + (1 - self._beta1) * gf
+        v = self._beta2 * slots["moment2"] + (1 - self._beta2) * gf * gf
+        stepf = jnp.asarray(step, jnp.float32)
+        mhat = m / (1 - self._beta1 ** stepf)
+        vhat = v / (1 - self._beta2 ** stepf)
+        new_p = p.astype(jnp.float32) - lr * mhat / (
+            jnp.sqrt(vhat) + self._eps)
+        return new_p.astype(p.dtype), {"moment1": m, "moment2": v}
+
+    def _ensure_slots(self, name, value):
+        if name not in self._slots:
+            self._slots[name] = {
+                s: jnp.zeros(value.shape, jnp.float32)
+                for s in self._slot_names}
+        return self._slots[name]
+
+    def init_state(self, params):
+        return {
+            "step": jnp.zeros((), jnp.int32),
+            "slots": {name: {s: jnp.zeros(v.shape, jnp.float32)
+                             for s in self._slot_names}
+                      for name, v in params.items()},
+        }
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference optimizer/adamw.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip, lazy_mode, multi_precision, name)
+        self._wd_coeff = float(weight_decay) \
+            if not isinstance(weight_decay, (L2Decay, L1Decay)) \
+            else weight_decay.coeff
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._current_param_name = None
+
+    def _decay_grad(self, p, g):
+        return g  # decoupled — handled in _rule
+
+    def _wd_enabled(self, name):
+        return self._apply_decay_param_fun is None or \
+            self._apply_decay_param_fun(name)
+
+    def _rule(self, p, g, slots, lr, step):
+        gf = g.astype(jnp.float32)
+        m = self._beta1 * slots["moment1"] + (1 - self._beta1) * gf
+        v = self._beta2 * slots["moment2"] + (1 - self._beta2) * gf * gf
+        stepf = jnp.asarray(step, jnp.float32)
+        mhat = m / (1 - self._beta1 ** stepf)
+        vhat = v / (1 - self._beta2 ** stepf)
+        pf = p.astype(jnp.float32)
+        decay = self._wd_coeff if (
+            self._current_param_name is None or
+            self._wd_enabled(self._current_param_name)) else 0.0
+        new_p = pf - lr * (mhat / (jnp.sqrt(vhat) + self._eps) + decay * pf)
+        return new_p.astype(p.dtype), {"moment1": m, "moment2": v}
+
+    def step(self):
+        # track the param name so apply_decay_param_fun can exclude
+        # LayerNorm/bias params the way the reference does
+        if self._parameter_list is None:
+            raise ValueError("optimizer created without parameters")
+        params_grads = [(p, p.grad._data) for p in self._parameter_list
+                        if p.grad is not None and not p.stop_gradient]
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        self._step_count += 1
+        with no_grad_guard():
+            for p, g in params_grads:
+                self._current_param_name = p.name
+                lr = self.get_lr() * getattr(
+                    p, "optimize_attr", {}).get("learning_rate", 1.0)
+                slots = self._ensure_slots(p.name, p._data)
+                new_p, new_slots = self._rule(
+                    p._data, g.astype(p._data.dtype), slots, lr,
+                    self._step_count)
+                p._data = new_p
+                self._slots[p.name] = new_slots
+        self._current_param_name = None
+
+    def apply_gradients(self, params, grads, state, lr=None):
+        lr = lr if lr is not None else self.get_lr()
+        step = state["step"] + 1
+        new_params, new_slots = {}, {}
+        for name, p in params.items():
+            g = grads[name]
+            if g is None:
+                new_params[name] = p
+                new_slots[name] = state["slots"][name]
+                continue
+            self._current_param_name = name
+            new_p, ns = self._rule(p, g, state["slots"][name], lr, step)
+            new_params[name] = new_p
+            new_slots[name] = ns
+        self._current_param_name = None
+        return new_params, {"step": step, "slots": new_slots}
+
+
+class Adamax(Optimizer):
+    _slot_names = ["moment", "inf_norm"]
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def _rule(self, p, g, slots, lr, step):
+        gf = g.astype(jnp.float32)
+        m = self._beta1 * slots["moment"] + (1 - self._beta1) * gf
+        u = jnp.maximum(self._beta2 * slots["inf_norm"], jnp.abs(gf))
+        stepf = jnp.asarray(step, jnp.float32)
+        new_p = p.astype(jnp.float32) - \
+            (lr / (1 - self._beta1 ** stepf)) * m / (u + self._eps)
+        return new_p.astype(p.dtype), {"moment": m, "inf_norm": u}
+
+
+class Adagrad(Optimizer):
+    _slot_names = ["moment"]
+
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None,
+                 initial_accumulator_value=0.0):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._eps = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _ensure_slots(self, name, value):
+        if name not in self._slots:
+            self._slots[name] = {"moment": jnp.full(
+                value.shape, self._init_acc, jnp.float32)}
+        return self._slots[name]
+
+    def _rule(self, p, g, slots, lr, step):
+        gf = g.astype(jnp.float32)
+        acc = slots["moment"] + gf * gf
+        new_p = p.astype(jnp.float32) - lr * gf / (jnp.sqrt(acc) + self._eps)
+        return new_p.astype(p.dtype), {"moment": acc}
+
+
+class Adadelta(Optimizer):
+    _slot_names = ["avg_squared_grad", "avg_squared_update"]
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._eps, self._rho = epsilon, rho
+
+    def _rule(self, p, g, slots, lr, step):
+        gf = g.astype(jnp.float32)
+        eg = self._rho * slots["avg_squared_grad"] + (1 - self._rho) * gf * gf
+        update = -jnp.sqrt(
+            (slots["avg_squared_update"] + self._eps) /
+            (eg + self._eps)) * gf
+        eu = self._rho * slots["avg_squared_update"] + \
+            (1 - self._rho) * update * update
+        new_p = p.astype(jnp.float32) + lr * update
+        return new_p.astype(p.dtype), {"avg_squared_grad": eg,
+                                       "avg_squared_update": eu}
+
+
+class RMSProp(Optimizer):
+    _slot_names = ["mean_square", "mean_grad", "momentum"]
+
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._rho, self._eps = rho, epsilon
+        self._momentum = momentum
+        self._centered = centered
+
+    def _rule(self, p, g, slots, lr, step):
+        gf = g.astype(jnp.float32)
+        ms = self._rho * slots["mean_square"] + (1 - self._rho) * gf * gf
+        if self._centered:
+            mg = self._rho * slots["mean_grad"] + (1 - self._rho) * gf
+            denom = jnp.sqrt(ms - mg * mg + self._eps)
+        else:
+            mg = slots["mean_grad"]
+            denom = jnp.sqrt(ms + self._eps)
+        mom = self._momentum * slots["momentum"] + lr * gf / denom
+        new_p = p.astype(jnp.float32) - mom
+        return new_p.astype(p.dtype), {"mean_square": ms, "mean_grad": mg,
+                                       "momentum": mom}
+
+
+class Lamb(Optimizer):
+    """Layer-wise adaptive moments for large-batch training (reference
+    optimizer/lamb.py)."""
+
+    _slot_names = ["moment1", "moment2"]
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None,
+                 grad_clip=None, exclude_from_weight_decay_fn=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._wd = lamb_weight_decay
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+        self._current_param = None
+
+    def _rule(self, p, g, slots, lr, step):
+        gf = g.astype(jnp.float32)
+        pf = p.astype(jnp.float32)
+        m = self._beta1 * slots["moment1"] + (1 - self._beta1) * gf
+        v = self._beta2 * slots["moment2"] + (1 - self._beta2) * gf * gf
+        stepf = jnp.asarray(step, jnp.float32)
+        mhat = m / (1 - self._beta1 ** stepf)
+        vhat = v / (1 - self._beta2 ** stepf)
+        wd = self._wd
+        if self._exclude_fn is not None and self._current_param is not None \
+                and self._exclude_fn(self._current_param):
+            wd = 0.0
+        r = mhat / (jnp.sqrt(vhat) + self._eps) + wd * pf
+        w_norm = jnp.linalg.norm(pf)
+        r_norm = jnp.linalg.norm(r)
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        new_p = pf - lr * trust * r
+        return new_p.astype(p.dtype), {"moment1": m, "moment2": v}

@@ -1,0 +1,146 @@
+"""``paddle.static`` — static-graph user API facade.
+
+Analog of the reference's ``python/paddle/static/`` (Program, Executor,
+program_guard, append_backward over ProgramDesc). TPU-native stance
+(SURVEY.md §7): the "program" is a traced, jit-compiled function — XLA is
+the executor and the ProgramDesc/InterpreterCore layer disappears. This
+module keeps the *ergonomics*: ``enable_static`` flips a mode flag,
+``Program`` captures a python callable + example specs and compiles it
+lazily, ``Executor.run`` executes the compiled artifact. ``InputSpec`` is
+shared with ``paddle.jit``.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.dtypes import convert_dtype
+from ..framework.tensor import Tensor
+
+__all__ = ["enable_static", "disable_static", "in_dynamic_mode",
+           "InputSpec", "Program", "program_guard", "default_main_program",
+           "default_startup_program", "Executor", "data", "name_scope",
+           "cpu_places", "device_guard"]
+
+_mode = threading.local()
+
+
+def enable_static():
+    _mode.static = True
+
+
+def disable_static():
+    _mode.static = False
+
+
+def in_dynamic_mode() -> bool:
+    return not getattr(_mode, "static", False)
+
+
+class InputSpec:
+    """Shape/dtype declaration for compiled functions (reference
+    python/paddle/static/input.py)."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = tuple(shape)
+        self.dtype = convert_dtype(dtype)
+        self.name = name
+
+    @classmethod
+    def from_tensor(cls, t, name=None):
+        return cls(t.shape, str(t.dtype), name or t.name)
+
+    def to_aval(self, batch=1):
+        shape = tuple(batch if s in (-1, None) else s for s in self.shape)
+        return jax.ShapeDtypeStruct(shape, self.dtype)
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, " \
+               f"name={self.name})"
+
+
+class Program:
+    """A lazily-jitted callable — the jaxpr/StableHLO artifact replaces
+    ProgramDesc."""
+
+    def __init__(self, fn=None, input_specs=None):
+        self._fn = fn
+        self._input_specs = input_specs
+        self._compiled = None
+
+    def __call__(self, *args):
+        if self._fn is None:
+            raise RuntimeError("empty Program")
+        if self._compiled is None:
+            self._compiled = jax.jit(self._fn)
+        return self._compiled(*args)
+
+    def clone(self, for_test=False):
+        return Program(self._fn, self._input_specs)
+
+
+_default_main = Program()
+_default_startup = Program()
+
+
+def default_main_program():
+    return _default_main
+
+
+def default_startup_program():
+    return _default_startup
+
+
+class program_guard:
+    def __init__(self, main_program, startup_program=None):
+        self.main = main_program
+
+    def __enter__(self):
+        return self.main
+
+    def __exit__(self, *a):
+        return False
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    return InputSpec(shape, dtype, name)
+
+
+def name_scope(prefix=None):
+    import contextlib
+    return contextlib.nullcontext()
+
+
+def cpu_places(device_count=None):
+    from ..framework.place import CPUPlace
+    return [CPUPlace()]
+
+
+def device_guard(device=None):
+    import contextlib
+    return contextlib.nullcontext()
+
+
+class Executor:
+    """API-parity executor: runs jitted programs / callables (reference
+    Executor.run fluid/executor.py:1109 → here XLA executes)."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None):
+        if callable(program) and not isinstance(program, Program):
+            out = program(**(feed or {}))
+        elif isinstance(program, Program):
+            out = program(**(feed or {})) if feed else program()
+        else:
+            raise TypeError("Executor.run needs a Program or callable")
+        if fetch_list:
+            return [np.asarray(o._data if isinstance(o, Tensor) else o)
+                    for o in (out if isinstance(out, (list, tuple))
+                              else [out])]
+        return out

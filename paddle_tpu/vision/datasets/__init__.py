@@ -1,0 +1,155 @@
+"""Vision datasets (reference: python/paddle/vision/datasets/ — MNIST,
+FashionMNIST, Cifar10/100, Flowers).
+
+This environment has zero network egress, so constructors accept local
+files only (``download=True`` raises with instructions); ``FakeData``
+provides a deterministic synthetic stand-in with the same sample shapes for
+tests and benchmarks.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+
+from ...io import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "FakeData"]
+
+
+class FakeData(Dataset):
+    """Deterministic synthetic dataset: gaussian images + uniform labels."""
+
+    def __init__(self, size=1000, image_shape=(1, 28, 28), num_classes=10,
+                 transform=None, seed=0, dtype="float32"):
+        self.size = size
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+        self.dtype = dtype
+        self._seed = seed
+
+    def __getitem__(self, idx):
+        g = np.random.RandomState(self._seed + idx)
+        img = g.randn(*self.image_shape).astype(self.dtype)
+        label = np.array(g.randint(0, self.num_classes), np.int64)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+
+    def __len__(self):
+        return self.size
+
+
+def _no_download(name, url_hint):
+    raise RuntimeError(
+        f"{name}: automatic download is unavailable in this environment "
+        f"(no network egress). Place the original files locally and pass "
+        f"their path ({url_hint}), or use paddle.vision.datasets.FakeData "
+        f"for synthetic data.")
+
+
+class MNIST(Dataset):
+    """IDX-format MNIST reader (reference vision/datasets/mnist.py)."""
+
+    NAME = "mnist"
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=False, backend=None):
+        self.mode = mode
+        self.transform = transform
+        if image_path is None or label_path is None:
+            _no_download(type(self).__name__,
+                         "image_path=/path/train-images-idx3-ubyte.gz, "
+                         "label_path=/path/train-labels-idx1-ubyte.gz")
+        self.images = self._read_images(image_path)
+        self.labels = self._read_labels(label_path)
+
+    @staticmethod
+    def _open(path):
+        return gzip.open(path, "rb") if path.endswith(".gz") else \
+            open(path, "rb")
+
+    def _read_images(self, path):
+        with self._open(path) as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            data = np.frombuffer(f.read(), dtype=np.uint8)
+        return data.reshape(n, 1, rows, cols)
+
+    def _read_labels(self, path):
+        with self._open(path) as f:
+            magic, n = struct.unpack(">II", f.read(8))
+            data = np.frombuffer(f.read(), dtype=np.uint8)
+        return data.astype(np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.labels)
+
+
+class FashionMNIST(MNIST):
+    NAME = "fashion-mnist"
+
+
+class Cifar10(Dataset):
+    """CIFAR python-pickle reader (reference vision/datasets/cifar.py)."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        if data_file is None:
+            _no_download("Cifar10", "data_file=/path/cifar-10-python.tar.gz")
+        self.transform = transform
+        self.data = []
+        self.labels = []
+        names = [f"data_batch_{i}" for i in range(1, 6)] \
+            if mode == "train" else ["test_batch"]
+        with tarfile.open(data_file, "r:*") as tar:
+            for member in tar.getmembers():
+                base = os.path.basename(member.name)
+                if base in names:
+                    d = pickle.load(tar.extractfile(member),
+                                    encoding="bytes")
+                    self.data.append(d[b"data"])
+                    self.labels.extend(d.get(b"labels",
+                                             d.get(b"fine_labels")))
+        self.data = np.concatenate(self.data).reshape(-1, 3, 32, 32)
+        self.labels = np.asarray(self.labels, np.int64)
+
+    def __getitem__(self, idx):
+        img = self.data[idx].astype(np.float32)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.labels)
+
+
+class Cifar100(Cifar10):
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        if data_file is None:
+            _no_download("Cifar100",
+                         "data_file=/path/cifar-100-python.tar.gz")
+        self.transform = transform
+        names = ["train"] if mode == "train" else ["test"]
+        self.data, self.labels = [], []
+        with tarfile.open(data_file, "r:*") as tar:
+            for member in tar.getmembers():
+                if os.path.basename(member.name) in names:
+                    d = pickle.load(tar.extractfile(member),
+                                    encoding="bytes")
+                    self.data.append(d[b"data"])
+                    self.labels.extend(d[b"fine_labels"])
+        self.data = np.concatenate(self.data).reshape(-1, 3, 32, 32)
+        self.labels = np.asarray(self.labels, np.int64)

@@ -1,0 +1,154 @@
+"""Vision transforms (reference: python/paddle/vision/transforms/).
+
+Numpy-based host-side transforms in CHW float layout; heavy augmentation
+stays on host so the TPU step remains static-shaped.
+"""
+from __future__ import annotations
+
+import numbers
+
+import numpy as np
+
+__all__ = ["Compose", "ToTensor", "Normalize", "Resize", "CenterCrop",
+           "RandomCrop", "RandomHorizontalFlip", "RandomVerticalFlip",
+           "Transpose", "BrightnessTransform", "Pad"]
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def __call__(self, x):
+        for t in self.transforms:
+            x = t(x)
+        return x
+
+
+class ToTensor:
+    """HWC uint8 -> CHW float32 in [0,1]; CHW input passes through scaled."""
+
+    def __init__(self, data_format="CHW"):
+        self.data_format = data_format
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        if arr.ndim == 2:
+            arr = arr[None]
+        elif arr.ndim == 3 and arr.shape[-1] in (1, 3, 4) and \
+                arr.shape[0] not in (1, 3, 4):
+            arr = arr.transpose(2, 0, 1)
+        arr = arr.astype(np.float32)
+        if arr.max() > 1.5:
+            arr = arr / 255.0
+        return arr
+
+
+class Normalize:
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False):
+        self.mean = np.asarray(mean, np.float32).reshape(-1, 1, 1)
+        self.std = np.asarray(std, np.float32).reshape(-1, 1, 1)
+
+    def __call__(self, img):
+        return (np.asarray(img, np.float32) - self.mean) / self.std
+
+
+class Transpose:
+    def __init__(self, order=(2, 0, 1)):
+        self.order = order
+
+    def __call__(self, img):
+        return np.asarray(img).transpose(self.order)
+
+
+def _interp_resize(img_chw, size):
+    """Nearest-neighbor resize (no PIL dependency on the data path)."""
+    c, h, w = img_chw.shape
+    nh, nw = size
+    ri = (np.arange(nh) * h / nh).astype(np.int64)
+    ci = (np.arange(nw) * w / nw).astype(np.int64)
+    return img_chw[:, ri][:, :, ci]
+
+
+class Resize:
+    def __init__(self, size, interpolation="bilinear"):
+        if isinstance(size, numbers.Number):
+            size = (int(size), int(size))
+        self.size = size
+
+    def __call__(self, img):
+        return _interp_resize(np.asarray(img, np.float32), self.size)
+
+
+class CenterCrop:
+    def __init__(self, size):
+        if isinstance(size, numbers.Number):
+            size = (int(size), int(size))
+        self.size = size
+
+    def __call__(self, img):
+        c, h, w = img.shape
+        th, tw = self.size
+        i = max(0, (h - th) // 2)
+        j = max(0, (w - tw) // 2)
+        return img[:, i:i + th, j:j + tw]
+
+
+class RandomCrop:
+    def __init__(self, size, padding=None):
+        if isinstance(size, numbers.Number):
+            size = (int(size), int(size))
+        self.size = size
+        self.padding = padding
+
+    def __call__(self, img):
+        img = np.asarray(img)
+        if self.padding:
+            p = self.padding
+            img = np.pad(img, [(0, 0), (p, p), (p, p)])
+        c, h, w = img.shape
+        th, tw = self.size
+        i = np.random.randint(0, h - th + 1)
+        j = np.random.randint(0, w - tw + 1)
+        return img[:, i:i + th, j:j + tw]
+
+
+class RandomHorizontalFlip:
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, img):
+        if np.random.rand() < self.prob:
+            return np.asarray(img)[:, :, ::-1].copy()
+        return img
+
+
+class RandomVerticalFlip:
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, img):
+        if np.random.rand() < self.prob:
+            return np.asarray(img)[:, ::-1].copy()
+        return img
+
+
+class BrightnessTransform:
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, img):
+        alpha = 1 + np.random.uniform(-self.value, self.value)
+        return np.asarray(img, np.float32) * alpha
+
+
+class Pad:
+    def __init__(self, padding, fill=0, padding_mode="constant"):
+        self.padding = padding if not isinstance(padding, int) \
+            else (padding,) * 4
+        self.fill = fill
+
+    def __call__(self, img):
+        l, t, r, b = self.padding if len(self.padding) == 4 else \
+            (self.padding[0], self.padding[1]) * 2
+        return np.pad(np.asarray(img), [(0, 0), (t, b), (l, r)],
+                      constant_values=self.fill)

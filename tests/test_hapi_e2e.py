@@ -1,0 +1,175 @@
+"""End-to-end slice (north-star config 1 analog on CPU): LeNet + Model.fit.
+
+Reference analog: hapi tests (python/paddle/tests/test_model.py) and the
+book/recognize_digits integration tests.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.io import DataLoader, TensorDataset
+from paddle_tpu.metric import Accuracy
+from paddle_tpu.vision.datasets import FakeData
+from paddle_tpu.vision.models import LeNet
+
+rng = np.random.RandomState(0)
+
+
+def _digit_like_dataset(n=128):
+    """Linearly-separable synthetic 'digits': class k has mean pattern k."""
+    imgs, labels = [], []
+    patterns = rng.randn(10, 1, 28, 28).astype(np.float32)
+    for i in range(n):
+        k = i % 10
+        imgs.append(patterns[k] + 0.1 * rng.randn(1, 28, 28)
+                    .astype(np.float32))
+        labels.append(k)
+    return TensorDataset([np.stack(imgs),
+                          np.asarray(labels, np.int64).reshape(-1, 1)])
+
+
+class TestModelFit:
+    def test_fit_learns_and_evaluates(self, tmp_path):
+        ds = _digit_like_dataset(128)
+        model = paddle.Model(LeNet())
+        opt = paddle.optimizer.Adam(learning_rate=0.003,
+                                    parameters=model.network.parameters())
+        model.prepare(opt, nn.CrossEntropyLoss(), Accuracy())
+        model.fit(ds, epochs=4, batch_size=32, verbose=0, shuffle=True)
+        res = model.evaluate(ds, batch_size=32, verbose=0)
+        assert res["loss"] < 1.0
+        assert res["acc"] > 0.7
+
+    def test_predict_shapes(self):
+        ds = FakeData(size=8, image_shape=(1, 28, 28))
+        model = paddle.Model(LeNet())
+        model.prepare()
+        outs = model.predict(ds, batch_size=4, stack_outputs=True)
+        assert outs[0].shape == (8, 10)
+
+    def test_save_load_roundtrip(self, tmp_path):
+        ds = _digit_like_dataset(32)
+        model = paddle.Model(LeNet())
+        opt = paddle.optimizer.Adam(parameters=model.network.parameters())
+        model.prepare(opt, nn.CrossEntropyLoss())
+        model.fit(ds, epochs=1, batch_size=16, verbose=0)
+        path = str(tmp_path / "ckpt" / "model")
+        model.save(path)
+        assert os.path.exists(path + ".pdparams")
+        assert os.path.exists(path + ".pdopt")
+
+        model2 = paddle.Model(LeNet())
+        opt2 = paddle.optimizer.Adam(parameters=model2.network.parameters())
+        model2.prepare(opt2, nn.CrossEntropyLoss())
+        model2.load(path)
+        w1 = model.network.state_dict()
+        w2 = model2.network.state_dict()
+        for k in w1:
+            np.testing.assert_allclose(w1[k].numpy(), w2[k].numpy(),
+                                       err_msg=k)
+
+    def test_train_batch_api(self):
+        model = paddle.Model(LeNet())
+        opt = paddle.optimizer.SGD(learning_rate=0.01,
+                                   parameters=model.network.parameters())
+        model.prepare(opt, nn.CrossEntropyLoss())
+        x = rng.randn(8, 1, 28, 28).astype(np.float32)
+        y = rng.randint(0, 10, (8, 1)).astype(np.int64)
+        l1 = model.train_batch([x], [y])
+        l2 = model.train_batch([x], [y])
+        assert np.isfinite(l1) and np.isfinite(l2)
+        assert l2 < l1  # same batch twice: loss must drop
+
+    def test_early_stopping_callback(self):
+        from paddle_tpu.hapi.callbacks import EarlyStopping
+        ds = _digit_like_dataset(64)
+        model = paddle.Model(LeNet())
+        opt = paddle.optimizer.SGD(learning_rate=0.0,  # never improves
+                                   parameters=model.network.parameters())
+        model.prepare(opt, nn.CrossEntropyLoss())
+        es = EarlyStopping(monitor="loss", patience=1, verbose=0)
+        model.fit(ds, eval_data=ds, epochs=6, batch_size=32, verbose=0,
+                  callbacks=[es])
+        assert model.stop_training
+
+
+class TestDataLoader:
+    def test_batching_and_shapes(self):
+        ds = FakeData(size=10, image_shape=(1, 8, 8))
+        dl = DataLoader(ds, batch_size=4)
+        batches = list(dl)
+        assert len(batches) == 3
+        assert batches[0][0].shape == (4, 1, 8, 8)
+        assert batches[-1][0].shape == (2, 1, 8, 8)
+
+    def test_drop_last_and_shuffle_determinism(self):
+        ds = FakeData(size=10, image_shape=(1, 4, 4))
+        dl = DataLoader(ds, batch_size=4, drop_last=True)
+        assert len(list(dl)) == 2
+
+    def test_threaded_workers_match_serial(self):
+        ds = FakeData(size=20, image_shape=(1, 6, 6))
+        serial = [b[1] for b in DataLoader(ds, batch_size=5)]
+        threaded = [b[1] for b in DataLoader(ds, batch_size=5,
+                                             num_workers=3)]
+        for a, b in zip(serial, threaded):
+            np.testing.assert_array_equal(a, b)
+
+    def test_worker_error_propagates(self):
+        class Bad(FakeData):
+            def __getitem__(self, idx):
+                if idx == 7:
+                    raise ValueError("boom")
+                return super().__getitem__(idx)
+
+        dl = DataLoader(Bad(size=10), batch_size=2, num_workers=2)
+        with pytest.raises(ValueError, match="boom"):
+            list(dl)
+
+    def test_distributed_batch_sampler_partitions(self):
+        from paddle_tpu.io import DistributedBatchSampler
+        ds = FakeData(size=16, image_shape=(1, 2, 2))
+        seen = []
+        for r in range(2):
+            s = DistributedBatchSampler(ds, batch_size=4, num_replicas=2,
+                                        rank=r)
+            for batch in s:
+                seen.extend(batch)
+        assert sorted(seen) == list(range(16))
+
+
+class TestSaveLoad:
+    def test_bf16_roundtrip(self, tmp_path):
+        import jax.numpy as jnp
+        t = paddle.to_tensor(np.arange(4, dtype=np.float32),
+                             dtype="bfloat16")
+        p = str(tmp_path / "t.pd")
+        paddle.save({"x": t}, p)
+        back = paddle.load(p)["x"]
+        assert back.dtype == jnp.bfloat16
+        np.testing.assert_allclose(back.numpy().astype(np.float32),
+                                   [0, 1, 2, 3])
+
+
+class TestMetrics:
+    def test_accuracy_topk(self):
+        from paddle_tpu.metric import Accuracy
+        m = Accuracy(topk=(1, 2))
+        pred = paddle.to_tensor(np.array(
+            [[0.1, 0.7, 0.2], [0.05, 0.2, 0.75]], np.float32))
+        label = paddle.to_tensor(np.array([[1], [0]]), dtype="int64")
+        m.update(m.compute(pred, label))
+        top1, top2 = m.accumulate()
+        assert abs(top1 - 0.5) < 1e-6
+        assert abs(top2 - 0.5) < 1e-6
+
+    def test_auc_perfect_separation(self):
+        from paddle_tpu.metric import Auc
+        m = Auc()
+        preds = np.array([0.9, 0.8, 0.2, 0.1])
+        labels = np.array([1, 1, 0, 0])
+        m.update(preds, labels)
+        assert abs(m.accumulate() - 1.0) < 1e-6

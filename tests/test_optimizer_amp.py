@@ -1,0 +1,289 @@
+"""Optimizer / LR scheduler / AMP / autograd tests.
+
+Reference analogs: unittests/test_adam_op.py (numpy-parity update math),
+test_lr_scheduler.py, test_grad_scaler.py, test_imperative_auto_cast,
+test_custom_grad / PyLayer tests.
+"""
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.optimizer as opt
+
+rng = np.random.RandomState(3)
+
+
+def _make_problem():
+    model = nn.Linear(4, 1)
+    x = paddle.to_tensor(rng.randn(32, 4).astype(np.float32))
+    w_true = np.array([[1.0], [-2.0], [0.5], [3.0]], np.float32)
+    y = paddle.to_tensor(x.numpy() @ w_true)
+    return model, x, y
+
+
+def _train(model, x, y, optimizer, steps=30):
+    losses = []
+    for _ in range(steps):
+        loss = F.mse_loss(model(x), y)
+        loss.backward()
+        optimizer.step()
+        optimizer.clear_grad()
+        losses.append(float(loss))
+    return losses
+
+
+class TestOptimizers:
+    @pytest.mark.parametrize("cls,kw", [
+        (opt.SGD, dict(learning_rate=0.1)),
+        (opt.Momentum, dict(learning_rate=0.05, momentum=0.9)),
+        (opt.Adam, dict(learning_rate=0.1)),
+        (opt.AdamW, dict(learning_rate=0.1, weight_decay=0.01)),
+        (opt.RMSProp, dict(learning_rate=0.05)),
+        (opt.Adagrad, dict(learning_rate=0.3)),
+        (opt.Adamax, dict(learning_rate=0.1)),
+        (opt.Adadelta, dict(learning_rate=10.0)),
+        (opt.Lamb, dict(learning_rate=0.05)),
+    ])
+    def test_loss_decreases(self, cls, kw):
+        model, x, y = _make_problem()
+        o = cls(parameters=model.parameters(), **kw)
+        losses = _train(model, x, y, o)
+        # Adadelta's accumulator warm-up makes it intrinsically slow
+        factor = 0.9 if cls is opt.Adadelta else 0.7
+        assert losses[-1] < losses[0] * factor, (cls.__name__, losses[:3],
+                                                 losses[-3:])
+
+    def test_adam_matches_numpy_reference(self):
+        # one Adam step vs hand-rolled numpy (OpTest-style parity)
+        p0 = rng.randn(3).astype(np.float32)
+        g = rng.randn(3).astype(np.float32)
+        t = paddle.framework.tensor.Parameter(
+            paddle.to_tensor(p0)._data, name="p")
+        t.grad = paddle.to_tensor(g)
+        o = opt.Adam(learning_rate=0.01, parameters=[t])
+        o.step()
+        m = 0.1 * g
+        v = 0.001 * g * g
+        mhat = m / (1 - 0.9)
+        vhat = v / (1 - 0.999)
+        expect = p0 - 0.01 * mhat / (np.sqrt(vhat) + 1e-8)
+        np.testing.assert_allclose(np.asarray(t._data), expect, rtol=1e-5)
+
+    def test_adamw_decoupled_decay(self):
+        p0 = np.ones(3, np.float32)
+        t = paddle.framework.tensor.Parameter(
+            paddle.to_tensor(p0)._data, name="p")
+        t.grad = paddle.to_tensor(np.zeros(3, np.float32))
+        o = opt.AdamW(learning_rate=0.1, weight_decay=0.5, parameters=[t])
+        o.step()
+        # zero grad: update comes only from decay term lr*wd*p
+        np.testing.assert_allclose(np.asarray(t._data),
+                                   p0 - 0.1 * 0.5 * p0, rtol=1e-5)
+
+    def test_grad_clip_global_norm_in_step(self):
+        model, x, y = _make_problem()
+        o = opt.SGD(learning_rate=0.1, parameters=model.parameters(),
+                    grad_clip=nn.ClipGradByGlobalNorm(0.001))
+        w_before = model.weight.numpy().copy()
+        b_before = model.bias.numpy().copy()
+        loss = F.mse_loss(model(x), y)
+        loss.backward()
+        o.step()
+        # global L2 of the update == lr * clip_norm when clipping is active
+        delta = np.sqrt(
+            np.sum((model.weight.numpy() - w_before) ** 2) +
+            np.sum((model.bias.numpy() - b_before) ** 2))
+        assert delta <= 0.1 * 0.001 * 1.01
+
+    def test_state_dict_roundtrip(self):
+        model, x, y = _make_problem()
+        o = opt.Adam(learning_rate=0.1, parameters=model.parameters())
+        _train(model, x, y, o, steps=3)
+        sd = o.state_dict()
+        o2 = opt.Adam(learning_rate=0.1, parameters=model.parameters())
+        o2.set_state_dict(sd)
+        assert o2._step_count == o._step_count
+        for k in o._slots:
+            for s in o._slots[k]:
+                np.testing.assert_allclose(
+                    np.asarray(o._slots[k][s]),
+                    np.asarray(o2._slots[k][s]))
+
+    def test_functional_apply_gradients(self):
+        import jax.numpy as jnp
+        o = opt.Adam(learning_rate=0.1)
+        params = {"w": jnp.ones((2,))}
+        state = o.init_state(params)
+        grads = {"w": jnp.full((2,), 0.5)}
+        import jax
+        step = jax.jit(lambda p, g, s: o.apply_gradients(p, g, s, lr=0.1))
+        p1, s1 = step(params, grads, state)
+        assert float(s1["step"]) == 1
+        assert float(p1["w"][0]) < 1.0
+
+
+class TestLRSchedulers:
+    def test_step_decay(self):
+        s = opt.lr.StepDecay(0.1, step_size=2, gamma=0.5)
+        vals = []
+        for _ in range(5):
+            vals.append(s())
+            s.step()
+        np.testing.assert_allclose(vals, [0.1, 0.1, 0.05, 0.05, 0.025])
+
+    def test_cosine(self):
+        s = opt.lr.CosineAnnealingDecay(1.0, T_max=10)
+        assert abs(s() - 1.0) < 1e-9
+        s.step(10)
+        assert abs(s() - 0.0) < 1e-9
+
+    def test_linear_warmup_wraps_scheduler(self):
+        inner = opt.lr.StepDecay(0.1, step_size=100)
+        s = opt.lr.LinearWarmup(inner, warmup_steps=4, start_lr=0.0,
+                                end_lr=0.1)
+        v0 = s()
+        s.step(); s.step(); s.step(); s.step()
+        assert v0 == 0.0 and abs(s() - 0.1) < 1e-9
+
+    def test_reduce_on_plateau(self):
+        s = opt.lr.ReduceOnPlateau(0.1, patience=1, factor=0.5)
+        s.step(1.0)
+        s.step(1.0)
+        s.step(1.0)  # two bad epochs > patience -> halve
+        assert abs(s() - 0.05) < 1e-9
+
+    def test_optimizer_uses_scheduler(self):
+        model, x, y = _make_problem()
+        sched = opt.lr.StepDecay(0.1, step_size=1, gamma=0.1)
+        o = opt.SGD(learning_rate=sched, parameters=model.parameters())
+        assert o.get_lr() == 0.1
+        sched.step()
+        assert abs(o.get_lr() - 0.01) < 1e-12
+
+    def test_noam(self):
+        s = opt.lr.NoamDecay(d_model=512, warmup_steps=10, learning_rate=1.0)
+        s.step(5)
+        expect = (512 ** -0.5) * 5 * (10 ** -1.5)
+        np.testing.assert_allclose(s(), expect, rtol=1e-6)
+
+
+class TestAmp:
+    def test_auto_cast_matmul_bf16(self):
+        import jax.numpy as jnp
+        x = paddle.to_tensor(rng.randn(4, 4).astype(np.float32))
+        with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
+            y = paddle.matmul(x, x)
+        assert y.dtype == jnp.bfloat16
+        z = paddle.matmul(x, x)
+        assert z.dtype == jnp.float32
+
+    def test_black_list_stays_fp32(self):
+        import jax.numpy as jnp
+        x = paddle.to_tensor(rng.randn(4, 4).astype(np.float32),
+                             dtype="bfloat16")
+        with paddle.amp.auto_cast(level="O1"):
+            y = F.softmax(x)
+        assert y.dtype == jnp.float32
+
+    def test_backward_through_amp_boundary(self):
+        # white-listed bf16 op feeding black-listed f32 loss: eager tape
+        # must cast cotangents across the dtype boundary (review fix)
+        import jax.numpy as jnp
+        model = nn.Linear(4, 2)
+        x = paddle.to_tensor(rng.randn(8, 4).astype(np.float32))
+        y = paddle.to_tensor(rng.randn(8, 2).astype(np.float32))
+        with paddle.amp.auto_cast(level="O1"):
+            out = model(x)           # bf16
+            loss = F.mse_loss(out, y)  # black-listed -> f32
+        loss.backward()
+        assert model.weight.grad is not None
+        # master-weight semantics: f32 param gets f32 grad
+        assert model.weight.grad.dtype == jnp.float32
+
+    def test_fp16_conv_f32_accumulation(self):
+        import jax.numpy as jnp
+        # Cancelling weights: true sum is 0, but naive fp16 accumulation
+        # peaks at ~860k >> 65504 (fp16 max) mid-reduction. f32
+        # accumulation (review fix) returns exactly 0.
+        x = paddle.to_tensor(np.ones((1, 64, 4, 4), np.float32),
+                             dtype="float16")
+        w_np = np.zeros((2, 64, 3, 3), np.float32)
+        w_np[:, :32] = 3000.0
+        w_np[:, 32:] = -3000.0
+        w = paddle.to_tensor(w_np, dtype="float16")
+        out = F.conv2d(x, w, padding=1)
+        assert out.dtype == jnp.float16
+        assert np.isfinite(out.numpy().astype(np.float32)).all()
+        np.testing.assert_allclose(
+            out.numpy().astype(np.float32), 0.0, atol=1e-3)
+
+    def test_grad_scaler_passthrough_bf16(self):
+        model, x, y = _make_problem()
+        o = opt.SGD(learning_rate=0.1, parameters=model.parameters())
+        scaler = paddle.amp.GradScaler(enable=False)
+        loss = F.mse_loss(model(x), y)
+        scaled = scaler.scale(loss)
+        assert scaled is loss
+        scaled.backward()
+        scaler.step(o)
+
+    def test_grad_scaler_fp16_state_machine(self):
+        scaler = paddle.amp.GradScaler(
+            enable=True, init_loss_scaling=8.0, incr_every_n_steps=1,
+            decr_every_n_nan_or_inf=1)
+        model, x, y = _make_problem()
+        o = opt.SGD(learning_rate=0.01, parameters=model.parameters())
+        loss = F.mse_loss(model(x), y)
+        scaler.scale(loss).backward()
+        scaler.step(o)  # finite step -> scale doubles (incr_every=1)
+        assert scaler.get_loss_scaling() == 16.0
+        # poison a grad with inf -> skip + halve
+        loss = F.mse_loss(model(x), y)
+        scaler.scale(loss).backward()
+        model.weight.grad._data = model.weight.grad._data * np.inf
+        w_before = model.weight.numpy().copy()
+        scaler.step(o)
+        assert scaler.get_loss_scaling() == 8.0
+        np.testing.assert_allclose(model.weight.numpy(), w_before)
+
+
+class TestAutograd:
+    def test_paddle_grad(self):
+        x = paddle.to_tensor(np.array([2.0], np.float32),
+                             stop_gradient=False)
+        y = x * x * x
+        (gx,) = paddle.autograd.grad(y, x)
+        np.testing.assert_allclose(gx.numpy(), [12.0], rtol=1e-6)
+        assert x.grad is None  # grad() must not pollute .grad
+
+    def test_pylayer_custom_backward(self):
+        class Double(paddle.autograd.PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * 2.0
+
+            @staticmethod
+            def backward(ctx, gy):
+                return gy * 2.0
+
+        x = paddle.to_tensor(np.array([3.0], np.float32),
+                             stop_gradient=False)
+        y = Double.apply(x)
+        paddle.sum(y * y).backward()
+        # d/dx (2x)^2 = 8x = 24
+        np.testing.assert_allclose(x.grad.numpy(), [24.0], rtol=1e-6)
+
+    def test_jacobian_hessian(self):
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32),
+                             stop_gradient=False)
+        jac = paddle.autograd.jacobian(lambda t: t * t, x)
+        h = paddle.autograd.hessian(lambda t: paddle.sum(t * t * t), x)
+        np.testing.assert_allclose(jac.numpy(), np.diag([2.0, 4.0]),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(h.numpy(), np.diag([6.0, 12.0]),
+                                   rtol=1e-5)
