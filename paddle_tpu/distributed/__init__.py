@@ -1,0 +1,28 @@
+"""``paddle.distributed`` — distributed training API.
+
+Analog of the reference's ``python/paddle/distributed/``: collective ops,
+environment bootstrap, fleet facade, parallelized layers. See
+``SURVEY.md`` §2.4 for the strategy inventory this package re-implements
+TPU-first (XLA collectives over a hybrid Mesh instead of NCCL rings).
+"""
+from . import env  # noqa: F401
+from .collective import (  # noqa: F401
+    ReduceOp, all_gather, all_reduce, alltoall, barrier, broadcast,
+    get_group, new_group, recv, reduce, scatter, send,
+)
+from .env import (  # noqa: F401
+    get_rank, get_world_size, init_parallel_env, is_initialized,
+)
+from . import fleet  # noqa: F401
+from . import spmd  # noqa: F401
+from .fleet.meta_parallel.parallel_layers.mp_layers import (  # noqa: F401
+    ColumnParallelLinear, ParallelCrossEntropy, RowParallelLinear,
+    VocabParallelEmbedding,
+)
+
+__all__ = [
+    "ReduceOp", "all_gather", "all_reduce", "alltoall", "barrier",
+    "broadcast", "get_group", "new_group", "recv", "reduce", "scatter",
+    "send", "get_rank", "get_world_size", "init_parallel_env",
+    "is_initialized", "fleet", "spmd",
+]

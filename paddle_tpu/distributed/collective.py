@@ -1,0 +1,241 @@
+"""Collective communication API.
+
+Analog of the reference's ``python/paddle/distributed/collective.py``
+(broadcast/all_reduce/reduce/all_gather/scatter/alltoall/send/recv over
+ProcessGroupNCCL / c_* ops, :343-1040).
+
+TPU-native design: there are two call sites with different mechanics —
+
+* **Inside a sharded program** (shard_map over a mesh axis): collectives are
+  ``jax.lax`` ops (psum/all_gather/ppermute/all_to_all) — this module's
+  ``*_in_axis`` functions. XLA schedules them on ICI/DCN; there is no
+  process-group object because the mesh axis IS the group.
+* **Eager, process-level** (API parity with the reference): operates on a
+  Tensor replicated/sharded across the registered mesh. Single-process
+  single-device degenerates to identity, which keeps the reference's
+  1-GPU semantics.
+
+``new_group`` returns a lightweight Group naming a mesh axis, which the
+meta-parallel layers use to pick their PartitionSpec axis.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..framework.tensor import Tensor
+from . import env
+
+__all__ = ["ReduceOp", "Group", "new_group", "get_group", "all_reduce",
+           "all_gather", "broadcast", "reduce", "scatter", "alltoall",
+           "send", "recv", "barrier", "psum_in_axis", "all_gather_in_axis",
+           "ppermute_in_axis", "all_to_all_in_axis", "reduce_scatter_in_axis"]
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Group:
+    """A named communication group == a mesh axis (+ optional rank subset).
+
+    The reference's Group carries NCCL ring state; here it only names the
+    mesh axis collectives run over.
+    """
+
+    def __init__(self, gid: int, axis_name: Optional[str] = None,
+                 ranks: Optional[List[int]] = None):
+        self.id = gid
+        self.axis_name = axis_name
+        self.ranks = ranks or []
+        self.nranks = len(self.ranks) if self.ranks else \
+            (dict(zip(env.get_mesh().axis_names, env.get_mesh().devices.shape))
+             [axis_name] if (env.get_mesh() is not None and axis_name) else 1)
+
+    def __repr__(self):
+        return f"Group(id={self.id}, axis={self.axis_name}, " \
+               f"nranks={self.nranks})"
+
+
+_groups = {}
+_next_gid = [1]
+_default_group = Group(0, None, [])
+
+
+def new_group(ranks=None, backend=None, axis_name=None, timeout=None):
+    gid = _next_gid[0]
+    _next_gid[0] += 1
+    g = Group(gid, axis_name, list(ranks) if ranks else None)
+    _groups[gid] = g
+    return g
+
+
+def get_group(gid=0):
+    return _groups.get(gid, _default_group)
+
+
+# ---------------------------------------------------------------------------
+# in-axis collectives (for use inside shard_map'd code)
+# ---------------------------------------------------------------------------
+
+def psum_in_axis(x, axis_name: str):
+    import jax
+    return jax.lax.psum(x, axis_name)
+
+
+def all_gather_in_axis(x, axis_name: str, tiled=True, axis=0):
+    import jax
+    return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def ppermute_in_axis(x, axis_name: str, perm):
+    import jax
+    return jax.lax.ppermute(x, axis_name, perm)
+
+
+def all_to_all_in_axis(x, axis_name: str, split_axis=0, concat_axis=0):
+    import jax
+    return jax.lax.all_to_all(x, axis_name, split_axis=split_axis,
+                              concat_axis=concat_axis, tiled=True)
+
+
+def reduce_scatter_in_axis(x, axis_name: str, scatter_axis=0):
+    import jax
+    return jax.lax.psum_scatter(x, axis_name, scatter_dimension=scatter_axis,
+                                tiled=True)
+
+
+# ---------------------------------------------------------------------------
+# eager process-level API (reference parity)
+# ---------------------------------------------------------------------------
+
+def _degenerate() -> bool:
+    """True when there is no multi-device mesh to communicate over."""
+    mesh = env.get_mesh()
+    return mesh is None or int(np.prod(mesh.devices.shape)) <= 1
+
+
+def _axis_of(group) -> str:
+    mesh = env.get_mesh()
+    if group is not None and getattr(group, "axis_name", None):
+        return group.axis_name
+    # default: reduce over every mesh axis
+    return mesh.axis_names
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    """Eager all-reduce across the mesh (identity when single device).
+
+    Under SPMD the data-parallel grad sync happens inside the jitted step;
+    this eager entry point exists for reference API parity (e.g. manual
+    metric reduction)."""
+    if _degenerate():
+        return tensor
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    mesh = env.get_mesh()
+    axes = _axis_of(group)
+    axes = (axes,) if isinstance(axes, str) else tuple(axes)
+
+    def f(x):
+        red = {"sum": jax.lax.psum, "max": jax.lax.pmax,
+               "min": jax.lax.pmin}[op if op != ReduceOp.AVG else "sum"]
+        y = red(x, axes)
+        if op == ReduceOp.AVG:
+            y = y / np.prod([mesh.shape[a] for a in axes])
+        return y
+
+    spec = P(axes if len(axes) > 1 else axes[0])
+    from jax.experimental.shard_map import shard_map
+    out = jax.jit(shard_map(f, mesh=mesh, in_specs=spec, out_specs=spec))(
+        _sharded_like(tensor._data, mesh, spec))
+    tensor._data = out
+    return tensor
+
+
+def _sharded_like(arr, mesh, spec):
+    import jax
+    from jax.sharding import NamedSharding
+    return jax.device_put(arr, NamedSharding(mesh, spec))
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True):
+    if _degenerate():
+        tensor_list.append(Tensor(tensor._data))
+        return tensor_list
+    raise NotImplementedError(
+        "eager all_gather over a live mesh: express the gather inside the "
+        "jitted step (all_gather_in_axis) — eager loops over mesh shards "
+        "are not a TPU execution model")
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    if _degenerate():
+        return tensor
+    # replicated arrays are already consistent; broadcast is the act of
+    # resharding to full replication
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    tensor._data = jax.device_put(
+        tensor._data, NamedSharding(env.get_mesh(), P()))
+    return tensor
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    return all_reduce(tensor, op, group, sync_op)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    if _degenerate():
+        if tensor_list:
+            tensor._data = tensor_list[0]._data
+        return tensor
+    raise NotImplementedError(
+        "eager scatter over a live mesh: use sharding annotations "
+        "(device_put with a PartitionSpec) instead")
+
+
+def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
+    if _degenerate():
+        outs = [Tensor(t._data) for t in in_tensor_list]
+        if out_tensor_list is not None:
+            out_tensor_list.extend(outs)
+            return out_tensor_list
+        return outs
+    raise NotImplementedError(
+        "eager alltoall over a live mesh: use all_to_all_in_axis inside "
+        "the jitted step (see MoELayer)")
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    if _degenerate():
+        return tensor
+    raise NotImplementedError(
+        "point-to-point send is expressed as ppermute inside the pipeline "
+        "schedule on TPU (see PipelineLayer)")
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    if _degenerate():
+        return tensor
+    raise NotImplementedError(
+        "point-to-point recv is expressed as ppermute inside the pipeline "
+        "schedule on TPU (see PipelineLayer)")
+
+
+def barrier(group=None):
+    """Host-level barrier: forces completion of all outstanding work."""
+    import jax
+    arr = jax.numpy.zeros(())
+    jax.block_until_ready(arr)
+    if env.get_world_size() > 1:
+        # cross-host rendezvous via a tiny global psum
+        from jax.sharding import PartitionSpec as P
+        mesh = env.get_mesh()
+        if mesh is not None:
+            all_reduce(Tensor(arr))

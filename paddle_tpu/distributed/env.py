@@ -1,0 +1,121 @@
+"""Distributed environment: process/mesh state.
+
+TPU-native replacement for the reference's bootstrap machinery —
+TCPStore rendezvous (paddle/fluid/distributed/store/tcp_store.cc),
+``init_parallel_env`` (python/paddle/distributed/parallel.py:93), NCCL comm
+bootstrap (platform/gen_comm_id_helper.cc): multi-host jax initialises
+through the PjRt coordination service (``jax.distributed.initialize``), and
+every "comm group" is an axis of one global device Mesh. Collectives are
+then XLA ops over ICI/DCN — rings, ids and stores disappear.
+
+Env vars honored (reference launcher parity): PADDLE_TRAINER_ID /
+PADDLE_TRAINERS_NUM map onto process index/count;
+PADDLE_MASTER / PADDLE_TRAINER_ENDPOINTS give the coordinator address.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_state = threading.local()
+_global = {"initialized": False, "mesh": None, "topology": None}
+
+
+def _jax():
+    import jax
+    return jax
+
+
+def init_parallel_env(coordinator_address: Optional[str] = None,
+                      num_processes: Optional[int] = None,
+                      process_id: Optional[int] = None):
+    """Bring up multi-host jax if env asks for it; no-op single-host.
+
+    Reference analog: distributed/parallel.py:93 init_parallel_env.
+    """
+    if _global["initialized"]:
+        return
+    coordinator = coordinator_address or os.environ.get("PADDLE_MASTER") \
+        or os.environ.get("MASTER_ADDR")
+    nproc = num_processes or int(
+        os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    pid = process_id if process_id is not None else int(
+        os.environ.get("PADDLE_TRAINER_ID", "0"))
+    if coordinator and nproc > 1:
+        _jax().distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=nproc,
+            process_id=pid)
+    _global["initialized"] = True
+
+
+def get_rank() -> int:
+    """Global process index (reference: paddle.distributed.get_rank)."""
+    try:
+        return _jax().process_index()
+    except Exception:
+        return 0
+
+
+def get_world_size() -> int:
+    """Number of processes (NOT devices) — matches reference semantics
+    where one trainer process drives one accelerator; under jax one
+    process drives all local devices, so device-level parallel degree is
+    ``device_count()``."""
+    try:
+        return _jax().process_count()
+    except Exception:
+        return 1
+
+
+def device_count() -> int:
+    return len(_jax().devices())
+
+
+def is_initialized() -> bool:
+    return _global["initialized"]
+
+
+# ---------------------------------------------------------------------------
+# the global hybrid mesh
+# ---------------------------------------------------------------------------
+
+def build_mesh(axes: Dict[str, int], devices=None):
+    """Create (and register globally) a Mesh from axis-name -> degree.
+
+    Axis order follows the reference's HybridCommunicateGroup layout
+    ["data","pipe","sharding","model"] extended with "sep"/"expert"
+    (fleet/base/topology.py:55) — outer axes ride DCN, inner axes ICI.
+    """
+    from jax.sharding import Mesh
+    jax = _jax()
+    devices = list(devices if devices is not None else jax.devices())
+    degrees = [max(1, int(d)) for d in axes.values()]
+    total = int(np.prod(degrees))
+    if total != len(devices):
+        raise ValueError(
+            f"mesh axes {axes} need {total} devices but "
+            f"{len(devices)} are visible")
+    arr = np.array(devices).reshape(degrees)
+    mesh = Mesh(arr, tuple(axes.keys()))
+    _global["mesh"] = mesh
+    return mesh
+
+
+def get_mesh():
+    return _global["mesh"]
+
+
+def set_mesh(mesh):
+    _global["mesh"] = mesh
+
+
+def set_topology(topo):
+    _global["topology"] = topo
+
+
+def get_topology():
+    return _global["topology"]

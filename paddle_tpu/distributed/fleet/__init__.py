@@ -1,0 +1,140 @@
+"""``paddle.distributed.fleet`` — the distributed training facade.
+
+Analog of the reference's ``fleet`` API
+(python/paddle/distributed/fleet/base/fleet_base.py:144): ``init`` builds
+the hybrid topology, ``distributed_model`` / ``distributed_optimizer`` wrap
+user objects per the strategy.
+
+TPU-native: init constructs the global Mesh (HybridCommunicateGroup);
+distributed_model returns the model unchanged-but-annotated (parallelism is
+sharding metadata, not wrapper layers issuing collectives);
+distributed_optimizer returns a HybridParallelOptimizer whose ``step``
+drives the ParallelEngine's single compiled SPMD step.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from .. import env as _env
+from ..spmd import ParallelEngine
+from .base.strategy import DistributedStrategy
+from .base.topology import HybridCommunicateGroup
+from . import meta_parallel  # noqa: F401
+from .utils import recompute as _recompute_mod  # noqa: F401
+from .utils.recompute import recompute  # noqa: F401
+
+__all__ = ["init", "DistributedStrategy", "HybridCommunicateGroup",
+           "distributed_model", "distributed_optimizer", "get_hybrid_communicate_group",
+           "worker_index", "worker_num", "is_first_worker", "barrier_worker",
+           "meta_parallel", "recompute"]
+
+_fleet_state = {"strategy": None, "hcg": None, "engine": None}
+
+
+def init(role_maker=None, is_collective=False, strategy=None, log_level=20):
+    """Reference: fleet_base.py:211. Builds the mesh from
+    strategy.hybrid_configs (degrees of 1 collapse axes)."""
+    strategy = strategy or DistributedStrategy()
+    _env.init_parallel_env()
+    h = strategy.hybrid_configs
+    n_dev = _env.device_count()
+    degrees = (h.dp_degree * h.pp_degree * h.sharding_degree *
+               h.sep_degree * h.ep_degree * h.mp_degree)
+    if degrees == 1 and n_dev > 1:
+        h.dp_degree = n_dev  # pure data parallel default, reference-like
+    hcg = HybridCommunicateGroup(
+        dp_degree=h.dp_degree, pp_degree=h.pp_degree,
+        sharding_degree=h.sharding_degree, sep_degree=h.sep_degree,
+        ep_degree=h.ep_degree, mp_degree=h.mp_degree)
+    _fleet_state["strategy"] = strategy
+    _fleet_state["hcg"] = hcg
+    return None
+
+
+def get_hybrid_communicate_group() -> Optional[HybridCommunicateGroup]:
+    return _fleet_state["hcg"]
+
+
+def _strategy() -> DistributedStrategy:
+    if _fleet_state["strategy"] is None:
+        init()
+    return _fleet_state["strategy"]
+
+
+def distributed_model(model):
+    """Reference: fleet_base.py:947 wraps per topology (TensorParallel /
+    PipelineParallel / ShardingParallel / DataParallel). Here the model's
+    sharding metadata (mesh_axes set by meta_parallel layers; batch axis
+    from the mesh) already encodes the strategy — we record the model for
+    the engine and return it."""
+    _fleet_state["model"] = model
+    return model
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    if strategy is not None:
+        _fleet_state["strategy"] = strategy
+    return HybridParallelOptimizer(optimizer)
+
+
+class HybridParallelOptimizer:
+    """Reference: hybrid_parallel_optimizer.py:172 (TP-aware global-norm
+    clip + sharding-aware step). The engine's compiled step performs the
+    clip inside the program; global norms across model/pipe shards are
+    correct because the grads live on the mesh."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self._engine: Optional[ParallelEngine] = None
+
+    def __getattr__(self, item):
+        return getattr(self._inner, item)
+
+    def _ensure_engine(self, loss_fn=None):
+        if self._engine is None:
+            strat = _strategy()
+            model = _fleet_state.get("model")
+            if model is None:
+                raise RuntimeError(
+                    "call fleet.distributed_model(model) before stepping "
+                    "the distributed optimizer")
+            zero = strat.sharding_configs.stage if strat.sharding else 0
+            self._engine = ParallelEngine(
+                model, self._inner, loss_fn,
+                mesh=_fleet_state["hcg"].mesh, zero_stage=zero,
+                recompute=strat.recompute)
+            _fleet_state["engine"] = self._engine
+        return self._engine
+
+    def train_step(self, inputs, labels=(), loss_fn=None):
+        """One hybrid-parallel step (the reference's model.train_batch)."""
+        eng = self._ensure_engine(loss_fn)
+        return eng.train_step(inputs, labels)
+
+    def step(self):
+        raise RuntimeError(
+            "HybridParallelOptimizer runs whole steps: use "
+            "train_step(inputs, labels) — forward/backward/update compile "
+            "into one XLA program on TPU")
+
+    def clear_grad(self):
+        pass
+
+
+def worker_index():
+    return _env.get_rank()
+
+
+def worker_num():
+    return _env.get_world_size()
+
+
+def is_first_worker():
+    return _env.get_rank() == 0
+
+
+def barrier_worker():
+    from ..collective import barrier
+    barrier()

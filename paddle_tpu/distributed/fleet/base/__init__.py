@@ -1,0 +1,2 @@
+from .strategy import DistributedStrategy  # noqa: F401
+from .topology import CommunicateTopology, HybridCommunicateGroup  # noqa: F401

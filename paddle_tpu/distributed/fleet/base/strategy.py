@@ -1,0 +1,112 @@
+"""DistributedStrategy.
+
+Analog of the reference's protobuf-backed ``DistributedStrategy``
+(framework/distributed_strategy.proto:278, python wrapper
+fleet/base/distributed_strategy.py:110 — ~40 toggle+config pairs). The
+protobuf indirection collapses into a plain dataclass; the toggles that
+exist only to drive CUDA-era executor rewrites (fuse_allreduce, DGC,
+localsgd…) are accepted for compatibility and recorded, but XLA makes the
+corresponding decisions during compilation.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+__all__ = ["DistributedStrategy"]
+
+
+@dataclass
+class HybridConfig:
+    dp_degree: int = 1
+    mp_degree: int = 1
+    pp_degree: int = 1
+    sharding_degree: int = 1
+    sep_degree: int = 1       # sequence/context parallel (NEW vs reference)
+    ep_degree: int = 1        # expert parallel
+
+
+@dataclass
+class RecomputeConfig:
+    checkpoints: list = field(default_factory=list)
+
+
+@dataclass
+class AmpConfig:
+    init_loss_scaling: float = 2.0 ** 15
+    use_dynamic_loss_scaling: bool = True
+    custom_white_list: list = field(default_factory=list)
+    custom_black_list: list = field(default_factory=list)
+    use_pure_fp16: bool = False
+    dtype: str = "bfloat16"
+    level: str = "O1"
+
+
+@dataclass
+class PipelineConfig:
+    accumulate_steps: int = 1
+    micro_batch_size: int = 1
+    schedule_mode: str = "1F1B"
+
+
+@dataclass
+class ShardingConfig:
+    stage: int = 1
+    degree: int = 1
+    offload: bool = False
+
+
+class DistributedStrategy:
+    def __init__(self):
+        self.amp = False
+        self.amp_configs = AmpConfig()
+        self.recompute = False
+        self.recompute_configs = RecomputeConfig()
+        self.pipeline = False
+        self.pipeline_configs = PipelineConfig()
+        self.sharding = False
+        self.sharding_configs = ShardingConfig()
+        self.tensor_parallel = False
+        self.hybrid_configs = HybridConfig()
+        self.gradient_merge = False
+        self.gradient_merge_configs: Dict[str, Any] = {"k_steps": 1}
+        self.lamb = False
+        self.dgc = False                 # accepted; no-op under XLA
+        self.localsgd = False            # accepted; no-op under XLA
+        self.fuse_all_reduce_ops = True  # XLA fuses collectives itself
+        self.find_unused_parameters = False
+        self.heter_ccl_mode = False
+
+    def __setattr__(self, key, value):
+        if key == "hybrid_configs" and isinstance(value, dict):
+            cfg = self.__dict__.get("hybrid_configs") or HybridConfig()
+            for k, v in value.items():
+                setattr(cfg, k, v)
+            object.__setattr__(self, key, cfg)
+            return
+        if key == "pipeline_configs" and isinstance(value, dict):
+            cfg = self.__dict__.get("pipeline_configs") or PipelineConfig()
+            for k, v in value.items():
+                setattr(cfg, k, v)
+            object.__setattr__(self, key, cfg)
+            return
+        if key == "sharding_configs" and isinstance(value, dict):
+            cfg = self.__dict__.get("sharding_configs") or ShardingConfig()
+            for k, v in value.items():
+                setattr(cfg, k, v)
+            object.__setattr__(self, key, cfg)
+            return
+        if key == "amp_configs" and isinstance(value, dict):
+            cfg = self.__dict__.get("amp_configs") or AmpConfig()
+            for k, v in value.items():
+                setattr(cfg, k, v)
+            object.__setattr__(self, key, cfg)
+            return
+        object.__setattr__(self, key, value)
+
+    def __repr__(self):
+        h = self.hybrid_configs
+        return (f"DistributedStrategy(dp={h.dp_degree}, mp={h.mp_degree}, "
+                f"pp={h.pp_degree}, sharding={h.sharding_degree}, "
+                f"sep={h.sep_degree}, ep={h.ep_degree}, amp={self.amp}, "
+                f"recompute={self.recompute})")

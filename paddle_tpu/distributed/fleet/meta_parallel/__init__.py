@@ -1,0 +1,14 @@
+"""meta_parallel — hybrid-parallel building blocks.
+
+Analog of the reference's ``fleet/meta_parallel/``.
+"""
+from .parallel_layers.mp_layers import (  # noqa: F401
+    ColumnParallelLinear, ParallelCrossEntropy, RowParallelLinear,
+    VocabParallelEmbedding, constrain, mark_sharding,
+)
+from .parallel_layers.pp_layers import (  # noqa: F401
+    LayerDesc, PipelineLayer, SharedLayerDesc,
+)
+from .parallel_layers.random import (  # noqa: F401
+    RNGStatesTracker, get_rng_state_tracker, model_parallel_random_seed,
+)

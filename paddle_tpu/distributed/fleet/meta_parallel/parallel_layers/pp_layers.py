@@ -1,0 +1,130 @@
+"""Declarative pipeline stage partitioning.
+
+Analog of the reference's ``PipelineLayer`` / ``LayerDesc`` /
+``SharedLayerDesc`` (fleet/meta_parallel/parallel_layers/pp_layers.py:58-233)
+— declare the model as an ordered layer list, segment it into stages.
+
+TPU-native: a PipelineLayer still runs as ONE sequential program on a
+single device (debug/parity path). Sharded pipeline execution stacks the
+uniform trunk's per-stage parameters along a leading "pipe"-sharded axis
+and runs the collective-permute schedule in
+``meta_parallel/pipeline_parallel.py``.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from ..... import nn
+from .....framework.tensor import Tensor
+
+__all__ = ["LayerDesc", "SharedLayerDesc", "PipelineLayer"]
+
+
+class LayerDesc:
+    """Lazy layer constructor (reference pp_layers.py:LayerDesc)."""
+
+    def __init__(self, layer_cls, *args, **kwargs):
+        self.layer_cls = layer_cls
+        self.args = args
+        self.kwargs = kwargs
+        if not issubclass(layer_cls, nn.Layer) and not callable(layer_cls):
+            raise TypeError("LayerDesc needs a Layer subclass")
+
+    def build_layer(self):
+        return self.layer_cls(*self.args, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_cls.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    """Layer shared between stages (reference: tied embeddings). Under
+    SPMD the sharing is literal — one parameter object, replicated over
+    "pipe" — so no grad-sync ops are needed."""
+
+    def __init__(self, key, layer_cls, forward_func=None, shared_weight_attr
+                 ="weight", *args, **kwargs):
+        super().__init__(layer_cls, *args, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class PipelineLayer(nn.Layer):
+    """Reference pp_layers.py:PipelineLayer — ``SegmentLayers`` uniform/
+    custom cut, ``get_stage_layers``. Single-device forward is the exact
+    sequential model, so pipeline loss parity is testable everywhere.
+    """
+
+    def __init__(self, layers: Sequence, num_stages: Optional[int] = None,
+                 topology=None, loss_fn=None, seg_method="uniform",
+                 recompute_interval=0, num_virtual_pipeline_stages=None):
+        super().__init__()
+        self._descs = list(layers)
+        self._loss_fn = loss_fn
+        self._num_stages = num_stages or (
+            topology.get_dim("pipe") if topology else 1)
+        self._shared = {}
+        built = []
+        for d in self._descs:
+            if isinstance(d, SharedLayerDesc):
+                if d.layer_name in self._shared:
+                    layer = self._shared[d.layer_name]
+                else:
+                    layer = d.build_layer()
+                    self._shared[d.layer_name] = layer
+                built.append((layer, d.forward_func))
+            elif isinstance(d, LayerDesc):
+                built.append((d.build_layer(), None))
+            elif isinstance(d, nn.Layer):
+                built.append((d, None))
+            elif callable(d):
+                built.append((d, None))
+            else:
+                raise TypeError(f"bad pipeline entry: {d!r}")
+        self.run_function = built
+        for i, (layer, _) in enumerate(built):
+            if isinstance(layer, nn.Layer):
+                self.add_sublayer(str(i), layer)
+        self._segment(seg_method)
+
+    def _segment(self, method):
+        n = len(self.run_function)
+        p = self._num_stages
+        if isinstance(method, str) and method.startswith("layer:"):
+            # cut at layers whose class name matches (reference custom cut)
+            name = method.split(":", 1)[1]
+            idxs = [i for i, (l, _) in enumerate(self.run_function)
+                    if type(l).__name__ == name]
+            if len(idxs) < p:
+                raise ValueError(
+                    f"need >= {p} '{name}' layers to cut {p} stages")
+            per = len(idxs) // p
+            bounds = [0] + [idxs[i * per] for i in range(1, p)] + [n]
+        else:  # uniform
+            per = (n + p - 1) // p
+            bounds = [min(i * per, n) for i in range(p)] + [n]
+        self.segment_parts = bounds
+
+    def get_stage_bounds(self, stage):
+        return self.segment_parts[stage], self.segment_parts[stage + 1]
+
+    def get_stage_layers(self, stage):
+        lo, hi = self.get_stage_bounds(stage)
+        return [l for l, _ in self.run_function[lo:hi]]
+
+    @property
+    def num_stages(self):
+        return self._num_stages
+
+    def forward(self, x, *args):
+        for layer, ffn in self.run_function:
+            if ffn is not None:
+                x = ffn(layer, x)
+            elif isinstance(layer, nn.Layer):
+                x = layer(x)
+            else:
+                x = layer(x)
+        return x

@@ -1,0 +1,63 @@
+"""Model-parallel RNG state tracking.
+
+Analog of the reference's ``get_rng_state_tracker``
+(fleet/meta_parallel/parallel_layers/random.py): named, seedable streams so
+e.g. dropout differs across mp ranks inside sharded regions but matches
+across dp replicas. On TPU the functional PRNG makes a stream = a folded
+key; per-rank decorrelation folds in the mesh axis index inside the traced
+program.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+from .....framework import random as _random
+
+__all__ = ["RNGStatesTracker", "get_rng_state_tracker",
+           "model_parallel_random_seed"]
+
+
+class RNGStatesTracker:
+    def __init__(self):
+        self._seeds = {}
+
+    def add(self, name, seed):
+        self._seeds[name] = int(seed)
+
+    def get_states_tracker(self):
+        return dict(self._seeds)
+
+    @contextlib.contextmanager
+    def rng_state(self, name="model-parallel-rng"):
+        """Route random ops to the named stream; inside a sharded program
+        the stream additionally folds in the "model" axis index so each mp
+        rank draws distinct values (the reference keeps per-rank CUDA seeds
+        for the same purpose)."""
+        seed = self._seeds.get(name, 0)
+        key = jax.random.key(seed)
+        try:
+            idx = jax.lax.axis_index("model")
+            key = jax.random.fold_in(key, idx)
+        except NameError:
+            pass  # not inside a "model"-axis context
+        with _random.rng_guard(key):
+            yield
+
+
+_tracker = RNGStatesTracker()
+_tracker.add("global_seed", 0)
+_tracker.add("model-parallel-rng", 1)
+
+
+def get_rng_state_tracker():
+    return _tracker
+
+
+def model_parallel_random_seed(seed=None):
+    base = seed if seed is not None else 0
+    _tracker._seeds.clear()
+    _tracker.add("global_seed", base)
+    _tracker.add("model-parallel-rng", base + 1)
+    _random.seed(base)

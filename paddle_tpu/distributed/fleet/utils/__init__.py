@@ -1,0 +1,1 @@
+from .recompute import RecomputeLayer, recompute  # noqa: F401

@@ -1,0 +1,60 @@
+"""Activation recompute.
+
+Analog of the reference's ``fleet/utils/recompute.py:207,350`` — a PyLayer
+that stashes RNG state and replays forward during backward. TPU-native:
+``jax.checkpoint`` (remat) expresses exactly this to XLA, RNG determinism
+included because random ops consume explicitly-folded keys
+(framework/random.py), so the replay sees identical streams.
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+
+from ....framework.tensor import Tensor, no_grad_guard
+from ....nn.layer.layers import Layer
+
+__all__ = ["recompute", "RecomputeLayer"]
+
+
+def recompute(function, *args, **kwargs):
+    """Run ``function(*args)`` under remat: activations inside are
+    rematerialised during backward instead of stored.
+
+    Works inside jitted train steps (the normal TPU path). The wrapped
+    function must be Tensor-in/Tensor-out.
+    """
+    kwargs.pop("preserve_rng_state", True)  # parity; replay is always exact
+    tensor_args = [a for a in args if isinstance(a, Tensor)]
+    # Eager (untraced) call: the tape engine owns residual lifetime, remat
+    # has nothing to trade — run the function directly.
+    if not any(isinstance(t._data, jax.core.Tracer) for t in tensor_args):
+        return function(*args, **kwargs)
+
+    @jax.checkpoint
+    def inner(*arrays):
+        ins = list(args)
+        it = iter(arrays)
+        ins = [Tensor(next(it), stop_gradient=a.stop_gradient)
+               if isinstance(a, Tensor) else a for a in ins]
+        out = function(*ins, **kwargs)
+        if isinstance(out, (list, tuple)):
+            return tuple(o._data for o in out)
+        return out._data
+
+    out = inner(*[t._data for t in tensor_args])
+    if isinstance(out, tuple):
+        return tuple(Tensor(o, stop_gradient=False) for o in out)
+    return Tensor(out, stop_gradient=False)
+
+
+class RecomputeLayer(Layer):
+    """Wrap a sublayer so its forward runs under remat."""
+
+    def __init__(self, layer: Layer):
+        super().__init__()
+        self.inner = layer
+
+    def forward(self, *args, **kwargs):
+        return recompute(self.inner, *args, **kwargs)

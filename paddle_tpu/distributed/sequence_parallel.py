@@ -1,0 +1,156 @@
+"""Sequence/context parallelism: ring attention over the "sep" mesh axis.
+
+The reference has NO long-context parallelism (SURVEY.md §5 — grep-verified
+absent); this is a new TPU-first capability required of this framework:
+sequences sharded over mesh axis "sep", attention computed blockwise while
+K/V chunks rotate around the ring via ``lax.ppermute`` (one ICI hop per
+step), with an online-softmax accumulator so memory stays O(L/sp) per chip
+(Ring Attention; blockwise attention numerics).
+
+``ring_attention`` is shaped like ``scaled_dot_product_attention``
+([B, L_local, H, D] in, same out) and is differentiable — reverse-mode AD
+transposes the ppermute ring into the reverse rotation.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import numpy as np
+
+from ..framework.tensor import Tensor
+from . import env as _env
+
+__all__ = ["ring_attention", "RingAttention", "split_sequence",
+           "gather_sequence"]
+
+
+def _ring_attention_arrays(q, k, v, axis_name: str, axis_size: int,
+                           causal: bool, scale: Optional[float]):
+    import jax
+    import jax.numpy as jnp
+
+    b, lq, h, d = q.shape
+    s = scale if scale is not None else 1.0 / (d ** 0.5)
+    my = jax.lax.axis_index(axis_name)
+
+    qf = q.astype(jnp.float32) * s
+    neg = jnp.asarray(-1e30, jnp.float32)
+
+    def block(qf, kf, vf, q_off, k_off):
+        logits = jnp.einsum("bqhd,bkhd->bhqk", qf, kf.astype(jnp.float32))
+        if causal:
+            qi = q_off + jnp.arange(lq)[:, None]
+            ki = k_off + jnp.arange(kf.shape[1])[None, :]
+            logits = jnp.where((ki <= qi)[None, None], logits, neg)
+        m = logits.max(-1)                                  # [b,h,q]
+        p = jnp.exp(logits - m[..., None])
+        l = p.sum(-1)                                       # [b,h,q]
+        o = jnp.einsum("bhqk,bkhd->bqhd", p, vf.astype(jnp.float32))
+        return m, l, o
+
+    # online-softmax accumulation across ring steps
+    m_acc = jnp.full((b, h, lq), -jnp.inf, jnp.float32)
+    l_acc = jnp.zeros((b, h, lq), jnp.float32)
+    o_acc = jnp.zeros((b, lq, h, d), jnp.float32)
+    k_cur, v_cur = k, v
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    q_off = my * lq
+    for step in range(axis_size):
+        src = (my - step) % axis_size  # whose K/V we hold this step
+        k_off = src * k.shape[1]
+        m_b, l_b, o_b = block(qf, k_cur, v_cur, q_off, k_off)
+        m_new = jnp.maximum(m_acc, m_b)
+        c_old = jnp.exp(m_acc - m_new)
+        c_new = jnp.exp(m_b - m_new)
+        l_acc = l_acc * c_old + l_b * c_new
+        o_acc = o_acc * c_old.transpose(0, 2, 1)[..., None] + \
+            o_b * c_new.transpose(0, 2, 1)[..., None]
+        m_acc = m_new
+        if step + 1 < axis_size:
+            k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+            v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+
+    out = o_acc / jnp.maximum(
+        l_acc.transpose(0, 2, 1), 1e-30)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention(q, k, v, axis_name: str = "sep", causal: bool = False,
+                   scale: Optional[float] = None, mesh=None):
+    """Blockwise ring attention.
+
+    Call INSIDE a program sharded over ``axis_name`` (e.g. via
+    ``sequence_parallel_attention`` below or a shard_map region), with
+    q/k/v holding this rank's sequence chunk [B, L/sp, H, D].
+    """
+    import jax
+    mesh = mesh or _env.get_mesh()
+    size = mesh.shape[axis_name] if mesh is not None else 1
+    raw = (q._data, k._data, v._data) if isinstance(q, Tensor) \
+        else (q, k, v)
+    if size <= 1:
+        from ..ops.registry import get_op
+        out = get_op("scaled_dot_product_attention").fn(
+            *raw, None, None, is_causal=causal, scale=scale)
+        return Tensor(out) if isinstance(q, Tensor) else out
+    out = _ring_attention_arrays(*raw, axis_name=axis_name, axis_size=size,
+                                 causal=causal, scale=scale)
+    return Tensor(out) if isinstance(q, Tensor) else out
+
+
+class RingAttention:
+    """Functional wrapper binding a mesh + axis (API convenience)."""
+
+    def __init__(self, axis_name="sep", causal=True, mesh=None):
+        self.axis_name = axis_name
+        self.causal = causal
+        self.mesh = mesh
+
+    def __call__(self, q, k, v):
+        return ring_attention(q, k, v, axis_name=self.axis_name,
+                              causal=self.causal, mesh=self.mesh)
+
+
+def sequence_parallel_attention(q, k, v, mesh=None, causal=False):
+    """Whole-sequence entry point: q/k/v [B, L, H, D] get sequence-sharded
+    over "sep"; returns full-length output. Run under jit with the mesh."""
+    import jax
+    from jax.sharding import PartitionSpec as PS
+
+    mesh = mesh or _env.get_mesh()
+    size = mesh.shape.get("sep", 1) if mesh is not None else 1
+    raw = (q._data, k._data, v._data) if isinstance(q, Tensor) else (q, k, v)
+    if size <= 1:
+        return ring_attention(q, k, v, mesh=mesh, causal=causal)
+    fn = jax.shard_map(
+        partial(_ring_attention_arrays, axis_name="sep", axis_size=size,
+                causal=causal, scale=None),
+        mesh=mesh,
+        in_specs=(PS(None, "sep"), PS(None, "sep"), PS(None, "sep")),
+        out_specs=PS(None, "sep"),
+        axis_names=frozenset({"sep"}), check_vma=False)
+    out = fn(*raw)
+    return Tensor(out) if isinstance(q, Tensor) else out
+
+
+def split_sequence(x, mesh=None, axis=1):
+    """Shard a [B, L, ...] tensor's sequence dim over "sep"."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as PS
+    mesh = mesh or _env.get_mesh()
+    spec = [None] * (x.ndim if not isinstance(x, Tensor) else len(x.shape))
+    spec[axis] = "sep"
+    data = x._data if isinstance(x, Tensor) else x
+    out = jax.device_put(data, NamedSharding(mesh, PS(*spec)))
+    return Tensor(out) if isinstance(x, Tensor) else out
+
+
+def gather_sequence(x, mesh=None, axis=1):
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as PS
+    mesh = mesh or _env.get_mesh()
+    data = x._data if isinstance(x, Tensor) else x
+    out = jax.device_put(data, NamedSharding(mesh, PS()))
+    return Tensor(out) if isinstance(x, Tensor) else out

@@ -1,0 +1,7 @@
+"""``paddle.incubate`` — experimental features.
+
+Analog of the reference's ``python/paddle/incubate/`` (fused transformer
+layers, MoE, functional autograd, sparse, autotune).
+"""
+from . import moe  # noqa: F401
+from .moe import MoELayer  # noqa: F401
