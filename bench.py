@@ -1,27 +1,202 @@
 """Benchmark harness — prints ONE JSON line for the driver.
 
-Current benchmark: north-star config 1 analog — LeNet/MNIST-shaped training
-throughput (imgs/sec) on a single chip through the full paddle_tpu stack
-(Model.fit's jitted train step: forward, loss, backward, Adam update).
+North-star configs measured (BASELINE.md):
+  gpt2     — config 5: GPT-2 124M causal-LM train step, tokens/sec + MFU
+  resnet50 — config 2: ResNet50 synthetic ImageNet train step, imgs/sec + MFU
+  bert     — config 3: BERT-base QA fine-tune step, AMP O2 bf16, steps/sec
+  lenet    — config 1: LeNet/MNIST Model.fit train_batch, imgs/sec
 
-vs_baseline: the reference publishes no numbers (BASELINE.md); 8xA100
-paddlepaddle-gpu LeNet-MNIST throughput is ingest-bound, not compute-bound.
-Until a measured baseline lands, vs_baseline reports throughput normalised
-by the driver-recorded previous round (1.0 = first measurement).
+Robustness contract (r1 verdict item 1b): the parent process NEVER imports
+jax — each benchmark runs in a subprocess with a timeout; a backend-init
+hang or crash costs one bench, not the round. On total TPU failure the
+parent retries the smallest bench on a forced-CPU backend so a number is
+always recorded, with diagnostics in the JSON instead of a traceback.
+
+Reference analog: tools/ci_op_benchmark.sh, tools/check_op_benchmark_result.py
+(perf as a CI gate).
 """
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
 
-import numpy as np
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+# bf16 peak FLOPs/sec per chip by device kind substring
+_PEAK_FLOPS = [
+    ("v5 lite", 197e12), ("v5e", 197e12), ("v5p", 459e12),
+    ("v4", 275e12), ("v3", 123e12), ("v6", 918e12),
+]
 
 
-def main():
+def _peak_flops(device_kind: str):
+    dk = device_kind.lower()
+    for sub, peak in _PEAK_FLOPS:
+        if sub in dk:
+            return peak
+    return None
+
+
+def _timeit(step_fn, n_warmup, n_steps):
+    for _ in range(n_warmup):
+        step_fn()
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        step_fn()
+    return time.perf_counter() - t0
+
+
+def _device_kind():
     import jax
+    return jax.devices()[0].device_kind
+
+
+def _smoke():
+    return os.environ.get("PADDLE_BENCH_SMOKE") == "1"
+
+
+# ---------------------------------------------------------------------------
+# individual benchmarks (run inside the child process)
+# ---------------------------------------------------------------------------
+
+def bench_gpt2():
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import env as denv
+    from paddle_tpu.distributed.spmd import ParallelEngine
+    from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining
+    from paddle_tpu.optimizer import AdamW
+
+    if _smoke():
+        cfg, batch, seq = GPTConfig.tiny(), 2, 32
+    else:
+        cfg, batch, seq = GPTConfig.gpt2_small(), 4, 1024
+        cfg.hidden_dropout_prob = 0.0
+        cfg.attention_dropout_prob = 0.0
+    paddle.framework.random.seed(0)
+    model = GPTForPretraining(cfg)
+    opt = AdamW(learning_rate=1e-4, weight_decay=0.01,
+                parameters=model.parameters())
+    denv.build_mesh({"data": 1})
+    eng = ParallelEngine(model, opt, loss_fn=None, mesh=denv.get_mesh())
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    n_warm, n_steps = (1, 2) if _smoke() else (2, 10)
+    dt = _timeit(lambda: eng.train_step([ids], [ids]), n_warm, n_steps)
+    tokens_per_sec = batch * seq * n_steps / dt
+    out = {"metric": "gpt2_124m_train_tokens_per_sec",
+           "value": round(tokens_per_sec, 1), "unit": "tokens/sec",
+           "n_params": n_params, "batch": batch, "seq": seq,
+           "device_kind": _device_kind()}
+    peak = _peak_flops(out["device_kind"])
+    if peak:
+        out["mfu"] = round(6.0 * n_params * tokens_per_sec / peak, 4)
+    return out
+
+
+def bench_resnet50():
+    import numpy as np
     import paddle_tpu as paddle
     import paddle_tpu.nn as nn
-    from paddle_tpu.metric import Accuracy
+    from paddle_tpu.distributed import env as denv
+    from paddle_tpu.distributed.spmd import ParallelEngine
+    from paddle_tpu.optimizer import Momentum
+    from paddle_tpu.vision.models import resnet50
+
+    batch, hw = (4, 32) if _smoke() else (64, 224)
+    paddle.framework.random.seed(0)
+    model = resnet50(num_classes=1000)
+    opt = Momentum(learning_rate=0.1, momentum=0.9,
+                   parameters=model.parameters())
+    denv.build_mesh({"data": 1})
+    eng = ParallelEngine(model, opt, loss_fn=nn.CrossEntropyLoss(),
+                         mesh=denv.get_mesh())
+    rng = np.random.RandomState(0)
+    x = rng.randn(batch, 3, hw, hw).astype(np.float32)
+    y = rng.randint(0, 1000, (batch, 1)).astype(np.int64)
+
+    n_warm, n_steps = (1, 2) if _smoke() else (2, 20)
+    dt = _timeit(lambda: eng.train_step([x], [y]), n_warm, n_steps)
+    imgs_per_sec = batch * n_steps / dt
+    out = {"metric": "resnet50_train_imgs_per_sec",
+           "value": round(imgs_per_sec, 1), "unit": "imgs/sec",
+           "batch": batch, "device_kind": _device_kind()}
+    peak = _peak_flops(out["device_kind"])
+    if peak and hw == 224:
+        # ~4.09 GFLOPs/img fwd at 224px; train ~= 3x fwd
+        out["mfu"] = round(3 * 4.09e9 * imgs_per_sec / peak, 4)
+    return out
+
+
+def bench_bert():
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import amp
+    from paddle_tpu.distributed import env as denv
+    from paddle_tpu.distributed.spmd import ParallelEngine
+    from paddle_tpu.models.bert import BertConfig, BertForQuestionAnswering
+    from paddle_tpu.optimizer import AdamW
+
+    if _smoke():
+        cfg = BertConfig(vocab_size=256, hidden_size=64,
+                         num_hidden_layers=2, num_attention_heads=4,
+                         intermediate_size=128, max_position_embeddings=64)
+        batch, seq = 2, 16
+    else:
+        cfg = BertConfig()  # base
+        cfg.hidden_dropout_prob = 0.0
+        cfg.attention_dropout_prob = 0.0
+        batch, seq = 32, 128
+    paddle.framework.random.seed(0)
+    import paddle_tpu.nn as nn
+
+    class _QATrain(nn.Layer):
+        # positional (ids, start, end) signature for the engine
+        def __init__(self, inner):
+            super().__init__()
+            self.inner = inner
+
+        def forward(self, ids, start, end):
+            return self.inner(ids, start_positions=start,
+                              end_positions=end)
+
+    model = _QATrain(BertForQuestionAnswering(cfg))
+    # AMP O2: bf16 parameters + fp32 master weights in the optimizer
+    amp.decorate(model, level="O2", dtype="bfloat16")
+    opt = AdamW(learning_rate=3e-5, weight_decay=0.01,
+                parameters=model.parameters(), multi_precision=True)
+    denv.build_mesh({"data": 1})
+    eng = ParallelEngine(model, opt, loss_fn=None, mesh=denv.get_mesh())
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+    start = rng.randint(0, seq, (batch,)).astype(np.int64)
+    end = rng.randint(0, seq, (batch,)).astype(np.int64)
+
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    n_warm, n_steps = (1, 2) if _smoke() else (2, 15)
+    dt = _timeit(lambda: eng.train_step([ids], [start, end]),
+                 n_warm, n_steps)
+    steps_per_sec = n_steps / dt
+    out = {"metric": "bert_base_amp_o2_steps_per_sec",
+           "value": round(steps_per_sec, 3), "unit": "steps/sec",
+           "batch": batch, "seq": seq,
+           "device_kind": _device_kind()}
+    peak = _peak_flops(out["device_kind"])
+    if peak:
+        out["mfu"] = round(
+            6.0 * n_params * batch * seq * steps_per_sec / peak, 4)
+    return out
+
+
+def bench_lenet():
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
     from paddle_tpu.vision.models import LeNet
 
     batch = 256
@@ -29,29 +204,97 @@ def main():
     opt = paddle.optimizer.Adam(learning_rate=1e-3,
                                 parameters=model.network.parameters())
     model.prepare(opt, nn.CrossEntropyLoss())
-
     rng = np.random.RandomState(0)
     x = rng.randn(batch, 1, 28, 28).astype(np.float32)
     y = rng.randint(0, 10, (batch, 1)).astype(np.int64)
 
-    # warmup (compile)
-    for _ in range(3):
-        model.train_batch([x], [y])
+    n_warm, n_steps = (1, 3) if _smoke() else (3, 30)
+    dt = _timeit(lambda: model.train_batch([x], [y]), n_warm, n_steps)
+    return {"metric": "lenet_mnist_train_imgs_per_sec",
+            "value": round(batch * n_steps / dt, 1), "unit": "imgs/sec",
+            "device_kind": _device_kind()}
 
-    n_steps = 30
-    t0 = time.perf_counter()
-    for _ in range(n_steps):
-        model.train_batch([x], [y])
-    dt = time.perf_counter() - t0
 
-    imgs_per_sec = batch * n_steps / dt
-    print(json.dumps({
-        "metric": "lenet_mnist_train_imgs_per_sec",
-        "value": round(imgs_per_sec, 1),
-        "unit": "imgs/sec",
-        "vs_baseline": 1.0,
-    }))
+BENCHES = {"gpt2": bench_gpt2, "resnet50": bench_resnet50,
+           "bert": bench_bert, "lenet": bench_lenet}
+
+
+# ---------------------------------------------------------------------------
+# parent orchestration
+# ---------------------------------------------------------------------------
+
+def _run_child(name: str, timeout: float, force_cpu: bool = False):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = HERE + os.pathsep + env.get("PYTHONPATH", "")
+    if force_cpu:
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PALLAS_AXON_POOL_IPS"] = ""
+        env["PADDLE_BENCH_SMOKE"] = "1"
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child", name],
+            env=env, cwd=HERE, timeout=timeout,
+            capture_output=True, text=True)
+    except subprocess.TimeoutExpired:
+        return {"error": f"timeout after {timeout:.0f}s"}
+    for line in reversed(proc.stdout.strip().splitlines()):
+        if line.startswith("RESULT "):
+            try:
+                return json.loads(line[len("RESULT "):])
+            except json.JSONDecodeError:
+                break
+    return {"error": f"rc={proc.returncode}: "
+                     f"{(proc.stderr or proc.stdout)[-800:]}"}
+
+
+def main():
+    budget = float(os.environ.get("PADDLE_BENCH_BUDGET_SEC", "2400"))
+    t_start = time.perf_counter()
+    results = {}
+    order = ["gpt2", "resnet50", "bert", "lenet"]
+    for name in order:
+        remaining = budget - (time.perf_counter() - t_start)
+        if remaining < 120:
+            results[name] = {"error": "skipped: bench time budget exhausted"}
+            continue
+        results[name] = _run_child(name, timeout=min(900.0, remaining))
+        if "error" in results[name] and name == "gpt2":
+            # one retry — transient TPU backend-init failures cost rounds
+            remaining = budget - (time.perf_counter() - t_start)
+            if remaining > 300:
+                retry = _run_child(name, timeout=min(900.0, remaining))
+                if "error" not in retry:
+                    results[name] = retry
+
+    headline = None
+    for name in order:
+        if "error" not in results.get(name, {}):
+            headline = results[name]
+            break
+    if headline is None:
+        # last resort: forced-CPU smoke so SOME number exists (bounded by
+        # what's left of the budget, floor 120s)
+        remaining = budget - (time.perf_counter() - t_start)
+        cpu = _run_child("lenet", timeout=max(120.0, min(600.0, remaining)),
+                         force_cpu=True)
+        if "error" not in cpu:
+            cpu["metric"] += "_cpu_fallback"
+            headline = cpu
+            results["lenet_cpu_fallback"] = cpu
+    if headline is None:
+        headline = {"metric": "bench_failed", "value": 0.0, "unit": "none"}
+
+    out = {"metric": headline["metric"], "value": headline["value"],
+           "unit": headline["unit"], "vs_baseline": 1.0,
+           "extras": results}
+    if "mfu" in headline:
+        out["mfu"] = headline["mfu"]
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) >= 3 and sys.argv[1] == "--child":
+        result = BENCHES[sys.argv[2]]()
+        print("RESULT " + json.dumps(result))
+    else:
+        main()

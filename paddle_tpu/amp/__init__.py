@@ -143,6 +143,9 @@ class GradScaler:
         self._good_steps = 0
         self._bad_steps = 0
         self._found_inf = False
+        # INIT -> UNSCALED -> STEPPED cycle, reset by update() (reference
+        # grad_scaler.py OptimizerState tracking).
+        self._stage = "INIT"
 
     def is_enable(self):
         return self._enable
@@ -164,6 +167,10 @@ class GradScaler:
         grad_scaler.py:243 _unscale → check_finite_and_unscale op)."""
         if not self._enable:
             return
+        if self._stage != "INIT":
+            raise RuntimeError(
+                "unscale_() may only be called once between update()s, "
+                "and not after step().")
         params = optimizer._parameter_list or []
         inv = 1.0 / self._scale
         found = False
@@ -175,21 +182,30 @@ class GradScaler:
             if not bool(jnp.all(jnp.isfinite(g))):
                 found = True
         self._found_inf = found
+        self._stage = "UNSCALED"
 
     def step(self, optimizer):
         if not self._enable:
             optimizer.step()
             return
-        self.unscale_(optimizer)
+        if self._stage == "STEPPED":
+            raise RuntimeError(
+                "step() has already been called since the last update().")
+        if self._stage != "UNSCALED":
+            self.unscale_(optimizer)
         if not self._found_inf:
             optimizer.step()
-        self.update()
+        # NOTE: no update() here — the canonical pattern is
+        # `scaler.step(opt); scaler.update()` (reference grad_scaler.py:159).
+        self._stage = "STEPPED"
 
     def minimize(self, optimizer, scaled_loss):
         scaled_loss.backward()
         self.step(optimizer)
+        self.update()
 
     def update(self):
+        self._stage = "INIT"
         if not (self._enable and self._dynamic):
             return
         if self._found_inf:

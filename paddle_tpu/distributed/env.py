@@ -95,11 +95,13 @@ def build_mesh(axes: Dict[str, int], devices=None):
     devices = list(devices if devices is not None else jax.devices())
     degrees = [max(1, int(d)) for d in axes.values()]
     total = int(np.prod(degrees))
-    if total != len(devices):
+    if total > len(devices):
         raise ValueError(
-            f"mesh axes {axes} need {total} devices but "
+            f"mesh axes {axes} need {total} devices but only "
             f"{len(devices)} are visible")
-    arr = np.array(devices).reshape(degrees)
+    # A mesh smaller than the machine is legal (reference new_group over a
+    # rank subset): take the leading devices.
+    arr = np.array(devices[:total]).reshape(degrees)
     mesh = Mesh(arr, tuple(axes.keys()))
     _global["mesh"] = mesh
     return mesh

@@ -109,7 +109,11 @@ def _template_key(template):
 
 def _const_key(v):
     if isinstance(v, (np.ndarray, jnp.ndarray)):
-        return ("arr", v.shape, str(v.dtype))
+        # Arrays should normally be routed through the traced-input path
+        # (see call_op); if one still lands here as a constant, key it by
+        # VALUE, not just shape/dtype, so distinct constants never alias.
+        return ("arr", v.shape, str(v.dtype),
+                np.asarray(v).tobytes())
     try:
         hash(v)
         return v
@@ -120,12 +124,21 @@ def _const_key(v):
 _fn_cache: Dict[tuple, Any] = {}
 
 
-def _get_callable(name: str, impl, template, attrs_key, attrs, jit_ok=True):
-    key = (name, id(impl), _template_key(template), attrs_key)
+def _get_callable(name: str, impl, template, attrs_key, attrs,
+                  arr_attr_names=(), jit_ok=True):
+    key = (name, id(impl), _template_key(template), attrs_key,
+           tuple(arr_attr_names))
     fn = _fn_cache.get(key)
     if fn is None:
+        n_attr = len(arr_attr_names)
+
         def raw(*arrays):
-            return impl(*_rebuild(template, arrays), **attrs)
+            pos = arrays[:len(arrays) - n_attr] if n_attr else arrays
+            kw = dict(attrs)
+            if n_attr:
+                kw.update(zip(arr_attr_names,
+                              arrays[len(arrays) - n_attr:]))
+            return impl(*_rebuild(template, pos), **kw)
 
         fn = jax.jit(raw) if (jit_ok and flag_value("FLAGS_eager_jit_ops")) \
             else raw
@@ -154,14 +167,27 @@ def _amp():
 def call_op(name: str, *args, **attrs):
     """Execute a registered op eagerly on Tensors, recording the tape."""
     opdef = get_op(name)
+    # Array-valued attrs (incl. Tensors and tracers) must be TRACED inputs,
+    # never closure constants: the jit cache is keyed by structure only, so a
+    # baked-in value would be served back for a different value of the same
+    # shape (advisor finding r1).
+    arr_attrs = {k: v for k, v in attrs.items()
+                 if isinstance(v, (Tensor, jax.Array, np.ndarray))
+                 or hasattr(v, "aval")}
+    const_attrs = {k: v for k, v in attrs.items() if k not in arr_attrs}
     template, tensors = _unwrap_args(args)
+    arr_attr_names = tuple(sorted(arr_attrs))
+    for k in arr_attr_names:
+        v = arr_attrs[k]
+        tensors.append(v if isinstance(v, Tensor)
+                       else Tensor(jnp.asarray(v), stop_gradient=True))
     arrays = [t._data for t in tensors]
     amp = _amp()
     if amp.is_auto_cast_enabled():
         arrays = amp.amp_cast_inputs(name, arrays)
     impl = opdef.select(args, attrs)
-    fn = _get_callable(name, impl, template, _attrs_key(attrs), attrs,
-                       jit_ok=opdef.jit)
+    fn = _get_callable(name, impl, template, _attrs_key(const_attrs),
+                       const_attrs, arr_attr_names, jit_ok=opdef.jit)
 
     needs_grad = (is_grad_enabled() and not opdef.nondiff
                   and any(t._requires_grad() for t in tensors))

@@ -158,9 +158,14 @@ def linear(x, weight, bias=None, name=None):
 def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
             name=None):
     if not training or p == 0.0:
+        # reference semantics: downscale_in_infer scales by (1-p) at eval
+        if not training and mode == "downscale_in_infer" and p > 0.0:
+            return _op("scale", x, scale=1.0 - float(p))
         return x if isinstance(x, Tensor) else _op("assign", x)
-    return _op("dropout_raw", x, _random.next_key(), p=float(p), axis=axis,
-               mode=mode)
+    axis_attr = None if axis is None else tuple(
+        (axis,) if isinstance(axis, int) else tuple(int(a) for a in axis))
+    return _op("dropout_raw", x, _random.next_key(), p=float(p),
+               axis=axis_attr, mode=mode)
 
 
 @_export

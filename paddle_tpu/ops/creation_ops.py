@@ -160,14 +160,24 @@ def _exponential(key, x, lam=1.0):
 
 
 @register_op("dropout_raw", nondiff=False)
-def _dropout(x, key, p=0.5, training=True, mode="upscale_in_train"):
-    # reference: phi/kernels/dropout_kernel.h semantics
+def _dropout(x, key, p=0.5, axis=None, training=True,
+             mode="upscale_in_train"):
+    # reference: phi/kernels/dropout_kernel.h semantics; axis ≙ the
+    # reference's dropout_nd (mask drawn on the given axes, broadcast over
+    # the rest — dropout2d/3d channel-wise masks).
     if not training or p == 0.0:
         return x
     if p == 1.0:
         return jnp.zeros_like(x)
     keep = 1.0 - p
-    mask = jax.random.bernoulli(key, keep, x.shape)
+    if axis is None:
+        mask_shape = x.shape
+    else:
+        axes = (axis,) if isinstance(axis, int) else tuple(axis)
+        axes = tuple(a % x.ndim for a in axes)
+        mask_shape = tuple(d if i in axes else 1
+                           for i, d in enumerate(x.shape))
+    mask = jax.random.bernoulli(key, keep, mask_shape)
     if mode == "upscale_in_train":
         return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
     return jnp.where(mask, x, 0.0).astype(x.dtype)

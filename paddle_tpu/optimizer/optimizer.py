@@ -53,6 +53,11 @@ class Optimizer:
         # slots[param_name][slot_name] -> jnp array; counters separate
         self._slots: Dict[str, Dict[str, jnp.ndarray]] = {}
         self._step_count = 0
+        # param currently being updated (AdamW/Lamb per-param weight-decay
+        # exclusion hooks read these; _current_param is the Parameter in
+        # eager mode, a name-only shim in the functional path)
+        self._current_param_name = None
+        self._current_param = None
 
     # -- lr -----------------------------------------------------------------
     def get_lr(self) -> float:
@@ -96,7 +101,7 @@ class Optimizer:
         for key, value in state.items():
             if key in ("@step", "LR_Scheduler"):
                 continue
-            for sname in self._slot_names:
+            for sname in list(self._slot_names) + ["master_weight"]:
                 suffix = "_" + sname
                 if key.endswith(suffix):
                     pname = key[: -len(suffix)]
@@ -118,6 +123,28 @@ class Optimizer:
             return g + self._weight_decay.coeff * jnp.sign(p)
         return g
 
+    def _needs_master(self, value) -> bool:
+        return self._multi_precision and value.dtype in (
+            jnp.bfloat16, jnp.float16)
+
+    def _apply_rule(self, p_value, g, slots, lr, step):
+        """Run _rule with fp32 master weights when multi_precision asks for
+        them (reference: optimizers' master_param accumulators) — the master
+        is updated and the low-precision param is a cast-down view, so small
+        updates don't round away every step."""
+        if self._needs_master(p_value):
+            master = slots.get("master_weight")
+            if master is None:
+                master = p_value.astype(jnp.float32)
+            rule_slots = {k: v for k, v in slots.items()
+                          if k != "master_weight"}
+            new_master, new_slots = self._rule(master, g, rule_slots, lr,
+                                               step)
+            new_slots = dict(new_slots)
+            new_slots["master_weight"] = new_master
+            return new_master.astype(p_value.dtype), new_slots
+        return self._rule(p_value, g, slots, lr, step)
+
     # -- eager step ---------------------------------------------------------
     def step(self):
         if self._parameter_list is None:
@@ -131,15 +158,19 @@ class Optimizer:
         self._step_count += 1
         with no_grad_guard():
             for p, g in params_grads:
+                self._current_param_name = p.name
+                self._current_param = p
                 lr = self.get_lr() * getattr(
                     p, "optimize_attr", {}).get("learning_rate", 1.0)
                 g = self._decay_grad(p._data, g.astype(p._data.dtype)
                                      if hasattr(g, "astype") else g)
                 slots = self._ensure_slots(p.name, p._data)
-                new_p, new_slots = self._rule(p._data, g, slots, lr,
-                                              self._step_count)
+                new_p, new_slots = self._apply_rule(p._data, g, slots, lr,
+                                                    self._step_count)
                 p._data = new_p
                 self._slots[p.name] = new_slots
+        self._current_param_name = None
+        self._current_param = None
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
@@ -156,12 +187,17 @@ class Optimizer:
     clear_gradients = clear_grad
 
     # -- functional API for jitted train steps ------------------------------
+    def _init_slot_dict(self, value):
+        slots = {s: jnp.zeros_like(value) for s in self._slot_names}
+        if self._needs_master(value):
+            slots["master_weight"] = value.astype(jnp.float32)
+        return slots
+
     def init_state(self, params: Dict[str, jnp.ndarray]):
         """Pure optimizer state for `apply_gradients` (step=0)."""
         return {
             "step": jnp.zeros((), jnp.int32),
-            "slots": {name: {s: jnp.zeros_like(v)
-                             for s in self._slot_names}
+            "slots": {name: self._init_slot_dict(v)
                       for name, v in params.items()},
         }
 
@@ -180,10 +216,16 @@ class Optimizer:
                 new_params[name] = p
                 new_slots[name] = state["slots"][name]
                 continue
+            self._current_param_name = name
+            from types import SimpleNamespace
+            self._current_param = SimpleNamespace(name=name)
             g = self._decay_grad(p, g.astype(p.dtype))
-            new_p, ns = self._rule(p, g, state["slots"][name], lr, step)
+            new_p, ns = self._apply_rule(p, g, state["slots"][name], lr,
+                                         step)
             new_params[name] = new_p
             new_slots[name] = ns
+        self._current_param_name = None
+        self._current_param = None
         return new_params, {"step": step, "slots": new_slots}
 
 
@@ -244,18 +286,15 @@ class Adam(Optimizer):
 
     def _ensure_slots(self, name, value):
         if name not in self._slots:
-            self._slots[name] = {
-                s: jnp.zeros(value.shape, jnp.float32)
-                for s in self._slot_names}
+            self._slots[name] = self._init_slot_dict(value)
         return self._slots[name]
 
-    def init_state(self, params):
-        return {
-            "step": jnp.zeros((), jnp.int32),
-            "slots": {name: {s: jnp.zeros(v.shape, jnp.float32)
-                             for s in self._slot_names}
-                      for name, v in params.items()},
-        }
+    def _init_slot_dict(self, value):
+        slots = {s: jnp.zeros(value.shape, jnp.float32)
+                 for s in self._slot_names}
+        if self._needs_master(value):
+            slots["master_weight"] = value.astype(jnp.float32)
+        return slots
 
 
 class AdamW(Adam):
@@ -293,46 +332,6 @@ class AdamW(Adam):
             self._wd_enabled(self._current_param_name)) else 0.0
         new_p = pf - lr * (mhat / (jnp.sqrt(vhat) + self._eps) + decay * pf)
         return new_p.astype(p.dtype), {"moment1": m, "moment2": v}
-
-    def step(self):
-        # track the param name so apply_decay_param_fun can exclude
-        # LayerNorm/bias params the way the reference does
-        if self._parameter_list is None:
-            raise ValueError("optimizer created without parameters")
-        params_grads = [(p, p.grad._data) for p in self._parameter_list
-                        if p.grad is not None and not p.stop_gradient]
-        if self._grad_clip is not None:
-            params_grads = self._grad_clip(params_grads)
-        self._step_count += 1
-        with no_grad_guard():
-            for p, g in params_grads:
-                self._current_param_name = p.name
-                lr = self.get_lr() * getattr(
-                    p, "optimize_attr", {}).get("learning_rate", 1.0)
-                slots = self._ensure_slots(p.name, p._data)
-                new_p, new_slots = self._rule(
-                    p._data, g.astype(p._data.dtype), slots, lr,
-                    self._step_count)
-                p._data = new_p
-                self._slots[p.name] = new_slots
-        self._current_param_name = None
-
-    def apply_gradients(self, params, grads, state, lr=None):
-        lr = lr if lr is not None else self.get_lr()
-        step = state["step"] + 1
-        new_params, new_slots = {}, {}
-        for name, p in params.items():
-            g = grads[name]
-            if g is None:
-                new_params[name] = p
-                new_slots[name] = state["slots"][name]
-                continue
-            self._current_param_name = name
-            new_p, ns = self._rule(p, g, state["slots"][name], lr, step)
-            new_params[name] = new_p
-            new_slots[name] = ns
-        self._current_param_name = None
-        return new_params, {"step": step, "slots": new_slots}
 
 
 class Adamax(Optimizer):
@@ -443,7 +442,6 @@ class Lamb(Optimizer):
         self._wd = lamb_weight_decay
         self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
         self._exclude_fn = exclude_from_weight_decay_fn
-        self._current_param = None
 
     def _rule(self, p, g, slots, lr, step):
         gf = g.astype(jnp.float32)
@@ -454,8 +452,11 @@ class Lamb(Optimizer):
         mhat = m / (1 - self._beta1 ** stepf)
         vhat = v / (1 - self._beta2 ** stepf)
         wd = self._wd
-        if self._exclude_fn is not None and self._current_param is not None \
-                and self._exclude_fn(self._current_param):
+        # reference API: the callback receives the Parameter (lamb.py) —
+        # a name-only shim stands in under the functional/jit path
+        if self._exclude_fn is not None and \
+                self._current_param is not None and \
+                self._exclude_fn(self._current_param):
             wd = 0.0
         r = mhat / (jnp.sqrt(vhat) + self._eps) + wd * pf
         w_norm = jnp.linalg.norm(pf)

@@ -236,6 +236,13 @@ class TestRingAttention:
 class TestMoE:
     def test_moe_forward_and_training(self):
         import paddle_tpu.distributed.env as env
+        old_mesh = env.get_mesh()
+        try:
+            self._run_moe(env)
+        finally:
+            env.set_mesh(old_mesh)
+
+    def _run_moe(self, env):
         from paddle_tpu.incubate.moe import MoELayer, ExpertMLP
         env.build_mesh({"data": 1, "pipe": 1, "sharding": 1, "sep": 1,
                         "expert": 4, "model": 1})

@@ -239,7 +239,14 @@ class TestAmp:
         o = opt.SGD(learning_rate=0.01, parameters=model.parameters())
         loss = F.mse_loss(model(x), y)
         scaler.scale(loss).backward()
-        scaler.step(o)  # finite step -> scale doubles (incr_every=1)
+        scaler.step(o)  # canonical pattern: step() then update()
+        assert scaler.get_loss_scaling() == 8.0  # step() must NOT update
+        # double step() between updates is an error (reference
+        # OptimizerState tracking)
+        import pytest as _pytest
+        with _pytest.raises(RuntimeError):
+            scaler.step(o)
+        scaler.update()  # finite step -> scale doubles (incr_every=1)
         assert scaler.get_loss_scaling() == 16.0
         # poison a grad with inf -> skip + halve
         loss = F.mse_loss(model(x), y)
@@ -247,7 +254,8 @@ class TestAmp:
         model.weight.grad._data = model.weight.grad._data * np.inf
         w_before = model.weight.numpy().copy()
         scaler.step(o)
-        assert scaler.get_loss_scaling() == 8.0
+        scaler.update()
+        assert scaler.get_loss_scaling() == 8.0  # 16 halved on inf
         np.testing.assert_allclose(model.weight.numpy(), w_before)
 
 
