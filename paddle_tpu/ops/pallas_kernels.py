@@ -1,0 +1,558 @@
+"""Pallas TPU kernels — the CUDA-analog tier.
+
+Reference analogs: paddle/fluid/operators/fused/fused_attention_op.cu,
+fmha_ref.h (flash attention), fused_dropout_helper.h + layer_norm_kernel
+(fused LN), operators/optimizers/adam_op (fused optimizer update).
+
+Design: every kernel registers as an *override* of the generic lax op
+(ops/registry.py:register_override) guarded by a predicate — on TPU with
+supported shapes the Pallas kernel runs; anywhere else the lax composition
+stands. On CPU the kernels execute in Pallas interpret mode, which is how
+the parity tests run them (SURVEY §4 OpTest ≙ numpy-vs-kernel parity).
+
+Enablement: FLAGS_use_pallas (default True). Forced interpret-mode selection
+for tests: FLAGS_pallas_force (runs kernels even off-TPU, interpreted).
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..framework.flags import define_flag, flag_value
+from .registry import register_op, register_override
+
+define_flag("FLAGS_use_pallas", True,
+            "use Pallas TPU kernels where registered")
+define_flag("FLAGS_pallas_force", False,
+            "force-select Pallas kernels off-TPU (interpret mode, tests)")
+
+_NEG_INF = -1e30
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def _interpret() -> bool:
+    # off-TPU the kernels can only run interpreted (tests)
+    return not _on_tpu()
+
+
+def _pallas_enabled() -> bool:
+    if not flag_value("FLAGS_use_pallas"):
+        return False
+    return _on_tpu() or flag_value("FLAGS_pallas_force")
+
+
+def _shape_of(x):
+    return tuple(getattr(x, "shape", ()))
+
+
+# ===========================================================================
+# Flash attention (fwd + bwd), layout [B, S, H, D]
+# ===========================================================================
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+
+
+def _fa_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
+                   block_q, block_k, seq_k):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)              # [bq, D]
+    bq, d = q.shape
+    nk_full = seq_k // block_k
+    if causal:
+        # kv blocks beyond the diagonal contribute nothing
+        nk = jnp.minimum(nk_full, ((qi + 1) * block_q + block_k - 1)
+                         // block_k)
+    else:
+        nk = nk_full
+
+    def body(j, carry):
+        m_prev, l_prev, acc = carry
+        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale    # [bq, bk]
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 0)
+            cols = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        m_cur = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+        acc = acc * alpha[:, None] + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc
+
+    m0 = jnp.full((bq,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq,), jnp.float32)
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, nk, body, (m0, l0, acc0))
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    lse_ref[0] = m + jnp.log(l_safe)
+
+
+def _fa_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                  *, scale, causal, block_q, block_k, seq_k):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]
+    delta = delta_ref[0]
+    bq, d = q.shape
+    nk_full = seq_k // block_k
+    nk = jnp.minimum(nk_full, ((qi + 1) * block_q + block_k - 1) //
+                     block_k) if causal else nk_full
+
+    def body(j, dq):
+        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 0)
+            cols = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        return dq + jax.lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(0, nk, body, jnp.zeros((bq, d), jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _fa_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dk_ref, dv_ref, *, scale, causal, block_q, block_k,
+                   seq_q):
+    ki = pl.program_id(1)
+    k = k_ref[0].astype(jnp.float32)              # [bk, D]
+    v = v_ref[0].astype(jnp.float32)
+    bk, d = k.shape
+    nq_full = seq_q // block_q
+    start_q = (ki * block_k) // block_q if causal else 0
+
+    def body(j, carry):
+        dk, dv = carry
+        q_blk = q_ref[0, pl.ds(j * block_q, block_q), :].astype(jnp.float32)
+        do_blk = do_ref[0, pl.ds(j * block_q, block_q), :].astype(
+            jnp.float32)
+        lse_blk = lse_ref[0, pl.ds(j * block_q, block_q)]
+        delta_blk = delta_ref[0, pl.ds(j * block_q, block_q)]
+        s = jax.lax.dot_general(
+            q_blk, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # [bq, bk]
+        if causal:
+            rows = j * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, bk), 0)
+            cols = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, bk), 1)
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        p = jnp.exp(s - lse_blk[:, None])
+        dv_new = dv + jax.lax.dot_general(
+            p, do_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do_blk, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_blk[:, None]) * scale
+        dk_new = dk + jax.lax.dot_general(
+            ds, q_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk_new, dv_new
+
+    z = jnp.zeros((bk, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(start_q, nq_full, body, (z, z))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _fa_call_fwd(q, k, v, scale, causal, block_q, block_k):
+    """q,k,v: [BH, S, D] -> (o [BH, Sq, D], lse [BH, Sq])."""
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    nq = sq // block_q
+    kernel = functools.partial(
+        _fa_fwd_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, seq_k=sk)
+    return pl.pallas_call(
+        kernel,
+        grid=(bh, nq),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, sq), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v)
+
+
+def _fa_call_bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k):
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1)                                    # [BH, Sq]
+    dq = pl.pallas_call(
+        functools.partial(_fa_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, seq_k=sk),
+        grid=(bh, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+    dk, dv = pl.pallas_call(
+        functools.partial(_fa_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, seq_q=sq),
+        grid=(bh, sk // block_k),
+        in_specs=[
+            pl.BlockSpec((1, sq, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, sq, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, sq), lambda b, i: (b, 0)),
+            pl.BlockSpec((1, sq), lambda b, i: (b, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+        ],
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_attention_bhsd(q, k, v, scale, causal, block_q, block_k):
+    o, _ = _fa_call_fwd(q, k, v, scale, causal, block_q, block_k)
+    return o
+
+
+def _flash_fwd_rule(q, k, v, scale, causal, block_q, block_k):
+    o, lse = _fa_call_fwd(q, k, v, scale, causal, block_q, block_k)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd_rule(scale, causal, block_q, block_k, res, do):
+    q, k, v, o, lse = res
+    return _fa_call_bwd(q, k, v, o, lse, do, scale, causal, block_q,
+                        block_k)
+
+
+_flash_attention_bhsd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention(q, k, v, is_causal=False, scale=None,
+                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
+    """Flash attention on [B, S, H, D] inputs (the framework's attention
+    layout). Differentiable via the Pallas backward kernels."""
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    # [B,S,H,D] -> [B*H, S, D]
+    qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    o = _flash_attention_bhsd(qt, kt, vt, float(s), bool(is_causal),
+                              int(block_q), int(block_k))
+    return o.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+
+
+def _fa_supported(q, k, v, mask, dropout_key, dropout_p, is_causal,
+                  block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
+    qs, ks = _shape_of(q), _shape_of(k)
+    if len(qs) != 4 or mask is not None or (dropout_p or 0.0) > 0.0:
+        return False
+    b, sq, h, d = qs
+    sk = ks[1]
+    if is_causal and sq != sk:
+        return False
+    bq, bk = min(block_q, sq), min(block_k, sk)
+    # VMEM budget: K/V (fwd, dq) or Q/dO (dkv) are mapped as full-length
+    # blocks — bound (sq+sk)*d so the worst pass stays well under ~16MB.
+    # (long-seq v2: block K/V through the grid instead.)
+    if (sq + sk) * d > 1_500_000:
+        return False
+    return (sq % bq == 0 and sk % bk == 0 and d <= 256 and
+            sq >= 8 and sk >= 8)
+
+
+def _sdpa_pallas(q, k, v, mask=None, dropout_key=None, dropout_p=0.0,
+                 is_causal=False, scale=None):
+    return flash_attention(q, k, v, is_causal=is_causal, scale=scale)
+
+
+register_override(
+    "scaled_dot_product_attention",
+    lambda args, attrs: _pallas_enabled() and _fa_supported(
+        args[0], args[1], args[2],
+        args[3] if len(args) > 3 else attrs.get("mask"),
+        args[4] if len(args) > 4 else attrs.get("dropout_key"),
+        attrs.get("dropout_p", 0.0), attrs.get("is_causal", False)),
+)(_sdpa_pallas)
+
+
+# ===========================================================================
+# Fused LayerNorm (last axis, affine) — fwd kernel + recompute bwd kernel
+# ===========================================================================
+
+LN_BLOCK_ROWS = 128
+
+
+def _ln_fwd_kernel(x_ref, w_ref, b_ref, o_ref, *, eps):
+    x = x_ref[...].astype(jnp.float32)            # [rows, D]
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    xc = x - mean
+    var = jnp.mean(xc * xc, axis=-1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + eps)
+    y = xc * rstd * w_ref[...].astype(jnp.float32)[None, :] + \
+        b_ref[...].astype(jnp.float32)[None, :]
+    o_ref[...] = y.astype(o_ref.dtype)
+
+
+def _ln_bwd_kernel(x_ref, w_ref, g_ref, dx_ref, dwp_ref, dbp_ref, *, eps):
+    x = x_ref[...].astype(jnp.float32)
+    g = g_ref[...].astype(jnp.float32)
+    w = w_ref[...].astype(jnp.float32)[None, :]
+    d = x.shape[-1]
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    xc = x - mean
+    var = jnp.mean(xc * xc, axis=-1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + eps)
+    xhat = xc * rstd
+    gw = g * w
+    m1 = jnp.mean(gw, axis=-1, keepdims=True)
+    m2 = jnp.mean(gw * xhat, axis=-1, keepdims=True)
+    dx = (gw - m1 - xhat * m2) * rstd
+    dx_ref[...] = dx.astype(dx_ref.dtype)
+    dwp_ref[0, :] = jnp.sum(g * xhat, axis=0)     # partial over row block
+    dbp_ref[0, :] = jnp.sum(g, axis=0)
+
+
+def _ln_reshape(x):
+    d = x.shape[-1]
+    rows = x.size // d
+    return x.reshape(rows, d), rows, d
+
+
+def _ln_block_rows(rows, d):
+    """Row-block size bounded by a ~4MB-per-buffer VMEM budget (the bwd
+    kernel holds three row blocks at fp32)."""
+    budget_rows = max(8, (4 * 2 ** 20) // (d * 4))
+    return min(LN_BLOCK_ROWS, rows, budget_rows)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _fused_layer_norm_2d(x2, w, b, eps):
+    rows, d = x2.shape
+    br = _ln_block_rows(rows, d)
+    return pl.pallas_call(
+        functools.partial(_ln_fwd_kernel, eps=eps),
+        grid=(rows // br,),
+        in_specs=[
+            pl.BlockSpec((br, d), lambda i: (i, 0)),
+            pl.BlockSpec((d,), lambda i: (0,)),
+            pl.BlockSpec((d,), lambda i: (0,)),
+        ],
+        out_specs=pl.BlockSpec((br, d), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((rows, d), x2.dtype),
+        interpret=_interpret(),
+    )(x2, w, b)
+
+
+def _ln_fwd_rule(x2, w, b, eps):
+    return _fused_layer_norm_2d(x2, w, b, eps), (x2, w, b)
+
+
+def _ln_bwd_rule(eps, res, g):
+    x2, w, b = res
+    b_dtype = b.dtype
+    rows, d = x2.shape
+    br = _ln_block_rows(rows, d)
+    nb = rows // br
+    dx, dwp, dbp = pl.pallas_call(
+        functools.partial(_ln_bwd_kernel, eps=eps),
+        grid=(nb,),
+        in_specs=[
+            pl.BlockSpec((br, d), lambda i: (i, 0)),
+            pl.BlockSpec((d,), lambda i: (0,)),
+            pl.BlockSpec((br, d), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((br, d), lambda i: (i, 0)),
+            pl.BlockSpec((1, d), lambda i: (i, 0)),
+            pl.BlockSpec((1, d), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((rows, d), x2.dtype),
+            jax.ShapeDtypeStruct((nb, d), jnp.float32),
+            jax.ShapeDtypeStruct((nb, d), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(x2, w, g)
+    return dx, dwp.sum(0).astype(w.dtype), dbp.sum(0).astype(b_dtype)
+
+
+_fused_layer_norm_2d.defvjp(_ln_fwd_rule, _ln_bwd_rule)
+
+
+def fused_layer_norm(x, weight, bias, epsilon=1e-5):
+    """LayerNorm over the last axis with affine params, as one Pallas
+    kernel per row-block (reference: fused LN in fused_dropout_helper.h)."""
+    x2, rows, d = _ln_reshape(x)
+    b = bias if bias is not None else jnp.zeros((d,), x.dtype)
+    out = _fused_layer_norm_2d(x2, weight, b, float(epsilon))
+    return out.reshape(x.shape)
+
+
+def _ln_supported(x, weight, bias, begin_norm_axis):
+    xs = _shape_of(x)
+    if not xs or weight is None:
+        return False
+    if begin_norm_axis is not None and begin_norm_axis != len(xs) - 1:
+        return False
+    d = xs[-1]
+    rows = 1
+    for s in xs[:-1]:
+        rows *= s
+    if rows == 0 or d < 8 or d > 16384:
+        return False
+    return rows % _ln_block_rows(rows, d) == 0
+
+
+register_override(
+    "layer_norm",
+    lambda args, attrs: _pallas_enabled() and _ln_supported(
+        args[0],
+        args[1] if len(args) > 1 else attrs.get("weight"),
+        args[2] if len(args) > 2 else attrs.get("bias"),
+        attrs.get("begin_norm_axis")),
+)(lambda x, weight=None, bias=None, epsilon=1e-5, begin_norm_axis=None:
+  fused_layer_norm(x, weight, bias, epsilon))
+
+
+# ===========================================================================
+# Fused AdamW update — one elementwise kernel for (p, m, v) (reference:
+# operators/optimizers/adam_op.cu / merged_adam)
+# ===========================================================================
+
+def _adamw_kernel(p_ref, g_ref, m_ref, v_ref, sc_ref,
+                  new_p_ref, new_m_ref, new_v_ref):
+    lr, b1, b2, eps, wd, bc1, bc2 = (sc_ref[0], sc_ref[1], sc_ref[2],
+                                     sc_ref[3], sc_ref[4], sc_ref[5],
+                                     sc_ref[6])
+    g = g_ref[...].astype(jnp.float32)
+    m = b1 * m_ref[...] + (1.0 - b1) * g
+    v = b2 * v_ref[...] + (1.0 - b2) * g * g
+    pf = p_ref[...].astype(jnp.float32)
+    mhat = m / bc1
+    vhat = v / bc2
+    new_p = pf - lr * (mhat / (jnp.sqrt(vhat) + eps) + wd * pf)
+    new_p_ref[...] = new_p.astype(new_p_ref.dtype)
+    new_m_ref[...] = m
+    new_v_ref[...] = v
+
+
+@functools.lru_cache(maxsize=1024)
+def _fused_adamw_callable(shape, dtype_name, interpret):
+    """One jitted (pad → kernel → unpad) callable per param shape/dtype —
+    the eager step hits this cache instead of re-tracing every call."""
+    dtype = jnp.dtype(dtype_name)
+    n = 1
+    for s in shape:
+        n *= s
+    lanes = 128
+    rows = max(1, (n + lanes - 1) // lanes)
+    pad = rows * lanes - n
+
+    def run(p, g, m, v, scalars):
+        def flat(a, dt):
+            a = a.reshape(-1).astype(dt)
+            if pad:
+                a = jnp.pad(a, (0, pad))
+            return a.reshape(rows, lanes)
+
+        new_p, new_m, new_v = pl.pallas_call(
+            _adamw_kernel,
+            in_specs=[pl.BlockSpec((rows, lanes), lambda: (0, 0)),
+                      pl.BlockSpec((rows, lanes), lambda: (0, 0)),
+                      pl.BlockSpec((rows, lanes), lambda: (0, 0)),
+                      pl.BlockSpec((rows, lanes), lambda: (0, 0)),
+                      pl.BlockSpec(memory_space=pltpu.SMEM)],
+            out_specs=[pl.BlockSpec((rows, lanes), lambda: (0, 0)),
+                       pl.BlockSpec((rows, lanes), lambda: (0, 0)),
+                       pl.BlockSpec((rows, lanes), lambda: (0, 0))],
+            out_shape=[jax.ShapeDtypeStruct((rows, lanes), dtype),
+                       jax.ShapeDtypeStruct((rows, lanes), jnp.float32),
+                       jax.ShapeDtypeStruct((rows, lanes), jnp.float32)],
+            interpret=interpret,
+        )(flat(p, dtype), flat(g, jnp.float32), flat(m, jnp.float32),
+          flat(v, jnp.float32), scalars)
+
+        def unflat(a, dt):
+            return a.reshape(-1)[:n].reshape(shape).astype(dt)
+
+        return (unflat(new_p, dtype), unflat(new_m, jnp.float32),
+                unflat(new_v, jnp.float32))
+
+    return jax.jit(run)
+
+
+def fused_adamw(p, g, m, v, lr, beta1, beta2, eps, weight_decay, step):
+    """Fused AdamW on a flattened parameter. Returns (new_p, new_m, new_v)."""
+    bc1 = 1.0 - beta1 ** step
+    bc2 = 1.0 - beta2 ** step
+    scalars = jnp.asarray([lr, beta1, beta2, eps, weight_decay, bc1, bc2],
+                          jnp.float32)
+    fn = _fused_adamw_callable(tuple(p.shape), jnp.dtype(p.dtype).name,
+                               _interpret())
+    return fn(p, g, m, v, scalars)
+
+
+def fused_adamw_available() -> bool:
+    return _pallas_enabled()

@@ -156,21 +156,25 @@ class Optimizer:
         if self._grad_clip is not None:
             params_grads = self._grad_clip(params_grads)
         self._step_count += 1
-        with no_grad_guard():
-            for p, g in params_grads:
-                self._current_param_name = p.name
-                self._current_param = p
-                lr = self.get_lr() * getattr(
-                    p, "optimize_attr", {}).get("learning_rate", 1.0)
-                g = self._decay_grad(p._data, g.astype(p._data.dtype)
-                                     if hasattr(g, "astype") else g)
-                slots = self._ensure_slots(p.name, p._data)
-                new_p, new_slots = self._apply_rule(p._data, g, slots, lr,
-                                                    self._step_count)
-                p._data = new_p
-                self._slots[p.name] = new_slots
-        self._current_param_name = None
-        self._current_param = None
+        self._use_fused = True  # eager path may take the Pallas kernel
+        try:
+            with no_grad_guard():
+                for p, g in params_grads:
+                    self._current_param_name = p.name
+                    self._current_param = p
+                    lr = self.get_lr() * getattr(
+                        p, "optimize_attr", {}).get("learning_rate", 1.0)
+                    g = self._decay_grad(p._data, g.astype(p._data.dtype)
+                                         if hasattr(g, "astype") else g)
+                    slots = self._ensure_slots(p.name, p._data)
+                    new_p, new_slots = self._apply_rule(
+                        p._data, g, slots, lr, self._step_count)
+                    p._data = new_p
+                    self._slots[p.name] = new_slots
+        finally:
+            self._use_fused = False
+            self._current_param_name = None
+            self._current_param = None
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
@@ -274,6 +278,9 @@ class Adam(Optimizer):
         self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
 
     def _rule(self, p, g, slots, lr, step):
+        fused = self._maybe_fused(p, g, slots, lr, step, wd=0.0)
+        if fused is not None:
+            return fused
         gf = g.astype(jnp.float32)
         m = self._beta1 * slots["moment1"] + (1 - self._beta1) * gf
         v = self._beta2 * slots["moment2"] + (1 - self._beta2) * gf * gf
@@ -283,6 +290,19 @@ class Adam(Optimizer):
         new_p = p.astype(jnp.float32) - lr * mhat / (
             jnp.sqrt(vhat) + self._eps)
         return new_p.astype(p.dtype), {"moment1": m, "moment2": v}
+
+    def _maybe_fused(self, p, g, slots, lr, step, wd):
+        """Eager step on TPU: one fused Pallas kernel per param (reference:
+        operators/optimizers/adam_op.cu / merged_adam multi-tensor path)."""
+        if not getattr(self, "_use_fused", False):
+            return None
+        from ..ops import pallas_kernels as pk
+        if not pk.fused_adamw_available():
+            return None
+        new_p, m, v = pk.fused_adamw(
+            p, g, slots["moment1"], slots["moment2"], lr,
+            self._beta1, self._beta2, self._eps, wd, step)
+        return new_p, {"moment1": m, "moment2": v}
 
     def _ensure_slots(self, name, value):
         if name not in self._slots:
@@ -320,6 +340,12 @@ class AdamW(Adam):
             self._apply_decay_param_fun(name)
 
     def _rule(self, p, g, slots, lr, step):
+        decay = self._wd_coeff if (
+            self._current_param_name is None or
+            self._wd_enabled(self._current_param_name)) else 0.0
+        fused = self._maybe_fused(p, g, slots, lr, step, wd=decay)
+        if fused is not None:
+            return fused
         gf = g.astype(jnp.float32)
         m = self._beta1 * slots["moment1"] + (1 - self._beta1) * gf
         v = self._beta2 * slots["moment2"] + (1 - self._beta2) * gf * gf
@@ -327,9 +353,6 @@ class AdamW(Adam):
         mhat = m / (1 - self._beta1 ** stepf)
         vhat = v / (1 - self._beta2 ** stepf)
         pf = p.astype(jnp.float32)
-        decay = self._wd_coeff if (
-            self._current_param_name is None or
-            self._wd_enabled(self._current_param_name)) else 0.0
         new_p = pf - lr * (mhat / (jnp.sqrt(vhat) + self._eps) + decay * pf)
         return new_p.astype(p.dtype), {"moment1": m, "moment2": v}
 
