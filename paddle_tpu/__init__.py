@@ -38,7 +38,7 @@ def _import_if_built(name):
 
 for _m in ("autograd", "optimizer", "amp", "io", "metric", "static", "jit",
            "vision", "distributed", "hapi", "parallel", "profiler",
-           "incubate", "models", "utils"):
+           "incubate", "models", "utils", "inference"):
     globals()[_m] = _import_if_built(_m) or globals().get(_m)
 
 if globals().get("static") is not None:

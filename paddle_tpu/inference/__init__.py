@@ -1,0 +1,122 @@
+"""``paddle.inference`` — the deployment/serving facade (L9).
+
+Reference analog: AnalysisPredictor + AnalysisConfig
+(paddle/fluid/inference/api/analysis_predictor.h, paddle_inference_api.h).
+TPU-native collapse (SURVEY §7): the reference's analysis passes (IR fusion,
+TRT subgraphs, memory reuse) are XLA's job; the predictor is a deserialized
+StableHLO artifact executed via PjRt. The AnalysisConfig surface keeps the
+reference's ergonomics where meaningful and records-but-ignores GPU/TRT
+switches that have no TPU analog.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .. import jit as _jit
+from ..framework.tensor import Tensor
+
+__all__ = ["Config", "Predictor", "create_predictor", "PredictorTensor"]
+
+
+class Config:
+    """Reference: AnalysisConfig (inference/api/analysis_config.cc)."""
+
+    def __init__(self, prog_file: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        # paddle convention: prog_file like /p/model.pdmodel
+        if prog_file and prog_file.endswith(".pdmodel"):
+            prog_file = prog_file[: -len(".pdmodel")]
+        self._prefix = prog_file
+        self._memory_pool_mb = 0
+        self._flags: Dict[str, object] = {}
+
+    def set_prog_file(self, p):
+        if p and p.endswith(".pdmodel"):
+            p = p[: -len(".pdmodel")]
+        self._prefix = p
+
+    def prog_file(self):
+        return (self._prefix or "") + ".pdmodel"
+
+    # GPU/TRT surface: recorded, inert on TPU (XLA owns these decisions)
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        self._flags["use_gpu"] = True
+
+    def disable_gpu(self):
+        self._flags["use_gpu"] = False
+
+    def enable_tensorrt_engine(self, **kwargs):
+        self._flags["tensorrt"] = kwargs
+
+    def switch_ir_optim(self, enable=True):
+        self._flags["ir_optim"] = enable
+
+    def enable_memory_optim(self):
+        self._flags["memory_optim"] = True
+
+
+class PredictorTensor:
+    """Zero-copy-style input/output handle (reference ZeroCopyTensor)."""
+
+    def __init__(self, name):
+        self.name = name
+        self._value = None
+
+    def copy_from_cpu(self, arr):
+        self._value = np.asarray(arr)
+
+    def copy_to_cpu(self):
+        return np.asarray(self._value)
+
+    def reshape(self, shape):
+        pass  # shapes are static in the exported artifact
+
+
+class Predictor:
+    """Reference: AnalysisPredictor::Run. Wraps a jit.load artifact."""
+
+    def __init__(self, config: Config):
+        if not config._prefix:
+            raise ValueError("Config needs the model path prefix")
+        self._layer = _jit.load(config._prefix)
+        self._input_names = self._layer.input_names
+        self._inputs: Dict[str, PredictorTensor] = {
+            n: PredictorTensor(n) for n in self._input_names}
+        self._outputs: List[np.ndarray] = []
+
+    def get_input_names(self):
+        return list(self._input_names)
+
+    def get_input_handle(self, name) -> PredictorTensor:
+        return self._inputs[name]
+
+    def run(self, inputs: Optional[List[np.ndarray]] = None):
+        if inputs is None:
+            unset = [n for n in self._input_names
+                     if self._inputs[n]._value is None]
+            if unset:
+                raise ValueError(
+                    f"input(s) {unset} were never set — call "
+                    f"get_input_handle(name).copy_from_cpu(arr) first")
+            inputs = [self._inputs[n].copy_to_cpu()
+                      for n in self._input_names]
+        out = self._layer(*inputs)
+        flat = out if isinstance(out, (list, tuple)) else [out]
+        self._outputs = [o.numpy() if isinstance(o, Tensor) else
+                         np.asarray(o) for o in flat]
+        return self._outputs
+
+    def get_output_names(self):
+        return [f"output_{i}" for i in range(len(self._outputs))]
+
+    def get_output_handle(self, name) -> PredictorTensor:
+        idx = int(name.split("_")[-1])
+        t = PredictorTensor(name)
+        t._value = self._outputs[idx]
+        return t
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

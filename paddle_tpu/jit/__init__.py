@@ -1,0 +1,302 @@
+"""``paddle.jit`` — to_static, save, load.
+
+Reference analogs: ``@to_static`` + ProgramTranslator
+(python/paddle/fluid/dygraph/jit.py:163, dygraph_to_static/), the C++ jit
+Layer/serializer (paddle/fluid/jit/layer.h, serializer.cc) and
+``save_inference_model`` round-trips (python/paddle/fluid/io.py).
+
+TPU-native stance (SURVEY §7): the AST-rewriting translator collapses —
+jax tracing IS the dy2static transform. ``to_static`` wraps a callable in a
+jit-compiled bridge; ``jit.save`` exports the traced function as a
+serialized StableHLO artifact (via jax.export) with parameters baked in,
+plus a separate ``.pdiparams`` state-dict for weight interchange;
+``jit.load`` deserializes into a TranslatedLayer-shaped predictor that runs
+through PjRt with no Python model code.
+
+Artifact layout for ``jit.save(layer, "/p/model")``:
+  /p/model.pdmodel    — serialized jax.export artifact (StableHLO)
+  /p/model.pdiparams  — pickled state_dict (framework.io.save)
+  /p/model.meta.json  — input specs + framework version
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from ..framework import io as _io
+from ..framework.tensor import Tensor, no_grad_guard
+from ..static import InputSpec
+
+__all__ = ["to_static", "save", "load", "TranslatedLayer", "not_to_static"]
+
+_FORMAT_VERSION = 1
+
+
+def _leaf_is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def _unwrap_tree(out):
+    return jax.tree_util.tree_map(
+        lambda t: t._data if isinstance(t, Tensor) else t, out,
+        is_leaf=_leaf_is_tensor)
+
+
+def _wrap_tree(out):
+    return jax.tree_util.tree_map(
+        lambda a: Tensor(a, stop_gradient=True), out)
+
+
+def _make_raw(fn, training=False):
+    """arrays -> arrays bridge around a Tensor-level callable; parameters
+    referenced by the callable become trace constants (inference export)."""
+
+    def raw(*arrays):
+        with no_grad_guard():
+            ins = [Tensor(a, stop_gradient=True) for a in arrays]
+            out = fn(*ins)
+        return _unwrap_tree(out)
+
+    return raw
+
+
+class StaticFunction:
+    """The ``@to_static`` wrapper: eager-looking call, jit-compiled body
+    (reference: dygraph_to_static/program_translator.py StaticFunction).
+
+    Layer-bound instances pass the parameter tree as TRACED INPUTS every
+    call (no stale-weight baking after optimizer.step), and fall back to
+    the eager tape whenever gradients are enabled on the params — so
+    training through a to_static model stays correct, matching the
+    reference's train-capable to_static."""
+
+    def __init__(self, function, input_spec=None, layer=None):
+        self._fn = function
+        self._layer = layer
+        self.input_spec = list(input_spec) if input_spec else None
+        self._compiled = None
+        self.__name__ = getattr(function, "__name__", "forward")
+
+    def _get_compiled(self):
+        if self._compiled is None:
+            if self._layer is not None:
+                from ..nn.layer.layers import functional_state
+
+                def raw(params, *arrays):
+                    with no_grad_guard():
+                        ins = [Tensor(a, stop_gradient=True)
+                               for a in arrays]
+                        # call the ORIGINAL forward (self._fn) — the
+                        # layer's .forward is this StaticFunction now
+                        with functional_state(self._layer, params, {}):
+                            out = self._fn(*ins)
+                    return _unwrap_tree(out)
+
+                self._compiled = jax.jit(raw)
+            else:
+                self._compiled = jax.jit(_make_raw(self._fn))
+        return self._compiled
+
+    def _needs_eager(self):
+        from ..framework.tensor import is_grad_enabled
+        if self._layer is None:
+            return False
+        return is_grad_enabled() and any(
+            not p.stop_gradient for p in self._layer.parameters())
+
+    def __call__(self, *args):
+        if self._needs_eager():
+            return self._fn(*args)  # training: run on the tape
+        arrays = [a._data if isinstance(a, Tensor) else np.asarray(a)
+                  for a in args]
+        if self._layer is not None:
+            from ..nn.layer.layers import get_params_tree
+            out = self._get_compiled()(get_params_tree(self._layer),
+                                       *arrays)
+        else:
+            out = self._get_compiled()(*arrays)
+        return _wrap_tree(out)
+
+    # reference-parity introspection hooks
+    @property
+    def concrete_program(self):
+        return self._get_compiled()
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, **kwargs):
+    """Decorator/wrapper converting a dygraph callable into a compiled
+    StaticFunction (reference fluid/dygraph/jit.py:163)."""
+
+    def deco(fn):
+        # Layer: compile its forward, keep the layer callable
+        from ..nn.layer.layers import Layer
+        if isinstance(fn, Layer):
+            sf = StaticFunction(fn.forward, input_spec, layer=fn)
+            fn.forward = sf
+            return fn
+        return StaticFunction(fn, input_spec)
+
+    if function is not None:
+        return deco(function)
+    return deco
+
+
+def not_to_static(fn):
+    """Marker no-op (reference jit.not_to_static) — everything traces."""
+    return fn
+
+
+def _resolve_specs(input_spec, example_inputs=None):
+    specs = []
+    for s in (input_spec or []):
+        if isinstance(s, InputSpec):
+            specs.append(s)
+        elif isinstance(s, Tensor):
+            specs.append(InputSpec.from_tensor(s))
+        else:
+            a = np.asarray(s)
+            specs.append(InputSpec(a.shape, str(a.dtype)))
+    return specs
+
+
+def save(layer, path, input_spec=None, **configs):
+    """Export ``layer`` (or a StaticFunction / plain callable) for
+    inference. Reference: jit.save -> TorchScript-like program+params
+    (fluid/dygraph/jit.py, fluid/jit/serializer.cc)."""
+    from ..nn.layer.layers import Layer
+
+    if isinstance(layer, Layer):
+        was_training = layer.training
+        layer.eval()
+        fn = layer.forward
+        fn = fn._fn if isinstance(fn, StaticFunction) else fn
+        if input_spec is None and isinstance(layer.forward, StaticFunction):
+            input_spec = layer.forward.input_spec
+        state = layer.state_dict()
+    elif isinstance(layer, StaticFunction):
+        was_training = None
+        fn = layer._fn
+        input_spec = input_spec or layer.input_spec
+        state = {}
+    else:
+        was_training = None
+        fn = layer
+        state = {}
+    try:
+        if not input_spec:
+            raise ValueError(
+                "jit.save needs input_spec=[InputSpec(shape, dtype), ...] "
+                "(or example Tensors) to trace the export")
+        specs = _resolve_specs(input_spec)
+        avals = _export_avals(specs)
+
+        raw = _make_raw(fn)
+        exported = None
+        errors = []
+        for platforms in (("cpu", "tpu"), None):
+            try:
+                e = jax.export.export(jax.jit(raw)) if platforms is None \
+                    else jax.export.export(jax.jit(raw),
+                                           platforms=platforms)
+                exported = e(*avals)
+                break
+            except Exception as exc:  # multi-platform/symbolic unsupported
+                errors.append(exc)
+        if exported is None:
+            # final fallback: static shapes (-1 -> 1), current platform
+            exported = jax.export.export(jax.jit(raw))(
+                *[s.to_aval() for s in specs])
+
+        os.makedirs(os.path.dirname(os.path.abspath(path)) or ".",
+                    exist_ok=True)
+        with open(path + ".pdmodel", "wb") as f:
+            f.write(exported.serialize())
+        _io.save(state, path + ".pdiparams")
+        meta = {
+            "format_version": _FORMAT_VERSION,
+            "platforms": list(exported.platforms),
+            "input_specs": [{"shape": list(s.shape),
+                             "dtype": np.dtype(s.dtype).name,
+                             "name": s.name} for s in specs],
+        }
+        with open(path + ".meta.json", "w") as f:
+            json.dump(meta, f)
+    finally:
+        if was_training:
+            layer.train()
+    return path
+
+
+def _export_avals(specs):
+    """ShapeDtypeStructs for export; -1/None dims become jax.export
+    symbolic dims so the artifact accepts any size there (dynamic batch)."""
+    avals = []
+    for i, s in enumerate(specs):
+        if any(d in (-1, None) for d in s.shape):
+            names = ", ".join(
+                f"d{i}_{j}" if d in (-1, None) else str(d)
+                for j, d in enumerate(s.shape))
+            shape = jax.export.symbolic_shape(names)
+        else:
+            shape = s.shape
+        avals.append(jax.ShapeDtypeStruct(shape, s.dtype))
+    return avals
+
+
+class TranslatedLayer:
+    """A loaded inference program (reference: TranslatedLayer of jit.load /
+    the C++ jit::Layer). Callable on Tensors/arrays; no Python model code
+    involved — execution is the deserialized StableHLO via PjRt."""
+
+    def __init__(self, exported, state, meta):
+        self._exported = exported
+        self._state = state
+        self._meta = meta
+        self.training = False
+
+    def __call__(self, *args):
+        arrays = [a._data if isinstance(a, Tensor) else np.asarray(a)
+                  for a in args]
+        out = self._exported.call(*arrays)
+        return _wrap_tree(out)
+
+    forward = __call__
+
+    def state_dict(self):
+        return self._state
+
+    def eval(self):
+        self.training = False
+        return self
+
+    @property
+    def input_specs(self) -> List[dict]:
+        return self._meta.get("input_specs", [])
+
+    @property
+    def input_names(self) -> List[str]:
+        return [s.get("name") or f"input_{i}"
+                for i, s in enumerate(self.input_specs)]
+
+    @property
+    def platforms(self):
+        return tuple(self._meta.get("platforms", ()))
+
+
+def load(path, **configs) -> TranslatedLayer:
+    """Load a ``jit.save`` artifact into a runnable predictor."""
+    with open(path + ".pdmodel", "rb") as f:
+        exported = jax.export.deserialize(f.read())
+    state = {}
+    if os.path.exists(path + ".pdiparams"):
+        state = _io.load(path + ".pdiparams")
+    meta = {}
+    if os.path.exists(path + ".meta.json"):
+        with open(path + ".meta.json") as f:
+            meta = json.load(f)
+    return TranslatedLayer(exported, state, meta)

@@ -294,6 +294,10 @@ def flash_attention(q, k, v, is_causal=False, scale=None,
     s = scale if scale is not None else 1.0 / math.sqrt(d)
     block_q = min(block_q, sq)
     block_k = min(block_k, sk)
+    if sq % block_q or sk % block_k:
+        raise ValueError(
+            f"flash_attention needs seq lengths divisible by the block "
+            f"sizes: sq={sq} %% {block_q}, sk={sk} %% {block_k}")
     # [B,S,H,D] -> [B*H, S, D]
     qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
     kt = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
@@ -445,6 +449,11 @@ def fused_layer_norm(x, weight, bias, epsilon=1e-5):
     """LayerNorm over the last axis with affine params, as one Pallas
     kernel per row-block (reference: fused LN in fused_dropout_helper.h)."""
     x2, rows, d = _ln_reshape(x)
+    br = _ln_block_rows(rows, d)
+    if rows % br:
+        raise ValueError(
+            f"fused_layer_norm needs total rows ({rows}) divisible by the "
+            f"row block ({br})")
     b = bias if bias is not None else jnp.zeros((d,), x.dtype)
     out = _fused_layer_norm_2d(x2, weight, b, float(epsilon))
     return out.reshape(x.shape)

@@ -24,7 +24,8 @@ from ..framework.tensor import Tensor
 __all__ = ["enable_static", "disable_static", "in_dynamic_mode",
            "InputSpec", "Program", "program_guard", "default_main_program",
            "default_startup_program", "Executor", "data", "name_scope",
-           "cpu_places", "device_guard"]
+           "cpu_places", "device_guard", "save_inference_model",
+           "load_inference_model"]
 
 _mode = threading.local()
 
@@ -144,3 +145,24 @@ class Executor:
                     for o in (out if isinstance(out, (list, tuple))
                               else [out])]
         return out
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **kwargs):
+    """Reference: static.save_inference_model (fluid/io.py) — here wired
+    onto jit.save's StableHLO artifact. ``fetch_vars`` may be a Layer or a
+    callable producing the fetches from the feeds."""
+    from .. import jit as _jit
+    target = program if program is not None else fetch_vars
+    specs = [v if isinstance(v, InputSpec) else InputSpec.from_tensor(v)
+             for v in (feed_vars if isinstance(feed_vars, (list, tuple))
+                       else [feed_vars])]
+    return _jit.save(target, path_prefix, input_spec=specs)
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    """Returns (predictor, feed_names, fetch_names) — reference signature
+    (program, feed_target_names, fetch_targets)."""
+    from .. import jit as _jit
+    layer = _jit.load(path_prefix)
+    return layer, layer.input_names, None
