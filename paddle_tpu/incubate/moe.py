@@ -7,9 +7,13 @@ Analog of the reference's ``MoELayer``
 
 TPU-native (GShard-style): token→expert routing is expressed as dense
 einsum dispatch/combine against a capacity-bounded one-hot mask — static
-shapes, MXU-friendly. With the expert dimension sharded over the "expert"
-mesh axis, GSPMD lowers the dispatch einsum to exactly the all-to-all the
-reference implements by hand; on one device it is a plain batched matmul.
+shapes, MXU-friendly. When the global mesh has an "expert" axis that
+divides both the token count and the expert count, dispatch runs through
+an EXPLICIT shard_map + lax.all_to_all exchange with per-shard capacity
+(_forward_expert_parallel — the analog of global_scatter/global_gather);
+otherwise the dense single-shard einsum path is the fallback, with GLOBAL
+capacity semantics. The two paths agree whenever capacity is generous
+enough that no tokens drop.
 """
 from __future__ import annotations
 
@@ -112,21 +116,13 @@ class MoELayer(nn.Layer):
             NaiveGate(d_model, num_experts, topk=topk)
         self.l_aux = None
 
-    def forward(self, x):
+    def _route(self, probs_a, cap):
+        """GShard top-k routing with capacity: probs [S, E] ->
+        (dispatch [S,E,C], combine [S,E,C], me [E], ce [E])."""
         import jax
         import jax.numpy as jnp
 
-        b, l, d = x.shape
-        s = b * l
-        e = self.num_experts
-        cap = max(1, int(math.ceil(s / e * self.capacity_factor)))
-
-        tokens = call_op("reshape", x, shape=(s, d))
-        logits = self.gate(tokens)  # [S, E]
-        probs = F.softmax(logits, axis=-1)
-
-        probs_a = probs._data
-        # top-k assignment with capacity via cumsum position (GShard):
+        s, e = probs_a.shape
         topv, topi = jax.lax.top_k(probs_a, self.topk)       # [S, K]
         onehot = jax.nn.one_hot(topi, e, dtype=probs_a.dtype)  # [S, K, E]
         # position of each token within its expert queue, k-major order
@@ -138,39 +134,127 @@ class MoELayer(nn.Layer):
         denom = jnp.maximum(gates.sum(-1, keepdims=True), 1e-9)
         gates = gates / denom
         cap_oh = jax.nn.one_hot(
-            jnp.where(keep, pos, cap), cap + 1,
+            jnp.where(keep, pos, cap).astype(jnp.int32), cap + 1,
             dtype=probs_a.dtype)[..., :cap]                  # [S, K, C]
-        # dispatch/combine tensors
-        dispatch = jnp.einsum("ske,skc->sec", onehot,
-                              cap_oh)                        # [S, E, C]
+        dispatch = jnp.einsum("ske,skc->sec", onehot, cap_oh)
         combine = jnp.einsum("sk,ske,skc->sec", gates, onehot, cap_oh)
-
-        # load-balance aux loss (reference moe grad path / GShard eq.4)
+        # load-balance aux terms (reference moe grad path / GShard eq.4)
         me = probs_a.mean(0)                                  # [E]
         ce = onehot[:, 0].mean(0)                             # top-1 share
-        self.l_aux = Tensor(jnp.sum(me * ce) * e)
+        return dispatch, combine, me, ce
 
-        expert_in = jnp.einsum("sd,sec->ecd", tokens._data, dispatch)
-        expert_in = constrain(expert_in, "expert", None, None)
-
-        # batched expert apply via vmap over stacked weights
-        pdict = {n: getattr(self,
-                            "expert_" + n.replace(".", "_"))._data
-                 for n in self._expert_param_names}
-        tmpl = self._expert_template
+    def _one_expert_fn(self):
         from ..nn.layer.layers import functional_state
+        tmpl = self._expert_template
+        names = self._expert_param_names
 
         def one_expert(pvals, xe):
-            pj = dict(zip(self._expert_param_names, pvals))
+            pj = dict(zip(names, pvals))
             with functional_state(tmpl, pj, {}):
                 return tmpl(Tensor(xe, stop_gradient=True))._data
 
-        expert_out = jax.vmap(one_expert, in_axes=(0, 0))(
-            [pdict[n] for n in self._expert_param_names], expert_in)
-        expert_out = constrain(expert_out, "expert", None, None)
+        return one_expert
 
+    def forward(self, x):
+        import jax
+        import jax.numpy as jnp
+        from ..distributed import env as _env
+
+        b, l, d = x.shape
+        s = b * l
+        e = self.num_experts
+
+        tokens = call_op("reshape", x, shape=(s, d))
+        logits = self.gate(tokens)  # [S, E]
+        probs = F.softmax(logits, axis=-1)
+        probs_a = probs._data
+
+        pdict = {n: getattr(self,
+                            "expert_" + n.replace(".", "_"))._data
+                 for n in self._expert_param_names}
+        pvals = [pdict[n] for n in self._expert_param_names]
+        one_expert = self._one_expert_fn()
+
+        mesh = _env.get_mesh()
+        ep = int(mesh.shape.get("expert", 1)) if mesh is not None else 1
+        if ep > 1:
+            if s % ep == 0 and e % ep == 0:
+                out, l_aux = self._forward_expert_parallel(
+                    tokens._data, probs_a, pvals, one_expert, mesh, ep)
+                self.l_aux = Tensor(l_aux)
+                return Tensor(out.reshape(b, l, d), stop_gradient=False)
+            if not getattr(self, "_warned_dense_fallback", False):
+                import warnings
+                warnings.warn(
+                    f"MoELayer: expert mesh axis degree {ep} does not "
+                    f"divide tokens={s} / experts={e}; falling back to "
+                    f"dense dispatch with GLOBAL capacity — routing "
+                    f"semantics differ from the expert-parallel path")
+                self._warned_dense_fallback = True
+
+        # single-shard (dense-dispatch) path
+        cap = max(1, int(math.ceil(s / e * self.capacity_factor)))
+        dispatch, combine, me, ce = self._route(probs_a, cap)
+        self.l_aux = Tensor(jnp.sum(me * ce) * e)
+        expert_in = jnp.einsum("sd,sec->ecd", tokens._data, dispatch)
+        expert_in = constrain(expert_in, "expert", None, None)
+        expert_out = jax.vmap(one_expert, in_axes=(0, 0))(pvals, expert_in)
+        expert_out = constrain(expert_out, "expert", None, None)
         out = jnp.einsum("ecd,sec->sd", expert_out, combine)
         # NOTE: routing math runs on raw arrays — differentiable under the
         # functional/jit train path (the only path MoE training uses); the
         # eager tape does not record it.
         return Tensor(out.reshape(b, l, d), stop_gradient=False)
+
+    def _forward_expert_parallel(self, tokens, probs, pvals, one_expert,
+                                 mesh, ep):
+        """Expert-parallel dispatch via explicit all_to_all over the
+        "expert" mesh axis (reference: global_scatter/global_gather,
+        operators/collective/global_scatter_op.cc — here the exchange is
+        a lax.all_to_all inside shard_map riding ICI).
+
+        Tokens are sharded over the expert axis; each shard routes its
+        local tokens with LOCAL capacity, all-to-alls the per-expert
+        slices to the experts' owners, applies its resident experts, and
+        reverses the exchange.
+        """
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+        try:
+            from jax import shard_map
+        except ImportError:  # older jax
+            from jax.experimental.shard_map import shard_map
+
+        s, d = tokens.shape
+        e = self.num_experts
+        s_local = s // ep
+        cap_l = max(1, int(math.ceil(s_local / e * self.capacity_factor)))
+
+        def local_fn(tokens_l, probs_l, *pvals_l):
+            dispatch, combine, me, ce = self._route(probs_l, cap_l)
+            # aux loss over ALL tokens: shards are equal-sized, so the
+            # global mean is the mean of shard means
+            me_g = jax.lax.pmean(me, "expert")
+            ce_g = jax.lax.pmean(ce, "expert")
+            l_aux = jnp.sum(me_g * ce_g) * e
+            expert_in = jnp.einsum("sd,sec->ecd", tokens_l, dispatch)
+            # [E, C, D] -> [E/ep, ep*C, D]: expert slices travel to their
+            # owner; capacity slots from every source shard concatenate
+            expert_in = jax.lax.all_to_all(
+                expert_in, "expert", split_axis=0, concat_axis=1,
+                tiled=True)
+            expert_out = jax.vmap(one_expert, in_axes=(0, 0))(
+                list(pvals_l), expert_in)
+            expert_out = jax.lax.all_to_all(
+                expert_out, "expert", split_axis=1, concat_axis=0,
+                tiled=True)                                   # [E, C, D]
+            out_l = jnp.einsum("ecd,sec->sd", expert_out, combine)
+            return out_l, l_aux
+
+        in_specs = (P("expert"), P("expert"),
+                    *([P("expert")] * len(pvals)))
+        out, l_aux = shard_map(
+            local_fn, mesh=mesh, in_specs=in_specs,
+            out_specs=(P("expert"), P()))(tokens, probs, *pvals)
+        return out, l_aux

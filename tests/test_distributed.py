@@ -254,6 +254,30 @@ class TestMoE:
         assert out.shape == [2, 8, 16]
         assert moe.l_aux is not None and np.isfinite(float(moe.l_aux))
 
+        # the expert-parallel path must (a) match the dense-dispatch path
+        # when capacity is generous, (b) actually contain an all_to_all
+        moe.capacity_factor = 4.0
+        ep_out = moe(x).numpy()
+        ep_aux = float(moe.l_aux)
+        mesh = env.get_mesh()
+        env.set_mesh(None)  # dense single-shard path
+        dense_out = moe(x).numpy()
+        dense_aux = float(moe.l_aux)
+        env.set_mesh(mesh)
+        np.testing.assert_allclose(ep_out, dense_out, atol=1e-5, rtol=1e-5)
+        np.testing.assert_allclose(ep_aux, dense_aux, rtol=1e-5)
+
+        import jax
+        from paddle_tpu.nn.layer.layers import functional_call, \
+            get_params_tree
+
+        def fwd(params, arr):
+            out, _ = functional_call(moe, params, {}, paddle.to_tensor(arr))
+            return out._data
+
+        jaxpr = str(jax.make_jaxpr(fwd)(get_params_tree(moe), x.numpy()))
+        assert "all_to_all" in jaxpr, "expert dispatch is not an alltoall"
+
         # functional training step over the mesh: loss decreases
         from paddle_tpu.distributed.spmd import ParallelEngine
         opt = paddle.optimizer.AdamW(learning_rate=1e-2,
