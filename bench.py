@@ -89,9 +89,13 @@ def bench_gpt2():
     n_warm, n_steps = (1, 2) if _smoke() else (2, 10)
     dt = _timeit(lambda: eng.train_step([ids], [ids]), n_warm, n_steps)
     tokens_per_sec = batch * seq * n_steps / dt
-    out = {"metric": "gpt2_124m_train_tokens_per_sec",
+    # config 5 proper is dp×mp over v5e-8; this hardware exposes ONE chip,
+    # so the measured mesh is dp=1 — the mp dimension is validated by the
+    # driver's CPU dryrun only. Say so in the JSON (r2 verdict weak #10).
+    out = {"metric": "gpt2_124m_train_tokens_per_sec_1chip_dp1",
            "value": round(tokens_per_sec, 1), "unit": "tokens/sec",
            "n_params": n_params, "batch": batch, "seq": seq,
+           "mesh": "data=1 (single chip; dpxmp dryrun-validated only)",
            "device_kind": _device_kind()}
     peak = _peak_flops(out["device_kind"])
     if peak:
@@ -284,8 +288,11 @@ def main():
     if headline is None:
         headline = {"metric": "bench_failed", "value": 0.0, "unit": "none"}
 
+    # vs_baseline: the reference publishes NO benchmark numbers
+    # (BASELINE.md — BASELINE.json.published is {}), so there is no real
+    # ratio to compute; null is the honest value (r2 verdict weak #4).
     out = {"metric": headline["metric"], "value": headline["value"],
-           "unit": headline["unit"], "vs_baseline": 1.0,
+           "unit": headline["unit"], "vs_baseline": None,
            "extras": results}
     if "mfu" in headline:
         out["mfu"] = headline["mfu"]

