@@ -6,6 +6,19 @@ North-star configs measured (BASELINE.md):
   bert     — config 3: BERT-base QA fine-tune step, AMP O2 bf16, steps/sec
   lenet    — config 1: LeNet/MNIST Model.fit train_batch, imgs/sec
 
+Measurement discipline (r2 verdict items 3/4/5):
+  * data is device-resident — transferred once, reused every step (the r2
+    bench re-uploaded the same numpy batch every step: 449 ms/step H2D);
+  * steps run through the ASYNC engine path (device-scalar loss, fetch
+    once at the end) so jax pipelines the chip instead of blocking on a
+    35-70 ms host round-trip per step;
+  * the Pallas smoke gate runs before each model bench; a kernel that
+    cannot lower on this chip flips the tier off instead of crashing the
+    bench, and the on/off state is recorded per result;
+  * gpt2/bert additionally record a with/without-Pallas delta;
+  * vs_baseline is null — the reference publishes no benchmark numbers
+    (BASELINE.md), so there is no honest ratio to compute.
+
 Robustness contract (r1 verdict item 1b): the parent process NEVER imports
 jax — each benchmark runs in a subprocess with a timeout; a backend-init
 hang or crash costs one bench, not the round. On total TPU failure the
@@ -40,15 +53,6 @@ def _peak_flops(device_kind: str):
     return None
 
 
-def _timeit(step_fn, n_warmup, n_steps):
-    for _ in range(n_warmup):
-        step_fn()
-    t0 = time.perf_counter()
-    for _ in range(n_steps):
-        step_fn()
-    return time.perf_counter() - t0
-
-
 def _device_kind():
     import jax
     return jax.devices()[0].device_kind
@@ -56,6 +60,51 @@ def _device_kind():
 
 def _smoke():
     return os.environ.get("PADDLE_BENCH_SMOKE") == "1"
+
+
+def _no_pallas():
+    return os.environ.get("PADDLE_BENCH_NO_PALLAS") == "1"
+
+
+def _setup_pallas():
+    """Disable the tier if asked; otherwise run the TPU smoke gate so a
+    broken kernel degrades instead of crashing. Returns the state dict
+    recorded in every result."""
+    from paddle_tpu.framework.flags import flag_value, set_flags
+    from paddle_tpu.ops import pallas_smoke
+
+    if _no_pallas():
+        set_flags({"FLAGS_use_pallas": False})
+        return {"pallas": False, "reason": "disabled by request"}
+    ok = pallas_smoke.ensure()
+    state = {"pallas": bool(flag_value("FLAGS_use_pallas"))}
+    rep = pallas_smoke.last_report()
+    if rep is not None and not ok:
+        state["smoke_failures"] = {
+            k: v for k, v in rep["kernels"].items() if v != "ok"}
+    return state
+
+
+def _timeit_async(step_fn, n_warmup, n_steps):
+    """Time n_steps of an async step fn (returns a device scalar),
+    blocking only on the last value. Returns (dt, last_loss_float).
+
+    The barrier is a VALUE fetch (float) of the last loss, not
+    jax.block_until_ready — through the remote-TPU relay the latter can
+    return before the dependency chain has executed, which would inflate
+    throughput by >20x. The value of loss N requires params from step
+    N-1, so fetching it bounds all queued work; the one scalar D2H
+    (~50 ms) amortizes over the measured steps."""
+    last = None
+    for _ in range(n_warmup):
+        last = step_fn()
+    float(last)
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        last = step_fn()
+    last_val = float(last)
+    dt = time.perf_counter() - t0
+    return dt, last_val
 
 
 # ---------------------------------------------------------------------------
@@ -70,6 +119,7 @@ def bench_gpt2():
     from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining
     from paddle_tpu.optimizer import AdamW
 
+    pallas_state = _setup_pallas()
     if _smoke():
         cfg, batch, seq = GPTConfig.tiny(), 2, 32
     else:
@@ -84,10 +134,14 @@ def bench_gpt2():
     eng = ParallelEngine(model, opt, loss_fn=None, mesh=denv.get_mesh())
     rng = np.random.RandomState(0)
     ids = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+    (dev_ids,), (dev_lbl,) = eng.device_put_batch([ids], [ids])
 
     n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
-    n_warm, n_steps = (1, 2) if _smoke() else (2, 10)
-    dt = _timeit(lambda: eng.train_step([ids], [ids]), n_warm, n_steps)
+    n_warm, n_steps = (1, 2) if _smoke() else (5, 20)
+    dt, last_loss = _timeit_async(
+        lambda: eng.train_step_async([dev_ids], [dev_lbl]),
+        n_warm, n_steps)
+    assert np.isfinite(last_loss), f"non-finite loss {last_loss}"
     tokens_per_sec = batch * seq * n_steps / dt
     # config 5 proper is dp×mp over v5e-8; this hardware exposes ONE chip,
     # so the measured mesh is dp=1 — the mp dimension is validated by the
@@ -95,8 +149,9 @@ def bench_gpt2():
     out = {"metric": "gpt2_124m_train_tokens_per_sec_1chip_dp1",
            "value": round(tokens_per_sec, 1), "unit": "tokens/sec",
            "n_params": n_params, "batch": batch, "seq": seq,
+           "loss": round(last_loss, 4),
            "mesh": "data=1 (single chip; dpxmp dryrun-validated only)",
-           "device_kind": _device_kind()}
+           "device_kind": _device_kind(), **pallas_state}
     peak = _peak_flops(out["device_kind"])
     if peak:
         out["mfu"] = round(6.0 * n_params * tokens_per_sec / peak, 4)
@@ -107,29 +162,39 @@ def bench_resnet50():
     import numpy as np
     import paddle_tpu as paddle
     import paddle_tpu.nn as nn
+    from paddle_tpu import amp
     from paddle_tpu.distributed import env as denv
     from paddle_tpu.distributed.spmd import ParallelEngine
     from paddle_tpu.optimizer import Momentum
     from paddle_tpu.vision.models import resnet50
 
-    batch, hw = (4, 32) if _smoke() else (64, 224)
+    pallas_state = _setup_pallas()
+    batch, hw = (4, 32) if _smoke() else (128, 224)
     paddle.framework.random.seed(0)
     model = resnet50(num_classes=1000)
+    # bf16 AMP O2 on a bf16-first chip (r2 verdict item 3); master weights
+    # stay fp32 in the optimizer
+    amp.decorate(model, level="O2", dtype="bfloat16")
     opt = Momentum(learning_rate=0.1, momentum=0.9,
-                   parameters=model.parameters())
+                   parameters=model.parameters(), multi_precision=True)
     denv.build_mesh({"data": 1})
     eng = ParallelEngine(model, opt, loss_fn=nn.CrossEntropyLoss(),
                          mesh=denv.get_mesh())
     rng = np.random.RandomState(0)
     x = rng.randn(batch, 3, hw, hw).astype(np.float32)
     y = rng.randint(0, 1000, (batch, 1)).astype(np.int64)
+    (dev_x,), (dev_y,) = eng.device_put_batch([x], [y])
 
-    n_warm, n_steps = (1, 2) if _smoke() else (2, 20)
-    dt = _timeit(lambda: eng.train_step([x], [y]), n_warm, n_steps)
+    n_warm, n_steps = (1, 2) if _smoke() else (5, 30)
+    dt, last_loss = _timeit_async(
+        lambda: eng.train_step_async([dev_x], [dev_y]), n_warm, n_steps)
+    assert np.isfinite(last_loss), f"non-finite loss {last_loss}"
     imgs_per_sec = batch * n_steps / dt
     out = {"metric": "resnet50_train_imgs_per_sec",
            "value": round(imgs_per_sec, 1), "unit": "imgs/sec",
-           "batch": batch, "device_kind": _device_kind()}
+           "batch": batch, "dtype": "bf16_amp_o2",
+           "loss": round(last_loss, 4),
+           "device_kind": _device_kind(), **pallas_state}
     peak = _peak_flops(out["device_kind"])
     if peak and hw == 224:
         # ~4.09 GFLOPs/img fwd at 224px; train ~= 3x fwd
@@ -146,6 +211,7 @@ def bench_bert():
     from paddle_tpu.models.bert import BertConfig, BertForQuestionAnswering
     from paddle_tpu.optimizer import AdamW
 
+    pallas_state = _setup_pallas()
     if _smoke():
         cfg = BertConfig(vocab_size=256, hidden_size=64,
                          num_hidden_layers=2, num_attention_heads=4,
@@ -180,16 +246,19 @@ def bench_bert():
     ids = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
     start = rng.randint(0, seq, (batch,)).astype(np.int64)
     end = rng.randint(0, seq, (batch,)).astype(np.int64)
+    (dev_ids,), (dev_s, dev_e) = eng.device_put_batch([ids], [start, end])
 
     n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
-    n_warm, n_steps = (1, 2) if _smoke() else (2, 15)
-    dt = _timeit(lambda: eng.train_step([ids], [start, end]),
-                 n_warm, n_steps)
+    n_warm, n_steps = (1, 2) if _smoke() else (5, 30)
+    dt, last_loss = _timeit_async(
+        lambda: eng.train_step_async([dev_ids], [dev_s, dev_e]),
+        n_warm, n_steps)
+    assert np.isfinite(last_loss), f"non-finite loss {last_loss}"
     steps_per_sec = n_steps / dt
     out = {"metric": "bert_base_amp_o2_steps_per_sec",
            "value": round(steps_per_sec, 3), "unit": "steps/sec",
-           "batch": batch, "seq": seq,
-           "device_kind": _device_kind()}
+           "batch": batch, "seq": seq, "loss": round(last_loss, 4),
+           "device_kind": _device_kind(), **pallas_state}
     peak = _peak_flops(out["device_kind"])
     if peak:
         out["mfu"] = round(
@@ -203,20 +272,26 @@ def bench_lenet():
     import paddle_tpu.nn as nn
     from paddle_tpu.vision.models import LeNet
 
+    pallas_state = _setup_pallas()
     batch = 256
     model = paddle.Model(LeNet())
     opt = paddle.optimizer.Adam(learning_rate=1e-3,
                                 parameters=model.network.parameters())
     model.prepare(opt, nn.CrossEntropyLoss())
     rng = np.random.RandomState(0)
-    x = rng.randn(batch, 1, 28, 28).astype(np.float32)
-    y = rng.randint(0, 10, (batch, 1)).astype(np.int64)
+    import jax
+    x = jax.device_put(rng.randn(batch, 1, 28, 28).astype(np.float32))
+    y = jax.device_put(rng.randint(0, 10, (batch, 1)).astype(np.int64))
 
-    n_warm, n_steps = (1, 3) if _smoke() else (3, 30)
-    dt = _timeit(lambda: model.train_batch([x], [y]), n_warm, n_steps)
+    n_warm, n_steps = (1, 3) if _smoke() else (6, 50)
+    dt, last_loss = _timeit_async(
+        lambda: model.train_batch([x], [y], return_numpy=False),
+        n_warm, n_steps)
+    assert np.isfinite(last_loss), f"non-finite loss {last_loss}"
     return {"metric": "lenet_mnist_train_imgs_per_sec",
             "value": round(batch * n_steps / dt, 1), "unit": "imgs/sec",
-            "device_kind": _device_kind()}
+            "loss": round(last_loss, 4),
+            "device_kind": _device_kind(), **pallas_state}
 
 
 BENCHES = {"gpt2": bench_gpt2, "resnet50": bench_resnet50,
@@ -227,13 +302,16 @@ BENCHES = {"gpt2": bench_gpt2, "resnet50": bench_resnet50,
 # parent orchestration
 # ---------------------------------------------------------------------------
 
-def _run_child(name: str, timeout: float, force_cpu: bool = False):
+def _run_child(name: str, timeout: float, force_cpu: bool = False,
+               no_pallas: bool = False):
     env = dict(os.environ)
     env["PYTHONPATH"] = HERE + os.pathsep + env.get("PYTHONPATH", "")
     if force_cpu:
         env["JAX_PLATFORMS"] = "cpu"
         env["PALLAS_AXON_POOL_IPS"] = ""
         env["PADDLE_BENCH_SMOKE"] = "1"
+    if no_pallas:
+        env["PADDLE_BENCH_NO_PALLAS"] = "1"
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--child", name],
@@ -256,19 +334,42 @@ def main():
     t_start = time.perf_counter()
     results = {}
     order = ["gpt2", "resnet50", "bert", "lenet"]
+
+    def remaining():
+        return budget - (time.perf_counter() - t_start)
+
     for name in order:
-        remaining = budget - (time.perf_counter() - t_start)
-        if remaining < 120:
+        if remaining() < 120:
             results[name] = {"error": "skipped: bench time budget exhausted"}
             continue
-        results[name] = _run_child(name, timeout=min(900.0, remaining))
-        if "error" in results[name] and name == "gpt2":
-            # one retry — transient TPU backend-init failures cost rounds
-            remaining = budget - (time.perf_counter() - t_start)
-            if remaining > 300:
-                retry = _run_child(name, timeout=min(900.0, remaining))
+        results[name] = _run_child(name, timeout=min(900.0, remaining()))
+        if "error" in results[name] and \
+                "timeout" not in results[name]["error"]:
+            # one retry with the Pallas tier disabled: a kernel lowering
+            # failure must still produce a lax-path number (r2 verdict
+            # weak #5). Timeouts are excluded — re-running a timeout just
+            # burns the budget twice.
+            if remaining() > 240:
+                retry = _run_child(name, timeout=min(900.0, remaining()),
+                                   no_pallas=True)
                 if "error" not in retry:
+                    retry["note"] = "pallas tier disabled after crash"
                     results[name] = retry
+
+    # second pass, strictly best-effort AFTER every primary bench had its
+    # chance: with/without-Pallas delta for the attention-heavy configs
+    # (r2 verdict item 1c)
+    if not _smoke():
+        for name in ("gpt2", "bert"):
+            if remaining() < 300 or not results.get(name, {}).get("pallas"):
+                continue
+            off = _run_child(name, timeout=min(900.0, remaining()),
+                             no_pallas=True)
+            if "error" not in off:
+                results[f"{name}_nopallas"] = off
+                if off["value"]:
+                    results[name]["pallas_speedup"] = round(
+                        results[name]["value"] / off["value"], 3)
 
     headline = None
     for name in order:
@@ -278,8 +379,8 @@ def main():
     if headline is None:
         # last resort: forced-CPU smoke so SOME number exists (bounded by
         # what's left of the budget, floor 120s)
-        remaining = budget - (time.perf_counter() - t_start)
-        cpu = _run_child("lenet", timeout=max(120.0, min(600.0, remaining)),
+        cpu = _run_child("lenet", timeout=max(120.0, min(600.0,
+                                                         remaining())),
                          force_cpu=True)
         if "error" not in cpu:
             cpu["metric"] += "_cpu_fallback"

@@ -116,6 +116,13 @@ def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
     if level == "O2":
         for m in model_list:
             m.to(dtype=dt)
+    # record the policy on the model so compiled-step engines
+    # (ParallelEngine, hapi adapter) trace the forward under auto_cast —
+    # otherwise fp32 *inputs* meet low-precision weights and dtype-strict
+    # ops (conv) reject the mix
+    for m in model_list:
+        m._amp_level = level
+        m._amp_dtype = dt
     if optimizers is None:
         return models if single else model_list
     return (models if single else model_list), optimizers

@@ -133,18 +133,38 @@ class ParallelEngine:
         import jax.numpy as jnp
         from jax.sharding import NamedSharding
 
+        # TPU Pallas smoke gate: a kernel that cannot lower on this chip
+        # must degrade to the lax path, never crash the compiled step
+        # (r2 verdict item 1b)
+        from ..ops import pallas_smoke
+        pallas_smoke.ensure()
+
         model, opt, loss_fn = self.model, self.optimizer, self.loss_fn
         mesh = self.mesh
         rep = NamedSharding(mesh, _P())
         bshard = NamedSharding(mesh, batch_pspec(mesh))
         clip = getattr(opt, "_grad_clip", None)
 
+        import contextlib
+
+        def _amp_ctx():
+            # models decorated via amp.decorate(level="O2") trace their
+            # forward under autocast so fp32 inputs are cast to the AMP
+            # dtype at dtype-strict ops (conv/matmul)
+            level = getattr(model, "_amp_level", "O0")
+            if level in ("O1", "O2"):
+                from .. import amp as _amp
+                return _amp.auto_cast(
+                    level=level,
+                    dtype=getattr(model, "_amp_dtype", "bfloat16"))
+            return contextlib.nullcontext()
+
         def step(params, opt_state, buffers, key, lr, *arrays):
             inputs = arrays[:n_inputs]
             labels = arrays[n_inputs:]
 
             def loss_of(p):
-                with _random.rng_guard(key):
+                with _random.rng_guard(key), _amp_ctx():
                     from ..nn.layer.layers import functional_state
                     with functional_state(model, p, buffers) as st:
                         with no_grad_guard():
@@ -188,15 +208,34 @@ class ParallelEngine:
         )
 
     # ------------------------------------------------------------------
-    def train_step(self, inputs, labels=()):
-        """Run one sharded train step; returns host float loss."""
+    @staticmethod
+    def _coerce(seq):
+        """numpy-fy host data; pass device arrays through untouched (a
+        np.asarray on a jax.Array is a device->host sync + re-upload —
+        the r2-measured 449 ms/step on ResNet)."""
+        import jax
+        items = seq if isinstance(seq, (list, tuple)) else [seq]
+        out = []
+        for a in items:
+            if isinstance(a, jax.Array):
+                out.append(a)
+            elif isinstance(a, Tensor):
+                out.append(a._data)
+            else:
+                out.append(np.asarray(a))
+        return out
+
+    def train_step_async(self, inputs, labels=()):
+        """One sharded train step; returns the loss as a DEVICE scalar
+        without blocking.  jax's async dispatch queues successive steps
+        back-to-back on the chip; fetch the loss (float()) only when you
+        need the number.  This is the fast path the benchmarks use — the
+        blocking form costs a host round-trip per step."""
         import jax
         import jax.numpy as jnp
 
-        ins = [np.asarray(a) for a in
-               (inputs if isinstance(inputs, (list, tuple)) else [inputs])]
-        lbs = [np.asarray(a) for a in
-               (labels if isinstance(labels, (list, tuple)) else [labels])]
+        ins = self._coerce(inputs)
+        lbs = self._coerce(labels)
         if self._train_step is None:
             self._n_batch = len(ins) + len(lbs)
             self._build(len(ins))
@@ -210,7 +249,22 @@ class ParallelEngine:
             (self.params, self.opt_state, self.buffers,
              loss) = self._train_step(self.params, self.opt_state,
                                       self.buffers, key, lr, *ins, *lbs)
-        return float(loss)
+        return loss
+
+    def train_step(self, inputs, labels=()):
+        """Run one sharded train step; returns host float loss."""
+        return float(self.train_step_async(inputs, labels))
+
+    def device_put_batch(self, inputs, labels=()):
+        """Place a host batch on the mesh with the engine's batch sharding
+        (transfer once, reuse across steps — e.g. device-resident
+        synthetic benches)."""
+        import jax
+        from jax.sharding import NamedSharding
+        bshard = NamedSharding(self.mesh, batch_pspec(self.mesh))
+        put = lambda seq: [jax.device_put(a, bshard)
+                           for a in self._coerce(seq)]
+        return put(inputs), put(labels)
 
     def sync_to_model(self):
         """Write device state back into the Layer (for save/eval)."""

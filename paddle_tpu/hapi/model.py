@@ -42,10 +42,18 @@ def _to_list(x):
 
 
 def _as_arrays(batch):
+    import jax
+
+    def one(b):
+        if isinstance(b, Tensor):
+            return b._data
+        if isinstance(b, jax.Array):
+            return b  # already on device: never round-trip through host
+        return np.asarray(b)
+
     if isinstance(batch, (list, tuple)):
-        return [np.asarray(b.numpy() if isinstance(b, Tensor) else b)
-                for b in batch]
-    return [np.asarray(batch)]
+        return [one(b) for b in batch]
+    return [one(batch)]
 
 
 class Model:
@@ -120,6 +128,7 @@ class Model:
         return contextlib.nullcontext()
 
     def _build_train_step(self):
+        self._pallas_gate()
         net, opt = self.network, self._optimizer
         clip = getattr(opt, "_grad_clip", None)
 
@@ -180,7 +189,20 @@ class Model:
                                      static_argnames=("n_inputs",))
 
     # -- single-batch APIs (reference train_batch/eval_batch/predict_batch) -
-    def train_batch(self, inputs, labels=None, update=True):
+    def _pallas_gate(self):
+        # same smoke gate as ParallelEngine._build: a Pallas kernel that
+        # cannot lower on this chip must degrade to lax, not crash fit()
+        from ..ops import pallas_smoke
+        pallas_smoke.ensure()
+
+    def train_batch(self, inputs, labels=None, update=True,
+                    return_numpy=True):
+        """One optimizer step.  ``return_numpy=False`` returns the loss as
+        a device scalar WITHOUT blocking on the chip — jax's async dispatch
+        then pipelines successive steps (the reference's dygraph step is
+        synchronous by construction; on TPU a per-step host sync costs
+        tens of ms through the runtime, so the non-blocking form is the
+        fast path for tight loops)."""
         if self._train_step_fn is None:
             self.network.train()
             self._sync_state_from_network()
@@ -196,7 +218,8 @@ class Model:
             len(ins), *ins, *lbs)
         metrics = self._update_metrics(outs, lbs)
         self._dirty = True
-        loss = float(loss)
+        if return_numpy:
+            loss = float(loss)
         return (loss, metrics) if metrics else loss
 
     def eval_batch(self, inputs, labels=None):
@@ -226,8 +249,10 @@ class Model:
     def _update_metrics(self, outs, labels):
         results = []
         for m in self._metrics:
+            # wrap labels directly — np.asarray on a device-resident label
+            # batch is a blocking D2H sync per step
             correct = m.compute(*[Tensor(o) for o in outs],
-                                *[Tensor(np.asarray(l)) for l in labels])
+                                *[Tensor(l) for l in labels])
             r = m.update(*(correct if isinstance(correct, tuple)
                            else (correct,)))
             results.append(r)

@@ -26,7 +26,8 @@ __all__ = ["Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
            "ChainDataset", "Subset", "random_split", "Sampler",
            "SequenceSampler", "RandomSampler", "WeightedRandomSampler",
            "BatchSampler", "DistributedBatchSampler", "DataLoader",
-           "get_worker_info", "default_collate_fn"]
+           "get_worker_info", "default_collate_fn", "device_prefetch",
+           "DeviceDataLoader"]
 
 
 class Dataset:
@@ -421,3 +422,96 @@ class DataLoader:
                 done_cv.notify_all()
             for t in threads:
                 t.join(timeout=1.0)
+
+
+# ---------------------------------------------------------------------------
+# Device prefetch: overlap host->device transfer with compute
+# ---------------------------------------------------------------------------
+
+def device_prefetch(iterable, sharding=None, buffer_size=2):
+    """Iterate ``iterable`` (typically a DataLoader) with batches moved to
+    device AHEAD of consumption: a background thread calls
+    ``jax.device_put`` on upcoming batches into a bounded buffer, so the
+    H2D transfer of batch N+1 rides under the compute of batch N instead
+    of serializing in front of it (r2 verdict: 449 ms synchronous H2D per
+    ResNet step at the measured 86 MB/s was the dominant step cost).
+
+    Reference analog: the subprocess + shared-memory + pinned-buffer
+    pipeline of fluid/dataloader/dataloader_iter.py — on TPU the transfer
+    engine is asynchronous, so a thread + double buffer delivers the same
+    overlap without shared-memory machinery.
+
+    ``sharding``: optional ``jax.sharding.Sharding`` (e.g. the batch
+    sharding of a ParallelEngine) applied to every array in the batch.
+    """
+    import jax
+
+    def put(batch):
+        def one(a):
+            if isinstance(a, Tensor):
+                a = a._data
+            if sharding is not None:
+                return jax.device_put(a, sharding)
+            return jax.device_put(a)
+        if isinstance(batch, (list, tuple)):
+            return type(batch)(one(a) for a in batch)
+        return one(batch)
+
+    q: _queue.Queue = _queue.Queue(maxsize=max(1, int(buffer_size)))
+    _END = object()
+    stop = threading.Event()
+
+    def _put(item):
+        # bounded put that aborts when the consumer went away — otherwise
+        # an early `break` out of the consuming loop leaves this thread
+        # blocked forever, pinning device batches and the inner loader
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except _queue.Full:
+                continue
+        return False
+
+    def producer():
+        try:
+            for batch in iterable:
+                if not _put(put(batch)):
+                    return
+            _put(_END)
+        except Exception as e:  # propagate into the consumer
+            _put(e)
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is _END:
+                break
+            if isinstance(item, Exception):
+                raise item
+            yield item
+    finally:
+        stop.set()
+        t.join(timeout=1.0)
+
+
+class DeviceDataLoader:
+    """DataLoader wrapper yielding device-resident batches via
+    ``device_prefetch`` (len()/attributes delegate to the inner loader)."""
+
+    def __init__(self, loader, sharding=None, buffer_size=2):
+        self._loader = loader
+        self._sharding = sharding
+        self._buffer_size = buffer_size
+
+    def __iter__(self):
+        return device_prefetch(self._loader, self._sharding,
+                               self._buffer_size)
+
+    def __len__(self):
+        return len(self._loader)
+
+    def __getattr__(self, item):
+        return getattr(self._loader, item)

@@ -15,6 +15,7 @@ for tests: FLAGS_pallas_force (runs kernels even off-TPU, interpreted).
 """
 from __future__ import annotations
 
+import contextlib
 import functools
 import math
 
@@ -56,6 +57,21 @@ def _shape_of(x):
     return tuple(getattr(x, "shape", ()))
 
 
+def _x64_off():
+    """Trace-scope guard: the framework enables jax x64 globally (reference
+    parity for int64/float64 tensors), but under x64 Python-int constants
+    inside kernel traces become int64 scalars that Mosaic cannot lower
+    (infinite int64->int32 convert recursion / malformed mixed-type index
+    arithmetic).  Every pallas_call invocation — which is when the kernel
+    body is traced — runs under this x64-off scope; the surrounding jaxpr
+    keeps its global setting."""
+    try:
+        from jax._src.config import enable_x64
+        return enable_x64(False)
+    except ImportError:  # future jax: fall back to no-op (x64 default off)
+        return contextlib.nullcontext()
+
+
 # ===========================================================================
 # Flash attention (fwd + bwd), layout [B, S, H, D]
 # ===========================================================================
@@ -64,8 +80,24 @@ DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
 
 
+# LSE (and the bwd delta) travel between kernels as [BH, S, LSE_LANES]
+# fp32 with the value replicated across the trailing lane dim.  A plain
+# [BH, S] layout with a (1, block_q) block violates the Mosaic tiling rule
+# (second-to-last block dim must be divisible by 8 or equal the array dim)
+# — the exact crash BENCH_r02 recorded on hardware.  With a trailing
+# LSE_LANES=8 dim, blocks are (1, block_q, 8): block_q is sublane-aligned
+# and the last block dim equals the array dim, so the layout is legal on
+# TPU at an 8x (not 128x) replication cost.
+LSE_LANES = 8
+
+
 def _fa_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
                    block_q, block_k, seq_k):
+    # i32-typed block-size constants: bare python ints in fori_loop bodies
+    # get materialized as i64 by Mosaic, producing malformed mixed-type
+    # index arithmetic on TPU
+    _I32_BQ = jnp.int32(block_q)
+    _I32_BK = jnp.int32(block_k)
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)              # [bq, D]
     bq, d = q.shape
@@ -78,66 +110,70 @@ def _fa_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
         nk = nk_full
 
     def body(j, carry):
+        # running softmax stats stay 2D [bq, 1] (sublane-oriented);
+        # rank-1 carries would force lane<->sublane relayouts in Mosaic
         m_prev, l_prev, acc = carry
-        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        k_blk = k_ref[0, pl.ds(j * _I32_BK, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(j * _I32_BK, block_k), :].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale    # [bq, bk]
         if causal:
-            rows = qi * block_q + jax.lax.broadcasted_iota(
+            rows = qi * _I32_BQ + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, block_k), 0)
-            cols = j * block_k + jax.lax.broadcasted_iota(
+            cols = j * _I32_BK + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, block_k), 1)
             s = jnp.where(rows >= cols, s, _NEG_INF)
-        m_cur = jnp.max(s, axis=-1)
+        m_cur = jnp.max(s, axis=-1, keepdims=True)         # [bq, 1]
         m_new = jnp.maximum(m_prev, m_cur)
-        p = jnp.exp(s - m_new[:, None])
+        p = jnp.exp(s - m_new)
         alpha = jnp.exp(m_prev - m_new)
-        l_new = l_prev * alpha + jnp.sum(p, axis=-1)
-        acc = acc * alpha[:, None] + jax.lax.dot_general(
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jax.lax.dot_general(
             p, v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return m_new, l_new, acc
 
-    m0 = jnp.full((bq,), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((bq,), jnp.float32)
+    m0 = jnp.full((bq, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
     acc0 = jnp.zeros((bq, d), jnp.float32)
     m, l, acc = jax.lax.fori_loop(0, nk, body, (m0, l0, acc0))
     l_safe = jnp.maximum(l, 1e-30)
-    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    lse_ref[0] = m + jnp.log(l_safe)
+    o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
+    lse_ref[0] = jnp.broadcast_to(m + jnp.log(l_safe), (bq, LSE_LANES))
 
 
 def _fa_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                   *, scale, causal, block_q, block_k, seq_k):
+    _I32_BQ = jnp.int32(block_q)
+    _I32_BK = jnp.int32(block_k)
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0]
-    delta = delta_ref[0]
+    lse = lse_ref[0][:, :1]                        # [bq, 1] of [bq, 8]
+    delta = delta_ref[0][:, :1]
     bq, d = q.shape
     nk_full = seq_k // block_k
     nk = jnp.minimum(nk_full, ((qi + 1) * block_q + block_k - 1) //
                      block_k) if causal else nk_full
 
     def body(j, dq):
-        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        k_blk = k_ref[0, pl.ds(j * _I32_BK, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(j * _I32_BK, block_k), :].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
         if causal:
-            rows = qi * block_q + jax.lax.broadcasted_iota(
+            rows = qi * _I32_BQ + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, block_k), 0)
-            cols = j * block_k + jax.lax.broadcasted_iota(
+            cols = j * _I32_BK + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, block_k), 1)
             s = jnp.where(rows >= cols, s, _NEG_INF)
-        p = jnp.exp(s - lse[:, None])
+        p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(
             do, v_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * scale
+        ds = p * (dp - delta) * scale
         return dq + jax.lax.dot_general(
             ds, k_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -149,6 +185,8 @@ def _fa_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 def _fa_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                    dk_ref, dv_ref, *, scale, causal, block_q, block_k,
                    seq_q):
+    _I32_BQ = jnp.int32(block_q)
+    _I32_BK = jnp.int32(block_k)
     ki = pl.program_id(1)
     k = k_ref[0].astype(jnp.float32)              # [bk, D]
     v = v_ref[0].astype(jnp.float32)
@@ -158,28 +196,28 @@ def _fa_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     def body(j, carry):
         dk, dv = carry
-        q_blk = q_ref[0, pl.ds(j * block_q, block_q), :].astype(jnp.float32)
-        do_blk = do_ref[0, pl.ds(j * block_q, block_q), :].astype(
+        q_blk = q_ref[0, pl.ds(j * _I32_BQ, block_q), :].astype(jnp.float32)
+        do_blk = do_ref[0, pl.ds(j * _I32_BQ, block_q), :].astype(
             jnp.float32)
-        lse_blk = lse_ref[0, pl.ds(j * block_q, block_q)]
-        delta_blk = delta_ref[0, pl.ds(j * block_q, block_q)]
+        lse_blk = lse_ref[0, pl.ds(j * _I32_BQ, block_q), :1]   # [bq, 1]
+        delta_blk = delta_ref[0, pl.ds(j * _I32_BQ, block_q), :1]
         s = jax.lax.dot_general(
             q_blk, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale   # [bq, bk]
         if causal:
-            rows = j * block_q + jax.lax.broadcasted_iota(
+            rows = j * _I32_BQ + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, bk), 0)
-            cols = ki * block_k + jax.lax.broadcasted_iota(
+            cols = ki * _I32_BK + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, bk), 1)
             s = jnp.where(rows >= cols, s, _NEG_INF)
-        p = jnp.exp(s - lse_blk[:, None])
+        p = jnp.exp(s - lse_blk)
         dv_new = dv + jax.lax.dot_general(
             p, do_blk, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(
             do_blk, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_blk[:, None]) * scale
+        ds = p * (dp - delta_blk) * scale
         dk_new = dk + jax.lax.dot_general(
             ds, q_blk, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -192,14 +230,15 @@ def _fa_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _fa_call_fwd(q, k, v, scale, causal, block_q, block_k):
-    """q,k,v: [BH, S, D] -> (o [BH, Sq, D], lse [BH, Sq])."""
+    """q,k,v: [BH, S, D] -> (o [BH, Sq, D], lse [BH, Sq, LSE_LANES])."""
     bh, sq, d = q.shape
     sk = k.shape[1]
     nq = sq // block_q
     kernel = functools.partial(
         _fa_fwd_kernel, scale=scale, causal=causal,
         block_q=block_q, block_k=block_k, seq_k=sk)
-    return pl.pallas_call(
+    with _x64_off():
+        return pl.pallas_call(
         kernel,
         grid=(bh, nq),
         in_specs=[
@@ -209,22 +248,24 @@ def _fa_call_fwd(q, k, v, scale, causal, block_q, block_k):
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, block_q, LSE_LANES), lambda b, i: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, sq), jnp.float32),
+            jax.ShapeDtypeStruct((bh, sq, LSE_LANES), jnp.float32),
         ],
-        interpret=_interpret(),
-    )(q, k, v)
+            interpret=_interpret(),
+        )(q, k, v)
 
 
 def _fa_call_bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k):
     bh, sq, d = q.shape
     sk = k.shape[1]
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
-                    axis=-1)                                    # [BH, Sq]
-    dq = pl.pallas_call(
+                    axis=-1, keepdims=True)                 # [BH, Sq, 1]
+    delta = jnp.broadcast_to(delta, (bh, sq, LSE_LANES))
+    with _x64_off():
+        dq = pl.pallas_call(
         functools.partial(_fa_dq_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k, seq_k=sk),
         grid=(bh, sq // block_q),
@@ -233,14 +274,14 @@ def _fa_call_bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k):
             pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, block_q, LSE_LANES), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, LSE_LANES), lambda b, i: (b, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-        interpret=_interpret(),
-    )(q, k, v, do, lse, delta)
-    dk, dv = pl.pallas_call(
+            interpret=_interpret(),
+        )(q, k, v, do, lse, delta)
+        dk, dv = pl.pallas_call(
         functools.partial(_fa_dkv_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k, seq_q=sq),
         grid=(bh, sk // block_k),
@@ -249,8 +290,8 @@ def _fa_call_bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k):
             pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, sq, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, sq), lambda b, i: (b, 0)),
-            pl.BlockSpec((1, sq), lambda b, i: (b, 0)),
+            pl.BlockSpec((1, sq, LSE_LANES), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, sq, LSE_LANES), lambda b, i: (b, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
@@ -260,8 +301,8 @@ def _fa_call_bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k):
             jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
             jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
         ],
-        interpret=_interpret(),
-    )(q, k, v, do, lse, delta)
+            interpret=_interpret(),
+        )(q, k, v, do, lse, delta)
     return dq, dk, dv
 
 
@@ -349,20 +390,22 @@ LN_BLOCK_ROWS = 128
 
 
 def _ln_fwd_kernel(x_ref, w_ref, b_ref, o_ref, *, eps):
+    # w_ref/b_ref are [1, D]: rank-1 blocks have no legal TPU layout for
+    # arbitrary D, and [1, D] broadcasts against [rows, D] for free
     x = x_ref[...].astype(jnp.float32)            # [rows, D]
     mean = jnp.mean(x, axis=-1, keepdims=True)
     xc = x - mean
     var = jnp.mean(xc * xc, axis=-1, keepdims=True)
     rstd = jax.lax.rsqrt(var + eps)
-    y = xc * rstd * w_ref[...].astype(jnp.float32)[None, :] + \
-        b_ref[...].astype(jnp.float32)[None, :]
+    y = xc * rstd * w_ref[...].astype(jnp.float32) + \
+        b_ref[...].astype(jnp.float32)
     o_ref[...] = y.astype(o_ref.dtype)
 
 
 def _ln_bwd_kernel(x_ref, w_ref, g_ref, dx_ref, dwp_ref, dbp_ref, *, eps):
     x = x_ref[...].astype(jnp.float32)
     g = g_ref[...].astype(jnp.float32)
-    w = w_ref[...].astype(jnp.float32)[None, :]
+    w = w_ref[...].astype(jnp.float32)            # [1, D]
     d = x.shape[-1]
     mean = jnp.mean(x, axis=-1, keepdims=True)
     xc = x - mean
@@ -374,8 +417,15 @@ def _ln_bwd_kernel(x_ref, w_ref, g_ref, dx_ref, dwp_ref, dbp_ref, *, eps):
     m2 = jnp.mean(gw * xhat, axis=-1, keepdims=True)
     dx = (gw - m1 - xhat * m2) * rstd
     dx_ref[...] = dx.astype(dx_ref.dtype)
-    dwp_ref[0, :] = jnp.sum(g * xhat, axis=0)     # partial over row block
-    dbp_ref[0, :] = jnp.sum(g, axis=0)
+    # per-row-block partial reductions for dw/db. The partials carry an
+    # 8-sublane middle dim ([nb, 8, D] overall) because a (1, D) block
+    # over an [nb, D] array is tiling-illegal on TPU; each partial is
+    # spread evenly over its 8 sublanes so the caller's plain sum over
+    # (nb, 8) recovers the exact total.
+    dwp_ref[0] = jnp.broadcast_to(
+        jnp.sum(g * xhat, axis=0, keepdims=True) / 8.0, (8, x.shape[-1]))
+    dbp_ref[0] = jnp.broadcast_to(
+        jnp.sum(g, axis=0, keepdims=True) / 8.0, (8, x.shape[-1]))
 
 
 def _ln_reshape(x):
@@ -393,20 +443,22 @@ def _ln_block_rows(rows, d):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
 def _fused_layer_norm_2d(x2, w, b, eps):
+    """x2: [rows, D]; w, b: [1, D]."""
     rows, d = x2.shape
     br = _ln_block_rows(rows, d)
-    return pl.pallas_call(
+    with _x64_off():
+        return pl.pallas_call(
         functools.partial(_ln_fwd_kernel, eps=eps),
         grid=(rows // br,),
         in_specs=[
             pl.BlockSpec((br, d), lambda i: (i, 0)),
-            pl.BlockSpec((d,), lambda i: (0,)),
-            pl.BlockSpec((d,), lambda i: (0,)),
+            pl.BlockSpec((1, d), lambda i: (0, 0)),
+            pl.BlockSpec((1, d), lambda i: (0, 0)),
         ],
         out_specs=pl.BlockSpec((br, d), lambda i: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((rows, d), x2.dtype),
-        interpret=_interpret(),
-    )(x2, w, b)
+            interpret=_interpret(),
+        )(x2, w, b)
 
 
 def _ln_fwd_rule(x2, w, b, eps):
@@ -419,27 +471,29 @@ def _ln_bwd_rule(eps, res, g):
     rows, d = x2.shape
     br = _ln_block_rows(rows, d)
     nb = rows // br
-    dx, dwp, dbp = pl.pallas_call(
+    with _x64_off():
+        dx, dwp, dbp = pl.pallas_call(
         functools.partial(_ln_bwd_kernel, eps=eps),
         grid=(nb,),
         in_specs=[
             pl.BlockSpec((br, d), lambda i: (i, 0)),
-            pl.BlockSpec((d,), lambda i: (0,)),
+            pl.BlockSpec((1, d), lambda i: (0, 0)),
             pl.BlockSpec((br, d), lambda i: (i, 0)),
         ],
         out_specs=[
             pl.BlockSpec((br, d), lambda i: (i, 0)),
-            pl.BlockSpec((1, d), lambda i: (i, 0)),
-            pl.BlockSpec((1, d), lambda i: (i, 0)),
+            pl.BlockSpec((1, 8, d), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, 8, d), lambda i: (i, 0, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((rows, d), x2.dtype),
-            jax.ShapeDtypeStruct((nb, d), jnp.float32),
-            jax.ShapeDtypeStruct((nb, d), jnp.float32),
+            jax.ShapeDtypeStruct((nb, 8, d), jnp.float32),
+            jax.ShapeDtypeStruct((nb, 8, d), jnp.float32),
         ],
-        interpret=_interpret(),
-    )(x2, w, g)
-    return dx, dwp.sum(0).astype(w.dtype), dbp.sum(0).astype(b_dtype)
+            interpret=_interpret(),
+        )(x2, w, g)
+    return (dx, dwp.sum((0, 1), keepdims=False)[None, :].astype(w.dtype),
+            dbp.sum((0, 1), keepdims=False)[None, :].astype(b_dtype))
 
 
 _fused_layer_norm_2d.defvjp(_ln_fwd_rule, _ln_bwd_rule)
@@ -455,7 +509,8 @@ def fused_layer_norm(x, weight, bias, epsilon=1e-5):
             f"fused_layer_norm needs total rows ({rows}) divisible by the "
             f"row block ({br})")
     b = bias if bias is not None else jnp.zeros((d,), x.dtype)
-    out = _fused_layer_norm_2d(x2, weight, b, float(epsilon))
+    out = _fused_layer_norm_2d(x2, weight.reshape(1, d), b.reshape(1, d),
+                               float(epsilon))
     return out.reshape(x.shape)
 
 
@@ -526,7 +581,8 @@ def _fused_adamw_callable(shape, dtype_name, interpret):
                 a = jnp.pad(a, (0, pad))
             return a.reshape(rows, lanes)
 
-        new_p, new_m, new_v = pl.pallas_call(
+        with _x64_off():
+            new_p, new_m, new_v = pl.pallas_call(
             _adamw_kernel,
             in_specs=[pl.BlockSpec((rows, lanes), lambda: (0, 0)),
                       pl.BlockSpec((rows, lanes), lambda: (0, 0)),
@@ -539,9 +595,9 @@ def _fused_adamw_callable(shape, dtype_name, interpret):
             out_shape=[jax.ShapeDtypeStruct((rows, lanes), dtype),
                        jax.ShapeDtypeStruct((rows, lanes), jnp.float32),
                        jax.ShapeDtypeStruct((rows, lanes), jnp.float32)],
-            interpret=interpret,
-        )(flat(p, dtype), flat(g, jnp.float32), flat(m, jnp.float32),
-          flat(v, jnp.float32), scalars)
+                interpret=interpret,
+            )(flat(p, dtype), flat(g, jnp.float32), flat(m, jnp.float32),
+              flat(v, jnp.float32), scalars)
 
         def unflat(a, dt):
             return a.reshape(-1)[:n].reshape(shape).astype(dt)

@@ -1,0 +1,120 @@
+"""Device-prefetch DataLoader tests (r2 verdict item 3: H2D overlap).
+
+Reference analog: the subprocess + shared-memory prefetch pipeline of
+fluid/dataloader/dataloader_iter.py; here a background thread device_puts
+ahead of consumption.
+"""
+import time
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.io import (DataLoader, DeviceDataLoader, TensorDataset,
+                           device_prefetch)
+
+
+def _dataset(n=32, shape=(4, 8)):
+    rng = np.random.RandomState(0)
+    xs = rng.randn(n, *shape).astype(np.float32)
+    ys = rng.randint(0, 10, (n, 1)).astype(np.int64)
+    return TensorDataset([xs, ys]), xs, ys
+
+
+class TestDevicePrefetch:
+    def test_batches_are_device_arrays_and_ordered(self):
+        import jax
+        ds, xs, ys = _dataset()
+        loader = DataLoader(ds, batch_size=8)
+        seen = list(device_prefetch(loader))
+        assert len(seen) == 4
+        off = 0
+        for batch in seen:
+            x, y = batch
+            assert isinstance(x, jax.Array) and isinstance(y, jax.Array)
+            np.testing.assert_array_equal(np.asarray(x), xs[off:off + 8])
+            np.testing.assert_array_equal(np.asarray(y), ys[off:off + 8])
+            off += 8
+
+    def test_sharded_prefetch(self):
+        import jax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        mesh = Mesh(np.array(jax.devices()).reshape(8), ("data",))
+        sharding = NamedSharding(mesh, P("data"))
+        ds, xs, _ = _dataset(n=32)
+        loader = DataLoader(ds, batch_size=8)
+        for x, y in device_prefetch(loader, sharding=sharding):
+            assert x.sharding.is_equivalent_to(sharding, x.ndim)
+
+    def test_transfer_overlaps_consumption(self):
+        """The producer must run ahead: while the consumer sleeps on batch
+        i, batch i+1 must already have been produced (double buffer)."""
+        produced = []
+
+        class SlowIter:
+            def __iter__(self):
+                for i in range(4):
+                    produced.append((i, time.perf_counter()))
+                    yield [np.full((2, 2), i, np.float32)]
+
+        consumed = []
+        for batch in device_prefetch(SlowIter(), buffer_size=2):
+            consumed.append(time.perf_counter())
+            time.sleep(0.05)
+        # by the time the consumer finished sleeping on batch 0, the
+        # producer had already put later batches (ran ahead)
+        assert produced[2][1] < consumed[1], (
+            "producer did not run ahead of the consumer")
+
+    def test_error_propagates(self):
+        class Bad:
+            def __iter__(self):
+                yield [np.zeros((2,), np.float32)]
+                raise RuntimeError("boom")
+
+        it = device_prefetch(Bad())
+        next(it)
+        try:
+            next(it)
+            raised = False
+        except RuntimeError as e:
+            raised = "boom" in str(e)
+        assert raised
+
+    def test_device_dataloader_wrapper(self):
+        import jax
+        ds, _, _ = _dataset()
+        inner = DataLoader(ds, batch_size=8)
+        dl = DeviceDataLoader(inner)
+        assert len(dl) == 4
+        assert dl.batch_sampler.batch_size == 8  # attribute delegation
+        batches = list(dl)
+        assert len(batches) == 4
+        assert isinstance(batches[0][0], jax.Array)
+
+    def test_engine_consumes_device_batches(self):
+        """End-to-end: prefetched device batches feed ParallelEngine
+        without re-upload (train loss decreases)."""
+        import paddle_tpu.nn as nn
+        from paddle_tpu.distributed import env as denv
+        from paddle_tpu.distributed.spmd import ParallelEngine
+
+        old = denv.get_mesh()
+        try:
+            denv.build_mesh({"data": 1})
+            paddle.framework.random.seed(0)
+            net = nn.Linear(8, 1)
+            opt = paddle.optimizer.Adam(learning_rate=5e-2,
+                                        parameters=net.parameters())
+            eng = ParallelEngine(net, opt, loss_fn=nn.MSELoss(),
+                                 mesh=denv.get_mesh())
+            rng = np.random.RandomState(0)
+            xs = rng.randn(64, 8).astype(np.float32)
+            ys = (xs.sum(1, keepdims=True) * 0.1).astype(np.float32)
+            ds = TensorDataset([xs, ys])
+            losses = []
+            for _ in range(3):
+                for x, y in device_prefetch(DataLoader(ds, batch_size=16)):
+                    losses.append(float(eng.train_step_async([x], [y])))
+            assert losses[-1] < losses[0] * 0.5, losses
+        finally:
+            denv.set_mesh(old)
