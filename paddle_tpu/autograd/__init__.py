@@ -20,7 +20,7 @@ from ..framework.tensor import (
 )
 
 __all__ = ["backward", "grad", "PyLayer", "PyLayerContext", "no_grad",
-           "jacobian", "hessian", "vjp", "jvp"]
+           "jacobian", "hessian", "vjp", "jvp", "differentiable_apply"]
 
 from ..framework.tensor import no_grad  # noqa: F401  (re-export)
 
@@ -230,3 +230,61 @@ def jvp(func, xs, v=None):
     js = [Tensor(j) for j in jout]
     return (outs[0] if len(outs) == 1 else outs,
             js[0] if len(js) == 1 else js)
+
+
+class _ArrayFnLayer(PyLayer):
+    """Tape node for an arbitrary pure array function (used by
+    differentiable_apply)."""
+
+    @staticmethod
+    def forward(ctx, fn, *tensors):
+        arrays = [t._data for t in tensors]
+        outs, vjp_fn = jax.vjp(fn, *arrays)
+        single = not isinstance(outs, (tuple, list))
+        out_list = [outs] if single else list(outs)
+        ctx.vjp_fn = vjp_fn
+        ctx.single = single
+        ctx.out_meta = [(o.shape, o.dtype) for o in out_list]
+        ts = [Tensor(o) for o in out_list]
+        return ts[0] if single else tuple(ts)
+
+    @staticmethod
+    def backward(ctx, *grads):
+        import jax.numpy as jnp
+        cots = []
+        for g, (shape, dtype) in zip(grads, ctx.out_meta):
+            cots.append(jnp.zeros(shape, dtype) if g is None else
+                        g._data.astype(dtype))
+        cot = cots[0] if ctx.single else tuple(cots)
+        gins = ctx.vjp_fn(cot)
+        return tuple(Tensor(g) for g in gins)
+
+
+def differentiable_apply(fn, *tensors):
+    """Run a pure array function over Tensor inputs with correct autograd
+    in EVERY regime (the pattern scan/while-based layers need — a python
+    fallback loop would unroll under jit, and raw arrays would silently
+    skip the eager tape, the r2 MoE bug):
+
+    * traced (inside a jitted step) or grads-off: plain call — jax's own
+      AD/tracing handles it;
+    * eager with grads on: ONE tape node whose backward applies jax.vjp.
+
+    ``fn(*arrays) -> array | tuple`` must be jax-traceable.
+    Returns Tensor or tuple of Tensors.
+    """
+    arrays = [t._data for t in tensors]
+    tracing = any(isinstance(a, jax.core.Tracer) for a in arrays)
+    from ..framework.tensor import is_grad_enabled
+    wants = is_grad_enabled() and any(t._requires_grad() for t in tensors)
+    if tracing or not wants:
+        outs = fn(*arrays)
+        single = not isinstance(outs, (tuple, list))
+        out_list = [outs] if single else list(outs)
+        # eager grads-off (no_grad / frozen params): outputs must NOT
+        # re-enter autograd; traced outputs keep stop_gradient=False so
+        # functional consumers treat them as differentiable
+        sg = not tracing
+        ts = [Tensor(o, stop_gradient=sg) for o in out_list]
+        return ts[0] if single else tuple(ts)
+    return _ArrayFnLayer.apply(fn, *tensors)

@@ -19,9 +19,9 @@ activation memory to O(P) micro-batches instead of GPipe's O(M). Here the
 same bound comes from ``recompute=True`` (the default): jax.checkpoint on
 each stage application makes the scan's saved residuals one activation
 per tick — O(activation) per live micro-batch slot, i.e. the 1F1B bound —
-while XLA overlaps the permutes with compute. ``recompute`` is a knob
-(PipelineParallel(..., recompute=False) or strategy.recompute) for small
-models where storing everything is faster.
+while XLA overlaps the permutes with compute. ``recompute`` is a constructor
+knob (PipelineParallel(..., recompute=False)) for small models where
+storing everything is faster.
 
 Stage structure: stages may hold DIFFERENT layer counts (non-uniform
 segmentation, e.g. ``seg_method="layer:Block"`` cuts or uneven uniform

@@ -1,0 +1,172 @@
+"""``python -m paddle_tpu.distributed.launch`` — multi-process job launcher.
+
+Reference: python/paddle/distributed/launch/main.py:18 (Context → controller
+→ Job/Pod/Container spawn + watch), fleet/elastic/manager.py:131 (restart
+policy, exit-code-101 restart signal), launch/controllers/watcher.py.
+
+TPU-native shape: the reference spawns ONE process per GPU; under jax one
+process drives all local chips, so the natural unit is one process per
+host (``--nproc_per_node`` stays available for CPU-mesh testing and
+multi-plane hosts). The launcher wires the PADDLE_* env that
+``env.init_parallel_env`` already reads — PADDLE_TRAINER_ID /
+PADDLE_TRAINERS_NUM / PADDLE_MASTER — so the rendezvous is jax's PjRt
+coordination service instead of a TCPStore. Elastic policy: a child that
+exits with code 101 (the reference's restart signal) or any non-zero code
+triggers a full local respawn up to ``--max_restarts`` times; rank logs
+stream to ``--log_dir``.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+__all__ = ["main", "launch_local"]
+
+# the reference's elastic manager treats 101 as "please restart me"
+# (fleet/elastic/manager.py ELASTIC_AUTO_PARALLEL_EXIT_CODE area)
+RESTART_EXIT_CODE = 101
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _parse(argv: Optional[List[str]] = None):
+    p = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="Launch a multi-process distributed job "
+                    "(reference: paddle.distributed.launch)")
+    p.add_argument("--nnodes", type=int, default=1,
+                   help="number of hosts in the job")
+    p.add_argument("--node_rank", type=int,
+                   default=int(os.environ.get("PADDLE_NODE_RANK", "0")),
+                   help="this host's index")
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="processes per host (1 = jax-native: one process "
+                        "drives all local chips)")
+    p.add_argument("--master", type=str,
+                   default=os.environ.get("PADDLE_MASTER"),
+                   help="coordinator ip:port (default: auto on "
+                        "single-host)")
+    p.add_argument("--log_dir", type=str, default=None,
+                   help="write per-rank logs here instead of inheriting "
+                        "stdio")
+    p.add_argument("--max_restarts", type=int, default=0,
+                   help="elastic: respawn the local pod up to N times on "
+                        "child failure")
+    p.add_argument("--backend", type=str, default=None,
+                   help="override JAX_PLATFORMS for children (e.g. cpu "
+                        "for mesh tests)")
+    p.add_argument("training_script", type=str)
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+class _Pod:
+    """The local process group (reference launch/job/pod.py Container
+    set)."""
+
+    def __init__(self, args):
+        self.args = args
+        self.procs: List[subprocess.Popen] = []
+        self.logs = []
+
+    def spawn(self):
+        a = self.args
+        world = a.nnodes * a.nproc_per_node
+        master = a.master
+        if master is None:
+            if a.nnodes > 1:
+                raise SystemExit(
+                    "--master ip:port is required for multi-host jobs")
+            master = f"127.0.0.1:{_free_port()}"
+        for local in range(a.nproc_per_node):
+            rank = a.node_rank * a.nproc_per_node + local
+            env = dict(os.environ)
+            env.update({
+                "PADDLE_TRAINER_ID": str(rank),
+                "PADDLE_TRAINERS_NUM": str(world),
+                "PADDLE_MASTER": master,
+                "PADDLE_LOCAL_RANK": str(local),
+                "PADDLE_NNODES": str(a.nnodes),
+            })
+            if a.backend:
+                env["JAX_PLATFORMS"] = a.backend
+            cmd = [sys.executable, a.training_script,
+                   *a.training_script_args]
+            if a.log_dir:
+                os.makedirs(a.log_dir, exist_ok=True)
+                logf = open(os.path.join(
+                    a.log_dir, f"workerlog.{rank}"), "ab")
+                self.logs.append(logf)
+                proc = subprocess.Popen(cmd, env=env, stdout=logf,
+                                        stderr=subprocess.STDOUT)
+            else:
+                proc = subprocess.Popen(cmd, env=env)
+            self.procs.append(proc)
+
+    def poll(self):
+        """Returns None while running, else the pod's exit code (first
+        failure wins; 0 when all exited cleanly)."""
+        codes = [p.poll() for p in self.procs]
+        for c in codes:
+            if c is not None and c != 0:
+                return c
+        if all(c == 0 for c in codes):
+            return 0
+        return None
+
+    def terminate(self):
+        for p in self.procs:
+            if p.poll() is None:
+                p.terminate()
+        deadline = time.time() + 10
+        for p in self.procs:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+        for f in self.logs:
+            f.close()
+        self.procs, self.logs = [], []
+
+
+def launch_local(argv: Optional[List[str]] = None) -> int:
+    """Spawn + watch + elastic-restart loop. Returns the job exit code."""
+    args = _parse(argv)
+    restarts = 0
+    while True:
+        pod = _Pod(args)
+        pod.spawn()
+        try:
+            while True:
+                code = pod.poll()
+                if code is not None:
+                    break
+                time.sleep(0.2)
+        except KeyboardInterrupt:
+            pod.terminate()
+            return 130
+        if code == 0:
+            return 0
+        pod.terminate()  # a dead rank means the collective is wedged:
+        #                  kill the whole local pod (reference watcher)
+        if restarts < args.max_restarts:
+            restarts += 1
+            print(f"[launch] child failed with code {code}; elastic "
+                  f"restart {restarts}/{args.max_restarts}",
+                  file=sys.stderr, flush=True)
+            continue
+        return int(code)
+
+
+def main():
+    raise SystemExit(launch_local())

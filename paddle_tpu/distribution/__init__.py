@@ -1,0 +1,312 @@
+"""``paddle.distribution`` — probability distributions.
+
+Reference: python/paddle/distribution/ (Distribution base, Normal,
+Uniform, Categorical, Beta, Dirichlet, kl_divergence registry in kl.py).
+
+TPU-native: sampling draws from the framework RNG (functional PRNG keys),
+log_prob/entropy are closed-form jnp expressions — all jit-traceable.
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional, Tuple, Type
+
+import numpy as np
+
+from ..framework import random as _random
+from ..framework.tensor import Tensor
+
+__all__ = ["Distribution", "Normal", "Uniform", "Categorical", "Beta",
+           "Dirichlet", "kl_divergence", "register_kl"]
+
+
+def _arr(x):
+    import jax.numpy as jnp
+    if isinstance(x, Tensor):
+        return x._data.astype(jnp.float32)
+    return jnp.asarray(x, jnp.float32)
+
+
+class Distribution:
+    """Reference distribution/distribution.py Distribution base."""
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        from ..framework.dispatch import call_op
+        return call_op("exp", self.log_prob(value))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    """Reference distribution/normal.py."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        import jax.numpy as jnp
+        shape = jnp.broadcast_shapes(self.loc.shape, self.scale.shape)
+        super().__init__(batch_shape=shape)
+
+    @property
+    def mean(self):
+        return Tensor(self.loc)
+
+    @property
+    def variance(self):
+        return Tensor(self.scale ** 2)
+
+    def sample(self, shape=(), seed=0):
+        import jax
+        key = _random.next_key()
+        out = self.loc + self.scale * jax.random.normal(
+            key, tuple(shape) + self.batch_shape)
+        return Tensor(out)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        import jax.numpy as jnp
+        v = _arr(value)
+        var = self.scale ** 2
+        return Tensor(-((v - self.loc) ** 2) / (2 * var)
+                      - jnp.log(self.scale)
+                      - 0.5 * math.log(2 * math.pi))
+
+    def entropy(self):
+        import jax.numpy as jnp
+        ent = 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale)
+        return Tensor(jnp.broadcast_to(ent, self.batch_shape))
+
+    def probs(self, value):
+        return self.prob(value)
+
+
+class Uniform(Distribution):
+    """Reference distribution/uniform.py: U[low, high)."""
+
+    def __init__(self, low, high, name=None):
+        self.low = _arr(low)
+        self.high = _arr(high)
+        import jax.numpy as jnp
+        shape = jnp.broadcast_shapes(self.low.shape, self.high.shape)
+        super().__init__(batch_shape=shape)
+
+    def sample(self, shape=(), seed=0):
+        import jax
+        key = _random.next_key()
+        u = jax.random.uniform(key, tuple(shape) + self.batch_shape)
+        return Tensor(self.low + u * (self.high - self.low))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        import jax.numpy as jnp
+        v = _arr(value)
+        inside = (v >= self.low) & (v < self.high)
+        lp = -jnp.log(self.high - self.low)
+        return Tensor(jnp.where(inside, lp, -jnp.inf))
+
+    def entropy(self):
+        import jax.numpy as jnp
+        return Tensor(jnp.log(self.high - self.low))
+
+
+class Categorical(Distribution):
+    """Reference distribution/categorical.py (constructed from logits)."""
+
+    def __init__(self, logits, name=None):
+        import jax
+        import jax.numpy as jnp
+        self.logits = _arr(logits)
+        self._log_p = jax.nn.log_softmax(self.logits, axis=-1)
+        super().__init__(batch_shape=self.logits.shape[:-1])
+
+    @property
+    def probs_tensor(self):
+        import jax.numpy as jnp
+        return Tensor(jnp.exp(self._log_p))
+
+    def sample(self, shape=(), seed=0):
+        import jax
+        key = _random.next_key()
+        out = jax.random.categorical(
+            key, self.logits, shape=tuple(shape) + self.batch_shape)
+        return Tensor(out)
+
+    def log_prob(self, value):
+        import jax.numpy as jnp
+        v = _arr(value).astype(jnp.int32)
+        return Tensor(jnp.take_along_axis(
+            self._log_p, v[..., None], axis=-1)[..., 0])
+
+    def probs(self, value):
+        return self.prob(value)
+
+    def entropy(self):
+        import jax.numpy as jnp
+        p = jnp.exp(self._log_p)
+        return Tensor(-(p * self._log_p).sum(-1))
+
+
+class Beta(Distribution):
+    """Reference distribution/beta.py."""
+
+    def __init__(self, alpha, beta):
+        self.alpha = _arr(alpha)
+        self.beta = _arr(beta)
+        import jax.numpy as jnp
+        shape = jnp.broadcast_shapes(self.alpha.shape, self.beta.shape)
+        super().__init__(batch_shape=shape)
+
+    @property
+    def mean(self):
+        return Tensor(self.alpha / (self.alpha + self.beta))
+
+    def sample(self, shape=()):
+        import jax
+        key = _random.next_key()
+        return Tensor(jax.random.beta(
+            key, self.alpha, self.beta, tuple(shape) + self.batch_shape))
+
+    def log_prob(self, value):
+        import jax.scipy.special as jsp
+        import jax.numpy as jnp
+        v = _arr(value)
+        lbeta = (jsp.gammaln(self.alpha) + jsp.gammaln(self.beta)
+                 - jsp.gammaln(self.alpha + self.beta))
+        return Tensor((self.alpha - 1) * jnp.log(v)
+                      + (self.beta - 1) * jnp.log1p(-v) - lbeta)
+
+    def entropy(self):
+        import jax.scipy.special as jsp
+        a, b = self.alpha, self.beta
+        lbeta = (jsp.gammaln(a) + jsp.gammaln(b) - jsp.gammaln(a + b))
+        return Tensor(lbeta - (a - 1) * jsp.digamma(a)
+                      - (b - 1) * jsp.digamma(b)
+                      + (a + b - 2) * jsp.digamma(a + b))
+
+
+class Dirichlet(Distribution):
+    """Reference distribution/dirichlet.py."""
+
+    def __init__(self, concentration):
+        self.concentration = _arr(concentration)
+        super().__init__(batch_shape=self.concentration.shape[:-1],
+                         event_shape=self.concentration.shape[-1:])
+
+    def sample(self, shape=()):
+        import jax
+        key = _random.next_key()
+        return Tensor(jax.random.dirichlet(
+            key, self.concentration, tuple(shape) + self.batch_shape))
+
+    def log_prob(self, value):
+        import jax.scipy.special as jsp
+        import jax.numpy as jnp
+        v = _arr(value)
+        a = self.concentration
+        norm = jsp.gammaln(a.sum(-1)) - jsp.gammaln(a).sum(-1)
+        return Tensor(((a - 1) * jnp.log(v)).sum(-1) + norm)
+
+    def entropy(self):
+        import jax.scipy.special as jsp
+        a = self.concentration
+        a0 = a.sum(-1)
+        k = a.shape[-1]
+        lnB = jsp.gammaln(a).sum(-1) - jsp.gammaln(a0)
+        return Tensor(lnB + (a0 - k) * jsp.digamma(a0)
+                      - ((a - 1) * jsp.digamma(a)).sum(-1))
+
+
+# ---------------------------------------------------------------------------
+# KL divergence registry (reference distribution/kl.py)
+# ---------------------------------------------------------------------------
+
+_KL_REGISTRY: Dict[Tuple[Type, Type], callable] = {}
+
+
+def register_kl(p_cls, q_cls):
+    def deco(fn):
+        _KL_REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+    return deco
+
+
+def kl_divergence(p: Distribution, q: Distribution) -> Tensor:
+    for (pc, qc), fn in _KL_REGISTRY.items():
+        if isinstance(p, pc) and isinstance(q, qc):
+            return fn(p, q)
+    raise NotImplementedError(
+        f"no KL rule for ({type(p).__name__}, {type(q).__name__})")
+
+
+@register_kl(Normal, Normal)
+def _kl_normal_normal(p, q):
+    import jax.numpy as jnp
+    var_ratio = (p.scale / q.scale) ** 2
+    t1 = ((p.loc - q.loc) / q.scale) ** 2
+    return Tensor(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform_uniform(p, q):
+    import jax.numpy as jnp
+    r = jnp.log((q.high - q.low) / (p.high - p.low))
+    outside = (p.low < q.low) | (p.high > q.high)
+    return Tensor(jnp.where(outside, jnp.inf, r))
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical_categorical(p, q):
+    import jax.numpy as jnp
+    pp = jnp.exp(p._log_p)
+    return Tensor((pp * (p._log_p - q._log_p)).sum(-1))
+
+
+@register_kl(Beta, Beta)
+def _kl_beta_beta(p, q):
+    import jax.scipy.special as jsp
+    a1, b1, a2, b2 = p.alpha, p.beta, q.alpha, q.beta
+    lbeta1 = jsp.gammaln(a1) + jsp.gammaln(b1) - jsp.gammaln(a1 + b1)
+    lbeta2 = jsp.gammaln(a2) + jsp.gammaln(b2) - jsp.gammaln(a2 + b2)
+    return Tensor(lbeta2 - lbeta1
+                  + (a1 - a2) * jsp.digamma(a1)
+                  + (b1 - b2) * jsp.digamma(b1)
+                  + (a2 - a1 + b2 - b1) * jsp.digamma(a1 + b1))
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet_dirichlet(p, q):
+    import jax.scipy.special as jsp
+    a, b = p.concentration, q.concentration
+    a0 = a.sum(-1)
+    lnB_a = jsp.gammaln(a).sum(-1) - jsp.gammaln(a0)
+    lnB_b = jsp.gammaln(b).sum(-1) - jsp.gammaln(b.sum(-1))
+    return Tensor(lnB_b - lnB_a
+                  + ((a - b) * (jsp.digamma(a)
+                                - jsp.digamma(a0)[..., None])).sum(-1))

@@ -114,11 +114,18 @@ def _const_key(v):
         # VALUE, not just shape/dtype, so distinct constants never alias.
         return ("arr", v.shape, str(v.dtype),
                 np.asarray(v).tobytes())
+    if isinstance(v, tuple):
+        # recurse: (1, 2) == (1.0, 2.0) alias elementwise, same bug one
+        # level down
+        return ("tuple", tuple(_const_key(x) for x in v))
     try:
         hash(v)
-        return v
     except TypeError:
         return repr(v)
+    # include the python type: 1 == 1.0 == True hash-alias as dict keys,
+    # which would serve a float-scalar compiled op for an int scalar (the
+    # add(int32, 1) -> float64 bug)
+    return (type(v).__name__, v)
 
 
 _fn_cache: Dict[tuple, Any] = {}

@@ -30,14 +30,19 @@ import numpy as np
 
 
 class Generator:
-    """A seedable stream of PRNG keys."""
+    """A seedable stream of PRNG keys.
+
+    The key materializes LAZILY: creating it eagerly would initialise the
+    XLA backend at ``import paddle_tpu`` time, which breaks multi-host
+    jobs (jax.distributed.initialize must run before any backend use).
+    """
 
     def __init__(self, seed: int = 0):
         self.manual_seed(seed)
 
     def manual_seed(self, seed: int):
         self._seed = int(seed) % (2 ** 63)
-        self._key = jax.random.key(self._seed)
+        self._key = None
         self._counter = 0
         return self
 
@@ -45,6 +50,8 @@ class Generator:
         return self._seed
 
     def next_key(self):
+        if self._key is None:
+            self._key = jax.random.key(self._seed)
         self._counter += 1
         return jax.random.fold_in(self._key, self._counter)
 
@@ -53,7 +60,7 @@ class Generator:
 
     def set_state(self, state):
         self._seed, self._counter = state
-        self._key = jax.random.key(self._seed)
+        self._key = None
 
 
 class _TracedRng:

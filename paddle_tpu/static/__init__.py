@@ -20,6 +20,7 @@ import numpy as np
 
 from ..framework.dtypes import convert_dtype
 from ..framework.tensor import Tensor
+from . import nn  # noqa: F401  (control-flow ops: cond/while_loop/...)
 
 __all__ = ["enable_static", "disable_static", "in_dynamic_mode",
            "InputSpec", "Program", "program_guard", "default_main_program",

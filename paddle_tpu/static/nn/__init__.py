@@ -1,0 +1,165 @@
+"""``paddle.static.nn`` — control-flow ops (cond / while_loop / case /
+switch_case).
+
+Reference: python/paddle/static/nn/__init__.py re-exporting
+fluid/layers/control_flow.py (cond:68, while_loop:86), backed by
+conditional_block_op.cc / while_op.cc in the C++ executor.
+
+TPU-native: under a trace these lower to ``lax.cond`` / ``lax.while_loop``
+— real XLA control flow, usable inside jitted train steps and exported
+programs (r2 verdict item 9). Eagerly (concrete boolean) they just pick a
+branch, exactly like the reference's dygraph mode.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Sequence
+
+from ...framework.tensor import Tensor
+
+__all__ = ["cond", "while_loop", "case", "switch_case"]
+
+
+def _is_tracer(x) -> bool:
+    import jax
+    return isinstance(x, jax.core.Tracer)
+
+
+def _pred_value(pred):
+    if isinstance(pred, Tensor):
+        return pred._data
+    return pred
+
+
+def _to_arrays(tree):
+    import jax
+    return jax.tree_util.tree_map(
+        lambda t: t._data if isinstance(t, Tensor) else t, tree,
+        is_leaf=lambda t: isinstance(t, Tensor))
+
+
+def _to_tensors(tree, template):
+    """Mirror the template's Tensor/non-Tensor structure onto arrays."""
+    import jax
+    t_leaves, treedef = jax.tree_util.tree_flatten(
+        template, is_leaf=lambda t: isinstance(t, Tensor))
+    a_leaves = jax.tree_util.tree_leaves(tree)
+    out = [Tensor(a) if isinstance(t, Tensor) else a
+           for t, a in zip(t_leaves, a_leaves)]
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def cond(pred, true_fn: Callable, false_fn: Callable, name=None):
+    """Reference: fluid/layers/control_flow.py cond — both branches must
+    return structures of matching shapes/dtypes."""
+    p = _pred_value(pred)
+    if not _is_tracer(p):
+        return true_fn() if bool(p) else false_fn()
+    import jax
+
+    template = None
+
+    def wrap_t(fn):
+        nonlocal template
+
+        def f(_):
+            nonlocal template
+            out = fn()
+            if template is None:
+                template = out
+            return _to_arrays(out)
+        return f
+
+    out = jax.lax.cond(p, wrap_t(true_fn), wrap_t(false_fn), 0)
+    return _to_tensors(out, template)
+
+
+def while_loop(cond_fn: Callable, body_fn: Callable,
+               loop_vars: Sequence, is_test=False, name=None):
+    """Reference: fluid/layers/control_flow.py while_loop. ``loop_vars``
+    is a list; cond_fn(*vars) -> bool scalar, body_fn(*vars) -> new vars.
+    """
+    loop_vars = list(loop_vars)
+    arrays = _to_arrays(loop_vars)
+    traced = any(_is_tracer(a) for a in arrays) or \
+        _is_tracer(_pred_value(cond_fn(*loop_vars)))
+    if not traced:
+        # eager: plain python loop (reference dygraph path)
+        vars_ = loop_vars
+        while bool(_pred_value(cond_fn(*vars_))):
+            out = body_fn(*vars_)
+            vars_ = list(out) if isinstance(out, (list, tuple)) else [out]
+        return vars_
+    import jax
+
+    def c(arrs):
+        vs = _to_tensors(arrs, loop_vars)
+        return _pred_value(cond_fn(*vs))
+
+    def b(arrs):
+        vs = _to_tensors(arrs, loop_vars)
+        out = body_fn(*vs)
+        out = list(out) if isinstance(out, (list, tuple)) else [out]
+        return _to_arrays(out)
+
+    out = jax.lax.while_loop(c, b, arrays)
+    return _to_tensors(out, loop_vars)
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """Reference: fluid/layers/control_flow.py case — first true pred
+    wins."""
+    if not pred_fn_pairs:
+        raise ValueError("pred_fn_pairs must not be empty")
+
+    def build(i):
+        if i >= len(pred_fn_pairs):
+            if default is None:
+                # reference semantics: last fn is the fallback
+                return pred_fn_pairs[-1][1]()
+            return default()
+        pred, fn = pred_fn_pairs[i]
+        return cond(pred, fn, lambda: build(i + 1))
+
+    return build(0)
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """Reference: fluid/layers/control_flow.py switch_case."""
+    if isinstance(branch_fns, dict):
+        pairs = sorted(branch_fns.items())
+    else:
+        pairs = list(enumerate(branch_fns))
+    idx = _pred_value(branch_index)
+    if not _is_tracer(idx):
+        i = int(idx)
+        for k, fn in pairs:
+            if k == i:
+                return fn()
+        if default is None:
+            raise ValueError(f"branch index {i} not found and no default")
+        return default()
+    import jax
+    import jax.numpy as jnp
+
+    keys = [k for k, _ in pairs]
+    fns = [fn for _, fn in pairs]
+    if default is not None:
+        fns = fns + [default]
+    template = None
+
+    def mk(fn):
+        def f(_):
+            nonlocal template
+            out = fn()
+            if template is None:
+                template = out
+            return _to_arrays(out)
+        return f
+
+    # map branch_index -> position in fns (unknown keys -> default slot)
+    pos = jnp.full((), len(fns) - 1 if default is not None else 0,
+                   jnp.int32)
+    for j, k in enumerate(keys):
+        pos = jnp.where(idx == k, j, pos)
+    out = jax.lax.switch(pos, [mk(f) for f in fns], 0)
+    return _to_tensors(out, template)

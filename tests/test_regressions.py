@@ -131,3 +131,18 @@ class TestBuildMeshSubset:
                 env.build_mesh({"data": 16})
         finally:
             env.set_mesh(old)
+
+
+class TestDispatchCacheScalarAliasing:
+    def test_int_and_float_scalar_consts_do_not_alias(self):
+        """1 == 1.0 == True as dict keys: the compiled-op cache must key
+        scalar constants by TYPE as well as value, or add(int32, 1) gets
+        served the float-scalar executable (r3 while_loop flake)."""
+        import jax.numpy as jnp
+        x = paddle.to_tensor(np.ones((3,), np.float32))
+        _ = x + 1.0  # prime the cache with the float-scalar variant
+        t = paddle.to_tensor(jnp.asarray(0, jnp.int32))
+        out = t + 1
+        assert str(out.dtype) in ("int32", "paddle.int32"), out.dtype
+        b = paddle.to_tensor(np.array([True, False]))
+        assert "bool" in str((b == True).dtype)  # noqa: E712

@@ -1,0 +1,317 @@
+"""Round-3 breadth: RNN family, paddle.distribution, control-flow ops.
+
+OpTest-style numeric parity against straight numpy implementations
+(SURVEY.md §4) plus autograd/jit regime checks.
+"""
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+rng = np.random.RandomState(0)
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+class TestRNNCells:
+    def test_simple_rnn_cell_parity(self):
+        paddle.framework.random.seed(0)
+        cell = nn.SimpleRNNCell(4, 8)
+        x = rng.randn(3, 4).astype(np.float32)
+        h = rng.randn(3, 8).astype(np.float32)
+        out, nh = cell(paddle.to_tensor(x), paddle.to_tensor(h))
+        w_ih = cell.weight_ih.numpy()
+        w_hh = cell.weight_hh.numpy()
+        ref = np.tanh(x @ w_ih.T + cell.bias_ih.numpy()
+                      + h @ w_hh.T + cell.bias_hh.numpy())
+        np.testing.assert_allclose(out.numpy(), ref, atol=1e-5)
+        np.testing.assert_allclose(nh.numpy(), ref, atol=1e-5)
+
+    def test_lstm_cell_parity(self):
+        """Gate order [i, f, g, o] — reference rnn.py:406."""
+        paddle.framework.random.seed(1)
+        cell = nn.LSTMCell(4, 6)
+        x = rng.randn(2, 4).astype(np.float32)
+        h = rng.randn(2, 6).astype(np.float32)
+        c = rng.randn(2, 6).astype(np.float32)
+        out, (nh, nc) = cell(paddle.to_tensor(x),
+                             (paddle.to_tensor(h), paddle.to_tensor(c)))
+        gates = (x @ cell.weight_ih.numpy().T + cell.bias_ih.numpy()
+                 + h @ cell.weight_hh.numpy().T + cell.bias_hh.numpy())
+        i, f, g, o = np.split(gates, 4, axis=-1)
+        ref_c = _sigmoid(f) * c + _sigmoid(i) * np.tanh(g)
+        ref_h = _sigmoid(o) * np.tanh(ref_c)
+        np.testing.assert_allclose(nc.numpy(), ref_c, atol=1e-5)
+        np.testing.assert_allclose(nh.numpy(), ref_h, atol=1e-5)
+        np.testing.assert_allclose(out.numpy(), ref_h, atol=1e-5)
+
+    def test_gru_cell_parity(self):
+        """Splits [r, z, c]; h = (prev - c) * z + c — reference
+        rnn.py:563."""
+        paddle.framework.random.seed(2)
+        cell = nn.GRUCell(4, 6)
+        x = rng.randn(2, 4).astype(np.float32)
+        h = rng.randn(2, 6).astype(np.float32)
+        out, nh = cell(paddle.to_tensor(x), paddle.to_tensor(h))
+        xg = x @ cell.weight_ih.numpy().T + cell.bias_ih.numpy()
+        hg = h @ cell.weight_hh.numpy().T + cell.bias_hh.numpy()
+        x_r, x_z, x_c = np.split(xg, 3, axis=-1)
+        h_r, h_z, h_c = np.split(hg, 3, axis=-1)
+        r = _sigmoid(x_r + h_r)
+        z = _sigmoid(x_z + h_z)
+        cand = np.tanh(x_c + r * h_c)
+        ref = (h - cand) * z + cand
+        np.testing.assert_allclose(nh.numpy(), ref, atol=1e-5)
+
+
+class TestRNNLayers:
+    def test_rnn_wrapper_matches_manual_loop(self):
+        paddle.framework.random.seed(3)
+        cell = nn.GRUCell(4, 6)
+        layer = nn.RNN(cell)
+        x = rng.randn(2, 5, 4).astype(np.float32)
+        out, final = layer(paddle.to_tensor(x))
+        assert out.shape == [2, 5, 6]
+        # manual step loop
+        h = paddle.to_tensor(np.zeros((2, 6), np.float32))
+        for t in range(5):
+            _, h = cell(paddle.to_tensor(x[:, t]), h)
+        np.testing.assert_allclose(final.numpy(), h.numpy(), atol=1e-5)
+        np.testing.assert_allclose(out.numpy()[:, -1], h.numpy(),
+                                   atol=1e-5)
+
+    def test_lstm_layer_shapes_and_final_states(self):
+        paddle.framework.random.seed(4)
+        lstm = nn.LSTM(4, 8, num_layers=2)
+        x = rng.randn(3, 7, 4).astype(np.float32)
+        out, (h, c) = lstm(paddle.to_tensor(x))
+        assert out.shape == [3, 7, 8]
+        assert h.shape == [2, 3, 8] and c.shape == [2, 3, 8]
+
+    def test_bidirectional_gru(self):
+        paddle.framework.random.seed(5)
+        gru = nn.GRU(4, 8, direction="bidirect")
+        x = rng.randn(3, 5, 4).astype(np.float32)
+        out, h = gru(paddle.to_tensor(x))
+        assert out.shape == [3, 5, 16]
+        assert h.shape == [2, 3, 8]
+
+    def test_lstm_eager_training_decreases_loss(self):
+        paddle.framework.random.seed(6)
+        model = nn.LSTM(4, 8)
+        head = nn.Linear(8, 1)
+        params = list(model.parameters()) + list(head.parameters())
+        opt = paddle.optimizer.Adam(learning_rate=1e-2, parameters=params)
+        x = paddle.to_tensor(rng.randn(8, 6, 4).astype(np.float32))
+        y = paddle.to_tensor(rng.randn(8, 1).astype(np.float32))
+        losses = []
+        for _ in range(10):
+            out, (h, c) = model(x)
+            pred = head(out[:, -1])
+            loss = F.mse_loss(pred, y)
+            loss.backward()
+            for p in params:
+                assert p.grad is not None
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.7, losses
+
+    def test_rnn_inside_jit_matches_eager(self):
+        import jax
+        from paddle_tpu.nn.layer.layers import functional_call, \
+            get_params_tree
+
+        paddle.framework.random.seed(7)
+        gru = nn.GRU(4, 6)
+        x = rng.randn(2, 5, 4).astype(np.float32)
+        eager_out, _ = gru(paddle.to_tensor(x))
+
+        def fwd(params, arr):
+            out, _ = functional_call(gru, params, {},
+                                     paddle.to_tensor(arr))
+            o, _h = out
+            return o._data
+
+        jit_out = jax.jit(fwd)(get_params_tree(gru), x)
+        np.testing.assert_allclose(eager_out.numpy(), np.asarray(jit_out),
+                                   atol=1e-5)
+
+    def test_time_major_and_reverse(self):
+        paddle.framework.random.seed(8)
+        cell = nn.SimpleRNNCell(3, 5)
+        fwd = nn.RNN(cell, time_major=True)
+        x = rng.randn(6, 2, 3).astype(np.float32)  # [T, B, I]
+        out, final = fwd(paddle.to_tensor(x))
+        assert out.shape == [6, 2, 5]
+        rev = nn.RNN(cell, is_reverse=True, time_major=True)
+        out_r, final_r = rev(paddle.to_tensor(x))
+        # reversed scan's "final" is the state after consuming t=0 last
+        h = paddle.to_tensor(np.zeros((2, 5), np.float32))
+        for t in reversed(range(6)):
+            _, h = cell(paddle.to_tensor(x[t]), h)
+        np.testing.assert_allclose(final_r.numpy(), h.numpy(), atol=1e-5)
+
+
+class TestDistribution:
+    def test_normal_log_prob_entropy_kl(self):
+        from paddle_tpu.distribution import Normal, kl_divergence
+        p = Normal(0.0, 1.0)
+        q = Normal(1.0, 2.0)
+        v = 0.5
+        ref_lp = -0.5 * v * v - 0.5 * math.log(2 * math.pi)
+        np.testing.assert_allclose(float(p.log_prob(v)), ref_lp, rtol=1e-5)
+        np.testing.assert_allclose(
+            float(p.entropy()), 0.5 * math.log(2 * math.pi * math.e),
+            rtol=1e-5)
+        # closed-form KL(N(0,1) || N(1,2))
+        ref_kl = math.log(2.0) + (1 + 1) / (2 * 4) - 0.5
+        np.testing.assert_allclose(float(kl_divergence(p, q)), ref_kl,
+                                   rtol=1e-5)
+
+    def test_normal_sample_moments(self):
+        from paddle_tpu.distribution import Normal
+        paddle.framework.random.seed(0)
+        d = Normal(2.0, 3.0)
+        s = d.sample([20000]).numpy()
+        assert abs(s.mean() - 2.0) < 0.1
+        assert abs(s.std() - 3.0) < 0.1
+
+    def test_uniform(self):
+        from paddle_tpu.distribution import Uniform, kl_divergence
+        p = Uniform(0.0, 2.0)
+        np.testing.assert_allclose(float(p.log_prob(1.0)),
+                                   -math.log(2.0), rtol=1e-5)
+        assert float(p.log_prob(3.0)) == -np.inf
+        np.testing.assert_allclose(float(p.entropy()), math.log(2.0),
+                                   rtol=1e-5)
+        q = Uniform(-1.0, 3.0)
+        np.testing.assert_allclose(float(kl_divergence(p, q)),
+                                   math.log(4.0 / 2.0), rtol=1e-5)
+        assert float(kl_divergence(q, p)) == np.inf
+
+    def test_categorical(self):
+        from paddle_tpu.distribution import Categorical, kl_divergence
+        logits = np.log(np.array([0.2, 0.3, 0.5], np.float32))
+        d = Categorical(logits)
+        np.testing.assert_allclose(float(d.log_prob(2)), math.log(0.5),
+                                   rtol=1e-5)
+        ref_ent = -sum(p * math.log(p) for p in (0.2, 0.3, 0.5))
+        np.testing.assert_allclose(float(d.entropy()), ref_ent, rtol=1e-5)
+        q = Categorical(np.zeros(3, np.float32))
+        ref_kl = sum(p * (math.log(p) - math.log(1 / 3))
+                     for p in (0.2, 0.3, 0.5))
+        np.testing.assert_allclose(float(kl_divergence(d, q)), ref_kl,
+                                   rtol=1e-5)
+        paddle.framework.random.seed(0)
+        s = d.sample([10000]).numpy()
+        freq = np.bincount(s, minlength=3) / 10000
+        np.testing.assert_allclose(freq, [0.2, 0.3, 0.5], atol=0.03)
+
+    def test_beta_dirichlet(self):
+        from paddle_tpu.distribution import (Beta, Dirichlet,
+                                             kl_divergence)
+        b = Beta(2.0, 3.0)
+        # B(2,3) = 1/12; logpdf(0.5) = log(12 * 0.5 * 0.25)
+        np.testing.assert_allclose(
+            float(b.log_prob(0.5)),
+            math.log(12.0) + math.log(0.5) + 2 * math.log(0.5), rtol=1e-4)
+        assert np.isfinite(float(b.entropy()))
+        np.testing.assert_allclose(float(kl_divergence(b, b)), 0.0,
+                                   atol=1e-6)
+        d = Dirichlet(np.array([1.0, 1.0, 1.0], np.float32))
+        # uniform simplex density = Gamma(3) = 2
+        np.testing.assert_allclose(
+            float(d.log_prob(np.array([0.2, 0.3, 0.5], np.float32))),
+            math.log(2.0), rtol=1e-4)
+        np.testing.assert_allclose(float(kl_divergence(d, d)), 0.0,
+                                   atol=1e-6)
+
+    def test_module_accessible_from_root(self):
+        assert paddle.distribution.Normal is not None
+
+
+class TestControlFlow:
+    def test_cond_eager(self):
+        from paddle_tpu.static.nn import cond
+        x = paddle.to_tensor(np.array(3.0, np.float32))
+        out = cond(x > 2, lambda: x * 2, lambda: x - 1)
+        assert float(out) == 6.0
+        out = cond(x > 5, lambda: x * 2, lambda: x - 1)
+        assert float(out) == 2.0
+
+    def test_cond_traced(self):
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.static.nn import cond
+
+        def f(a):
+            t = paddle.to_tensor(a)
+            out = cond(t.sum() > 0,
+                       lambda: t * 2,
+                       lambda: t * -1)
+            return out._data
+
+        fn = jax.jit(f)
+        np.testing.assert_allclose(
+            np.asarray(fn(jnp.asarray([1.0, 2.0]))), [2.0, 4.0])
+        np.testing.assert_allclose(
+            np.asarray(fn(jnp.asarray([-1.0, -2.0]))), [1.0, 2.0])
+
+    def test_while_loop_eager(self):
+        from paddle_tpu.static.nn import while_loop
+        i = paddle.to_tensor(np.array(0, np.int64))
+        s = paddle.to_tensor(np.array(0.0, np.float32))
+        i, s = while_loop(lambda i, s: i < 5,
+                          lambda i, s: [i + 1, s + float(i) + 1.0],
+                          [i, s])
+        assert int(i) == 5
+
+    def test_while_loop_traced(self):
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.static.nn import while_loop
+
+        def f(n):
+            i = paddle.to_tensor(jnp.asarray(0, jnp.int32))
+            acc = paddle.to_tensor(jnp.asarray(0, jnp.int32))
+            i, acc = while_loop(lambda i, a: i._data < n,
+                                lambda i, a: [i + 1, a + i],
+                                [i, acc])
+            return acc._data
+
+        out = jax.jit(f)(jnp.asarray(5, jnp.int32))
+        assert int(out) == 10  # 0+1+2+3+4
+
+    def test_switch_case_and_case(self):
+        from paddle_tpu.static.nn import case, switch_case
+        x = paddle.to_tensor(np.array(2, np.int32))
+        out = switch_case(x, {1: lambda: paddle.to_tensor(10.0),
+                              2: lambda: paddle.to_tensor(20.0)},
+                          default=lambda: paddle.to_tensor(-1.0))
+        assert float(out) == 20.0
+        out = case([(paddle.to_tensor(False), lambda: paddle.to_tensor(1.0)),
+                    (paddle.to_tensor(True), lambda: paddle.to_tensor(2.0))],
+                   default=lambda: paddle.to_tensor(3.0))
+        assert float(out) == 2.0
+
+    def test_cond_in_jitted_train_step_with_grad(self):
+        """Control flow composes with autodiff inside a jitted step."""
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.static.nn import cond
+
+        def loss_fn(w, x):
+            t = paddle.to_tensor(w * x)
+            out = cond(t.sum() > 0, lambda: t * t, lambda: t * 0.5)
+            return jnp.sum(out._data)
+
+        g = jax.jit(jax.grad(loss_fn))(jnp.asarray(2.0),
+                                       jnp.asarray([1.0, 2.0]))
+        np.testing.assert_allclose(float(g), 2 * 2.0 * (1 + 4), rtol=1e-5)
