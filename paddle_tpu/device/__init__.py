@@ -1,0 +1,119 @@
+"""``paddle.device`` — device query/selection + HBM memory stats.
+
+Reference: python/paddle/device/ (set_device/get_device, cuda memory
+query APIs) over the C++ memory facade (fluid/memory/malloc.h,
+AllocatorFacade, stats.cc STAT_ADD gpu mem counters — SURVEY §1 L2).
+
+TPU-native: allocation itself belongs to PjRt/XLA (no user-visible
+allocator to re-implement — arrays are managed buffers), so the facade's
+real surface is OBSERVABILITY: per-device HBM statistics straight from
+the PjRt client (``jax`` ``Device.memory_stats``). ``paddle.device.cuda``
+is aliased to the same implementation so ported scripts keep working on
+TPU.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..framework import get_device, set_device  # noqa: F401
+
+__all__ = ["get_device", "set_device", "device_count", "synchronize",
+           "get_device_properties", "memory_allocated",
+           "max_memory_allocated", "memory_reserved", "memory_stats",
+           "cuda", "is_compiled_with_cuda"]
+
+
+def _jax():
+    import jax
+    return jax
+
+
+def _device(device=None):
+    jax = _jax()
+    if device is None:
+        return jax.devices()[0]
+    if isinstance(device, int):
+        return jax.devices()[device]
+    if isinstance(device, str) and ":" in device:
+        return jax.devices()[int(device.rsplit(":", 1)[1])]
+    return device
+
+
+def device_count() -> int:
+    return len(_jax().devices())
+
+
+def is_compiled_with_cuda() -> bool:
+    return False  # honest: this build targets TPU via XLA
+
+
+def synchronize(device=None):
+    """Wait until all queued work on the device finished (reference:
+    paddle.device.cuda.synchronize). XLA exposes a global effects
+    barrier rather than per-stream sync."""
+    jax = _jax()
+    jax.effects_barrier()
+
+
+def memory_stats(device=None) -> dict:
+    """Raw PjRt memory statistics (bytes_in_use, peak_bytes_in_use,
+    bytes_limit, ...); {} where the backend doesn't report (CPU)."""
+    d = _device(device)
+    try:
+        stats = d.memory_stats()
+    except Exception:
+        stats = None
+    return dict(stats or {})
+
+
+def memory_allocated(device=None) -> int:
+    """Bytes currently held by live buffers on the device (reference:
+    paddle.device.cuda.memory_allocated over STAT gpu mem)."""
+    return int(memory_stats(device).get("bytes_in_use", 0))
+
+
+def max_memory_allocated(device=None) -> int:
+    return int(memory_stats(device).get("peak_bytes_in_use", 0))
+
+
+def memory_reserved(device=None) -> int:
+    """The backend pool's reservation; PjRt reports the usable limit."""
+    s = memory_stats(device)
+    return int(s.get("bytes_reserved", s.get("bytes_in_use", 0)))
+
+
+class _Properties:
+    def __init__(self, d):
+        self.name = getattr(d, "device_kind", str(d))
+        self.total_memory = int(
+            memory_stats(d).get("bytes_limit", 0))
+        self.platform = d.platform
+        self.id = d.id
+
+    def __repr__(self):
+        return (f"DeviceProperties(name={self.name!r}, id={self.id}, "
+                f"platform={self.platform!r}, "
+                f"total_memory={self.total_memory})")
+
+
+def get_device_properties(device=None) -> _Properties:
+    return _Properties(_device(device))
+
+
+class _CudaAlias:
+    """``paddle.device.cuda`` compatibility surface: ported GPU scripts
+    query memory/sync through the TPU PjRt stats."""
+    device_count = staticmethod(device_count)
+    synchronize = staticmethod(synchronize)
+    memory_allocated = staticmethod(memory_allocated)
+    max_memory_allocated = staticmethod(max_memory_allocated)
+    memory_reserved = staticmethod(memory_reserved)
+    get_device_properties = staticmethod(get_device_properties)
+
+    @staticmethod
+    def empty_cache():
+        # PjRt owns its pools; there is no user-level cache to drop.
+        return None
+
+
+cuda = _CudaAlias()

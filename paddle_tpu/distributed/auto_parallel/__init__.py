@@ -1,0 +1,179 @@
+"""``paddle.distributed.auto_parallel`` — semi-automatic SPMD annotations.
+
+Reference: auto_parallel/process_mesh.py:39 (ProcessMesh),
+interface.py:34/73 (shard_tensor / shard_op), engine.py (high-level fit),
+completion.py / partitioner.py / reshard.py (the 21k-LoC propagation +
+program-rewrite machinery).
+
+TPU-native: annotations map 1:1 onto GSPMD — ``shard_tensor`` is a
+``with_sharding_constraint`` (traced) or sharded ``device_put`` (eager),
+and the entire Completer/Partitioner/Resharder pipeline collapses into
+XLA's SPMD propagation pass: annotate a few tensors, XLA completes the
+rest and inserts the collectives the reference's Resharder emits by hand.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import numpy as np
+
+from ...framework.tensor import Tensor
+from .. import env as _env
+
+__all__ = ["ProcessMesh", "shard_tensor", "shard_op", "get_mesh",
+           "set_mesh"]
+
+_current = {"mesh": None}
+
+
+class ProcessMesh:
+    """Logical mesh of processes/devices (reference process_mesh.py:39).
+
+    ``mesh``: nested list / ndarray of device (process) ids giving the
+    topology; ``dim_names``: one name per mesh dimension. The physical
+    jax ``Mesh`` places device i of ``jax.devices()`` at logical id i.
+    """
+
+    def __init__(self, mesh: Union[Sequence, np.ndarray],
+                 dim_names: Optional[List[str]] = None,
+                 process_ids=None):
+        arr = np.asarray(mesh)
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(arr.ndim)]
+        if len(dim_names) != arr.ndim:
+            raise ValueError(
+                f"{len(dim_names)} dim_names for a {arr.ndim}-d mesh")
+        self._ids = arr
+        self._dim_names = list(dim_names)
+
+    @property
+    def shape(self):
+        return list(self._ids.shape)
+
+    @property
+    def dim_names(self):
+        return list(self._dim_names)
+
+    @property
+    def process_ids(self):
+        return self._ids.reshape(-1).tolist()
+
+    @property
+    def ndim(self):
+        return self._ids.ndim
+
+    def get_dim_size(self, name: str) -> int:
+        return self._ids.shape[self._dim_names.index(name)]
+
+    def jax_mesh(self):
+        import jax
+        from jax.sharding import Mesh
+        devs = jax.devices()
+        arr = np.empty(self._ids.shape, dtype=object)
+        for idx, pid in np.ndenumerate(self._ids):
+            arr[idx] = devs[int(pid)]
+        return Mesh(arr, tuple(self._dim_names))
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh)
+                and np.array_equal(self._ids, other._ids)
+                and self._dim_names == other._dim_names)
+
+    def __repr__(self):
+        return (f"ProcessMesh(shape={self.shape}, "
+                f"dim_names={self._dim_names})")
+
+    def __enter__(self):
+        self._prev = _current["mesh"]
+        _current["mesh"] = self
+        return self
+
+    def __exit__(self, *exc):
+        _current["mesh"] = self._prev
+        return False
+
+
+def get_mesh() -> Optional[ProcessMesh]:
+    return _current["mesh"]
+
+
+def set_mesh(mesh: Optional[ProcessMesh]):
+    _current["mesh"] = mesh
+
+
+def _resolve_spec(process_mesh, shard_spec, ndim):
+    """Accept both API generations: ``shard_spec`` axis-name list
+    (["x", None, "y"]) or a v2.3 ``dims_mapping`` int list ([0, -1, 1])."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = process_mesh or get_mesh()
+    if mesh is None:
+        raise ValueError("no ProcessMesh: pass process_mesh= or enter a "
+                         "`with ProcessMesh(...)` scope")
+    names = mesh.dim_names
+    spec = list(shard_spec if shard_spec is not None else [])
+    spec += [None] * (ndim - len(spec))
+    axes = []
+    for s in spec[:ndim]:
+        if s is None or s == -1:
+            axes.append(None)
+        elif isinstance(s, int):
+            axes.append(names[s])       # dims_mapping form
+        else:
+            if s not in names:
+                raise ValueError(f"unknown mesh dim {s!r}; have {names}")
+            axes.append(s)
+    return NamedSharding(mesh.jax_mesh(), P(*axes))
+
+
+def shard_tensor(x, process_mesh: Optional[ProcessMesh] = None,
+                 shard_spec=None, dist_attr=None, stop_gradient=None):
+    """Annotate a tensor's placement (reference interface.py:34).
+
+    Traced: becomes ``lax.with_sharding_constraint`` — GSPMD propagates
+    from there. Eager: a sharded ``device_put``.
+    ``dist_attr={"process_mesh": m, "dims_mapping": [...]}`` (v2.3 form)
+    is accepted alongside ``shard_spec=["x", None]``.
+    """
+    import jax
+
+    if dist_attr is not None:
+        process_mesh = dist_attr.get("process_mesh", process_mesh)
+        shard_spec = dist_attr.get("dims_mapping", shard_spec)
+    is_tensor = isinstance(x, Tensor)
+    arr = x._data if is_tensor else x
+    sharding = _resolve_spec(process_mesh, shard_spec, arr.ndim)
+    if isinstance(arr, jax.core.Tracer):
+        out = jax.lax.with_sharding_constraint(arr, sharding)
+    else:
+        out = jax.device_put(arr, sharding)
+    if is_tensor:
+        t = Tensor(out, stop_gradient=x.stop_gradient)
+        return t
+    return out
+
+
+def shard_op(op_fn, process_mesh: Optional[ProcessMesh] = None,
+             in_specs=None, out_specs=None):
+    """Annotate an op's inputs/outputs (reference interface.py:73):
+    returns a wrapped callable that constrains tensor arguments and
+    results; the op body itself stays GSPMD-propagated."""
+
+    def wrapped(*args, **kwargs):
+        def put(a, spec):
+            if isinstance(a, Tensor) or hasattr(a, "ndim"):
+                return shard_tensor(a, process_mesh, spec)
+            return a
+
+        if in_specs is not None:
+            args = tuple(put(a, s) for a, s in zip(args, in_specs))
+        out = op_fn(*args, **kwargs)
+        if out_specs is None:
+            return out
+        if isinstance(out, (list, tuple)):
+            return type(out)(put(o, s)
+                             for o, s in zip(out, out_specs))
+        return put(out, out_specs if not isinstance(out_specs, (list,
+                   tuple)) else out_specs[0])
+
+    return wrapped

@@ -155,10 +155,11 @@ def launch_local(argv: Optional[List[str]] = None) -> int:
         except KeyboardInterrupt:
             pod.terminate()
             return 130
+        # terminate() also closes/flushes the workerlog handles, so run
+        # it on EVERY exit path (clean exit included)
+        pod.terminate()
         if code == 0:
             return 0
-        pod.terminate()  # a dead rank means the collective is wedged:
-        #                  kill the whole local pod (reference watcher)
         if restarts < args.max_restarts:
             restarts += 1
             print(f"[launch] child failed with code {code}; elastic "

@@ -62,10 +62,22 @@ class Distribution:
         return kl_divergence(self, other)
 
 
+def _draw_key(seed):
+    """seed=0 (the reference default) draws from the global stream; an
+    explicit nonzero seed gives a reproducible dedicated stream."""
+    import jax
+    if seed:
+        return jax.random.key(int(seed))
+    return _random.next_key()
+
+
 class Normal(Distribution):
     """Reference distribution/normal.py."""
 
     def __init__(self, loc, scale, name=None):
+        # keep original Tensor params so rsample stays differentiable
+        self._loc_t = loc if isinstance(loc, Tensor) else None
+        self._scale_t = scale if isinstance(scale, Tensor) else None
         self.loc = _arr(loc)
         self.scale = _arr(scale)
         import jax.numpy as jnp
@@ -82,12 +94,24 @@ class Normal(Distribution):
 
     def sample(self, shape=(), seed=0):
         import jax
-        key = _random.next_key()
+        key = _draw_key(seed)
         out = self.loc + self.scale * jax.random.normal(
             key, tuple(shape) + self.batch_shape)
         return Tensor(out)
 
-    rsample = sample
+    def rsample(self, shape=(), seed=0):
+        """Reparameterized draw: differentiable w.r.t. Tensor loc/scale
+        (loc + scale * eps) — feeds VAE/policy-gradient training."""
+        import jax
+        from .. import autograd
+        key = _draw_key(seed)
+        eps = jax.random.normal(key, tuple(shape) + self.batch_shape)
+        loc_t = self._loc_t if self._loc_t is not None else \
+            Tensor(self.loc)
+        scale_t = self._scale_t if self._scale_t is not None else \
+            Tensor(self.scale)
+        return autograd.differentiable_apply(
+            lambda l, s: l + s * eps, loc_t, scale_t)
 
     def log_prob(self, value):
         import jax.numpy as jnp
@@ -110,6 +134,8 @@ class Uniform(Distribution):
     """Reference distribution/uniform.py: U[low, high)."""
 
     def __init__(self, low, high, name=None):
+        self._low_t = low if isinstance(low, Tensor) else None
+        self._high_t = high if isinstance(high, Tensor) else None
         self.low = _arr(low)
         self.high = _arr(high)
         import jax.numpy as jnp
@@ -118,11 +144,21 @@ class Uniform(Distribution):
 
     def sample(self, shape=(), seed=0):
         import jax
-        key = _random.next_key()
+        key = _draw_key(seed)
         u = jax.random.uniform(key, tuple(shape) + self.batch_shape)
         return Tensor(self.low + u * (self.high - self.low))
 
-    rsample = sample
+    def rsample(self, shape=(), seed=0):
+        import jax
+        from .. import autograd
+        key = _draw_key(seed)
+        u = jax.random.uniform(key, tuple(shape) + self.batch_shape)
+        low_t = self._low_t if self._low_t is not None else \
+            Tensor(self.low)
+        high_t = self._high_t if self._high_t is not None else \
+            Tensor(self.high)
+        return autograd.differentiable_apply(
+            lambda lo, hi: lo + u * (hi - lo), low_t, high_t)
 
     def log_prob(self, value):
         import jax.numpy as jnp
@@ -153,7 +189,7 @@ class Categorical(Distribution):
 
     def sample(self, shape=(), seed=0):
         import jax
-        key = _random.next_key()
+        key = _draw_key(seed)
         out = jax.random.categorical(
             key, self.logits, shape=tuple(shape) + self.batch_shape)
         return Tensor(out)
@@ -187,9 +223,9 @@ class Beta(Distribution):
     def mean(self):
         return Tensor(self.alpha / (self.alpha + self.beta))
 
-    def sample(self, shape=()):
+    def sample(self, shape=(), seed=0):
         import jax
-        key = _random.next_key()
+        key = _draw_key(seed)
         return Tensor(jax.random.beta(
             key, self.alpha, self.beta, tuple(shape) + self.batch_shape))
 
@@ -219,9 +255,9 @@ class Dirichlet(Distribution):
         super().__init__(batch_shape=self.concentration.shape[:-1],
                          event_shape=self.concentration.shape[-1:])
 
-    def sample(self, shape=()):
+    def sample(self, shape=(), seed=0):
         import jax
-        key = _random.next_key()
+        key = _draw_key(seed)
         return Tensor(jax.random.dirichlet(
             key, self.concentration, tuple(shape) + self.batch_shape))
 

@@ -28,6 +28,7 @@ import numpy as np
 from ..ops.registry import get_op
 from . import dtypes as _dtypes
 from .flags import flag_value
+from .monitor import stat_add
 from .tensor import GradNode, Tensor, is_grad_enabled
 
 Array = Any
@@ -174,6 +175,29 @@ def _amp():
 def call_op(name: str, *args, **attrs):
     """Execute a registered op eagerly on Tensors, recording the tape."""
     opdef = get_op(name)
+    stat_add(f"op_count/{name}")
+    if flag_value("FLAGS_benchmark"):
+        return _call_op_timed(name, opdef, args, attrs)
+    return _call_op_impl(name, opdef, args, attrs)
+
+
+def _call_op_timed(name, opdef, args, attrs):
+    """FLAGS_benchmark per-op timing (reference flags.cc `benchmark`):
+    blocks on the outputs, so debugging only."""
+    import time
+    t0 = time.perf_counter()
+    out = _call_op_impl(name, opdef, args, attrs)
+    try:
+        jax.block_until_ready(jax.tree_util.tree_map(
+            lambda t: t._data if isinstance(t, Tensor) else t, out,
+            is_leaf=lambda t: isinstance(t, Tensor)))
+    except Exception:
+        pass  # tracers under jit: timing is trace-time only
+    stat_add(f"op_time_ms/{name}", (time.perf_counter() - t0) * 1e3)
+    return out
+
+
+def _call_op_impl(name, opdef, args, attrs):
     # Array-valued attrs (incl. Tensors and tracers) must be TRACED inputs,
     # never closure constants: the jit cache is keyed by structure only, so a
     # baked-in value would be served back for a different value of the same

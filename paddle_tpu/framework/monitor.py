@@ -1,0 +1,60 @@
+"""Runtime counters + per-op timing (observability).
+
+Reference: paddle/fluid/platform/monitor.h:78 (``StatRegistry`` /
+``STAT_ADD`` — process-wide named int counters, e.g. GPU mem stats in
+memory/stats.cc) and the ``benchmark`` flag that prints per-op timing
+(platform/flags.cc).
+
+The dispatch layer feeds two families automatically:
+  * ``op_count/<name>`` — calls per op (always on, ~free);
+  * ``op_time_ms/<name>`` — accumulated wall ms per op when
+    ``FLAGS_benchmark`` is set (forces a block_until_ready per call, so
+    ONLY for debugging — it serializes the device).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+__all__ = ["stat_add", "stat_get", "stat_reset", "stats_summary",
+           "all_stats"]
+
+_lock = threading.Lock()
+_stats: Dict[str, float] = {}
+
+
+def stat_add(name: str, value: float = 1) -> None:
+    """STAT_ADD analog (monitor.h:131).
+
+    Lock-free on the hot path: a racing pair of threads may lose an
+    increment, which is acceptable for observability counters — taking a
+    lock per eager op dispatch is not."""
+    _stats[name] = _stats.get(name, 0) + value
+
+
+def stat_get(name: str) -> float:
+    with _lock:
+        return _stats.get(name, 0)
+
+
+def stat_reset(name: str = None) -> None:
+    with _lock:
+        if name is None:
+            _stats.clear()
+        else:
+            _stats.pop(name, None)
+
+
+def all_stats() -> Dict[str, float]:
+    with _lock:
+        return dict(_stats)
+
+
+def stats_summary(prefix: str = "") -> str:
+    """Human-readable counter table (≙ StatRegistry::publish)."""
+    rows = sorted((k, v) for k, v in all_stats().items()
+                  if k.startswith(prefix))
+    if not rows:
+        return "(no stats)"
+    w = max(len(k) for k, _ in rows)
+    return "\n".join(f"{k:<{w}}  {v:g}" for k, v in rows)

@@ -231,10 +231,13 @@ class RNN(Layer):
         from ... import autograd
         import jax.numpy as jnp
 
+        if sequence_length is not None:
+            raise NotImplementedError(
+                "sequence_length masking is not implemented; pre-mask or "
+                "bucket padded batches (TPU-native padding strategy, "
+                "SURVEY §7 hard-parts)")
         cell = self.cell
         if initial_states is None:
-            batch_ref = inputs if self.time_major else inputs
-            # batch dim: 1 for [B, T, I], 0... compute from layout
             batch = inputs.shape[0] if not self.time_major else \
                 inputs.shape[1]
             zeros = Tensor(jnp.zeros((batch, cell.hidden_size),
@@ -282,6 +285,10 @@ class BiRNN(Layer):
 
     def forward(self, inputs, initial_states=None, sequence_length=None):
         from ...framework.dispatch import call_op
+        if sequence_length is not None:
+            raise NotImplementedError(
+                "sequence_length masking is not implemented; pre-mask or "
+                "bucket padded batches")
         states_fw, states_bw = (initial_states if initial_states
                                 is not None else (None, None))
         out_fw, st_fw = self.rnn_fw(inputs, states_fw)
@@ -324,12 +331,48 @@ class RNNBase(Layer):
             self.add_sublayer(f"layer_{i}", layer)
             self._layers.append(layer)
 
+    def _split_initial(self, initial_states):
+        """Reference layout [num_layers * num_dirs, B, H] (tuple of two
+        such for LSTM) -> per-layer state structures."""
+        if initial_states is None:
+            return [None] * self.num_layers
+        num_dir = 2 if self.bidirectional else 1
+        is_lstm = isinstance(initial_states, (tuple, list)) and \
+            len(initial_states) == 2 and \
+            getattr(initial_states[0], "ndim", 0) == 3
+
+        def slab(stacked, idx):
+            return stacked[idx]
+
+        per_layer = []
+        for i in range(self.num_layers):
+            if is_lstm:
+                h_all, c_all = initial_states
+                if self.bidirectional:
+                    per_layer.append((
+                        (h_all[2 * i], c_all[2 * i]),
+                        (h_all[2 * i + 1], c_all[2 * i + 1])))
+                else:
+                    per_layer.append((h_all[i], c_all[i]))
+            else:
+                st = initial_states
+                if self.bidirectional:
+                    per_layer.append((st[2 * i], st[2 * i + 1]))
+                else:
+                    per_layer.append(st[i])
+        return per_layer
+
     def forward(self, inputs, initial_states=None, sequence_length=None):
         from ..functional import dropout as F_dropout
+        if sequence_length is not None:
+            raise NotImplementedError(
+                "sequence_length masking is not implemented; pre-mask or "
+                "bucket padded batches")
         x = inputs
         finals = []
+        per_layer_states = self._split_initial(initial_states)
         for i, layer in enumerate(self._layers):
-            x, st = layer(x, None)
+            x, st = layer(x, per_layer_states[i])
             finals.append(st)
             if self.dropout and i < self.num_layers - 1 and self.training:
                 x = F_dropout(x, p=self.dropout, training=True)

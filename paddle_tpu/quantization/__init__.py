@@ -1,0 +1,226 @@
+"""``paddle.quantization`` — QAT (fake-quant) + post-training quantization.
+
+Reference: python/paddle/fluid/contrib/slim/quantization/ —
+``ImperativeQuantAware`` (imperative/qat.py: swaps Linear/Conv2D for
+quantized variants with fake-quant on weights + moving-average abs-max
+activation observers), ``PostTrainingQuantization``
+(post_training_quantization.py: calibration-driven scale search).
+
+TPU-native: fake quantization is a straight-through-estimator expression
+(x + stop_gradient(quant(x) - x)) that XLA fuses into the surrounding
+matmul; observers are plain running stats. int8 *execution* maps to
+bf16/int8 MXU paths at inference export time — the artifact carries the
+scales (this mirrors the reference, whose QAT graphs also run float with
+fake-quant ops until a deployment pass strips them).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..framework.tensor import Tensor
+from ..nn.layer.layers import Layer
+
+__all__ = ["fake_quant", "FakeQuantAbsMax", "MovingAverageAbsMaxScale",
+           "QuantizedLinear", "QuantizedConv2D", "ImperativeQuantAware",
+           "PostTrainingQuantization"]
+
+
+def fake_quant(x, scale, bits: int = 8):
+    """Symmetric per-tensor fake quantization with an STE gradient.
+
+    q = round(clip(x, ±scale) / scale * qmax) * scale / qmax, gradient
+    passes straight through (reference fake_quantize_abs_max op).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    qmax = float(2 ** (bits - 1) - 1)
+
+    def fn(arr, s):
+        s = jnp.maximum(s.astype(arr.dtype), 1e-8)
+        q = jnp.clip(arr, -s, s) / s * qmax
+        q = jnp.round(q) * s / qmax
+        return arr + jax.lax.stop_gradient(q - arr)   # STE
+
+    if isinstance(x, Tensor):
+        from .. import autograd
+        s_t = scale if isinstance(scale, Tensor) else \
+            Tensor(jnp.asarray(scale, jnp.float32))
+        return autograd.differentiable_apply(fn, x, s_t)
+    return fn(x, jnp.asarray(
+        scale._data if isinstance(scale, Tensor) else scale, jnp.float32))
+
+
+class FakeQuantAbsMax(Layer):
+    """Weight quantizer: scale = abs-max of the current tensor."""
+
+    def __init__(self, bits: int = 8):
+        super().__init__()
+        self.bits = bits
+
+    def forward(self, x):
+        from ..framework.dispatch import call_op
+        scale = call_op("max", call_op("abs", x))
+        return fake_quant(x, scale, self.bits)
+
+
+class MovingAverageAbsMaxScale(Layer):
+    """Activation observer: EMA of abs-max (reference
+    moving_average_abs_max op). In training mode it updates its state and
+    fake-quants; in eval it applies the frozen scale."""
+
+    def __init__(self, bits: int = 8, momentum: float = 0.9):
+        super().__init__()
+        import jax.numpy as jnp
+        self.bits = bits
+        self.momentum = momentum
+        self.register_buffer("scale_state",
+                             Tensor(jnp.zeros((1,), jnp.float32)))
+
+    def forward(self, x):
+        import jax
+        import jax.numpy as jnp
+        arr = x._data if isinstance(x, Tensor) else x
+        cur = jnp.max(jnp.abs(arr)).astype(jnp.float32)
+        state = self.scale_state._data.reshape(())
+        if self.training:
+            new = jnp.where(state == 0, cur,
+                            self.momentum * state
+                            + (1 - self.momentum) * cur)
+            # observer state is a buffer: functional_state captures it
+            # under jit; eagerly we just overwrite
+            self.scale_state._data = new.reshape(1)
+            scale = new
+        else:
+            scale = jnp.where(state == 0, cur, state)
+        return fake_quant(x, scale, self.bits)
+
+
+class QuantizedLinear(Layer):
+    """Linear with fake-quantized weights + activations (reference
+    imperative/quant_layers QuantizedLinear)."""
+
+    def __init__(self, inner, weight_bits=8, activation_bits=8):
+        super().__init__()
+        self.inner = inner
+        self.weight_quant = FakeQuantAbsMax(weight_bits)
+        self.act_quant = MovingAverageAbsMaxScale(activation_bits)
+
+    def forward(self, x):
+        from ..nn import functional as F
+        x = self.act_quant(x)
+        w = self.weight_quant(self.inner.weight)
+        return F.linear(x, w, self.inner.bias)
+
+
+class QuantizedConv2D(Layer):
+    def __init__(self, inner, weight_bits=8, activation_bits=8):
+        super().__init__()
+        self.inner = inner
+        self.weight_quant = FakeQuantAbsMax(weight_bits)
+        self.act_quant = MovingAverageAbsMaxScale(activation_bits)
+
+    def forward(self, x):
+        from ..framework.dispatch import call_op
+        x = self.act_quant(x)
+        w = self.weight_quant(self.inner.weight)
+        return call_op("conv2d", x, w, self.inner.bias,
+                       stride=self.inner._stride,
+                       padding=self.inner._padding,
+                       dilation=self.inner._dilation,
+                       groups=self.inner._groups)
+
+
+class ImperativeQuantAware:
+    """QAT driver (reference imperative/qat.py:ImperativeQuantAware):
+    ``quantize(model)`` swaps quantizable sublayers in place; train as
+    usual; ``save_quantized_model`` exports via paddle.jit.save."""
+
+    def __init__(self, weight_bits=8, activation_bits=8,
+                 quantizable_layer_type=("Linear", "Conv2D")):
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.types = set(quantizable_layer_type)
+
+    def quantize(self, model: Layer) -> Layer:
+        from ..nn import Conv2D, Linear
+
+        def recurse(layer):
+            for name, sub in list(layer._sub_layers.items()):
+                if isinstance(sub, Linear) and "Linear" in self.types:
+                    layer._sub_layers[name] = QuantizedLinear(
+                        sub, self.weight_bits, self.activation_bits)
+                elif isinstance(sub, Conv2D) and "Conv2D" in self.types:
+                    layer._sub_layers[name] = QuantizedConv2D(
+                        sub, self.weight_bits, self.activation_bits)
+                else:
+                    recurse(sub)
+        recurse(model)
+        return model
+
+    def save_quantized_model(self, model, path, input_spec=None):
+        from .. import jit
+        model.eval()
+        jit.save(model, path, input_spec=input_spec)
+
+
+class PostTrainingQuantization:
+    """PTQ (reference post_training_quantization.py): run calibration
+    batches through the model recording per-layer activation abs-max,
+    then freeze the scales into quantized layers."""
+
+    def __init__(self, model: Layer, weight_bits=8, activation_bits=8):
+        self.model = model
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self._scales: Dict[str, float] = {}
+
+    def collect(self, batches) -> Dict[str, float]:
+        """Feed calibration batches; returns {layer_name: act_scale}."""
+        import jax.numpy as jnp
+        from ..nn import Conv2D, Linear
+
+        records: Dict[str, float] = {}
+        hooks = []
+        for name, sub in self.model.named_sublayers():
+            if isinstance(sub, (Linear, Conv2D)):
+                def mk(nm):
+                    def hook(layer, inputs):
+                        x = inputs[0]
+                        arr = x._data if isinstance(x, Tensor) else x
+                        cur = float(jnp.max(jnp.abs(arr)))
+                        records[nm] = max(records.get(nm, 0.0), cur)
+                        return None
+                    return hook
+                hooks.append(sub.register_forward_pre_hook(mk(name)))
+        self.model.eval()
+        try:
+            for batch in batches:
+                self.model(batch if isinstance(batch, Tensor)
+                           else Tensor(np.asarray(batch)))
+        finally:
+            for h in hooks:
+                h.remove()
+        self._scales = records
+        return dict(records)
+
+    def quantize(self) -> Layer:
+        """Swap quantizable layers, freezing collected activation scales
+        (observers start from the calibrated value, eval-mode apply)."""
+        import jax.numpy as jnp
+        qat = ImperativeQuantAware(self.weight_bits, self.activation_bits)
+        name_map = dict(self._scales)
+        # remember original names before swapping
+        originals = {id(sub): nm for nm, sub in
+                     self.model.named_sublayers()}
+        qat.quantize(self.model)
+        for _, sub in self.model.named_sublayers():
+            if isinstance(sub, (QuantizedLinear, QuantizedConv2D)):
+                nm = originals.get(id(sub.inner))
+                if nm in name_map and name_map[nm] > 0:
+                    sub.act_quant.scale_state._data = jnp.asarray(
+                        [name_map[nm]], jnp.float32)
+        self.model.eval()
+        return self.model

@@ -1,0 +1,299 @@
+"""``paddle.sparse`` — COO/CSR sparse tensors and math.
+
+Reference: python/paddle/incubate/sparse/ (creation.py sparse_coo_tensor /
+sparse_csr_tensor, unary.py, binary.py math, nn/) over the phi
+SparseCooTensor/SparseCsrTensor kernels (paddle/phi/kernels/sparse/).
+
+TPU-native: storage is ``jax.experimental.sparse`` BCOO/BCSR — batched
+COO with static nse, which is the XLA-compatible sparse format (dynamic
+nnz is hostile to the compiler; the reference's dynamic-shape sparse
+kernels have no TPU analog). Elementwise math maps onto the values;
+spmm lowers through ``bcoo_dot_general``.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..framework.tensor import Tensor
+
+__all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
+           "SparseCsrTensor", "is_sparse", "add", "subtract", "multiply",
+           "divide", "matmul", "masked_matmul", "relu", "sqrt", "sin",
+           "tanh", "abs", "pow", "neg", "cast", "to_dense"]
+
+
+def _bcoo():
+    from jax.experimental import sparse as jsparse
+    return jsparse
+
+
+class SparseCooTensor:
+    """COO sparse tensor (reference phi::SparseCooTensor)."""
+
+    def __init__(self, bcoo):
+        self._mat = bcoo
+
+    # -- construction ------------------------------------------------------
+    @classmethod
+    def from_parts(cls, indices, values, shape):
+        import jax.numpy as jnp
+        jsparse = _bcoo()
+        idx = jnp.asarray(indices)
+        vals = jnp.asarray(values)
+        if idx.ndim != 2:
+            raise ValueError("indices must be [sparse_ndim, nnz]")
+        mat = jsparse.BCOO((vals, idx.T), shape=tuple(shape))
+        return cls(mat)
+
+    # -- paddle API --------------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._mat.shape)
+
+    @property
+    def dtype(self):
+        return self._mat.dtype
+
+    def nnz(self) -> int:
+        return int(self._mat.nse)
+
+    def indices(self) -> Tensor:
+        return Tensor(self._mat.indices.T)
+
+    def values(self) -> Tensor:
+        return Tensor(self._mat.data)
+
+    def to_dense(self) -> Tensor:
+        return Tensor(self._mat.todense())
+
+    def coalesce(self) -> "SparseCooTensor":
+        return SparseCooTensor(self._mat.sum_duplicates())
+
+    def is_sparse_coo(self):
+        return True
+
+    def is_sparse_csr(self):
+        return False
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+    def _map_values(self, fn) -> "SparseCooTensor":
+        jsparse = _bcoo()
+        mat = jsparse.BCOO((fn(self._mat.data), self._mat.indices),
+                           shape=self._mat.shape)
+        return SparseCooTensor(mat)
+
+
+class SparseCsrTensor:
+    """CSR sparse tensor (reference phi::SparseCsrTensor)."""
+
+    def __init__(self, bcsr):
+        self._mat = bcsr
+
+    @classmethod
+    def from_parts(cls, crows, cols, values, shape):
+        import jax.numpy as jnp
+        jsparse = _bcoo()
+        mat = jsparse.BCSR(
+            (jnp.asarray(values), jnp.asarray(cols),
+             jnp.asarray(crows)), shape=tuple(shape))
+        return cls(mat)
+
+    @property
+    def shape(self):
+        return list(self._mat.shape)
+
+    @property
+    def dtype(self):
+        return self._mat.dtype
+
+    def nnz(self) -> int:
+        return int(self._mat.nse)
+
+    def crows(self) -> Tensor:
+        return Tensor(self._mat.indptr)
+
+    def cols(self) -> Tensor:
+        return Tensor(self._mat.indices)
+
+    def values(self) -> Tensor:
+        return Tensor(self._mat.data)
+
+    def to_dense(self) -> Tensor:
+        return Tensor(self._mat.todense())
+
+    def is_sparse_coo(self):
+        return False
+
+    def is_sparse_csr(self):
+        return True
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      place=None, stop_gradient=True):
+    """Reference: incubate/sparse/creation.py sparse_coo_tensor."""
+    import jax.numpy as jnp
+    idx = np.asarray(indices if not isinstance(indices, Tensor)
+                     else indices.numpy())
+    vals = values.numpy() if isinstance(values, Tensor) else \
+        np.asarray(values)
+    if dtype is not None:
+        from ..framework.dtypes import convert_dtype
+        vals = vals.astype(convert_dtype(dtype))
+    if shape is None:
+        shape = tuple(int(m) + 1 for m in idx.max(axis=1))
+    return SparseCooTensor.from_parts(idx, vals, shape)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
+                      place=None, stop_gradient=True):
+    """Reference: incubate/sparse/creation.py sparse_csr_tensor."""
+    vals = values.numpy() if isinstance(values, Tensor) else \
+        np.asarray(values)
+    if dtype is not None:
+        from ..framework.dtypes import convert_dtype
+        vals = vals.astype(convert_dtype(dtype))
+    crows = crows.numpy() if isinstance(crows, Tensor) else \
+        np.asarray(crows)
+    cols = cols.numpy() if isinstance(cols, Tensor) else np.asarray(cols)
+    return SparseCsrTensor.from_parts(crows, cols, vals, shape)
+
+
+def is_sparse(x) -> bool:
+    return isinstance(x, (SparseCooTensor, SparseCsrTensor))
+
+
+def to_dense(x):
+    return x.to_dense() if is_sparse(x) else x
+
+
+# ---------------------------------------------------------------------------
+# math (reference incubate/sparse/{unary,binary}.py)
+# ---------------------------------------------------------------------------
+
+def _same_pattern(a: SparseCooTensor, b: SparseCooTensor) -> bool:
+    import jax.numpy as jnp
+    ia, ib = a._mat.indices, b._mat.indices
+    return ia.shape == ib.shape and bool(jnp.all(ia == ib))
+
+
+def _binary(a, b, fn):
+    jsparse = _bcoo()
+    if isinstance(a, SparseCooTensor) and isinstance(b, SparseCooTensor):
+        if _same_pattern(a, b):
+            mat = jsparse.BCOO((fn(a._mat.data, b._mat.data),
+                                a._mat.indices), shape=a._mat.shape)
+            return SparseCooTensor(mat)
+        # differing patterns: densify (the reference's kernels merge
+        # patterns; under static shapes densify is the honest fallback)
+        return Tensor(fn(a._mat.todense(), b._mat.todense()))
+    da = a._mat.todense() if is_sparse(a) else (
+        a._data if isinstance(a, Tensor) else a)
+    db = b._mat.todense() if is_sparse(b) else (
+        b._data if isinstance(b, Tensor) else b)
+    return Tensor(fn(da, db))
+
+
+def add(a, b):
+    return _binary(a, b, lambda x, y: x + y)
+
+
+def subtract(a, b):
+    return _binary(a, b, lambda x, y: x - y)
+
+
+def multiply(a, b):
+    return _binary(a, b, lambda x, y: x * y)
+
+
+def divide(a, b):
+    return _binary(a, b, lambda x, y: x / y)
+
+
+def matmul(a, b):
+    """sparse @ dense (reference sparse/binary.py matmul) via
+    bcoo_dot_general — the spmm path XLA can fuse."""
+    import jax.numpy as jnp
+    db = b._data if isinstance(b, Tensor) else jnp.asarray(b)
+    if isinstance(a, SparseCsrTensor):
+        a = SparseCooTensor(a._mat.to_bcoo())
+    if isinstance(a, SparseCooTensor):
+        jsparse = _bcoo()
+        out = jsparse.bcoo_dot_general(
+            a._mat, db,
+            dimension_numbers=(((a._mat.ndim - 1,), (0,)), ((), ())))
+        return Tensor(out)
+    da = a._data if isinstance(a, Tensor) else jnp.asarray(a)
+    return Tensor(da @ db)
+
+
+def masked_matmul(x, y, mask):
+    """dense @ dense sampled at mask's pattern (reference
+    sparse/binary.py masked_matmul — SDDMM)."""
+    import jax.numpy as jnp
+    jsparse = _bcoo()
+    dx = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    dy = y._data if isinstance(y, Tensor) else jnp.asarray(y)
+    if not isinstance(mask, SparseCooTensor):
+        raise TypeError("mask must be a SparseCooTensor")
+    idx = mask._mat.indices          # [nnz, 2]
+    rows, cols = idx[:, 0], idx[:, 1]
+    vals = (dx[rows, :] * dy[:, cols].T).sum(-1)
+    return SparseCooTensor(jsparse.BCOO((vals, idx),
+                                        shape=mask._mat.shape))
+
+
+def _unary(name, fn):
+    def op(x):
+        if isinstance(x, SparseCooTensor):
+            return x._map_values(fn)
+        if isinstance(x, SparseCsrTensor):
+            jsparse = _bcoo()
+            mat = jsparse.BCSR((fn(x._mat.data), x._mat.indices,
+                                x._mat.indptr), shape=x._mat.shape)
+            return SparseCsrTensor(mat)
+        from ..framework.dispatch import call_op
+        return call_op(name, x)
+    op.__name__ = name
+    return op
+
+
+import jax.numpy as _jnp  # noqa: E402
+import jax as _jax  # noqa: E402
+
+relu = _unary("relu", lambda v: _jax.nn.relu(v))
+sqrt = _unary("sqrt", _jnp.sqrt)
+sin = _unary("sin", _jnp.sin)
+tanh = _unary("tanh", _jnp.tanh)
+abs = _unary("abs", _jnp.abs)  # noqa: A001
+neg = _unary("neg", lambda v: -v)
+
+
+def pow(x, factor):  # noqa: A001
+    if is_sparse(x):
+        return x._map_values(lambda v: v ** factor)
+    from ..framework.dispatch import call_op
+    return call_op("pow", x, y=factor)
+
+
+def cast(x, index_dtype=None, value_dtype=None):
+    from ..framework.dtypes import convert_dtype
+    if isinstance(x, SparseCooTensor):
+        jsparse = _bcoo()
+        idx = x._mat.indices
+        vals = x._mat.data
+        if index_dtype is not None:
+            idx = idx.astype(convert_dtype(index_dtype))
+        if value_dtype is not None:
+            vals = vals.astype(convert_dtype(value_dtype))
+        return SparseCooTensor(jsparse.BCOO((vals, idx),
+                                            shape=x._mat.shape))
+    raise TypeError("cast expects a SparseCooTensor")

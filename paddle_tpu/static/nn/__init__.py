@@ -130,18 +130,22 @@ def switch_case(branch_index, branch_fns, default=None, name=None):
     else:
         pairs = list(enumerate(branch_fns))
     idx = _pred_value(branch_index)
+    keys = [k for k, _ in pairs]
+    # reference semantics: an unmatched index runs `default`, or the
+    # MAX-key branch when no default is given (control_flow.py
+    # switch_case) — identical for eager and traced execution
+    fallback_pos = len(pairs) if default is not None else \
+        keys.index(max(keys))
     if not _is_tracer(idx):
         i = int(idx)
         for k, fn in pairs:
             if k == i:
                 return fn()
-        if default is None:
-            raise ValueError(f"branch index {i} not found and no default")
-        return default()
+        return default() if default is not None else \
+            pairs[fallback_pos][1]()
     import jax
     import jax.numpy as jnp
 
-    keys = [k for k, _ in pairs]
     fns = [fn for _, fn in pairs]
     if default is not None:
         fns = fns + [default]
@@ -156,9 +160,7 @@ def switch_case(branch_index, branch_fns, default=None, name=None):
             return _to_arrays(out)
         return f
 
-    # map branch_index -> position in fns (unknown keys -> default slot)
-    pos = jnp.full((), len(fns) - 1 if default is not None else 0,
-                   jnp.int32)
+    pos = jnp.full((), fallback_pos, jnp.int32)
     for j, k in enumerate(keys):
         pos = jnp.where(idx == k, j, pos)
     out = jax.lax.switch(pos, [mk(f) for f in fns], 0)

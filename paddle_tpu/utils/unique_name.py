@@ -1,0 +1,44 @@
+"""Reference: python/paddle/utils/unique_name.py (generate/guard/switch)."""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+__all__ = ["generate", "guard", "switch"]
+
+
+class _Generator:
+    def __init__(self):
+        self.ids = {}
+
+    def __call__(self, key):
+        self.ids[key] = self.ids.get(key, 0) + 1
+        return f"{key}_{self.ids[key] - 1}"
+
+
+_tls = threading.local()
+
+
+def _gen() -> _Generator:
+    if not hasattr(_tls, "gen"):
+        _tls.gen = _Generator()
+    return _tls.gen
+
+
+def generate(key: str) -> str:
+    return _gen()(key)
+
+
+def switch(new_generator=None):
+    old = _gen()
+    _tls.gen = new_generator or _Generator()
+    return old
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    old = switch(new_generator)
+    try:
+        yield
+    finally:
+        _tls.gen = old

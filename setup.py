@@ -1,0 +1,10 @@
+"""Build script (reference: the CMake superbuild collapses to a pure-python
+wheel + optional C extensions; see CMakeLists.txt:48-264 option matrix).
+
+Native components (the tpu_dataio shared-memory ring, built via cc) are
+compiled on demand at import time with a graceful pure-python fallback, so
+the wheel itself stays universal. ``pip install -e .`` works offline.
+"""
+from setuptools import setup
+
+setup()

@@ -1,0 +1,80 @@
+"""Sharded checkpoint tests: save on N shards, load on M (reference
+analog: auto_parallel dist_saver.py + converter.py slice/merge)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.distributed import checkpoint as dck
+from paddle_tpu.distributed import env as denv
+from paddle_tpu.distributed.spmd import ParallelEngine
+
+rng = np.random.RandomState(0)
+
+
+def _mesh(shard_deg):
+    return denv.build_mesh({"data": 1, "pipe": 1, "sharding": shard_deg,
+                            "sep": 1, "expert": 1, "model": 1})
+
+
+def _engine(zero_stage, shard_deg, seed=21):
+    paddle.framework.random.seed(seed)
+    model = nn.Sequential(nn.Linear(16, 64), nn.ReLU(), nn.Linear(64, 8))
+    opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                 parameters=model.parameters())
+    return ParallelEngine(model, opt,
+                          loss_fn=lambda a, b: F.cross_entropy(a, b),
+                          mesh=_mesh(shard_deg), zero_stage=zero_stage)
+
+
+class TestShardedCheckpoint:
+    def test_save_on_8_shards_load_replicated(self, tmp_path):
+        """ZeRO-3 param shards over 8 devices -> restore into an
+        unsharded engine; training continues bit-identically."""
+        x = rng.randn(16, 16).astype(np.float32)
+        y = rng.randint(0, 8, (16,)).astype(np.int64)
+
+        writer = _engine(zero_stage=3, shard_deg=8)
+        for _ in range(3):
+            writer.train_step([x], [y])
+        ref_next = writer.train_step([x], [y])  # step 4 from the writer
+        # rebuild to state at step 3 for a fair resume comparison
+        writer2 = _engine(zero_stage=3, shard_deg=8)
+        for _ in range(3):
+            writer2.train_step([x], [y])
+        dck.save_state_dict(writer2, str(tmp_path / "ckpt"))
+
+        reader = _engine(zero_stage=0, shard_deg=1, seed=99)  # M != N
+        dck.load_state_dict(reader, str(tmp_path / "ckpt"))
+        # restored leaves carry the READER's shardings
+        wname = next(iter(reader.params))
+        assert "sharding" not in str(reader.params[wname].sharding.spec)
+        resumed = reader.train_step([x], [y])
+        np.testing.assert_allclose(resumed, ref_next, rtol=1e-5)
+
+    def test_save_replicated_load_sharded(self, tmp_path):
+        x = rng.randn(16, 16).astype(np.float32)
+        y = rng.randint(0, 8, (16,)).astype(np.int64)
+        writer = _engine(zero_stage=0, shard_deg=1)
+        writer.train_step([x], [y])
+        dck.save_state_dict(writer, str(tmp_path / "ckpt"))
+
+        reader = _engine(zero_stage=3, shard_deg=8, seed=99)
+        dck.load_state_dict(reader, str(tmp_path / "ckpt"))
+        wname = [n for n in reader.params if "weight" in n][0]
+        assert "sharding" in str(reader.params[wname].sharding.spec)
+        l1 = writer.train_step([x], [y])
+        l2 = reader.train_step([x], [y])
+        np.testing.assert_allclose(l2, l1, rtol=1e-5)
+
+    def test_plain_pytree_roundtrip(self, tmp_path):
+        import jax.numpy as jnp
+        tree = {"a": jnp.arange(12.0).reshape(3, 4),
+                "b": {"c": jnp.ones((5,), jnp.int32)}}
+        dck.save_sharded(tree, str(tmp_path / "t"))
+        back = dck.load_sharded(str(tmp_path / "t"))
+        np.testing.assert_array_equal(np.asarray(back["a"]),
+                                      np.asarray(tree["a"]))
+        np.testing.assert_array_equal(np.asarray(back["b"]["c"]),
+                                      np.asarray(tree["b"]["c"]))

@@ -1,0 +1,133 @@
+"""Quantization tests (reference: slim QAT/PTQ unittests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.quantization import (FakeQuantAbsMax, ImperativeQuantAware,
+                                     PostTrainingQuantization,
+                                     QuantizedConv2D, QuantizedLinear,
+                                     fake_quant)
+
+rng = np.random.RandomState(0)
+
+
+class TestFakeQuant:
+    def test_values_snap_to_grid(self):
+        x = paddle.to_tensor(np.linspace(-1, 1, 11).astype(np.float32))
+        q = fake_quant(x, 1.0, bits=8)
+        grid = q.numpy() * 127.0
+        np.testing.assert_allclose(grid, np.round(grid), atol=1e-5)
+        np.testing.assert_allclose(q.numpy(), x.numpy(), atol=1 / 127)
+
+    def test_clipping(self):
+        x = paddle.to_tensor(np.array([-3.0, 0.5, 3.0], np.float32))
+        q = fake_quant(x, 1.0, bits=8)
+        np.testing.assert_allclose(q.numpy(), [-1.0, 0.5, 1.0], atol=0.01)
+
+    def test_ste_gradient_passes_through(self):
+        x = paddle.to_tensor(rng.randn(8).astype(np.float32),
+                             stop_gradient=False)
+        q = fake_quant(x, 2.0)
+        loss = (q * q).sum()
+        loss.backward()
+        assert x.grad is not None
+        # STE: d(loss)/dx == 2*q (as if quant were identity)
+        np.testing.assert_allclose(x.grad.numpy(), 2 * q.numpy(),
+                                   rtol=1e-4)
+
+
+class TestQAT:
+    def _net(self):
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc1 = nn.Linear(8, 16)
+                self.fc2 = nn.Linear(16, 4)
+
+            def forward(self, x):
+                return self.fc2(F.relu(self.fc1(x)))
+
+        return Net()
+
+    def test_quantize_swaps_layers(self):
+        paddle.framework.random.seed(0)
+        net = self._net()
+        ImperativeQuantAware().quantize(net)
+        assert isinstance(net.fc1, QuantizedLinear)
+        assert isinstance(net.fc2, QuantizedLinear)
+        x = paddle.to_tensor(rng.randn(4, 8).astype(np.float32))
+        out = net(x)
+        assert out.shape == [4, 4]
+
+    def test_qat_trains_and_tracks_float(self):
+        paddle.framework.random.seed(1)
+        net = self._net()
+        x = paddle.to_tensor(rng.randn(16, 8).astype(np.float32))
+        y = paddle.to_tensor(rng.randint(0, 4, (16,)).astype(np.int64))
+        float_out = net(x).numpy()
+        ImperativeQuantAware().quantize(net)
+        net.train()
+        qat_out = net(x).numpy()
+        # int8 fake-quant stays close to float forward
+        assert np.abs(qat_out - float_out).max() < 0.15, \
+            np.abs(qat_out - float_out).max()
+        opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                    parameters=net.parameters())
+        losses = []
+        for _ in range(12):
+            loss = F.cross_entropy(net(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
+
+    def test_conv_quantization(self):
+        paddle.framework.random.seed(2)
+
+        class CNet(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.conv = nn.Conv2D(3, 8, 3, padding=1)
+
+            def forward(self, x):
+                return self.conv(x)
+
+        net = CNet()
+        x = paddle.to_tensor(rng.randn(2, 3, 8, 8).astype(np.float32))
+        float_out = net(x).numpy()
+        ImperativeQuantAware().quantize(net)
+        assert isinstance(net.conv, QuantizedConv2D)
+        q_out = net(x).numpy()
+        assert q_out.shape == float_out.shape
+        assert np.abs(q_out - float_out).max() < 0.2
+
+
+class TestPTQ:
+    def test_collect_and_freeze_scales(self):
+        paddle.framework.random.seed(3)
+
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(8, 4)
+
+            def forward(self, x):
+                return self.fc(x)
+
+        net = Net()
+        ptq = PostTrainingQuantization(net)
+        batches = [rng.randn(4, 8).astype(np.float32) * 3 for _ in range(5)]
+        scales = ptq.collect(batches)
+        assert "fc" in scales and scales["fc"] > 0
+        expected = max(np.abs(b).max() for b in batches)
+        np.testing.assert_allclose(scales["fc"], expected, rtol=1e-6)
+        qnet = ptq.quantize()
+        assert isinstance(qnet.fc, QuantizedLinear)
+        got = float(qnet.fc.act_quant.scale_state.numpy()[0])
+        np.testing.assert_allclose(got, expected, rtol=1e-6)
+        x = paddle.to_tensor(batches[0])
+        out = qnet(x)
+        assert out.shape == [4, 4]

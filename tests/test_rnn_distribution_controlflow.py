@@ -315,3 +315,69 @@ class TestControlFlow:
         g = jax.jit(jax.grad(loss_fn))(jnp.asarray(2.0),
                                        jnp.asarray([1.0, 2.0]))
         np.testing.assert_allclose(float(g), 2 * 2.0 * (1 + 4), rtol=1e-5)
+
+
+class TestReviewFixes:
+    """r3 code-review findings: initial_states threading, rsample
+    differentiability, switch_case fallback parity."""
+
+    def test_rnnbase_initial_states_used(self):
+        paddle.framework.random.seed(30)
+        lstm = nn.LSTM(4, 8, num_layers=2)
+        x = paddle.to_tensor(rng.randn(3, 5, 4).astype(np.float32))
+        h0 = paddle.to_tensor(rng.randn(2, 3, 8).astype(np.float32))
+        c0 = paddle.to_tensor(rng.randn(2, 3, 8).astype(np.float32))
+        out_zero, _ = lstm(x)
+        out_init, _ = lstm(x, (h0, c0))
+        assert np.abs(out_zero.numpy() - out_init.numpy()).max() > 1e-4, \
+            "nonzero initial states were ignored"
+        # zero initial states explicitly == default
+        z = paddle.to_tensor(np.zeros((2, 3, 8), np.float32))
+        out_explicit_zero, _ = lstm(x, (z, z))
+        np.testing.assert_allclose(out_explicit_zero.numpy(),
+                                   out_zero.numpy(), atol=1e-6)
+
+    def test_sequence_length_raises(self):
+        import pytest as _pytest
+        gru = nn.GRU(4, 8)
+        x = paddle.to_tensor(rng.randn(2, 5, 4).astype(np.float32))
+        with _pytest.raises(NotImplementedError):
+            gru(x, sequence_length=paddle.to_tensor(
+                np.array([5, 3], np.int64)))
+
+    def test_rsample_differentiable(self):
+        from paddle_tpu.distribution import Normal
+        loc = paddle.to_tensor(np.array(0.5, np.float32),
+                               stop_gradient=False)
+        scale = paddle.to_tensor(np.array(1.5, np.float32),
+                                 stop_gradient=False)
+        d = Normal(loc, scale)
+        s = d.rsample([64], seed=7)
+        loss = (s * s).mean()
+        loss.backward()
+        assert loc.grad is not None and scale.grad is not None
+        assert abs(float(loc.grad)) > 0
+
+    def test_sample_seed_reproducible(self):
+        from paddle_tpu.distribution import Normal
+        d = Normal(0.0, 1.0)
+        a = d.sample([8], seed=42).numpy()
+        b = d.sample([8], seed=42).numpy()
+        np.testing.assert_array_equal(a, b)
+
+    def test_switch_case_fallback_max_key_both_regimes(self):
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.static.nn import switch_case
+
+        fns = {1: lambda: paddle.to_tensor(10.0),
+               3: lambda: paddle.to_tensor(30.0)}
+        # eager: unmatched index -> max-key branch (reference semantics)
+        out = switch_case(paddle.to_tensor(np.array(9, np.int32)), fns)
+        assert float(out) == 30.0
+
+        def f(i):
+            return switch_case(paddle.to_tensor(i), dict(fns))._data
+
+        out_traced = jax.jit(f)(jnp.asarray(9, jnp.int32))
+        assert float(out_traced) == 30.0
