@@ -1,0 +1,253 @@
+// tpu_dataio — POSIX shared-memory ring buffer for DataLoader worker
+// processes.
+//
+// Reference analog: paddle/fluid/memory/allocation/mmap_allocator.cc
+// (shared-memory tensors for DataLoader subprocess workers) +
+// python/paddle/fluid/dataloader/dataloader_iter.py's
+// _shared_memory_batch_queue. Worker processes serialize batches into
+// fixed-size slots of one shm segment; the parent pops them without a
+// pickle-over-pipe copy. Synchronisation is a process-shared mutex +
+// condvars living in the segment header, so any worker/parent crash is
+// recoverable by destroying the segment (the reference installs signal
+// handlers for the same reason).
+//
+// C ABI (consumed from Python via ctypes — no pybind in this image):
+//   td_create(name, slot_bytes, n_slots) -> fd-like handle (>=0) or -errno
+//   td_attach(name)                      -> handle
+//   td_push(h, buf, len, timeout_ms)     -> 0, -ETIMEDOUT, -EMSGSIZE
+//   td_pop(h, buf, cap, timeout_ms)      -> nbytes, -ETIMEDOUT, -EMSGSIZE
+//   td_close(h), td_destroy(name)
+//
+// Build: g++ -O2 -shared -fPIC -o libtpu_dataio.so tpu_dataio.cc -lpthread -lrt
+
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <ctime>
+
+#include <fcntl.h>
+#include <pthread.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint64_t kMagic = 0x7464696f52494e47ull;  // "tdioRING"
+
+struct RingHeader {
+  uint64_t magic;
+  uint64_t slot_bytes;   // payload capacity per slot
+  uint64_t n_slots;
+  uint64_t head;         // next slot to pop
+  uint64_t tail;         // next slot to push
+  uint64_t count;        // filled slots
+  pthread_mutex_t mu;
+  pthread_cond_t not_full;
+  pthread_cond_t not_empty;
+};
+
+struct Slot {
+  uint64_t len;
+  // payload follows
+};
+
+struct Mapping {
+  RingHeader* hdr;
+  size_t map_bytes;
+  bool used;
+};
+
+constexpr int kMaxHandles = 256;
+Mapping g_maps[kMaxHandles];
+
+size_t ring_bytes(uint64_t slot_bytes, uint64_t n_slots) {
+  return sizeof(RingHeader) + n_slots * (sizeof(Slot) + slot_bytes);
+}
+
+Slot* slot_at(RingHeader* h, uint64_t i) {
+  char* base = reinterpret_cast<char*>(h) + sizeof(RingHeader);
+  return reinterpret_cast<Slot*>(base + i * (sizeof(Slot) + h->slot_bytes));
+}
+
+int alloc_handle(RingHeader* hdr, size_t bytes) {
+  for (int i = 0; i < kMaxHandles; ++i) {
+    if (!g_maps[i].used) {
+      g_maps[i] = {hdr, bytes, true};
+      return i;
+    }
+  }
+  return -EMFILE;
+}
+
+RingHeader* hdr_of(int h) {
+  if (h < 0 || h >= kMaxHandles || !g_maps[h].used) return nullptr;
+  return g_maps[h].hdr;
+}
+
+void abstime_in(struct timespec* ts, long timeout_ms) {
+  clock_gettime(CLOCK_REALTIME, ts);
+  ts->tv_sec += timeout_ms / 1000;
+  ts->tv_nsec += (timeout_ms % 1000) * 1000000L;
+  if (ts->tv_nsec >= 1000000000L) {
+    ts->tv_sec += 1;
+    ts->tv_nsec -= 1000000000L;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+int td_create(const char* name, uint64_t slot_bytes, uint64_t n_slots) {
+  if (slot_bytes == 0 || n_slots == 0) return -EINVAL;
+  shm_unlink(name);  // stale segment from a crashed run
+  int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return -errno;
+  size_t bytes = ring_bytes(slot_bytes, n_slots);
+  if (ftruncate(fd, static_cast<off_t>(bytes)) != 0) {
+    int e = errno;
+    close(fd);
+    shm_unlink(name);
+    return -e;
+  }
+  void* mem = mmap(nullptr, bytes, PROT_READ | PROT_WRITE, MAP_SHARED,
+                   fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) return -errno;
+  auto* hdr = static_cast<RingHeader*>(mem);
+  hdr->slot_bytes = slot_bytes;
+  hdr->n_slots = n_slots;
+  hdr->head = hdr->tail = hdr->count = 0;
+
+  pthread_mutexattr_t ma;
+  pthread_mutexattr_init(&ma);
+  pthread_mutexattr_setpshared(&ma, PTHREAD_PROCESS_SHARED);
+#if defined(__linux__)
+  // PTHREAD_MUTEX_ROBUST is an enum on glibc (an #ifdef on it is always
+  // false!) — robustness is required so a killed worker can't wedge the
+  // whole pipeline holding the lock
+  pthread_mutexattr_setrobust(&ma, PTHREAD_MUTEX_ROBUST);
+#endif
+  pthread_mutex_init(&hdr->mu, &ma);
+  pthread_condattr_t ca;
+  pthread_condattr_init(&ca);
+  pthread_condattr_setpshared(&ca, PTHREAD_PROCESS_SHARED);
+  pthread_cond_init(&hdr->not_full, &ca);
+  pthread_cond_init(&hdr->not_empty, &ca);
+  __sync_synchronize();
+  hdr->magic = kMagic;
+  return alloc_handle(hdr, bytes);
+}
+
+int td_attach(const char* name) {
+  int fd = shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return -errno;
+  struct stat st;
+  if (fstat(fd, &st) != 0) {
+    int e = errno;
+    close(fd);
+    return -e;
+  }
+  void* mem = mmap(nullptr, static_cast<size_t>(st.st_size),
+                   PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) return -errno;
+  auto* hdr = static_cast<RingHeader*>(mem);
+  if (hdr->magic != kMagic) {
+    munmap(mem, static_cast<size_t>(st.st_size));
+    return -EPROTO;
+  }
+  return alloc_handle(hdr, static_cast<size_t>(st.st_size));
+}
+
+static int lock_mu(RingHeader* h) {
+  int rc = pthread_mutex_lock(&h->mu);
+#if defined(__linux__)
+  if (rc == EOWNERDEAD) {
+    // a worker died holding the lock: state is consistent enough for a
+    // queue (we only mutate under the lock), recover and continue
+    pthread_mutex_consistent(&h->mu);
+    rc = 0;
+  }
+#endif
+  return rc;
+}
+
+int td_push(int h, const void* buf, uint64_t len, long timeout_ms) {
+  RingHeader* hdr = hdr_of(h);
+  if (!hdr) return -EBADF;
+  if (len > hdr->slot_bytes) return -EMSGSIZE;
+  struct timespec ts;
+  abstime_in(&ts, timeout_ms);
+  if (lock_mu(hdr) != 0) return -EINVAL;
+  while (hdr->count == hdr->n_slots) {
+    int rc = pthread_cond_timedwait(&hdr->not_full, &hdr->mu, &ts);
+    if (rc == ETIMEDOUT) {
+      pthread_mutex_unlock(&hdr->mu);
+      return -ETIMEDOUT;
+    }
+  }
+  Slot* s = slot_at(hdr, hdr->tail);
+  s->len = len;
+  memcpy(reinterpret_cast<char*>(s) + sizeof(Slot), buf, len);
+  hdr->tail = (hdr->tail + 1) % hdr->n_slots;
+  hdr->count += 1;
+  pthread_cond_signal(&hdr->not_empty);
+  pthread_mutex_unlock(&hdr->mu);
+  return 0;
+}
+
+long long td_pop(int h, void* buf, uint64_t cap, long timeout_ms) {
+  RingHeader* hdr = hdr_of(h);
+  if (!hdr) return -EBADF;
+  struct timespec ts;
+  abstime_in(&ts, timeout_ms);
+  if (lock_mu(hdr) != 0) return -EINVAL;
+  while (hdr->count == 0) {
+    int rc = pthread_cond_timedwait(&hdr->not_empty, &hdr->mu, &ts);
+    if (rc == ETIMEDOUT) {
+      pthread_mutex_unlock(&hdr->mu);
+      return -ETIMEDOUT;
+    }
+  }
+  Slot* s = slot_at(hdr, hdr->head);
+  uint64_t len = s->len;
+  if (len > cap) {
+    pthread_mutex_unlock(&hdr->mu);
+    return -EMSGSIZE;
+  }
+  memcpy(buf, reinterpret_cast<char*>(s) + sizeof(Slot), len);
+  hdr->head = (hdr->head + 1) % hdr->n_slots;
+  hdr->count -= 1;
+  pthread_cond_signal(&hdr->not_full);
+  pthread_mutex_unlock(&hdr->mu);
+  return static_cast<long long>(len);
+}
+
+uint64_t td_slot_bytes(int h) {
+  RingHeader* hdr = hdr_of(h);
+  return hdr ? hdr->slot_bytes : 0;
+}
+
+uint64_t td_pending(int h) {
+  RingHeader* hdr = hdr_of(h);
+  if (!hdr) return 0;
+  lock_mu(hdr);
+  uint64_t n = hdr->count;
+  pthread_mutex_unlock(&hdr->mu);
+  return n;
+}
+
+int td_close(int h) {
+  if (h < 0 || h >= kMaxHandles || !g_maps[h].used) return -EBADF;
+  munmap(g_maps[h].hdr, g_maps[h].map_bytes);
+  g_maps[h].used = false;
+  return 0;
+}
+
+int td_destroy(const char* name) {
+  return shm_unlink(name) == 0 ? 0 : -errno;
+}
+
+}  // extern "C"

@@ -34,8 +34,15 @@ def _device(device=None):
         return jax.devices()[0]
     if isinstance(device, int):
         return jax.devices()[device]
-    if isinstance(device, str) and ":" in device:
-        return jax.devices()[int(device.rsplit(":", 1)[1])]
+    if isinstance(device, str):
+        if ":" in device:
+            return jax.devices()[int(device.rsplit(":", 1)[1])]
+        # index-less name ("tpu", "gpu", "cpu"): first device of that
+        # platform, falling back to the default device
+        try:
+            return jax.devices(device)[0]
+        except Exception:
+            return jax.devices()[0]
     return device
 
 

@@ -161,18 +161,28 @@ def shard_op(op_fn, process_mesh: Optional[ProcessMesh] = None,
 
     def wrapped(*args, **kwargs):
         def put(a, spec):
-            if isinstance(a, Tensor) or hasattr(a, "ndim"):
+            if spec is not None and (isinstance(a, Tensor)
+                                     or hasattr(a, "ndim")):
                 return shard_tensor(a, process_mesh, spec)
             return a
 
+        def pad(specs, n):
+            # zip truncation would silently DROP args/outputs beyond the
+            # spec list; absent specs mean "leave unconstrained"
+            specs = list(specs)
+            return specs + [None] * (n - len(specs))
+
         if in_specs is not None:
-            args = tuple(put(a, s) for a, s in zip(args, in_specs))
+            args = tuple(put(a, s)
+                         for a, s in zip(args, pad(in_specs, len(args))))
         out = op_fn(*args, **kwargs)
         if out_specs is None:
             return out
         if isinstance(out, (list, tuple)):
+            specs = out_specs if isinstance(out_specs, (list, tuple)) \
+                else [out_specs]
             return type(out)(put(o, s)
-                             for o, s in zip(out, out_specs))
+                             for o, s in zip(out, pad(specs, len(out))))
         return put(out, out_specs if not isinstance(out_specs, (list,
                    tuple)) else out_specs[0])
 

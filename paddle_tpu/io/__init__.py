@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import itertools
 import math
+import os
 import queue as _queue
 import threading
 from typing import Any, Iterable, List, Optional, Sequence
@@ -321,6 +322,7 @@ class DataLoader:
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = max(0, int(num_workers))
         self.prefetch_factor = max(1, int(prefetch_factor))
+        self.use_shared_memory = bool(use_shared_memory)
         self.timeout = timeout
         self.worker_init_fn = worker_init_fn
         self._iterable_mode = isinstance(dataset, IterableDataset)
@@ -361,7 +363,118 @@ class DataLoader:
             for indices in self.batch_sampler:
                 yield self._fetch(indices)
             return
+        if self.use_shared_memory:
+            from . import shm_ring
+            if shm_ring.available():
+                yield from self._iter_multiprocess()
+                return
         yield from self._iter_threaded()
+
+    def _iter_multiprocess(self):
+        """Subprocess workers + the native shared-memory rings
+        (reference: dataloader_iter.py _DataLoaderIterMultiProcess over
+        mmap shared memory). A TASK ring carries batch indices to the
+        workers; a RESULT ring carries the fetched batches back. The
+        parent only keeps ``inflight`` tasks outstanding, so the
+        reorder buffer, the result ring, and every worker's progress are
+        all bounded by prefetch_factor — a slow batch applies
+        backpressure instead of letting the rest of the epoch pile up in
+        parent RAM."""
+        import multiprocessing as mp
+
+        from . import shm_ring
+
+        batches = list(self.batch_sampler)
+        if not batches:
+            return
+        n_workers = min(self.num_workers, len(batches))
+        inflight = max(n_workers, n_workers * self.prefetch_factor)
+        uid = f"{os.getpid()}_{id(self)}"
+        # reference timeout semantics: 0 means "no timeout" — producers
+        # always block until space frees (the parent going slow must
+        # stall workers, not kill them); an explicit timeout bounds only
+        # the parent's wait for data
+        _FOREVER_MS = 7 * 24 * 3600 * 1000
+        pop_timeout_ms = int(self.timeout * 1000) if self.timeout else \
+            _FOREVER_MS
+        task_ring = shm_ring.ShmRing(f"/pdtpu_t_{uid}",
+                                     slot_bytes=1 << 16,
+                                     n_slots=inflight + n_workers,
+                                     create=True)
+        res_ring = shm_ring.ShmRing(f"/pdtpu_r_{uid}",
+                                    slot_bytes=64 << 20,
+                                    n_slots=inflight, create=True)
+
+        def worker(wid):
+            _worker_tls.info = WorkerInfo(wid, n_workers, self.dataset,
+                                          wid)
+            if self.worker_init_fn is not None:
+                self.worker_init_fn(wid)
+            w_tasks = shm_ring.ShmRing(task_ring.name.decode(),
+                                       create=False)
+            w_res = shm_ring.ShmRing(res_ring.name.decode(),
+                                     create=False)
+            try:
+                while True:
+                    task = w_tasks.pop_obj(_FOREVER_MS)
+                    if task is None:  # sentinel: drain done
+                        return
+                    i, indices = task
+                    try:
+                        result = self._fetch(indices)
+                        w_res.push_obj((i, None, result), _FOREVER_MS)
+                    except Exception as e:  # parent re-raises the
+                        #                     ORIGINAL exception type
+                        try:
+                            w_res.push_obj((i, e, None), _FOREVER_MS)
+                        except Exception:
+                            w_res.push_obj(
+                                (i, RuntimeError(
+                                    f"{type(e).__name__}: {e}"), None),
+                                _FOREVER_MS)
+            finally:
+                w_tasks.close()
+                w_res.close()
+
+        ctx = mp.get_context("fork")
+        procs = [ctx.Process(target=worker, args=(w,), daemon=True)
+                 for w in range(n_workers)]
+        for p in procs:
+            p.start()
+        issued = 0
+        done_sent = False
+        try:
+            pending = {}
+            next_out = 0
+            received = 0
+            while next_out < len(batches):
+                # keep at most `inflight` tasks outstanding
+                while issued < len(batches) and \
+                        issued - next_out < inflight:
+                    task_ring.push_obj((issued, batches[issued]),
+                                       _FOREVER_MS)
+                    issued += 1
+                if issued == len(batches) and not done_sent:
+                    for _ in range(n_workers):
+                        task_ring.push_obj(None, _FOREVER_MS)
+                    done_sent = True
+                if next_out in pending:
+                    yield pending.pop(next_out)
+                    next_out += 1
+                    continue
+                i, err, result = res_ring.pop_obj(pop_timeout_ms)
+                received += 1
+                if err is not None:
+                    raise err
+                pending[i] = result
+        finally:
+            for p in procs:
+                if p.is_alive():
+                    p.terminate()
+            for p in procs:
+                p.join(timeout=2)
+            task_ring.close()
+            res_ring.close()
 
     def _iter_threaded(self):
         """Ordered prefetch: worker threads pull index-batches from a task

@@ -1,0 +1,157 @@
+"""ctypes bindings + on-demand build for the native tpu_dataio shared
+memory ring (native/tpu_dataio.cc).
+
+Reference analog: mmap_allocator.cc shared-memory tensors +
+dataloader_iter.py's shared-memory batch queue. The .so is compiled with
+the system g++ on first use and cached next to the source; everything
+degrades gracefully (``available()`` is False) when no toolchain exists.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import pickle
+import subprocess
+import threading
+from typing import Optional
+
+__all__ = ["available", "ShmRing"]
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(_HERE)),
+                           "native")
+_SRC = os.path.join(_NATIVE_DIR, "tpu_dataio.cc")
+_SO = os.path.join(_NATIVE_DIR, "libtpu_dataio.so")
+
+_lib = None
+_lib_err: Optional[str] = None
+_build_lock = threading.Lock()
+
+
+def _load():
+    global _lib, _lib_err
+    if _lib is not None or _lib_err is not None:
+        return _lib
+    with _build_lock:
+        if _lib is not None or _lib_err is not None:
+            return _lib
+        try:
+            if not os.path.exists(_SO) or (
+                    os.path.exists(_SRC) and
+                    os.path.getmtime(_SRC) > os.path.getmtime(_SO)):
+                subprocess.run(
+                    ["g++", "-O2", "-shared", "-fPIC", "-o", _SO, _SRC,
+                     "-lpthread", "-lrt"],
+                    check=True, capture_output=True, text=True,
+                    timeout=120)
+            lib = ctypes.CDLL(_SO)
+        except Exception as e:  # no toolchain / load failure: fall back
+            _lib_err = f"{type(e).__name__}: {e}"
+            return None
+        lib.td_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                  ctypes.c_uint64]
+        lib.td_create.restype = ctypes.c_int
+        lib.td_attach.argtypes = [ctypes.c_char_p]
+        lib.td_attach.restype = ctypes.c_int
+        lib.td_push.argtypes = [ctypes.c_int, ctypes.c_char_p,
+                                ctypes.c_uint64, ctypes.c_long]
+        lib.td_push.restype = ctypes.c_int
+        lib.td_pop.argtypes = [ctypes.c_int, ctypes.c_char_p,
+                               ctypes.c_uint64, ctypes.c_long]
+        lib.td_pop.restype = ctypes.c_longlong
+        lib.td_slot_bytes.argtypes = [ctypes.c_int]
+        lib.td_slot_bytes.restype = ctypes.c_uint64
+        lib.td_pending.argtypes = [ctypes.c_int]
+        lib.td_pending.restype = ctypes.c_uint64
+        lib.td_close.argtypes = [ctypes.c_int]
+        lib.td_destroy.argtypes = [ctypes.c_char_p]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def build_error() -> Optional[str]:
+    _load()
+    return _lib_err
+
+
+class ShmRing:
+    """Fixed-slot shared-memory queue usable across fork/spawn processes.
+
+    ``push_obj``/``pop_obj`` move pickled python objects (numpy batches)
+    through the segment — one copy in, one copy out, no pipe."""
+
+    def __init__(self, name: str, slot_bytes: int = 8 << 20,
+                 n_slots: int = 8, create: bool = True):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(
+                f"native tpu_dataio unavailable: {_lib_err}")
+        self._lib = lib
+        self.name = name.encode()
+        if create:
+            self._h = lib.td_create(self.name, slot_bytes, n_slots)
+        else:
+            self._h = lib.td_attach(self.name)
+        if self._h < 0:
+            raise OSError(-self._h, os.strerror(-self._h),
+                          name)
+        self._owner = create
+        self.slot_bytes = int(lib.td_slot_bytes(self._h))
+        # one reusable pop buffer per ring: a fresh slot-sized
+        # (64 MB in the DataLoader) allocation per pop would churn the
+        # allocator on the hot path. NOTE: pop is therefore not safe
+        # from multiple threads of ONE process on the same ShmRing
+        # object (processes each have their own).
+        self._pop_buf = None
+
+    def push(self, data: bytes, timeout_ms: int = 10000) -> None:
+        rc = self._lib.td_push(self._h, data, len(data), timeout_ms)
+        if rc == -91 or rc == -90:  # EMSGSIZE differs per libc
+            raise ValueError(
+                f"message of {len(data)} bytes exceeds slot capacity "
+                f"{self.slot_bytes}")
+        if rc != 0:
+            raise TimeoutError(f"ring push failed: errno {-rc}")
+
+    def pop(self, timeout_ms: int = 10000) -> bytes:
+        if self._pop_buf is None:
+            self._pop_buf = ctypes.create_string_buffer(self.slot_bytes)
+        buf = self._pop_buf
+        n = self._lib.td_pop(self._h, buf, self.slot_bytes, timeout_ms)
+        if n < 0:
+            raise TimeoutError(f"ring pop failed: errno {-n}")
+        return buf.raw[:n]
+
+    def push_obj(self, obj, timeout_ms: int = 10000) -> None:
+        self.push(pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL),
+                  timeout_ms)
+
+    def pop_obj(self, timeout_ms: int = 10000):
+        return pickle.loads(self.pop(timeout_ms))
+
+    def pending(self) -> int:
+        return int(self._lib.td_pending(self._h))
+
+    def close(self):
+        if self._h >= 0:
+            self._lib.td_close(self._h)
+            if self._owner:
+                self._lib.td_destroy(self.name)
+            self._h = -1
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

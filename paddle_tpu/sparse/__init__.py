@@ -251,13 +251,24 @@ def masked_matmul(x, y, mask):
                                         shape=mask._mat.shape))
 
 
-def _unary(name, fn):
+def _unary(name, value_fn_name):
+    """value_fn_name resolves lazily inside the call so this module (and
+    paddle_tpu's eager import of it) never forces the jax stack in."""
+
+    def value_fn(v):
+        import jax
+        import jax.numpy as jnp
+        table = {"relu": jax.nn.relu, "sqrt": jnp.sqrt, "sin": jnp.sin,
+                 "tanh": jnp.tanh, "abs": jnp.abs,
+                 "neg": lambda a: -a}
+        return table[value_fn_name](v)
+
     def op(x):
         if isinstance(x, SparseCooTensor):
-            return x._map_values(fn)
+            return x._map_values(value_fn)
         if isinstance(x, SparseCsrTensor):
             jsparse = _bcoo()
-            mat = jsparse.BCSR((fn(x._mat.data), x._mat.indices,
+            mat = jsparse.BCSR((value_fn(x._mat.data), x._mat.indices,
                                 x._mat.indptr), shape=x._mat.shape)
             return SparseCsrTensor(mat)
         from ..framework.dispatch import call_op
@@ -266,15 +277,12 @@ def _unary(name, fn):
     return op
 
 
-import jax.numpy as _jnp  # noqa: E402
-import jax as _jax  # noqa: E402
-
-relu = _unary("relu", lambda v: _jax.nn.relu(v))
-sqrt = _unary("sqrt", _jnp.sqrt)
-sin = _unary("sin", _jnp.sin)
-tanh = _unary("tanh", _jnp.tanh)
-abs = _unary("abs", _jnp.abs)  # noqa: A001
-neg = _unary("neg", lambda v: -v)
+relu = _unary("relu", "relu")
+sqrt = _unary("sqrt", "sqrt")
+sin = _unary("sin", "sin")
+tanh = _unary("tanh", "tanh")
+abs = _unary("abs", "abs")  # noqa: A001
+neg = _unary("neg", "neg")
 
 
 def pow(x, factor):  # noqa: A001

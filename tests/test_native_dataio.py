@@ -1,0 +1,102 @@
+"""Native shared-memory ring + multiprocess DataLoader tests.
+
+Reference analog: mmap_allocator / dataloader_iter multiprocess suite.
+Skipped wholesale when no C++ toolchain is present.
+"""
+import multiprocessing as mp
+import os
+
+import numpy as np
+import pytest
+
+from paddle_tpu.io import DataLoader, TensorDataset
+from paddle_tpu.io import shm_ring
+
+pytestmark = pytest.mark.skipif(
+    not shm_ring.available(),
+    reason=f"native tpu_dataio unavailable: {shm_ring.build_error()}")
+
+
+class TestShmRing:
+    def test_same_process_roundtrip(self):
+        with shm_ring.ShmRing(f"/pdtpu_t1_{os.getpid()}",
+                              slot_bytes=1 << 16, n_slots=4) as ring:
+            ring.push(b"hello")
+            ring.push_obj({"a": np.arange(5)})
+            assert ring.pending() == 2
+            assert ring.pop() == b"hello"
+            obj = ring.pop_obj()
+            np.testing.assert_array_equal(obj["a"], np.arange(5))
+
+    def test_capacity_backpressure_timeout(self):
+        with shm_ring.ShmRing(f"/pdtpu_t2_{os.getpid()}",
+                              slot_bytes=64, n_slots=2) as ring:
+            ring.push(b"a")
+            ring.push(b"b")
+            with pytest.raises(TimeoutError):
+                ring.push(b"c", timeout_ms=100)
+            assert ring.pop() == b"a"
+            ring.push(b"c", timeout_ms=100)  # slot freed
+
+    def test_oversize_message_rejected(self):
+        with shm_ring.ShmRing(f"/pdtpu_t3_{os.getpid()}",
+                              slot_bytes=16, n_slots=2) as ring:
+            with pytest.raises(ValueError):
+                ring.push(b"x" * 64)
+
+    def test_cross_process_transfer(self):
+        name = f"/pdtpu_t4_{os.getpid()}"
+        with shm_ring.ShmRing(name, slot_bytes=1 << 20,
+                              n_slots=4) as ring:
+            def child():
+                r = shm_ring.ShmRing(name, create=False)
+                for i in range(10):
+                    r.push_obj((i, np.full((100,), i, np.float32)))
+                r.close()
+
+            p = mp.get_context("fork").Process(target=child)
+            p.start()
+            got = [ring.pop_obj(20000) for _ in range(10)]
+            p.join(timeout=10)
+            for i, (idx, arr) in enumerate(got):
+                assert idx == i
+                np.testing.assert_array_equal(arr, np.full((100,), i))
+
+
+class TestMultiprocessDataLoader:
+    def _data(self, n=64):
+        rng = np.random.RandomState(0)
+        xs = rng.randn(n, 6).astype(np.float32)
+        ys = rng.randint(0, 4, (n, 1)).astype(np.int64)
+        return TensorDataset([xs, ys]), xs, ys
+
+    def test_ordered_parity_with_single_worker(self):
+        ds, xs, ys = self._data()
+        single = [b for b in DataLoader(ds, batch_size=8)]
+        multi = [b for b in DataLoader(ds, batch_size=8, num_workers=3,
+                                       use_shared_memory=True)]
+        assert len(multi) == len(single)
+        for (sx, sy), (mx, my) in zip(single, multi):
+            np.testing.assert_array_equal(np.asarray(sx), np.asarray(mx))
+            np.testing.assert_array_equal(np.asarray(sy), np.asarray(my))
+
+    def test_worker_error_propagates(self):
+        class Bad(TensorDataset):
+            def __getitem__(self, idx):
+                if idx == 13:
+                    raise RuntimeError("poison item")
+                return super().__getitem__(idx)
+
+        ds, _, _ = self._data()
+        bad = Bad(ds.tensors)
+        loader = DataLoader(bad, batch_size=4, num_workers=2,
+                            use_shared_memory=True)
+        with pytest.raises(RuntimeError, match="poison item"):
+            list(loader)
+
+    def test_shared_memory_off_uses_threads(self):
+        ds, xs, _ = self._data(32)
+        out = list(DataLoader(ds, batch_size=8, num_workers=2,
+                              use_shared_memory=False))
+        assert len(out) == 4
+        np.testing.assert_array_equal(np.asarray(out[0][0]), xs[:8])
