@@ -70,13 +70,18 @@ Slot* slot_at(RingHeader* h, uint64_t i) {
   return reinterpret_cast<Slot*>(base + i * (sizeof(Slot) + h->slot_bytes));
 }
 
+pthread_mutex_t g_maps_mu = PTHREAD_MUTEX_INITIALIZER;
+
 int alloc_handle(RingHeader* hdr, size_t bytes) {
+  pthread_mutex_lock(&g_maps_mu);
   for (int i = 0; i < kMaxHandles; ++i) {
     if (!g_maps[i].used) {
       g_maps[i] = {hdr, bytes, true};
+      pthread_mutex_unlock(&g_maps_mu);
       return i;
     }
   }
+  pthread_mutex_unlock(&g_maps_mu);
   return -EMFILE;
 }
 
@@ -187,6 +192,12 @@ int td_push(int h, const void* buf, uint64_t len, long timeout_ms) {
       pthread_mutex_unlock(&hdr->mu);
       return -ETIMEDOUT;
     }
+#if defined(__linux__)
+    // the wait re-acquires the mutex: a peer death surfaces HERE, and
+    // looping back into timedwait without marking consistent would make
+    // the mutex ENOTRECOVERABLE
+    if (rc == EOWNERDEAD) pthread_mutex_consistent(&hdr->mu);
+#endif
   }
   Slot* s = slot_at(hdr, hdr->tail);
   s->len = len;
@@ -210,6 +221,9 @@ long long td_pop(int h, void* buf, uint64_t cap, long timeout_ms) {
       pthread_mutex_unlock(&hdr->mu);
       return -ETIMEDOUT;
     }
+#if defined(__linux__)
+    if (rc == EOWNERDEAD) pthread_mutex_consistent(&hdr->mu);
+#endif
   }
   Slot* s = slot_at(hdr, hdr->head);
   uint64_t len = s->len;

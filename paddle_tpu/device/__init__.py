@@ -38,11 +38,12 @@ def _device(device=None):
         if ":" in device:
             return jax.devices()[int(device.rsplit(":", 1)[1])]
         # index-less name ("tpu", "gpu", "cpu"): first device of that
-        # platform, falling back to the default device
-        try:
-            return jax.devices(device)[0]
-        except Exception:
-            return jax.devices()[0]
+        # platform. Unknown/unavailable platforms RAISE — silently
+        # falling back to another device hides a 100x misconfiguration.
+        devs = jax.devices(device)  # raises for unknown platforms
+        if not devs:
+            raise RuntimeError(f"no devices for platform {device!r}")
+        return devs[0]
     return device
 
 

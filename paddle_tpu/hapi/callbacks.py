@@ -12,7 +12,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 __all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "EarlyStopping",
-           "LRScheduler", "History", "config_callbacks"]
+           "LRScheduler", "History", "VisualDL", "config_callbacks"]
 
 
 class Callback:
@@ -242,3 +242,55 @@ def config_callbacks(callbacks=None, model=None, epochs=None, steps=None,
         "metrics": metrics or [],
     })
     return clist
+
+
+class VisualDL(Callback):
+    """Scalar logging callback (reference hapi/callbacks.py:844 VisualDL).
+
+    The VisualDL service itself is a separate product; this callback
+    writes the same per-step/per-epoch scalars as JSONL under
+    ``log_dir`` (one record per scalar: {"tag", "step", "value"}), which
+    VisualDL/TensorBoard importers and plain pandas read directly.
+    """
+
+    def __init__(self, log_dir="./vdl_log"):
+        super().__init__()
+        self.log_dir = log_dir
+        self._fh = None
+        self._step = 0
+
+    def _write(self, tag, value, step):
+        import json
+        import os
+        if self._fh is None:
+            os.makedirs(self.log_dir, exist_ok=True)
+            self._fh = open(
+                os.path.join(self.log_dir, "scalars.jsonl"), "a")
+        try:
+            v = float(value)
+        except (TypeError, ValueError):
+            return
+        self._fh.write(json.dumps(
+            {"tag": tag, "step": int(step), "value": v}) + "\n")
+
+    def on_train_batch_end(self, step, logs=None):
+        self._step += 1
+        for k, v in (logs or {}).items():
+            self._write(f"train/{k}", v, self._step)
+
+    def on_epoch_end(self, epoch, logs=None):
+        for k, v in (logs or {}).items():
+            self._write(f"epoch/{k}", v, epoch)
+        if self._fh is not None:
+            self._fh.flush()
+
+    def on_eval_end(self, logs=None):
+        for k, v in (logs or {}).items():
+            self._write(f"eval/{k}", v, self._step)
+        if self._fh is not None:
+            self._fh.flush()
+
+    def on_train_end(self, logs=None):
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None

@@ -3,5 +3,5 @@
 Analog of the reference's ``python/paddle/incubate/`` (fused transformer
 layers, MoE, functional autograd, sparse, autotune).
 """
-from . import moe  # noqa: F401
+from . import moe, nn  # noqa: F401
 from .moe import MoELayer  # noqa: F401

@@ -1,0 +1,22 @@
+"""``paddle.incubate.nn`` — fused transformer layers.
+
+Reference: python/paddle/incubate/nn/layer/fused_transformer.py —
+``FusedMultiHeadAttention`` (:176), ``FusedFeedForward`` (:437),
+``FusedTransformerEncoderLayer`` (:641), backed by the hand-fused CUDA
+kernels in operators/fused/ (fused_attention_op.cu, fused_feedforward).
+
+TPU-native: "fused" is a property of the compiled program, not a special
+layer class — these layers express attention through
+``scaled_dot_product_attention`` (served by the Pallas flash-attention
+kernel on TPU) and layer_norm through the fused Pallas LN, and XLA fuses
+the bias/residual/dropout epilogues the CUDA kernels fuse by hand. The
+classes exist for API parity and for the pre/post-LN + residual wiring
+the reference bakes into its fused ops.
+"""
+from .layer.fused_transformer import (  # noqa: F401
+    FusedFeedForward, FusedMultiHeadAttention,
+    FusedTransformerEncoderLayer,
+)
+
+__all__ = ["FusedMultiHeadAttention", "FusedFeedForward",
+           "FusedTransformerEncoderLayer"]

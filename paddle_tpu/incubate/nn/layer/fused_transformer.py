@@ -1,0 +1,130 @@
+"""Fused transformer layers (reference incubate/nn/layer/
+fused_transformer.py:176/437/641)."""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+from .... import nn
+from ....framework.dispatch import call_op
+from ....nn import functional as F
+from ....nn.layer.layers import Layer
+
+__all__ = ["FusedMultiHeadAttention", "FusedFeedForward",
+           "FusedTransformerEncoderLayer"]
+
+
+class FusedMultiHeadAttention(Layer):
+    """Attention + residual + (pre/post) LayerNorm in one module
+    (reference fused_transformer.py:176 — fused_attention_op.cu).
+
+    On TPU the attention core runs through
+    ``F.scaled_dot_product_attention`` (Pallas flash attention when the
+    shapes qualify) and the LN through the fused Pallas LN; XLA fuses
+    the qkv bias add, dropout and residual epilogues.
+    """
+
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, normalize_before=False,
+                 need_weights=False, qkv_weight_attr=None,
+                 qkv_bias_attr=None, linear_weight_attr=None,
+                 linear_bias_attr=None, pre_ln_scale_attr=None,
+                 pre_ln_bias_attr=None, ln_scale_attr=None,
+                 ln_bias_attr=None, epsilon=1e-5, name=None):
+        super().__init__()
+        if embed_dim % num_heads:
+            raise ValueError("num_heads must divide embed_dim")
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.normalize_before = normalize_before
+        self.dropout_rate = dropout_rate
+        self.attn_dropout_rate = attn_dropout_rate
+        self.qkv_proj = nn.Linear(embed_dim, 3 * embed_dim)
+        self.out_proj = nn.Linear(embed_dim, embed_dim)
+        self.norm = nn.LayerNorm(embed_dim, epsilon=epsilon)
+
+    def forward(self, x, attn_mask=None, cache=None):
+        b, s, d = x.shape
+        residual = x
+        if self.normalize_before:
+            x = self.norm(x)
+        qkv = self.qkv_proj(x)                       # [B, S, 3D]
+        qkv = call_op("reshape", qkv,
+                      shape=(b, s, 3, self.num_heads, self.head_dim))
+        q = qkv[:, :, 0]
+        k = qkv[:, :, 1]
+        v = qkv[:, :, 2]                             # [B, S, H, Dh]
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask,
+            dropout_p=self.attn_dropout_rate if self.training else 0.0)
+        out = call_op("reshape", out, shape=(b, s, d))
+        out = self.out_proj(out)
+        if self.dropout_rate and self.training:
+            out = F.dropout(out, p=self.dropout_rate, training=True)
+        out = residual + out
+        if not self.normalize_before:
+            out = self.norm(out)
+        return out
+
+
+class FusedFeedForward(Layer):
+    """FFN + residual + (pre/post) LN (reference fused_transformer.py:437
+    — fused_feedforward_op)."""
+
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-5, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, linear1_weight_attr=None,
+                 linear1_bias_attr=None, linear2_weight_attr=None,
+                 linear2_bias_attr=None, ln1_scale_attr=None,
+                 ln1_bias_attr=None, ln2_scale_attr=None,
+                 ln2_bias_attr=None, name=None):
+        super().__init__()
+        self.normalize_before = normalize_before
+        self.dropout_rate = dropout_rate
+        self.act_dropout_rate = dropout_rate if act_dropout_rate is None \
+            else act_dropout_rate
+        self.activation = activation
+        self.linear1 = nn.Linear(d_model, dim_feedforward)
+        self.linear2 = nn.Linear(dim_feedforward, d_model)
+        self.norm = nn.LayerNorm(d_model, epsilon=epsilon)
+
+    def forward(self, src, cache=None):
+        residual = src
+        x = self.norm(src) if self.normalize_before else src
+        x = self.linear1(x)
+        x = F.relu(x) if self.activation == "relu" else F.gelu(x)
+        if self.act_dropout_rate and self.training:
+            x = F.dropout(x, p=self.act_dropout_rate, training=True)
+        x = self.linear2(x)
+        if self.dropout_rate and self.training:
+            x = F.dropout(x, p=self.dropout_rate, training=True)
+        out = residual + x
+        if not self.normalize_before:
+            out = self.norm(out)
+        return out
+
+
+class FusedTransformerEncoderLayer(Layer):
+    """Reference fused_transformer.py:641: FusedMultiHeadAttention +
+    FusedFeedForward."""
+
+    def __init__(self, d_model, nhead, dim_feedforward,
+                 dropout_rate=0.1, activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False):
+        super().__init__()
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead,
+            dropout_rate=dropout_rate,
+            attn_dropout_rate=(dropout_rate if attn_dropout_rate is None
+                               else attn_dropout_rate),
+            normalize_before=normalize_before)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            activation=activation,
+            act_dropout_rate=act_dropout_rate,
+            normalize_before=normalize_before)
+
+    def forward(self, src, src_mask=None, cache=None):
+        out = self.fused_attn(src, attn_mask=src_mask)
+        return self.ffn(out)

@@ -296,6 +296,14 @@ class WorkerInfo:
 _worker_tls = threading.local()
 
 
+class _SPILLED:
+    """Marker for a result too large for its shm slot, shipped via a
+    spill file instead (multiprocess DataLoader path)."""
+
+    def __init__(self, path):
+        self.path = path
+
+
 def get_worker_info():
     return getattr(_worker_tls, "info", None)
 
@@ -384,6 +392,9 @@ class DataLoader:
 
         from . import shm_ring
 
+        import pickle as _pickle
+        import tempfile
+
         batches = list(self.batch_sampler)
         if not batches:
             return
@@ -397,13 +408,19 @@ class DataLoader:
         _FOREVER_MS = 7 * 24 * 3600 * 1000
         pop_timeout_ms = int(self.timeout * 1000) if self.timeout else \
             _FOREVER_MS
+        # size the task slots for the LARGEST index batch (batch_size is
+        # unbounded; a fixed slot would cap it)
+        biggest = max(batches, key=len)
+        task_slot = max(1 << 16,
+                        2 * len(_pickle.dumps((len(batches), biggest))))
         task_ring = shm_ring.ShmRing(f"/pdtpu_t_{uid}",
-                                     slot_bytes=1 << 16,
+                                     slot_bytes=task_slot,
                                      n_slots=inflight + n_workers,
                                      create=True)
         res_ring = shm_ring.ShmRing(f"/pdtpu_r_{uid}",
                                     slot_bytes=64 << 20,
                                     n_slots=inflight, create=True)
+        spill_dir = tempfile.mkdtemp(prefix="pdtpu_dl_spill_")
 
         def worker(wid):
             _worker_tls.info = WorkerInfo(wid, n_workers, self.dataset,
@@ -422,7 +439,21 @@ class DataLoader:
                     i, indices = task
                     try:
                         result = self._fetch(indices)
-                        w_res.push_obj((i, None, result), _FOREVER_MS)
+                        payload = _pickle.dumps(
+                            (i, None, result),
+                            protocol=_pickle.HIGHEST_PROTOCOL)
+                        if len(payload) > w_res.slot_bytes:
+                            # batch exceeds the shm slot: spill to disk
+                            # and ship the path (keeps arbitrary batch
+                            # sizes working; shm stays the fast path)
+                            path = os.path.join(spill_dir,
+                                                f"batch_{i}.pkl")
+                            with open(path, "wb") as f:
+                                f.write(payload)
+                            w_res.push_obj((i, None, _SPILLED(path)),
+                                           _FOREVER_MS)
+                        else:
+                            w_res.push(payload, _FOREVER_MS)
                     except Exception as e:  # parent re-raises the
                         #                     ORIGINAL exception type
                         try:
@@ -446,7 +477,7 @@ class DataLoader:
         try:
             pending = {}
             next_out = 0
-            received = 0
+            waited_ms = 0
             while next_out < len(batches):
                 # keep at most `inflight` tasks outstanding
                 while issued < len(batches) and \
@@ -462,10 +493,38 @@ class DataLoader:
                     yield pending.pop(next_out)
                     next_out += 1
                     continue
-                i, err, result = res_ring.pop_obj(pop_timeout_ms)
-                received += 1
+                # poll in short slices so a dead worker surfaces as an
+                # error instead of a multi-day hang (reference: the
+                # launcher/iterator watch worker exit)
+                try:
+                    i, err, result = res_ring.pop_obj(5000)
+                    waited_ms = 0
+                except TimeoutError:
+                    waited_ms += 5000
+                    dead = [p for p in procs
+                            if p.exitcode not in (None, 0)]
+                    if dead:
+                        raise RuntimeError(
+                            f"DataLoader worker died with exit code "
+                            f"{dead[0].exitcode} (killed/OOM?); "
+                            f"{len(batches) - next_out} batches "
+                            f"unfetched")
+                    if all(p.exitcode is not None for p in procs) and \
+                            res_ring.pending() == 0:
+                        raise RuntimeError(
+                            "all DataLoader workers exited but "
+                            f"{len(batches) - next_out} batches were "
+                            "never produced")
+                    if waited_ms >= pop_timeout_ms:
+                        raise
+                    continue
                 if err is not None:
                     raise err
+                if isinstance(result, _SPILLED):
+                    spath = result.path
+                    with open(spath, "rb") as f:
+                        _, _, result = _pickle.loads(f.read())
+                    os.unlink(spath)
                 pending[i] = result
         finally:
             for p in procs:
@@ -475,6 +534,8 @@ class DataLoader:
                 p.join(timeout=2)
             task_ring.close()
             res_ring.close()
+            import shutil
+            shutil.rmtree(spill_dir, ignore_errors=True)
 
     def _iter_threaded(self):
         """Ordered prefetch: worker threads pull index-batches from a task

@@ -11,7 +11,6 @@ from __future__ import annotations
 import ctypes
 import os
 import pickle
-import subprocess
 import threading
 from typing import Optional
 
@@ -21,7 +20,6 @@ _HERE = os.path.dirname(os.path.abspath(__file__))
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(_HERE)),
                            "native")
 _SRC = os.path.join(_NATIVE_DIR, "tpu_dataio.cc")
-_SO = os.path.join(_NATIVE_DIR, "libtpu_dataio.so")
 
 _lib = None
 _lib_err: Optional[str] = None
@@ -36,15 +34,14 @@ def _load():
         if _lib is not None or _lib_err is not None:
             return _lib
         try:
-            if not os.path.exists(_SO) or (
-                    os.path.exists(_SRC) and
-                    os.path.getmtime(_SRC) > os.path.getmtime(_SO)):
-                subprocess.run(
-                    ["g++", "-O2", "-shared", "-fPIC", "-o", _SO, _SRC,
-                     "-lpthread", "-lrt"],
-                    check=True, capture_output=True, text=True,
-                    timeout=120)
-            lib = ctypes.CDLL(_SO)
+            # one build pipeline for all native code: content-hash cache
+            # dir works from read-only installs, unlike building next to
+            # the source
+            from ..utils import cpp_extension
+            ext = cpp_extension.load(
+                "tpu_dataio", [_SRC],
+                extra_ldflags=["-lpthread", "-lrt"])
+            lib = ext.__lib__
         except Exception as e:  # no toolchain / load failure: fall back
             _lib_err = f"{type(e).__name__}: {e}"
             return None

@@ -1,0 +1,178 @@
+"""Custom C++ op toolchain (``paddle.utils.cpp_extension``).
+
+Reference: python/paddle/utils/cpp_extension/cpp_extension.py —
+``load()`` JIT-compiles a C++/CUDA source registering ops via
+``PD_BUILD_OP`` (framework/custom_operator.cc) and returns a module of
+generated Python wrappers; ``setup()`` is the setuptools variant.
+
+TPU-native: device compute belongs to XLA/Pallas, so a "custom op" here
+is host-side C++ with a C ABI (data prep, tokenizers, samplers, IO —
+the roles the reference's CPU custom ops actually play), compiled with
+the system toolchain and bound through ctypes. The returned module
+exposes one Python callable per exported ``extern "C"`` symbol; a
+signature table maps numpy arrays to pointers. Ops that should join the
+autograd tape can be registered with ``register_as_op`` (pure_callback
+under jit).
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import re
+import subprocess
+import types
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["load", "get_build_directory", "CppExtension"]
+
+_CACHE_ENV = "PADDLE_EXTENSION_DIR"
+
+
+def get_build_directory() -> str:
+    d = os.environ.get(_CACHE_ENV) or os.path.join(
+        os.path.expanduser("~"), ".cache", "paddle_tpu_extensions")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+class CppExtension:
+    """setup()-style description (reference CppExtension); carried for
+    API parity — building happens through load()."""
+
+    def __init__(self, sources: Sequence[str], *args, **kwargs):
+        self.sources = list(sources)
+        self.extra_compile_args = kwargs.get("extra_compile_args", [])
+
+
+_C_TYPES = {
+    "void": None,
+    "int": ctypes.c_int,
+    "long": ctypes.c_long,
+    "long long": ctypes.c_longlong,
+    "float": ctypes.c_float,
+    "double": ctypes.c_double,
+    "int*": ctypes.POINTER(ctypes.c_int),
+    "long*": ctypes.POINTER(ctypes.c_long),
+    "float*": ctypes.POINTER(ctypes.c_float),
+    "double*": ctypes.POINTER(ctypes.c_double),
+    "const int*": ctypes.POINTER(ctypes.c_int),
+    "const long*": ctypes.POINTER(ctypes.c_long),
+    "const float*": ctypes.POINTER(ctypes.c_float),
+    "const double*": ctypes.POINTER(ctypes.c_double),
+    "const char*": ctypes.c_char_p,
+    "char*": ctypes.c_char_p,
+}
+
+# type token: "long long" before "long" so backtracking can't misbind a
+# two-word type's first word as the whole return type
+_TYPE_TOKEN = (r"(?:const\s+)?(?:unsigned\s+)?"
+               r"(?:long\s+long|[A-Za-z_]\w*)\s*\*?")
+
+
+def _parse_signatures(source: str) -> Dict[str, tuple]:
+    """Best-effort parse of `extern "C"` function signatures so ctypes
+    bindings get argtypes/restype. Functions with unrecognized types are
+    still exported, untyped."""
+    sigs = {}
+    block = source
+    # find functions following an extern "C" marker (single or block)
+    pat = re.compile(
+        r'(?:extern\s+"C"\s+)?'
+        r'(?P<ret>' + _TYPE_TOKEN + r')\s+'
+        r'(?P<name>\w+)\s*\((?P<args>[^)]*)\)\s*\{')
+    extern_names = set(re.findall(
+        r'extern\s+"C"[\s\{]*?(?:const\s+)?[\w]+\s*\*?\s*(\w+)\s*\(',
+        source))
+    in_extern_block = 'extern "C"' in source
+    def norm(t):
+        # canonical form: single spaces, '*' glued to the type name
+        t = re.sub(r"\s+", " ", t).strip()
+        return t.replace(" *", "*")
+
+    for m in pat.finditer(block):
+        name = m.group("name")
+        if not in_extern_block and name not in extern_names:
+            continue
+        ret = norm(m.group("ret"))
+        args = []
+        ok = ret in _C_TYPES
+        for a in m.group("args").split(","):
+            a = a.strip()
+            if not a or a == "void":
+                continue
+            # drop the parameter name
+            a = norm(re.sub(r"\s*\w+$", "", a))
+            if a not in _C_TYPES or _C_TYPES[a] is None:
+                ok = False
+                break
+            args.append(_C_TYPES[a])
+        if ok:
+            sigs[name] = (_C_TYPES[ret], args)
+    return sigs
+
+
+def _as_ctypes_arg(a, expected):
+    if isinstance(a, np.ndarray):
+        return a.ctypes.data_as(expected) if expected is not None else \
+            a.ctypes.data
+    if isinstance(a, str):
+        return a.encode()
+    return a
+
+
+def load(name: str, sources: Sequence[str],
+         extra_cxx_cflags: Optional[Sequence[str]] = None,
+         extra_ldflags: Optional[Sequence[str]] = None,
+         build_directory: Optional[str] = None,
+         verbose: bool = False, **kwargs) -> types.SimpleNamespace:
+    """JIT-build a C++ extension and return a module-like namespace of
+    its ``extern "C"`` functions (reference cpp_extension.py:738 load).
+    Recompiles only when sources change (content hash in the .so name).
+    """
+    build_dir = build_directory or get_build_directory()
+    srcs = [os.path.abspath(s) for s in sources]
+    for s in srcs:
+        if not os.path.exists(s):
+            raise FileNotFoundError(s)
+    content = "".join(open(s).read() for s in srcs)
+    tag = hashlib.sha256(
+        (content + repr(extra_cxx_cflags) + repr(extra_ldflags))
+        .encode()).hexdigest()[:16]
+    so_path = os.path.join(build_dir, f"{name}_{tag}.so")
+    if not os.path.exists(so_path):
+        cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+               *(extra_cxx_cflags or []), "-o", so_path, *srcs,
+               *(extra_ldflags or [])]
+        if verbose:
+            print("[cpp_extension]", " ".join(cmd))
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=600)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"extension '{name}' failed to build:\n{proc.stderr}")
+    lib = ctypes.CDLL(so_path)
+    sigs = _parse_signatures(content)
+
+    ns = types.SimpleNamespace(__name__=name, __so_path__=so_path,
+                               __lib__=lib)
+    for fname, (ret, argtypes) in sigs.items():
+        fn = getattr(lib, fname, None)
+        if fn is None:
+            continue
+        fn.restype = ret
+        fn.argtypes = argtypes
+
+        def make(fn=fn, argtypes=argtypes, fname=fname):
+            def call(*args):
+                conv = [_as_ctypes_arg(a, t)
+                        for a, t in zip(args, argtypes)] if argtypes \
+                    else [_as_ctypes_arg(a, None) for a in args]
+                return fn(*conv)
+            call.__name__ = fname
+            return call
+
+        setattr(ns, fname, make())
+    return ns

@@ -1,0 +1,73 @@
+"""Custom C++ extension toolchain tests (reference: tests/custom_op/)."""
+import os
+import textwrap
+
+import numpy as np
+import pytest
+
+from paddle_tpu.utils import cpp_extension
+
+pytestmark = pytest.mark.skipif(
+    os.system("which g++ > /dev/null 2>&1") != 0,
+    reason="no C++ toolchain")
+
+
+SRC = '''
+#include <cstdint>
+
+extern "C" {
+
+float dot(const float* a, const float* b, int n) {
+  float acc = 0.f;
+  for (int i = 0; i < n; ++i) acc += a[i] * b[i];
+  return acc;
+}
+
+void saxpy(float alpha, const float* x, float* y, int n) {
+  for (int i = 0; i < n; ++i) y[i] += alpha * x[i];
+}
+
+int add_ints(int a, int b) { return a + b; }
+
+}
+'''
+
+
+class TestCppExtension:
+    def _build(self, tmp_path, name="custom_ops"):
+        src = tmp_path / "ops.cc"
+        src.write_text(textwrap.dedent(SRC))
+        return cpp_extension.load(name, [str(src)],
+                                  build_directory=str(tmp_path))
+
+    def test_load_and_call_scalar(self, tmp_path):
+        ext = self._build(tmp_path)
+        assert ext.add_ints(3, 4) == 7
+
+    def test_numpy_array_marshalling(self, tmp_path):
+        ext = self._build(tmp_path)
+        a = np.arange(5, dtype=np.float32)
+        b = np.ones(5, dtype=np.float32)
+        np.testing.assert_allclose(ext.dot(a, b, 5), a.sum(), rtol=1e-6)
+        y = np.zeros(5, np.float32)
+        ext.saxpy(2.0, a, y, 5)
+        np.testing.assert_allclose(y, 2 * a)
+
+    def test_rebuild_only_on_change(self, tmp_path):
+        ext1 = self._build(tmp_path)
+        so1 = ext1.__so_path__
+        ext2 = self._build(tmp_path)
+        assert ext2.__so_path__ == so1  # content hash unchanged
+        src = tmp_path / "ops.cc"
+        src.write_text(src.read_text().replace("a + b", "a + b + 1"))
+        ext3 = cpp_extension.load("custom_ops", [str(src)],
+                                  build_directory=str(tmp_path))
+        assert ext3.__so_path__ != so1
+        assert ext3.add_ints(3, 4) == 8
+
+    def test_build_error_surfaces(self, tmp_path):
+        bad = tmp_path / "bad.cc"
+        bad.write_text('extern "C" { int broken( { }')
+        with pytest.raises(RuntimeError, match="failed to build"):
+            cpp_extension.load("bad_ext", [str(bad)],
+                               build_directory=str(tmp_path))

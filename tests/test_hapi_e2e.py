@@ -173,3 +173,28 @@ class TestMetrics:
         labels = np.array([1, 1, 0, 0])
         m.update(preds, labels)
         assert abs(m.accumulate() - 1.0) < 1e-6
+
+
+class TestVisualDLCallback:
+    def test_scalars_written(self, tmp_path):
+        import json
+        from paddle_tpu.hapi.callbacks import VisualDL
+        from paddle_tpu.vision.models import LeNet
+
+        paddle.framework.random.seed(0)
+        model = paddle.Model(LeNet())
+        opt = paddle.optimizer.Adam(
+            learning_rate=1e-3, parameters=model.network.parameters())
+        model.prepare(opt, nn.CrossEntropyLoss())
+        x = np.random.RandomState(0).randn(32, 1, 28, 28).astype(np.float32)
+        y = np.random.RandomState(1).randint(0, 10, (32, 1)).astype(np.int64)
+        ds = paddle.io.TensorDataset([x, y])
+        vdl = VisualDL(log_dir=str(tmp_path))
+        model.fit(ds, batch_size=8, epochs=2, verbose=0, callbacks=[vdl])
+        path = tmp_path / "scalars.jsonl"
+        assert path.exists()
+        recs = [json.loads(l) for l in path.read_text().splitlines()]
+        tags = {r["tag"] for r in recs}
+        assert any(t.startswith("train/") for t in tags), tags
+        assert any(t.startswith("epoch/") for t in tags), tags
+        assert all(np.isfinite(r["value"]) for r in recs)

@@ -100,3 +100,53 @@ class TestMultiprocessDataLoader:
                               use_shared_memory=False))
         assert len(out) == 4
         np.testing.assert_array_equal(np.asarray(out[0][0]), xs[:8])
+
+
+class TestReviewFixes:
+    def test_oversize_batch_spills_to_disk(self):
+        """A batch bigger than the result slot must still arrive (spill
+        path), not crash the epoch."""
+        from paddle_tpu.io import DataLoader
+
+        class BigDataset(TensorDataset):
+            pass
+
+        rng = np.random.RandomState(0)
+        # 17 x 4MB items = 68MB pickled batch > the 64MB result slot
+        xs = rng.randn(18, 1024, 1024).astype(np.float32)
+        ds = TensorDataset([xs])
+        loader = DataLoader(ds, batch_size=17, num_workers=1,
+                            use_shared_memory=True)
+        batches = list(loader)
+        assert len(batches) == 2
+        np.testing.assert_array_equal(np.asarray(batches[0][0]), xs[:17])
+
+    def test_dead_worker_detected(self):
+        """A worker killed mid-epoch must raise, not hang."""
+        from paddle_tpu.io import DataLoader
+
+        class KillSelf(TensorDataset):
+            def __getitem__(self, idx):
+                if idx == 5:
+                    os._exit(137)  # simulate OOM kill
+                return super().__getitem__(idx)
+
+        rng = np.random.RandomState(0)
+        ds = KillSelf([rng.randn(16, 4).astype(np.float32)])
+        loader = DataLoader(ds, batch_size=2, num_workers=1,
+                            use_shared_memory=True)
+        with pytest.raises(RuntimeError, match="died|never produced"):
+            list(loader)
+
+    def test_large_batch_size_task_slot(self):
+        """batch_size with huge index lists must not overflow the task
+        ring slot."""
+        from paddle_tpu.io import DataLoader
+        rng = np.random.RandomState(0)
+        n = 40000
+        ds = TensorDataset([rng.randn(n, 2).astype(np.float32)])
+        loader = DataLoader(ds, batch_size=20000, num_workers=1,
+                            use_shared_memory=True)
+        batches = list(loader)
+        assert len(batches) == 2
+        assert np.asarray(batches[0][0]).shape == (20000, 2)

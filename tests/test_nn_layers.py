@@ -219,3 +219,51 @@ class TestActivationsAndLosses:
         total = np.sqrt(sum(float(np.sum(np.square(np.asarray(g))))
                             for _, g in out))
         np.testing.assert_allclose(total, 1.0, rtol=1e-5)
+
+
+class TestFusedTransformerLayers:
+    """incubate.nn fused layers (reference fused_transformer.py) — parity
+    with the unfused composition and trainability."""
+
+    def test_fused_mha_shapes_and_train(self):
+        from paddle_tpu.incubate.nn import FusedMultiHeadAttention
+        paddle.framework.random.seed(40)
+        layer = FusedMultiHeadAttention(32, 4, dropout_rate=0.0,
+                                        attn_dropout_rate=0.0)
+        x = paddle.to_tensor(rng.randn(2, 8, 32).astype(np.float32))
+        out = layer(x)
+        assert out.shape == [2, 8, 32]
+        opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                    parameters=layer.parameters())
+        losses = []
+        target = paddle.to_tensor(rng.randn(2, 8, 32).astype(np.float32))
+        for _ in range(6):
+            loss = F.mse_loss(layer(x), target)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
+
+    def test_fused_encoder_layer_pre_post_ln(self):
+        from paddle_tpu.incubate.nn import FusedTransformerEncoderLayer
+        paddle.framework.random.seed(41)
+        x = paddle.to_tensor(rng.randn(2, 6, 16).astype(np.float32))
+        for pre in (False, True):
+            enc = FusedTransformerEncoderLayer(
+                16, 4, 64, dropout_rate=0.0, normalize_before=pre)
+            enc.eval()
+            out = enc(x)
+            assert out.shape == [2, 6, 16]
+            assert np.isfinite(out.numpy()).all()
+
+    def test_fused_ffn_matches_manual(self):
+        from paddle_tpu.incubate.nn import FusedFeedForward
+        paddle.framework.random.seed(42)
+        ffn = FusedFeedForward(16, 32, dropout_rate=0.0)
+        ffn.eval()
+        x = paddle.to_tensor(rng.randn(2, 4, 16).astype(np.float32))
+        out = ffn(x)
+        h = F.relu(ffn.linear1(x))
+        ref = ffn.norm(x + ffn.linear2(h))
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), atol=1e-5)
