@@ -254,9 +254,14 @@ uint64_t td_pending(int h) {
 }
 
 int td_close(int h) {
-  if (h < 0 || h >= kMaxHandles || !g_maps[h].used) return -EBADF;
+  pthread_mutex_lock(&g_maps_mu);
+  if (h < 0 || h >= kMaxHandles || !g_maps[h].used) {
+    pthread_mutex_unlock(&g_maps_mu);
+    return -EBADF;
+  }
   munmap(g_maps[h].hdr, g_maps[h].map_bytes);
   g_maps[h].used = false;
+  pthread_mutex_unlock(&g_maps_mu);
   return 0;
 }
 

@@ -33,11 +33,25 @@ class Place:
     def __repr__(self):
         return f"Place({self.device_type}:{self._device_id})"
 
+    _warned_fallback = set()
+
     def jax_device(self):
         devs = [d for d in jax.devices() if _platform_of(d) == self.device_type]
         if not devs:
-            # Fall back to the default backend (e.g. asking for tpu on a
-            # CPU-only test host).
+            # Fall back to the default backend — this is what lets
+            # TPU-targeted code run on the CPU fake-device test mesh
+            # (SURVEY §4). It must never be SILENT though: on a
+            # mis-provisioned production host this is a ~100x slowdown,
+            # so warn once per requested platform. (The observability
+            # API, paddle.device.*, is strict and raises instead.)
+            if self.device_type not in Place._warned_fallback:
+                Place._warned_fallback.add(self.device_type)
+                import warnings
+                warnings.warn(
+                    f"no {self.device_type!r} devices visible; falling "
+                    f"back to the default backend "
+                    f"({jax.default_backend()}). If this is not a test "
+                    f"environment, check the device provisioning.")
             devs = jax.devices()
         return devs[min(self._device_id, len(devs) - 1)]
 

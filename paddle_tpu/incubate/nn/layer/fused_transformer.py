@@ -34,6 +34,19 @@ class FusedMultiHeadAttention(Layer):
         super().__init__()
         if embed_dim % num_heads:
             raise ValueError("num_heads must divide embed_dim")
+        if need_weights:
+            raise NotImplementedError(
+                "need_weights=True is unsupported (the reference fused op "
+                "asserts the same); use nn.MultiHeadAttention to inspect "
+                "attention weights")
+        attrs = [qkv_weight_attr, qkv_bias_attr, linear_weight_attr,
+                 linear_bias_attr, pre_ln_scale_attr, pre_ln_bias_attr,
+                 ln_scale_attr, ln_bias_attr]
+        if any(a is not None for a in attrs):
+            raise NotImplementedError(
+                "ParamAttr-based initializers are not wired for the fused "
+                "layers; initialize via state_dict/set_state_dict instead "
+                "of silently ignoring the attrs")
         self.embed_dim = embed_dim
         self.num_heads = num_heads
         self.head_dim = embed_dim // num_heads
@@ -45,6 +58,10 @@ class FusedMultiHeadAttention(Layer):
         self.norm = nn.LayerNorm(embed_dim, epsilon=epsilon)
 
     def forward(self, x, attn_mask=None, cache=None):
+        if cache is not None:
+            raise NotImplementedError(
+                "incremental-decode cache is not supported by the fused "
+                "attention layer; use nn.MultiHeadAttention")
         b, s, d = x.shape
         residual = x
         if self.normalize_before:
@@ -84,6 +101,13 @@ class FusedFeedForward(Layer):
         self.dropout_rate = dropout_rate
         self.act_dropout_rate = dropout_rate if act_dropout_rate is None \
             else act_dropout_rate
+        # dispatch by NAME through the functional registry — silently
+        # substituting gelu for an unknown activation trains a different
+        # model with no diagnostic
+        if not hasattr(F, activation):
+            raise ValueError(
+                f"unknown activation {activation!r} (no "
+                f"paddle_tpu.nn.functional.{activation})")
         self.activation = activation
         self.linear1 = nn.Linear(d_model, dim_feedforward)
         self.linear2 = nn.Linear(dim_feedforward, d_model)
@@ -93,7 +117,7 @@ class FusedFeedForward(Layer):
         residual = src
         x = self.norm(src) if self.normalize_before else src
         x = self.linear1(x)
-        x = F.relu(x) if self.activation == "relu" else F.gelu(x)
+        x = getattr(F, self.activation)(x)
         if self.act_dropout_rate and self.training:
             x = F.dropout(x, p=self.act_dropout_rate, training=True)
         x = self.linear2(x)

@@ -495,12 +495,15 @@ class DataLoader:
                     continue
                 # poll in short slices so a dead worker surfaces as an
                 # error instead of a multi-day hang (reference: the
-                # launcher/iterator watch worker exit)
+                # launcher/iterator watch worker exit); a sub-5s user
+                # timeout keeps its precision
                 try:
-                    i, err, result = res_ring.pop_obj(5000)
+                    slice_ms = min(5000,
+                                   max(1, pop_timeout_ms - waited_ms))
+                    i, err, result = res_ring.pop_obj(slice_ms)
                     waited_ms = 0
                 except TimeoutError:
-                    waited_ms += 5000
+                    waited_ms += slice_ms
                     dead = [p for p in procs
                             if p.exitcode not in (None, 0)]
                     if dead:

@@ -72,30 +72,23 @@ _TYPE_TOKEN = (r"(?:const\s+)?(?:unsigned\s+)?"
                r"(?:long\s+long|[A-Za-z_]\w*)\s*\*?")
 
 
-def _parse_signatures(source: str) -> Dict[str, tuple]:
+def _parse_signatures(source: str) -> Dict[str, Optional[tuple]]:
     """Best-effort parse of `extern "C"` function signatures so ctypes
-    bindings get argtypes/restype. Functions with unrecognized types are
-    still exported, untyped."""
-    sigs = {}
-    block = source
-    # find functions following an extern "C" marker (single or block)
+    bindings get argtypes/restype. Functions with unrecognized types map
+    to None and are exported UNTYPED (ctypes defaults)."""
+    sigs: Dict[str, Optional[tuple]] = {}
     pat = re.compile(
         r'(?:extern\s+"C"\s+)?'
         r'(?P<ret>' + _TYPE_TOKEN + r')\s+'
         r'(?P<name>\w+)\s*\((?P<args>[^)]*)\)\s*\{')
-    extern_names = set(re.findall(
-        r'extern\s+"C"[\s\{]*?(?:const\s+)?[\w]+\s*\*?\s*(\w+)\s*\(',
-        source))
-    in_extern_block = 'extern "C"' in source
+
     def norm(t):
         # canonical form: single spaces, '*' glued to the type name
         t = re.sub(r"\s+", " ", t).strip()
         return t.replace(" *", "*")
 
-    for m in pat.finditer(block):
+    for m in pat.finditer(source):
         name = m.group("name")
-        if not in_extern_block and name not in extern_names:
-            continue
         ret = norm(m.group("ret"))
         args = []
         ok = ret in _C_TYPES
@@ -109,8 +102,7 @@ def _parse_signatures(source: str) -> Dict[str, tuple]:
                 ok = False
                 break
             args.append(_C_TYPES[a])
-        if ok:
-            sigs[name] = (_C_TYPES[ret], args)
+        sigs[name] = (_C_TYPES[ret], args) if ok else None
     return sigs
 
 
@@ -143,27 +135,39 @@ def load(name: str, sources: Sequence[str],
         .encode()).hexdigest()[:16]
     so_path = os.path.join(build_dir, f"{name}_{tag}.so")
     if not os.path.exists(so_path):
+        # compile to a private temp path and rename atomically: a killed
+        # or concurrent build must never leave a truncated .so that
+        # poisons the content-hash cache forever
+        tmp_path = f"{so_path}.tmp.{os.getpid()}"
         cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
-               *(extra_cxx_cflags or []), "-o", so_path, *srcs,
+               *(extra_cxx_cflags or []), "-o", tmp_path, *srcs,
                *(extra_ldflags or [])]
         if verbose:
             print("[cpp_extension]", " ".join(cmd))
-        proc = subprocess.run(cmd, capture_output=True, text=True,
-                              timeout=600)
-        if proc.returncode != 0:
-            raise RuntimeError(
-                f"extension '{name}' failed to build:\n{proc.stderr}")
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=600)
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"extension '{name}' failed to build:\n{proc.stderr}")
+            os.rename(tmp_path, so_path)
+        finally:
+            if os.path.exists(tmp_path):
+                os.unlink(tmp_path)
     lib = ctypes.CDLL(so_path)
     sigs = _parse_signatures(content)
 
     ns = types.SimpleNamespace(__name__=name, __so_path__=so_path,
                                __lib__=lib)
-    for fname, (ret, argtypes) in sigs.items():
+    for fname, sig in sigs.items():
         fn = getattr(lib, fname, None)
         if fn is None:
             continue
-        fn.restype = ret
-        fn.argtypes = argtypes
+        argtypes = None
+        if sig is not None:
+            ret, argtypes = sig
+            fn.restype = ret
+            fn.argtypes = argtypes
 
         def make(fn=fn, argtypes=argtypes, fname=fname):
             def call(*args):
