@@ -39,7 +39,8 @@ def _import_if_built(name):
 for _m in ("autograd", "optimizer", "amp", "io", "metric", "static", "jit",
            "vision", "distributed", "hapi", "parallel", "profiler",
            "incubate", "models", "utils", "inference", "distribution",
-           "sparse", "text", "device", "quantization"):
+           "sparse", "text", "device", "quantization", "linalg", "fft",
+           "signal"):
     _mod = _import_if_built(_m)
     if _mod is not None:
         globals()[_m] = _mod

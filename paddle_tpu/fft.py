@@ -1,0 +1,72 @@
+"""``paddle.fft`` — discrete Fourier transforms.
+
+Reference: python/paddle/fft.py (fft/ifft/rfft/... over pocketfft/cuFFT
+kernels). TPU-native: XLA lowers FFTs natively on every backend.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .framework.tensor import Tensor
+
+__all__ = ["fft", "ifft", "rfft", "irfft", "hfft", "ihfft",
+           "fft2", "ifft2", "rfft2", "irfft2",
+           "fftn", "ifftn", "rfftn", "irfftn",
+           "fftfreq", "rfftfreq", "fftshift", "ifftshift"]
+
+
+def _arr(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _wrap1(jnp_fn):
+    def fn(x, n=None, axis=-1, norm="backward", name=None):
+        return Tensor(jnp_fn(_arr(x), n=n, axis=axis, norm=norm))
+    fn.__name__ = jnp_fn.__name__
+    return fn
+
+
+def _wrap2(jnp_fn):
+    def fn(x, s=None, axes=(-2, -1), norm="backward", name=None):
+        return Tensor(jnp_fn(_arr(x), s=s, axes=axes, norm=norm))
+    fn.__name__ = jnp_fn.__name__
+    return fn
+
+
+def _wrapn(jnp_fn):
+    def fn(x, s=None, axes=None, norm="backward", name=None):
+        return Tensor(jnp_fn(_arr(x), s=s, axes=axes, norm=norm))
+    fn.__name__ = jnp_fn.__name__
+    return fn
+
+
+fft = _wrap1(jnp.fft.fft)
+ifft = _wrap1(jnp.fft.ifft)
+rfft = _wrap1(jnp.fft.rfft)
+irfft = _wrap1(jnp.fft.irfft)
+hfft = _wrap1(jnp.fft.hfft)
+ihfft = _wrap1(jnp.fft.ihfft)
+fft2 = _wrap2(jnp.fft.fft2)
+ifft2 = _wrap2(jnp.fft.ifft2)
+rfft2 = _wrap2(jnp.fft.rfft2)
+irfft2 = _wrap2(jnp.fft.irfft2)
+fftn = _wrapn(jnp.fft.fftn)
+ifftn = _wrapn(jnp.fft.ifftn)
+rfftn = _wrapn(jnp.fft.rfftn)
+irfftn = _wrapn(jnp.fft.irfftn)
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor(jnp.fft.fftfreq(n, d=d))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor(jnp.fft.rfftfreq(n, d=d))
+
+
+def fftshift(x, axes=None, name=None):
+    return Tensor(jnp.fft.fftshift(_arr(x), axes=axes))
+
+
+def ifftshift(x, axes=None, name=None):
+    return Tensor(jnp.fft.ifftshift(_arr(x), axes=axes))

@@ -150,5 +150,8 @@ class FusedTransformerEncoderLayer(Layer):
             normalize_before=normalize_before)
 
     def forward(self, src, src_mask=None, cache=None):
-        out = self.fused_attn(src, attn_mask=src_mask)
+        # pass cache through: the inner layer raises NotImplementedError
+        # for it — silently dropping decode state would recompute full
+        # attention with no diagnostic
+        out = self.fused_attn(src, attn_mask=src_mask, cache=cache)
         return self.ffn(out)

@@ -79,7 +79,14 @@ class ShmRing:
     """Fixed-slot shared-memory queue usable across fork/spawn processes.
 
     ``push_obj``/``pop_obj`` move pickled python objects (numpy batches)
-    through the segment — one copy in, one copy out, no pipe."""
+    through the segment — one copy in, one copy out, no pipe.
+
+    Threading contract: a ShmRing OBJECT belongs to one thread — pop
+    reuses a single buffer, and ``close`` must not race in-flight
+    push/pop on the same handle (the native layer guards the handle
+    table, not readers mid-wait). Cross-PROCESS concurrency is the
+    supported axis: any number of processes each holding their own
+    attach()ed ring."""
 
     def __init__(self, name: str, slot_bytes: int = 8 << 20,
                  n_slots: int = 8, create: bool = True):

@@ -1,0 +1,110 @@
+"""``paddle.linalg`` — linear algebra namespace.
+
+Reference: python/paddle/linalg.py re-exporting tensor/linalg.py
+(svd/qr/eig/inv/solve/... over LAPACK/cuSOLVER kernels).
+
+TPU-native: QR/SVD/eigh/cholesky lower natively through XLA on TPU;
+nonsymmetric eig runs as a host callback (XLA restriction — the
+reference's eig is CPU-kernel-only too, paddle/phi/kernels/cpu/
+eig_kernel.cc).
+"""
+from __future__ import annotations
+
+from .framework.dispatch import call_op as _op
+
+__all__ = ["cholesky", "det", "slogdet", "norm", "cond", "inv", "pinv",
+           "svd", "qr", "lu", "eig", "eigvals", "eigh", "eigvalsh",
+           "matrix_power", "matrix_rank", "solve", "triangular_solve",
+           "lstsq", "multi_dot"]
+
+
+def cholesky(x, upper=False, name=None):
+    out = _op("cholesky", x)
+    return _op("transpose", out, perm=list(range(out.ndim - 2))
+               + [out.ndim - 1, out.ndim - 2]) if upper else out
+
+
+def det(x, name=None):
+    return _op("det", x)
+
+
+def slogdet(x, name=None):
+    return _op("slogdet", x)
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    return _op("p_norm", x, porder=2.0 if p is None else p, axis=axis,
+               keepdim=keepdim)
+
+
+def cond(x, p=None, name=None):
+    return _op("cond", x, p=p)
+
+
+def inv(x, name=None):
+    return _op("inverse", x)
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return _op("pinv", x, rtol=rcond, hermitian=hermitian)
+
+
+def svd(x, full_matrices=False, name=None):
+    return _op("svd", x, full_matrices=full_matrices)
+
+
+def qr(x, mode="reduced", name=None):
+    return _op("qr", x, mode=mode)
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    lu_mat, piv = _op("lu", x)
+    if get_infos:
+        # XLA's LU has no per-matrix info status; report success (0),
+        # matching lapack's info==0 for the factorizations it returns
+        info = _op("zeros", shape=list(x.shape[:-2]) or [1],
+                   dtype="int32")
+        return lu_mat, piv, info
+    return lu_mat, piv
+
+
+def eig(x, name=None):
+    return _op("eig", x)
+
+
+def eigvals(x, name=None):
+    return _op("eigvals", x)
+
+
+def eigh(x, UPLO="L", name=None):
+    return _op("eigh", x, UPLO=UPLO)
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return _op("eigvalsh", x, UPLO=UPLO)
+
+
+def matrix_power(x, n, name=None):
+    return _op("matrix_power", x, n=int(n))
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return _op("matrix_rank", x, rtol=tol)
+
+
+def solve(x, y, name=None):
+    return _op("solve", x, y)
+
+
+def triangular_solve(x, y, upper=True, transpose=False,
+                     unitriangular=False, name=None):
+    return _op("triangular_solve", x, y, upper=upper,
+               transpose=transpose, unitriangular=unitriangular)
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    return _op("lstsq", x, y, rcond=rcond)
+
+
+def multi_dot(xs, name=None):
+    return _op("multi_dot", xs)

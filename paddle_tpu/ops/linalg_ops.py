@@ -121,10 +121,29 @@ def _svd(x, full_matrices=False):
     return u, s, vh
 
 
+def _host_eig(x):
+    """Nonsymmetric eig has no TPU/XLA lowering on accelerators (the
+    reference's eig kernel is CPU-only too, phi/kernels/cpu/
+    eig_kernel.cc) — run it on host via pure_callback so it works under
+    jit on every backend."""
+    import numpy as np
+
+    cdt = jnp.complex64 if x.dtype in (jnp.float32, jnp.complex64) \
+        else jnp.complex128
+
+    def cb(a):
+        w, v = np.linalg.eig(np.asarray(a))
+        return w.astype(cdt), v.astype(cdt)
+
+    n = x.shape[-1]
+    out_shape = (jax.ShapeDtypeStruct(x.shape[:-2] + (n,), cdt),
+                 jax.ShapeDtypeStruct(x.shape, cdt))
+    return jax.pure_callback(cb, out_shape, x, vmap_method="sequential")
+
+
 @register_op("eig")
 def _eig(x):
-    # CPU-only in jax; evaluated via callback on TPU paths if needed
-    return tuple(jnp.linalg.eig(x))
+    return _host_eig(x)
 
 
 @register_op("eigh")
@@ -134,7 +153,18 @@ def _eigh(x, UPLO="L"):
 
 @register_op("eigvals")
 def _eigvals(x):
-    return jnp.linalg.eigvals(x)
+    import numpy as np
+
+    cdt = jnp.complex64 if x.dtype in (jnp.float32, jnp.complex64) \
+        else jnp.complex128
+
+    def cb(a):
+        return np.linalg.eigvals(np.asarray(a)).astype(cdt)
+
+    # dedicated values-only callback: going through _host_eig would
+    # materialize and transfer the n*n eigenvector matrix just to drop it
+    out_shape = jax.ShapeDtypeStruct(x.shape[:-1], cdt)
+    return jax.pure_callback(cb, out_shape, x, vmap_method="sequential")
 
 
 @register_op("eigvalsh")
@@ -195,3 +225,12 @@ def _bincount(x, weights=None, minlength=0):
 @register_op("matrix_nms", nondiff=True, jit=False)
 def _unavailable(*a, **k):
     raise NotImplementedError("matrix_nms pending detection-op milestone")
+
+@register_op("cond")
+def _cond(x, p=None):
+    return jnp.linalg.cond(x, p=p)
+
+
+@register_op("multi_dot")
+def _multi_dot(xs):
+    return jnp.linalg.multi_dot(list(xs))

@@ -1,0 +1,119 @@
+"""``paddle.signal`` — STFT / ISTFT.
+
+Reference: python/paddle/signal.py (stft:11x frame+fft composition,
+istft overlap-add). TPU-native: framing is a gather-free
+strided-reshape + window multiply; the FFT lowers natively in XLA —
+the whole transform jits into one fused program.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from .framework.tensor import Tensor
+
+__all__ = ["stft", "istft", "frame", "overlap_add"]
+
+
+def _arr(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Slice overlapping frames (reference signal.py frame)."""
+    a = _arr(x)
+    if axis not in (-1, a.ndim - 1):
+        raise NotImplementedError("frame supports the last axis")
+    n = a.shape[-1]
+    num = 1 + (n - frame_length) // hop_length
+    idx = (jnp.arange(frame_length)[None, :]
+           + hop_length * jnp.arange(num)[:, None])       # [num, L]
+    out = a[..., idx]                                     # [..., num, L]
+    # reference layout: [..., frame_length, num_frames]
+    return Tensor(jnp.swapaxes(out, -1, -2))
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """Inverse of frame (reference signal.py overlap_add): input
+    [..., frame_length, num_frames] -> [..., output_len]."""
+    a = _arr(x)
+    if axis not in (-1, a.ndim - 1):
+        raise NotImplementedError("overlap_add supports the last axis")
+    frame_length, num = a.shape[-2], a.shape[-1]
+    out_len = frame_length + hop_length * (num - 1)
+    frames = jnp.swapaxes(a, -1, -2)                      # [..., num, L]
+
+    out = jnp.zeros(a.shape[:-2] + (out_len,), a.dtype)
+    for i in range(num):  # static unroll: num is a compile-time constant
+        out = out.at[..., i * hop_length:i * hop_length
+                     + frame_length].add(frames[..., i, :])
+    return Tensor(out)
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False,
+         onesided=True, name=None):
+    """Short-time Fourier transform (reference signal.py stft).
+
+    x: [B, T] or [T]; returns [B, n_fft//2+1 (or n_fft), num_frames]
+    complex.
+    """
+    a = _arr(x)
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is None:
+        win = jnp.ones((win_length,), jnp.float32)
+    else:
+        win = _arr(window).astype(jnp.float32)
+    if win_length < n_fft:  # center-pad the window to n_fft (reference)
+        lpad = (n_fft - win_length) // 2
+        win = jnp.pad(win, (lpad, n_fft - win_length - lpad))
+    if center:
+        pad = n_fft // 2
+        a = jnp.pad(a, [(0, 0)] * (a.ndim - 1) + [(pad, pad)],
+                    mode=pad_mode)
+    framed = frame(Tensor(a), n_fft, hop_length)._data   # [..., n_fft, F]
+    framed = jnp.swapaxes(framed, -1, -2) * win          # [..., F, n_fft]
+    spec = jnp.fft.rfft(framed, axis=-1) if onesided else \
+        jnp.fft.fft(framed, axis=-1)
+    if normalized:
+        spec = spec / jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+    return Tensor(jnp.swapaxes(spec, -1, -2))            # [..., K, F]
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """Inverse STFT with window-envelope normalization (reference
+    signal.py istft)."""
+    spec = _arr(x)
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is None:
+        win = jnp.ones((win_length,), jnp.float32)
+    else:
+        win = _arr(window).astype(jnp.float32)
+    if win_length < n_fft:
+        lpad = (n_fft - win_length) // 2
+        win = jnp.pad(win, (lpad, n_fft - win_length - lpad))
+    frames = jnp.swapaxes(spec, -1, -2)                  # [..., F, K]
+    if normalized:
+        frames = frames * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+    wave = jnp.fft.irfft(frames, n=n_fft, axis=-1) if onesided else \
+        jnp.fft.ifft(frames, axis=-1).real
+    wave = wave * win                                    # [..., F, n_fft]
+    out = overlap_add(Tensor(jnp.swapaxes(wave, -1, -2)),
+                      hop_length)._data
+    # normalize by the summed squared window envelope
+    env = overlap_add(
+        Tensor(jnp.broadcast_to((win * win)[:, None],
+                                (n_fft, frames.shape[-2]))),
+        hop_length)._data
+    out = out / jnp.maximum(env, 1e-11)
+    if center:
+        pad = n_fft // 2
+        out = out[..., pad:out.shape[-1] - pad]
+    if length is not None:
+        out = out[..., :length]
+    return Tensor(out)

@@ -108,8 +108,11 @@ def _parse_signatures(source: str) -> Dict[str, Optional[tuple]]:
 
 def _as_ctypes_arg(a, expected):
     if isinstance(a, np.ndarray):
-        return a.ctypes.data_as(expected) if expected is not None else \
-            a.ctypes.data
+        if expected is not None:
+            return a.ctypes.data_as(expected)
+        # untyped function: pass a c_void_p, NOT the bare int address —
+        # ctypes masks bare ints to C int width, truncating the pointer
+        return a.ctypes.data_as(ctypes.c_void_p)
     if isinstance(a, str):
         return a.encode()
     return a

@@ -1,0 +1,158 @@
+"""paddle.linalg / paddle.fft / paddle.signal — numpy-parity OpTests."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+rng = np.random.RandomState(0)
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+class TestLinalg:
+    def test_svd_reconstruction(self):
+        a = rng.randn(4, 6).astype(np.float32)
+        u, s, vh = paddle.linalg.svd(_t(a))
+        rec = u.numpy() @ np.diag(s.numpy()) @ vh.numpy()
+        np.testing.assert_allclose(rec, a, atol=1e-4)
+
+    def test_qr(self):
+        a = rng.randn(5, 3).astype(np.float32)
+        q, r = paddle.linalg.qr(_t(a))
+        np.testing.assert_allclose(q.numpy() @ r.numpy(), a, atol=1e-5)
+        np.testing.assert_allclose(q.numpy().T @ q.numpy(), np.eye(3),
+                                   atol=1e-5)
+
+    def test_eigh_and_eigvalsh(self):
+        a = rng.randn(4, 4).astype(np.float32)
+        sym = (a + a.T) / 2
+        w, v = paddle.linalg.eigh(_t(sym))
+        np.testing.assert_allclose(
+            v.numpy() @ np.diag(w.numpy()) @ v.numpy().T, sym, atol=1e-4)
+        w2 = paddle.linalg.eigvalsh(_t(sym))
+        np.testing.assert_allclose(w2.numpy(), w.numpy(), atol=1e-5)
+
+    def test_eig_host_callback(self):
+        a = rng.randn(4, 4).astype(np.float32)
+        w, v = paddle.linalg.eig(_t(a))
+        ref_w = np.linalg.eigvals(a)
+        np.testing.assert_allclose(sorted(w.numpy().real),
+                                   sorted(ref_w.real), atol=1e-4)
+        # A v = w v
+        av = a @ v.numpy()
+        wv = v.numpy() * w.numpy()[None, :]
+        np.testing.assert_allclose(av, wv, atol=1e-3)
+
+    def test_inv_solve_pinv(self):
+        a = rng.randn(4, 4).astype(np.float32) + 4 * np.eye(
+            4, dtype=np.float32)
+        b = rng.randn(4, 2).astype(np.float32)
+        np.testing.assert_allclose(
+            paddle.linalg.inv(_t(a)).numpy(), np.linalg.inv(a), atol=1e-4)
+        np.testing.assert_allclose(
+            paddle.linalg.solve(_t(a), _t(b)).numpy(),
+            np.linalg.solve(a, b), atol=1e-4)
+        r = rng.randn(5, 3).astype(np.float32)
+        np.testing.assert_allclose(paddle.linalg.pinv(_t(r)).numpy(),
+                                   np.linalg.pinv(r), atol=1e-4)
+
+    def test_matrix_power_rank_slogdet_cond(self):
+        a = rng.randn(3, 3).astype(np.float32) + 3 * np.eye(
+            3, dtype=np.float32)
+        np.testing.assert_allclose(
+            paddle.linalg.matrix_power(_t(a), 3).numpy(),
+            np.linalg.matrix_power(a, 3), rtol=1e-4)
+        assert int(paddle.linalg.matrix_rank(_t(a))) == 3
+        sign, logdet = paddle.linalg.slogdet(_t(a))
+        rs, rl = np.linalg.slogdet(a)
+        np.testing.assert_allclose(float(sign), rs, atol=1e-5)
+        np.testing.assert_allclose(float(logdet), rl, rtol=1e-4)
+        np.testing.assert_allclose(float(paddle.linalg.cond(_t(a))),
+                                   np.linalg.cond(a), rtol=1e-3)
+
+    def test_lstsq_triangular_multi_dot(self):
+        a = rng.randn(6, 3).astype(np.float32)
+        b = rng.randn(6, 2).astype(np.float32)
+        sol = paddle.linalg.lstsq(_t(a), _t(b))[0]
+        ref = np.linalg.lstsq(a, b, rcond=None)[0]
+        np.testing.assert_allclose(sol.numpy(), ref, atol=1e-4)
+        u = np.triu(rng.randn(4, 4)).astype(np.float32) + 2 * np.eye(
+            4, dtype=np.float32)
+        y = rng.randn(4, 2).astype(np.float32)
+        out = paddle.linalg.triangular_solve(_t(u), _t(y), upper=True)
+        np.testing.assert_allclose(u @ out.numpy(), y, atol=1e-4)
+        ms = [rng.randn(3, 4).astype(np.float32),
+              rng.randn(4, 5).astype(np.float32),
+              rng.randn(5, 2).astype(np.float32)]
+        np.testing.assert_allclose(
+            paddle.linalg.multi_dot([_t(m) for m in ms]).numpy(),
+            ms[0] @ ms[1] @ ms[2], rtol=1e-4)
+
+    def test_grad_flows_through_svd(self):
+        a = _t(rng.randn(4, 4).astype(np.float32))
+        a.stop_gradient = False
+        u, s, vh = paddle.linalg.svd(a)
+        s.sum().backward()
+        assert a.grad is not None
+        assert np.isfinite(a.grad.numpy()).all()
+
+
+class TestFFT:
+    def test_fft_roundtrip_parity(self):
+        x = rng.randn(8, 16).astype(np.float32)
+        out = paddle.fft.fft(_t(x))
+        np.testing.assert_allclose(out.numpy(), np.fft.fft(x),
+                                   atol=1e-4)
+        back = paddle.fft.ifft(out)
+        np.testing.assert_allclose(back.numpy().real, x, atol=1e-5)
+
+    def test_rfft_irfft(self):
+        x = rng.randn(4, 32).astype(np.float32)
+        out = paddle.fft.rfft(_t(x))
+        np.testing.assert_allclose(out.numpy(), np.fft.rfft(x), atol=1e-4)
+        back = paddle.fft.irfft(out, n=32)
+        np.testing.assert_allclose(back.numpy(), x, atol=1e-5)
+
+    def test_fft2_fftn_shift_freq(self):
+        x = rng.randn(4, 8, 8).astype(np.float32)
+        np.testing.assert_allclose(paddle.fft.fft2(_t(x)).numpy(),
+                                   np.fft.fft2(x), atol=1e-3)
+        np.testing.assert_allclose(paddle.fft.fftn(_t(x)).numpy(),
+                                   np.fft.fftn(x), atol=1e-3)
+        np.testing.assert_allclose(paddle.fft.fftfreq(8, d=0.5).numpy(),
+                                   np.fft.fftfreq(8, d=0.5), atol=1e-6)
+        np.testing.assert_allclose(
+            paddle.fft.fftshift(_t(x)).numpy(), np.fft.fftshift(x),
+            atol=1e-6)
+
+
+class TestSignal:
+    def test_frame_overlap_add_inverse(self):
+        from paddle_tpu.signal import frame, overlap_add
+        x = rng.randn(2, 64).astype(np.float32)
+        f = frame(_t(x), frame_length=16, hop_length=16)  # no overlap
+        assert f.shape == [2, 16, 4]
+        back = overlap_add(f, hop_length=16)
+        np.testing.assert_allclose(back.numpy(), x, atol=1e-6)
+
+    def test_stft_matches_manual_dft(self):
+        x = rng.randn(1, 128).astype(np.float32)
+        n_fft, hop = 32, 8
+        spec = paddle.signal.stft(_t(x), n_fft, hop_length=hop,
+                                  center=False)
+        # manual frame 0
+        ref0 = np.fft.rfft(x[0, :n_fft])
+        np.testing.assert_allclose(spec.numpy()[0, :, 0], ref0, atol=1e-3)
+
+    def test_stft_istft_roundtrip(self):
+        x = rng.randn(2, 256).astype(np.float32)
+        n_fft, hop = 64, 16
+        win = np.hanning(n_fft).astype(np.float32)
+        spec = paddle.signal.stft(_t(x), n_fft, hop_length=hop,
+                                  window=_t(win), center=True)
+        back = paddle.signal.istft(spec, n_fft, hop_length=hop,
+                                   window=_t(win), center=True,
+                                   length=256)
+        np.testing.assert_allclose(back.numpy(), x, atol=1e-4)
