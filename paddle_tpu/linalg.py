@@ -33,6 +33,11 @@ def slogdet(x, name=None):
 
 
 def norm(x, p=None, axis=None, keepdim=False, name=None):
+    if p == "fro" or (p is None and axis is None):
+        return _op("frobenius_norm", x, axis=axis, keepdim=keepdim)
+    if p == "nuc":
+        s = _op("svd", x, full_matrices=False)[1]
+        return _op("sum", s, axis=-1, keepdim=keepdim)
     return _op("p_norm", x, porder=2.0 if p is None else p, axis=axis,
                keepdim=keepdim)
 
@@ -62,8 +67,9 @@ def lu(x, pivot=True, get_infos=False, name=None):
     if get_infos:
         # XLA's LU has no per-matrix info status; report success (0),
         # matching lapack's info==0 for the factorizations it returns
-        info = _op("zeros", shape=list(x.shape[:-2]) or [1],
-                   dtype="int32")
+        import jax.numpy as jnp
+        from .framework.tensor import Tensor
+        info = Tensor(jnp.zeros(tuple(x.shape[:-2]) or (1,), jnp.int32))
         return lu_mat, piv, info
     return lu_mat, piv
 

@@ -43,11 +43,12 @@ def overlap_add(x, hop_length, axis=-1, name=None):
     frame_length, num = a.shape[-2], a.shape[-1]
     out_len = frame_length + hop_length * (num - 1)
     frames = jnp.swapaxes(a, -1, -2)                      # [..., num, L]
-
+    # one scatter-add over the frame index matrix — an unrolled
+    # per-frame loop makes compile time linear in num_frames
+    idx = (jnp.arange(frame_length)[None, :]
+           + hop_length * jnp.arange(num)[:, None])       # [num, L]
     out = jnp.zeros(a.shape[:-2] + (out_len,), a.dtype)
-    for i in range(num):  # static unroll: num is a compile-time constant
-        out = out.at[..., i * hop_length:i * hop_length
-                     + frame_length].add(frames[..., i, :])
+    out = out.at[..., idx].add(frames)
     return Tensor(out)
 
 
@@ -100,8 +101,16 @@ def istft(x, n_fft, hop_length=None, win_length=None, window=None,
     frames = jnp.swapaxes(spec, -1, -2)                  # [..., F, K]
     if normalized:
         frames = frames * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
-    wave = jnp.fft.irfft(frames, n=n_fft, axis=-1) if onesided else \
-        jnp.fft.ifft(frames, axis=-1).real
+    if onesided:
+        if return_complex:
+            raise ValueError(
+                "return_complex=True requires onesided=False (a onesided "
+                "spectrum reconstructs a real signal)")
+        wave = jnp.fft.irfft(frames, n=n_fft, axis=-1)
+    else:
+        wave = jnp.fft.ifft(frames, axis=-1)
+        if not return_complex:
+            wave = wave.real
     wave = wave * win                                    # [..., F, n_fft]
     out = overlap_add(Tensor(jnp.swapaxes(wave, -1, -2)),
                       hop_length)._data

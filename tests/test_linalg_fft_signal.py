@@ -156,3 +156,44 @@ class TestSignal:
                                    window=_t(win), center=True,
                                    length=256)
         np.testing.assert_allclose(back.numpy(), x, atol=1e-4)
+
+
+class TestReviewFixes:
+    def test_norm_fro_and_nuc(self):
+        a = rng.randn(4, 5).astype(np.float32)
+        np.testing.assert_allclose(
+            float(paddle.linalg.norm(_t(a), p="fro")),
+            np.linalg.norm(a, "fro"), rtol=1e-5)
+        np.testing.assert_allclose(
+            float(paddle.linalg.norm(_t(a), p="nuc")),
+            np.linalg.norm(a, "nuc"), rtol=1e-4)
+
+    def test_lu_get_infos(self):
+        a = rng.randn(4, 4).astype(np.float32) + 4 * np.eye(
+            4, dtype=np.float32)
+        lu_mat, piv, info = paddle.linalg.lu(_t(a), get_infos=True)
+        assert int(np.asarray(info.numpy()).sum()) == 0
+
+    def test_istft_return_complex(self):
+        import pytest as _pytest
+        x = (rng.randn(1, 64) + 1j * rng.randn(1, 64)).astype(np.complex64)
+        spec = paddle.signal.stft(
+            paddle.to_tensor(x.real.astype(np.float32)), 16, hop_length=4,
+            onesided=False)
+        out = paddle.signal.istft(spec, 16, hop_length=4, onesided=False,
+                                  return_complex=True, length=64)
+        assert "complex" in str(out.dtype)
+        with _pytest.raises(ValueError):
+            paddle.signal.istft(spec, 16, hop_length=4, onesided=True,
+                                return_complex=True)
+
+    def test_overlap_add_many_frames_compiles_fast(self):
+        import time
+        from paddle_tpu.signal import frame, overlap_add
+        x = rng.randn(1, 16000).astype(np.float32)
+        t0 = time.perf_counter()
+        f = frame(_t(x), frame_length=400, hop_length=160)  # ~98 frames
+        back = overlap_add(f, hop_length=160)
+        dt = time.perf_counter() - t0
+        assert back.shape[-1] == 400 + 160 * (f.shape[-1] - 1)
+        assert dt < 20, f"overlap_add too slow to build: {dt}s"

@@ -201,3 +201,43 @@ class TestFusedAdamW:
 
         np.testing.assert_allclose(run(True), run(False),
                                    atol=1e-6, rtol=1e-6)
+
+
+class TestStreamingFlashVariant:
+    """The 3D-grid streaming kernels (no sequence cap) must agree with
+    the VMEM-resident kernels and the lax reference."""
+
+    def test_streaming_matches_resident_fwd_bwd(self):
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.ops import pallas_kernels as pk
+
+        rng = np.random.RandomState(0)
+        q = jnp.asarray(rng.randn(2, 256, 64), jnp.float32)
+        k = jnp.asarray(rng.randn(2, 256, 64), jnp.float32)
+        v = jnp.asarray(rng.randn(2, 256, 64), jnp.float32)
+        for causal in (False, True):
+            o_s, lse_s = pk._fa_call_fwd(q, k, v, 0.125, causal, 128, 128)
+            o_r, lse_r = pk._fa_call_fwd_resident(q, k, v, 0.125, causal,
+                                                  128, 128)
+            np.testing.assert_allclose(np.asarray(o_s), np.asarray(o_r),
+                                       atol=1e-5)
+            np.testing.assert_allclose(np.asarray(lse_s),
+                                       np.asarray(lse_r), atol=1e-5)
+            do = jnp.asarray(rng.randn(2, 256, 64), jnp.float32)
+            gs = pk._fa_call_bwd(q, k, v, o_s, lse_s, do, 0.125, causal,
+                                 128, 128)
+            gr = pk._fa_call_bwd_resident(q, k, v, o_r, lse_r, do, 0.125,
+                                          causal, 128, 128)
+            for a, b in zip(gs, gr):
+                np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                           atol=1e-4)
+
+    def test_dispatch_picks_streaming_beyond_vmem_budget(self):
+        from paddle_tpu.ops import pallas_kernels as pk
+        assert pk._use_resident(1024, 1024, 64)
+        assert not pk._use_resident(16384, 16384, 128)
+        # predicate no longer caps the sequence
+        assert pk._fa_supported(
+            np.zeros((1, 32768, 4, 128)), np.zeros((1, 32768, 4, 128)),
+            None, None, None, 0.0, True)
