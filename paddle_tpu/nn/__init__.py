@@ -15,9 +15,10 @@ from .layer.container import (  # noqa: F401
     LayerDict, LayerList, ParameterList, Sequential,
 )
 from .layer.common import (  # noqa: F401
-    AlphaDropout, Bilinear, CosineSimilarity, Dropout, Dropout2D, Dropout3D,
-    Embedding, Flatten, Identity, Linear, Pad1D, Pad2D, Pad3D, PixelShuffle,
-    Unfold, Upsample, UpsamplingBilinear2D, UpsamplingNearest2D,
+    AlphaDropout, Bilinear, ChannelShuffle, CosineSimilarity, Dropout,
+    Dropout2D, Dropout3D, Embedding, Flatten, Fold, Identity, Linear, Pad1D,
+    Pad2D, Pad3D, PairwiseDistance, PixelShuffle, PixelUnshuffle, Unfold,
+    Upsample, UpsamplingBilinear2D, UpsamplingNearest2D, ZeroPad2D,
 )
 from .layer.conv import (  # noqa: F401
     Conv1D, Conv1DTranspose, Conv2D, Conv2DTranspose, Conv3D,
@@ -30,24 +31,28 @@ from .layer.norm import (  # noqa: F401
 )
 from .layer.pooling import (  # noqa: F401
     AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveAvgPool3D,
-    AdaptiveMaxPool1D, AdaptiveMaxPool2D, AvgPool1D, AvgPool2D, AvgPool3D,
-    MaxPool1D, MaxPool2D, MaxPool3D,
+    AdaptiveMaxPool1D, AdaptiveMaxPool2D, AdaptiveMaxPool3D, AvgPool1D,
+    AvgPool2D, AvgPool3D, MaxPool1D, MaxPool2D, MaxPool3D, MaxUnPool1D,
+    MaxUnPool2D, MaxUnPool3D,
 )
 from .layer.activation import (  # noqa: F401
     CELU, ELU, GELU, GLU, Hardshrink, Hardsigmoid, Hardswish, Hardtanh,
     LeakyReLU, LogSigmoid, LogSoftmax, Maxout, Mish, PReLU, ReLU, ReLU6,
-    RReLU, SELU, Sigmoid, Silu, Softmax, Softplus, Softshrink, Softsign,
-    Swish, Tanh, Tanhshrink, ThresholdedReLU,
+    RReLU, SELU, Sigmoid, Silu, Softmax, Softmax2D, Softplus, Softshrink,
+    Softsign, Swish, Tanh, Tanhshrink, ThresholdedReLU,
 )
 from .layer.loss import (  # noqa: F401
-    BCELoss, BCEWithLogitsLoss, CrossEntropyLoss, HingeEmbeddingLoss,
-    HuberLoss, KLDivLoss, L1Loss, MarginRankingLoss, MSELoss, NLLLoss,
-    SigmoidFocalLoss, SmoothL1Loss,
+    BCELoss, BCEWithLogitsLoss, CosineEmbeddingLoss, CrossEntropyLoss,
+    CTCLoss, HingeEmbeddingLoss, HSigmoidLoss, HuberLoss, KLDivLoss, L1Loss,
+    MarginRankingLoss, MSELoss, MultiLabelSoftMarginLoss, NLLLoss,
+    SigmoidFocalLoss, SmoothL1Loss, TripletMarginLoss,
+    TripletMarginWithDistanceLoss,
 )
 from .layer.transformer import (  # noqa: F401
     MultiHeadAttention, Transformer, TransformerDecoder,
     TransformerDecoderLayer, TransformerEncoder, TransformerEncoderLayer,
 )
+from .decode import BeamSearchDecoder, dynamic_decode  # noqa: F401
 from .layer.rnn import (  # noqa: F401
     BiRNN, GRU, GRUCell, LSTM, LSTMCell, RNN, RNNCellBase, SimpleRNN,
     SimpleRNNCell,

@@ -292,6 +292,9 @@ def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
 def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
                      output_padding=0, groups=1, dilation=1,
                      output_size=None, data_format="NCHW", name=None):
+    if output_size is not None:
+        output_padding = _opad_from_output_size(
+            x, weight, stride, padding, dilation, output_size, 2)
     return _op("conv2d_transpose", x, weight, bias, stride=stride,
                padding=padding, output_padding=output_padding, groups=groups,
                dilation=dilation, output_size=output_size,
@@ -301,6 +304,9 @@ def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
 @_export
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, name=None):
+    if return_mask:
+        return _op("max_pool1d_with_mask", x, kernel_size=kernel_size,
+                   stride=stride, padding=padding, ceil_mode=ceil_mode)
     return _op("max_pool1d", x, kernel_size=kernel_size, stride=stride,
                padding=padding, ceil_mode=ceil_mode)
 
@@ -315,6 +321,12 @@ def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
 @_export
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCHW", name=None):
+    if return_mask:
+        if not data_format.startswith("NC"):
+            raise ValueError(
+                "return_mask=True requires NCHW (reference restriction)")
+        return _op("max_pool2d_with_mask", x, kernel_size=kernel_size,
+                   stride=stride, padding=padding, ceil_mode=ceil_mode)
     return _op("max_pool2d", x, kernel_size=kernel_size, stride=stride,
                padding=padding, ceil_mode=ceil_mode, data_format=data_format)
 
@@ -331,6 +343,12 @@ def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
 @_export
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCDHW", name=None):
+    if return_mask:
+        if not data_format.startswith("NC"):
+            raise ValueError(
+                "return_mask=True requires NCDHW (reference restriction)")
+        return _op("max_pool3d_with_mask", x, kernel_size=kernel_size,
+                   stride=stride, padding=padding, ceil_mode=ceil_mode)
     return _op("max_pool3d", x, kernel_size=kernel_size, stride=stride,
                padding=padding, ceil_mode=ceil_mode, data_format=data_format)
 
@@ -351,6 +369,8 @@ def adaptive_avg_pool1d(x, output_size, name=None):
 
 @_export
 def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    if return_mask:
+        return _adaptive_max_with_mask(x, output_size, 1)
     return _op("adaptive_max_pool1d", x, output_size=output_size)
 
 
@@ -362,7 +382,21 @@ def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
 
 @_export
 def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    if return_mask:
+        return _adaptive_max_with_mask(x, output_size, 2)
     return _op("adaptive_max_pool2d", x, output_size=output_size)
+
+
+def _adaptive_max_with_mask(x, output_size, nd):
+    out = (output_size,) * nd if isinstance(output_size, int) \
+        else tuple(output_size)
+    spatial = x.shape[2:2 + nd]
+    if any(s % o != 0 for s, o in zip(spatial, out)):
+        raise NotImplementedError(
+            "return_mask=True needs output_size dividing the input size")
+    ks = tuple(s // o for s, o in zip(spatial, out))
+    return _op(f"max_pool{nd}d_with_mask", x, kernel_size=ks, stride=ks,
+               padding=0)
 
 
 # ---------------------------------------------------------------------------
@@ -528,3 +562,382 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     return _op("scaled_dot_product_attention", query, key, value, attn_mask,
                key_rng, dropout_p=dropout_p if training else 0.0,
                is_causal=is_causal)
+
+
+@_export
+def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
+                     key_padding_mask=None, attn_mask=None, name=None):
+    """CSR-patterned attention (reference:
+    python/paddle/nn/functional/sparse_attention.py). The CSR pattern is
+    materialised as a dense mask — on TPU the masked-dense form rides the
+    MXU and is the fast path at the block sparsities the reference supports."""
+    return _op("sparse_attention", query, key, value, sparse_csr_offset,
+               sparse_csr_columns, key_padding_mask, attn_mask)
+
+
+# ---------------------------------------------------------------------------
+# transposed convs / 3-D adaptive pooling / unpooling (reference: conv.py,
+# pooling.py)
+# ---------------------------------------------------------------------------
+
+@_export
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCL", name=None):
+    if output_size is not None:
+        output_padding = _opad_from_output_size(
+            x, weight, stride, padding, dilation, output_size, 1)
+    return _op("conv1d_transpose", x, weight, bias, stride=stride,
+               padding=padding, output_padding=output_padding, groups=groups,
+               dilation=dilation, data_format=data_format)
+
+
+def _opad_from_output_size(x, weight, stride, padding, dilation,
+                           output_size, nd):
+    """output_size -> output_padding (reference: conv_transpose derives the
+    extra high-side padding from the requested spatial size)."""
+    def tup(v):
+        return (int(v),) * nd if isinstance(v, int) else \
+            tuple(int(i) for i in v)
+    st, dl = tup(stride), tup(dilation)
+    pd = tup(padding) if not isinstance(padding, (list, tuple)) or \
+        all(isinstance(p, int) for p in padding) else None
+    if pd is None:
+        raise ValueError("output_size with per-side padding is unsupported")
+    if isinstance(padding, int):
+        pd = (padding,) * nd
+    target = [int(v) for v in output_size][-nd:]
+    in_sp = x.shape[2:2 + nd]
+    ks = weight.shape[2:2 + nd]
+    opad = []
+    for d in range(nd):
+        base = (in_sp[d] - 1) * st[d] - 2 * pd[d] + dl[d] * (ks[d] - 1) + 1
+        op = target[d] - base
+        if not 0 <= op < st[d] + dl[d]:
+            raise ValueError(
+                f"invalid output_size {target[d]} for dim {d}: base {base}")
+        opad.append(op)
+    return tuple(opad)
+
+
+@_export
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCDHW", name=None):
+    if output_size is not None:
+        output_padding = _opad_from_output_size(
+            x, weight, stride, padding, dilation, output_size, 3)
+    return _op("conv3d_transpose", x, weight, bias, stride=stride,
+               padding=padding, output_padding=output_padding, groups=groups,
+               dilation=dilation, data_format=data_format)
+
+
+@_export
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _op("adaptive_avg_pool3d", x, output_size=output_size,
+               data_format=data_format)
+
+
+@_export
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    if return_mask:
+        return _adaptive_max_with_mask(x, output_size, 3)
+    return _op("adaptive_max_pool3d", x, output_size=output_size)
+
+
+@_export
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCL", name=None):
+    if not data_format.startswith("NC"):
+        raise ValueError(
+            "max_unpool1d supports channel-first only "
+            "(reference restriction)")
+    return _op("max_unpool1d", x, indices, kernel_size=kernel_size,
+               stride=stride, padding=padding, output_size=output_size)
+
+
+@_export
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCHW", name=None):
+    if not data_format.startswith("NC"):
+        raise ValueError(
+            "max_unpool2d supports channel-first only "
+            "(reference restriction)")
+    return _op("max_unpool2d", x, indices, kernel_size=kernel_size,
+               stride=stride, padding=padding, output_size=output_size)
+
+
+@_export
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCDHW", name=None):
+    if not data_format.startswith("NC"):
+        raise ValueError(
+            "max_unpool3d supports channel-first only "
+            "(reference restriction)")
+    return _op("max_unpool3d", x, indices, kernel_size=kernel_size,
+               stride=stride, padding=padding, output_size=output_size)
+
+
+# ---------------------------------------------------------------------------
+# rearrangement / sampling / video (reference: vision.py, common.py)
+# ---------------------------------------------------------------------------
+
+@_export
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    return _op("fold", x, output_sizes=output_sizes,
+               kernel_sizes=kernel_sizes, strides=strides, paddings=paddings,
+               dilations=dilations)
+
+
+@_export
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    return _op("pixel_unshuffle", x, downscale_factor=downscale_factor,
+               data_format=data_format)
+
+
+@_export
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    return _op("channel_shuffle", x, groups=groups, data_format=data_format)
+
+
+@_export
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    return _op("temporal_shift", x, seg_num=seg_num, shift_ratio=shift_ratio,
+               data_format=data_format)
+
+
+@_export
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    return _op("grid_sample", x, grid, mode=mode, padding_mode=padding_mode,
+               align_corners=align_corners)
+
+
+@_export
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    if isinstance(out_shape, Tensor):
+        out_shape = [int(v) for v in np.asarray(out_shape._data)]
+    return _op("affine_grid", theta, out_shape=tuple(int(v)
+               for v in out_shape), align_corners=align_corners)
+
+
+@_export
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    return pad(x, padding, mode="constant", value=0.0,
+               data_format=data_format)
+
+
+@_export
+def bilinear(x1, x2, weight, bias=None, name=None):
+    return _op("bilinear", x1, x2, weight, bias)
+
+
+@_export
+def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
+    return _op("diag_embed", x, offset=offset, dim1=dim1, dim2=dim2)
+
+
+@_export
+def gather_tree(ids, parents):
+    return _op("gather_tree", ids, parents)
+
+
+# ---------------------------------------------------------------------------
+# extra losses (reference: loss.py, distance.py)
+# ---------------------------------------------------------------------------
+
+def _reduce(loss, reduction):
+    if reduction == "mean":
+        return _op("mean", loss)
+    if reduction == "sum":
+        return _op("sum", loss)
+    return loss
+
+
+@_export
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """Reference semantics (nn/functional/loss.py ctc_loss over warpctc):
+    per-sample NLL; 'mean' divides by label length then averages."""
+    loss = _op("ctc_loss", log_probs, labels, input_lengths, label_lengths,
+               blank=blank)
+    if reduction == "mean":
+        ll = _op("cast", label_lengths, dtype="float32")
+        return _op("mean", _op("divide", loss,
+                               _op("maximum", ll, _op("full_like", ll, fill_value=1.0))))
+    return _reduce(loss, reduction)
+
+
+@_export
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    return _op("hsigmoid_loss", input, label, weight, bias, path_table,
+               path_code, num_classes=num_classes)
+
+
+@_export
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean"):
+    out = _op("margin_cross_entropy", logits, label, margin1=margin1,
+              margin2=margin2, margin3=margin3, scale=scale,
+              return_softmax=return_softmax)
+    if return_softmax:
+        loss, softmax_out = out
+        return _reduce(loss, reduction), softmax_out
+    return _reduce(out, reduction)
+
+
+@_export
+def class_center_sample(label, num_classes, num_samples, group=None):
+    return _op("class_center_sample", label, num_classes=num_classes,
+               num_samples=num_samples)
+
+
+@_export
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    d = _op("subtract", x, y)
+    d = _op("add", d, _op("full_like", d, fill_value=float(epsilon)))
+    return _op("p_norm", d, porder=float(p), axis=-1, keepdim=keepdim)
+
+
+@_export
+def cosine_embedding_loss(input1, input2, label, margin=0.0,
+                          reduction="mean", name=None):
+    cos = _op("cosine_similarity", input1, input2, axis=-1, eps=1e-8)
+    one = _op("full_like", cos, fill_value=1.0)
+    zero = _op("full_like", cos, fill_value=0.0)
+    pos = _op("subtract", one, cos)
+    neg = _op("maximum", _op("subtract", cos,
+                             _op("scale", one, scale=float(margin))), zero)
+    lab = _op("cast", label, dtype=cos.dtype)
+    is_pos = _op("cast", _op("equal", lab, one), cos.dtype)
+    is_neg = _op("cast", _op("equal", lab, _op("scale", one, scale=-1.0)),
+                 cos.dtype)
+    loss = _op("add", _op("multiply", is_pos, pos),
+               _op("multiply", is_neg, neg))
+    return _reduce(loss, reduction)
+
+
+@_export
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean",
+                        name=None):
+    dp = pairwise_distance(input, positive, p, epsilon)
+    dn = pairwise_distance(input, negative, p, epsilon)
+    if swap:
+        dn2 = pairwise_distance(positive, negative, p, epsilon)
+        dn = _op("minimum", dn, dn2)
+    marg = _op("full_like", dp, fill_value=float(margin))
+    loss = _op("maximum", _op("add", _op("subtract", dp, dn), marg),
+               _op("full_like", dp, fill_value=0.0))
+    return _reduce(loss, reduction)
+
+
+@_export
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean",
+                                      name=None):
+    dist = distance_function if distance_function is not None else \
+        (lambda a, b: pairwise_distance(a, b, 2.0))
+    dp = dist(input, positive)
+    dn = dist(input, negative)
+    if swap:
+        dn2 = dist(positive, negative)
+        dn = _op("minimum", dn, dn2)
+    marg = _op("full_like", dp, fill_value=float(margin))
+    loss = _op("maximum", _op("add", _op("subtract", dp, dn), marg),
+               _op("full_like", dp, fill_value=0.0))
+    return _reduce(loss, reduction)
+
+
+@_export
+def multi_label_soft_margin_loss(input, label, weight=None,
+                                 reduction="mean", name=None):
+    lab = _op("cast", label, dtype=input.dtype
+              if hasattr(input, "dtype") else "float32")
+    pos = _op("log_sigmoid", input)
+    neg = _op("log_sigmoid", _op("scale", input, scale=-1.0))
+    one = _op("full_like", lab, fill_value=1.0)
+    per = _op("add", _op("multiply", lab, pos),
+              _op("multiply", _op("subtract", one, lab), neg))
+    if weight is not None:
+        per = _op("multiply", per, weight)
+    loss = _op("scale", _op("mean", per, axis=-1), scale=-1.0)
+    return _reduce(loss, reduction)
+
+
+@_export
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """input: [N, ..., C] probabilities, label: [N, ..., 1] int (reference:
+    nn/functional/loss.py dice_loss)."""
+    nc = input.shape[-1]
+    lab = _op("squeeze", label, axis=-1)
+    oh = _op("one_hot", lab, num_classes=nc)
+    ohf = _op("cast", oh, dtype=input.dtype)
+    axes = tuple(range(1, len(input.shape)))
+    inter = _op("sum", _op("multiply", input, ohf), axis=axes)
+    union = _op("add", _op("sum", input, axis=axes),
+                _op("sum", ohf, axis=axes))
+    num = _op("scale", inter, scale=2.0)
+    eps = _op("full_like", union, fill_value=float(epsilon))
+    dice = _op("divide", num, _op("add", union, eps))
+    one = _op("full_like", dice, fill_value=1.0)
+    return _op("mean", _op("subtract", one, dice))
+
+
+@_export
+def log_loss(input, label, epsilon=1e-4, name=None):
+    eps = _op("full_like", input, fill_value=float(epsilon))
+    one = _op("full_like", input, fill_value=1.0)
+    t1 = _op("multiply", label, _op("log", _op("add", input, eps)))
+    t2 = _op("multiply", _op("subtract", one, label),
+             _op("log", _op("add", _op("subtract", one, input), eps)))
+    return _op("scale", _op("add", t1, t2), scale=-1.0)
+
+
+@_export
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    """Reference: nn/functional/loss.py npair_loss — softmax CE over
+    anchor·positiveᵀ similarities with same-label targets + L2 term."""
+    sim = _op("matmul", anchor, positive, transpose_y=True)
+    lab = _op("cast", labels, dtype=sim.dtype)
+    n = lab.shape[0]
+    li = _op("reshape", lab, shape=(n, 1))
+    eq = _op("cast", _op("equal", li, _op("reshape", lab, shape=(1, n))),
+             sim.dtype)
+    row = _op("sum", eq, axis=1, keepdim=True)
+    tgt = _op("divide", eq, row)
+    ce = _op("softmax_with_cross_entropy", sim, tgt, soft_label=True)
+    l2 = _op("scale", _op("add", _op("sum", _op("multiply", anchor, anchor)),
+                          _op("sum", _op("multiply", positive, positive))),
+             scale=float(l2_reg) * 0.25 / int(n))
+    return _op("add", _op("mean", ce), l2)
+
+
+# ---------------------------------------------------------------------------
+# in-place aliases. Tensors here are facades over immutable jax arrays; the
+# in-place API rebinds the underlying buffer, matching the reference's
+# observable semantics (autograd through in-place ops is likewise undefined
+# in the reference's _ variants).
+# ---------------------------------------------------------------------------
+
+def _inplace(fn, name):
+    def f(x, *args, **kwargs):
+        out = fn(x, *args, **kwargs)
+        if isinstance(x, Tensor) and isinstance(out, Tensor):
+            x._data = out._data
+            return x
+        return out
+    f.__name__ = name
+    return _export(f)
+
+
+relu_ = _inplace(relu, "relu_")
+elu_ = _inplace(elu, "elu_")
+tanh_ = _inplace(tanh, "tanh_")
+softmax_ = _inplace(softmax, "softmax_")

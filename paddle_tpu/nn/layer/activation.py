@@ -9,6 +9,7 @@ from .. import functional as F
 from .layers import Layer
 
 __all__ = [
+    "Softmax2D",
     "ReLU", "ReLU6", "GELU", "Sigmoid", "Tanh", "Softmax", "LogSoftmax",
     "LeakyReLU", "ELU", "CELU", "SELU", "Silu", "Swish", "Mish",
     "Hardsigmoid", "Hardswish", "Hardtanh", "Hardshrink", "Softshrink",
@@ -200,3 +201,11 @@ class GLU(Layer):
 
     def forward(self, x):
         return F.glu(x, self._axis)
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel axis of NCHW input (reference:
+    nn/layer/activation.py Softmax2D)."""
+
+    def forward(self, x):
+        return F.softmax(x, axis=-3)

@@ -12,6 +12,8 @@ from ..initializer import Constant, Normal, XavierUniform
 from .layers import Layer, ParamAttr
 
 __all__ = [
+    "Fold", "PixelUnshuffle", "ChannelShuffle", "ZeroPad2D",
+    "PairwiseDistance",
     "Identity", "Linear", "Embedding", "Dropout", "Dropout2D", "Dropout3D",
     "AlphaDropout", "Flatten", "Pad1D", "Pad2D", "Pad3D", "Upsample",
     "UpsamplingBilinear2D", "UpsamplingNearest2D", "CosineSimilarity",
@@ -239,3 +241,55 @@ class Bilinear(Layer):
     def forward(self, x1, x2):
         from ...framework.dispatch import call_op
         return call_op("bilinear", x1, x2, self.weight, self.bias)
+
+
+class Fold(Layer):
+    """col2im (reference: nn/layer/common.py Fold)."""
+
+    def __init__(self, output_sizes, kernel_sizes, strides=1, paddings=0,
+                 dilations=1, name=None):
+        super().__init__()
+        self._a = (output_sizes, kernel_sizes, strides, paddings, dilations)
+
+    def forward(self, x):
+        o, k, s, p, d = self._a
+        return F.fold(x, o, k, s, p, d)
+
+
+class PixelUnshuffle(Layer):
+    def __init__(self, downscale_factor, data_format="NCHW", name=None):
+        super().__init__()
+        self._r = downscale_factor
+        self._df = data_format
+
+    def forward(self, x):
+        return F.pixel_unshuffle(x, self._r, self._df)
+
+
+class ChannelShuffle(Layer):
+    def __init__(self, groups, data_format="NCHW", name=None):
+        super().__init__()
+        self._g = groups
+        self._df = data_format
+
+    def forward(self, x):
+        return F.channel_shuffle(x, self._g, self._df)
+
+
+class ZeroPad2D(Layer):
+    def __init__(self, padding, data_format="NCHW", name=None):
+        super().__init__()
+        self._p = padding
+        self._df = data_format
+
+    def forward(self, x):
+        return F.zeropad2d(x, self._p, self._df)
+
+
+class PairwiseDistance(Layer):
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self._p, self._eps, self._keepdim = p, epsilon, keepdim
+
+    def forward(self, x, y):
+        return F.pairwise_distance(x, y, self._p, self._eps, self._keepdim)

@@ -111,17 +111,11 @@ class Conv1DTranspose(_ConvNd):
                          output_padding=output_padding)
 
     def forward(self, x, output_size=None):
-        from ...framework.dispatch import call_op
-        # route through the 2-D transpose kernel with a dummy H axis
-        x4 = call_op("unsqueeze", x, axis=2)
-        w4 = call_op("unsqueeze", self.weight, axis=2)
-        out = F.conv2d_transpose(
-            x4, w4, self.bias, stride=(1,) + self._stride,
-            padding=(0,) + _ntuple(self._padding, 1),
-            output_padding=(0,) + _ntuple(self._output_padding, 1),
-            groups=self._groups, dilation=(1,) + self._dilation,
-            data_format="NCHW")
-        return call_op("squeeze", out, axis=2)
+        return F.conv1d_transpose(
+            x, self.weight, self.bias, stride=self._stride,
+            padding=self._padding, output_padding=self._output_padding,
+            groups=self._groups, dilation=self._dilation,
+            output_size=output_size, data_format=self._data_format)
 
 
 class Conv2DTranspose(_ConvNd):
@@ -151,5 +145,8 @@ class Conv3DTranspose(_ConvNd):
                          output_padding=output_padding)
 
     def forward(self, x, output_size=None):
-        raise NotImplementedError(
-            "Conv3DTranspose forward is not implemented yet")
+        return F.conv3d_transpose(
+            x, self.weight, self.bias, stride=self._stride,
+            padding=self._padding, output_padding=self._output_padding,
+            groups=self._groups, dilation=self._dilation,
+            output_size=output_size, data_format=self._data_format)

@@ -9,7 +9,10 @@ from .layers import Layer
 
 __all__ = ["CrossEntropyLoss", "MSELoss", "L1Loss", "NLLLoss", "BCELoss",
            "BCEWithLogitsLoss", "KLDivLoss", "SmoothL1Loss", "HuberLoss",
-           "MarginRankingLoss", "HingeEmbeddingLoss", "SigmoidFocalLoss"]
+           "MarginRankingLoss", "HingeEmbeddingLoss", "SigmoidFocalLoss",
+           "CTCLoss", "HSigmoidLoss", "CosineEmbeddingLoss",
+           "TripletMarginLoss", "TripletMarginWithDistanceLoss",
+           "MultiLabelSoftMarginLoss"]
 
 
 class CrossEntropyLoss(Layer):
@@ -153,3 +156,78 @@ class SigmoidFocalLoss(Layer):
         return F.sigmoid_focal_loss(logit, label, self._normalizer,
                                     self._alpha, self._gamma,
                                     self._reduction)
+
+
+class CTCLoss(Layer):
+    def __init__(self, blank=0, reduction="mean"):
+        super().__init__()
+        self._blank = blank
+        self._reduction = reduction
+
+    def forward(self, log_probs, labels, input_lengths, label_lengths,
+                norm_by_times=False):
+        return F.ctc_loss(log_probs, labels, input_lengths, label_lengths,
+                          self._blank, self._reduction, norm_by_times)
+
+
+class HSigmoidLoss(Layer):
+    """Hierarchical sigmoid (reference: nn/layer/loss.py HSigmoidLoss) —
+    holds the [num_classes-1, feature] internal-node weights."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        self._num_classes = num_classes
+        n_nodes = num_classes - 1
+        self.weight = self.create_parameter(
+            [n_nodes, feature_size], attr=weight_attr)
+        self.bias = self.create_parameter(
+            [n_nodes], attr=bias_attr, is_bias=True)
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        return F.hsigmoid_loss(input, label, self._num_classes, self.weight,
+                               self.bias, path_table, path_code)
+
+
+class CosineEmbeddingLoss(Layer):
+    def __init__(self, margin=0.0, reduction="mean", name=None):
+        super().__init__()
+        self._margin, self._reduction = margin, reduction
+
+    def forward(self, input1, input2, label):
+        return F.cosine_embedding_loss(input1, input2, label, self._margin,
+                                       self._reduction)
+
+
+class TripletMarginLoss(Layer):
+    def __init__(self, margin=1.0, p=2.0, epsilon=1e-6, swap=False,
+                 reduction="mean", name=None):
+        super().__init__()
+        self._a = (margin, p, epsilon, swap, reduction)
+
+    def forward(self, input, positive, negative):
+        m, p, e, s, r = self._a
+        return F.triplet_margin_loss(input, positive, negative, m, p, e, s, r)
+
+
+class TripletMarginWithDistanceLoss(Layer):
+    def __init__(self, distance_function=None, margin=1.0, swap=False,
+                 reduction="mean", name=None):
+        super().__init__()
+        self._a = (distance_function, margin, swap, reduction)
+
+    def forward(self, input, positive, negative):
+        d, m, s, r = self._a
+        return F.triplet_margin_with_distance_loss(
+            input, positive, negative, d, m, s, r)
+
+
+class MultiLabelSoftMarginLoss(Layer):
+    def __init__(self, weight=None, reduction="mean", name=None):
+        super().__init__()
+        self._weight, self._reduction = weight, reduction
+
+    def forward(self, input, label):
+        return F.multi_label_soft_margin_loss(input, label, self._weight,
+                                              self._reduction)

@@ -7,7 +7,8 @@ from __future__ import annotations
 from .. import functional as F
 from .layers import Layer
 
-__all__ = ["MaxPool1D", "MaxPool2D", "MaxPool3D", "AvgPool1D", "AvgPool2D",
+__all__ = ["MaxUnPool1D", "MaxUnPool2D", "MaxUnPool3D", "AdaptiveMaxPool3D",
+           "MaxPool1D", "MaxPool2D", "MaxPool3D", "AvgPool1D", "AvgPool2D",
            "AvgPool3D", "AdaptiveAvgPool1D", "AdaptiveAvgPool2D",
            "AdaptiveAvgPool3D", "AdaptiveMaxPool1D", "AdaptiveMaxPool2D"]
 
@@ -28,8 +29,14 @@ class _PoolNd(Layer):
 
 
 class MaxPool1D(_PoolNd):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 return_mask=False, ceil_mode=False, name=None):
+        super().__init__(kernel_size, stride, padding, ceil_mode)
+        self.return_mask = return_mask
+
     def forward(self, x):
         return F.max_pool1d(x, self.kernel_size, self.stride, self.padding,
+                            return_mask=self.return_mask,
                             ceil_mode=self.ceil_mode)
 
 
@@ -38,17 +45,26 @@ class MaxPool2D(_PoolNd):
                  return_mask=False, ceil_mode=False, data_format="NCHW",
                  name=None):
         super().__init__(kernel_size, stride, padding, ceil_mode)
+        self.return_mask = return_mask
         self.data_format = data_format
 
     def forward(self, x):
         return F.max_pool2d(x, self.kernel_size, self.stride, self.padding,
+                            return_mask=self.return_mask,
                             ceil_mode=self.ceil_mode,
                             data_format=self.data_format)
 
 
 class MaxPool3D(_PoolNd):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 return_mask=False, ceil_mode=False, data_format="NCDHW",
+                 name=None):
+        super().__init__(kernel_size, stride, padding, ceil_mode)
+        self.return_mask = return_mask
+
     def forward(self, x):
         return F.max_pool3d(x, self.kernel_size, self.stride, self.padding,
+                            return_mask=self.return_mask,
                             ceil_mode=self.ceil_mode)
 
 
@@ -103,25 +119,67 @@ class AdaptiveAvgPool3D(Layer):
     def __init__(self, output_size, data_format="NCDHW", name=None):
         super().__init__()
         self._output_size = output_size
+        self._data_format = data_format
 
     def forward(self, x):
-        raise NotImplementedError(
-            "AdaptiveAvgPool3D is not implemented yet")
+        return F.adaptive_avg_pool3d(x, self._output_size,
+                                     self._data_format)
 
 
 class AdaptiveMaxPool1D(Layer):
     def __init__(self, output_size, return_mask=False, name=None):
         super().__init__()
         self._output_size = output_size
+        self._return_mask = return_mask
 
     def forward(self, x):
-        return F.adaptive_max_pool1d(x, self._output_size)
+        return F.adaptive_max_pool1d(x, self._output_size,
+                                     self._return_mask)
 
 
 class AdaptiveMaxPool2D(Layer):
     def __init__(self, output_size, return_mask=False, name=None):
         super().__init__()
         self._output_size = output_size
+        self._return_mask = return_mask
 
     def forward(self, x):
-        return F.adaptive_max_pool2d(x, self._output_size)
+        return F.adaptive_max_pool2d(x, self._output_size,
+                                     self._return_mask)
+
+
+class AdaptiveMaxPool3D(Layer):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__()
+        self._output_size = output_size
+        self._return_mask = return_mask
+
+    def forward(self, x):
+        return F.adaptive_max_pool3d(x, self._output_size,
+                                     self._return_mask)
+
+
+class _MaxUnPoolNd(Layer):
+    _fn = None
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format=None, output_size=None, name=None):
+        super().__init__()
+        self._k, self._s, self._p = kernel_size, stride, padding
+        self._output_size = output_size
+
+    def forward(self, x, indices):
+        return type(self)._fn(x, indices, self._k, self._s, self._p,
+                              self._output_size)
+
+
+class MaxUnPool1D(_MaxUnPoolNd):
+    _fn = staticmethod(F.max_unpool1d)
+
+
+class MaxUnPool2D(_MaxUnPoolNd):
+    _fn = staticmethod(F.max_unpool2d)
+
+
+class MaxUnPool3D(_MaxUnPoolNd):
+    _fn = staticmethod(F.max_unpool3d)

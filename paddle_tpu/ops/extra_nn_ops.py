@@ -1,0 +1,617 @@
+"""Extended nn op families: transposed convs (1d/3d), adaptive 3-D pooling,
+fold/unfold adjoints, max-unpooling, grid sampling, temporal shift, CTC loss,
+hierarchical sigmoid, margin-based softmax, beam-search ancestry.
+
+Reference analogs: paddle/phi/kernels/{conv_transpose_kernel.h,
+pool_kernel.h, fold_kernel.h, unpool_kernel.h, grid_sample_kernel.h,
+temporal_shift_kernel.h}, paddle/fluid/operators/{warpctc_op.cc,
+hierarchical_sigmoid_op.cc, margin_cross_entropy_op.cu, gather_tree_op.cc}.
+All TPU-first: static shapes, lax control flow, gathers/scatters XLA can
+fuse — no CUDA-style per-element kernels.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op
+from .nn_ops import _norm_tuple, _conv_padding, _adaptive_pool
+
+
+# ---------------------------------------------------------------------------
+# transposed convolutions (reference: conv_transpose_kernel.h)
+# ---------------------------------------------------------------------------
+
+def _conv_transpose_nd(x, w, bias, stride, padding, output_padding, dilation,
+                       groups, data_format, nd):
+    """Fractionally-strided conv: lhs_dilation=stride over the flipped,
+    io-swapped kernel — the XLA-native formulation (one conv HLO on the MXU,
+    not a scatter)."""
+    strides = _norm_tuple(stride, nd)
+    pads = _conv_padding(padding, nd)
+    dil = _norm_tuple(dilation, nd)
+    opad = _norm_tuple(output_padding, nd)
+    if isinstance(pads, str):
+        raise NotImplementedError("string padding for conv_transpose")
+    ch_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    if groups != 1:
+        xs = jnp.split(x, groups, axis=ch_axis)
+        ws = jnp.split(w, groups, axis=0)
+        outs = [_conv_transpose_nd(xg, wg, None, stride, padding,
+                                   output_padding, dilation, 1,
+                                   data_format, nd)
+                for xg, wg in zip(xs, ws)]
+        out = jnp.concatenate(outs, axis=ch_axis)
+    else:
+        spatial = "DHW"[3 - nd:]
+        lhs_spec = ("NC" + spatial) if data_format.startswith("NC") \
+            else ("N" + spatial + "C")
+        dn = lax.conv_dimension_numbers(
+            x.shape, (w.shape[1], w.shape[0]) + w.shape[2:],
+            (lhs_spec, "OI" + spatial, lhs_spec))
+        pad_trans = [
+            (d * (k - 1) - p0, d * (k - 1) - p1 + op)
+            for (p0, p1), k, d, op in zip(pads, w.shape[2:], dil, opad)]
+        flip = (slice(None), slice(None)) + (slice(None, None, -1),) * nd
+        out = lax.conv_general_dilated(
+            x, jnp.swapaxes(w, 0, 1)[flip],
+            window_strides=(1,) * nd,
+            padding=pad_trans,
+            lhs_dilation=strides,
+            rhs_dilation=dil,
+            dimension_numbers=dn)
+    if bias is not None:
+        if data_format.startswith("NC"):
+            out = out + bias.reshape((1, -1) + (1,) * nd)
+        else:
+            out = out + bias
+    return out
+
+
+@register_op("conv1d_transpose")
+def _conv1d_transpose(x, w, bias=None, stride=1, padding=0, output_padding=0,
+                      dilation=1, groups=1, data_format="NCL",
+                      output_size=None):
+    df = "NCW" if data_format in ("NCL", "NCW") else "NWC"
+    return _conv_transpose_nd(x, w, bias, stride, padding, output_padding,
+                              dilation, groups, df, 1)
+
+
+@register_op("conv3d_transpose")
+def _conv3d_transpose(x, w, bias=None, stride=1, padding=0, output_padding=0,
+                      dilation=1, groups=1, data_format="NCDHW",
+                      output_size=None):
+    return _conv_transpose_nd(x, w, bias, stride, padding, output_padding,
+                              dilation, groups, data_format, 3)
+
+
+# ---------------------------------------------------------------------------
+# adaptive 3-D pooling
+# ---------------------------------------------------------------------------
+
+@register_op("adaptive_avg_pool3d")
+def _adaptive_avg_pool3d(x, output_size, data_format="NCDHW"):
+    return _adaptive_pool(x, output_size, 3, data_format, "avg")
+
+
+@register_op("adaptive_max_pool3d")
+def _adaptive_max_pool3d(x, output_size, data_format="NCDHW"):
+    return _adaptive_pool(x, output_size, 3, data_format, "max")
+
+
+# ---------------------------------------------------------------------------
+# fold / unpool (reference: fold_kernel.h, unpool_kernel.h)
+# ---------------------------------------------------------------------------
+
+@register_op("fold")
+def _fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1):
+    """col2im: adjoint of unfold. x: [N, C*kh*kw, L] -> [N, C, H, W].
+    Overlaps accumulate (sum), matching the reference kernel."""
+    hs, ws_ = _norm_tuple(output_sizes, 2)
+    kh, kw = _norm_tuple(kernel_sizes, 2)
+    sh, sw = _norm_tuple(strides, 2)
+    dh, dw = _norm_tuple(dilations, 2)
+    pd = _conv_padding(paddings, 2)
+    (pt, pb), (pl, pr) = pd
+    n = x.shape[0]
+    c = x.shape[1] // (kh * kw)
+    oh = (hs + pt + pb - (dh * (kh - 1) + 1)) // sh + 1
+    ow = (ws_ + pl + pr - (dw * (kw - 1) + 1)) // sw + 1
+    cols = x.reshape(n, c, kh, kw, oh, ow)
+    out = jnp.zeros((n, c, hs + pt + pb, ws_ + pl + pr), x.dtype)
+    for i in range(kh):
+        for j in range(kw):
+            out = out.at[:, :,
+                         i * dh:i * dh + (oh - 1) * sh + 1:sh,
+                         j * dw:j * dw + (ow - 1) * sw + 1:sw].add(
+                cols[:, :, i, j])
+    return out[:, :, pt:pt + hs, pl:pl + ws_]
+
+
+def _max_pool_with_mask(x, kernel_size, stride, padding, nd,
+                        ceil_mode=False):
+    """Max pool returning (pooled, flat spatial argmax index per window) —
+    the reference's return_mask=True contract (pool_kernel.h MaxPoolWithIndex).
+    Computed from patches so the index math stays static-shaped for XLA."""
+    ks = _norm_tuple(kernel_size, nd)
+    st = _norm_tuple(stride if stride is not None else kernel_size, nd)
+    pd = _conv_padding(padding, nd)
+    spatial = x.shape[2:]
+    if ceil_mode:
+        # extend right padding so a trailing partial window emits one more
+        # output; the extra region holds dtype-min so it never wins argmax
+        pd = [(p0, p1 + (-(L + p0 + p1 - k)) % s)
+              for (p0, p1), L, k, s in zip(pd, spatial, ks, st)]
+    # finite min, not -inf: patch extraction is a one-hot conv and
+    # -inf * 0 would poison patches with NaN
+    neg = jnp.asarray(jnp.finfo(x.dtype).min
+                      if jnp.issubdtype(x.dtype, jnp.floating)
+                      else jnp.iinfo(x.dtype).min, x.dtype)
+    pad_width = [(0, 0), (0, 0)] + list(pd)
+    xp = jnp.pad(x, pad_width, constant_values=neg)
+    n, c = x.shape[:2]
+    spec = "NCDHW"[:2 + nd] if nd == 3 else ("NCHW" if nd == 2 else "NCW")
+    dn = lax.conv_dimension_numbers(xp.shape, (1, 1) + ks,
+                                    (spec, "OI" + spec[2:], spec))
+    patches = lax.conv_general_dilated_patches(
+        xp, ks, st, [(0, 0)] * nd, dimension_numbers=dn)
+    out_sp = patches.shape[2:]
+    kprod = int(np.prod(ks))
+    patches = patches.reshape((n, c, kprod) + out_sp)
+    pooled = jnp.max(patches, axis=2)
+    win_arg = jnp.argmax(patches, axis=2)  # flat index within the window
+    # window offset -> global (unpadded) flat spatial index
+    k_unravel = jnp.unravel_index(jnp.arange(kprod), ks)
+    g_idx = jnp.zeros((kprod,) + out_sp, jnp.int32)
+    for d in range(nd):
+        o_coord = jnp.arange(out_sp[d]) * st[d] - pd[d][0]
+        shape_o = [1] * (nd + 1)
+        shape_o[1 + d] = out_sp[d]
+        shape_k = [kprod] + [1] * nd
+        coord = (o_coord.reshape(shape_o)
+                 + k_unravel[d].astype(jnp.int32).reshape(shape_k))
+        stride_flat = int(np.prod(spatial[d + 1:]))
+        g_idx = g_idx + coord * stride_flat
+    mask = jnp.take_along_axis(
+        g_idx[None, None], win_arg[:, :, None], axis=2).squeeze(2)
+    return pooled, mask.astype(jnp.int32)
+
+
+@register_op("max_pool1d_with_mask")
+def _max_pool1d_mask(x, kernel_size, stride=None, padding=0,
+                     ceil_mode=False):
+    return _max_pool_with_mask(x, kernel_size, stride, padding, 1, ceil_mode)
+
+
+@register_op("max_pool2d_with_mask")
+def _max_pool2d_mask(x, kernel_size, stride=None, padding=0,
+                     ceil_mode=False):
+    return _max_pool_with_mask(x, kernel_size, stride, padding, 2, ceil_mode)
+
+
+@register_op("max_pool3d_with_mask")
+def _max_pool3d_mask(x, kernel_size, stride=None, padding=0,
+                     ceil_mode=False):
+    return _max_pool_with_mask(x, kernel_size, stride, padding, 3, ceil_mode)
+
+
+def _max_unpool(x, indices, out_spatial):
+    n, c = x.shape[:2]
+    hw = int(np.prod(out_spatial))
+    l = int(np.prod(x.shape[2:]))
+    xf = x.reshape(n * c, l)
+    idx = indices.reshape(n * c, l).astype(jnp.int32)
+    out = jnp.zeros((n * c, hw), x.dtype)
+    out = out.at[jnp.arange(n * c)[:, None], idx].set(xf)
+    return out.reshape((n, c) + tuple(out_spatial))
+
+
+def _unpool_out_size(in_sp, ks, st, pd, output_size, nd):
+    if output_size is not None:
+        os = tuple(int(v) for v in output_size)
+        return os[-nd:]
+    return tuple((in_sp[d] - 1) * st[d] - 2 * pd[d][0] + ks[d]
+                 for d in range(nd))
+
+
+@register_op("max_unpool1d")
+def _max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                  output_size=None):
+    ks = _norm_tuple(kernel_size, 1)
+    st = _norm_tuple(stride if stride is not None else kernel_size, 1)
+    pd = _conv_padding(padding, 1)
+    return _max_unpool(x, indices, _unpool_out_size(
+        x.shape[2:], ks, st, pd, output_size, 1))
+
+
+@register_op("max_unpool2d")
+def _max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                  output_size=None):
+    ks = _norm_tuple(kernel_size, 2)
+    st = _norm_tuple(stride if stride is not None else kernel_size, 2)
+    pd = _conv_padding(padding, 2)
+    return _max_unpool(x, indices, _unpool_out_size(
+        x.shape[2:], ks, st, pd, output_size, 2))
+
+
+@register_op("max_unpool3d")
+def _max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                  output_size=None):
+    ks = _norm_tuple(kernel_size, 3)
+    st = _norm_tuple(stride if stride is not None else kernel_size, 3)
+    pd = _conv_padding(padding, 3)
+    return _max_unpool(x, indices, _unpool_out_size(
+        x.shape[2:], ks, st, pd, output_size, 3))
+
+
+# ---------------------------------------------------------------------------
+# channel/pixel rearrangement, temporal shift
+# ---------------------------------------------------------------------------
+
+@register_op("pixel_unshuffle")
+def _pixel_unshuffle(x, downscale_factor, data_format="NCHW"):
+    r = int(downscale_factor)
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        x = x.reshape(n, c, h // r, r, w // r, r)
+        x = jnp.transpose(x, (0, 1, 3, 5, 2, 4))
+        return x.reshape(n, c * r * r, h // r, w // r)
+    n, h, w, c = x.shape
+    x = x.reshape(n, h // r, r, w // r, r, c)
+    x = jnp.transpose(x, (0, 1, 3, 2, 4, 5))
+    return x.reshape(n, h // r, w // r, c * r * r)
+
+
+@register_op("channel_shuffle")
+def _channel_shuffle(x, groups, data_format="NCHW"):
+    g = int(groups)
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        return jnp.transpose(x.reshape(n, g, c // g, h, w),
+                             (0, 2, 1, 3, 4)).reshape(n, c, h, w)
+    n, h, w, c = x.shape
+    return jnp.transpose(x.reshape(n, h, w, g, c // g),
+                         (0, 1, 2, 4, 3)).reshape(n, h, w, c)
+
+
+@register_op("temporal_shift")
+def _temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW"):
+    """TSM shift (reference: temporal_shift_op.cc): first fold of channels
+    shifts t-1 -> t, second fold shifts t+1 -> t, rest pass through."""
+    if data_format != "NCHW":
+        x = jnp.transpose(x, (0, 3, 1, 2))
+    nt, c, h, w = x.shape
+    t = int(seg_num)
+    n = nt // t
+    c1 = int(c * shift_ratio)
+    c2 = int(c * 2 * shift_ratio)
+    xs = x.reshape(n, t, c, h, w)
+    fwd = jnp.concatenate(
+        [jnp.zeros_like(xs[:, :1, :c1]), xs[:, :-1, :c1]], axis=1)
+    bwd = jnp.concatenate(
+        [xs[:, 1:, c1:c2], jnp.zeros_like(xs[:, :1, c1:c2])], axis=1)
+    out = jnp.concatenate([fwd, bwd, xs[:, :, c2:]], axis=2)
+    out = out.reshape(nt, c, h, w)
+    if data_format != "NCHW":
+        out = jnp.transpose(out, (0, 2, 3, 1))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# grid sampling (reference: grid_sample_kernel.h, affine_grid_op.cc)
+# ---------------------------------------------------------------------------
+
+def _gs_unnormalize(coord, size, align_corners):
+    if align_corners:
+        return (coord + 1.0) * 0.5 * (size - 1)
+    return ((coord + 1.0) * size - 1.0) * 0.5
+
+
+def _gs_reflect(coord, size, align_corners):
+    if align_corners:
+        lo, hi = 0.0, float(size - 1)
+    else:
+        lo, hi = -0.5, size - 0.5
+    span = hi - lo
+    if span <= 0:
+        return jnp.zeros_like(coord)
+    c = jnp.abs(coord - lo) % (2 * span)
+    return lo + jnp.where(c > span, 2 * span - c, c)
+
+
+@register_op("grid_sample")
+def _grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                 align_corners=True):
+    n, c, h, w = x.shape
+    gx = _gs_unnormalize(grid[..., 0].astype(jnp.float32), w, align_corners)
+    gy = _gs_unnormalize(grid[..., 1].astype(jnp.float32), h, align_corners)
+    if padding_mode == "border":
+        gx = jnp.clip(gx, 0, w - 1)
+        gy = jnp.clip(gy, 0, h - 1)
+    elif padding_mode == "reflection":
+        gx = jnp.clip(_gs_reflect(gx, w, align_corners), 0, w - 1)
+        gy = jnp.clip(_gs_reflect(gy, h, align_corners), 0, h - 1)
+
+    def sample_int(ix, iy):
+        """Gather x[n, :, iy, ix] with zero fill for out-of-range."""
+        valid = (ix >= 0) & (ix < w) & (iy >= 0) & (iy < h)
+        ixc = jnp.clip(ix, 0, w - 1)
+        iyc = jnp.clip(iy, 0, h - 1)
+        flat = x.reshape(n, c, h * w)
+        lin = (iyc * w + ixc).reshape(n, 1, -1)
+        vals = jnp.take_along_axis(
+            flat, jnp.broadcast_to(lin, (n, c, lin.shape[-1])), axis=2)
+        vals = vals.reshape((n, c) + ix.shape[1:])
+        return jnp.where(valid[:, None], vals, 0.0)
+
+    if mode == "nearest":
+        out = sample_int(jnp.round(gx).astype(jnp.int32),
+                         jnp.round(gy).astype(jnp.int32))
+        return out.astype(x.dtype)
+    x0 = jnp.floor(gx).astype(jnp.int32)
+    y0 = jnp.floor(gy).astype(jnp.int32)
+    x1, y1 = x0 + 1, y0 + 1
+    wx = gx - x0
+    wy = gy - y0
+    v00 = sample_int(x0, y0)
+    v01 = sample_int(x1, y0)
+    v10 = sample_int(x0, y1)
+    v11 = sample_int(x1, y1)
+    wxe = wx[:, None]
+    wye = wy[:, None]
+    out = (v00 * (1 - wxe) * (1 - wye) + v01 * wxe * (1 - wye)
+           + v10 * (1 - wxe) * wye + v11 * wxe * wye)
+    return out.astype(x.dtype)
+
+
+@register_op("affine_grid")
+def _affine_grid(theta, out_shape, align_corners=True):
+    n, _, h, w = [int(v) for v in out_shape]
+
+    def base(size):
+        if align_corners:
+            return jnp.linspace(-1.0, 1.0, size)
+        return (jnp.arange(size) * 2 + 1) / size - 1.0
+
+    ys = base(h)
+    xs = base(w)
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    ones = jnp.ones_like(gx)
+    coords = jnp.stack([gx, gy, ones], axis=-1).reshape(1, h * w, 3)
+    out = jnp.einsum("bij,bpj->bpi",
+                     theta.astype(jnp.float32),
+                     jnp.broadcast_to(coords, (theta.shape[0], h * w, 3)))
+    return out.reshape(theta.shape[0], h, w, 2).astype(theta.dtype)
+
+
+# ---------------------------------------------------------------------------
+# gather_tree (reference: gather_tree_op.cc — beam-search ancestry walk)
+# ---------------------------------------------------------------------------
+
+@register_op("gather_tree", nondiff=True)
+def _gather_tree(ids, parents):
+    """ids/parents: [max_time, batch, beam]. Walks parent pointers from the
+    last step back, emitting the full sequence per final beam. lax.scan in
+    reverse — the TPU-shaped equivalent of the reference's per-beam loop."""
+    t, b, k = ids.shape
+    beam_iota = jnp.broadcast_to(jnp.arange(k, dtype=ids.dtype), (b, k))
+
+    def step(carry, xs):
+        cur_parents = carry
+        step_ids, step_parents = xs
+        out = jnp.take_along_axis(step_ids, cur_parents, axis=1)
+        nxt = jnp.take_along_axis(step_parents, cur_parents, axis=1)
+        return nxt, out
+
+    init = beam_iota
+    (_, outs) = lax.scan(step, init, (ids[::-1], parents[::-1]))
+    return outs[::-1]
+
+
+# ---------------------------------------------------------------------------
+# CTC loss (reference: warpctc_op.cc semantics, TPU-native lax.scan
+# forward algorithm in log space — no warp-ctc dependency)
+# ---------------------------------------------------------------------------
+
+_NEG_INF = -1e30
+
+
+@register_op("ctc_loss")
+def _ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0):
+    """log_probs: [T, N, C] (will be log-softmaxed), labels: [N, L] int,
+    returns per-sample negative log likelihood [N]."""
+    log_probs = jax.nn.log_softmax(log_probs.astype(jnp.float32), axis=-1)
+    t_max, n, _ = log_probs.shape
+    l_max = labels.shape[1]
+    s = 2 * l_max + 1
+    # extended label sequence: blank l1 blank l2 ... lL blank
+    ext = jnp.full((n, s), blank, labels.dtype)
+    ext = ext.at[:, 1::2].set(labels)
+    lab_len = label_lengths.astype(jnp.int32).reshape(n)
+    in_len = input_lengths.astype(jnp.int32).reshape(n)
+    ext_len = 2 * lab_len + 1
+    # allow alpha[s] <- alpha[s-2] when ext[s] != blank and ext[s] != ext[s-2]
+    ext_prev2 = jnp.concatenate(
+        [jnp.full((n, 2), -1, ext.dtype), ext[:, :-2]], axis=1)
+    skip_ok = (ext != blank) & (ext != ext_prev2)
+    pos = jnp.arange(s)[None, :]
+
+    def emit(lp_t):
+        # lp_t: [N, C] -> [N, S] log prob of each extended symbol
+        return jnp.take_along_axis(lp_t, ext, axis=1)
+
+    alpha0 = jnp.full((n, s), _NEG_INF)
+    alpha0 = alpha0.at[:, 0].set(emit(log_probs[0])[:, 0])
+    alpha0 = jnp.where(
+        (pos == 1) & (lab_len[:, None] > 0),
+        emit(log_probs[0])[:, 1:2], alpha0)
+
+    def step(alpha, xs):
+        lp_t, t = xs
+        a_shift1 = jnp.concatenate(
+            [jnp.full((n, 1), _NEG_INF), alpha[:, :-1]], axis=1)
+        a_shift2 = jnp.concatenate(
+            [jnp.full((n, 2), _NEG_INF), alpha[:, :-2]], axis=1)
+        a_shift2 = jnp.where(skip_ok, a_shift2, _NEG_INF)
+        m = jnp.maximum(jnp.maximum(alpha, a_shift1), a_shift2)
+        dead = m <= _NEG_INF
+        msafe = jnp.where(dead, 0.0, m)
+        inner = (jnp.exp(alpha - msafe) + jnp.exp(a_shift1 - msafe)
+                 + jnp.exp(a_shift2 - msafe))
+        # double-where: log sees a safe value on dead lanes so the untaken
+        # branch can't emit NaN cotangents
+        summed = msafe + jnp.log(jnp.where(dead, 1.0, inner))
+        new = jnp.where(dead, _NEG_INF, summed) + emit(lp_t)
+        # past the input length: freeze alpha
+        new = jnp.where(t < in_len[:, None], new, alpha)
+        return new, None
+
+    ts = jnp.arange(1, t_max)
+    alpha, _ = lax.scan(
+        step, alpha0, (log_probs[1:], ts))
+    # final: alpha[ext_len-1] + alpha[ext_len-2]
+    last1 = jnp.take_along_axis(alpha, (ext_len - 1)[:, None], axis=1)[:, 0]
+    last2 = jnp.where(
+        lab_len > 0,
+        jnp.take_along_axis(
+            alpha, jnp.maximum(ext_len - 2, 0)[:, None], axis=1)[:, 0],
+        _NEG_INF)
+    m = jnp.maximum(last1, last2)
+    dead = m <= _NEG_INF
+    msafe = jnp.where(dead, 0.0, m)
+    inner = jnp.exp(last1 - msafe) + jnp.exp(last2 - msafe)
+    ll = msafe + jnp.log(jnp.where(dead, 1.0, inner))
+    return -ll
+
+
+# ---------------------------------------------------------------------------
+# hierarchical sigmoid (reference: hierarchical_sigmoid_op.cc SimpleCode)
+# ---------------------------------------------------------------------------
+
+@register_op("hsigmoid_loss")
+def _hsigmoid_loss(x, label, weight, bias=None, path_table=None,
+                   path_code=None, num_classes=2):
+    """Default tree = the reference's SimpleCode complete binary tree:
+    code = label + num_classes; node index at depth j = (code >> (len-j)) - 1,
+    branch bit = (code >> (len-1-j)) & 1. Custom trees via path_table (node
+    ids, -1 padded) + path_code (branch bits)."""
+    xf = x.astype(jnp.float32)
+    n = x.shape[0]
+    if path_table is None:
+        depth_max = int(np.ceil(np.log2(max(int(num_classes), 2))))
+        code = label.astype(jnp.int32).reshape(n) + int(num_classes)
+        # length = floor(log2(code)); vectorized over the batch
+        lengths = (jnp.floor(jnp.log2(code.astype(jnp.float32)))
+                   .astype(jnp.int32))
+        j = jnp.arange(depth_max)[None, :]
+        active = j < lengths[:, None]
+        idx = jnp.where(active,
+                        (code[:, None] >> (lengths[:, None] - j)) - 1, 0)
+        bits = jnp.where(
+            active,
+            (code[:, None] >> (lengths[:, None] - 1 - j)) & 1, 0)
+    else:
+        idx = path_table.astype(jnp.int32)
+        active = idx >= 0
+        idx = jnp.where(active, idx, 0)
+        bits = jnp.where(active, path_code.astype(jnp.int32), 0)
+    w_nodes = weight.astype(jnp.float32)[idx]        # [N, D, F]
+    logits = jnp.einsum("nf,ndf->nd", xf, w_nodes)
+    if bias is not None:
+        logits = logits + bias.astype(jnp.float32).reshape(-1)[idx]
+    # binary logistic loss at every active node:
+    #   bit=1 -> -log sigmoid(logit);  bit=0 -> -log sigmoid(-logit)
+    per_node = jax.nn.softplus(logits) - bits * logits
+    loss = jnp.sum(jnp.where(active, per_node, 0.0), axis=1, keepdims=True)
+    return loss.astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# margin cross entropy (reference: margin_cross_entropy_op.cu — ArcFace
+# combined margin over cosine logits) + class_center_sample
+# ---------------------------------------------------------------------------
+
+@register_op("margin_cross_entropy")
+def _margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                          margin3=0.0, scale=64.0, return_softmax=False):
+    lf = logits.astype(jnp.float32)
+    n, c = lf.shape
+    lab = label.astype(jnp.int32).reshape(n)
+    onehot = jax.nn.one_hot(lab, c, dtype=jnp.float32)
+    target = jnp.sum(lf * onehot, axis=1)
+    theta = jnp.arccos(jnp.clip(target, -1.0, 1.0))
+    target_m = jnp.cos(margin1 * theta + margin2) - margin3
+    mod = lf * (1 - onehot) + target_m[:, None] * onehot
+    mod = mod * scale
+    logp = jax.nn.log_softmax(mod, axis=1)
+    loss = (-jnp.sum(logp * onehot, axis=1, keepdims=True)).astype(
+        logits.dtype)
+    if return_softmax:
+        return loss, jnp.exp(logp).astype(logits.dtype)
+    return loss
+
+
+@register_op("class_center_sample", nondiff=True, jit=False)
+def _class_center_sample(label, num_classes, num_samples, seed=None):
+    """Uniform-negative class-center sampling (PLSC / partial-fc style,
+    reference: class_center_sample_op.cu). Eager-only: the sampled id set is
+    data-dependent, so it runs on host numpy (the result feeds a gather whose
+    shape IS static: num_samples)."""
+    lab = np.asarray(label).reshape(-1)
+    rng = np.random.RandomState(seed)
+    pos = np.unique(lab)
+    n_total = int(num_classes)
+    n_samp = int(num_samples)
+    if len(pos) >= n_samp:
+        # positives are never dropped (reference keeps all positives and
+        # num_samples acts as a floor topped up with negatives)
+        sampled = pos
+    else:
+        neg_pool = np.setdiff1d(np.arange(n_total), pos, assume_unique=True)
+        extra = rng.choice(neg_pool, size=n_samp - len(pos), replace=False)
+        sampled = np.concatenate([pos, extra])
+    sampled = np.sort(sampled)
+    remap = np.full(n_total, -1, np.int64)
+    remap[sampled] = np.arange(len(sampled))
+    return (jnp.asarray(remap[lab]), jnp.asarray(sampled))
+
+
+# ---------------------------------------------------------------------------
+# sparse attention (reference: nn/functional/sparse_attention.py — CSR
+# block pattern). Semantics-exact: CSR -> dense mask -> masked softmax.
+# On TPU the dense masked form IS the fast path for moderate sparsity
+# (MXU-friendly); a Pallas block-sparse kernel can override later.
+# ---------------------------------------------------------------------------
+
+@register_op("sparse_attention")
+def _sparse_attention(q, k, v, offset, columns, key_padding_mask=None,
+                      attn_mask=None):
+    b, h, l, d = q.shape
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    nnz = columns.shape[-1]
+    # build dense mask from CSR: valid (row, col) pairs
+    pos = jnp.arange(nnz)[None, None, :]
+    # map each nnz slot to its row: row r owns slots [offset[r], offset[r+1])
+    row_id = jnp.sum(pos[..., None, :] >= offset[..., 1:, None],
+                     axis=-2)                              # [B,H,nnz]
+    valid = pos < offset[..., -1:, None][..., 0, :]
+    mask = jnp.zeros((b, h, l, l), bool)
+    bb = jnp.arange(b)[:, None, None]
+    hh = jnp.arange(h)[None, :, None]
+    mask = mask.at[bb, hh, row_id, columns].max(valid)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qf, kf) / jnp.sqrt(float(d))
+    if key_padding_mask is not None:
+        # [B, L] additive (0 keep / -INF drop), reference sparse_attention.py
+        logits = logits + key_padding_mask.astype(
+            jnp.float32)[:, None, None, :]
+    if attn_mask is not None:
+        logits = logits + attn_mask.astype(jnp.float32)[None, None]
+    logits = jnp.where(mask, logits, _NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    probs = jnp.where(mask, probs, 0.0)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)

@@ -1,0 +1,535 @@
+"""Parity tests for the extended nn op families (OpTest pattern, SURVEY §4):
+numeric comparison against torch CPU reference implementations where torch
+has the op, self-consistency/adjoint identities where it does not.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+torch = pytest.importorskip("torch")
+import torch.nn.functional as TF  # noqa: E402
+
+
+def t(x):
+    return paddle.to_tensor(np.asarray(x))
+
+
+def close(a, b, rtol=1e-4, atol=1e-5):
+    np.testing.assert_allclose(np.asarray(a._data if hasattr(a, "_data")
+                                          else a), b, rtol=rtol, atol=atol)
+
+
+class TestConvTranspose:
+    def test_conv1d_transpose_parity(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 4, 9).astype(np.float32)
+        w = rng.randn(4, 3, 5).astype(np.float32)
+        b = rng.randn(3).astype(np.float32)
+        ours = F.conv1d_transpose(t(x), t(w), t(b), stride=2, padding=1,
+                                  output_padding=1)
+        ref = TF.conv_transpose1d(torch.tensor(x), torch.tensor(w),
+                                  torch.tensor(b), stride=2, padding=1,
+                                  output_padding=1)
+        close(ours, ref.numpy())
+
+    def test_conv3d_transpose_parity(self):
+        rng = np.random.RandomState(1)
+        x = rng.randn(1, 4, 3, 4, 5).astype(np.float32)
+        w = rng.randn(4, 2, 3, 3, 3).astype(np.float32)
+        ours = F.conv3d_transpose(t(x), t(w), stride=2, padding=1)
+        ref = TF.conv_transpose3d(torch.tensor(x), torch.tensor(w),
+                                  stride=2, padding=1)
+        close(ours, ref.numpy())
+
+    def test_conv3d_transpose_groups(self):
+        rng = np.random.RandomState(2)
+        x = rng.randn(1, 4, 4, 4, 4).astype(np.float32)
+        w = rng.randn(4, 2, 2, 2, 2).astype(np.float32)
+        ours = F.conv3d_transpose(t(x), t(w), groups=2, stride=1)
+        ref = TF.conv_transpose3d(torch.tensor(x), torch.tensor(w), groups=2)
+        close(ours, ref.numpy())
+
+    def test_layer_forward(self):
+        layer = nn.Conv3DTranspose(4, 6, 3, stride=2, padding=1)
+        y = layer(t(np.random.randn(2, 4, 4, 4, 4).astype(np.float32)))
+        assert tuple(y.shape) == (2, 6, 7, 7, 7)
+        l1 = nn.Conv1DTranspose(4, 6, 3, stride=2)
+        y1 = l1(t(np.random.randn(2, 4, 8).astype(np.float32)))
+        assert tuple(y1.shape) == (2, 6, 17)
+
+
+class TestPooling3D:
+    def test_adaptive_avg_pool3d(self):
+        rng = np.random.RandomState(3)
+        x = rng.randn(2, 3, 8, 6, 10).astype(np.float32)
+        ours = F.adaptive_avg_pool3d(t(x), (4, 3, 5))
+        ref = TF.adaptive_avg_pool3d(torch.tensor(x), (4, 3, 5))
+        close(ours, ref.numpy())
+
+    def test_adaptive_avg_pool3d_nondivisible(self):
+        rng = np.random.RandomState(4)
+        x = rng.randn(1, 2, 7, 5, 9).astype(np.float32)
+        ours = F.adaptive_avg_pool3d(t(x), (3, 2, 4))
+        ref = TF.adaptive_avg_pool3d(torch.tensor(x), (3, 2, 4))
+        close(ours, ref.numpy())
+
+    def test_adaptive_max_pool3d(self):
+        rng = np.random.RandomState(5)
+        x = rng.randn(2, 3, 8, 8, 8).astype(np.float32)
+        ours = F.adaptive_max_pool3d(t(x), 4)
+        ref = TF.adaptive_max_pool3d(torch.tensor(x), 4)
+        close(ours, ref.numpy())
+
+
+class TestUnpool:
+    def test_max_pool2d_mask_and_unpool_roundtrip(self):
+        rng = np.random.RandomState(6)
+        x = rng.randn(2, 3, 8, 8).astype(np.float32)
+        pooled, mask = F.max_pool2d(t(x), 2, stride=2, return_mask=True)
+        tp, tm = TF.max_pool2d(torch.tensor(x), 2, stride=2,
+                               return_indices=True)
+        close(pooled, tp.numpy())
+        np.testing.assert_array_equal(np.asarray(mask._data), tm.numpy())
+        ours_up = F.max_unpool2d(pooled, mask, 2, stride=2)
+        ref_up = TF.max_unpool2d(tp, tm, 2, stride=2)
+        close(ours_up, ref_up.numpy())
+
+    def test_max_pool2d_mask_padding(self):
+        rng = np.random.RandomState(7)
+        x = rng.randn(1, 2, 7, 7).astype(np.float32)
+        pooled, mask = F.max_pool2d(t(x), 3, stride=2, padding=1,
+                                    return_mask=True)
+        tp, tm = TF.max_pool2d(torch.tensor(x), 3, stride=2, padding=1,
+                               return_indices=True)
+        close(pooled, tp.numpy())
+        np.testing.assert_array_equal(np.asarray(mask._data), tm.numpy())
+
+    def test_max_unpool1d_3d(self):
+        rng = np.random.RandomState(8)
+        x1 = rng.randn(2, 3, 10).astype(np.float32)
+        p1, m1 = F.max_pool1d(t(x1), 2, return_mask=True)
+        tp1, tm1 = TF.max_pool1d(torch.tensor(x1), 2, return_indices=True)
+        close(F.max_unpool1d(p1, m1, 2),
+              TF.max_unpool1d(tp1, tm1, 2).numpy())
+        x3 = rng.randn(1, 2, 4, 4, 4).astype(np.float32)
+        p3, m3 = F.max_pool3d(t(x3), 2, return_mask=True)
+        tp3, tm3 = TF.max_pool3d(torch.tensor(x3), 2, return_indices=True)
+        close(F.max_unpool3d(p3, m3, 2),
+              TF.max_unpool3d(tp3, tm3, 2).numpy())
+
+    def test_unpool_layer(self):
+        x = np.random.randn(1, 2, 6, 6).astype(np.float32)
+        pooled, mask = F.max_pool2d(t(x), 2, return_mask=True)
+        out = nn.MaxUnPool2D(2)(pooled, mask)
+        assert tuple(out.shape) == (1, 2, 6, 6)
+
+
+class TestFoldUnfold:
+    def test_fold_parity(self):
+        rng = np.random.RandomState(9)
+        x = rng.randn(2, 3 * 2 * 2, 9).astype(np.float32)
+        ours = F.fold(t(x), (4, 4), (2, 2), strides=1, paddings=0)
+        ref = TF.fold(torch.tensor(x), (4, 4), (2, 2))
+        close(ours, ref.numpy())
+
+    def test_fold_stride_pad_dilation(self):
+        rng = np.random.RandomState(10)
+        # L for (H=6,W=6,k=2,s=2,p=1,d=1): ((6+2-2)/2+1)^2 = 16
+        x = rng.randn(1, 3 * 4, 16).astype(np.float32)
+        ours = F.fold(t(x), (6, 6), (2, 2), strides=2, paddings=1)
+        ref = TF.fold(torch.tensor(x), (6, 6), (2, 2), stride=2, padding=1)
+        close(ours, ref.numpy())
+
+    def test_fold_unfold_adjoint(self):
+        # <unfold(x), y> == <x, fold(y)> — the defining adjoint identity
+        rng = np.random.RandomState(11)
+        x = rng.randn(1, 2, 6, 6).astype(np.float32)
+        y = rng.randn(1, 2 * 9, 16).astype(np.float32)
+        ux = np.asarray(F.unfold(t(x), 3)._data)
+        fy = np.asarray(F.fold(t(y), (6, 6), 3)._data)
+        np.testing.assert_allclose((ux * y).sum(), (x * fy).sum(), rtol=1e-4)
+
+
+class TestRearrange:
+    def test_pixel_unshuffle(self):
+        rng = np.random.RandomState(12)
+        x = rng.randn(2, 3, 8, 8).astype(np.float32)
+        close(F.pixel_unshuffle(t(x), 2),
+              TF.pixel_unshuffle(torch.tensor(x), 2).numpy())
+
+    def test_pixel_unshuffle_inverts_shuffle(self):
+        x = np.random.randn(1, 16, 4, 4).astype(np.float32)
+        y = F.pixel_shuffle(t(x), 2)
+        back = F.pixel_unshuffle(y, 2)
+        close(back, x)
+
+    def test_channel_shuffle(self):
+        rng = np.random.RandomState(13)
+        x = rng.randn(2, 12, 4, 4).astype(np.float32)
+        close(F.channel_shuffle(t(x), 3),
+              TF.channel_shuffle(torch.tensor(x), 3).numpy())
+
+    def test_temporal_shift(self):
+        # hand check: first fold comes from t-1, second fold from t+1
+        x = np.arange(2 * 2 * 4 * 1 * 1, dtype=np.float32).reshape(
+            4, 4, 1, 1)  # N=2 segments of T=2
+        out = np.asarray(F.temporal_shift(t(x), seg_num=2,
+                                          shift_ratio=0.25)._data)
+        xs = x.reshape(2, 2, 4, 1, 1)
+        assert np.all(out.reshape(2, 2, 4, 1, 1)[:, 0, 0] == 0)  # fwd pad
+        assert np.all(out.reshape(2, 2, 4, 1, 1)[:, 1, 0]
+                      == xs[:, 0, 0])
+        assert np.all(out.reshape(2, 2, 4, 1, 1)[:, 0, 1]
+                      == xs[:, 1, 1])  # bwd shift
+        assert np.all(out.reshape(2, 2, 4, 1, 1)[:, :, 2:]
+                      == xs[:, :, 2:])  # passthrough
+
+
+class TestGridSample:
+    @pytest.mark.parametrize("mode", ["bilinear", "nearest"])
+    @pytest.mark.parametrize("pmode", ["zeros", "border", "reflection"])
+    @pytest.mark.parametrize("align", [True, False])
+    def test_parity(self, mode, pmode, align):
+        rng = np.random.RandomState(14)
+        x = rng.randn(2, 3, 5, 7).astype(np.float32)
+        grid = rng.uniform(-1.3, 1.3, (2, 4, 6, 2)).astype(np.float32)
+        ours = F.grid_sample(t(x), t(grid), mode=mode, padding_mode=pmode,
+                             align_corners=align)
+        ref = TF.grid_sample(torch.tensor(x), torch.tensor(grid), mode=mode,
+                             padding_mode=pmode, align_corners=align)
+        close(ours, ref.numpy(), rtol=1e-4, atol=1e-4)
+
+    @pytest.mark.parametrize("align", [True, False])
+    def test_affine_grid_parity(self, align):
+        rng = np.random.RandomState(15)
+        theta = rng.randn(2, 2, 3).astype(np.float32)
+        ours = F.affine_grid(t(theta), (2, 3, 5, 7), align_corners=align)
+        ref = TF.affine_grid(torch.tensor(theta), (2, 3, 5, 7),
+                             align_corners=align)
+        close(ours, ref.numpy(), rtol=1e-4, atol=1e-5)
+
+
+class TestCTC:
+    def test_ctc_loss_parity(self):
+        rng = np.random.RandomState(16)
+        T_, N, C, L = 12, 3, 6, 4
+        logits = rng.randn(T_, N, C).astype(np.float32)
+        labels = rng.randint(1, C, (N, L)).astype(np.int32)
+        in_len = np.array([12, 10, 8], np.int64)
+        lab_len = np.array([4, 3, 2], np.int64)
+        ours = F.ctc_loss(t(logits), t(labels), t(in_len), t(lab_len),
+                          blank=0, reduction="none")
+        ref = TF.ctc_loss(torch.tensor(logits).log_softmax(-1),
+                          torch.tensor(labels.astype(np.int64)),
+                          torch.tensor(in_len), torch.tensor(lab_len),
+                          blank=0, reduction="none")
+        close(ours, ref.numpy(), rtol=1e-4, atol=1e-4)
+
+    def test_ctc_loss_grad_flows(self):
+        rng = np.random.RandomState(17)
+        logits = paddle.to_tensor(
+            rng.randn(6, 2, 5).astype(np.float32), stop_gradient=False)
+        labels = t(rng.randint(1, 5, (2, 3)).astype(np.int32))
+        loss = F.ctc_loss(logits, labels, t(np.array([6, 6])),
+                          t(np.array([3, 2])))
+        loss.backward()
+        g = np.asarray(logits.grad._data)
+        assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+    def test_ctc_layer(self):
+        rng = np.random.RandomState(18)
+        loss = nn.CTCLoss(blank=0)(
+            t(rng.randn(8, 2, 5).astype(np.float32)),
+            t(rng.randint(1, 5, (2, 3)).astype(np.int32)),
+            t(np.array([8, 8])), t(np.array([3, 3])))
+        assert np.isfinite(float(loss))
+
+
+class TestHSigmoid:
+    def test_probabilities_normalize(self):
+        """Sum over all classes of exp(-loss(class)) must be 1 — the tree
+        defines a proper distribution."""
+        rng = np.random.RandomState(19)
+        num_classes, feat = 6, 4
+        x = rng.randn(1, feat).astype(np.float32)
+        w = rng.randn(num_classes - 1, feat).astype(np.float32)
+        b = rng.randn(num_classes - 1).astype(np.float32)
+        total = 0.0
+        for c in range(num_classes):
+            loss = F.hsigmoid_loss(t(x), t(np.array([c])), num_classes,
+                                   t(w), t(b))
+            total += float(np.exp(-np.asarray(loss._data)[0, 0]))
+        assert abs(total - 1.0) < 1e-4
+
+    def test_layer_and_grad(self):
+        layer = nn.HSigmoidLoss(8, 10)
+        x = paddle.to_tensor(
+            np.random.randn(4, 8).astype(np.float32), stop_gradient=False)
+        loss = layer(x, t(np.array([1, 3, 5, 9])))
+        paddle.mean(loss).backward()
+        assert np.isfinite(np.asarray(x.grad._data)).all()
+        assert np.abs(np.asarray(layer.weight.grad._data)).sum() > 0
+
+
+class TestMarginLosses:
+    def test_margin_cross_entropy_reduces_to_ce_at_zero_margin(self):
+        rng = np.random.RandomState(20)
+        logits = rng.uniform(-1, 1, (4, 7)).astype(np.float32)
+        label = np.array([0, 2, 5, 6])
+        loss = F.margin_cross_entropy(t(logits), t(label), margin1=1.0,
+                                      margin2=0.0, margin3=0.0, scale=1.0,
+                                      reduction="none")
+        ref = TF.cross_entropy(torch.tensor(logits),
+                               torch.tensor(label), reduction="none")
+        close(loss, ref.numpy().reshape(-1, 1), rtol=1e-4, atol=1e-5)
+
+    def test_margin_cross_entropy_arcface(self):
+        rng = np.random.RandomState(21)
+        # cosine logits in [-1, 1]
+        logits = rng.uniform(-1, 1, (3, 5)).astype(np.float32)
+        label = np.array([1, 0, 4])
+        loss = F.margin_cross_entropy(t(logits), t(label), margin2=0.5,
+                                      scale=64.0, reduction="none")
+        # manual arcface
+        lf = logits.copy()
+        for i, c in enumerate(label):
+            lf[i, c] = np.cos(np.arccos(np.clip(lf[i, c], -1, 1)) + 0.5)
+        lf *= 64.0
+        ref = TF.cross_entropy(torch.tensor(lf), torch.tensor(label),
+                               reduction="none")
+        close(loss, ref.numpy().reshape(-1, 1), rtol=1e-4, atol=1e-4)
+
+    def test_class_center_sample(self):
+        label = np.array([3, 1, 3, 7])
+        remapped, sampled = F.class_center_sample(t(label), 10, 6)
+        s = np.asarray(sampled._data)
+        r = np.asarray(remapped._data)
+        assert len(s) == 6 and set([1, 3, 7]) <= set(s.tolist())
+        np.testing.assert_array_equal(s[r], label)
+
+    def test_triplet_and_cosine_losses(self):
+        rng = np.random.RandomState(22)
+        a = rng.randn(5, 8).astype(np.float32)
+        p = rng.randn(5, 8).astype(np.float32)
+        n = rng.randn(5, 8).astype(np.float32)
+        ours = F.triplet_margin_loss(t(a), t(p), t(n), margin=1.0,
+                                     reduction="none")
+        ref = TF.triplet_margin_loss(torch.tensor(a), torch.tensor(p),
+                                     torch.tensor(n), margin=1.0,
+                                     reduction="none")
+        close(ours, ref.numpy(), rtol=1e-4, atol=1e-5)
+        lab = np.array([1, -1, 1, -1, 1])
+        ours_c = F.cosine_embedding_loss(t(a), t(p), t(lab), margin=0.2,
+                                         reduction="none")
+        ref_c = TF.cosine_embedding_loss(
+            torch.tensor(a), torch.tensor(p), torch.tensor(lab),
+            margin=0.2, reduction="none")
+        close(ours_c, ref_c.numpy(), rtol=1e-4, atol=1e-5)
+
+    def test_multilabel_and_pairwise(self):
+        rng = np.random.RandomState(23)
+        x = rng.randn(4, 6).astype(np.float32)
+        y = (rng.rand(4, 6) > 0.5).astype(np.float32)
+        ours = F.multi_label_soft_margin_loss(t(x), t(y), reduction="none")
+        ref = TF.multilabel_soft_margin_loss(
+            torch.tensor(x), torch.tensor(y), reduction="none")
+        close(ours, ref.numpy(), rtol=1e-4, atol=1e-5)
+        a = rng.randn(4, 6).astype(np.float32)
+        b = rng.randn(4, 6).astype(np.float32)
+        ours_d = F.pairwise_distance(t(a), t(b), p=2.0)
+        ref_d = TF.pairwise_distance(torch.tensor(a), torch.tensor(b), p=2.0)
+        close(ours_d, ref_d.numpy(), rtol=1e-4, atol=1e-4)
+
+    def test_dice_log_npair_run(self):
+        rng = np.random.RandomState(24)
+        probs = np.abs(rng.rand(2, 4, 3)).astype(np.float32)
+        probs /= probs.sum(-1, keepdims=True)
+        lab = rng.randint(0, 3, (2, 4, 1))
+        d = F.dice_loss(t(probs), t(lab))
+        assert 0.0 <= float(d) <= 1.0
+        x = np.clip(rng.rand(4, 1).astype(np.float32), 0.05, 0.95)
+        y = (rng.rand(4, 1) > 0.5).astype(np.float32)
+        ll = F.log_loss(t(x), t(y))
+        ref_ll = -(y * np.log(x + 1e-4) + (1 - y) * np.log(1 - x + 1e-4))
+        close(ll, ref_ll, rtol=1e-4)
+        anc = rng.randn(4, 8).astype(np.float32)
+        pos = rng.randn(4, 8).astype(np.float32)
+        npl = F.npair_loss(t(anc), t(pos), t(np.array([0, 1, 0, 2])))
+        assert np.isfinite(float(npl))
+
+
+class TestGatherTreeDecode:
+    def test_gather_tree_parity_with_torch_semantics(self):
+        # manual 2-step example
+        ids = np.array([[[1, 2]], [[3, 4]]], np.int64)       # [T=2,B=1,K=2]
+        parents = np.array([[[0, 0]], [[1, 0]]], np.int64)
+        out = np.asarray(F.gather_tree(t(ids), t(parents))._data)
+        # final beam 0 traces parent 1 at t=1 -> token ids[0][1]=2, then 3
+        np.testing.assert_array_equal(out[:, 0, 0], [2, 3])
+        np.testing.assert_array_equal(out[:, 0, 1], [1, 4])
+
+    def test_beam_search_decoder_greedy_consistency(self):
+        """A deterministic cell whose logits always prefer token 2 then
+        end_token: beam 0 must emit that sequence."""
+        import paddle_tpu
+        vocab = 5
+
+        class Cell:
+            def __call__(self, inp, states):
+                step = states
+                base = np.full((inp.shape[0], vocab), -10.0, np.float32)
+                logits = np.where(
+                    np.asarray(step._data)[:, None] < 2,
+                    np.eye(1, vocab, 2, dtype=np.float32) * 20 + base,
+                    np.eye(1, vocab, 1, dtype=np.float32) * 20 + base)
+                return (paddle_tpu.to_tensor(logits),
+                        paddle_tpu.to_tensor(
+                            np.asarray(step._data) + 1))
+
+        dec = nn.BeamSearchDecoder(Cell(), start_token=0, end_token=1,
+                                   beam_size=2)
+        ids, lp = nn.dynamic_decode(
+            dec, inits=paddle.to_tensor(np.zeros(3, np.int32)),
+            max_step_num=6)
+        seq = np.asarray(ids._data)[:, 0]  # best beam per batch
+        assert seq.shape[0] == 3
+        for row in seq:
+            assert row[0] == 2 and row[1] == 2 and row[2] == 1
+
+
+class TestSparseAttention:
+    def test_matches_dense_when_full(self):
+        rng = np.random.RandomState(25)
+        b, h, l, d = 1, 2, 4, 8
+        q = rng.randn(b, h, l, d).astype(np.float32)
+        k = rng.randn(b, h, l, d).astype(np.float32)
+        v = rng.randn(b, h, l, d).astype(np.float32)
+        offset = np.tile(np.arange(0, (l + 1) * l, l), (b, h, 1)).astype(
+            np.int32)
+        cols = np.tile(np.tile(np.arange(l), l), (b, h, 1)).astype(np.int32)
+        out = np.asarray(F.sparse_attention(
+            t(q), t(k), t(v), t(offset), t(cols))._data)
+        ref = TF.scaled_dot_product_attention(
+            torch.tensor(q), torch.tensor(k), torch.tensor(v)).numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_banded_pattern(self):
+        rng = np.random.RandomState(26)
+        b, h, l, d = 1, 1, 4, 4
+        q = rng.randn(b, h, l, d).astype(np.float32)
+        k = rng.randn(b, h, l, d).astype(np.float32)
+        v = rng.randn(b, h, l, d).astype(np.float32)
+        # each row attends to itself only
+        offset = np.arange(l + 1).reshape(1, 1, -1).astype(np.int32)
+        cols = np.arange(l).reshape(1, 1, -1).astype(np.int32)
+        out = np.asarray(F.sparse_attention(
+            t(q), t(k), t(v), t(offset), t(cols))._data)
+        np.testing.assert_allclose(out, v, rtol=1e-4, atol=1e-5)
+
+
+class TestInplaceAliases:
+    def test_relu_(self):
+        x = t(np.array([-1.0, 2.0], np.float32))
+        y = F.relu_(x)
+        assert y is x
+        np.testing.assert_array_equal(np.asarray(x._data), [0.0, 2.0])
+
+
+class TestReviewFixes:
+    def test_max_pool_return_mask_ceil_mode(self):
+        rng = np.random.RandomState(30)
+        x = rng.randn(1, 2, 7, 7).astype(np.float32)
+        pooled, mask = F.max_pool2d(t(x), 3, stride=2, return_mask=True,
+                                    ceil_mode=True)
+        tp, tm = TF.max_pool2d(torch.tensor(x), 3, stride=2,
+                               return_indices=True, ceil_mode=True)
+        close(pooled, tp.numpy())
+        np.testing.assert_array_equal(np.asarray(mask._data), tm.numpy())
+
+    def test_max_pool_return_mask_rejects_nhwc(self):
+        x = t(np.zeros((1, 4, 4, 2), np.float32))
+        with pytest.raises(ValueError):
+            F.max_pool2d(x, 2, return_mask=True, data_format="NHWC")
+
+    def test_adaptive_max_pool_return_mask(self):
+        rng = np.random.RandomState(31)
+        x = rng.randn(1, 2, 8, 8).astype(np.float32)
+        pooled, mask = F.adaptive_max_pool2d(t(x), 4, return_mask=True)
+        tp, tm = TF.adaptive_max_pool2d(torch.tensor(x), 4,
+                                        return_indices=True)
+        close(pooled, tp.numpy())
+        np.testing.assert_array_equal(np.asarray(mask._data), tm.numpy())
+        with pytest.raises(NotImplementedError):
+            F.adaptive_max_pool2d(t(np.zeros((1, 2, 7, 7), np.float32)),
+                                  3, return_mask=True)
+
+    def test_max_unpool_rejects_channel_last(self):
+        x = t(np.zeros((1, 4, 4, 2), np.float32))
+        with pytest.raises(ValueError):
+            F.max_unpool2d(x, x, 2, data_format="NHWC")
+
+    def test_conv_transpose_output_size(self):
+        rng = np.random.RandomState(32)
+        x = rng.randn(1, 4, 5, 5).astype(np.float32)
+        w = rng.randn(4, 3, 3, 3).astype(np.float32)
+        # stride 2: base out = 9; output_size 10 => output_padding 1
+        ours = F.conv2d_transpose(t(x), t(w), stride=2, padding=1,
+                                  output_size=[10, 10])
+        ref = TF.conv_transpose2d(torch.tensor(x), torch.tensor(w),
+                                  stride=2, padding=1, output_padding=1)
+        close(ours, ref.numpy())
+        with pytest.raises(ValueError):
+            F.conv2d_transpose(t(x), t(w), stride=2, padding=1,
+                               output_size=[40, 40])
+
+    def test_sparse_attention_key_padding_mask(self):
+        rng = np.random.RandomState(33)
+        b, h, l, d = 1, 1, 4, 4
+        q = rng.randn(b, h, l, d).astype(np.float32)
+        k = rng.randn(b, h, l, d).astype(np.float32)
+        v = rng.randn(b, h, l, d).astype(np.float32)
+        offset = np.tile(np.arange(0, (l + 1) * l, l), (b, h, 1)).astype(
+            np.int32)
+        cols = np.tile(np.tile(np.arange(l), l), (b, h, 1)).astype(np.int32)
+        kpm = np.array([[0.0, 0.0, 0.0, -1e9]], np.float32)  # drop key 3
+        out = np.asarray(F.sparse_attention(
+            t(q), t(k), t(v), t(offset), t(cols),
+            key_padding_mask=t(kpm))._data)
+        mask = torch.zeros(1, 1, 1, l)
+        mask[..., 3] = float("-inf")
+        ref = TF.scaled_dot_product_attention(
+            torch.tensor(q), torch.tensor(k), torch.tensor(v),
+            attn_mask=mask).numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_class_center_sample_keeps_all_positives(self):
+        label = np.arange(8)
+        remapped, sampled = F.class_center_sample(t(label), 10, 4)
+        s = np.asarray(sampled._data)
+        assert len(s) == 8 and set(range(8)) == set(s.tolist())
+
+    def test_dynamic_decode_under_jit(self):
+        """The decode loop must trace cleanly (no tracer bool coercion)."""
+        import jax
+        import paddle_tpu
+        vocab = 4
+
+        class Cell:
+            def __call__(self, inp, states):
+                logits = paddle_tpu.ops.get_op("one_hot").fn(
+                    np.int32(2) * (0 * inp._data + 1), vocab) * 10.0
+                return paddle_tpu.Tensor(logits), states
+
+        dec = nn.BeamSearchDecoder(Cell(), start_token=0, end_token=1,
+                                   beam_size=2)
+
+        def run(z):
+            ids, lp = nn.dynamic_decode(
+                dec, inits=paddle.to_tensor(z), max_step_num=3)
+            return ids._data
+
+        out = jax.jit(run)(np.zeros(2, np.int32))
+        assert out.shape == (2, 2, 3)
