@@ -294,7 +294,8 @@ def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
                      output_size=None, data_format="NCHW", name=None):
     if output_size is not None:
         output_padding = _opad_from_output_size(
-            x, weight, stride, padding, dilation, output_size, 2)
+            x, weight, stride, padding, dilation, output_size, 2,
+            data_format)
     return _op("conv2d_transpose", x, weight, bias, stride=stride,
                padding=padding, output_padding=output_padding, groups=groups,
                dilation=dilation, output_size=output_size,
@@ -586,14 +587,15 @@ def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
                      output_size=None, data_format="NCL", name=None):
     if output_size is not None:
         output_padding = _opad_from_output_size(
-            x, weight, stride, padding, dilation, output_size, 1)
+            x, weight, stride, padding, dilation, output_size, 1,
+            data_format)
     return _op("conv1d_transpose", x, weight, bias, stride=stride,
                padding=padding, output_padding=output_padding, groups=groups,
                dilation=dilation, data_format=data_format)
 
 
 def _opad_from_output_size(x, weight, stride, padding, dilation,
-                           output_size, nd):
+                           output_size, nd, data_format="NC"):
     """output_size -> output_padding (reference: conv_transpose derives the
     extra high-side padding from the requested spatial size)."""
     def tup(v):
@@ -607,7 +609,8 @@ def _opad_from_output_size(x, weight, stride, padding, dilation,
     if isinstance(padding, int):
         pd = (padding,) * nd
     target = [int(v) for v in output_size][-nd:]
-    in_sp = x.shape[2:2 + nd]
+    in_sp = x.shape[2:2 + nd] if data_format.startswith("NC") \
+        else x.shape[1:1 + nd]
     ks = weight.shape[2:2 + nd]
     opad = []
     for d in range(nd):
@@ -626,7 +629,8 @@ def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
                      output_size=None, data_format="NCDHW", name=None):
     if output_size is not None:
         output_padding = _opad_from_output_size(
-            x, weight, stride, padding, dilation, output_size, 3)
+            x, weight, stride, padding, dilation, output_size, 3,
+            data_format)
     return _op("conv3d_transpose", x, weight, bias, stride=stride,
                padding=padding, output_padding=output_padding, groups=groups,
                dilation=dilation, data_format=data_format)
@@ -725,6 +729,8 @@ def affine_grid(theta, out_shape, align_corners=True, name=None):
 
 @_export
 def zeropad2d(x, padding, data_format="NCHW", name=None):
+    if isinstance(padding, int):
+        padding = [padding] * 4
     return pad(x, padding, mode="constant", value=0.0,
                data_format=data_format)
 
@@ -760,9 +766,14 @@ def _reduce(loss, reduction):
 def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
              reduction="mean", norm_by_times=False):
     """Reference semantics (nn/functional/loss.py ctc_loss over warpctc):
-    per-sample NLL; 'mean' divides by label length then averages."""
+    per-sample NLL; 'mean' divides by label length then averages;
+    norm_by_times divides each sample's loss by its input length first."""
     loss = _op("ctc_loss", log_probs, labels, input_lengths, label_lengths,
                blank=blank)
+    if norm_by_times:
+        il = _op("cast", input_lengths, dtype="float32")
+        loss = _op("divide", loss,
+                   _op("maximum", il, _op("full_like", il, fill_value=1.0)))
     if reduction == "mean":
         ll = _op("cast", label_lengths, dtype="float32")
         return _op("mean", _op("divide", loss,

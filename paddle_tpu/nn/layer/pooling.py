@@ -61,11 +61,13 @@ class MaxPool3D(_PoolNd):
                  name=None):
         super().__init__(kernel_size, stride, padding, ceil_mode)
         self.return_mask = return_mask
+        self.data_format = data_format
 
     def forward(self, x):
         return F.max_pool3d(x, self.kernel_size, self.stride, self.padding,
                             return_mask=self.return_mask,
-                            ceil_mode=self.ceil_mode)
+                            ceil_mode=self.ceil_mode,
+                            data_format=self.data_format)
 
 
 class AvgPool1D(_PoolNd):
@@ -162,24 +164,31 @@ class AdaptiveMaxPool3D(Layer):
 class _MaxUnPoolNd(Layer):
     _fn = None
 
+    _default_df = None
+
     def __init__(self, kernel_size, stride=None, padding=0,
                  data_format=None, output_size=None, name=None):
         super().__init__()
         self._k, self._s, self._p = kernel_size, stride, padding
         self._output_size = output_size
+        self._df = data_format if data_format is not None \
+            else type(self)._default_df
 
     def forward(self, x, indices):
         return type(self)._fn(x, indices, self._k, self._s, self._p,
-                              self._output_size)
+                              self._output_size, self._df)
 
 
 class MaxUnPool1D(_MaxUnPoolNd):
     _fn = staticmethod(F.max_unpool1d)
+    _default_df = "NCL"
 
 
 class MaxUnPool2D(_MaxUnPoolNd):
     _fn = staticmethod(F.max_unpool2d)
+    _default_df = "NCHW"
 
 
 class MaxUnPool3D(_MaxUnPoolNd):
     _fn = staticmethod(F.max_unpool3d)
+    _default_df = "NCDHW"

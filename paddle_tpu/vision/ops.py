@@ -1,0 +1,600 @@
+"""``paddle.vision.ops`` — detection ops.
+
+Analog of the reference's python/paddle/vision/ops.py (yolo_loss, yolo_box,
+deform_conv2d, psroi_pool, roi_pool, roi_align, nms) backed by
+paddle/phi/kernels/{yolo_box_kernel.h, deformable_conv_kernel.h,
+roi_align_kernel.h, roi_pool_kernel.h, psroi_pool_kernel.h} and
+paddle/fluid/operators/detection/. TPU-first shapes: RoI ops are dense
+gathers over static box counts; deformable conv is grid-sample + einsum
+(MXU contraction), not a per-pixel CUDA kernel.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.dispatch import call_op as _op
+from ..framework.tensor import Tensor
+from ..ops.registry import register_op
+from .. import nn
+
+__all__ = ["yolo_box", "yolo_loss", "deform_conv2d", "DeformConv2D",
+           "psroi_pool", "PSRoIPool", "roi_pool", "RoIPool", "roi_align",
+           "RoIAlign", "nms"]
+
+
+# ---------------------------------------------------------------------------
+# RoI ops
+# ---------------------------------------------------------------------------
+
+def _roi_bilinear(feat, ys, xs):
+    """feat: [C, H, W]; ys/xs arbitrary same-shaped float coords.
+    Bilinear sample with border clamp (reference roi_align semantics)."""
+    c, h, w = feat.shape
+    y0 = jnp.floor(ys)
+    x0 = jnp.floor(xs)
+    y1 = y0 + 1
+    x1 = x0 + 1
+    ly = ys - y0
+    lx = xs - x0
+    y0c = jnp.clip(y0, 0, h - 1).astype(jnp.int32)
+    y1c = jnp.clip(y1, 0, h - 1).astype(jnp.int32)
+    x0c = jnp.clip(x0, 0, w - 1).astype(jnp.int32)
+    x1c = jnp.clip(x1, 0, w - 1).astype(jnp.int32)
+    flat = feat.reshape(c, h * w)
+
+    def g(yy, xx):
+        lin = (yy * w + xx).reshape(-1)
+        return jnp.take(flat, lin, axis=1).reshape((c,) + ys.shape)
+
+    v = (g(y0c, x0c) * (1 - ly) * (1 - lx) + g(y0c, x1c) * (1 - ly) * lx
+         + g(y1c, x0c) * ly * (1 - lx) + g(y1c, x1c) * ly * lx)
+    # outside-image samples contribute 0 (reference: is_empty -> skip)
+    valid = (ys >= -1) & (ys <= feat.shape[1]) & (xs >= -1) \
+        & (xs <= feat.shape[2])
+    return jnp.where(valid[None], v, 0.0)
+
+
+@register_op("roi_align")
+def _roi_align(x, boxes, boxes_num, output_size=1, spatial_scale=1.0,
+               sampling_ratio=-1, aligned=True):
+    """vmap over RoIs: one batched gather graph regardless of box count.
+    sampling_ratio<=0 uses the static upper bound ceil(feature/output) per
+    axis (capped at 8) — XLA needs a static grid, and oversampling a small
+    RoI only densifies the average (the reference's per-RoI adaptive count
+    is a CPU-side perf choice, not a semantics change for large grids)."""
+    oh, ow = (output_size, output_size) if isinstance(output_size, int) \
+        else tuple(output_size)
+    counts = np.asarray(boxes_num)
+    img_of_roi = jnp.asarray(np.repeat(np.arange(len(counts)), counts))
+    assert img_of_roi.shape[0] == boxes.shape[0], \
+        "boxes_num must sum to len(boxes)"
+    if sampling_ratio > 0:
+        sry = srx = int(sampling_ratio)
+    else:
+        sry = min(8, max(1, -(-x.shape[2] // oh)))
+        srx = min(8, max(1, -(-x.shape[3] // ow)))
+    off = 0.5 if aligned else 0.0
+    xf = x.astype(jnp.float32)
+
+    def one_roi(box, feat):
+        b = box.astype(jnp.float32) * spatial_scale
+        x1, y1, x2, y2 = b[0] - off, b[1] - off, b[2] - off, b[3] - off
+        rw = x2 - x1
+        rh = y2 - y1
+        if not aligned:
+            rw = jnp.maximum(rw, 1.0)
+            rh = jnp.maximum(rh, 1.0)
+        bin_h = rh / oh
+        bin_w = rw / ow
+        iy = (jnp.arange(oh)[:, None, None, None] * bin_h + y1
+              + (jnp.arange(sry)[None, None, :, None] + 0.5) * bin_h / sry)
+        ix = (jnp.arange(ow)[None, :, None, None] * bin_w + x1
+              + (jnp.arange(srx)[None, None, None, :] + 0.5) * bin_w / srx)
+        ys = jnp.broadcast_to(iy, (oh, ow, sry, srx))
+        xs = jnp.broadcast_to(ix, (oh, ow, sry, srx))
+        return jnp.mean(_roi_bilinear(feat, ys, xs), axis=(-1, -2))
+
+    feats = jnp.take(xf, img_of_roi, axis=0)        # [R, C, H, W]
+    return jax.vmap(one_roi)(boxes, feats).astype(x.dtype)
+
+
+@register_op("roi_pool")
+def _roi_pool(x, boxes, boxes_num, output_size=1, spatial_scale=1.0):
+    oh, ow = (output_size, output_size) if isinstance(output_size, int) \
+        else tuple(output_size)
+    counts = np.asarray(boxes_num)
+    img_of_roi = jnp.asarray(np.repeat(np.arange(len(counts)), counts))
+    h, w = x.shape[2], x.shape[3]
+    xf = x.astype(jnp.float32)
+    iy = jnp.arange(h, dtype=jnp.float32)
+    ix = jnp.arange(w, dtype=jnp.float32)
+
+    def one_roi(box, feat):
+        b = jnp.round(box.astype(jnp.float32) * spatial_scale)
+        x1, y1, x2, y2 = b[0], b[1], b[2], b[3]
+        rh = jnp.maximum(y2 - y1 + 1, 1.0)
+        rw = jnp.maximum(x2 - x1 + 1, 1.0)
+        bin_h = rh / oh
+        bin_w = rw / ow
+        # mask-reduce per bin: static shapes, XLA-friendly
+        ystart = jnp.floor(jnp.arange(oh) * bin_h + y1)
+        yend = jnp.ceil((jnp.arange(oh) + 1) * bin_h + y1)
+        xstart = jnp.floor(jnp.arange(ow) * bin_w + x1)
+        xend = jnp.ceil((jnp.arange(ow) + 1) * bin_w + x1)
+        ymask = (iy[None, :] >= jnp.clip(ystart, 0, h)[:, None]) & \
+                (iy[None, :] < jnp.clip(yend, 0, h)[:, None])   # [oh, H]
+        xmask = (ix[None, :] >= jnp.clip(xstart, 0, w)[:, None]) & \
+                (ix[None, :] < jnp.clip(xend, 0, w)[:, None])   # [ow, W]
+        m = ymask[:, None, :, None] & xmask[None, :, None, :]   # [oh,ow,H,W]
+        masked = jnp.where(m[None], feat[:, None, None], -jnp.inf)
+        pooled = jnp.max(masked, axis=(-1, -2))
+        empty = ~jnp.any(m, axis=(-1, -2))
+        return jnp.where(empty[None], 0.0, pooled)
+
+    feats = jnp.take(xf, img_of_roi, axis=0)
+    return jax.vmap(one_roi)(boxes, feats).astype(x.dtype)
+
+
+@register_op("psroi_pool")
+def _psroi_pool(x, boxes, boxes_num, output_size=1, spatial_scale=1.0):
+    """Position-sensitive RoI average pool: channel dim must be
+    C = out_c * oh * ow; bin (i,j) reads channel slice [i*ow+j]."""
+    oh, ow = (output_size, output_size) if isinstance(output_size, int) \
+        else tuple(output_size)
+    c = x.shape[1]
+    out_c = c // (oh * ow)
+    counts = np.asarray(boxes_num)
+    img_of_roi = jnp.asarray(np.repeat(np.arange(len(counts)), counts))
+    h, w = x.shape[2], x.shape[3]
+    xf = x.astype(jnp.float32)
+    iy = jnp.arange(h, dtype=jnp.float32)
+    ix = jnp.arange(w, dtype=jnp.float32)
+
+    def one_roi(box, feat):
+        b = box.astype(jnp.float32) * spatial_scale
+        x1, y1, x2, y2 = b[0], b[1], b[2], b[3]
+        rh = jnp.maximum(y2 - y1, 0.1)
+        rw = jnp.maximum(x2 - x1, 0.1)
+        bin_h = rh / oh
+        bin_w = rw / ow
+        fps = feat.reshape(out_c, oh, ow, h, w)
+        ystart = jnp.floor(jnp.arange(oh) * bin_h + y1)
+        yend = jnp.ceil((jnp.arange(oh) + 1) * bin_h + y1)
+        xstart = jnp.floor(jnp.arange(ow) * bin_w + x1)
+        xend = jnp.ceil((jnp.arange(ow) + 1) * bin_w + x1)
+        ymask = (iy[None, :] >= jnp.clip(ystart, 0, h)[:, None]) & \
+                (iy[None, :] < jnp.clip(yend, 0, h)[:, None])
+        xmask = (ix[None, :] >= jnp.clip(xstart, 0, w)[:, None]) & \
+                (ix[None, :] < jnp.clip(xend, 0, w)[:, None])
+        m = ymask[:, None, :, None] & xmask[None, :, None, :]
+        s = jnp.sum(jnp.where(m[None], fps, 0.0), axis=(-1, -2))
+        cnt = jnp.maximum(jnp.sum(m, axis=(-1, -2)), 1)
+        return s / cnt[None]
+
+    feats = jnp.take(xf, img_of_roi, axis=0)
+    return jax.vmap(one_roi)(boxes, feats).astype(x.dtype)
+
+
+@register_op("nms", nondiff=True, jit=False)
+def _nms(boxes, scores=None, iou_threshold=0.3, top_k=None):
+    """Hard NMS on host (the result length is data-dependent; the reference
+    kernel is likewise a host-style sequential op). Returns kept indices
+    sorted by score."""
+    b = np.asarray(boxes, np.float32)
+    if scores is None:
+        order = np.arange(len(b))
+    else:
+        order = np.argsort(-np.asarray(scores, np.float32))
+    x1, y1, x2, y2 = b[:, 0], b[:, 1], b[:, 2], b[:, 3]
+    areas = np.maximum(x2 - x1, 0) * np.maximum(y2 - y1, 0)
+    keep = []
+    suppressed = np.zeros(len(b), bool)
+    for i in order:
+        if suppressed[i]:
+            continue
+        keep.append(i)
+        xx1 = np.maximum(x1[i], x1)
+        yy1 = np.maximum(y1[i], y1)
+        xx2 = np.minimum(x2[i], x2)
+        yy2 = np.minimum(y2[i], y2)
+        inter = np.maximum(xx2 - xx1, 0) * np.maximum(yy2 - yy1, 0)
+        iou = inter / np.maximum(areas[i] + areas - inter, 1e-10)
+        suppressed |= iou > iou_threshold
+    kept = np.asarray(keep, np.int64)
+    if top_k is not None:
+        kept = kept[:int(top_k)]
+    return jnp.asarray(kept)
+
+
+# ---------------------------------------------------------------------------
+# deformable convolution (v1: no mask; v2: modulated)
+# ---------------------------------------------------------------------------
+
+@register_op("deform_conv2d")
+def _deform_conv2d(x, offset, weight, mask=None, bias=None, stride=1,
+                   padding=0, dilation=1, deformable_groups=1, groups=1):
+    """Grid-sample formulation: for each kernel tap, sample the input at the
+    (offset-shifted) tap position, then contract taps×in-channels against the
+    kernel with one einsum — the whole op is gathers + one MXU matmul."""
+    st = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    pd = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    dl = (dilation, dilation) if isinstance(dilation, int) else tuple(dilation)
+    n, cin, h, w = x.shape
+    cout, cin_g, kh, kw = weight.shape
+    oh = (h + 2 * pd[0] - dl[0] * (kh - 1) - 1) // st[0] + 1
+    ow = (w + 2 * pd[1] - dl[1] * (kw - 1) - 1) // st[1] + 1
+    xf = x.astype(jnp.float32)
+    offs = offset.astype(jnp.float32).reshape(
+        n, deformable_groups, kh * kw, 2, oh, ow)
+    base_y = (jnp.arange(oh) * st[0] - pd[0])[:, None] \
+        + (jnp.arange(kh) * dl[0])[None, :]            # [oh, kh]
+    base_x = (jnp.arange(ow) * st[1] - pd[1])[:, None] \
+        + (jnp.arange(kw) * dl[1])[None, :]            # [ow, kw]
+    # sample positions per (tap, out_y, out_x)
+    ys = (base_y.T[:, None, :, None]
+          + jnp.zeros((kw, 1, ow))[None]).reshape(kh * kw, oh, ow)
+    xs = (base_x.T[None, :, None, :]
+          + jnp.zeros((kh, 1, oh, 1))).reshape(kh * kw, oh, ow)
+    cin_per_dg = cin // deformable_groups
+
+    def _bilinear_zero(feat, pys, pxs):
+        """Bilinear with zero outside the image (deformable-conv semantics:
+        taps falling into the padding read 0, unlike roi_align's clamp)."""
+        c, fh, fw = feat.shape
+        y0 = jnp.floor(pys)
+        x0 = jnp.floor(pxs)
+        flat = feat.reshape(c, fh * fw)
+
+        def corner(yy, xx):
+            inb = (yy >= 0) & (yy < fh) & (xx >= 0) & (xx < fw)
+            yc = jnp.clip(yy, 0, fh - 1).astype(jnp.int32)
+            xc = jnp.clip(xx, 0, fw - 1).astype(jnp.int32)
+            lin = (yc * fw + xc).reshape(-1)
+            v = jnp.take(flat, lin, axis=1).reshape((c,) + pys.shape)
+            return jnp.where(inb[None], v, 0.0)
+
+        ly = pys - y0
+        lx = pxs - x0
+        return (corner(y0, x0) * (1 - ly) * (1 - lx)
+                + corner(y0, x0 + 1) * (1 - ly) * lx
+                + corner(y0 + 1, x0) * ly * (1 - lx)
+                + corner(y0 + 1, x0 + 1) * ly * lx)
+
+    def sample_image(img, off_img, mask_img):
+        # img [C,H,W]; off_img [DG, K, 2, oh, ow]
+        vals = []
+        for dg in range(deformable_groups):
+            py = ys[None] + off_img[dg, :, 0]          # [K, oh, ow]
+            px = xs[None] + off_img[dg, :, 1]
+            sub = img[dg * cin_per_dg:(dg + 1) * cin_per_dg]
+            v = _bilinear_zero(sub, py, px)            # [C/dg, K, oh, ow]
+            if mask_img is not None:
+                v = v * mask_img[dg][None]
+            vals.append(v)
+        return jnp.concatenate(vals, axis=0)           # [C, K, oh, ow]
+
+    if mask is not None:
+        masks = mask.astype(jnp.float32).reshape(
+            n, deformable_groups, kh * kw, oh, ow)
+        sampled = jax.vmap(sample_image)(xf, offs, masks)
+    else:
+        sampled = jax.vmap(
+            lambda im, of: sample_image(im, of, None))(xf, offs)
+    wf = weight.astype(jnp.float32).reshape(groups, cout // groups, cin_g,
+                                            kh * kw)
+    sg = sampled.reshape(n, groups, cin // groups, kh * kw, oh, ow)
+    out = jnp.einsum("gock,ngckyx->ngoyx", wf, sg).reshape(n, cout, oh, ow)
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out.astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# YOLO
+# ---------------------------------------------------------------------------
+
+@register_op("yolo_box")
+def _yolo_box(x, img_size, anchors=(), class_num=1, conf_thresh=0.01,
+              downsample_ratio=32, clip_bbox=True, scale_x_y=1.0,
+              iou_aware=False, iou_aware_factor=0.5):
+    """Decode YOLOv3 head output [N, A*(5+cls), H, W] to boxes + scores
+    (reference: detection/yolo_box_op.cc)."""
+    anchors = list(anchors)
+    na = len(anchors) // 2
+    n, _, h, w = x.shape
+    xf = x.astype(jnp.float32).reshape(n, na, 5 + class_num, h, w)
+    grid_x = jnp.arange(w, dtype=jnp.float32)[None, None, None, :]
+    grid_y = jnp.arange(h, dtype=jnp.float32)[None, None, :, None]
+    aw = jnp.asarray(anchors[0::2], jnp.float32)[None, :, None, None]
+    ah = jnp.asarray(anchors[1::2], jnp.float32)[None, :, None, None]
+    input_h = downsample_ratio * h
+    input_w = downsample_ratio * w
+    bx = (jax.nn.sigmoid(xf[:, :, 0]) * scale_x_y
+          - (scale_x_y - 1) / 2 + grid_x) / w
+    by = (jax.nn.sigmoid(xf[:, :, 1]) * scale_x_y
+          - (scale_x_y - 1) / 2 + grid_y) / h
+    bw = jnp.exp(xf[:, :, 2]) * aw / input_w
+    bh = jnp.exp(xf[:, :, 3]) * ah / input_h
+    conf = jax.nn.sigmoid(xf[:, :, 4])
+    probs = jax.nn.sigmoid(xf[:, :, 5:]) * conf[:, :, None]
+    img_h = img_size.astype(jnp.float32)[:, 0][:, None, None, None]
+    img_w = img_size.astype(jnp.float32)[:, 1][:, None, None, None]
+    x1 = (bx - bw / 2) * img_w
+    y1 = (by - bh / 2) * img_h
+    x2 = (bx + bw / 2) * img_w
+    y2 = (by + bh / 2) * img_h
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0)
+        y1 = jnp.clip(y1, 0)
+        x2 = jnp.minimum(x2, img_w - 1)
+        y2 = jnp.minimum(y2, img_h - 1)
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1).reshape(n, -1, 4)
+    keep = (conf > conf_thresh)[..., None]
+    scores = jnp.where(keep, probs.transpose(0, 1, 3, 4, 2),
+                       0.0).reshape(n, -1, class_num)
+    return boxes, scores
+
+
+@register_op("yolo_loss")
+def _yolo_loss(x, gt_box, gt_label, gt_score=None, anchors=(),
+               anchor_mask=(), class_num=1, ignore_thresh=0.7,
+               downsample_ratio=32, use_label_smooth=True, scale_x_y=1.0):
+    """YOLOv3 loss (reference: detection/yolov3_loss_op.cc): coordinate BCE/
+    L1 terms on responsible anchors + objectness BCE with ignore region +
+    class BCE. gt_box is [N, B, 4] in (cx, cy, w, h) normalized-to-image."""
+    anchors = list(anchors)
+    anchor_mask = list(anchor_mask)
+    n, _, h, w = x.shape
+    na = len(anchor_mask)
+    xf = x.astype(jnp.float32).reshape(n, na, 5 + class_num, h, w)
+    input_size = downsample_ratio * h
+    gt = gt_box.astype(jnp.float32)
+    nb = gt.shape[1]
+    # responsible anchor per gt: best iou among ALL anchors at origin
+    all_aw = jnp.asarray(anchors[0::2], jnp.float32) / input_size
+    all_ah = jnp.asarray(anchors[1::2], jnp.float32) / input_size
+    gw = gt[..., 2][..., None]
+    gh = gt[..., 3][..., None]
+    inter = jnp.minimum(gw, all_aw) * jnp.minimum(gh, all_ah)
+    iou_a = inter / (gw * all_ah * 0 + gw * gh + all_aw * all_ah - inter
+                     + 1e-10)
+    best_a = jnp.argmax(iou_a, axis=-1)                 # [N, B]
+    gi = jnp.clip((gt[..., 0] * w).astype(jnp.int32), 0, w - 1)
+    gj = jnp.clip((gt[..., 1] * h).astype(jnp.int32), 0, h - 1)
+    valid = (gt[..., 2] > 0) & (gt[..., 3] > 0)         # [N, B]
+
+    px = jax.nn.sigmoid(xf[:, :, 0]) * scale_x_y - (scale_x_y - 1) / 2
+    py = jax.nn.sigmoid(xf[:, :, 1]) * scale_x_y - (scale_x_y - 1) / 2
+    pw = xf[:, :, 2]
+    ph = xf[:, :, 3]
+    pobj = xf[:, :, 4]
+    pcls = xf[:, :, 5:]
+
+    # objectness target / ignore mask via decoded-pred vs gt iou
+    mask_aw = jnp.asarray([anchors[2 * m] for m in anchor_mask],
+                          jnp.float32)[None, :, None, None]
+    mask_ah = jnp.asarray([anchors[2 * m + 1] for m in anchor_mask],
+                          jnp.float32)[None, :, None, None]
+    bx = (px + jnp.arange(w, dtype=jnp.float32)[None, None, None, :]) / w
+    by = (py + jnp.arange(h, dtype=jnp.float32)[None, None, :, None]) / h
+    bw = jnp.exp(pw) * mask_aw / input_size
+    bh = jnp.exp(ph) * mask_ah / input_size
+    # iou of every predicted box with every gt box
+    px1 = bx - bw / 2
+    py1 = by - bh / 2
+    px2 = bx + bw / 2
+    py2 = by + bh / 2
+    gx1 = (gt[..., 0] - gt[..., 2] / 2)[:, :, None, None, None]
+    gy1 = (gt[..., 1] - gt[..., 3] / 2)[:, :, None, None, None]
+    gx2 = (gt[..., 0] + gt[..., 2] / 2)[:, :, None, None, None]
+    gy2 = (gt[..., 1] + gt[..., 3] / 2)[:, :, None, None, None]
+    ix1 = jnp.maximum(px1[:, None], gx1)
+    iy1 = jnp.maximum(py1[:, None], gy1)
+    ix2 = jnp.minimum(px2[:, None], gx2)
+    iy2 = jnp.minimum(py2[:, None], gy2)
+    inter_p = jnp.maximum(ix2 - ix1, 0) * jnp.maximum(iy2 - iy1, 0)
+    area_p = (px2 - px1) * (py2 - py1)
+    area_g = ((gx2 - gx1) * (gy2 - gy1))
+    iou_p = inter_p / (area_p[:, None] + area_g - inter_p + 1e-10)
+    iou_p = jnp.where(valid[:, :, None, None, None], iou_p, 0.0)
+    best_iou = jnp.max(iou_p, axis=1)                   # [N, A, H, W]
+    ignore = best_iou > ignore_thresh
+
+    # scatter positive targets
+    obj_t = jnp.zeros((n, na, h, w))
+    tx = jnp.zeros((n, na, h, w))
+    ty = jnp.zeros((n, na, h, w))
+    tw = jnp.zeros((n, na, h, w))
+    th = jnp.zeros((n, na, h, w))
+    tscale = jnp.zeros((n, na, h, w))
+    cls_t = jnp.zeros((n, na, class_num, h, w))
+    batch_idx = jnp.arange(n)[:, None] * jnp.ones((1, nb), jnp.int32)
+    # only gts whose best anchor is in this layer's mask
+    am = jnp.asarray(anchor_mask)
+    in_layer = jnp.any(best_a[..., None] == am[None, None], axis=-1) & valid
+    a_local = jnp.argmax(
+        best_a[..., None] == am[None, None], axis=-1)   # [N, B]
+    sel_aw = jnp.take(all_aw, best_a)
+    sel_ah = jnp.take(all_ah, best_a)
+    score = jnp.ones((n, nb)) if gt_score is None else \
+        gt_score.astype(jnp.float32)
+    wgt = jnp.where(in_layer, score, 0.0)
+    bi = batch_idx.reshape(-1)
+    ai = a_local.reshape(-1)
+    ji = gj.reshape(-1)
+    ii = gi.reshape(-1)
+    obj_t = obj_t.at[bi, ai, ji, ii].max(wgt.reshape(-1))
+    tx = tx.at[bi, ai, ji, ii].set(
+        jnp.where(in_layer, gt[..., 0] * w - gi, 0.0).reshape(-1))
+    ty = ty.at[bi, ai, ji, ii].set(
+        jnp.where(in_layer, gt[..., 1] * h - gj, 0.0).reshape(-1))
+    tw = tw.at[bi, ai, ji, ii].set(jnp.where(
+        in_layer, jnp.log(jnp.maximum(gt[..., 2] / sel_aw, 1e-9)),
+        0.0).reshape(-1))
+    th = th.at[bi, ai, ji, ii].set(jnp.where(
+        in_layer, jnp.log(jnp.maximum(gt[..., 3] / sel_ah, 1e-9)),
+        0.0).reshape(-1))
+    tscale = tscale.at[bi, ai, ji, ii].set(jnp.where(
+        in_layer, 2.0 - gt[..., 2] * gt[..., 3], 0.0).reshape(-1))
+    smooth = 1.0 / class_num if use_label_smooth and class_num > 1 else 0.0
+    lab = gt_label.astype(jnp.int32)
+    cls_onehot = jax.nn.one_hot(lab, class_num)
+    cls_val = cls_onehot * (1.0 - 2 * smooth) + smooth
+    cls_t = cls_t.at[bi, ai, :, ji, ii].max(
+        (cls_val * jnp.where(in_layer, 1.0, 0.0)[..., None]).reshape(
+            -1, class_num))
+
+    def bce(logit, target):
+        return jnp.maximum(logit, 0) - logit * target \
+            + jnp.log1p(jnp.exp(-jnp.abs(logit)))
+
+    pos = obj_t > 0
+    loss_xy = jnp.sum(jnp.where(
+        pos, tscale * obj_t * (bce(xf[:, :, 0], tx) + bce(xf[:, :, 1], ty)),
+        0.0), axis=(1, 2, 3))
+    loss_wh = jnp.sum(jnp.where(
+        pos, tscale * obj_t * (jnp.abs(pw - tw) + jnp.abs(ph - th)), 0.0),
+        axis=(1, 2, 3))
+    obj_loss = bce(pobj, jnp.where(pos, 1.0, 0.0))
+    loss_obj = jnp.sum(jnp.where(
+        pos, obj_t * obj_loss, jnp.where(ignore, 0.0, obj_loss)),
+        axis=(1, 2, 3))
+    loss_cls = jnp.sum(jnp.where(
+        pos[:, :, None], obj_t[:, :, None] * bce(pcls, cls_t), 0.0),
+        axis=(1, 2, 3, 4))
+    return (loss_xy + loss_wh + loss_obj + loss_cls).astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# functional wrappers + layers
+# ---------------------------------------------------------------------------
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    bn = boxes_num.numpy() if isinstance(boxes_num, Tensor) else boxes_num
+    return _op("roi_align", x, boxes, output_size=output_size,
+               spatial_scale=spatial_scale, sampling_ratio=sampling_ratio,
+               aligned=aligned, boxes_num=tuple(int(v) for v in bn))
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    bn = boxes_num.numpy() if isinstance(boxes_num, Tensor) else boxes_num
+    return _op("roi_pool", x, boxes, output_size=output_size,
+               spatial_scale=spatial_scale,
+               boxes_num=tuple(int(v) for v in bn))
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    bn = boxes_num.numpy() if isinstance(boxes_num, Tensor) else boxes_num
+    return _op("psroi_pool", x, boxes, output_size=output_size,
+               spatial_scale=spatial_scale,
+               boxes_num=tuple(int(v) for v in bn))
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    if category_idxs is None:
+        return _op("nms", boxes, scores, iou_threshold=iou_threshold,
+                   top_k=top_k)
+    # categorical NMS: run per category on score-offset boxes (reference
+    # python fallback semantics)
+    import numpy as _np
+    b = np.asarray(boxes._data if isinstance(boxes, Tensor) else boxes)
+    s = np.asarray(scores._data if isinstance(scores, Tensor) else scores)
+    cat = np.asarray(category_idxs._data
+                     if isinstance(category_idxs, Tensor) else category_idxs)
+    keep_all = []
+    for c in categories:
+        idx = _np.where(cat == c)[0]
+        if len(idx) == 0:
+            continue
+        kept = np.asarray(_op("nms", Tensor(jnp.asarray(b[idx])),
+                              Tensor(jnp.asarray(s[idx])),
+                              iou_threshold=iou_threshold)._data)
+        keep_all.extend(idx[kept].tolist())
+    keep_all = _np.asarray(keep_all, _np.int64)
+    order = _np.argsort(-s[keep_all], kind="stable")
+    kept = keep_all[order]
+    if top_k is not None:
+        kept = kept[:int(top_k)]
+    return Tensor(jnp.asarray(kept))
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    return _op("deform_conv2d", x, offset, weight, mask, bias,
+               stride=stride, padding=padding, dilation=dilation,
+               deformable_groups=deformable_groups, groups=groups)
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
+             downsample_ratio=32, clip_bbox=True, name=None, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5):
+    return _op("yolo_box", x, img_size, anchors=tuple(anchors),
+               class_num=class_num, conf_thresh=conf_thresh,
+               downsample_ratio=downsample_ratio, clip_bbox=clip_bbox,
+               scale_x_y=scale_x_y)
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    return _op("yolo_loss", x, gt_box, gt_label, gt_score,
+               anchors=tuple(anchors), anchor_mask=tuple(anchor_mask),
+               class_num=class_num, ignore_thresh=ignore_thresh,
+               downsample_ratio=downsample_ratio,
+               use_label_smooth=use_label_smooth, scale_x_y=scale_x_y)
+
+
+class RoIAlign(nn.Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._o, self._s = output_size, spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_align(x, boxes, boxes_num, self._o, self._s)
+
+
+class RoIPool(nn.Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._o, self._s = output_size, spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self._o, self._s)
+
+
+class PSRoIPool(nn.Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._o, self._s = output_size, spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self._o, self._s)
+
+
+class DeformConv2D(nn.Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        ks = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        self._attrs = dict(stride=stride, padding=padding, dilation=dilation,
+                           deformable_groups=deformable_groups,
+                           groups=groups)
+        from ..nn.initializer import XavierUniform
+        self.weight = self.create_parameter(
+            [out_channels, in_channels // groups, *ks], attr=weight_attr,
+            default_initializer=XavierUniform())
+        self.bias = self.create_parameter([out_channels], attr=bias_attr,
+                                          is_bias=True)
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, self.bias,
+                             mask=mask, **self._attrs)
