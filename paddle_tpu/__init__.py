@@ -40,7 +40,8 @@ for _m in ("autograd", "optimizer", "amp", "io", "metric", "static", "jit",
            "vision", "distributed", "hapi", "parallel", "profiler",
            "incubate", "models", "utils", "inference", "distribution",
            "sparse", "text", "device", "quantization", "linalg", "fft",
-           "signal"):
+           "signal", "regularizer", "sysconfig", "compat", "hub", "reader",
+           "dataset", "onnx", "callbacks", "cost_model"):
     _mod = _import_if_built(_m)
     if _mod is not None:
         globals()[_m] = _mod
@@ -55,3 +56,5 @@ if globals().get("parallel") is not None:
     from .parallel.api import DataParallel  # noqa: F401
 if _ilu.find_spec(f"{__name__}.framework.io") is not None:
     from .framework.io import load, save  # noqa: F401
+if _ilu.find_spec(f"{__name__}.batch") is not None:
+    from .batch import batch  # noqa: F401

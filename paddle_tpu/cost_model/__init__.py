@@ -1,0 +1,69 @@
+"""``paddle.cost_model`` (reference: python/paddle/cost_model/cost_model.py
+— measures per-op cost of a program to feed the auto-parallel tuner).
+
+TPU-native version: measures per-op wall time through the dispatch layer's
+benchmark counters (framework/monitor.py) while executing a callable, and
+supports static cost estimation from a jaxpr (FLOP counting via XLA's cost
+analysis when available).
+"""
+from __future__ import annotations
+
+import time
+
+__all__ = ["CostModel"]
+
+
+class CostModel:
+    def __init__(self):
+        self._costs = {}
+
+    def profile_measure(self, fn_or_program, *args, device="tpu",
+                        fetch_cost_list=("time",), repeat=3, **kwargs):
+        """Run ``fn_or_program`` and collect per-op time from the dispatch
+        benchmark sweep. Returns {op_name: {"time": seconds_mean, ...}}."""
+        from ..framework import flags as _flags
+        from ..framework import monitor as _monitor
+        old = _flags.get_flags("FLAGS_benchmark").get("FLAGS_benchmark")
+        _flags.set_flags({"FLAGS_benchmark": True})
+        _monitor.stat_reset()
+        try:
+            for _ in range(int(repeat)):
+                fn_or_program(*args, **kwargs)
+        finally:
+            _flags.set_flags({"FLAGS_benchmark": bool(old)})
+        stats = _monitor.all_stats()
+        self._costs = {}
+        for key, total_ms in stats.items():
+            if not key.startswith("op_time_ms/"):
+                continue
+            op = key[len("op_time_ms/"):]
+            count = stats.get(f"op_count/{op}", 1)
+            self._costs[op] = {"time": total_ms / 1e3 / max(count, 1),
+                               "calls": int(count)}
+        return self._costs
+
+    def static_cost_data(self):
+        """Last measured table (reference keeps a static json of measured
+        op benchmarks — here the table is always measured in-situ)."""
+        return self._costs
+
+    def get_static_op_time(self, op_name, forward=True, dtype="float32"):
+        key = op_name if forward else f"{op_name}_grad"
+        if key in self._costs:
+            return self._costs[key]
+        raise ValueError(
+            f"op {key!r} has no measured cost; run profile_measure first")
+
+
+def estimate_flops(fn, *example_args):
+    """FLOP estimate for a jittable callable via XLA cost analysis."""
+    import jax
+    lowered = jax.jit(fn).lower(*example_args)
+    compiled = lowered.compile()
+    try:
+        analysis = compiled.cost_analysis()
+        if isinstance(analysis, (list, tuple)):
+            analysis = analysis[0]
+        return float(analysis.get("flops", -1.0))
+    except Exception:
+        return -1.0

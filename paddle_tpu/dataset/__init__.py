@@ -1,0 +1,19 @@
+"""``paddle.dataset`` — legacy reader-style dataset namespace.
+
+Analog of the reference's python/paddle/dataset/ (mnist, cifar, imdb,
+uci_housing, …): each module exposes ``train()``/``test()`` reader creators
+yielding samples. This environment has no network egress, so loaders read
+from ``common.DATA_HOME`` (or explicit paths) and raise a clear error when
+the files are absent — same behavior as the reference on a download failure.
+"""
+from . import common  # noqa: F401
+from . import mnist  # noqa: F401
+from . import cifar  # noqa: F401
+from . import uci_housing  # noqa: F401
+from . import imdb  # noqa: F401
+from . import imikolov  # noqa: F401
+from . import movielens  # noqa: F401
+from . import flowers  # noqa: F401
+
+__all__ = ["common", "mnist", "cifar", "uci_housing", "imdb", "imikolov",
+           "movielens", "flowers"]

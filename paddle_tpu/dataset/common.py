@@ -1,0 +1,50 @@
+"""Shared dataset plumbing (reference: python/paddle/dataset/common.py)."""
+from __future__ import annotations
+
+import hashlib
+import os
+
+__all__ = ["DATA_HOME", "md5file", "download", "cluster_files_reader"]
+
+DATA_HOME = os.path.expanduser(
+    os.environ.get("PADDLE_DATA_HOME", "~/.cache/paddle/dataset"))
+
+
+def md5file(fname):
+    h = hashlib.md5()
+    with open(fname, "rb") as f:
+        for chunk in iter(lambda: f.read(4096), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def download(url, module_name, md5sum, save_name=None):
+    """No network egress in this environment: resolve against DATA_HOME and
+    fail loudly with placement instructions instead of fetching."""
+    dirname = os.path.join(DATA_HOME, module_name)
+    filename = os.path.join(
+        dirname, save_name if save_name else url.split("/")[-1])
+    if os.path.exists(filename):
+        if not md5sum or md5file(filename) == md5sum:
+            return filename
+        raise RuntimeError(f"{filename} exists but fails md5 check")
+    raise RuntimeError(
+        f"cannot download {url} (no network egress); place the file at "
+        f"{filename}")
+
+
+def cluster_files_reader(files_pattern, trainer_count, trainer_id,
+                         loader=None):
+    import glob
+
+    def reader():
+        flist = sorted(glob.glob(files_pattern))
+        my = flist[trainer_id::trainer_count]
+        for fn in my:
+            if loader is None:
+                with open(fn, "rb") as f:
+                    yield f.read()
+            else:
+                yield from loader(fn)
+
+    return reader

@@ -1,0 +1,119 @@
+"""``paddle.incubate.optimizer`` — LookAhead and ModelAverage.
+
+Analog of the reference's python/paddle/incubate/optimizer/{lookahead.py,
+modelaverage.py}: wrappers around an inner optimizer that keep auxiliary
+parameter copies (slow weights / running averages) as device-resident
+arrays.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...framework.tensor import Tensor
+from ...optimizer.optimizer import Optimizer
+
+__all__ = ["LookAhead", "ModelAverage"]
+
+
+class LookAhead(Optimizer):
+    """k-step lookahead (reference: incubate/optimizer/lookahead.py):
+    every k inner steps, slow <- slow + alpha*(fast - slow); fast <- slow."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        if inner_optimizer is None:
+            raise ValueError("inner optimizer cannot be None")
+        params = inner_optimizer._parameter_list
+        super().__init__(learning_rate=inner_optimizer._lr,
+                         parameters=params)
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        # snapshot slow weights at construction: the first k-boundary sync
+        # interpolates init -> fast_k (lazy init would make it a no-op)
+        self._slow = {p.name: p._data for p in params or []}
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._step_count += 1
+        if self._step_count % self.k != 0:
+            return
+        for p in self.inner_optimizer._parameter_list or []:
+            name = p.name
+            if name not in self._slow:
+                self._slow[name] = p._data
+            slow = self._slow[name] + self.alpha * (p._data
+                                                    - self._slow[name])
+            self._slow[name] = slow
+            p._data = slow
+
+    def clear_grad(self, set_to_zero=False):
+        self.inner_optimizer.clear_grad(set_to_zero)
+
+    def state_dict(self):
+        out = self.inner_optimizer.state_dict()
+        out["@lookahead_step"] = self._step_count
+        for name, arr in self._slow.items():
+            out[f"{name}_slow"] = Tensor(arr)
+        return out
+
+    def set_state_dict(self, state):
+        self._step_count = int(state.pop("@lookahead_step", 0))
+        slow_keys = [k for k in state if k.endswith("_slow")]
+        for k in slow_keys:
+            v = state.pop(k)
+            self._slow[k[:-5]] = v._data if isinstance(v, Tensor) \
+                else jnp.asarray(v)
+        self.inner_optimizer.set_state_dict(state)
+
+
+class ModelAverage(Optimizer):
+    """Running parameter average (reference:
+    incubate/optimizer/modelaverage.py): accumulates sum(param) per step;
+    ``apply()`` swaps in the average, ``restore()`` swaps back."""
+
+    def __init__(self, average_window_rate, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        super().__init__(learning_rate=0.0, parameters=parameters)
+        self.avg_rate = float(average_window_rate)
+        self.min_avg = int(min_average_window)
+        self.max_avg = int(max_average_window)
+        self._sum = {}
+        self._num = {}
+        self._backup = None
+
+    def step(self):
+        for p in self._parameter_list or []:
+            name = p.name
+            if name not in self._sum:
+                self._sum[name] = jnp.zeros_like(p._data)
+                self._num[name] = 0
+            self._sum[name] = self._sum[name] + p._data
+            self._num[name] += 1
+            window = max(self.min_avg,
+                         min(self.max_avg,
+                             int(self._num[name] * self.avg_rate)))
+            if self._num[name] > window:
+                # decay old contribution: keep a moving window by rescale
+                self._sum[name] = self._sum[name] * (
+                    window / self._num[name])
+                self._num[name] = window
+        self._step_count += 1
+
+    def apply(self, executor=None, need_restore=True):
+        self._backup = {p.name: p._data
+                        for p in self._parameter_list or []}
+        for p in self._parameter_list or []:
+            n = self._num.get(p.name, 0)
+            if n > 0:
+                p._data = self._sum[p.name] / n
+        if not need_restore:
+            self._backup = None
+
+    def restore(self, executor=None):
+        if self._backup is None:
+            return
+        for p in self._parameter_list or []:
+            if p.name in self._backup:
+                p._data = self._backup[p.name]
+        self._backup = None

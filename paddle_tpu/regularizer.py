@@ -1,0 +1,10 @@
+"""``paddle.regularizer`` — weight-decay regularizers.
+
+Analog of the reference's python/paddle/regularizer.py (L1Decay/L2Decay).
+The classes live in optimizer/optimizer.py because the TPU-native optimizer
+applies decay inside the fused jitted update; this module is the canonical
+public re-export.
+"""
+from .optimizer.optimizer import L1Decay, L2Decay  # noqa: F401
+
+__all__ = ["L1Decay", "L2Decay"]

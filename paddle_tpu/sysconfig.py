@@ -1,0 +1,18 @@
+"""``paddle.sysconfig`` (reference: python/paddle/sysconfig.py) —
+include/lib dirs for building C++ extensions against the framework."""
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+_ROOT = os.path.dirname(os.path.abspath(__file__))
+
+
+def get_include():
+    """Directory holding the C headers consumed by cpp_extension builds."""
+    return os.path.join(_ROOT, "include")
+
+
+def get_lib():
+    """Directory holding the framework's native shared objects (built on
+    demand by utils.cpp_extension)."""
+    return os.path.join(_ROOT, "lib")

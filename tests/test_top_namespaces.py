@@ -1,0 +1,265 @@
+"""Tests for the top-level namespace parity batch: regularizer, batch,
+reader, compat, hub, sysconfig, dataset, cost_model, callbacks, onnx,
+incubate.optimizer."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+class TestRegularizer:
+    def test_l2_decay_changes_update(self):
+        import paddle_tpu.nn as nn
+        import paddle_tpu.nn.functional as F
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(4, 3).astype(np.float32))
+        y = paddle.to_tensor(rng.randn(4, 2).astype(np.float32))
+        results = []
+        for wd in (None, paddle.regularizer.L2Decay(0.5)):
+            paddle.seed(7)
+            lin = nn.Linear(3, 2)
+            opt = paddle.optimizer.Momentum(
+                learning_rate=0.1, parameters=lin.parameters(),
+                weight_decay=wd)
+            loss = F.mse_loss(lin(x), y)
+            loss.backward()
+            opt.step()
+            results.append(np.asarray(lin.weight._data).copy())
+        assert not np.allclose(results[0], results[1])
+
+    def test_l1_decay_importable_top_level(self):
+        assert paddle.regularizer.L1Decay(0.1).coeff == 0.1
+
+
+class TestBatchReader:
+    def test_batch(self):
+        def reader():
+            yield from range(10)
+
+        batches = list(paddle.batch(reader, 3)())
+        assert batches == [[0, 1, 2], [3, 4, 5], [6, 7, 8], [9]]
+        batches = list(paddle.batch(reader, 3, drop_last=True)())
+        assert batches == [[0, 1, 2], [3, 4, 5], [6, 7, 8]]
+
+    def test_reader_decorators(self):
+        r = paddle.reader
+
+        def nums():
+            yield from range(6)
+
+        assert list(r.firstn(nums, 3)()) == [0, 1, 2]
+        assert list(r.map_readers(lambda a: a * 2, nums)()) == \
+            [0, 2, 4, 6, 8, 10]
+        assert sorted(r.shuffle(nums, 4)()) == list(range(6))
+        assert list(r.chain(nums, nums)()) == list(range(6)) * 2
+        assert list(r.buffered(nums, 2)()) == list(range(6))
+        assert list(r.cache(nums)()) == list(range(6))
+        out = list(r.xmap_readers(lambda v: v + 1, nums, 2, 4, order=True)())
+        assert out == [1, 2, 3, 4, 5, 6]
+        comp = list(r.compose(nums, nums)())
+        assert comp[0] == (0, 0)
+
+
+class TestCompat:
+    def test_text_bytes_roundtrip(self):
+        c = paddle.compat
+        assert c.to_text(b"abc") == "abc"
+        assert c.to_bytes("abc") == b"abc"
+        assert c.to_text([b"a", b"b"]) == ["a", "b"]
+        assert c.round(2.5) == 3.0
+        assert c.round(-2.5) == -3.0
+        assert c.floor_division(7, 2) == 3
+
+
+class TestHub:
+    def test_local_hubconf(self, tmp_path):
+        (tmp_path / "hubconf.py").write_text(
+            "def tiny_model(scale=1):\n"
+            "    'docstring here'\n"
+            "    return {'scale': scale}\n")
+        names = paddle.hub.list(str(tmp_path), source="local")
+        assert "tiny_model" in names
+        assert "docstring" in paddle.hub.help(str(tmp_path), "tiny_model",
+                                              source="local")
+        m = paddle.hub.load(str(tmp_path), "tiny_model", source="local",
+                            scale=3)
+        assert m == {"scale": 3}
+
+    def test_github_source_raises(self):
+        with pytest.raises(RuntimeError):
+            paddle.hub.list("some/repo", source="github")
+
+
+class TestSysconfig:
+    def test_paths_inside_package(self):
+        inc = paddle.sysconfig.get_include()
+        lib = paddle.sysconfig.get_lib()
+        pkg = os.path.dirname(paddle.__file__)
+        assert inc.startswith(pkg) and lib.startswith(pkg)
+
+
+class TestDataset:
+    def test_modules_present(self):
+        for m in ("mnist", "cifar", "uci_housing", "imdb", "imikolov",
+                  "movielens", "flowers", "common"):
+            assert hasattr(paddle.dataset, m)
+
+    def test_uci_housing_with_local_file(self, tmp_path, monkeypatch):
+        rng = np.random.RandomState(0)
+        data = np.abs(rng.randn(50, 14))
+        path = tmp_path / "uci_housing"
+        path.mkdir()
+        np.savetxt(path / "housing.data", data)
+        monkeypatch.setattr(paddle.dataset.common, "DATA_HOME",
+                            str(tmp_path))
+        samples = list(paddle.dataset.uci_housing.train()())
+        assert len(samples) == 40
+        feat, lab = samples[0]
+        assert feat.shape == (13,) and lab.shape == (1,)
+
+    def test_missing_file_raises_with_path(self, tmp_path, monkeypatch):
+        monkeypatch.setattr(paddle.dataset.common, "DATA_HOME",
+                            str(tmp_path))
+        with pytest.raises(RuntimeError, match="place"):
+            list(paddle.dataset.uci_housing.train()())
+        with pytest.raises(RuntimeError, match="egress"):
+            paddle.dataset.common.download("http://x/y.tgz", "mod", "")
+
+
+class TestCostModel:
+    def test_profile_measure(self):
+        import paddle_tpu.nn.functional as F
+        cm = paddle.cost_model.CostModel()
+        x = paddle.to_tensor(np.random.randn(8, 8).astype(np.float32))
+
+        def fn():
+            return F.relu(paddle.matmul(x, x))
+
+        costs = cm.profile_measure(fn, repeat=2)
+        assert "matmul" in costs and "relu" in costs
+        assert costs["matmul"]["time"] >= 0
+        assert cm.get_static_op_time("matmul")["calls"] >= 2
+
+    def test_flops_estimate(self):
+        import jax.numpy as jnp
+        from paddle_tpu.cost_model import estimate_flops
+        f = estimate_flops(lambda a: a @ a, jnp.ones((16, 16)))
+        assert f == -1.0 or f > 0
+
+
+class TestCallbacksAlias:
+    def test_alias(self):
+        assert paddle.callbacks.EarlyStopping is not None
+        from paddle_tpu.hapi.callbacks import EarlyStopping
+        assert paddle.callbacks.EarlyStopping is EarlyStopping
+
+
+class TestOnnx:
+    def test_gated(self):
+        with pytest.raises((ImportError, NotImplementedError)):
+            paddle.onnx.export(None, "/tmp/x")
+
+
+class TestIncubateOptimizers:
+    def _setup(self):
+        import paddle_tpu.nn as nn
+        import paddle_tpu.nn.functional as F
+        rng = np.random.RandomState(1)
+        lin = nn.Linear(4, 2)
+        x = paddle.to_tensor(rng.randn(8, 4).astype(np.float32))
+        y = paddle.to_tensor(rng.randn(8, 2).astype(np.float32))
+        return lin, x, y, F
+
+    def test_lookahead_converges_and_syncs(self):
+        lin, x, y, F = self._setup()
+        inner = paddle.optimizer.SGD(learning_rate=0.1,
+                                     parameters=lin.parameters())
+        la = paddle.incubate.LookAhead(inner, alpha=0.5, k=2)
+        losses = []
+        for _ in range(8):
+            loss = F.mse_loss(lin(x), y)
+            loss.backward()
+            la.step()
+            la.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
+        sd = la.state_dict()
+        assert any(k.endswith("_slow") for k in sd)
+
+    def test_model_average_apply_restore(self):
+        lin, x, y, F = self._setup()
+        opt = paddle.optimizer.SGD(learning_rate=0.5,
+                                   parameters=lin.parameters())
+        ma = paddle.incubate.ModelAverage(
+            0.15, parameters=lin.parameters(), min_average_window=2,
+            max_average_window=10)
+        for _ in range(4):
+            loss = F.mse_loss(lin(x), y)
+            loss.backward()
+            opt.step()
+            ma.step()
+            opt.clear_grad()
+        cur = np.asarray(lin.weight._data).copy()
+        ma.apply()
+        avg = np.asarray(lin.weight._data).copy()
+        assert not np.allclose(cur, avg)
+        ma.restore()
+        np.testing.assert_allclose(np.asarray(lin.weight._data), cur)
+
+
+class TestReaderErrorPropagation:
+    def test_buffered_reraises(self):
+        def bad():
+            yield 1
+            raise ValueError("boom")
+
+        r = paddle.reader.buffered(bad, 2)
+        with pytest.raises(ValueError, match="boom"):
+            list(r())
+
+    def test_xmap_mapper_error_reraises(self):
+        def nums():
+            yield from range(4)
+
+        r = paddle.reader.xmap_readers(lambda v: 1 // 0, nums, 2, 4)
+        with pytest.raises(ZeroDivisionError):
+            list(r())
+
+    def test_compose_alignment(self):
+        def a():
+            yield from range(3)
+
+        def b():
+            yield from range(5)
+
+        with pytest.raises(paddle.reader.ComposeNotAligned):
+            list(paddle.reader.compose(a, b)())
+        out = list(paddle.reader.compose(a, b, check_alignment=False)())
+        assert len(out) == 3
+
+    def test_lookahead_first_sync_interpolates(self):
+        import paddle_tpu.nn as nn
+        import paddle_tpu.nn.functional as F
+        rng = np.random.RandomState(3)
+        lin = nn.Linear(4, 2)
+        w0 = np.asarray(lin.weight._data).copy()
+        inner = paddle.optimizer.SGD(learning_rate=0.5,
+                                     parameters=lin.parameters())
+        la = paddle.incubate.LookAhead(inner, alpha=0.5, k=2)
+        x = paddle.to_tensor(rng.randn(8, 4).astype(np.float32))
+        y = paddle.to_tensor(rng.randn(8, 2).astype(np.float32))
+        fast = None
+        for i in range(2):
+            loss = F.mse_loss(lin(x), y)
+            loss.backward()
+            if i == 1:
+                # fast weights after the inner step, before the sync
+                g = np.asarray(lin.weight.grad._data)
+                fast = np.asarray(lin.weight._data) - 0.5 * g
+            la.step()
+            la.clear_grad()
+        w_after = np.asarray(lin.weight._data)
+        expected = w0 + 0.5 * (fast - w0)
+        np.testing.assert_allclose(w_after, expected, rtol=1e-4, atol=1e-5)
