@@ -10,15 +10,18 @@ from . import common
 __all__ = ["train10", "test10", "train100", "test100"]
 
 
-def _reader(cls_name, data_file, mode):
+def _reader(cls_name, data_file, mode, cycle=False):
     from ..vision import datasets as V
 
     def reader():
         ds = getattr(V, cls_name)(data_file=data_file, mode=mode)
-        for i in range(len(ds)):
-            img, lab = ds[i]
-            yield (np.asarray(img, np.float32).reshape(-1) / 255.0,
-                   int(np.asarray(lab)))
+        while True:
+            for i in range(len(ds)):
+                img, lab = ds[i]
+                yield (np.asarray(img, np.float32).reshape(-1) / 255.0,
+                       int(np.asarray(lab)))
+            if not cycle:
+                return
 
     return reader
 
@@ -26,13 +29,13 @@ def _reader(cls_name, data_file, mode):
 def train10(cycle=False):
     path = os.path.join(common.DATA_HOME, "cifar",
                         "cifar-10-python.tar.gz")
-    return _reader("Cifar10", path, "train")
+    return _reader("Cifar10", path, "train", cycle)
 
 
 def test10(cycle=False):
     path = os.path.join(common.DATA_HOME, "cifar",
                         "cifar-10-python.tar.gz")
-    return _reader("Cifar10", path, "test")
+    return _reader("Cifar10", path, "test", cycle)
 
 
 def train100():

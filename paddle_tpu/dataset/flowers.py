@@ -42,13 +42,24 @@ def _reader(mode):
     return reader
 
 
+def _wrap(base, mapper, cycle):
+    def reader():
+        while True:
+            for sample in base():
+                yield mapper(sample) if mapper is not None else sample
+            if not cycle:
+                return
+
+    return reader
+
+
 def train(mapper=None, buffered_size=1024, use_xmap=True, cycle=False):
-    return _reader("train")
+    return _wrap(_reader("train"), mapper, cycle)
 
 
 def test(mapper=None, buffered_size=1024, use_xmap=True, cycle=False):
-    return _reader("test")
+    return _wrap(_reader("test"), mapper, cycle)
 
 
 def valid(mapper=None, buffered_size=1024, use_xmap=True, cycle=False):
-    return _reader("valid")
+    return _wrap(_reader("valid"), mapper, cycle)

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops.registry import get_op
+from .infermeta import maybe_check as _infermeta_check
 from . import dtypes as _dtypes
 from .flags import flag_value
 from .monitor import stat_add
@@ -176,6 +177,11 @@ def call_op(name: str, *args, **attrs):
     """Execute a registered op eagerly on Tensors, recording the tape."""
     opdef = get_op(name)
     stat_add(f"op_count/{name}")
+    if flag_value("FLAGS_check_shapes"):
+        # InferMeta-style pre-dispatch validation (reference: phi/infermeta/
+        # run per kernel launch); raises ShapeError at the call site instead
+        # of an XLA error deep inside jit
+        _infermeta_check(name, args, attrs)
     if flag_value("FLAGS_benchmark"):
         return _call_op_timed(name, opdef, args, attrs)
     return _call_op_impl(name, opdef, args, attrs)

@@ -79,6 +79,9 @@ define_flag("FLAGS_check_nan_inf", False,
             "Sweep op outputs for NaN/Inf after each eager op "
             "(reference: framework/details/nan_inf_utils_detail.cc)")
 define_flag("FLAGS_benchmark", False, "Print per-op timing in eager mode")
+define_flag("FLAGS_check_shapes", True,
+            "InferMeta-style pre-dispatch shape validation with call-site "
+            "errors (reference: phi/infermeta/)")
 define_flag("FLAGS_use_standalone_executor", True,
             "Kept for API parity; the XLA executor is always standalone")
 define_flag("FLAGS_eager_jit_ops", True,

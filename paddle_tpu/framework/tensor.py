@@ -156,13 +156,17 @@ class Tensor:
         return np.asarray(self._data).tolist()
 
     def __float__(self):
-        return float(self._data)
+        # any 1-element tensor converts (reference semantics), not just rank-0
+        return float(self._data.reshape(()) if self._data.size == 1
+                     else self._data)
 
     def __int__(self):
-        return int(self._data)
+        return int(self._data.reshape(()) if self._data.size == 1
+                   else self._data)
 
     def __bool__(self):
-        return bool(self._data)
+        return bool(self._data.reshape(()) if self._data.size == 1
+                    else self._data)
 
     def __len__(self):
         if self.ndim == 0:

@@ -1,0 +1,206 @@
+"""Remaining tensor-op families: complex views, statistics, numeric
+utilities, LU unpack, sharding helpers.
+
+Reference analogs: paddle/phi/kernels/{lerp_kernel.h, dist_kernel.h,
+logcumsumexp_kernel.h, mode_kernel.h, multiplex_kernel.h,
+nanmedian_kernel.h, cholesky_solve_kernel.h, lu_unpack_kernel.h,
+shard_index_kernel.h, complex_kernel.h} and python/paddle/tensor/math.py.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op
+
+
+@register_op("add_n")
+def _add_n(inputs):
+    out = inputs[0]
+    for x in inputs[1:]:
+        out = out + x
+    return out
+
+
+@register_op("lerp")
+def _lerp(x, y, weight):
+    return x + weight * (y - x)
+
+
+@register_op("deg2rad")
+def _deg2rad(x):
+    return jnp.deg2rad(x)
+
+
+@register_op("rad2deg")
+def _rad2deg(x):
+    return jnp.rad2deg(x)
+
+
+@register_op("gcd", nondiff=True)
+def _gcd(x, y):
+    return jnp.gcd(x, y)
+
+
+@register_op("lcm", nondiff=True)
+def _lcm(x, y):
+    return jnp.lcm(x, y)
+
+
+@register_op("diff")
+def _diff(x, prepend=None, append=None, n=1, axis=-1):
+    return jnp.diff(x, n=n, axis=axis, prepend=prepend, append=append)
+
+
+@register_op("dist")
+def _dist(x, y, p=2.0):
+    d = (x - y).ravel()
+    p = float(p)
+    if p == float("inf"):
+        return jnp.max(jnp.abs(d))
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(d))
+    if p == 0:
+        return jnp.sum(d != 0).astype(x.dtype)
+    return jnp.sum(jnp.abs(d) ** p) ** (1.0 / p)
+
+
+@register_op("logcumsumexp")
+def _logcumsumexp(x, axis=None):
+    if axis is None:
+        x = x.ravel()
+        axis = 0
+    return lax.cumlogsumexp(x, axis=axis)
+
+
+@register_op("mode")
+def _mode(x, axis=-1, keepdim=False):
+    """Most frequent value along axis; ties resolve to the largest value
+    (matching the reference's last-occurrence-in-sorted-order)."""
+    ax = axis % x.ndim
+    xs = jnp.moveaxis(x, ax, -1)
+    sorted_x = jnp.sort(xs, axis=-1)
+    n = sorted_x.shape[-1]
+    # run length ending at each position
+    same = jnp.concatenate(
+        [jnp.zeros(sorted_x.shape[:-1] + (1,), bool),
+         sorted_x[..., 1:] == sorted_x[..., :-1]], axis=-1)
+
+    def scan_fn(carry, s):
+        run = jnp.where(s, carry + 1, 1)
+        return run, run
+
+    _, runs = lax.scan(scan_fn,
+                       jnp.ones(sorted_x.shape[:-1], jnp.int32),
+                       jnp.moveaxis(same, -1, 0))
+    runs = jnp.moveaxis(runs, 0, -1)
+    # reference keeps the LAST max run (ties -> larger value): flip argmax
+    rev_best = (n - 1) - jnp.argmax(runs[..., ::-1], axis=-1)
+    values = jnp.take_along_axis(sorted_x, rev_best[..., None],
+                                 axis=-1)[..., 0]
+    # index of (last occurrence of) the mode in the ORIGINAL array
+    eq = xs == values[..., None]
+    idx = (n - 1) - jnp.argmax(eq[..., ::-1], axis=-1)
+    if keepdim:
+        values = jnp.expand_dims(values, ax)
+        idx = jnp.expand_dims(idx, ax)
+    return values, idx.astype(jnp.int64)
+
+
+@register_op("multiplex")
+def _multiplex(inputs, index):
+    stacked = jnp.stack(inputs)                      # [K, N, ...]
+    idx = index.reshape(-1).astype(jnp.int32)        # [N]
+    rows = jnp.arange(stacked.shape[1])
+    return stacked[idx, rows]
+
+
+@register_op("nanmedian")
+def _nanmedian(x, axis=None, keepdim=False):
+    return jnp.nanmedian(x, axis=axis, keepdims=keepdim).astype(x.dtype)
+
+
+@register_op("nanquantile")
+def _nanquantile(x, q, axis=None, keepdim=False):
+    return jnp.nanquantile(x.astype(jnp.float64)
+                           if x.dtype == jnp.float64 else
+                           x.astype(jnp.float32),
+                           jnp.asarray(q), axis=axis, keepdims=keepdim)
+
+
+@register_op("cov")
+def _cov(x, fweights=None, aweights=None, rowvar=True, ddof=True):
+    return jnp.cov(x, rowvar=rowvar, ddof=1 if ddof else 0,
+                   fweights=fweights, aweights=aweights).astype(x.dtype)
+
+
+@register_op("corrcoef")
+def _corrcoef(x, rowvar=True):
+    return jnp.corrcoef(x, rowvar=rowvar).astype(x.dtype)
+
+
+@register_op("lu_unpack")
+def _lu_unpack(lu_mat, pivots, unpack_ludata=True, unpack_pivots=True):
+    m, n = lu_mat.shape[-2], lu_mat.shape[-1]
+    k = min(m, n)
+    lower = jnp.tril(lu_mat[..., :, :k], k=-1)[..., :m, :]
+    eye = jnp.eye(m, k, dtype=lu_mat.dtype)
+    l_mat = lower + eye
+    u_mat = jnp.triu(lu_mat)[..., :k, :]
+    # pivots (1-based sequential row swaps, LAPACK ipiv) -> permutation
+    piv = pivots.astype(jnp.int32) - 1
+
+    def perm_from_ipiv(ip):
+        perm = jnp.arange(m)
+
+        def body(i, p):
+            j = ip[i]
+            pi = p[i]
+            pj = p[j]
+            p = p.at[i].set(pj).at[j].set(pi)
+            return p
+
+        perm = lax.fori_loop(0, ip.shape[0], body, perm)
+        return perm
+
+    batch = piv.shape[:-1]
+    if batch:
+        perm = jax.vmap(perm_from_ipiv)(piv.reshape(-1, piv.shape[-1]))
+        perm = perm.reshape(batch + (m,))
+    else:
+        perm = perm_from_ipiv(piv)
+    p_mat = jax.nn.one_hot(perm, m, dtype=lu_mat.dtype)
+    # rows of P: P[perm[i], i] = 1 so that A = P L U
+    p_mat = jnp.swapaxes(p_mat, -1, -2)
+    return p_mat, l_mat, u_mat
+
+
+@register_op("shard_index", nondiff=True)
+def _shard_index(x, index_num, nshards, shard_id, ignore_value=-1):
+    shard_size = (index_num + nshards - 1) // nshards
+    in_shard = (x // shard_size) == shard_id
+    return jnp.where(in_shard, x % shard_size, ignore_value)
+
+
+@register_op("as_complex")
+def _as_complex(x):
+    return lax.complex(x[..., 0], x[..., 1])
+
+
+@register_op("as_real")
+def _as_real(x):
+    return jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1)
+
+
+@register_op("make_complex")
+def _make_complex(real, imag):
+    return lax.complex(real, imag)
+
+
+@register_op("randint_like", nondiff=True)
+def _randint_like(x, key, low=0, high=None):
+    if high is None:
+        low, high = 0, low
+    return jax.random.randint(key, x.shape, int(low), int(high),
+                              dtype=jnp.int64)

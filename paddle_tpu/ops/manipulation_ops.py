@@ -17,7 +17,11 @@ from .registry import register_op
 
 @register_op("reshape")
 def _reshape(x, shape):
-    return jnp.reshape(x, tuple(shape))
+    # reference semantics: a 0 in the target copies the input dim at that
+    # position (phi ReshapeInferMeta)
+    shape = tuple(x.shape[i] if d == 0 and i < x.ndim else d
+                  for i, d in enumerate(shape))
+    return jnp.reshape(x, shape)
 
 
 @register_op("transpose")

@@ -1031,4 +1031,245 @@ def _attach_methods():
     Tensor.normal_ = _normal_
 
 
+# ---------------------------------------------------------------------------
+# remaining reference surface: complex views, statistics, numeric utilities,
+# LoDTensorArray facade (reference: tensor/math.py, tensor/attribute.py,
+# fluid/layers/control_flow.py array ops)
+# ---------------------------------------------------------------------------
+
+@_export
+def add_n(inputs, name=None):
+    if isinstance(inputs, Tensor):
+        return _op("assign", inputs)  # fresh output, never an alias
+    return _op("add_n", list(inputs))
+
+
+@_export
+def lerp(x, y, weight, name=None):
+    if isinstance(weight, float):
+        weight = full_like(x, weight)
+    return _op("lerp", x, y, weight)
+
+
+@_export
+def deg2rad(x, name=None):
+    return _op("deg2rad", x)
+
+
+@_export
+def rad2deg(x, name=None):
+    return _op("rad2deg", x)
+
+
+@_export
+def gcd(x, y, name=None):
+    return _op("gcd", x, y)
+
+
+@_export
+def lcm(x, y, name=None):
+    return _op("lcm", x, y)
+
+
+@_export
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    return _op("diff", x, prepend, append, n=n, axis=axis)
+
+
+@_export
+def dist(x, y, p=2.0, name=None):
+    return _op("dist", x, y, p=float(p))
+
+
+@_export
+def logcumsumexp(x, axis=None, dtype=None, name=None):
+    out = _op("logcumsumexp", x, axis=axis)
+    if dtype is not None:
+        out = _op("cast", out, dtype=dtype)
+    return out
+
+
+@_export
+def mode(x, axis=-1, keepdim=False, name=None):
+    return _op("mode", x, axis=axis, keepdim=keepdim)
+
+
+@_export
+def multiplex(inputs, index, name=None):
+    return _op("multiplex", list(inputs), index)
+
+
+@_export
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    return _op("nanmedian", x, axis=axis, keepdim=keepdim)
+
+
+@_export
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    return _op("nanquantile", x, q=q, axis=axis, keepdim=keepdim)
+
+
+@_export
+def unbind(input, axis=0):
+    return list(_op("unstack", input, axis=axis))
+
+
+@_export
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return _op("cov", x, fweights, aweights, rowvar=rowvar, ddof=ddof)
+
+
+@_export
+def corrcoef(x, rowvar=True, name=None):
+    return _op("corrcoef", x, rowvar=rowvar)
+
+
+@_export
+def cholesky_solve(x, y, upper=False, name=None):
+    return _op("cholesky_solve", x, y, upper=upper)
+
+
+@_export
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    p, l, u = _op("lu_unpack", x, y)
+    # reference contract: un-requested outputs are None
+    if not unpack_ludata:
+        l = u = None
+    if not unpack_pivots:
+        p = None
+    return p, l, u
+
+
+@_export
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    if not 0 <= shard_id < nshards:
+        raise ValueError("shard_id must be in [0, nshards)")
+    return _op("shard_index", input, index_num=index_num, nshards=nshards,
+               shard_id=shard_id, ignore_value=ignore_value)
+
+
+@_export
+def as_complex(x, name=None):
+    return _op("as_complex", x)
+
+
+@_export
+def as_real(x, name=None):
+    return _op("as_real", x)
+
+
+@_export
+def complex(real, imag, name=None):
+    return _op("make_complex", real, imag)
+
+
+@_export
+def is_complex(x):
+    return jnp.issubdtype(x._data.dtype if isinstance(x, Tensor)
+                          else jnp.asarray(x).dtype, jnp.complexfloating)
+
+
+@_export
+def is_floating_point(x):
+    return jnp.issubdtype(x._data.dtype if isinstance(x, Tensor)
+                          else jnp.asarray(x).dtype, jnp.floating)
+
+
+@_export
+def is_integer(x):
+    return jnp.issubdtype(x._data.dtype if isinstance(x, Tensor)
+                          else jnp.asarray(x).dtype, jnp.integer)
+
+
+@_export
+def is_empty(x, name=None):
+    return to_tensor(int(np.prod(x.shape)) == 0)
+
+
+@_export
+def increment(x, value=1.0, name=None):
+    out = _op("scale", x, scale=1.0, bias=float(value))
+    if isinstance(x, Tensor):
+        x._data = out._data
+        return x
+    return out
+
+
+@_export
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    out = _op("randint_like", x, _random.next_key(), low=low, high=high)
+    # reference contract: dtype defaults to x's dtype
+    target = dtype if dtype is not None else x.dtype
+    return _op("cast", out, dtype=target)
+
+
+@_export
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+@_export
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    np.set_printoptions(**kw)
+
+
+# LoDTensorArray facade: in the reference these are static-graph ops over a
+# tensor-array variable (fluid/layers/control_flow.py); eager mode uses a
+# plain list, which is exactly what jit tracing handles here too.
+
+@_export
+def create_array(dtype="float32", initialized_list=None):
+    return list(initialized_list) if initialized_list is not None else []
+
+
+@_export
+def array_write(x, i, array=None):
+    idx = int(i) if not isinstance(i, Tensor) else int(np.asarray(i._data))
+    if array is None:
+        array = []
+    while len(array) <= idx:
+        array.append(None)
+    array[idx] = x
+    return array
+
+
+@_export
+def array_read(array, i):
+    idx = int(i) if not isinstance(i, Tensor) else int(np.asarray(i._data))
+    return array[idx]
+
+
+@_export
+def array_length(array):
+    return to_tensor(np.int64(len(array)))
+
+
+# top-level linalg re-exports (reference exposes these both at paddle.* and
+# paddle.linalg.*)
+
+def _linalg_reexport():
+    from .. import linalg as _linalg
+    for _name in ("eig", "eigh", "eigvalsh", "qr", "svd", "lu",
+                  "matrix_power", "multi_dot", "cond", "lstsq", "solve",
+                  "pinv"):
+        fn = getattr(_linalg, _name)
+        globals()[_name] = fn
+        __all__.append(_name)
+
+
+_linalg_reexport()
+
+
 _attach_methods()

@@ -1,0 +1,225 @@
+"""Parity tests for the remaining tensor-op surface (numpy/torch refs)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+torch = pytest.importorskip("torch")
+
+
+def t(x):
+    return paddle.to_tensor(np.asarray(x))
+
+
+def a(x):
+    return np.asarray(x._data if hasattr(x, "_data") else x)
+
+
+class TestSimpleMath:
+    def test_add_n_lerp_dist(self):
+        rng = np.random.RandomState(0)
+        xs = [rng.randn(3, 4).astype(np.float32) for _ in range(3)]
+        np.testing.assert_allclose(a(paddle.add_n([t(v) for v in xs])),
+                                   sum(xs), rtol=1e-6)
+        x, y = xs[0], xs[1]
+        np.testing.assert_allclose(
+            a(paddle.lerp(t(x), t(y), 0.3)),
+            torch.lerp(torch.tensor(x), torch.tensor(y), 0.3).numpy(),
+            rtol=1e-5)
+        np.testing.assert_allclose(
+            a(paddle.dist(t(x), t(y), p=3)),
+            torch.dist(torch.tensor(x), torch.tensor(y), p=3).numpy(),
+            rtol=1e-4)
+        np.testing.assert_allclose(
+            a(paddle.dist(t(x), t(y), p=float("inf"))),
+            np.abs(x - y).max(), rtol=1e-6)
+
+    def test_deg_rad_gcd_lcm_diff(self):
+        x = np.array([0.0, 90.0, 180.0], np.float32)
+        np.testing.assert_allclose(a(paddle.deg2rad(t(x))),
+                                   np.deg2rad(x), rtol=1e-6)
+        np.testing.assert_allclose(a(paddle.rad2deg(t(np.deg2rad(x)))),
+                                   x, rtol=1e-5)
+        g = np.array([12, 20, 7])
+        h = np.array([20, 30, 5])
+        np.testing.assert_array_equal(a(paddle.gcd(t(g), t(h))),
+                                      np.gcd(g, h))
+        np.testing.assert_array_equal(a(paddle.lcm(t(g), t(h))),
+                                      np.lcm(g, h))
+        d = np.array([1.0, 4.0, 9.0, 16.0], np.float32)
+        np.testing.assert_allclose(a(paddle.diff(t(d))), np.diff(d))
+        np.testing.assert_allclose(a(paddle.diff(t(d), n=2)),
+                                   np.diff(d, n=2))
+
+    def test_logcumsumexp(self):
+        rng = np.random.RandomState(1)
+        x = rng.randn(4, 5).astype(np.float32)
+        np.testing.assert_allclose(
+            a(paddle.logcumsumexp(t(x), axis=1)),
+            torch.logcumsumexp(torch.tensor(x), dim=1).numpy(), rtol=1e-4)
+
+    def test_nan_stats(self):
+        x = np.array([[1.0, np.nan, 3.0], [4.0, 5.0, np.nan]], np.float32)
+        np.testing.assert_allclose(a(paddle.nanmedian(t(x), axis=1)),
+                                   np.nanmedian(x, axis=1), rtol=1e-6)
+        np.testing.assert_allclose(
+            a(paddle.nanquantile(t(x), 0.5, axis=1)),
+            np.nanquantile(x, 0.5, axis=1), rtol=1e-6)
+
+    def test_cov_corrcoef(self):
+        rng = np.random.RandomState(2)
+        x = rng.randn(3, 50).astype(np.float32)
+        np.testing.assert_allclose(a(paddle.cov(t(x))), np.cov(x),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(a(paddle.corrcoef(t(x))),
+                                   np.corrcoef(x), rtol=1e-4, atol=1e-5)
+
+
+class TestModeMultiplex:
+    def test_mode_parity(self):
+        x = np.array([[2, 2, 3, 1, 2], [5, 4, 4, 4, 9]], np.float32)
+        v, i = paddle.mode(t(x), axis=-1)
+        tv, ti = torch.mode(torch.tensor(x), dim=-1)
+        np.testing.assert_array_equal(a(v), tv.numpy())
+        # index may differ among equal values; check the value at index
+        got = np.take_along_axis(x, a(i)[:, None].astype(int), axis=1)[:, 0]
+        np.testing.assert_array_equal(got, tv.numpy())
+
+    def test_mode_tie_prefers_larger(self):
+        x = np.array([[1, 1, 7, 7]], np.float32)
+        v, _ = paddle.mode(t(x))
+        assert a(v)[0] == 7
+
+    def test_multiplex(self):
+        i1 = np.array([[1, 2], [3, 4]], np.float32)
+        i2 = np.array([[5, 6], [7, 8]], np.float32)
+        idx = np.array([[1], [0]])
+        out = paddle.multiplex([t(i1), t(i2)], t(idx))
+        np.testing.assert_array_equal(a(out), [[5, 6], [3, 4]])
+
+
+class TestComplexViews:
+    def test_roundtrip(self):
+        rng = np.random.RandomState(3)
+        x = rng.randn(3, 4, 2).astype(np.float32)
+        c = paddle.as_complex(t(x))
+        assert paddle.is_complex(c)
+        back = paddle.as_real(c)
+        np.testing.assert_allclose(a(back), x, rtol=1e-6)
+        z = paddle.complex(t(x[..., 0]), t(x[..., 1]))
+        np.testing.assert_allclose(a(z), x[..., 0] + 1j * x[..., 1],
+                                   rtol=1e-6)
+
+    def test_dtype_predicates(self):
+        assert paddle.is_floating_point(t(np.zeros(2, np.float32)))
+        assert paddle.is_integer(t(np.zeros(2, np.int32)))
+        assert not paddle.is_complex(t(np.zeros(2, np.float32)))
+
+
+class TestLinalgExtras:
+    def test_cholesky_solve(self):
+        rng = np.random.RandomState(4)
+        m = rng.randn(4, 4).astype(np.float32)
+        spd = m @ m.T + 4 * np.eye(4, dtype=np.float32)
+        b = rng.randn(4, 2).astype(np.float32)
+        chol = np.linalg.cholesky(spd).astype(np.float32)
+        out = a(paddle.cholesky_solve(t(b), t(chol), upper=False))
+        np.testing.assert_allclose(spd @ out, b, rtol=1e-3, atol=1e-3)
+
+    def test_lu_unpack_reconstructs(self):
+        rng = np.random.RandomState(5)
+        x = rng.randn(4, 4).astype(np.float32)
+        lu_mat, piv = paddle.lu(t(x))
+        p, l, u = paddle.lu_unpack(lu_mat, piv)
+        recon = a(p) @ a(l) @ a(u)
+        np.testing.assert_allclose(recon, x, rtol=1e-4, atol=1e-4)
+
+    def test_top_level_svd_qr(self):
+        rng = np.random.RandomState(6)
+        x = rng.randn(5, 3).astype(np.float32)
+        u, s, vh = paddle.svd(t(x))
+        recon = a(u)[:, :3] * a(s)[None, :] @ a(vh)[:3] \
+            if a(u).shape[1] != 3 else a(u) * a(s)[None, :] @ a(vh)
+        assert np.allclose(np.sort(a(s))[::-1], a(s), atol=1e-5)
+        q, r = paddle.qr(t(x))
+        np.testing.assert_allclose(a(q) @ a(r), x, rtol=1e-4, atol=1e-4)
+
+
+class TestUtilities:
+    def test_unbind(self):
+        x = np.arange(24).reshape(2, 3, 4).astype(np.float32)
+        parts = paddle.unbind(t(x), axis=1)
+        assert len(parts) == 3
+        np.testing.assert_array_equal(a(parts[1]), x[:, 1])
+
+    def test_shard_index(self):
+        lab = np.array([[1], [6], [12], [19]])
+        out = paddle.shard_index(t(lab), index_num=20, nshards=2, shard_id=0)
+        np.testing.assert_array_equal(a(out), [[1], [6], [-1], [-1]])
+        out1 = paddle.shard_index(t(lab), index_num=20, nshards=2,
+                                  shard_id=1)
+        np.testing.assert_array_equal(a(out1), [[-1], [-1], [2], [9]])
+
+    def test_increment_inplace(self):
+        x = t(np.array([1.0], np.float32))
+        y = paddle.increment(x, 2.5)
+        assert y is x and float(x) == 3.5
+
+    def test_randint_like(self):
+        x = t(np.zeros((100,), np.float32))
+        r = a(paddle.randint_like(x, low=3, high=7))
+        assert r.shape == (100,) and r.min() >= 3 and r.max() < 7
+
+    def test_broadcast_shape_and_is_empty(self):
+        assert paddle.broadcast_shape([2, 1, 3], [4, 3]) == [2, 4, 3]
+        assert bool(paddle.is_empty(t(np.zeros((0, 3)))))
+        assert not bool(paddle.is_empty(t(np.zeros((1, 3)))))
+
+    def test_array_api(self):
+        arr = paddle.create_array()
+        arr = paddle.array_write(t(np.array([1.0])), 0, arr)
+        arr = paddle.array_write(t(np.array([2.0])), 1, arr)
+        assert float(paddle.array_length(arr)) == 2
+        assert float(paddle.array_read(arr, 1)) == 2.0
+
+    def test_grad_through_lerp_diff(self):
+        x = paddle.to_tensor(np.array([1.0, 2.0, 4.0], np.float32),
+                             stop_gradient=False)
+        y = paddle.to_tensor(np.array([2.0, 3.0, 5.0], np.float32),
+                             stop_gradient=False)
+        out = paddle.mean(paddle.lerp(x, y, 0.25))
+        out.backward()
+        np.testing.assert_allclose(a(x.grad), [0.25, 0.25, 0.25])
+        np.testing.assert_allclose(a(y.grad), [1 / 12] * 3, rtol=1e-5)
+
+
+class TestReviewFixes:
+    def test_randint_like_dtype_defaults_to_input(self):
+        x = t(np.zeros((10,), np.float32))
+        r = paddle.randint_like(x, 5)
+        assert "float32" in str(r.dtype)
+
+    def test_reshape_zero_copies_dim(self):
+        x = t(np.zeros((2, 3, 4)))
+        out = paddle.reshape(x, [0, 3, 4])
+        assert tuple(out.shape) == (2, 3, 4)
+        out = paddle.reshape(x, [0, -1])
+        assert tuple(out.shape) == (2, 12)
+
+    def test_add_n_single_is_fresh(self):
+        x = t(np.array([1.0], np.float32))
+        y = paddle.add_n(x)
+        assert y is not x
+
+    def test_lu_unpack_flags(self):
+        x = t(np.random.RandomState(0).randn(3, 3).astype(np.float32))
+        lu_mat, piv = paddle.lu(x)
+        p, l, u = paddle.lu_unpack(lu_mat, piv, unpack_pivots=False)
+        assert p is None and l is not None
+        p2, l2, u2 = paddle.lu_unpack(lu_mat, piv, unpack_ludata=False)
+        assert l2 is None and u2 is None and p2 is not None
+
+    def test_concat_axis_out_of_range(self):
+        from paddle_tpu.framework.infermeta import ShapeError
+        with pytest.raises(ShapeError, match="out of range"):
+            paddle.concat([t(np.zeros((2, 2))), t(np.zeros((2, 2)))], axis=3)

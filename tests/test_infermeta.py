@@ -1,0 +1,85 @@
+"""InferMeta tests: call-site shape errors + compute-free inference."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.framework.infermeta import ShapeError, infer_meta
+
+
+def t(x):
+    return paddle.to_tensor(np.asarray(x))
+
+
+class TestValidators:
+    def test_matmul_mismatch(self):
+        with pytest.raises(ShapeError, match="contracted dims"):
+            paddle.matmul(t(np.zeros((2, 3))), t(np.zeros((4, 5))))
+        # transpose flips the contracted dim
+        out = paddle.matmul(t(np.zeros((2, 3))), t(np.zeros((5, 3))),
+                            transpose_y=True)
+        assert tuple(out.shape) == (2, 5)
+
+    def test_concat_mismatch(self):
+        with pytest.raises(ShapeError, match="non-axis dims"):
+            paddle.concat([t(np.zeros((2, 3))), t(np.zeros((2, 4)))], axis=0)
+        ok = paddle.concat([t(np.zeros((2, 3))), t(np.zeros((2, 4)))],
+                           axis=1)
+        assert tuple(ok.shape) == (2, 7)
+
+    def test_conv2d_channel_mismatch(self):
+        with pytest.raises(ShapeError, match="input channels"):
+            F.conv2d(t(np.zeros((1, 3, 8, 8), np.float32)),
+                     t(np.zeros((4, 5, 3, 3), np.float32)))
+
+    def test_linear_mismatch(self):
+        with pytest.raises(ShapeError, match="feature dim"):
+            F.linear(t(np.zeros((2, 7), np.float32)),
+                     t(np.zeros((8, 4), np.float32)))
+
+    def test_reshape_bad_product(self):
+        with pytest.raises(ShapeError, match="reshape"):
+            paddle.reshape(t(np.zeros((2, 3))), [4, 5])
+        with pytest.raises(ShapeError, match="divisible"):
+            paddle.reshape(t(np.zeros((2, 3))), [-1, 4])
+
+    def test_transpose_bad_perm(self):
+        with pytest.raises(ShapeError, match="permutation"):
+            paddle.transpose(t(np.zeros((2, 3))), (0, 0))
+
+    def test_batch_norm_channel_mismatch(self):
+        with pytest.raises(ShapeError, match="channels"):
+            F.batch_norm(t(np.zeros((2, 4, 3, 3), np.float32)),
+                         t(np.zeros(5, np.float32)),
+                         t(np.ones(5, np.float32)))
+
+    def test_flag_disables(self):
+        paddle.set_flags({"FLAGS_check_shapes": False})
+        try:
+            with pytest.raises(Exception) as ei:
+                paddle.matmul(t(np.zeros((2, 3))), t(np.zeros((4, 5))))
+            assert not isinstance(ei.value, ShapeError)
+        finally:
+            paddle.set_flags({"FLAGS_check_shapes": True})
+
+
+class TestInferMeta:
+    def test_infer_matmul(self):
+        import jax
+        out = infer_meta("matmul",
+                         jax.ShapeDtypeStruct((8, 16), np.float32),
+                         jax.ShapeDtypeStruct((16, 32), np.float32))
+        assert out.shape == (8, 32) and out.dtype == np.float32
+
+    def test_infer_conv_from_tensor(self):
+        out = infer_meta("conv2d", t(np.zeros((2, 3, 8, 8), np.float32)),
+                         t(np.zeros((16, 3, 3, 3), np.float32)),
+                         stride=2, padding=1)
+        assert out.shape == (2, 16, 4, 4)
+
+    def test_infer_multi_output(self):
+        outs = infer_meta("max_pool2d_with_mask",
+                          t(np.zeros((1, 2, 8, 8), np.float32)),
+                          kernel_size=2)
+        assert outs[0].shape == (1, 2, 4, 4)
+        assert outs[1].dtype == np.int32
