@@ -7,8 +7,10 @@ TPU-first (XLA collectives over a hybrid Mesh instead of NCCL rings).
 """
 from . import env  # noqa: F401
 from .collective import (  # noqa: F401
-    ReduceOp, all_gather, all_reduce, alltoall, barrier, broadcast,
-    get_group, new_group, recv, reduce, scatter, send,
+    ReduceOp, all_gather, all_gather_object, all_reduce, all_to_all,
+    alltoall, barrier, broadcast, destroy_process_group, get_backend,
+    get_group, irecv, is_available, isend, new_group, recv, reduce,
+    reduce_scatter, scatter, send, wait,
 )
 from .env import (  # noqa: F401
     get_rank, get_world_size, init_parallel_env, is_initialized,

@@ -29,8 +29,11 @@ from . import env
 
 __all__ = ["ReduceOp", "Group", "new_group", "get_group", "all_reduce",
            "all_gather", "broadcast", "reduce", "scatter", "alltoall",
-           "send", "recv", "barrier", "psum_in_axis", "all_gather_in_axis",
-           "ppermute_in_axis", "all_to_all_in_axis", "reduce_scatter_in_axis"]
+           "all_to_all", "reduce_scatter", "send", "recv", "isend", "irecv",
+           "wait", "barrier", "get_backend", "is_available",
+           "destroy_process_group", "all_gather_object", "psum_in_axis",
+           "all_gather_in_axis", "ppermute_in_axis", "all_to_all_in_axis",
+           "reduce_scatter_in_axis"]
 
 
 class ReduceOp:
@@ -239,3 +242,82 @@ def barrier(group=None):
         mesh = env.get_mesh()
         if mesh is not None:
             all_reduce(Tensor(arr))
+
+
+def all_to_all(in_tensor_list, out_tensor_list=None, group=None,
+               sync_op=True):
+    """Reference name for alltoall (python/paddle/distributed/collective.py
+    exposes both)."""
+    return alltoall(in_tensor_list, out_tensor_list, group, sync_op)
+
+
+def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None,
+                   sync_op=True):
+    """Sum tensor_list across ranks, scatter one shard per rank. Eager
+    entry point; inside jitted steps this is lax.psum_scatter riding ICI
+    (reduce_scatter_in_axis)."""
+    if _degenerate():
+        summed = tensor_list[0]
+        for t in tensor_list[1:]:
+            summed = summed + t
+        tensor._data = summed._data if hasattr(summed, "_data") else summed
+        return tensor
+    raise NotImplementedError(
+        "multi-rank eager reduce_scatter: use reduce_scatter_in_axis inside "
+        "shard_map (the SPMD engine emits it for ZeRO grads)")
+
+
+class _CompletedTask:
+    def wait(self):
+        return True
+
+    def is_completed(self):
+        return True
+
+
+def isend(tensor, dst=0, group=None):
+    send(tensor, dst, group, sync_op=False)
+    return _CompletedTask()
+
+
+def irecv(tensor, src=0, group=None):
+    recv(tensor, src, group, sync_op=False)
+    return _CompletedTask()
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    """Stream-ordering wait (reference: c_wait_compute/c_wait_comm). XLA
+    orders collectives by data dependence; this blocks the host on the
+    value for the eager path."""
+    import jax
+    if hasattr(tensor, "_data"):
+        jax.block_until_ready(tensor._data)
+    return _CompletedTask()
+
+
+def get_backend(group=None) -> str:
+    """The one TPU backend: XLA collectives over ICI/DCN."""
+    return "XLA"
+
+
+def is_available() -> bool:
+    return True
+
+
+def destroy_process_group(group=None):
+    if group is None and env.is_initialized():
+        import jax
+        jax.distributed.shutdown()
+        env.reset()
+
+
+def all_gather_object(object_list, obj, group=None):
+    """Gather arbitrary picklable objects (reference contract). Single
+    process: identity; multi-host uses the coordination-service KV store."""
+    ws = env.get_world_size()
+    if ws <= 1:
+        object_list.append(obj)
+        return
+    raise NotImplementedError(
+        "cross-host object gather is served by the launcher's KV store; "
+        "gather arrays with all_gather instead")

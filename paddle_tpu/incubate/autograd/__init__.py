@@ -1,0 +1,48 @@
+"""``paddle.incubate.autograd`` — functional/prim autodiff API.
+
+Analog of the reference's python/paddle/incubate/autograd/primapi.py
+(forward/reverse primitive rules). On TPU the "primitive" layer IS jax's
+jvp/vjp machinery, so enable_prim is a mode flag kept for parity and the
+functional entry points delegate to the autograd facade.
+"""
+from __future__ import annotations
+
+__all__ = ["enable_prim", "disable_prim", "prim_enabled", "forward_grad",
+           "grad", "jvp", "vjp"]
+
+_prim = {"enabled": False}
+
+
+def enable_prim():
+    _prim["enabled"] = True
+
+
+def disable_prim():
+    _prim["enabled"] = False
+
+
+def prim_enabled() -> bool:
+    return _prim["enabled"]
+
+
+def jvp(func, xs, v=None):
+    from ...autograd import jvp as _jvp
+    return _jvp(func, xs, v)
+
+
+def vjp(func, xs, v=None):
+    from ...autograd import vjp as _vjp
+    return _vjp(func, xs, v)
+
+
+def forward_grad(outputs_fn, xs, v=None):
+    """Forward-mode derivative of ``outputs_fn`` at ``xs`` along ``v``
+    (reference primapi.forward_grad)."""
+    _, tangents = jvp(outputs_fn, xs, v)
+    return tangents
+
+
+def grad(outputs_fn, xs, v=None):
+    """Reverse-mode gradients (reference primapi.grad)."""
+    _, grads = vjp(outputs_fn, xs, v)
+    return grads

@@ -1,0 +1,38 @@
+"""``paddle.incubate.autotune`` (reference:
+python/paddle/incubate/autotune.py — kernel/layout/dataloader autotuning
+switches). TPU mapping: the kernel knob gates the measured Pallas dispatch
+tier, the dataloader knob tunes io prefetch depth; layout autotune is XLA's
+job and the knob is accepted for parity.
+"""
+from __future__ import annotations
+
+import json
+
+__all__ = ["set_config"]
+
+_CONFIG = {
+    "kernel": {"enable": True, "tuning_range": [1, 10]},
+    "layout": {"enable": False},
+    "dataloader": {"enable": False, "tuning_steps": 500},
+}
+
+
+def set_config(config=None):
+    """Accepts a dict or a path to a JSON file (reference contract)."""
+    from ..framework import flags as _flags
+
+    if config is None:
+        config = {}
+    if isinstance(config, str):
+        with open(config) as f:
+            config = json.load(f)
+    bad = [k for k in config if k not in _CONFIG]
+    if bad:
+        raise ValueError(f"unknown autotune domain(s) {bad} "
+                         f"(kernel/layout/dataloader)")
+    for key, val in config.items():
+        _CONFIG[key].update(val)
+    if "kernel" in config and "enable" in config["kernel"]:
+        _flags.set_flags(
+            {"FLAGS_use_pallas": bool(config["kernel"]["enable"])})
+    return dict(_CONFIG)

@@ -1,0 +1,136 @@
+"""Tests for incubate.asp / autotune / autograd prims and the extended
+collective API."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.incubate import asp
+
+
+def t(x):
+    return paddle.to_tensor(np.asarray(x))
+
+
+class TestASP:
+    def _model(self):
+        paddle.seed(0)
+        return nn.Sequential(nn.Linear(16, 32), nn.ReLU(),
+                             nn.Linear(32, 4))
+
+    def test_prune_produces_2_4_sparsity(self):
+        asp.reset_excluded_layers()
+        model = self._model()
+        masks = asp.prune_model(model)
+        assert masks, "no parameters pruned"
+        for p in model.parameters():
+            if p.name in masks:
+                assert asp.check_sparsity(p, 2, 4), p.name
+                assert abs(asp.calculate_density(p) - 0.5) < 0.05
+
+    def test_sparsity_survives_training(self):
+        asp.reset_excluded_layers()
+        model = self._model()
+        asp.prune_model(model)
+        opt = asp.decorate(paddle.optimizer.Adam(
+            learning_rate=0.01, parameters=model.parameters()))
+        rng = np.random.RandomState(0)
+        x = t(rng.randn(8, 16).astype(np.float32))
+        y = t(rng.randint(0, 4, (8,)))
+        for _ in range(3):
+            loss = F.cross_entropy(model(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        w = model[0].weight
+        assert asp.check_sparsity(w, 2, 4)
+        # pruned weights stayed exactly zero while others trained
+        assert asp.calculate_density(w) <= 0.5 + 1e-6
+
+    def test_excluded_layers(self):
+        asp.reset_excluded_layers()
+        model = self._model()
+        first_w = model[0].weight.name
+        asp.set_excluded_layers(param_names=[first_w])
+        masks = asp.prune_model(model)
+        assert first_w not in masks
+        asp.reset_excluded_layers()
+
+
+class TestAutotune:
+    def test_set_config_kernel_gate(self):
+        from paddle_tpu.framework.flags import flag_value
+        old = flag_value("FLAGS_use_pallas")
+        try:
+            cfg = paddle.incubate.autotune.set_config(
+                {"kernel": {"enable": False}})
+            assert flag_value("FLAGS_use_pallas") is False
+            assert cfg["kernel"]["enable"] is False
+        finally:
+            paddle.set_flags({"FLAGS_use_pallas": old})
+
+    def test_unknown_domain_raises(self):
+        with pytest.raises(ValueError):
+            paddle.incubate.autotune.set_config({"bogus": {}})
+
+
+class TestPrimAPI:
+    def test_forward_grad_matches_jvp(self):
+        from paddle_tpu.incubate import autograd as pag
+        x = t(np.array([1.0, 2.0], np.float32))
+        v = t(np.array([1.0, 0.0], np.float32))
+        tangent = pag.forward_grad(lambda a: a * a, x, v)
+        np.testing.assert_allclose(np.asarray(tangent._data), [2.0, 0.0],
+                                   rtol=1e-5)
+        pag.enable_prim()
+        assert pag.prim_enabled()
+        pag.disable_prim()
+
+
+class TestCollectiveExtras:
+    def test_single_process_semantics(self):
+        import paddle_tpu.distributed.collective as C
+        import paddle_tpu.distributed.env as env
+        old_mesh = env.get_mesh()
+        env.set_mesh(None)  # force the single-shard degenerate path
+        try:
+            self._run(C)
+        finally:
+            env.set_mesh(old_mesh)
+
+    def _run(self, C):
+        x = t(np.array([1.0, 2.0], np.float32))
+        ys = [t(np.array([3.0, 4.0], np.float32)),
+              t(np.array([5.0, 6.0], np.float32))]
+        out = C.reduce_scatter(x, ys)
+        np.testing.assert_allclose(np.asarray(out._data), [8.0, 10.0])
+        task = C.wait(x)
+        assert task.is_completed()
+        assert C.get_backend() == "XLA"
+        assert C.is_available()
+        objs = []
+        C.all_gather_object(objs, {"k": 1})
+        assert objs == [{"k": 1}]
+        task = C.isend(x, dst=0)
+        assert task.wait() and task.is_completed()
+
+    def test_minimize_keeps_sparsity(self):
+        from paddle_tpu.incubate import asp as _asp
+        _asp.reset_excluded_layers()
+        paddle.seed(1)
+        model = nn.Sequential(nn.Linear(16, 32), nn.ReLU(),
+                              nn.Linear(32, 4))
+        _asp.prune_model(model)
+        opt = _asp.decorate(paddle.optimizer.Adam(
+            learning_rate=0.05, parameters=model.parameters()))
+        rng = np.random.RandomState(0)
+        x = t(rng.randn(8, 16).astype(np.float32))
+        y = t(rng.randint(0, 4, (8,)))
+        loss = F.cross_entropy(model(x), y)
+        opt.minimize(loss)
+        assert _asp.check_sparsity(model[0].weight, 2, 4)
+
+    def test_all_to_all_alias(self):
+        import paddle_tpu.distributed.collective as C
+        assert C.all_to_all.__doc__ and "alltoall" in C.all_to_all.__doc__
