@@ -34,11 +34,14 @@ def _ring_attention_arrays(q, k, v, axis_name: str, axis_size: int,
     s = scale if scale is not None else 1.0 / (d ** 0.5)
     my = jax.lax.axis_index(axis_name)
 
-    qf = q.astype(jnp.float32) * s
+    # operands keep their storage dtype (bf16 -> native MXU rate); the
+    # f32 numerics live in the accumulators via preferred_element_type
+    qs = q * jnp.asarray(s, q.dtype)
     neg = jnp.asarray(-1e30, jnp.float32)
 
     def block(qf, kf, vf, q_off, k_off):
-        logits = jnp.einsum("bqhd,bkhd->bhqk", qf, kf.astype(jnp.float32))
+        logits = jnp.einsum("bqhd,bkhd->bhqk", qf, kf,
+                            preferred_element_type=jnp.float32)
         if causal:
             qi = q_off + jnp.arange(lq)[:, None]
             ki = k_off + jnp.arange(kf.shape[1])[None, :]
@@ -46,7 +49,8 @@ def _ring_attention_arrays(q, k, v, axis_name: str, axis_size: int,
         m = logits.max(-1)                                  # [b,h,q]
         p = jnp.exp(logits - m[..., None])
         l = p.sum(-1)                                       # [b,h,q]
-        o = jnp.einsum("bhqk,bkhd->bqhd", p, vf.astype(jnp.float32))
+        o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(vf.dtype), vf,
+                       preferred_element_type=jnp.float32)
         return m, l, o
 
     # online-softmax accumulation across ring steps
@@ -60,7 +64,7 @@ def _ring_attention_arrays(q, k, v, axis_name: str, axis_size: int,
     for step in range(axis_size):
         src = (my - step) % axis_size  # whose K/V we hold this step
         k_off = src * k.shape[1]
-        m_b, l_b, o_b = block(qf, k_cur, v_cur, q_off, k_off)
+        m_b, l_b, o_b = block(qs, k_cur, v_cur, q_off, k_off)
         m_new = jnp.maximum(m_acc, m_b)
         c_old = jnp.exp(m_acc - m_new)
         c_new = jnp.exp(m_b - m_new)

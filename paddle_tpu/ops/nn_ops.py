@@ -894,9 +894,11 @@ def _sdpa(q, k, v, mask=None, dropout_key=None, dropout_p=0.0,
     fusion region (Pallas flash-attention override registered separately)."""
     d = q.shape[-1]
     s = scale if scale is not None else 1.0 / (d ** 0.5)
-    qf = q.astype(jnp.float32)
-    kf = k.astype(jnp.float32)
-    logits = jnp.einsum("...qhd,...khd->...hqk", qf, kf) * s
+    # operands keep their storage dtype (bf16 -> native MXU rate);
+    # preferred_element_type makes the accumulator f32, which is all the
+    # numerics need — upcasting q/k first would force fp32-rate matmuls
+    logits = jnp.einsum("...qhd,...khd->...hqk", q, k,
+                        preferred_element_type=jnp.float32) * s
     if is_causal:
         ql, kl = logits.shape[-2], logits.shape[-1]
         cm = jnp.tril(jnp.ones((ql, kl), bool), k=kl - ql)
@@ -911,5 +913,6 @@ def _sdpa(q, k, v, mask=None, dropout_key=None, dropout_p=0.0,
         keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_p,
                                     probs.shape)
         probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0)
-    out = jnp.einsum("...hqk,...khd->...qhd", probs, v.astype(jnp.float32))
+    out = jnp.einsum("...hqk,...khd->...qhd", probs.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
     return out.astype(q.dtype)

@@ -121,10 +121,13 @@ def _fa_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
 
     @pl.when(needed)
     def _update():
-        q = q_ref[0].astype(jnp.float32)              # [bq, D]
+        # operands stay in their storage dtype (bf16 runs the MXU at native
+        # rate); preferred_element_type=f32 keeps the ACCUMULATION in f32 —
+        # upcasting operands first would force fp32-rate matmuls
+        q = q_ref[0]                                  # [bq, D]
         bq, d = q.shape
-        k_blk = k_ref[0].astype(jnp.float32)          # [bk, D]
-        v_blk = v_ref[0].astype(jnp.float32)
+        k_blk = k_ref[0]                              # [bk, D]
+        v_blk = v_ref[0]
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale    # [bq, bk]
@@ -143,7 +146,7 @@ def _fa_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         m_scr[...] = m_new
         l_scr[...] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
         acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())),
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(kj == n_k - 1)
@@ -172,13 +175,14 @@ def _fa_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     @pl.when(needed)
     def _update():
-        q = q_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        # native-dtype operands + f32 accumulation (see fwd kernel note)
+        q = q_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0][:, :1]                        # [bq, 1] of [bq, 8]
         delta = delta_ref[0][:, :1]
         bq, d = q.shape
-        k_blk = k_ref[0].astype(jnp.float32)
-        v_blk = v_ref[0].astype(jnp.float32)
+        k_blk = k_ref[0]
+        v_blk = v_ref[0]
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
@@ -194,7 +198,7 @@ def _fa_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * scale
         dq_scr[...] = dq_scr[...] + jax.lax.dot_general(
-            ds, k_blk, (((1,), (0,)), ((), ())),
+            ds.astype(k_blk.dtype), k_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(kj == n_k - 1)
@@ -223,11 +227,12 @@ def _fa_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(needed)
     def _update():
-        k = k_ref[0].astype(jnp.float32)              # [bk, D]
-        v = v_ref[0].astype(jnp.float32)
+        # native-dtype operands + f32 accumulation (see fwd kernel note)
+        k = k_ref[0]                                  # [bk, D]
+        v = v_ref[0]
         bk, d = k.shape
-        q_blk = q_ref[0].astype(jnp.float32)          # [bq, D]
-        do_blk = do_ref[0].astype(jnp.float32)
+        q_blk = q_ref[0]                              # [bq, D]
+        do_blk = do_ref[0]
         lse_blk = lse_ref[0][:, :1]                   # [bq, 1]
         delta_blk = delta_ref[0][:, :1]
         s = jax.lax.dot_general(
@@ -241,14 +246,14 @@ def _fa_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             s = jnp.where(rows >= cols, s, _NEG_INF)
         p = jnp.exp(s - lse_blk)
         dv_scr[...] = dv_scr[...] + jax.lax.dot_general(
-            p, do_blk, (((0,), (0,)), ((), ())),
+            p.astype(do_blk.dtype), do_blk, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(
             do_blk, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         ds = p * (dp - delta_blk) * scale
         dk_scr[...] = dk_scr[...] + jax.lax.dot_general(
-            ds, q_blk, (((0,), (0,)), ((), ())),
+            ds.astype(q_blk.dtype), q_blk, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(qj == n_q - 1)
@@ -359,7 +364,7 @@ def _fa_fwd_kernel_resident(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causa
     _I32_BQ = jnp.int32(block_q)
     _I32_BK = jnp.int32(block_k)
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)              # [bq, D]
+    q = q_ref[0]                                  # [bq, D] (native dtype)
     bq, d = q.shape
     nk_full = seq_k // block_k
     if causal:
@@ -373,8 +378,8 @@ def _fa_fwd_kernel_resident(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causa
         # running softmax stats stay 2D [bq, 1] (sublane-oriented);
         # rank-1 carries would force lane<->sublane relayouts in Mosaic
         m_prev, l_prev, acc = carry
-        k_blk = k_ref[0, pl.ds(j * _I32_BK, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(j * _I32_BK, block_k), :].astype(jnp.float32)
+        k_blk = k_ref[0, pl.ds(j * _I32_BK, block_k), :]
+        v_blk = v_ref[0, pl.ds(j * _I32_BK, block_k), :]
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale    # [bq, bk]
@@ -390,7 +395,7 @@ def _fa_fwd_kernel_resident(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causa
         alpha = jnp.exp(m_prev - m_new)
         l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
         acc = acc * alpha + jax.lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())),
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return m_new, l_new, acc
 
@@ -408,8 +413,8 @@ def _fa_dq_kernel_resident(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_r
     _I32_BQ = jnp.int32(block_q)
     _I32_BK = jnp.int32(block_k)
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)
+    q = q_ref[0]
+    do = do_ref[0]
     lse = lse_ref[0][:, :1]                        # [bq, 1] of [bq, 8]
     delta = delta_ref[0][:, :1]
     bq, d = q.shape
@@ -418,8 +423,8 @@ def _fa_dq_kernel_resident(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_r
                      block_k) if causal else nk_full
 
     def body(j, dq):
-        k_blk = k_ref[0, pl.ds(j * _I32_BK, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(j * _I32_BK, block_k), :].astype(jnp.float32)
+        k_blk = k_ref[0, pl.ds(j * _I32_BK, block_k), :]
+        v_blk = v_ref[0, pl.ds(j * _I32_BK, block_k), :]
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
@@ -435,7 +440,7 @@ def _fa_dq_kernel_resident(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_r
             preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * scale
         return dq + jax.lax.dot_general(
-            ds, k_blk, (((1,), (0,)), ((), ())),
+            ds.astype(k_blk.dtype), k_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     dq = jax.lax.fori_loop(0, nk, body, jnp.zeros((bq, d), jnp.float32))
@@ -448,17 +453,16 @@ def _fa_dkv_kernel_resident(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     _I32_BQ = jnp.int32(block_q)
     _I32_BK = jnp.int32(block_k)
     ki = pl.program_id(1)
-    k = k_ref[0].astype(jnp.float32)              # [bk, D]
-    v = v_ref[0].astype(jnp.float32)
+    k = k_ref[0]                                  # [bk, D] (native dtype)
+    v = v_ref[0]
     bk, d = k.shape
     nq_full = seq_q // block_q
     start_q = (ki * block_k) // block_q if causal else 0
 
     def body(j, carry):
         dk, dv = carry
-        q_blk = q_ref[0, pl.ds(j * _I32_BQ, block_q), :].astype(jnp.float32)
-        do_blk = do_ref[0, pl.ds(j * _I32_BQ, block_q), :].astype(
-            jnp.float32)
+        q_blk = q_ref[0, pl.ds(j * _I32_BQ, block_q), :]
+        do_blk = do_ref[0, pl.ds(j * _I32_BQ, block_q), :]
         lse_blk = lse_ref[0, pl.ds(j * _I32_BQ, block_q), :1]   # [bq, 1]
         delta_blk = delta_ref[0, pl.ds(j * _I32_BQ, block_q), :1]
         s = jax.lax.dot_general(
@@ -472,14 +476,14 @@ def _fa_dkv_kernel_resident(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             s = jnp.where(rows >= cols, s, _NEG_INF)
         p = jnp.exp(s - lse_blk)
         dv_new = dv + jax.lax.dot_general(
-            p, do_blk, (((0,), (0,)), ((), ())),
+            p.astype(do_blk.dtype), do_blk, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(
             do_blk, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         ds = p * (dp - delta_blk) * scale
         dk_new = dk + jax.lax.dot_general(
-            ds, q_blk, (((0,), (0,)), ((), ())),
+            ds.astype(q_blk.dtype), q_blk, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return dk_new, dv_new
 

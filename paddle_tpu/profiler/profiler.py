@@ -90,7 +90,13 @@ class _HostRecorder:
 
 
 _recorder = _HostRecorder()
+_recorder.native_active = False
 _current_step = [0]
+
+
+def _native():
+    from . import native as _native_mod
+    return _native_mod
 
 
 class RecordEvent:
@@ -105,10 +111,14 @@ class RecordEvent:
         self.name = name
         self._t0 = None
         self._ann = None
+        self._native_open = False
 
     def begin(self):
         self._t0 = time.perf_counter()
         if _recorder.enabled:
+            if _recorder.native_active:
+                _native().begin(self.name)
+                self._native_open = True
             try:
                 import jax
                 self._ann = jax.profiler.TraceAnnotation(self.name)
@@ -123,6 +133,12 @@ class RecordEvent:
         if self._ann is not None:
             self._ann.__exit__(None, None, None)
             self._ann = None
+        if self._native_open:
+            # paired per-instance: an end never pops a range it didn't open
+            # (scheduler transitions between begin and end can't desync the
+            # native stack)
+            _native().end()
+            self._native_open = False
         if _recorder.enabled:
             _recorder.add(_HostEvent(self.name, self._t0, t1,
                                      threading.get_ident(),
@@ -169,7 +185,18 @@ class Profiler:
 
     def __init__(self, *, targets: Optional[Iterable] = None,
                  scheduler=None, on_trace_ready=None,
-                 trace_dir: Optional[str] = None, timer_only: bool = False):
+                 trace_dir: Optional[str] = None, timer_only: bool = False,
+                 use_native: Optional[bool] = None):
+        # use_native: mirror host ranges into the C++ tpu_prof recorder
+        # (native/tpu_prof.cc, ~100ns/event). Resolved HERE — a first-use
+        # build (g++ subprocess) must happen at construction, never inside
+        # the profiled region.
+        if use_native is None:
+            use_native = _native().available()
+        elif use_native:
+            use_native = _native().available()
+        self._use_native = bool(use_native)
+        self._native_session = False
         if scheduler is None:
             self._scheduler = _default_state_scheduler
         elif callable(scheduler):
@@ -204,6 +231,8 @@ class Profiler:
     def stop(self):
         self._transition(self.current_state, ProfilerState.CLOSED)
         self.current_state = ProfilerState.CLOSED
+        if self._native_session:
+            _native().disable()
         if self._on_trace_ready is not None and _recorder.events:
             self._on_trace_ready(self)
 
@@ -227,6 +256,15 @@ class Profiler:
         now = new in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN)
         if now and not was:
             _recorder.enabled = True
+            if self._use_native:
+                if not self._native_session:
+                    # enable ONCE per profiler session so multi-cycle
+                    # schedulers accumulate in the native lane like the
+                    # python lane does; the python-side gates keep
+                    # CLOSED/READY phases out of it
+                    _native().enable()
+                    self._native_session = True
+                _recorder.native_active = True
             if self._device_trace and not self._timer_only and \
                     not self._device_active:
                 try:
@@ -237,6 +275,7 @@ class Profiler:
                     self._device_active = False
         elif was and not now:
             _recorder.enabled = False
+            _recorder.native_active = False
             if self._device_active:
                 try:
                     import jax
@@ -269,6 +308,10 @@ class Profiler:
                 "args": {"step": ev.step},
             })
         doc = {"traceEvents": events, "displayTimeUnit": "ms"}
+        if self._native_session and _native().count():
+            # merge the native recorder's (monotonic-clock) timeline as a
+            # separate pid lane
+            doc = _native().merge_into(doc)
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
         with open(path, "w") as f:
             json.dump(doc, f)

@@ -53,9 +53,11 @@ class TestProfiler:
         assert len(p.events) == 6
         path = p.export(str(tmp_path / "trace.json"))
         doc = load_profiler_result(path)
-        names = {e["name"] for e in doc["traceEvents"]}
+        names = {e["name"] for e in doc["traceEvents"]
+                 if e.get("ph") != "M"}  # skip metadata lane labels
         assert names == {"forward", "backward"}
-        assert all(e["dur"] > 0 for e in doc["traceEvents"])
+        assert all(e["dur"] > 0 for e in doc["traceEvents"]
+                   if e.get("ph") == "X")
         s = p.summary()
         assert "forward" in s and "backward" in s and "[step]" in s
 
@@ -123,3 +125,51 @@ class TestParallelModule:
                      "static", "jit", "vision", "distributed", "hapi",
                      "incubate", "models", "inference"):
             assert getattr(paddle, name) is not None
+
+
+class TestNativeRecorder:
+    def test_native_events_recorded_and_dumped(self, tmp_path):
+        from paddle_tpu.profiler import native as N
+        if not N.available():
+            import pytest
+            pytest.skip("no native toolchain")
+        N.enable(1000)
+        N.begin("outer")
+        N.begin("inner")
+        N.end()
+        N.end()
+        N.instant("marker")
+        N.disable()
+        assert N.count() == 3
+        out = str(tmp_path / "native_trace.json")
+        n = N.dump(out)
+        assert n == 3
+        import json
+        with open(out) as f:
+            doc = json.load(f)
+        names = sorted(e["name"] for e in doc["traceEvents"])
+        assert names == ["inner", "marker", "outer"]
+        durs = {e["name"]: e["dur"] for e in doc["traceEvents"]}
+        assert durs["outer"] >= durs["inner"] >= 0
+
+    def test_profiler_merges_native_lane(self, tmp_path):
+        import paddle_tpu.profiler as profiler
+        from paddle_tpu.profiler import native as N
+        if not N.available():
+            import pytest
+            pytest.skip("no native toolchain")
+        prof = profiler.Profiler(targets=[profiler.ProfilerTarget.CPU],
+                                 use_native=True)
+        prof.start()
+        with profiler.RecordEvent("native_merge_probe"):
+            pass
+        prof.stop()
+        out = str(tmp_path / "merged.json")
+        prof.export(out)
+        import json
+        with open(out) as f:
+            doc = json.load(f)
+        probes = [e for e in doc["traceEvents"]
+                  if e["name"] == "native_merge_probe"]
+        # one python-lane event + one native-lane event
+        assert len(probes) >= 2
