@@ -94,6 +94,10 @@ void tp_enable(uint64_t capacity) {
 
 void tp_disable() { g_enabled.store(false, std::memory_order_release); }
 
+// Re-arm recording WITHOUT clearing the buffer (profiler restart keeps
+// accumulating, matching the python recorder's session semantics).
+void tp_resume() { g_enabled.store(true, std::memory_order_release); }
+
 int tp_enabled() { return g_enabled.load(std::memory_order_acquire); }
 
 void tp_begin(const char* name) {

@@ -41,7 +41,7 @@ for _m in ("autograd", "optimizer", "amp", "io", "metric", "static", "jit",
            "incubate", "models", "utils", "inference", "distribution",
            "sparse", "text", "device", "quantization", "linalg", "fft",
            "signal", "regularizer", "sysconfig", "compat", "hub", "reader",
-           "dataset", "onnx", "callbacks", "cost_model"):
+           "dataset", "onnx", "callbacks", "cost_model", "version"):
     _mod = _import_if_built(_m)
     if _mod is not None:
         globals()[_m] = _mod
@@ -58,3 +58,26 @@ if _ilu.find_spec(f"{__name__}.framework.io") is not None:
     from .framework.io import load, save  # noqa: F401
 if _ilu.find_spec(f"{__name__}.batch") is not None:
     from .batch import batch  # noqa: F401
+if globals().get("autograd") is not None:
+    from .autograd import grad  # noqa: F401
+if globals().get("hapi") is not None:
+    from .hapi.model_summary import flops, summary  # noqa: F401
+from .framework.tensor import grad_enabled_guard as _geg  # noqa: E402
+
+
+class set_grad_enabled:
+    """Reference: paddle.set_grad_enabled — context manager setting grad
+    recording to ``mode`` unconditionally (True re-enables inside an
+    enclosing no_grad scope)."""
+
+    def __init__(self, mode: bool):
+        self._mode = mode
+        self._cm = None
+
+    def __enter__(self):
+        self._cm = _geg(self._mode)
+        self._cm.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        return self._cm.__exit__(*exc)

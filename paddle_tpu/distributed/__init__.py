@@ -15,6 +15,7 @@ from .collective import (  # noqa: F401
 from .env import (  # noqa: F401
     get_rank, get_world_size, init_parallel_env, is_initialized,
 )
+from .spawn import ParallelEnv, spawn  # noqa: F401
 from . import fleet  # noqa: F401
 from . import spmd  # noqa: F401
 from .fleet.meta_parallel.parallel_layers.mp_layers import (  # noqa: F401

@@ -40,6 +40,18 @@ def is_grad_enabled() -> bool:
 
 
 @contextlib.contextmanager
+def grad_enabled_guard(mode: bool):
+    """Set grad recording to ``mode`` unconditionally (True re-enables
+    inside an enclosing no_grad scope — reference set_grad_enabled)."""
+    old = getattr(_no_grad_state, "off", False)
+    _no_grad_state.off = not mode
+    try:
+        yield
+    finally:
+        _no_grad_state.off = old
+
+
+@contextlib.contextmanager
 def no_grad_guard():
     old = getattr(_no_grad_state, "off", False)
     _no_grad_state.off = True
@@ -71,7 +83,7 @@ class GradNode:
     """One recorded op on the tape (analog of a codegen'd GradNode)."""
 
     __slots__ = ("op_name", "vjp_fn", "inputs", "n_outputs", "out_treedef",
-                 "out_meta", "__weakref__")
+                 "out_meta", "out_hooks", "retained", "__weakref__")
 
     def __init__(self, op_name, vjp_fn, inputs, n_outputs, out_treedef,
                  out_meta):
@@ -81,6 +93,8 @@ class GradNode:
         self.n_outputs = n_outputs
         self.out_treedef = out_treedef
         self.out_meta = out_meta  # [(shape, dtype)] per flat output
+        self.out_hooks = None  # {out_idx: [fn]} — Tensor.register_hook
+        self.retained = None   # {out_idx: weakref(Tensor)} — retain_grads
 
 
 def _is_float_dtype(dt) -> bool:
@@ -185,9 +199,37 @@ class Tensor:
     # -- autograd -----------------------------------------------------------
     def retain_grads(self):
         self._retain_grads = True
+        if self._node is not None:
+            import weakref
+            if self._node.retained is None:
+                self._node.retained = {}
+            self._node.retained[self._out_idx] = weakref.ref(self)
 
     def backward(self, grad_tensor=None, retain_graph: bool = False):
         run_backward(self, grad_tensor, retain_graph)
+
+    def register_hook(self, hook):
+        """Reference: varbase register_hook — ``hook(grad) -> grad|None``
+        runs when this tensor's gradient is computed during backward,
+        on the FULLY-ACCUMULATED gradient (all consuming paths summed),
+        for leaves and non-leaves alike."""
+        if self.stop_gradient and self._node is None:
+            raise ValueError(
+                "register_hook on a tensor with stop_gradient=True")
+        if self._node is not None:
+            if self._node.out_hooks is None:
+                self._node.out_hooks = {}
+            hooks = self._node.out_hooks.setdefault(self._out_idx, [])
+        else:
+            hooks = self.__dict__.setdefault("_grad_hooks", [])
+        hooks.append(hook)
+
+        class _Remove:
+            def remove(self_inner):
+                if hook in hooks:
+                    hooks.remove(hook)
+
+        return _Remove()
 
     def clear_grad(self):
         self.grad = None
@@ -309,11 +351,30 @@ def run_backward(root: Tensor, grad_tensor=None, retain_graph=False):
     # cotangent accumulation per (node, out_idx)
     cots = {id(root._node): [None] * root._node.n_outputs}
     cots[id(root._node)][root._out_idx] = seed
+    leaf_acc = {}  # id(leaf) -> (leaf, summed grad) for hooked leaves
 
     for node in reversed(topo):
         pending = cots.pop(id(node), None)
         if pending is None or all(c is None for c in pending):
             continue
+        if node.out_hooks:
+            # user grad hooks on this node's outputs see the accumulated
+            # cotangent and may replace it (reference register_hook)
+            for i, hook_list in node.out_hooks.items():
+                if pending[i] is None:
+                    continue
+                for hook in hook_list:
+                    res = hook(Tensor(pending[i], stop_gradient=True))
+                    if res is not None:
+                        pending[i] = res._data if isinstance(res, Tensor) \
+                            else jnp.asarray(res)
+        if node.retained:
+            # retain_grads accumulation happens HERE, after hooks, on the
+            # final cotangent — consistent with what downstream receives
+            for i, tref in node.retained.items():
+                t = tref()
+                if t is not None and pending[i] is not None:
+                    _accum_grad(t, pending[i])
         if node.vjp_fn is None:
             raise PreconditionNotMetError(
                 f"grad graph for op {node.op_name!r} was already freed; "
@@ -340,10 +401,28 @@ def run_backward(root: Tensor, grad_tensor=None, retain_graph=False):
                 slot = cots.setdefault(id(t._node), [None] * t._node.n_outputs)
                 slot[t._out_idx] = g if slot[t._out_idx] is None \
                     else slot[t._out_idx] + g
-                if t._retain_grads:
-                    _accum_grad(t, g)
             elif not t.stop_gradient:
-                _accum_grad(t, g)
+                # leaves: accumulate per path; hooks run ONCE at the end on
+                # the summed gradient (reference semantics for multi-use
+                # leaves like tied embeddings)
+                if t.__dict__.get("_grad_hooks"):
+                    acc = leaf_acc.get(id(t))
+                    leaf_acc[id(t)] = (t, g if acc is None
+                                       else acc[1] + g)
+                else:
+                    _accum_grad(t, g)
+
+    _flush_hooked_leaves(leaf_acc)
+
+
+def _flush_hooked_leaves(leaf_acc):
+    for t, g in leaf_acc.values():
+        for hook in t.__dict__.get("_grad_hooks", ()):
+            res = hook(Tensor(g, stop_gradient=True))
+            if res is not None:
+                g = res._data if isinstance(res, Tensor) \
+                    else jnp.asarray(res)
+        _accum_grad(t, g)
 
 
 def _accum_grad(t: Tensor, g):

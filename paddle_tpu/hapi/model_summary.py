@@ -1,0 +1,134 @@
+"""``paddle.summary`` / ``paddle.flops`` (reference: python/paddle/hapi/
+model_summary.py, dynamic_flops.py) — per-layer output shapes + parameter
+counts via forward hooks, and a FLOP estimate via XLA cost analysis."""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["summary", "flops"]
+
+
+def _spec_to_input(input_size, dtypes):
+    import paddle_tpu as paddle
+    if isinstance(input_size, (list, tuple)) and input_size and \
+            isinstance(input_size[0], (list, tuple)):
+        sizes = list(input_size)
+    else:
+        sizes = [tuple(input_size)]
+    dtypes = dtypes or ["float32"] * len(sizes)
+    if isinstance(dtypes, str):
+        dtypes = [dtypes] * len(sizes)
+    outs = []
+    for shape, dt in zip(sizes, dtypes):
+        shape = tuple(1 if (s in (-1, None)) else int(s) for s in shape)
+        if str(dt).startswith("int"):
+            arr = np.zeros(shape, dtype=dt)
+        else:
+            arr = np.random.uniform(-1, 1, shape).astype(dt)
+        outs.append(paddle.to_tensor(arr))
+    return outs
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    """Layer-by-layer table: output shape + param count (reference:
+    hapi/model_summary.py summary)."""
+    import paddle_tpu as paddle
+
+    rows = []
+    handles = []
+
+    def make_hook(name, layer):
+        def hook(lyr, inputs, outputs):
+            out = outputs[0] if isinstance(outputs, (list, tuple)) \
+                else outputs
+            shape = list(getattr(out, "shape", []))
+            n_params = sum(
+                int(np.prod(p.shape))
+                for p in lyr._parameters.values() if p is not None) \
+                if hasattr(lyr, "_parameters") else 0
+            rows.append((name or type(lyr).__name__,
+                         type(lyr).__name__, shape, n_params))
+
+        return hook
+
+    for name, sub in net.named_sublayers():
+        # leaf layers only — container shapes repeat their children
+        if next(iter(sub.named_sublayers()), None) is None:
+            handles.append(sub.register_forward_post_hook(
+                make_hook(name, sub)))
+
+    was_training = net.training
+    net.eval()
+    try:
+        if input is not None:
+            args = input if isinstance(input, (list, tuple)) else [input]
+        else:
+            if input_size is None:
+                raise ValueError("summary needs input_size or input")
+            args = _spec_to_input(input_size, dtypes)
+        with paddle.no_grad():
+            net(*args)
+    finally:
+        for h in handles:
+            h.remove()
+        if was_training:
+            net.train()
+
+    total = sum(int(np.prod(p.shape)) for p in net.parameters())
+    trainable = sum(int(np.prod(p.shape)) for p in net.parameters()
+                    if not p.stop_gradient)
+    w_name = max([len(r[0]) + len(r[1]) + 3 for r in rows] + [20])
+    lines = ["-" * (w_name + 40),
+             f"{'Layer (type)':<{w_name}} {'Output Shape':<22} "
+             f"{'Param #':>12}",
+             "=" * (w_name + 40)]
+    for name, ltype, shape, n_params in rows:
+        lines.append(f"{name + ' (' + ltype + ')':<{w_name}} "
+                     f"{str(shape):<22} {n_params:>12,}")
+    lines += ["=" * (w_name + 40),
+              f"Total params: {total:,}",
+              f"Trainable params: {trainable:,}",
+              f"Non-trainable params: {total - trainable:,}",
+              "-" * (w_name + 40)]
+    print("\n".join(lines))
+    return {"total_params": total, "trainable_params": trainable}
+
+
+def flops(net, input_size=None, inputs=None, custom_ops=None,
+          print_detail: bool = False) -> int:
+    """FLOPs of one forward pass, measured by XLA's cost analysis over the
+    traced program (reference: hapi/dynamic_flops.py counts per-layer by
+    hand; the compiler already knows)."""
+    import jax
+
+    import paddle_tpu as paddle
+    from ..nn.layer.layers import functional_call, get_params_tree
+
+    if inputs is not None:
+        args = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    else:
+        if input_size is None:
+            raise ValueError("flops needs input_size or inputs")
+        args = _spec_to_input(input_size, None)
+    params = get_params_tree(net)
+    arrs = [a._data for a in args]
+
+    def fwd(p, *xs):
+        out, _ = functional_call(net, p, {},
+                                 *[paddle.Tensor(x) for x in xs])
+        first = out[0] if isinstance(out, (list, tuple)) else out
+        return first._data
+
+    try:
+        compiled = jax.jit(fwd).lower(params, *arrs).compile()
+        analysis = compiled.cost_analysis()
+        if isinstance(analysis, (list, tuple)):
+            analysis = analysis[0]
+        total = int(analysis.get("flops", -1))
+    except Exception:
+        total = -1
+    if print_detail:
+        print(f"Total Flops: {total}")
+    return total

@@ -65,6 +65,13 @@ def disable():
         lib.tp_disable()
 
 
+def resume():
+    """Re-arm recording without clearing accumulated events."""
+    lib = _load()
+    if lib is not None:
+        lib.tp_resume()
+
+
 def begin(name: str):
     lib = _load()
     if lib is not None:

@@ -191,10 +191,14 @@ class Profiler:
         # (native/tpu_prof.cc, ~100ns/event). Resolved HERE — a first-use
         # build (g++ subprocess) must happen at construction, never inside
         # the profiled region.
-        if use_native is None:
+        if use_native or use_native is None:
+            requested = bool(use_native)
             use_native = _native().available()
-        elif use_native:
-            use_native = _native().available()
+            if requested and not use_native:
+                import warnings
+                warnings.warn("use_native=True but the tpu_prof extension "
+                              "is unavailable; falling back to the python "
+                              "recorder")
         self._use_native = bool(use_native)
         self._native_session = False
         if scheduler is None:
@@ -258,12 +262,13 @@ class Profiler:
             _recorder.enabled = True
             if self._use_native:
                 if not self._native_session:
-                    # enable ONCE per profiler session so multi-cycle
-                    # schedulers accumulate in the native lane like the
-                    # python lane does; the python-side gates keep
-                    # CLOSED/READY phases out of it
+                    # first RECORD of this profiler: clear + arm; later
+                    # cycles AND restarts resume without clearing, so the
+                    # native lane accumulates like the python lane
                     _native().enable()
                     self._native_session = True
+                else:
+                    _native().resume()
                 _recorder.native_active = True
             if self._device_trace and not self._timer_only and \
                     not self._device_active:

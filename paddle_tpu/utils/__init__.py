@@ -13,7 +13,10 @@ import warnings
 from ..framework import monitor  # noqa: F401  (STAT counters)
 from . import unique_name  # noqa: F401
 
-__all__ = ["unique_name", "deprecated", "try_import", "monitor"]
+__all__ = ["unique_name", "deprecated", "try_import", "monitor",
+           "dlpack", "download"]
+from . import dlpack  # noqa: E402,F401
+from . import download  # noqa: E402,F401
 
 
 def deprecated(update_to: str = "", since: str = "", reason: str = ""):

@@ -1,0 +1,29 @@
+"""``paddle.utils.dlpack`` (reference: python/paddle/utils/dlpack.py) —
+zero-copy tensor exchange with other frameworks via the DLPack protocol,
+served by jax's dlpack support."""
+from __future__ import annotations
+
+from ..framework.tensor import Tensor
+
+__all__ = ["to_dlpack", "from_dlpack"]
+
+
+def to_dlpack(x):
+    """Tensor -> DLPack-exporting object (modern protocol: the returned
+    object carries ``__dlpack__``/``__dlpack_device__``; any consumer
+    framework's ``from_dlpack`` accepts it zero-copy)."""
+    import jax
+    return x._data if isinstance(x, Tensor) else jax.numpy.asarray(x)
+
+
+def from_dlpack(ext) -> Tensor:
+    """Object exporting ``__dlpack__`` (jax/torch/numpy array or a legacy
+    capsule) -> Tensor."""
+    import jax
+    if hasattr(ext, "__dlpack__"):
+        arr = jax.dlpack.from_dlpack(ext)
+    else:  # legacy PyCapsule path
+        import numpy as _np
+        arr = jax.numpy.asarray(_np.from_dlpack(ext)) \
+            if hasattr(_np, "from_dlpack") else jax.dlpack.from_dlpack(ext)
+    return Tensor(arr, stop_gradient=True)

@@ -1,0 +1,50 @@
+"""``paddle.utils.download`` (reference: python/paddle/utils/download.py).
+
+No network egress in this environment: resolution happens against the
+local weights cache; a missing file raises with placement instructions
+(mirrors the reference's behavior on a failed download, loudly).
+"""
+from __future__ import annotations
+
+import os
+import os.path as osp
+
+__all__ = ["get_weights_path_from_url", "get_path_from_url"]
+
+WEIGHTS_HOME = osp.expanduser(
+    os.environ.get("PADDLE_WEIGHTS_HOME", "~/.cache/paddle/hapi/weights"))
+
+
+def _resolve(url: str, root_dir: str, md5sum=None):
+    fname = osp.join(root_dir, url.split("/")[-1])
+    if osp.exists(fname):
+        if md5sum:
+            from ..dataset.common import md5file
+            if md5file(fname) != md5sum:
+                raise RuntimeError(f"{fname} exists but fails md5 check")
+        return fname
+    raise RuntimeError(
+        f"cannot download {url} (no network egress); place the file at "
+        f"{fname}")
+
+
+def get_weights_path_from_url(url: str, md5sum=None) -> str:
+    return _resolve(url, WEIGHTS_HOME, md5sum)
+
+
+def get_path_from_url(url: str, root_dir: str, md5sum=None,
+                      check_exist: bool = True, decompress: bool = True,
+                      method: str = "get") -> str:
+    path = _resolve(url, root_dir, md5sum)
+    if decompress and (path.endswith(".tar.gz") or path.endswith(".tgz")
+                       or path.endswith(".zip")):
+        import tarfile
+        import zipfile
+        dst = osp.dirname(path)
+        if path.endswith(".zip"):
+            with zipfile.ZipFile(path) as z:
+                z.extractall(dst)
+        else:
+            with tarfile.open(path) as t:
+                t.extractall(dst)
+    return path

@@ -1,0 +1,32 @@
+"""``paddle.version`` (reference: generated python/paddle/version.py)."""
+
+full_version = "2.3.0+tpu"
+major = "2"
+minor = "3"
+patch = "0"
+rc = "0"
+istaged = True
+commit = "tpu-native"
+with_mkl = "OFF"
+
+cuda_version = "False"
+cudnn_version = "False"
+xpu_version = "False"
+
+
+def show():
+    print(f"full_version: {full_version}")
+    print(f"commit: {commit}")
+    print("tpu: True (jax/XLA backend)")
+
+
+def cuda():
+    return cuda_version
+
+
+def cudnn():
+    return cudnn_version
+
+
+def xpu():
+    return xpu_version

@@ -1,0 +1,11 @@
+"""Module-level target for distributed.spawn tests (must be picklable)."""
+import os
+
+
+def write_rank_file(tmpdir):
+    import paddle_tpu.distributed as dist
+
+    pe = dist.ParallelEnv()
+    path = os.path.join(tmpdir, f"rank_{pe.rank}.txt")
+    with open(path, "w") as f:
+        f.write(f"{pe.rank}/{pe.world_size}")

@@ -1,0 +1,181 @@
+"""Tests for the small-parity batch: register_hook, spawn/ParallelEnv,
+summary/flops, dlpack, version, set_grad_enabled."""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+class TestRegisterHook:
+    def test_nonleaf_hook_scales_grad(self):
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32),
+                             stop_gradient=False)
+        y = x * 3.0
+        y.register_hook(lambda g: g * 10.0)
+        paddle.sum(y).backward()
+        np.testing.assert_allclose(np.asarray(x.grad._data), [30.0, 30.0])
+
+    def test_leaf_hook(self):
+        x = paddle.to_tensor(np.array([1.0], np.float32),
+                             stop_gradient=False)
+        x.register_hook(lambda g: g + 5.0)
+        (x * 2.0).backward()
+        np.testing.assert_allclose(np.asarray(x.grad._data), [7.0])
+
+    def test_hook_remove(self):
+        x = paddle.to_tensor(np.array([1.0], np.float32),
+                             stop_gradient=False)
+        h = x.register_hook(lambda g: g * 100.0)
+        h.remove()
+        (x * 2.0).backward()
+        np.testing.assert_allclose(np.asarray(x.grad._data), [2.0])
+
+    def test_hook_observes_without_modifying(self):
+        seen = []
+        x = paddle.to_tensor(np.array([4.0], np.float32),
+                             stop_gradient=False)
+        y = x * 2.0
+        y.register_hook(lambda g: seen.append(float(g)))
+        paddle.sum(y).backward()
+        assert seen == [1.0]
+        np.testing.assert_allclose(np.asarray(x.grad._data), [2.0])
+
+    def test_hook_on_stopped_tensor_raises(self):
+        x = paddle.to_tensor(np.array([1.0], np.float32))
+        with pytest.raises(ValueError):
+            x.register_hook(lambda g: g)
+
+
+class TestSpawn:
+    def test_two_process_spawn(self, tmp_path):
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        try:
+            from _spawn_target import write_rank_file
+            os.environ["PADDLE_SPAWN_CPU"] = "1"
+            paddle.distributed.spawn(write_rank_file,
+                                     args=(str(tmp_path),), nprocs=2)
+        finally:
+            sys.path.pop(0)
+            os.environ.pop("PADDLE_SPAWN_CPU", None)
+        r0 = (tmp_path / "rank_0.txt").read_text()
+        r1 = (tmp_path / "rank_1.txt").read_text()
+        assert r0 == "0/2" and r1 == "1/2"
+
+    def test_parallel_env_defaults(self):
+        pe = paddle.distributed.ParallelEnv()
+        assert pe.rank == 0 and pe.world_size == 1
+        assert pe.nranks == 1 and pe.local_rank == 0
+
+
+class TestSummaryFlops:
+    def test_summary_counts(self, capsys):
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        info = paddle.summary(net, (1, 8))
+        assert info["total_params"] == 8 * 16 + 16 + 16 * 4 + 4
+        out = capsys.readouterr().out
+        assert "Linear" in out and "Total params" in out
+
+    def test_flops_positive(self):
+        net = nn.Sequential(nn.Linear(32, 32))
+        f = paddle.flops(net, (1, 32))
+        # XLA cost analysis may be unavailable (-1); when present, a 32x32
+        # matmul forward is ~2*32*32 flops
+        assert f == -1 or f >= 2 * 32 * 32
+
+    def test_summary_restores_training_mode(self):
+        net = nn.Sequential(nn.Linear(4, 4), nn.Dropout(0.5))
+        net.train()
+        paddle.summary(net, (1, 4))
+        assert net.training
+
+
+class TestDlpackVersion:
+    def test_dlpack_roundtrip(self):
+        x = paddle.to_tensor(np.arange(6, dtype=np.float32))
+        obj = paddle.utils.dlpack.to_dlpack(x)
+        y = paddle.utils.dlpack.from_dlpack(obj)
+        np.testing.assert_array_equal(np.asarray(y._data),
+                                      np.arange(6, dtype=np.float32))
+
+    def test_dlpack_torch_interop(self):
+        torch = pytest.importorskip("torch")
+        t = torch.arange(4, dtype=torch.float32)
+        y = paddle.utils.dlpack.from_dlpack(t)
+        np.testing.assert_array_equal(np.asarray(y._data), [0, 1, 2, 3])
+
+    def test_version(self):
+        assert paddle.version.full_version
+        assert paddle.version.cuda() == "False"
+
+    def test_set_grad_enabled(self):
+        x = paddle.to_tensor(np.ones(2, np.float32), stop_gradient=False)
+        with paddle.set_grad_enabled(False):
+            y = x * 2
+        assert y._node is None
+        with paddle.set_grad_enabled(True):
+            z = x * 2
+        assert z._node is not None
+
+    def test_download_gated(self):
+        with pytest.raises(RuntimeError, match="egress"):
+            paddle.utils.download.get_weights_path_from_url(
+                "http://example.com/w.pdparams")
+
+
+class TestReviewFixes2:
+    def test_leaf_hook_once_on_accumulated_grad(self):
+        x = paddle.to_tensor(np.array([1.0], np.float32),
+                             stop_gradient=False)
+        x.register_hook(lambda g: g + 10.0)
+        (x * 2.0 + x * 3.0).backward()
+        # hook sees the SUM (5), once: 15 — not per-path (25)
+        np.testing.assert_allclose(np.asarray(x.grad._data), [15.0])
+
+    def test_retained_nonleaf_grad_sees_hook(self):
+        x = paddle.to_tensor(np.array([1.0, 1.0], np.float32),
+                             stop_gradient=False)
+        y = x * 2.0
+        y.retain_grads()
+        y.register_hook(lambda g: g * 100.0)
+        paddle.sum(y).backward()
+        np.testing.assert_allclose(np.asarray(y.grad._data), [100.0, 100.0])
+        np.testing.assert_allclose(np.asarray(x.grad._data), [200.0, 200.0])
+
+    def test_set_grad_enabled_true_inside_no_grad(self):
+        x = paddle.to_tensor(np.ones(2, np.float32), stop_gradient=False)
+        with paddle.no_grad():
+            with paddle.set_grad_enabled(True):
+                y = x * 2
+        assert y._node is not None
+
+    def test_parallel_env_device_list(self, monkeypatch):
+        monkeypatch.setenv("FLAGS_selected_gpus", "2,3")
+        pe = paddle.distributed.ParallelEnv()
+        assert pe.device_id == 2
+
+    def test_profiler_restart_keeps_native_lane(self, tmp_path):
+        import json
+        import paddle_tpu.profiler as profiler
+        from paddle_tpu.profiler import native as N
+        if not N.available():
+            pytest.skip("no native toolchain")
+        prof = profiler.Profiler(targets=[profiler.ProfilerTarget.CPU],
+                                 use_native=True)
+        prof.start()
+        with profiler.RecordEvent("first_sess"):
+            pass
+        prof.stop()
+        prof.start()
+        with profiler.RecordEvent("second_sess"):
+            pass
+        prof.stop()
+        path = prof.export(str(tmp_path / "restart.json"))
+        doc = json.load(open(path))
+        native_pid = os.getpid() + 1
+        native_names = {e["name"] for e in doc["traceEvents"]
+                        if e.get("pid") == native_pid and e.get("ph") == "X"}
+        assert {"first_sess", "second_sess"} <= native_names
