@@ -11,8 +11,9 @@ from __future__ import annotations
 
 import multiprocessing as mp
 import os
-import socket
 from typing import Optional
+
+from .launch import _free_port
 
 __all__ = ["spawn", "ParallelEnv"]
 
@@ -60,20 +61,7 @@ class ParallelEnv:
         return self._trainer_endpoints
 
 
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
-
-
-def _worker(func, args, rank, nprocs, coordinator, backend):
-    os.environ["PADDLE_TRAINER_ID"] = str(rank)
-    os.environ["PADDLE_TRAINERS_NUM"] = str(nprocs)
-    os.environ["PADDLE_MASTER"] = coordinator
-    os.environ["PADDLE_CURRENT_ENDPOINT"] = coordinator
-    if backend == "cpu" or os.environ.get("PADDLE_SPAWN_CPU") == "1":
-        os.environ.setdefault("JAX_PLATFORMS", "cpu")
-        os.environ.setdefault("PALLAS_AXON_POOL_IPS", "")
+def _worker(func, args):
     func(*args)
 
 
@@ -86,15 +74,40 @@ def spawn(func, args=(), nprocs: Optional[int] = None, join: bool = True,
         nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
     coordinator = options.get(
         "master", f"127.0.0.1:{_free_port()}")
+    endpoints = ",".join(f"127.0.0.1:rank{r}" for r in range(nprocs))
     ctx = mp.get_context("spawn")
     procs = []
-    for rank in range(nprocs):
-        p = ctx.Process(target=_worker,
-                        args=(func, args, rank, nprocs, coordinator,
-                              backend),
-                        daemon=daemon)
-        p.start()
-        procs.append(p)
+    # env is set in the PARENT around each start(): spawn children inherit
+    # it before unpickling, so modules that initialize jax at import time
+    # (the normal `import paddle_tpu` pattern) see the right platform and
+    # rank — setting env inside the worker would be too late
+    saved = {k: os.environ.get(k) for k in
+             ("PADDLE_TRAINER_ID", "PADDLE_TRAINERS_NUM", "PADDLE_MASTER",
+              "PADDLE_CURRENT_ENDPOINT", "PADDLE_TRAINER_ENDPOINTS",
+              "FLAGS_selected_tpus", "JAX_PLATFORMS",
+              "PALLAS_AXON_POOL_IPS")}
+    try:
+        for rank in range(nprocs):
+            os.environ["PADDLE_TRAINER_ID"] = str(rank)
+            os.environ["PADDLE_TRAINERS_NUM"] = str(nprocs)
+            os.environ["PADDLE_MASTER"] = coordinator
+            os.environ["PADDLE_CURRENT_ENDPOINT"] = coordinator
+            os.environ["PADDLE_TRAINER_ENDPOINTS"] = endpoints
+            os.environ["FLAGS_selected_tpus"] = str(rank)
+            if backend == "cpu" or \
+                    os.environ.get("PADDLE_SPAWN_CPU") == "1":
+                os.environ["JAX_PLATFORMS"] = "cpu"
+                os.environ["PALLAS_AXON_POOL_IPS"] = ""
+            p = ctx.Process(target=_worker, args=(func, args),
+                            daemon=daemon)
+            p.start()
+            procs.append(p)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
     if not join:
         return procs
     failed = []

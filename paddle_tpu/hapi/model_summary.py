@@ -53,7 +53,12 @@ def summary(net, input_size=None, dtypes=None, input=None):
 
         return hook
 
-    for name, sub in net.named_sublayers():
+    subs = list(net.named_sublayers())
+    if not subs:
+        # the model is itself a leaf layer: report it directly
+        handles.append(net.register_forward_post_hook(
+            make_hook(type(net).__name__, net)))
+    for name, sub in subs:
         # leaf layers only — container shapes repeat their children
         if next(iter(sub.named_sublayers()), None) is None:
             handles.append(sub.register_forward_post_hook(

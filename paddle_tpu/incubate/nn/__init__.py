@@ -13,10 +13,11 @@ the bias/residual/dropout epilogues the CUDA kernels fuse by hand. The
 classes exist for API parity and for the pre/post-LN + residual wiring
 the reference bakes into its fused ops.
 """
+from . import functional  # noqa: F401
 from .layer.fused_transformer import (  # noqa: F401
     FusedFeedForward, FusedMultiHeadAttention,
     FusedTransformerEncoderLayer,
 )
 
 __all__ = ["FusedMultiHeadAttention", "FusedFeedForward",
-           "FusedTransformerEncoderLayer"]
+           "FusedTransformerEncoderLayer", "functional"]

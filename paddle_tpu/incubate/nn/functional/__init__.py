@@ -1,0 +1,122 @@
+"""``paddle.incubate.nn.functional`` — functional forms of the fused ops.
+
+Analog of the reference's python/paddle/incubate/nn/functional/
+(fused_transformer.py, fused_matmul_bias.py). On TPU "fused" means one XLA
+fusion region (+ Pallas flash attention / fused LN where registered): the
+functional forms below compose the same primitives the fused layers use,
+weights passed explicitly.
+"""
+from __future__ import annotations
+
+from ....framework.dispatch import call_op as _op
+from ....framework import random as _random
+from ....nn import functional as F
+
+__all__ = ["fused_matmul_bias", "fused_linear",
+           "fused_bias_dropout_residual_layer_norm",
+           "fused_multi_head_attention", "fused_feedforward"]
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False,
+                      name=None):
+    """Reference: fused_matmul_bias.py — matmul + bias epilogue (cublasLt
+    there, one XLA fusion here)."""
+    out = _op("matmul", x, y, transpose_x=transpose_x,
+              transpose_y=transpose_y)
+    if bias is not None:
+        out = _op("add", out, bias)
+    return out
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    return fused_matmul_bias(x, weight, bias,
+                             transpose_y=transpose_weight)
+
+
+def fused_bias_dropout_residual_layer_norm(
+        x, residual, bias=None, ln_scale=None, ln_bias=None,
+        dropout_rate=0.5, ln_epsilon=1e-5, training=True,
+        mode="upscale_in_train", name=None):
+    """Reference: fused_transformer.py:225 — out = LN(residual +
+    dropout(x + bias))."""
+    if bias is not None:
+        x = _op("add", x, bias)
+    if dropout_rate and training:
+        x = F.dropout(x, p=dropout_rate, training=True, mode=mode)
+    y = _op("add", residual, x)
+    return _op("layer_norm", y, ln_scale, ln_bias, epsilon=ln_epsilon,
+               begin_norm_axis=len(y.shape) - 1)
+
+
+def fused_multi_head_attention(
+        x, qkv_weight, linear_weight, pre_layer_norm=False,
+        pre_ln_scale=None, pre_ln_bias=None, ln_scale=None, ln_bias=None,
+        pre_ln_epsilon=1e-5, qkv_bias=None, linear_bias=None,
+        cache_kv=None, attn_mask=None, dropout_rate=0.5,
+        attn_dropout_rate=0.5, ln_epsilon=1e-5, training=True,
+        mode="upscale_in_train", ring_id=-1, add_residual=True, name=None):
+    """Reference: fused_transformer.py:371 (fused_attention_op.cu).
+    qkv_weight: [3, H, Dh, D]; linear_weight: [D, D]."""
+    if cache_kv is not None:
+        raise NotImplementedError(
+            "cache_kv (incremental decode) is not supported by the fused "
+            "attention path; use nn.MultiHeadAttention with its cache")
+    b, s, d = x.shape
+    n_heads = qkv_weight.shape[1]
+    head_dim = qkv_weight.shape[2]
+    residual = x
+    if pre_layer_norm:
+        x = _op("layer_norm", x, pre_ln_scale, pre_ln_bias,
+                epsilon=pre_ln_epsilon, begin_norm_axis=len(x.shape) - 1)
+    w = _op("reshape", qkv_weight, shape=(3 * n_heads * head_dim, d))
+    qkv = _op("matmul", x, w, transpose_y=True)        # [B, S, 3HDh]
+    if qkv_bias is not None:
+        qkv = _op("add", qkv,
+                  _op("reshape", qkv_bias, shape=(3 * n_heads * head_dim,)))
+    qkv = _op("reshape", qkv, shape=(b, s, 3, n_heads, head_dim))
+    q = qkv[:, :, 0]
+    k = qkv[:, :, 1]
+    v = qkv[:, :, 2]
+    out = F.scaled_dot_product_attention(
+        q, k, v, attn_mask=attn_mask,
+        dropout_p=attn_dropout_rate if training else 0.0,
+        training=training)
+    out = _op("reshape", out, shape=(b, s, n_heads * head_dim))
+    out = _op("matmul", out, linear_weight)
+    if linear_bias is not None:
+        out = _op("add", out, linear_bias)
+    if dropout_rate and training:
+        out = F.dropout(out, p=dropout_rate, training=True, mode=mode)
+    if add_residual:
+        out = _op("add", residual, out)
+    if not pre_layer_norm:
+        out = _op("layer_norm", out, ln_scale, ln_bias, epsilon=ln_epsilon,
+                  begin_norm_axis=len(out.shape) - 1)
+    return out
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu",
+                      ln1_epsilon=1e-5, ln2_epsilon=1e-5,
+                      pre_layer_norm=False, training=True,
+                      mode="upscale_in_train", ring_id=-1, name=None):
+    """Reference: fused_transformer.py:31 (fused_feedforward_op.cu):
+    residual + dropout2(linear2(dropout1(act(linear1(LN(x))))))."""
+    residual = x
+    if pre_layer_norm:
+        x = _op("layer_norm", x, ln1_scale, ln1_bias, epsilon=ln1_epsilon,
+                begin_norm_axis=len(x.shape) - 1)
+    h = fused_matmul_bias(x, linear1_weight, linear1_bias)
+    h = getattr(F, activation)(h)
+    if dropout1_rate and training:
+        h = F.dropout(h, p=dropout1_rate, training=True, mode=mode)
+    h = fused_matmul_bias(h, linear2_weight, linear2_bias)
+    if dropout2_rate and training:
+        h = F.dropout(h, p=dropout2_rate, training=True, mode=mode)
+    out = _op("add", residual, h)
+    if not pre_layer_norm:
+        out = _op("layer_norm", out, ln2_scale, ln2_bias,
+                  epsilon=ln2_epsilon, begin_norm_axis=len(out.shape) - 1)
+    return out

@@ -18,6 +18,7 @@ from ...framework import random as _random
 from ...framework.dtypes import convert_dtype
 
 __all__ = [
+    "Bilinear", "set_global_initializer",
     "Initializer", "Constant", "Uniform", "Normal", "TruncatedNormal",
     "XavierNormal", "XavierUniform", "KaimingNormal", "KaimingUniform",
     "Assign", "Orthogonal", "Dirac", "calculate_gain",
@@ -206,3 +207,40 @@ class Dirac(Initializer):
             for i in range(min(per_group, in_c)):
                 w[(g * per_group + i, i) + centre] = 1.0
         return jnp.asarray(w, dtype=convert_dtype(dtype))
+
+
+class Bilinear(Initializer):
+    """Bilinear-upsampling kernel init for transposed convs (reference:
+    nn/initializer/Bilinear — fluid/initializer.py BilinearInitializer)."""
+
+    def __call__(self, shape, dtype):
+        shape = tuple(int(s) for s in shape)
+        if len(shape) != 4:
+            raise ValueError("Bilinear initializer needs a 4-D weight")
+        kh, kw = shape[2], shape[3]
+        f_h = (kh + 1) // 2
+        f_w = (kw + 1) // 2
+        c_h = (2 * f_h - 1 - f_h % 2) / (2.0 * f_h)
+        c_w = (2 * f_w - 1 - f_w % 2) / (2.0 * f_w)
+        og = np.ogrid[:kh, :kw]
+        filt = (1 - abs(og[0] / f_h - c_h)) * (1 - abs(og[1] / f_w - c_w))
+        w = np.zeros(shape, np.float32)
+        for i in range(shape[0]):
+            for j in range(shape[1]):
+                w[i, j] = filt
+        return jnp.asarray(w, dtype=convert_dtype(dtype))
+
+
+_GLOBAL_INIT = {"weight": None, "bias": None}
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """Reference: nn/initializer/set_global_initializer — default
+    initializers used by create_parameter when neither the ParamAttr nor
+    the layer specifies one. Pass (None, None) to reset."""
+    _GLOBAL_INIT["weight"] = weight_init
+    _GLOBAL_INIT["bias"] = bias_init
+
+
+def _global_initializer(is_bias: bool):
+    return _GLOBAL_INIT["bias" if is_bias else "weight"]

@@ -143,12 +143,16 @@ class Layer:
 
     def create_parameter(self, shape, attr=None, dtype=None,
                          is_bias=False, default_initializer=None):
-        from ..initializer import Constant, XavierUniform
+        from ..initializer import Constant, XavierUniform, \
+            _global_initializer
         attr = ParamAttr._to_attr(attr)
         if attr is False:
             return None
         dtype = convert_dtype(dtype) if dtype else self._dtype
-        init = attr.initializer or default_initializer or \
+        # priority (reference set_global_initializer contract): explicit
+        # ParamAttr > global default > the layer's built-in default
+        init = attr.initializer or _global_initializer(is_bias) or \
+            default_initializer or \
             (Constant(0.0) if is_bias else XavierUniform())
         data = init(tuple(shape), dtype)
         p = Parameter(data, name=attr.name, trainable=attr.trainable)

@@ -36,15 +36,19 @@ def get_path_from_url(url: str, root_dir: str, md5sum=None,
                       check_exist: bool = True, decompress: bool = True,
                       method: str = "get") -> str:
     path = _resolve(url, root_dir, md5sum)
-    if decompress and (path.endswith(".tar.gz") or path.endswith(".tgz")
-                       or path.endswith(".zip")):
-        import tarfile
-        import zipfile
-        dst = osp.dirname(path)
-        if path.endswith(".zip"):
-            with zipfile.ZipFile(path) as z:
-                z.extractall(dst)
-        else:
-            with tarfile.open(path) as t:
-                t.extractall(dst)
+    for suffix in (".tar.gz", ".tgz", ".zip"):
+        if decompress and path.endswith(suffix):
+            extracted = path[: -len(suffix)]
+            if check_exist and osp.exists(extracted):
+                return extracted  # already extracted: don't clobber
+            import tarfile
+            import zipfile
+            dst = osp.dirname(path)
+            if suffix == ".zip":
+                with zipfile.ZipFile(path) as z:
+                    z.extractall(dst)
+            else:
+                with tarfile.open(path) as t:
+                    t.extractall(dst)
+            return extracted if osp.exists(extracted) else path
     return path

@@ -134,3 +134,72 @@ class TestCollectiveExtras:
     def test_all_to_all_alias(self):
         import paddle_tpu.distributed.collective as C
         assert C.all_to_all.__doc__ and "alltoall" in C.all_to_all.__doc__
+
+
+class TestFusedFunctional:
+    def test_fused_linear_matches_linear(self):
+        import paddle_tpu.incubate.nn.functional as FF
+        rng = np.random.RandomState(40)
+        x = t(rng.randn(4, 8).astype(np.float32))
+        w = t(rng.randn(8, 16).astype(np.float32))
+        b = t(rng.randn(16).astype(np.float32))
+        out = FF.fused_linear(x, w, b)
+        ref = F.linear(x, w, b)
+        np.testing.assert_allclose(np.asarray(out._data),
+                                   np.asarray(ref._data), rtol=1e-5)
+
+    def test_fused_bias_dropout_residual_ln(self):
+        import paddle_tpu.incubate.nn.functional as FF
+        rng = np.random.RandomState(41)
+        x = t(rng.randn(2, 4, 8).astype(np.float32))
+        res = t(rng.randn(2, 4, 8).astype(np.float32))
+        scale = t(np.ones(8, np.float32))
+        bias = t(np.zeros(8, np.float32))
+        out = FF.fused_bias_dropout_residual_layer_norm(
+            x, res, ln_scale=scale, ln_bias=bias, dropout_rate=0.0)
+        ref = F.layer_norm(res + x, [8], scale, bias)
+        np.testing.assert_allclose(np.asarray(out._data),
+                                   np.asarray(ref._data), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_fused_mha_matches_unfused(self):
+        import paddle_tpu.incubate.nn.functional as FF
+        rng = np.random.RandomState(42)
+        b, s, h, dh = 2, 6, 2, 4
+        d = h * dh
+        x = t(rng.randn(b, s, d).astype(np.float32))
+        qkv_w = rng.randn(3, h, dh, d).astype(np.float32)
+        lin_w = rng.randn(d, d).astype(np.float32)
+        scale = t(np.ones(d, np.float32))
+        bias0 = t(np.zeros(d, np.float32))
+        out = FF.fused_multi_head_attention(
+            x, t(qkv_w), t(lin_w), ln_scale=scale, ln_bias=bias0,
+            dropout_rate=0.0, attn_dropout_rate=0.0, training=False)
+        # manual: qkv proj -> sdpa -> out proj -> residual -> LN
+        w2 = qkv_w.reshape(3 * d, d)
+        qkv = np.asarray(x._data) @ w2.T
+        qkv = qkv.reshape(b, s, 3, h, dh)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        att = np.asarray(F.scaled_dot_product_attention(
+            t(q), t(k), t(v))._data).reshape(b, s, d)
+        manual = np.asarray(x._data) + att @ lin_w
+        ref = np.asarray(F.layer_norm(t(manual), [d], scale, bias0)._data)
+        np.testing.assert_allclose(np.asarray(out._data), ref, rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_fused_feedforward_matches_unfused(self):
+        import paddle_tpu.incubate.nn.functional as FF
+        rng = np.random.RandomState(43)
+        x = t(rng.randn(2, 4, 8).astype(np.float32))
+        w1 = t(rng.randn(8, 32).astype(np.float32))
+        w2 = t(rng.randn(32, 8).astype(np.float32))
+        scale = t(np.ones(8, np.float32))
+        zb = t(np.zeros(8, np.float32))
+        out = FF.fused_feedforward(x, w1, w2, dropout1_rate=0.0,
+                                   dropout2_rate=0.0, ln2_scale=scale,
+                                   ln2_bias=zb, training=False)
+        h = F.relu(paddle.matmul(x, w1))
+        ref = F.layer_norm(x + paddle.matmul(h, w2), [8], scale, zb)
+        np.testing.assert_allclose(np.asarray(out._data),
+                                   np.asarray(ref._data), rtol=1e-4,
+                                   atol=1e-4)

@@ -267,3 +267,30 @@ class TestFusedTransformerLayers:
         h = F.relu(ffn.linear1(x))
         ref = ffn.norm(x + ffn.linear2(h))
         np.testing.assert_allclose(out.numpy(), ref.numpy(), atol=1e-5)
+
+
+class TestInitializerExtras:
+    def test_bilinear_kernel(self):
+        import numpy as np
+        from paddle_tpu.nn.initializer import Bilinear
+        w = np.asarray(Bilinear()((2, 2, 4, 4), "float32"))
+        # symmetric partition-of-unity filter per (out, in) pair
+        assert np.allclose(w[0, 0], w[0, 0].T)
+        assert abs(w[0, 0].sum() - 4.0) < 1e-4
+
+    def test_set_global_initializer(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+        from paddle_tpu.nn.initializer import (Constant,
+                                               set_global_initializer)
+        set_global_initializer(Constant(0.5), Constant(0.1))
+        try:
+            lin = nn.Linear(3, 3)
+            assert np.allclose(np.asarray(lin.weight._data), 0.5)
+            assert np.allclose(np.asarray(lin.bias._data), 0.1)
+            attr_lin = nn.Linear(3, 3, weight_attr=paddle.ParamAttr(
+                initializer=Constant(2.0)))
+            assert np.allclose(np.asarray(attr_lin.weight._data), 2.0)
+        finally:
+            set_global_initializer(None, None)
