@@ -7,7 +7,8 @@ over a device Mesh; the user API mirrors ``import paddle``.
 from __future__ import annotations
 
 from .framework import (  # noqa: F401
-    CPUPlace, CUDAPlace, Place, TPUPlace, Tensor, Parameter,
+    CPUPlace, CUDAPinnedPlace, CUDAPlace, NPUPlace, Place, TPUPlace,
+    Tensor, Parameter,
     bfloat16, bool_, complex64, complex128, float16, float32, float64,
     int8, int16, int32, int64, uint8,
     get_default_dtype, set_default_dtype,
@@ -16,6 +17,17 @@ from .framework import (  # noqa: F401
     no_grad, is_grad_enabled,
 )
 from .tensor import *  # noqa: E402,F401,F403
+
+# reference exports `bool` and `dtype` at top level (framework/dtype.py)
+bool = bool_  # noqa: A001 — intentional builtin shadow, reference parity
+import numpy as _np_for_dtype  # noqa: E402
+dtype = _np_for_dtype.dtype  # paddle.dtype(...) constructs/compares dtypes
+
+# cuda-named RNG state aliases (reference: framework/random.py)
+from .framework.random import (  # noqa: E402,F401
+    get_rng_state as get_cuda_rng_state,
+    set_rng_state as set_cuda_rng_state,
+)
 
 __version__ = "0.1.0"
 

@@ -74,7 +74,8 @@ def spawn(func, args=(), nprocs: Optional[int] = None, join: bool = True,
         nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
     coordinator = options.get(
         "master", f"127.0.0.1:{_free_port()}")
-    endpoints = ",".join(f"127.0.0.1:rank{r}" for r in range(nprocs))
+    endpoints = ",".join(
+        f"127.0.0.1:{_free_port()}" for _ in range(nprocs))
     ctx = mp.get_context("spawn")
     procs = []
     # env is set in the PARENT around each start(): spawn children inherit
@@ -91,7 +92,8 @@ def spawn(func, args=(), nprocs: Optional[int] = None, join: bool = True,
             os.environ["PADDLE_TRAINER_ID"] = str(rank)
             os.environ["PADDLE_TRAINERS_NUM"] = str(nprocs)
             os.environ["PADDLE_MASTER"] = coordinator
-            os.environ["PADDLE_CURRENT_ENDPOINT"] = coordinator
+            os.environ["PADDLE_CURRENT_ENDPOINT"] = \
+                endpoints.split(",")[rank]
             os.environ["PADDLE_TRAINER_ENDPOINTS"] = endpoints
             os.environ["FLAGS_selected_tpus"] = str(rank)
             if backend == "cpu" or \

@@ -13,7 +13,8 @@ from .dtypes import (bfloat16, bool_, complex64, complex128,  # noqa: E402,F401
 from .enforce import (EnforceNotMet, InvalidArgumentError,  # noqa: E402,F401
                       enforce)
 from .flags import define_flag, get_flags, set_flags  # noqa: E402,F401
-from .place import (CPUPlace, CUDAPlace, Place, TPUPlace,  # noqa: E402,F401
+from .place import (CPUPlace, CUDAPinnedPlace, CUDAPlace, NPUPlace,  # noqa: E402,F401
+                    Place, TPUPlace,
                     current_place, get_device, is_compiled_with_tpu,
                     set_device)
 from .random import (default_generator, rng_guard, seed)  # noqa: E402,F401

@@ -64,6 +64,20 @@ class TPUPlace(Place):
     device_type = "tpu"
 
 
+class NPUPlace(Place):
+    """Accepted for reference API parity; resolves to the TPU backend."""
+
+    def __init__(self, device_id: int = 0):
+        super().__init__("npu", device_id)
+
+
+class CUDAPinnedPlace(Place):
+    """Reference parity: pinned host memory is PjRt's concern on TPU."""
+
+    def __init__(self):
+        super().__init__("cuda_pinned", 0)
+
+
 class CUDAPlace(Place):
     # Accepted for API parity with the reference; maps onto whatever
     # accelerator jax exposes.

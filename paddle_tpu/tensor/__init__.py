@@ -1272,4 +1272,107 @@ def _linalg_reexport():
 _linalg_reexport()
 
 
+# ---------------------------------------------------------------------------
+# final reference-export stragglers (paddle.__all__ parity)
+# ---------------------------------------------------------------------------
+
+@_export
+def reverse(x, axis, name=None):
+    """Reference alias of flip (tensor/manipulation.py reverse)."""
+    return flip(x, axis)
+
+
+@_export
+def renorm(x, p, axis, max_norm, name=None):
+    """Per-slice p-norm renormalization along ``axis`` (reference:
+    tensor/math.py renorm): slices whose norm exceeds max_norm are scaled
+    down to it. Built from taped ops so the backward includes the
+    projection term (the scale depends on x)."""
+    nd = len(x.shape)
+    ax = axis % nd
+    red = tuple(i for i in range(nd) if i != ax)
+    pw = _op("pow", _op("abs", x), float(p))
+    norms = _op("pow", _op("sum", pw, axis=red, keepdim=True),
+                1.0 / float(p))
+    eps = _op("full_like", norms, fill_value=1e-12)
+    ratio = _op("divide", _op("full_like", norms,
+                              fill_value=float(max_norm)),
+                _op("maximum", norms, eps))
+    one = _op("full_like", norms, fill_value=1.0)
+    scale_t = _op("minimum", ratio, one)
+    return _op("multiply", x, scale_t)
+
+
+@_export
+def tril_indices(row, col=None, offset=0, dtype="int64"):
+    if col is None:
+        col = row
+    r, c = np.tril_indices(int(row), k=int(offset), m=int(col))
+    return to_tensor(np.stack([r, c]).astype(dtype))
+
+
+@_export
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    if col is None:
+        col = row
+    r, c = np.triu_indices(int(row), k=int(offset), m=int(col))
+    return to_tensor(np.stack([r, c]).astype(dtype))
+
+
+@_export
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """Reference: paddle.create_parameter — a free-standing Parameter.
+    Same initializer priority chain as Layer.create_parameter
+    (attr > global > default > built-in)."""
+    from ..nn.initializer import Constant, XavierUniform, \
+        _global_initializer
+    from ..nn.layer.layers import ParamAttr
+    attr = ParamAttr._to_attr(attr)
+    if attr is False:
+        return None
+    init = attr.initializer or _global_initializer(is_bias) or \
+        default_initializer or (Constant(0.0) if is_bias
+                                else XavierUniform())
+    data = init(tuple(int(s) for s in shape), convert_dtype(dtype))
+    return Parameter(data, name=name or attr.name,
+                     trainable=attr.trainable)
+
+
+@_export
+def disable_signal_handler():
+    """Reference parity no-op: the reference installs C++ signal handlers
+    for crash stacks; this runtime relies on python's default handlers."""
+    return None
+
+
+@_export
+def check_shape(shape):
+    """Reference: static shape sanity check used by creation APIs."""
+    if isinstance(shape, Tensor):
+        return
+    for s in shape:
+        if not isinstance(s, (int, np.integer)) or (s < 0 and s != -1):
+            raise ValueError(f"invalid shape entry {s!r} in {shape}")
+
+
+# in-place module-level variants (reference exports these at top level)
+def _inplace_alias(fn_name, base_fn):
+    def f(x, *args, **kwargs):
+        out = base_fn(x, *args, **kwargs)
+        if isinstance(x, Tensor) and isinstance(out, Tensor):
+            x._rebind(out)
+            return x
+        return out
+    f.__name__ = fn_name
+    return _export(f)
+
+
+reshape_ = _inplace_alias("reshape_", reshape)
+squeeze_ = _inplace_alias("squeeze_", squeeze)
+unsqueeze_ = _inplace_alias("unsqueeze_", unsqueeze)
+tanh_ = _inplace_alias("tanh_", tanh)
+scatter_ = _inplace_alias("scatter_", scatter)
+
+
 _attach_methods()

@@ -39,8 +39,10 @@ def get_path_from_url(url: str, root_dir: str, md5sum=None,
     for suffix in (".tar.gz", ".tgz", ".zip"):
         if decompress and path.endswith(suffix):
             extracted = path[: -len(suffix)]
-            if check_exist and osp.exists(extracted):
-                return extracted  # already extracted: don't clobber
+            if check_exist and osp.exists(extracted) and \
+                    os.path.getmtime(extracted) >= os.path.getmtime(path):
+                # extraction is at least as new as the archive
+                return extracted
             import tarfile
             import zipfile
             dst = osp.dirname(path)
