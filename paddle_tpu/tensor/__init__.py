@@ -1289,6 +1289,8 @@ def renorm(x, p, axis, max_norm, name=None):
     down to it. Built from taped ops so the backward includes the
     projection term (the scale depends on x)."""
     nd = len(x.shape)
+    if not -nd <= axis < nd:
+        raise ValueError(f"renorm: axis {axis} out of range for rank {nd}")
     ax = axis % nd
     red = tuple(i for i in range(nd) if i != ax)
     pw = _op("pow", _op("abs", x), float(p))
@@ -1335,8 +1337,13 @@ def create_parameter(shape, dtype="float32", name=None, attr=None,
         default_initializer or (Constant(0.0) if is_bias
                                 else XavierUniform())
     data = init(tuple(int(s) for s in shape), convert_dtype(dtype))
-    return Parameter(data, name=name or attr.name,
-                     trainable=attr.trainable)
+    param = Parameter(data, name=name or attr.name,
+                      trainable=attr.trainable)
+    # same ParamAttr plumbing as Layer.create_parameter (layers.py:155)
+    param.optimize_attr = {"learning_rate": attr.learning_rate}
+    param.regularizer = attr.regularizer
+    param.need_clip = attr.need_clip
+    return param
 
 
 @_export

@@ -39,9 +39,13 @@ def get_path_from_url(url: str, root_dir: str, md5sum=None,
     for suffix in (".tar.gz", ".tgz", ".zip"):
         if decompress and path.endswith(suffix):
             extracted = path[: -len(suffix)]
+            # freshness via a marker file written AFTER extraction
+            # (member mtimes are restored from the archive, so comparing
+            # the extracted tree's own mtime against the archive is wrong)
+            marker = path + ".extracted"
             if check_exist and osp.exists(extracted) and \
-                    os.path.getmtime(extracted) >= os.path.getmtime(path):
-                # extraction is at least as new as the archive
+                    osp.exists(marker) and \
+                    os.path.getmtime(marker) >= os.path.getmtime(path):
                 return extracted
             import tarfile
             import zipfile
@@ -52,5 +56,7 @@ def get_path_from_url(url: str, root_dir: str, md5sum=None,
             else:
                 with tarfile.open(path) as t:
                     t.extractall(dst)
+            with open(marker, "w") as f:
+                f.write("ok")
             return extracted if osp.exists(extracted) else path
     return path

@@ -1,0 +1,137 @@
+"""Numeric-vs-analytic gradient checks (OpTest check_grad pattern,
+SURVEY §4) for the round-3 op additions."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.vision import ops as V
+
+from op_test import check_grad
+
+
+rng = np.random.RandomState(0)
+
+
+class TestExtraOpGrads:
+    def test_fold_grad(self):
+        x = rng.randn(1, 2 * 4, 9)
+        check_grad(lambda t: F.fold(t, (4, 4), (2, 2)), [x])
+
+    def test_unfold_grad(self):
+        x = rng.randn(1, 2, 6, 6)
+        check_grad(lambda t: F.unfold(t, 3, strides=2), [x])
+
+    def test_grid_sample_grad_both_inputs(self):
+        x = rng.randn(1, 2, 5, 5)
+        grid = rng.uniform(-0.9, 0.9, (1, 3, 3, 2))
+        check_grad(lambda a, b: F.grid_sample(a, b), [x, grid],
+                   atol=5e-4, rtol=5e-3)
+
+    def test_temporal_shift_grad(self):
+        x = rng.randn(4, 4, 3, 3)
+        check_grad(lambda t: F.temporal_shift(t, seg_num=2), [x])
+
+    def test_pixel_unshuffle_grad(self):
+        x = rng.randn(1, 2, 4, 4)
+        check_grad(lambda t: F.pixel_unshuffle(t, 2), [x])
+
+    def test_conv3d_transpose_grad(self):
+        x = rng.randn(1, 2, 3, 3, 3)
+        w = rng.randn(2, 2, 2, 2, 2)
+        check_grad(lambda a, b: F.conv3d_transpose(a, b, stride=2),
+                   [x, w], atol=5e-4, rtol=5e-3)
+
+    def test_max_unpool2d_grad(self):
+        x = rng.randn(1, 2, 6, 6)
+
+        def fn(t):
+            pooled, mask = F.max_pool2d(t, 2, return_mask=True)
+            return F.max_unpool2d(pooled, mask, 2)
+
+        check_grad(fn, [x])
+
+    def test_ctc_loss_grad(self):
+        logits = rng.randn(6, 2, 5)
+        labels = np.array([[1, 2, 3], [2, 3, 0]], np.int32)
+
+        def fn(t):
+            return F.ctc_loss(t, paddle.to_tensor(labels),
+                              paddle.to_tensor(np.array([6, 6])),
+                              paddle.to_tensor(np.array([3, 2])),
+                              reduction="sum")
+
+        check_grad(fn, [logits], atol=5e-4, rtol=5e-3)
+
+    def test_hsigmoid_grad(self):
+        x = rng.randn(3, 4)
+        w = rng.randn(5, 4)
+        b = rng.randn(5)
+        lab = np.array([0, 2, 5])
+
+        def fn(a, wv, bv):
+            return F.hsigmoid_loss(a, paddle.to_tensor(lab), 6, wv, bv)
+
+        check_grad(fn, [x, w, b], atol=5e-4, rtol=5e-3)
+
+    def test_margin_cross_entropy_grad(self):
+        logits = rng.uniform(-0.9, 0.9, (3, 5))
+        lab = np.array([1, 0, 4])
+
+        def fn(t):
+            return F.margin_cross_entropy(
+                t, paddle.to_tensor(lab), margin2=0.3, scale=8.0,
+                reduction="sum")
+
+        check_grad(fn, [logits], atol=5e-4, rtol=5e-3)
+
+    def test_roi_align_grad(self):
+        x = rng.randn(1, 2, 8, 8)
+        boxes = np.array([[1.0, 1.0, 6.0, 6.0]], np.float32)
+
+        def fn(t):
+            return V.roi_align(t, paddle.to_tensor(boxes),
+                               paddle.to_tensor(np.array([1])),
+                               output_size=2)
+
+        check_grad(fn, [x], atol=5e-4, rtol=5e-3)
+
+    def test_deform_conv_grad_all_inputs(self):
+        x = rng.randn(1, 2, 5, 5)
+        offset = 0.2 * rng.randn(1, 18, 3, 3)
+        w = rng.randn(3, 2, 3, 3)
+
+        def fn(a, o, wv):
+            return V.deform_conv2d(a, o, wv)
+
+        check_grad(fn, [x, offset, w], atol=5e-4, rtol=5e-3)
+
+    def test_renorm_grad(self):
+        x = rng.randn(3, 4) * 2
+
+        def fn(t):
+            return paddle.renorm(t, p=2.0, axis=0, max_norm=1.0)
+
+        check_grad(fn, [x], atol=5e-4, rtol=5e-3)
+
+    def test_lerp_dist_grad(self):
+        a = rng.randn(4, 3)
+        b = rng.randn(4, 3)
+        check_grad(lambda u, v: paddle.lerp(u, v, 0.3), [a, b])
+        check_grad(lambda u, v: paddle.dist(u, v, 3.0), [a, b],
+                   atol=5e-4, rtol=5e-3)
+
+    def test_sparse_attention_grad(self):
+        b, h, l, d = 1, 1, 4, 4
+        q = rng.randn(b, h, l, d)
+        k = rng.randn(b, h, l, d)
+        v = rng.randn(b, h, l, d)
+        offset = np.tile(np.arange(0, (l + 1) * l, l),
+                         (b, h, 1)).astype(np.int32)
+        cols = np.tile(np.tile(np.arange(l), l), (b, h, 1)).astype(np.int32)
+
+        def fn(qa, ka, va):
+            return F.sparse_attention(qa, ka, va,
+                                      paddle.to_tensor(offset),
+                                      paddle.to_tensor(cols))
+
+        check_grad(fn, [q, k, v], atol=5e-4, rtol=5e-3)

@@ -179,3 +179,45 @@ class TestReviewFixes2:
         native_names = {e["name"] for e in doc["traceEvents"]
                         if e.get("pid") == native_pid and e.get("ph") == "X"}
         assert {"first_sess", "second_sess"} <= native_names
+
+
+class TestDownloadFreshness:
+    def test_extracted_cache_and_refresh(self, tmp_path):
+        import tarfile
+        src = tmp_path / "pkg"
+        src.mkdir()
+        (src / "a.txt").write_text("v1")
+        archive = tmp_path / "pkg.tar.gz"
+        with tarfile.open(archive, "w:gz") as t:
+            t.add(src, arcname="pkg")
+        out = paddle.utils.download.get_path_from_url(
+            "http://x/pkg.tar.gz", str(tmp_path))
+        assert out.endswith("pkg") and (tmp_path / "pkg" / "a.txt").exists()
+        # second call: cached, does not re-extract (marker newer than tar)
+        marker = str(archive) + ".extracted"
+        before = os.path.getmtime(marker)
+        out2 = paddle.utils.download.get_path_from_url(
+            "http://x/pkg.tar.gz", str(tmp_path))
+        assert out2 == out and os.path.getmtime(marker) == before
+        # refresh the archive -> re-extracts
+        import time
+        time.sleep(0.05)
+        (src / "a.txt").write_text("v2")
+        with tarfile.open(archive, "w:gz") as t:
+            t.add(src, arcname="pkg")
+        os.utime(archive, None)
+        paddle.utils.download.get_path_from_url(
+            "http://x/pkg.tar.gz", str(tmp_path))
+        assert os.path.getmtime(marker) > before
+
+    def test_create_parameter_param_attr_plumbing(self):
+        p = paddle.create_parameter(
+            [2, 2], attr=paddle.ParamAttr(learning_rate=0.1,
+                                          need_clip=False))
+        assert p.optimize_attr["learning_rate"] == 0.1
+        assert p.need_clip is False
+
+    def test_renorm_axis_out_of_range(self):
+        with pytest.raises(ValueError, match="out of range"):
+            paddle.renorm(paddle.to_tensor(np.ones((2, 2), np.float32)),
+                          2.0, 5, 1.0)
