@@ -67,9 +67,25 @@ def check_sparsity(x, n=2, m=4) -> bool:
 
 
 def _supported(param) -> bool:
-    # reference supports FC/Linear weights and conv kernels; biases,
-    # norms, and embeddings are never pruned
     return param.ndim >= 2 and min(param.shape) >= 4
+
+
+def _prunable_params(model):
+    """Weights of Linear/Conv layers only — the reference never prunes
+    embeddings, norms, or biases, so shape alone is not enough (an
+    embedding table is >=2-D with large dims)."""
+    from paddle_tpu import nn
+    prunable_types = (nn.Linear, nn.Conv1D, nn.Conv2D, nn.Conv3D,
+                      nn.Conv1DTranspose, nn.Conv2DTranspose,
+                      nn.Conv3DTranspose)
+    seen = set()
+    for layer in model.sublayers(include_self=True):
+        if not isinstance(layer, prunable_types):
+            continue
+        w = getattr(layer, "weight", None)
+        if w is not None and id(w) not in seen:
+            seen.add(id(w))
+            yield w
 
 
 def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
@@ -78,7 +94,7 @@ def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
     if mask_algo not in ("mask_1d", "mask_2d_greedy", "mask_2d_best"):
         raise ValueError(f"unknown mask_algo {mask_algo!r}")
     out = {}
-    for param in model.parameters():
+    for param in _prunable_params(model):
         if param.name in _EXCLUDED or not _supported(param):
             continue
         w = np.asarray(param._data)

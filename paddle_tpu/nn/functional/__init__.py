@@ -651,7 +651,7 @@ def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
 
 @_export
 def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
-                 output_size=None, data_format="NCL", name=None):
+                 data_format="NCL", output_size=None, name=None):
     if not data_format.startswith("NC"):
         raise ValueError(
             "max_unpool1d supports channel-first only "
@@ -662,7 +662,7 @@ def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
 
 @_export
 def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
-                 output_size=None, data_format="NCHW", name=None):
+                 data_format="NCHW", output_size=None, name=None):
     if not data_format.startswith("NC"):
         raise ValueError(
             "max_unpool2d supports channel-first only "
@@ -673,7 +673,7 @@ def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
 
 @_export
 def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
-                 output_size=None, data_format="NCDHW", name=None):
+                 data_format="NCDHW", output_size=None, name=None):
     if not data_format.startswith("NC"):
         raise ValueError(
             "max_unpool3d supports channel-first only "

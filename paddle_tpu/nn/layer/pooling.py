@@ -176,7 +176,7 @@ class _MaxUnPoolNd(Layer):
 
     def forward(self, x, indices):
         return type(self)._fn(x, indices, self._k, self._s, self._p,
-                              self._output_size, self._df)
+                              self._df, self._output_size)
 
 
 class MaxUnPool1D(_MaxUnPoolNd):

@@ -1190,7 +1190,7 @@ def is_empty(x, name=None):
 def increment(x, value=1.0, name=None):
     out = _op("scale", x, scale=1.0, bias=float(value))
     if isinstance(x, Tensor):
-        x._data = out._data
+        x._rebind(out)  # keep tape/autograd bookkeeping consistent
         return x
     return out
 

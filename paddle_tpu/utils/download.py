@@ -52,10 +52,22 @@ def get_path_from_url(url: str, root_dir: str, md5sum=None,
             dst = osp.dirname(path)
             if suffix == ".zip":
                 with zipfile.ZipFile(path) as z:
+                    # reject members that would escape the destination
+                    # (absolute paths / ".." traversal in a tampered cache)
+                    base = osp.realpath(dst)
+                    for name in z.namelist():
+                        target = osp.realpath(osp.join(dst, name))
+                        if not (target == base
+                                or target.startswith(base + os.sep)):
+                            raise RuntimeError(
+                                f"unsafe zip member path: {name!r}")
                     z.extractall(dst)
             else:
                 with tarfile.open(path) as t:
-                    t.extractall(dst)
+                    if hasattr(tarfile, "data_filter"):
+                        t.extractall(dst, filter="data")
+                    else:  # pre-3.12: no filter= support
+                        t.extractall(dst)
             with open(marker, "w") as f:
                 f.write("ok")
             return extracted if osp.exists(extracted) else path

@@ -422,7 +422,11 @@ def _yolo_loss(x, gt_box, gt_label, gt_score=None, anchors=(),
     score = jnp.ones((n, nb)) if gt_score is None else \
         gt_score.astype(jnp.float32)
     wgt = jnp.where(in_layer, score, 0.0)
-    bi = batch_idx.reshape(-1)
+    # rows not in this layer (padded gts / other-layer anchors) get an
+    # out-of-bounds batch index so the scatter drops them — otherwise a
+    # padded row writes 0.0 at (b, anchor 0, cell 0,0) and can silently
+    # zero a real target's coordinate loss there
+    bi = jnp.where(in_layer, batch_idx, n).reshape(-1)
     ai = a_local.reshape(-1)
     ji = gj.reshape(-1)
     ii = gi.reshape(-1)
