@@ -14,6 +14,10 @@ from . import imdb  # noqa: F401
 from . import imikolov  # noqa: F401
 from . import movielens  # noqa: F401
 from . import flowers  # noqa: F401
+from . import wmt14  # noqa: F401
+from . import wmt16  # noqa: F401
+from . import conll05  # noqa: F401
+from . import voc2012  # noqa: F401
 
 __all__ = ["common", "mnist", "cifar", "uci_housing", "imdb", "imikolov",
-           "movielens", "flowers"]
+           "movielens", "flowers", "wmt14", "wmt16", "conll05", "voc2012"]

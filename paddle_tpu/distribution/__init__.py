@@ -17,7 +17,9 @@ from ..framework import random as _random
 from ..framework.tensor import Tensor
 
 __all__ = ["Distribution", "Normal", "Uniform", "Categorical", "Beta",
-           "Dirichlet", "kl_divergence", "register_kl"]
+           "Dirichlet", "kl_divergence", "register_kl",
+           "ExponentialFamily", "Independent", "Multinomial",
+           "TransformedDistribution"]
 
 
 def _arr(x):
@@ -209,7 +211,44 @@ class Categorical(Distribution):
         return Tensor(-(p * self._log_p).sum(-1))
 
 
-class Beta(Distribution):
+class ExponentialFamily(Distribution):
+    """Exponential-family base: p(x) = h(x) exp(<η, T(x)> − A(η)).
+
+    Reference: distribution/exponential_family.py — entropy via the
+    Bregman divergence of the log-normalizer. TPU-native: the reference
+    hand-rolls the gradient through its autograd; here ``jax.grad`` of
+    ``_log_normalizer`` w.r.t. the natural parameters IS the expected
+    sufficient statistic, so the generic entropy/KL need no per-family
+    math."""
+
+    @property
+    def _natural_parameters(self) -> tuple:
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        return 0.0
+
+    def entropy(self):
+        """−E[log p] = A(η) − <η, ∇A(η)> + E[−log h] (Bregman form)."""
+        import jax
+        import jax.numpy as jnp
+        nparams = tuple(jnp.asarray(p) for p in self._natural_parameters)
+        lognorm = self._log_normalizer(*nparams)
+        grads = jax.grad(
+            lambda ps: jnp.sum(self._log_normalizer(*ps)))(nparams)
+        ent = lognorm + jnp.asarray(self._mean_carrier_measure)
+        for np_, g in zip(nparams, grads):
+            ent = ent - (np_ * g).reshape(
+                np_.shape[:lognorm.ndim] + (-1,)).sum(-1) \
+                if np_.ndim > lognorm.ndim else ent - np_ * g
+        return Tensor(ent)
+
+
+class Beta(ExponentialFamily):
     """Reference distribution/beta.py."""
 
     def __init__(self, alpha, beta):
@@ -222,6 +261,14 @@ class Beta(Distribution):
     @property
     def mean(self):
         return Tensor(self.alpha / (self.alpha + self.beta))
+
+    @property
+    def _natural_parameters(self):
+        return (self.alpha, self.beta)
+
+    def _log_normalizer(self, a, b):
+        import jax.scipy.special as jsp
+        return jsp.gammaln(a) + jsp.gammaln(b) - jsp.gammaln(a + b)
 
     def sample(self, shape=(), seed=0):
         import jax
@@ -247,13 +294,21 @@ class Beta(Distribution):
                       + (a + b - 2) * jsp.digamma(a + b))
 
 
-class Dirichlet(Distribution):
+class Dirichlet(ExponentialFamily):
     """Reference distribution/dirichlet.py."""
 
     def __init__(self, concentration):
         self.concentration = _arr(concentration)
         super().__init__(batch_shape=self.concentration.shape[:-1],
                          event_shape=self.concentration.shape[-1:])
+
+    @property
+    def _natural_parameters(self):
+        return (self.concentration,)
+
+    def _log_normalizer(self, a):
+        import jax.scipy.special as jsp
+        return jsp.gammaln(a).sum(-1) - jsp.gammaln(a.sum(-1))
 
     def sample(self, shape=(), seed=0):
         import jax
@@ -277,6 +332,191 @@ class Dirichlet(Distribution):
         lnB = jsp.gammaln(a).sum(-1) - jsp.gammaln(a0)
         return Tensor(lnB + (a0 - k) * jsp.digamma(a0)
                       - ((a - 1) * jsp.digamma(a)).sum(-1))
+
+
+class Independent(Distribution):
+    """Reinterpret the rightmost ``reinterpreted_batch_rank`` batch dims
+    as event dims (reference distribution/independent.py)."""
+
+    def __init__(self, base: Distribution, reinterpreted_batch_rank: int):
+        if not (0 < reinterpreted_batch_rank <= len(base.batch_shape)):
+            raise ValueError(
+                f"reinterpreted_batch_rank must be in (0, "
+                f"{len(base.batch_shape)}], got {reinterpreted_batch_rank}")
+        self._base = base
+        self._reinterpreted_batch_rank = int(reinterpreted_batch_rank)
+        shape = base.batch_shape + base.event_shape
+        split = len(base.batch_shape) - reinterpreted_batch_rank
+        super().__init__(batch_shape=shape[:split],
+                         event_shape=shape[split:])
+
+    @property
+    def mean(self):
+        return self._base.mean
+
+    @property
+    def variance(self):
+        return self._base.variance
+
+    def sample(self, shape=(), seed=0):
+        return self._base.sample(shape, seed=seed)
+
+    def rsample(self, shape=(), seed=0):
+        return self._base.rsample(shape, seed=seed)
+
+    def _sum_rightmost(self, value, n):
+        return value.sum(tuple(range(-n, 0))) if n > 0 else value
+
+    def log_prob(self, value):
+        lp = self._base.log_prob(value)
+        return Tensor(self._sum_rightmost(
+            lp._data, self._reinterpreted_batch_rank))
+
+    def entropy(self):
+        ent = self._base.entropy()
+        return Tensor(self._sum_rightmost(
+            ent._data, self._reinterpreted_batch_rank))
+
+
+class Multinomial(Distribution):
+    """Counts over k categories from ``total_count`` independent draws
+    (reference distribution/multinomial.py)."""
+
+    def __init__(self, total_count, probs):
+        import jax.numpy as jnp
+        if not isinstance(total_count, int) or total_count < 1:
+            raise ValueError("total_count must be an int >= 1")
+        p = _arr(probs)
+        if p.ndim < 1:
+            raise ValueError("probs must have at least one dimension")
+        self.probs = p / p.sum(-1, keepdims=True)
+        self.total_count = total_count
+        self._categorical = Categorical(jnp.log(self.probs))
+        super().__init__(batch_shape=p.shape[:-1],
+                         event_shape=p.shape[-1:])
+
+    @property
+    def mean(self):
+        return Tensor(self.probs * self.total_count)
+
+    @property
+    def variance(self):
+        return Tensor(self.total_count * self.probs * (1 - self.probs))
+
+    def log_prob(self, value):
+        import jax.numpy as jnp
+        import jax.scipy.special as jsp
+        v = _arr(value)
+        logits = jnp.log(self.probs)
+        # 0 * log(0) := 0 for impossible-but-unused categories
+        logits = jnp.where((v == 0) & jnp.isneginf(logits), 0.0, logits)
+        return Tensor(jsp.gammaln(v.sum(-1) + 1)
+                      - jsp.gammaln(v + 1).sum(-1)
+                      + (v * logits).sum(-1))
+
+    def sample(self, shape=(), seed=0):
+        import jax
+        import jax.numpy as jnp
+        key = _draw_key(seed)
+        draws = jax.random.categorical(
+            key, jnp.log(self.probs),
+            shape=(self.total_count,) + tuple(shape) + self.batch_shape)
+        onehot = jax.nn.one_hot(draws, self.probs.shape[-1],
+                                dtype=self.probs.dtype)
+        return Tensor(onehot.sum(0))
+
+    def entropy(self):
+        import jax.numpy as jnp
+        import jax.scipy.special as jsp
+        n = float(self.total_count)
+        # H = n*H(cat) - lgamma(n+1) + sum_i E[lgamma(X_i + 1)] with
+        # X_i ~ Binomial(n, p_i) (reference multinomial.py:154)
+        support = jnp.arange(1.0, n + 1)
+        shape = (-1,) + (1,) * self.probs.ndim
+        support = support.reshape(shape)
+        logits = jnp.log(self.probs) - jnp.log1p(-self.probs)
+        norm = (n * jnp.clip(logits, 0)
+                + n * jnp.log1p(jnp.exp(-jnp.abs(logits)))
+                - jsp.gammaln(n + 1))
+        binom_lp = (support * logits - jsp.gammaln(support + 1)
+                    - jsp.gammaln(n - support + 1) - norm)
+        e_lgamma = (jnp.exp(binom_lp)
+                    * jsp.gammaln(support + 1)).sum(0).sum(-1)
+        cat_ent = self._categorical.entropy()._data
+        return Tensor(n * cat_ent - jsp.gammaln(n + 1) + e_lgamma)
+
+
+class TransformedDistribution(Distribution):
+    """base distribution pushed through a transform chain (reference
+    distribution/transformed_distribution.py)."""
+
+    def __init__(self, base: Distribution, transforms):
+        from .transform import ChainTransform, Transform
+        if isinstance(transforms, Transform):
+            transforms = [transforms]
+        for t in transforms:
+            if not isinstance(t, Transform):
+                raise TypeError(f"not a Transform: {t!r}")
+        self._base = base
+        self.transforms = list(transforms)
+        chain = ChainTransform(self.transforms)
+        base_shape = base.batch_shape + base.event_shape
+        out_shape = chain.forward_shape(base_shape)
+        event_rank = max(chain._codomain_event_rank,
+                         len(base.event_shape))
+        split = len(out_shape) - event_rank
+        super().__init__(batch_shape=tuple(out_shape[:split]),
+                         event_shape=tuple(out_shape[split:]))
+
+    def sample(self, shape=(), seed=0):
+        x = self._base.sample(shape, seed=seed)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def rsample(self, shape=(), seed=0):
+        x = self._base.rsample(shape, seed=seed)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def log_prob(self, value):
+        import jax.numpy as jnp
+
+        def sum_rightmost(v, n):
+            return v.sum(tuple(range(-n, 0))) if n > 0 else v
+
+        y = _arr(value)
+        event_rank = len(self.event_shape)
+        lp = 0.0
+        for t in reversed(self.transforms):
+            if not t._is_injective():
+                raise NotImplementedError(
+                    f"log_prob through non-injective "
+                    f"{type(t).__name__} is undefined")
+            x = t._inverse(y)
+            ldj = jnp.asarray(t.forward_log_det_jacobian(Tensor(x))._data)
+            lp = lp - sum_rightmost(
+                ldj, event_rank - t._codomain_event_rank)
+            event_rank += t._domain_event_rank - t._codomain_event_rank
+            y = x
+        base_lp = jnp.asarray(self._base.log_prob(Tensor(y))._data)
+        lp = lp + sum_rightmost(
+            base_lp, event_rank - len(self._base.event_shape))
+        return Tensor(lp)
+
+
+from .transform import (  # noqa: E402,F401
+    AbsTransform, AffineTransform, ChainTransform, ExpTransform,
+    IndependentTransform, PowerTransform, ReshapeTransform,
+    SigmoidTransform, SoftmaxTransform, StackTransform,
+    StickBreakingTransform, TanhTransform, Transform)
+
+__all__ += [
+    "Transform", "AbsTransform", "AffineTransform", "ChainTransform",
+    "ExpTransform", "IndependentTransform", "PowerTransform",
+    "ReshapeTransform", "SigmoidTransform", "SoftmaxTransform",
+    "StackTransform", "StickBreakingTransform", "TanhTransform"]
 
 
 # ---------------------------------------------------------------------------
@@ -346,3 +586,37 @@ def _kl_dirichlet_dirichlet(p, q):
     return Tensor(lnB_b - lnB_a
                   + ((a - b) * (jsp.digamma(a)
                                 - jsp.digamma(a0)[..., None])).sum(-1))
+
+
+@register_kl(Independent, Independent)
+def _kl_independent_independent(p, q):
+    if p._reinterpreted_batch_rank != q._reinterpreted_batch_rank:
+        raise NotImplementedError(
+            "KL between Independents of different reinterpreted ranks")
+    kl = kl_divergence(p._base, q._base)
+    return Tensor(p._sum_rightmost(kl._data, p._reinterpreted_batch_rank))
+
+
+@register_kl(ExponentialFamily, ExponentialFamily)
+def _kl_expfamily_expfamily(p, q):
+    """Generic same-family KL via the Bregman divergence of the
+    log-normalizer (reference kl.py _kl_expfamily_expfamily — there via
+    hand-rolled double grad, here one jax.value_and_grad)."""
+    if type(p) is not type(q):
+        raise NotImplementedError(
+            f"KL between different families {type(p).__name__} and "
+            f"{type(q).__name__}")
+    import jax
+    import jax.numpy as jnp
+    p_np = tuple(jnp.asarray(v) for v in p._natural_parameters)
+    q_np = tuple(jnp.asarray(v) for v in q._natural_parameters)
+    grads = jax.grad(lambda ps: jnp.sum(p._log_normalizer(*ps)))(p_np)
+    # KL = A(η_q) - A(η_p) - <η_q - η_p, ∇A(η_p)>
+    kl = q._log_normalizer(*q_np) - p._log_normalizer(*p_np)
+    for pn, qn, g in zip(p_np, q_np, grads):
+        term = (pn - qn) * g
+        extra = term.ndim - kl.ndim
+        if extra > 0:
+            term = term.sum(tuple(range(-extra, 0)))
+        kl = kl + term
+    return Tensor(kl)

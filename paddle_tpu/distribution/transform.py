@@ -1,0 +1,445 @@
+"""``paddle.distribution`` transforms.
+
+Reference: python/paddle/distribution/transform.py (Transform base + 12
+concrete transforms feeding TransformedDistribution) and variable.py
+(domain/codomain declarations).
+
+TPU-native: transforms are pure jnp expressions over arrays with Tensors
+at the API boundary — fully jit-traceable, log-det-jacobians in closed
+form.
+"""
+from __future__ import annotations
+
+import enum
+import functools
+import math
+import operator
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+
+__all__ = ["Transform", "AbsTransform", "AffineTransform", "ChainTransform",
+           "ExpTransform", "IndependentTransform", "PowerTransform",
+           "ReshapeTransform", "SigmoidTransform", "SoftmaxTransform",
+           "StackTransform", "StickBreakingTransform", "TanhTransform"]
+
+
+def _a(x):
+    if isinstance(x, Tensor):
+        return x._data.astype(jnp.float32)
+    return jnp.asarray(x, jnp.float32)
+
+
+def _t(a):
+    return Tensor(a)
+
+
+class Type(enum.Enum):
+    """Mapping type of a transform (reference transform.py Type)."""
+    BIJECTION = "bijection"
+    INJECTION = "injection"
+    SURJECTION = "surjection"
+    OTHER = "other"
+
+    @classmethod
+    def is_injective(cls, t):
+        return t in (cls.BIJECTION, cls.INJECTION)
+
+
+class Transform:
+    """Base transform: y = f(x) with log|det J| bookkeeping (reference
+    transform.py Transform)."""
+
+    _type = Type.INJECTION
+    # event ranks consumed/produced (the variable.py domain/codomain
+    # event_rank collapsed to the two integers the math needs)
+    _domain_event_rank = 0
+    _codomain_event_rank = 0
+
+    @classmethod
+    def _is_injective(cls):
+        return Type.is_injective(cls._type)
+
+    def __call__(self, x):
+        from .import Distribution, TransformedDistribution
+        if isinstance(x, Distribution):
+            return TransformedDistribution(x, [self])
+        if isinstance(x, Transform):
+            return ChainTransform([self, x])
+        return self.forward(x)
+
+    def forward(self, x):
+        return _t(self._forward(_a(x)))
+
+    def inverse(self, y):
+        return _t(self._inverse(_a(y)))
+
+    def forward_log_det_jacobian(self, x):
+        x = _a(x)
+        if hasattr(self, "_forward_log_det_jacobian"):
+            return _t(self._forward_log_det_jacobian(x))
+        if hasattr(self, "_inverse_log_det_jacobian"):
+            return _t(-self._inverse_log_det_jacobian(self._forward(x)))
+        raise NotImplementedError(
+            f"{type(self).__name__} has no log det jacobian")
+
+    def inverse_log_det_jacobian(self, y):
+        y = _a(y)
+        if hasattr(self, "_inverse_log_det_jacobian"):
+            return _t(self._inverse_log_det_jacobian(y))
+        if hasattr(self, "_forward_log_det_jacobian"):
+            return _t(-self._forward_log_det_jacobian(self._inverse(y)))
+        raise NotImplementedError(
+            f"{type(self).__name__} has no log det jacobian")
+
+    def forward_shape(self, shape):
+        return tuple(shape)
+
+    def inverse_shape(self, shape):
+        return tuple(shape)
+
+
+class AbsTransform(Transform):
+    """y = |x| — a surjection; ``inverse`` returns the positive branch
+    (reference transform.py:318)."""
+
+    _type = Type.SURJECTION
+
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y
+
+
+class AffineTransform(Transform):
+    """y = loc + scale * x (reference transform.py:390)."""
+
+    _type = Type.BIJECTION
+
+    def __init__(self, loc, scale):
+        self.loc = _a(loc)
+        self.scale = _a(scale)
+
+    def _forward(self, x):
+        return self.loc + self.scale * x
+
+    def _inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), x.shape)
+
+
+class ChainTransform(Transform):
+    """Composition t_n ∘ … ∘ t_1 (reference transform.py:467)."""
+
+    def __init__(self, transforms: Sequence[Transform]):
+        self.transforms = list(transforms)
+        self._type = (Type.BIJECTION if all(
+            t._is_injective() for t in self.transforms)
+            else Type.OTHER)
+
+    @classmethod
+    def _class_is_injective(cls):
+        return True
+
+    def _is_injective(self):
+        return all(t._is_injective() for t in self.transforms)
+
+    @property
+    def _domain_event_rank(self):
+        return max((t._domain_event_rank for t in self.transforms),
+                   default=0)
+
+    @property
+    def _codomain_event_rank(self):
+        return max((t._codomain_event_rank for t in self.transforms),
+                   default=0)
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t._forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t._inverse(y)
+        return y
+
+    def _forward_log_det_jacobian(self, x):
+        ldj = jnp.zeros(())
+        for t in self.transforms:
+            ldj = ldj + jnp.asarray(t.forward_log_det_jacobian(
+                _t(x))._data)
+            x = t._forward(x)
+        return ldj
+
+    def forward_shape(self, shape):
+        for t in self.transforms:
+            shape = t.forward_shape(shape)
+        return tuple(shape)
+
+    def inverse_shape(self, shape):
+        for t in reversed(self.transforms):
+            shape = t.inverse_shape(shape)
+        return tuple(shape)
+
+
+class ExpTransform(Transform):
+    """y = exp(x) (reference transform.py:590)."""
+
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _forward_log_det_jacobian(self, x):
+        return x
+
+
+class IndependentTransform(Transform):
+    """Reinterpret the rightmost ``reinterpreted_batch_rank`` batch dims
+    of ``base`` as event dims — jacobians sum over them (reference
+    transform.py:639)."""
+
+    def __init__(self, base: Transform, reinterpreted_batch_rank: int):
+        if reinterpreted_batch_rank <= 0:
+            raise ValueError("reinterpreted_batch_rank must be positive")
+        self.base = base
+        self.reinterpreted_batch_rank = int(reinterpreted_batch_rank)
+        self._type = base._type
+
+    @property
+    def _domain_event_rank(self):
+        return self.base._domain_event_rank + self.reinterpreted_batch_rank
+
+    @property
+    def _codomain_event_rank(self):
+        return (self.base._codomain_event_rank
+                + self.reinterpreted_batch_rank)
+
+    def _is_injective(self):
+        return self.base._is_injective()
+
+    def _forward(self, x):
+        return self.base._forward(x)
+
+    def _inverse(self, y):
+        return self.base._inverse(y)
+
+    def _forward_log_det_jacobian(self, x):
+        ldj = jnp.asarray(
+            self.base.forward_log_det_jacobian(_t(x))._data)
+        axes = tuple(range(-self.reinterpreted_batch_rank, 0))
+        return ldj.sum(axes)
+
+    def forward_shape(self, shape):
+        return self.base.forward_shape(shape)
+
+    def inverse_shape(self, shape):
+        return self.base.inverse_shape(shape)
+
+
+class PowerTransform(Transform):
+    """y = x ** power on the positive reals (reference
+    transform.py:730)."""
+
+    _type = Type.BIJECTION
+
+    def __init__(self, power):
+        self.power = _a(power)
+
+    def _forward(self, x):
+        return jnp.power(x, self.power)
+
+    def _inverse(self, y):
+        return jnp.power(y, 1.0 / self.power)
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.log(jnp.abs(self.power * jnp.power(x, self.power - 1)))
+
+
+class ReshapeTransform(Transform):
+    """Reshape the event part (reference transform.py:793)."""
+
+    _type = Type.BIJECTION
+
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = tuple(in_event_shape)
+        self.out_event_shape = tuple(out_event_shape)
+        if functools.reduce(operator.mul, self.in_event_shape, 1) != \
+                functools.reduce(operator.mul, self.out_event_shape, 1):
+            raise ValueError(
+                "in_event_shape and out_event_shape must have the same "
+                "number of elements")
+
+    @property
+    def _domain_event_rank(self):
+        return len(self.in_event_shape)
+
+    @property
+    def _codomain_event_rank(self):
+        return len(self.out_event_shape)
+
+    def _batch(self, shape, event):
+        n = len(event)
+        if n and tuple(shape[-n:]) != tuple(event):
+            raise ValueError(
+                f"trailing dims of {tuple(shape)} do not match {event}")
+        return shape[:len(shape) - n] if n else shape
+
+    def _forward(self, x):
+        batch = self._batch(x.shape, self.in_event_shape)
+        return x.reshape(tuple(batch) + self.out_event_shape)
+
+    def _inverse(self, y):
+        batch = self._batch(y.shape, self.out_event_shape)
+        return y.reshape(tuple(batch) + self.in_event_shape)
+
+    def _forward_log_det_jacobian(self, x):
+        batch = self._batch(x.shape, self.in_event_shape)
+        return jnp.zeros(batch, x.dtype)
+
+    def forward_shape(self, shape):
+        return tuple(self._batch(tuple(shape), self.in_event_shape)) \
+            + self.out_event_shape
+
+    def inverse_shape(self, shape):
+        return tuple(self._batch(tuple(shape), self.out_event_shape)) \
+            + self.in_event_shape
+
+
+class SigmoidTransform(Transform):
+    """y = sigmoid(x) (reference transform.py:900)."""
+
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _forward_log_det_jacobian(self, x):
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class SoftmaxTransform(Transform):
+    """y = softmax(x): a surjection onto the simplex with no density
+    (reference transform.py:943)."""
+
+    _type = Type.OTHER
+    _domain_event_rank = 1
+    _codomain_event_rank = 1
+
+    def _forward(self, x):
+        z = x - x.max(-1, keepdims=True)
+        ez = jnp.exp(z)
+        return ez / ez.sum(-1, keepdims=True)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+
+class StackTransform(Transform):
+    """Apply a sequence of transforms to slices along ``axis``
+    (reference transform.py:999)."""
+
+    def __init__(self, transforms: Sequence[Transform], axis: int = 0):
+        if not transforms:
+            raise ValueError("transforms must not be empty")
+        self.transforms = list(transforms)
+        self.axis = int(axis)
+
+    def _is_injective(self):
+        return all(t._is_injective() for t in self.transforms)
+
+    def _map(self, method, v):
+        parts = [
+            getattr(t, method)(jnp.take(v, i, axis=self.axis))
+            for i, t in enumerate(self.transforms)]
+        return jnp.stack(parts, axis=self.axis)
+
+    def _forward(self, x):
+        return self._map("_forward", x)
+
+    def _inverse(self, y):
+        return self._map("_inverse", y)
+
+    def _forward_log_det_jacobian(self, x):
+        parts = [
+            jnp.asarray(t.forward_log_det_jacobian(
+                _t(jnp.take(x, i, axis=self.axis)))._data)
+            for i, t in enumerate(self.transforms)]
+        return jnp.stack(parts, axis=self.axis)
+
+
+class StickBreakingTransform(Transform):
+    """R^(K-1) -> K-simplex via stick-breaking (reference
+    transform.py:1104)."""
+
+    _type = Type.BIJECTION
+    _domain_event_rank = 1
+    _codomain_event_rank = 1
+
+    def _forward(self, x):
+        k = x.shape[-1]
+        offset = jnp.log(jnp.arange(k, 0, -1, dtype=x.dtype))
+        z = jax.nn.sigmoid(x - offset)
+        zc = jnp.cumprod(1 - z, axis=-1)
+        lead = jnp.concatenate(
+            [jnp.ones(x.shape[:-1] + (1,), x.dtype), zc], axis=-1)
+        pad_z = jnp.concatenate(
+            [z, jnp.ones(x.shape[:-1] + (1,), x.dtype)], axis=-1)
+        return lead * pad_z
+
+    def _inverse(self, y):
+        k = y.shape[-1] - 1
+        offset = jnp.log(jnp.arange(k, 0, -1, dtype=y.dtype))
+        zc = 1 - jnp.cumsum(y[..., :-1], axis=-1)
+        lead = jnp.concatenate(
+            [jnp.ones(y.shape[:-1] + (1,), y.dtype), zc[..., :-1]],
+            axis=-1)
+        z = y[..., :-1] / lead
+        return jnp.log(z) - jnp.log1p(-z) + offset
+
+    def _forward_log_det_jacobian(self, x):
+        k = x.shape[-1]
+        offset = jnp.log(jnp.arange(k, 0, -1, dtype=x.dtype))
+        z = x - offset
+        # d y_i / d x_i factors: sigmoid'(z) * prod_{j<i}(1 - sig(z_j))
+        zc_log = jnp.cumsum(jax.nn.log_sigmoid(-z), axis=-1)
+        lead = jnp.concatenate(
+            [jnp.zeros(x.shape[:-1] + (1,), x.dtype), zc_log[..., :-1]],
+            axis=-1)
+        return (jax.nn.log_sigmoid(z) + jax.nn.log_sigmoid(-z)
+                + lead).sum(-1)
+
+    def forward_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] + 1,)
+
+    def inverse_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] - 1,)
+
+
+class TanhTransform(Transform):
+    """y = tanh(x) (reference transform.py:1169)."""
+
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(y)
+
+    def _forward_log_det_jacobian(self, x):
+        # numerically-stable 2(log2 - x - softplus(-2x))
+        return 2.0 * (math.log(2.0) - x - jax.nn.softplus(-2.0 * x))

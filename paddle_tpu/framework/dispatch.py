@@ -28,6 +28,7 @@ import numpy as np
 from ..ops.registry import get_op
 from .infermeta import maybe_check as _infermeta_check
 from . import dtypes as _dtypes
+from . import static_capture as _capture
 from .flags import flag_value
 from .monitor import stat_add
 from .tensor import GradNode, Tensor, is_grad_enabled
@@ -258,6 +259,11 @@ def _call_op_impl(name, opdef, args, attrs):
 
     if flag_value("FLAGS_check_nan_inf"):
         _check_nan_inf(name, out_tensors)
+
+    if _capture.current is not None:
+        # static-graph mode: append this dispatch to the active Program
+        # (the append_op analog; see framework/static_capture.py)
+        _capture.record(name, fn, tensors, out_tensors)
 
     return jax.tree_util.tree_unflatten(out_treedef, out_tensors)
 

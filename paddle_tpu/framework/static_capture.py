@@ -1,0 +1,53 @@
+"""Static-graph op capture — the recording half of ``paddle.static``.
+
+Reference analog: ProgramDesc building via ``append_op``
+(python/paddle/fluid/framework.py Block.append_op) feeding the C++
+executor. TPU-native: while a Program is "current", every eager op
+dispatch (framework/dispatch.py) appends an OpNode here; the Program
+replays the node list as a pure jax function of (feeds, params) and jits
+it — XLA is the executor, jax.grad is append_backward.
+
+This module lives in ``framework`` (not ``static``) so dispatch.py can
+import it without a package cycle. It holds only the mutable "current
+program" pointer and the node type; Program/Executor live in
+``paddle_tpu.static``.
+"""
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple
+
+# the active recording target (a paddle_tpu.static.Program) or None
+current: Optional[Any] = None
+
+
+class OpNode:
+    """One recorded dispatch: re-invokable callable + input/output wiring.
+
+    ``inputs`` entries are (tensor_id, buildtime_array, param_name):
+    replay takes the env value for tensor_id if an earlier node (or feed)
+    produced it, the live parameter value if param_name is set, and the
+    captured build-time constant otherwise.
+    """
+
+    __slots__ = ("op", "fn", "inputs", "out_ids")
+
+    def __init__(self, op: str, fn, inputs: List[Tuple[int, Any, Any]],
+                 out_ids: List[int]):
+        self.op = op
+        self.fn = fn
+        self.inputs = inputs
+        self.out_ids = out_ids
+
+
+def set_current(program) -> None:
+    global current
+    current = program
+
+
+def record(op_name: str, fn, in_tensors, out_tensors) -> None:
+    """Called from dispatch._call_op_impl for every op while capture is
+    active. ``in_tensors``/``out_tensors`` are framework Tensors."""
+    prog = current
+    if prog is None:
+        return
+    prog._record_op(op_name, fn, in_tensors, out_tensors)

@@ -31,7 +31,8 @@ from ..framework import io as _io
 from ..framework.tensor import Tensor, no_grad_guard
 from ..static import InputSpec
 
-__all__ = ["to_static", "save", "load", "TranslatedLayer", "not_to_static"]
+__all__ = ["to_static", "save", "load", "TranslatedLayer", "not_to_static",
+           "ProgramTranslator", "enable_to_static", "ignore_module"]
 
 _FORMAT_VERSION = 1
 
@@ -79,10 +80,21 @@ class StaticFunction:
         self._layer = layer
         self.input_spec = list(input_spec) if input_spec else None
         self._compiled = None
+        self._conv = None
         self.__name__ = getattr(function, "__name__", "forward")
+
+    def _converted_fn(self):
+        """The dy2static-rewritten body: tensor-dependent if/while become
+        static.nn.cond/while_loop (reference: the dygraph_to_static AST
+        pipeline; here in dy2static.py)."""
+        if self._conv is None:
+            from .dy2static import convert_to_static
+            self._conv = convert_to_static(self._fn)
+        return self._conv
 
     def _get_compiled(self):
         if self._compiled is None:
+            fn = self._converted_fn()
             if self._layer is not None:
                 from ..nn.layer.layers import functional_state
 
@@ -90,19 +102,21 @@ class StaticFunction:
                     with no_grad_guard():
                         ins = [Tensor(a, stop_gradient=True)
                                for a in arrays]
-                        # call the ORIGINAL forward (self._fn) — the
+                        # call the (converted) ORIGINAL forward — the
                         # layer's .forward is this StaticFunction now
                         with functional_state(self._layer, params, {}):
-                            out = self._fn(*ins)
+                            out = fn(*ins)
                     return _unwrap_tree(out)
 
                 self._compiled = jax.jit(raw)
             else:
-                self._compiled = jax.jit(_make_raw(self._fn))
+                self._compiled = jax.jit(_make_raw(fn))
         return self._compiled
 
     def _needs_eager(self):
         from ..framework.tensor import is_grad_enabled
+        if not _translator_enabled():
+            return True
         if self._layer is None:
             return False
         return is_grad_enabled() and any(
@@ -113,12 +127,16 @@ class StaticFunction:
             return self._fn(*args)  # training: run on the tape
         arrays = [a._data if isinstance(a, Tensor) else np.asarray(a)
                   for a in args]
-        if self._layer is not None:
-            from ..nn.layer.layers import get_params_tree
-            out = self._get_compiled()(get_params_tree(self._layer),
-                                       *arrays)
-        else:
-            out = self._get_compiled()(*arrays)
+        try:
+            if self._layer is not None:
+                from ..nn.layer.layers import get_params_tree
+                out = self._get_compiled()(get_params_tree(self._layer),
+                                           *arrays)
+            else:
+                out = self._get_compiled()(*arrays)
+        except jax.errors.TracerBoolConversionError as e:
+            from .dy2static import explain_trace_error
+            raise explain_trace_error(e, self._fn) from e
         return _wrap_tree(out)
 
     # reference-parity introspection hooks
@@ -151,6 +169,47 @@ def not_to_static(fn):
     return fn
 
 
+class ProgramTranslator:
+    """Global to_static switch (reference:
+    dygraph_to_static/program_translator.py ProgramTranslator). Singleton;
+    ``enable(False)`` makes every StaticFunction run its original dygraph
+    body."""
+
+    _instance = None
+    _enabled = True
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    @classmethod
+    def get_instance(cls):
+        return cls()
+
+    def enable(self, enable_to_static: bool):
+        type(self)._enabled = bool(enable_to_static)
+
+    @property
+    def enable_to_static(self):
+        return type(self)._enabled
+
+
+def enable_to_static(enable: bool = True):
+    """Reference: paddle.jit.enable_to_static."""
+    ProgramTranslator().enable(enable)
+
+
+def ignore_module(modules):
+    """Reference parity no-op: modules are never AST-converted here —
+    only the decorated function itself is rewritten."""
+    return modules
+
+
+def _translator_enabled():
+    return ProgramTranslator._enabled
+
+
 def _resolve_specs(input_spec, example_inputs=None):
     specs = []
     for s in (input_spec or []):
@@ -170,22 +229,25 @@ def save(layer, path, input_spec=None, **configs):
     (fluid/dygraph/jit.py, fluid/jit/serializer.cc)."""
     from ..nn.layer.layers import Layer
 
+    from .dy2static import convert_to_static
+
     if isinstance(layer, Layer):
         was_training = layer.training
         layer.eval()
         fn = layer.forward
-        fn = fn._fn if isinstance(fn, StaticFunction) else fn
+        fn = fn._converted_fn() if isinstance(fn, StaticFunction) \
+            else convert_to_static(fn)
         if input_spec is None and isinstance(layer.forward, StaticFunction):
             input_spec = layer.forward.input_spec
         state = layer.state_dict()
     elif isinstance(layer, StaticFunction):
         was_training = None
-        fn = layer._fn
+        fn = layer._converted_fn()
         input_spec = input_spec or layer.input_spec
         state = {}
     else:
         was_training = None
-        fn = layer
+        fn = convert_to_static(layer)
         state = {}
     try:
         if not input_spec:

@@ -178,6 +178,16 @@ class Optimizer:
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
+        from ..framework import static_capture
+        if static_capture.current is not None:
+            # static-graph mode: attach loss + this optimizer to the
+            # program being built; Executor.run replays the graph as a
+            # jitted train step (reference: minimize under program_guard
+            # appending backward + optimize ops to the ProgramDesc)
+            prog = static_capture.current
+            prog._loss = loss
+            prog._optimizer = self
+            return [], []
         loss.backward()
         self.step()
         self.clear_grad()

@@ -20,7 +20,8 @@ from .profiler import (  # noqa: F401
     Profiler, ProfilerState, ProfilerTarget, RecordEvent, load_profiler_result,
     make_scheduler, export_chrome_tracing,
 )
+from .xplane import device_op_table, summary_table  # noqa: F401
 
 __all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
            "make_scheduler", "export_chrome_tracing",
-           "load_profiler_result"]
+           "load_profiler_result", "device_op_table", "summary_table"]

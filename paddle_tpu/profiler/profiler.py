@@ -342,7 +342,20 @@ class Profiler:
             lines.append(f"{'[step]':<40} {len(st):>8} "
                          f"{st.sum() * scale:>14.3f} "
                          f"{st.mean() * scale:>12.3f}")
+        if self._device_trace and not self._timer_only:
+            # per-op device-time table decoded from the XPlane trace
+            # (reference: profiler_statistic.py's device view; r3 weak #9)
+            from .xplane import summary_table
+            lines.append("")
+            lines.append("-- Device ops (from XPlane) " + "-" * 48)
+            lines.append(summary_table(self.trace_dir))
         return "\n".join(lines)
+
+    def device_op_table(self, device_only: bool = True):
+        """Raw per-op device-time rows from the XPlane trace:
+        [{name, plane, calls, total_us, avg_us}] sorted by total."""
+        from .xplane import device_op_table
+        return device_op_table(self.trace_dir, device_only=device_only)
 
     @property
     def events(self):

@@ -1,0 +1,183 @@
+"""XPlane (.xplane.pb) parser + device-op statistics.
+
+Reference analog: the profiler_statistic.py device-time tables built from
+the C++ HostTraceAnalyzer/ChromeTracingLogger stack
+(python/paddle/profiler/profiler_statistic.py). TPU-native: the device
+timeline comes out of PjRt/XLA as an XPlane protobuf written by
+``jax.profiler.start_trace``; this module decodes it with a ~100-line
+wire-format reader (no tensorflow/tensorboard dependency in the image)
+and aggregates per-op device time.
+
+XPlane schema (tensorflow/core/profiler/protobuf/xplane.proto):
+XSpace.planes[].lines[].events[] with event durations in picoseconds and
+names interned in plane-level event_metadata.
+"""
+from __future__ import annotations
+
+import glob
+import os
+from typing import Dict, Iterator, List, Optional, Tuple
+
+__all__ = ["parse_xspace", "device_op_table", "latest_xplane_file",
+           "summary_table"]
+
+
+# ---------------------------------------------------------------------------
+# minimal protobuf wire-format reader
+# ---------------------------------------------------------------------------
+
+def _varint(buf: bytes, i: int) -> Tuple[int, int]:
+    out = 0
+    shift = 0
+    while True:
+        b = buf[i]
+        i += 1
+        out |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return out, i
+        shift += 7
+
+
+def _fields(buf: bytes) -> Iterator[Tuple[int, int, object]]:
+    """Yield (field_number, wire_type, value). Length-delimited values are
+    bytes; varints are ints; fixed32/64 are raw ints."""
+    i, n = 0, len(buf)
+    while i < n:
+        tag, i = _varint(buf, i)
+        field, wire = tag >> 3, tag & 7
+        if wire == 0:  # varint
+            val, i = _varint(buf, i)
+        elif wire == 1:  # fixed64
+            val = int.from_bytes(buf[i:i + 8], "little")
+            i += 8
+        elif wire == 2:  # length-delimited
+            ln, i = _varint(buf, i)
+            val = buf[i:i + ln]
+            i += ln
+        elif wire == 5:  # fixed32
+            val = int.from_bytes(buf[i:i + 4], "little")
+            i += 4
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+        yield field, wire, val
+
+
+def _parse_event(buf: bytes) -> Tuple[int, int]:
+    """XEvent -> (metadata_id, duration_ps)."""
+    meta, dur = 0, 0
+    for field, _, val in _fields(buf):
+        if field == 1:
+            meta = val
+        elif field == 3:
+            dur = val
+    return meta, dur
+
+
+def _parse_line(buf: bytes) -> Tuple[str, List[Tuple[int, int]]]:
+    """XLine -> (name, [(metadata_id, duration_ps)])."""
+    name = ""
+    events = []
+    for field, _, val in _fields(buf):
+        if field == 2:
+            name = val.decode("utf-8", "replace")
+        elif field == 4:
+            events.append(_parse_event(val))
+    return name, events
+
+
+def _parse_event_metadata(buf: bytes) -> Tuple[int, str]:
+    """map entry -> XEventMetadata -> (id, name)."""
+    mid, name = 0, ""
+    for field, _, val in _fields(buf):
+        if field == 1:  # map key
+            mid = val
+        elif field == 2:  # map value: XEventMetadata
+            for f2, _, v2 in _fields(val):
+                if f2 == 2:
+                    name = v2.decode("utf-8", "replace")
+                elif f2 == 4 and not name:
+                    name = v2.decode("utf-8", "replace")
+    return mid, name
+
+
+def _parse_plane(buf: bytes) -> dict:
+    name = ""
+    lines = []
+    meta: Dict[int, str] = {}
+    for field, _, val in _fields(buf):
+        if field == 2:
+            name = val.decode("utf-8", "replace")
+        elif field == 3:
+            lines.append(_parse_line(val))
+        elif field == 4:
+            mid, mname = _parse_event_metadata(val)
+            meta[mid] = mname
+    return {"name": name, "lines": lines, "event_metadata": meta}
+
+
+def parse_xspace(data: bytes) -> List[dict]:
+    """XSpace bytes -> [{name, lines: [(line_name, [(meta_id, dur_ps)])],
+    event_metadata: {id: name}}]."""
+    return [_parse_plane(val) for field, _, val in _fields(data)
+            if field == 1]
+
+
+# ---------------------------------------------------------------------------
+# statistics
+# ---------------------------------------------------------------------------
+
+def latest_xplane_file(trace_dir: str) -> Optional[str]:
+    files = glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"),
+                      recursive=True)
+    return max(files, key=os.path.getmtime) if files else None
+
+
+def device_op_table(trace_dir: str, device_only: bool = True
+                    ) -> List[dict]:
+    """Aggregate per-op device time from the newest xplane.pb under
+    ``trace_dir``. Returns rows sorted by total time:
+    {name, plane, calls, total_us, avg_us}."""
+    path = latest_xplane_file(trace_dir)
+    if path is None:
+        return []
+    with open(path, "rb") as f:
+        planes = parse_xspace(f.read())
+    agg: Dict[Tuple[str, str], List[float]] = {}
+    for plane in planes:
+        pname = plane["name"]
+        plane_is_device = ("/device:" in pname or "TPU" in pname
+                           or "GPU" in pname)
+        meta = plane["event_metadata"]
+        for line_name, events in plane["lines"]:
+            # on TPU the device ops live in /device:TPU:* planes; on the
+            # CPU backend they live in the host plane's XLAPjRt client
+            # line — treat both as "device" timelines
+            if device_only and not (plane_is_device
+                                    or "XLAPjRt" in line_name):
+                continue
+            for mid, dur_ps in events:
+                key = (meta.get(mid, f"#{mid}"), pname)
+                cell = agg.setdefault(key, [0.0, 0])
+                cell[0] += dur_ps / 1e6  # ps -> us
+                cell[1] += 1
+    rows = [{"name": name, "plane": plane, "calls": cnt,
+             "total_us": tot, "avg_us": tot / cnt}
+            for (name, plane), (tot, cnt) in agg.items()]
+    rows.sort(key=lambda r: -r["total_us"])
+    return rows
+
+
+def summary_table(trace_dir: str, limit: int = 30,
+                  device_only: bool = True) -> str:
+    """Formatted device-op table (≙ profiler_statistic.py's device view)."""
+    rows = device_op_table(trace_dir, device_only=device_only)
+    if not rows:
+        return "(no xplane trace found under %s)" % trace_dir
+    lines = [f"{'Device op':<48} {'Calls':>7} {'Total(us)':>12} "
+             f"{'Avg(us)':>10}"]
+    for r in rows[:limit]:
+        lines.append(f"{r['name'][:48]:<48} {r['calls']:>7} "
+                     f"{r['total_us']:>12.1f} {r['avg_us']:>10.1f}")
+    if len(rows) > limit:
+        lines.append(f"... ({len(rows) - limit} more rows)")
+    return "\n".join(lines)

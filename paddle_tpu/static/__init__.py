@@ -1,13 +1,28 @@
-"""``paddle.static`` — static-graph user API facade.
+"""``paddle.static`` — static-graph user API.
 
 Analog of the reference's ``python/paddle/static/`` (Program, Executor,
-program_guard, append_backward over ProgramDesc). TPU-native stance
-(SURVEY.md §7): the "program" is a traced, jit-compiled function — XLA is
-the executor and the ProgramDesc/InterpreterCore layer disappears. This
-module keeps the *ergonomics*: ``enable_static`` flips a mode flag,
-``Program`` captures a python callable + example specs and compiles it
-lazily, ``Executor.run`` executes the compiled artifact. ``InputSpec`` is
-shared with ``paddle.jit``.
+program_guard, append_backward over ProgramDesc; fluid/executor.py:1109,
+fluid/backward.py). TPU-native stance (SURVEY.md §7): a "program" is a
+recorded op list replayed as a pure jax function — XLA is the executor,
+``jax.grad`` is ``append_backward``, and the ProgramDesc/InterpreterCore
+layer disappears.
+
+How it works (r3 verdict item 7 — real feed/fetch semantics):
+
+- ``enable_static()`` + ``program_guard`` activate op CAPTURE: every eager
+  dispatch appends an OpNode to the current Program
+  (framework/static_capture.py, hooked in framework/dispatch.py).
+- ``static.data(name, shape)`` creates a feed Variable — a live Tensor
+  holding a zero placeholder (None dims -> 1) whose id marks where feeds
+  enter the recorded graph.
+- Layers/ops run eagerly ONCE at build time (concrete placeholder values)
+  while the recording happens — the build IS the trace.
+- ``Executor.run(prog, feed={name: arr}, fetch_list=[vars])`` replays the
+  node list as a jitted pure function of (feeds, params): feeds by NAME,
+  fetches by Variable identity (or name). If an optimizer was attached via
+  ``minimize()``, the replay is a full train step — jax.value_and_grad over
+  the recorded loss + the optimizer's pure update rule — and parameter
+  state persists across run() calls (written back to the live Parameters).
 """
 from __future__ import annotations
 
@@ -18,6 +33,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..framework import static_capture as _capture
 from ..framework.dtypes import convert_dtype
 from ..framework.tensor import Tensor
 from . import nn  # noqa: F401  (control-flow ops: cond/while_loop/...)
@@ -26,17 +42,20 @@ __all__ = ["enable_static", "disable_static", "in_dynamic_mode",
            "InputSpec", "Program", "program_guard", "default_main_program",
            "default_startup_program", "Executor", "data", "name_scope",
            "cpu_places", "device_guard", "save_inference_model",
-           "load_inference_model"]
+           "load_inference_model", "append_backward"]
 
 _mode = threading.local()
 
 
 def enable_static():
     _mode.static = True
+    if _capture.current is None:
+        _capture.set_current(_default_main)
 
 
 def disable_static():
     _mode.static = False
+    _capture.set_current(None)
 
 
 def in_dynamic_mode() -> bool:
@@ -66,14 +85,53 @@ class InputSpec:
 
 
 class Program:
-    """A lazily-jitted callable — the jaxpr/StableHLO artifact replaces
-    ProgramDesc."""
+    """A recorded op graph, replayable as a pure jitted function.
+
+    Also still accepts a plain callable (legacy Program(fn) ergonomics).
+    """
 
     def __init__(self, fn=None, input_specs=None):
         self._fn = fn
         self._input_specs = input_specs
         self._compiled = None
+        # --- recorded-graph state ---
+        self._nodes: List[_capture.OpNode] = []
+        self._feeds: Dict[str, int] = {}          # feed name -> tensor id
+        self._vars: Dict[int, Tensor] = {}        # keep-alive + fetch map
+        self._var_names: Dict[str, int] = {}      # var name -> tensor id
+        self._params: Dict[str, Tensor] = {}      # param name -> Parameter
+        self._loss: Optional[Tensor] = None
+        self._optimizer = None
+        self._opt_state = None
+        self._grad_vars: Dict[int, str] = {}      # grad var id -> param name
+        self._replay_cache: Dict[Any, Any] = {}
 
+    # -- capture hooks (called via framework/static_capture.py) ----------
+    def _record_op(self, op_name, fn, in_tensors, out_tensors):
+        from ..framework.tensor import Parameter
+        inputs = []
+        for t in in_tensors:
+            tid = id(t)
+            self._vars.setdefault(tid, t)
+            pname = None
+            if isinstance(t, Parameter):
+                pname = t.name
+                self._params.setdefault(pname, t)
+            inputs.append((tid, t._data, pname))
+        out_ids = []
+        for t in out_tensors:
+            tid = id(t)
+            self._vars[tid] = t
+            out_ids.append(tid)
+        self._nodes.append(_capture.OpNode(op_name, fn, inputs, out_ids))
+        self._replay_cache.clear()
+
+    def _add_feed(self, name, tensor):
+        self._feeds[name] = id(tensor)
+        self._vars[id(tensor)] = tensor
+        self._var_names[name] = id(tensor)
+
+    # -- program surface -------------------------------------------------
     def __call__(self, *args):
         if self._fn is None:
             raise RuntimeError("empty Program")
@@ -82,7 +140,134 @@ class Program:
         return self._compiled(*args)
 
     def clone(self, for_test=False):
-        return Program(self._fn, self._input_specs)
+        p = Program(self._fn, self._input_specs)
+        p._nodes = list(self._nodes)
+        p._feeds = dict(self._feeds)
+        p._vars = dict(self._vars)
+        p._var_names = dict(self._var_names)
+        p._params = dict(self._params)
+        p._loss = self._loss
+        p._grad_vars = dict(self._grad_vars)
+        if not for_test:
+            p._optimizer = self._optimizer
+            p._opt_state = self._opt_state  # keep slot continuity
+        return p
+
+    def list_vars(self):
+        return list(self._vars.values())
+
+    @property
+    def num_blocks(self):
+        return 1
+
+    # -- replay ----------------------------------------------------------
+    def _resolve_fetch(self, item) -> int:
+        if isinstance(item, Tensor):
+            tid = id(item)
+            if tid in self._vars or tid in self._grad_vars:
+                return tid
+            raise KeyError(
+                f"fetch var {item.name!r} is not part of this program")
+        if isinstance(item, str):
+            if item in self._var_names:
+                return self._var_names[item]
+            raise KeyError(f"no variable named {item!r} in this program")
+        raise TypeError(f"cannot fetch {type(item).__name__}")
+
+    def _forward_env(self, feeds: Dict[str, Any], params: Dict[str, Any]):
+        """Replay the node list; returns {tensor_id: array}."""
+        env: Dict[int, Any] = {}
+        for name, tid in self._feeds.items():
+            if name in feeds:
+                env[tid] = feeds[name]
+        for name, value in params.items():
+            env[id(self._params[name])] = value
+        for node in self._nodes:
+            ins = []
+            for tid, const, pname in node.inputs:
+                if pname is not None:
+                    ins.append(params[pname])
+                elif tid in env:
+                    ins.append(env[tid])
+                else:
+                    ins.append(const)
+            out = node.fn(*ins)
+            flat = jax.tree_util.tree_leaves(out)
+            for tid, a in zip(node.out_ids, flat):
+                env[tid] = a
+        return env
+
+    def _needed_ids(self, roots) -> set:
+        """Tensor ids reachable backward from ``roots`` through the node
+        list (the reference's graph pruning for fetch targets)."""
+        needed = set(roots)
+        for node in reversed(self._nodes):
+            if any(tid in needed for tid in node.out_ids):
+                needed.update(tid for tid, _, _ in node.inputs)
+        return needed
+
+    def _execute(self, feed: Dict[str, Any], fetch_ids: Sequence[int]):
+        """One Executor.run: pure replay (+ train step when an optimizer
+        is attached), jit-compiled and cached per feed-shape signature."""
+        feed = {k: jnp.asarray(v) for k, v in feed.items()}
+        params = {n: p._data for n, p in self._params.items()}
+        train = self._optimizer is not None and self._loss is not None
+        want_grads = [tid for tid in fetch_ids if tid in self._grad_vars]
+        need_grad = train or bool(want_grads)
+
+        # a feed the requested computation depends on must actually be
+        # fed — falling back to the zero build-time placeholder would
+        # silently return garbage (reference Executor raises too)
+        roots = [t for t in fetch_ids if t not in self._grad_vars]
+        if need_grad and self._loss is not None:
+            roots.append(id(self._loss))
+        needed = self._needed_ids(roots)
+        missing = [name for name, tid in self._feeds.items()
+                   if tid in needed and name not in feed]
+        if missing:
+            raise ValueError(
+                f"feed is missing declared variable(s) {missing} required "
+                f"by the requested fetch targets")
+
+        key = (tuple(sorted((k, v.shape, str(v.dtype))
+                            for k, v in feed.items())),
+               tuple(fetch_ids), train)
+        step = self._replay_cache.get(key)
+        if step is None:
+            loss_id = id(self._loss) if self._loss is not None else None
+
+            def run_fn(feeds, params, opt_state, lr):
+                if need_grad:
+                    def loss_of(ps):
+                        env = self._forward_env(feeds, ps)
+                        return env[loss_id].astype(jnp.float32), env
+
+                    (loss, env), grads = jax.value_and_grad(
+                        loss_of, has_aux=True)(params)
+                    for tid in self._grad_vars:
+                        env[tid] = grads[self._grad_vars[tid]]
+                    if train:
+                        params, opt_state = \
+                            self._optimizer.apply_gradients(
+                                params, grads, opt_state, lr=lr)
+                else:
+                    env = self._forward_env(feeds, params)
+                fetched = [env[tid] for tid in fetch_ids]
+                return fetched, params, opt_state
+
+            step = jax.jit(run_fn)
+            self._replay_cache[key] = step
+
+        if train and self._opt_state is None:
+            self._opt_state = self._optimizer.init_state(params)
+        lr = self._optimizer.get_lr() if train else 0.0
+        fetched, new_params, new_opt_state = step(
+            feed, params, self._opt_state, jnp.asarray(lr, jnp.float32))
+        if train:
+            self._opt_state = new_opt_state
+            for n, p in self._params.items():
+                p._data = new_params[n]  # persist across run() calls
+        return [np.asarray(v) for v in fetched]
 
 
 _default_main = Program()
@@ -98,18 +283,55 @@ def default_startup_program():
 
 
 class program_guard:
+    """Route capture into ``main_program`` (reference
+    fluid/framework.py program_guard)."""
+
     def __init__(self, main_program, startup_program=None):
         self.main = main_program
+        self._prev = None
 
     def __enter__(self):
+        self._prev = _capture.current
+        _capture.set_current(self.main)
         return self.main
 
     def __exit__(self, *a):
+        _capture.set_current(self._prev)
         return False
 
 
 def data(name, shape, dtype="float32", lod_level=0):
-    return InputSpec(shape, dtype, name)
+    """Declare a feed Variable in the current program (reference
+    static/input.py data). Returns a live placeholder Tensor."""
+    prog = _capture.current or _default_main
+    placeholder = jnp.zeros(
+        tuple(1 if s in (-1, None) else int(s) for s in shape),
+        convert_dtype(dtype))
+    var = Tensor(placeholder, stop_gradient=True)
+    var.name = name
+    prog._add_feed(name, var)
+    return var
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None):
+    """Register grad computation for ``loss`` (reference
+    fluid/backward.py append_backward). Returns [(param, grad_var)] where
+    the grad vars are fetchable through Executor.run."""
+    prog = _capture.current or _default_main
+    prog._loss = loss
+    out = []
+    names = set(parameter_list or ())
+    for pname, param in prog._params.items():
+        if names and pname not in names and param not in names:
+            continue
+        gvar = Tensor(jnp.zeros_like(param._data), stop_gradient=True)
+        gvar.name = pname + "@GRAD"
+        prog._grad_vars[id(gvar)] = pname
+        prog._vars[id(gvar)] = gvar
+        prog._var_names[gvar.name] = id(gvar)
+        out.append((param, gvar))
+    return out
 
 
 def name_scope(prefix=None):
@@ -128,24 +350,47 @@ def device_guard(device=None):
 
 
 class Executor:
-    """API-parity executor: runs jitted programs / callables (reference
-    Executor.run fluid/executor.py:1109 → here XLA executes)."""
+    """Feed/fetch-by-name executor over recorded Programs (reference
+    Executor.run fluid/executor.py:1109 → here the jitted replay runs
+    through XLA)."""
 
     def __init__(self, place=None):
         self.place = place
 
-    def run(self, program=None, feed=None, fetch_list=None):
+    def run(self, program=None, feed=None, fetch_list=None,
+            return_numpy=True):
+        if program is None:
+            program = _default_main
         if callable(program) and not isinstance(program, Program):
             out = program(**(feed or {}))
-        elif isinstance(program, Program):
-            out = program(**(feed or {})) if feed else program()
-        else:
+            if fetch_list:
+                return [np.asarray(o._data if isinstance(o, Tensor) else o)
+                        for o in (out if isinstance(out, (list, tuple))
+                                  else [out])]
+            return out
+        if not isinstance(program, Program):
             raise TypeError("Executor.run needs a Program or callable")
-        if fetch_list:
-            return [np.asarray(o._data if isinstance(o, Tensor) else o)
-                    for o in (out if isinstance(out, (list, tuple))
-                              else [out])]
-        return out
+        if program._nodes:
+            # pause capture during replay: executing the program must not
+            # append to it
+            prev = _capture.current
+            _capture.set_current(None)
+            try:
+                fetch_ids = [program._resolve_fetch(f)
+                             for f in (fetch_list or [])]
+                return program._execute(feed or {}, fetch_ids)
+            finally:
+                _capture.set_current(prev)
+        if program._fn is not None:
+            out = program(**(feed or {})) if feed else program()
+            if fetch_list:
+                return [np.asarray(o._data if isinstance(o, Tensor) else o)
+                        for o in (out if isinstance(out, (list, tuple))
+                                  else [out])]
+            return out
+        # startup program / empty main: parameters were initialised
+        # eagerly at layer construction — nothing to do
+        return []
 
 
 def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,

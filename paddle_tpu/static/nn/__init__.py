@@ -165,3 +165,36 @@ def switch_case(branch_index, branch_fns, default=None, name=None):
         pos = jnp.where(idx == k, j, pos)
     out = jax.lax.switch(pos, [mk(f) for f in fns], 0)
     return _to_tensors(out, template)
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    """Fully-connected layer for static graphs (reference:
+    python/paddle/static/nn/common.py fc): dims ``x.shape[nfd:]`` flatten
+    into the weight's input axis (weight [prod(x.shape[nfd:]), size]) and
+    the output keeps the leading dims — shape ``x.shape[:nfd] + [size]``.
+    Creates fresh parameters at build time — the graph is built once, so
+    each call site is its own layer, matching the reference's unique
+    auto-named params."""
+    import numpy as _np
+    from ...nn import Linear
+    from ...nn import functional as F
+    nfd = num_flatten_dims if num_flatten_dims >= 0 \
+        else len(x.shape) + num_flatten_dims
+    in_features = int(_np.prod(x.shape[nfd:]))
+    if len(x.shape) != nfd + 1:
+        # collapse x.shape[nfd:] into one feature axis; the batch (dim 0)
+        # stays -1 so the recorded reshape replays at any batch size.
+        # Linear then maps the last axis, so the output keeps the lead
+        # dims: x.shape[:nfd] + [size], the reference contract.
+        x = x.reshape([-1] + [int(d) for d in x.shape[1:nfd]]
+                      + [in_features])
+    layer = Linear(in_features, size, weight_attr=weight_attr,
+                   bias_attr=bias_attr)
+    out = layer(x)
+    if activation is not None:
+        out = getattr(F, activation)(out)
+    return out
+
+
+__all__.append("fc")

