@@ -91,6 +91,28 @@ def _setup_pallas():
     return state
 
 
+def _tune_attention(state, batch, seq, heads, head_dim, dtype="bfloat16",
+                    is_causal=True):
+    """Measure the pallas-vs-lax crossover for this bench's attention
+    shape class on the real chip and record it in the persistent autotune
+    cache (ops/autotune_cache.py) so dispatch uses the measured winner,
+    not the heuristic. Records the outcome into the bench JSON."""
+    if not state.get("pallas"):
+        return
+    import numpy as np
+    from paddle_tpu import incubate
+    try:
+        rng = np.random.RandomState(0)
+        q = rng.randn(batch, seq, heads, head_dim).astype("float32")
+        if dtype == "bfloat16":
+            import jax.numpy as jnp
+            q = jnp.asarray(q, jnp.bfloat16)
+        state["attn_tuned"] = incubate.autotune.tune_attention(
+            q, q, q, is_causal=is_causal)
+    except Exception as e:  # tuning is best-effort
+        state["attn_tune_error"] = str(e)[-200:]
+
+
 def _timeit_async(step_fn, n_warmup, n_steps):
     """Time n_steps of an async step fn (returns a device scalar),
     blocking only on the last value. Returns (dt, last_loss_float).
@@ -133,6 +155,10 @@ def bench_gpt2(amp_o2=False):
         cfg, batch, seq = GPTConfig.gpt2_small(), 4, 1024
         cfg.hidden_dropout_prob = 0.0
         cfg.attention_dropout_prob = 0.0
+        _tune_attention(pallas_state, batch, seq,
+                        cfg.num_attention_heads,
+                        cfg.hidden_size // cfg.num_attention_heads,
+                        dtype="bfloat16" if amp_o2 else "float32")
     paddle.framework.random.seed(0)
     model = GPTForPretraining(cfg)
     if amp_o2:
@@ -234,6 +260,11 @@ def bench_bert():
         cfg.hidden_dropout_prob = 0.0
         cfg.attention_dropout_prob = 0.0
         batch, seq = 32, 128
+        # BERT's attention is bidirectional: tune the non-causal class
+        _tune_attention(pallas_state, batch, seq,
+                        cfg.num_attention_heads,
+                        cfg.hidden_size // cfg.num_attention_heads,
+                        is_causal=False)
     paddle.framework.random.seed(0)
     import paddle_tpu.nn as nn
 

@@ -1,0 +1,210 @@
+"""Elastic training: membership, heartbeats, fault detection, rebuild.
+
+Reference: python/paddle/distributed/fleet/elastic/manager.py:131 — node
+registry + heartbeats in ETCD, scale up/down by rewriting
+DISTRIBUTED_TRAINER_ENDPOINTS and restarting, exit-code-101 restart
+signalling.
+
+TPU-native shape: the registry is a tiny stdlib-TCP master (newline-JSON
+request/response, threaded) hosted by the rank-0 LAUNCHER — the ETCD role
+without the external dependency (single-master fate-sharing is the
+documented trade-off). Launch agents register their node, heartbeat on a
+thread, and poll membership; when a node's heartbeats lapse (dead host) or
+a node joins, the membership VERSION bumps and every launcher rebuilds its
+local pod against the new node list: ranks reassigned by sorted node
+order, world size rewritten, and a fresh PjRt coordination port per
+version so the re-rendezvous never collides with a stale service.
+Workers resume from their latest checkpoint — jax's coordination service
+replaces the TCPStore, sharded checkpoints (distributed/checkpoint.py)
+replace the reference's per-rank state files.
+"""
+from __future__ import annotations
+
+import json
+import os
+import socket
+import socketserver
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["ElasticMaster", "ElasticAgent", "sort_nodes"]
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def sort_nodes(nodes) -> List[str]:
+    """Rank order for a membership list: numeric node_rank suffix first,
+    then name — so the master-hosting node (node_rank 0) always gets
+    global rank 0 and the PjRt coordinator binds on its own host."""
+    def key(n: str):
+        name, _, suffix = n.rpartition("#")
+        try:
+            return (0, int(suffix), name)
+        except ValueError:
+            return (1, 0, n)
+    return sorted(nodes, key=key)
+
+
+class ElasticMaster:
+    """Membership registry + TTL sweeper (the ETCD analog).
+
+    Protocol: one JSON line request -> one JSON line response per
+    connection. Commands: register / heartbeat / leave / status.
+    """
+
+    def __init__(self, port: int, ttl: float = 6.0,
+                 sweep_interval: float = 0.5):
+        self.ttl = float(ttl)
+        self._lock = threading.Lock()
+        self._nodes: Dict[str, float] = {}   # node_id -> last heartbeat
+        self._version = 0
+        self._pjrt_port = _free_port()
+        master = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                try:
+                    line = self.rfile.readline()
+                    req = json.loads(line.decode())
+                    resp = master._handle(req)
+                except Exception as e:  # malformed request
+                    resp = {"ok": 0, "error": str(e)}
+                self.wfile.write((json.dumps(resp) + "\n").encode())
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server(("0.0.0.0", port), Handler)
+        self.port = self._server.server_address[1]
+        self._threads = [
+            threading.Thread(target=self._server.serve_forever,
+                             daemon=True),
+            threading.Thread(target=self._sweep_loop,
+                             args=(sweep_interval,), daemon=True),
+        ]
+        self._stopped = False
+        for t in self._threads:
+            t.start()
+
+    # -- state transitions -------------------------------------------------
+    def _bump(self):
+        self._version += 1
+        self._pjrt_port = _free_port()  # fresh rendezvous per membership
+
+    def _handle(self, req: dict) -> dict:
+        cmd = req.get("cmd")
+        node = req.get("node")
+        with self._lock:
+            if cmd == "register":
+                if node not in self._nodes:
+                    self._bump()
+                self._nodes[node] = time.time()
+            elif cmd == "heartbeat":
+                if node in self._nodes:
+                    self._nodes[node] = time.time()
+                else:
+                    # expired while away: re-register (scale back up)
+                    self._bump()
+                    self._nodes[node] = time.time()
+            elif cmd == "leave":
+                if node in self._nodes:
+                    del self._nodes[node]
+                    self._bump()
+            elif cmd != "status":
+                return {"ok": 0, "error": f"unknown cmd {cmd!r}"}
+            return {"ok": 1, "version": self._version,
+                    "nodes": sorted(self._nodes),
+                    "pjrt_port": self._pjrt_port}
+
+    def _sweep_loop(self, interval: float):
+        while not self._stopped:
+            time.sleep(interval)
+            now = time.time()
+            with self._lock:
+                dead = [n for n, last in self._nodes.items()
+                        if now - last > self.ttl]
+                for n in dead:
+                    del self._nodes[n]
+                if dead:
+                    self._bump()
+
+    def shutdown(self):
+        self._stopped = True
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class ElasticAgent:
+    """Launcher-side client: register + background heartbeats + membership
+    polls (reference: the elastic manager inside each launch controller).
+    """
+
+    def __init__(self, master_addr: str, node_id: str,
+                 heartbeat_interval: float = 1.0, timeout: float = 5.0):
+        host, port = master_addr.rsplit(":", 1)
+        self._addr: Tuple[str, int] = (host, int(port))
+        self.node_id = node_id
+        self._interval = heartbeat_interval
+        self._timeout = timeout
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- rpc ---------------------------------------------------------------
+    def _call(self, cmd: str) -> dict:
+        with socket.create_connection(self._addr,
+                                      timeout=self._timeout) as s:
+            s.sendall((json.dumps(
+                {"cmd": cmd, "node": self.node_id}) + "\n").encode())
+            buf = b""
+            while not buf.endswith(b"\n"):
+                chunk = s.recv(4096)
+                if not chunk:
+                    break
+                buf += chunk
+        if not buf:
+            # master died between accept and reply: surface as the same
+            # class callers already guard against
+            raise ConnectionError("empty reply from elastic master")
+        return json.loads(buf.decode())
+
+    def register(self, retries: int = 50, delay: float = 0.2) -> dict:
+        last: Exception = RuntimeError("unreached")
+        for _ in range(retries):
+            try:
+                return self._call("register")
+            except OSError as e:
+                last = e
+                time.sleep(delay)
+        raise RuntimeError(
+            f"cannot reach elastic master at {self._addr}: {last}")
+
+    def status(self) -> dict:
+        return self._call("status")
+
+    def leave(self) -> None:
+        try:
+            self._call("leave")
+        except OSError:
+            pass  # master already gone
+
+    # -- heartbeat thread --------------------------------------------------
+    def start_heartbeat(self):
+        def loop():
+            while not self._stop.wait(self._interval):
+                try:
+                    self._call("heartbeat")
+                except (OSError, ValueError):
+                    pass  # master unreachable/garbled: TTL will expire us
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop_heartbeat(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)

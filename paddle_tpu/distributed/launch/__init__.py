@@ -33,10 +33,7 @@ __all__ = ["main", "launch_local"]
 RESTART_EXIT_CODE = 101
 
 
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+from ..elastic import _free_port  # shared bind-port-0 helper  # noqa: E402
 
 
 def _parse(argv: Optional[List[str]] = None):
@@ -62,6 +59,13 @@ def _parse(argv: Optional[List[str]] = None):
     p.add_argument("--max_restarts", type=int, default=0,
                    help="elastic: respawn the local pod up to N times on "
                         "child failure")
+    p.add_argument("--elastic_master", type=str, default=None,
+                   help="elastic membership master host:port "
+                        "(node_rank 0 hosts it); enables heartbeat "
+                        "membership + rebuild-on-node-change")
+    p.add_argument("--elastic_ttl", type=float, default=6.0,
+                   help="seconds without heartbeats before a node is "
+                        "declared dead")
     p.add_argument("--backend", type=str, default=None,
                    help="override JAX_PLATFORMS for children (e.g. cpu "
                         "for mesh tests)")
@@ -72,31 +76,35 @@ def _parse(argv: Optional[List[str]] = None):
 
 class _Pod:
     """The local process group (reference launch/job/pod.py Container
-    set)."""
+    set). ``membership`` (elastic) overrides nnodes/node_rank/master with
+    the current alive-node view."""
 
-    def __init__(self, args):
+    def __init__(self, args, membership=None):
         self.args = args
+        self.membership = membership
         self.procs: List[subprocess.Popen] = []
         self.logs = []
 
     def spawn(self):
         a = self.args
-        world = a.nnodes * a.nproc_per_node
-        master = a.master
+        nnodes, node_rank, master = a.nnodes, a.node_rank, a.master
+        if self.membership is not None:
+            nnodes, node_rank, master = self.membership
+        world = nnodes * a.nproc_per_node
         if master is None:
-            if a.nnodes > 1:
+            if nnodes > 1:
                 raise SystemExit(
                     "--master ip:port is required for multi-host jobs")
             master = f"127.0.0.1:{_free_port()}"
         for local in range(a.nproc_per_node):
-            rank = a.node_rank * a.nproc_per_node + local
+            rank = node_rank * a.nproc_per_node + local
             env = dict(os.environ)
             env.update({
                 "PADDLE_TRAINER_ID": str(rank),
                 "PADDLE_TRAINERS_NUM": str(world),
                 "PADDLE_MASTER": master,
                 "PADDLE_LOCAL_RANK": str(local),
-                "PADDLE_NNODES": str(a.nnodes),
+                "PADDLE_NNODES": str(nnodes),
             })
             if a.backend:
                 env["JAX_PLATFORMS"] = a.backend
@@ -142,6 +150,8 @@ class _Pod:
 def launch_local(argv: Optional[List[str]] = None) -> int:
     """Spawn + watch + elastic-restart loop. Returns the job exit code."""
     args = _parse(argv)
+    if args.elastic_master:
+        return _launch_elastic(args)
     restarts = 0
     while True:
         pod = _Pod(args)
@@ -167,6 +177,112 @@ def launch_local(argv: Optional[List[str]] = None) -> int:
                   file=sys.stderr, flush=True)
             continue
         return int(code)
+
+
+def _launch_elastic(args) -> int:
+    """Membership-driven watch loop (reference:
+    fleet/elastic/manager.py): register with the master, heartbeat,
+    rebuild the pod whenever the alive-node set changes — ranks and world
+    size rewritten from the sorted node list, a fresh PjRt port per
+    membership version. Node_rank 0 hosts the master in-process (the
+    documented single-master trade-off vs the reference's external ETCD).
+    """
+    from ..elastic import ElasticAgent, ElasticMaster, sort_nodes
+
+    host, port = args.elastic_master.rsplit(":", 1)
+    master = None
+    if args.node_rank == 0:
+        master = ElasticMaster(int(port), ttl=args.elastic_ttl)
+    node_id = f"{host if args.node_rank == 0 else socket.gethostname()}" \
+              f"#{args.node_rank}"
+    agent = ElasticAgent(args.elastic_master, node_id)
+    agent.register()
+    agent.start_heartbeat()
+    restarts = 0
+    code = 1
+    # a peer whose master is gone for this long gives up instead of
+    # spinning forever (the single-master fate-sharing boundary)
+    master_lost_after = max(3 * args.elastic_ttl, 30.0)
+    try:
+        while True:
+            try:
+                st = agent.status()
+            except (OSError, ValueError):
+                # transient blip at rebuild time: retry via register's
+                # backoff rather than crashing the launcher
+                st = agent.register()
+            if agent.node_id not in st["nodes"]:
+                st = agent.register()  # expired while rebuilding
+            version = st["version"]
+            # node_rank-suffix order, NOT lexicographic: the node hosting
+            # the master (node_rank 0) must map to global rank 0 so the
+            # PjRt coordinator binds on its own host
+            nodes = sort_nodes(st["nodes"])
+            membership = (len(nodes), nodes.index(agent.node_id),
+                          f"{host}:{st['pjrt_port']}")
+            print(f"[launch] elastic v{version}: {len(nodes)} node(s), "
+                  f"this={membership[1]}", file=sys.stderr, flush=True)
+            pod = _Pod(args, membership=membership)
+            pod.spawn()
+            rebuild = False
+            master_lost_since = None
+            try:
+                while True:
+                    code = pod.poll()
+                    if code is not None:
+                        break
+                    try:
+                        cur = agent.status()
+                        master_lost_since = None
+                    except (OSError, ValueError):
+                        cur = None  # master briefly unreachable: keep on
+                        now = time.time()
+                        if master_lost_since is None:
+                            master_lost_since = now
+                        elif now - master_lost_since > master_lost_after:
+                            print("[launch] elastic master unreachable "
+                                  f"for {master_lost_after:.0f}s; "
+                                  "terminating", file=sys.stderr,
+                                  flush=True)
+                            pod.terminate()
+                            return 1
+                    if cur is not None and cur["version"] != version:
+                        # a node died (TTL lapse) or joined: rebuild with
+                        # rewritten world size/endpoints
+                        print("[launch] membership changed "
+                              f"(v{version} -> v{cur['version']}); "
+                              "rebuilding", file=sys.stderr, flush=True)
+                        rebuild = True
+                        break
+                    time.sleep(0.3)
+            except KeyboardInterrupt:
+                pod.terminate()
+                return 130
+            pod.terminate()
+            if rebuild:
+                continue
+            if code == 0:
+                return 0
+            if restarts < args.max_restarts:
+                restarts += 1
+                print(f"[launch] child failed with code {code}; elastic "
+                      f"restart {restarts}/{args.max_restarts}",
+                      file=sys.stderr, flush=True)
+                continue
+            return int(code)
+    finally:
+        agent.stop_heartbeat()
+        if code == 0:
+            # clean exit leaves the membership explicitly; a FAILED node
+            # just stops heartbeating, so peers detect it through the TTL
+            # sweep — the actual dead-rank path (reference: ETCD lease
+            # expiry, manager.py:131)
+            agent.leave()
+        if master is not None and code == 0:
+            # clean job end: wait briefly so peers can observe the leave
+            time.sleep(0.5)
+        if master is not None:
+            master.shutdown()
 
 
 def main():

@@ -8,7 +8,7 @@ from __future__ import annotations
 
 import json
 
-__all__ = ["set_config"]
+__all__ = ["set_config", "stats", "tune_attention"]
 
 _CONFIG = {
     "kernel": {"enable": True, "tuning_range": [1, 10]},
@@ -36,3 +36,17 @@ def set_config(config=None):
         _flags.set_flags(
             {"FLAGS_use_pallas": bool(config["kernel"]["enable"])})
     return dict(_CONFIG)
+
+
+def stats():
+    """Hit/miss/measure counters + entry count of the shape-class kernel
+    cache (reference: autotune cache stats in switch_autotune.h)."""
+    from ..ops import autotune_cache
+    return autotune_cache.stats()
+
+
+def tune_attention(q, k, v, is_causal=False):
+    """Measure pallas-vs-lax attention for this shape class and persist
+    the winner per device kind (ops/pallas_kernels.py tune_attention)."""
+    from ..ops.pallas_kernels import tune_attention as _tune
+    return _tune(q, k, v, is_causal=is_causal)

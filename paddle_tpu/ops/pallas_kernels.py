@@ -57,6 +57,10 @@ def _shape_of(x):
     return tuple(getattr(x, "shape", ()))
 
 
+def _dtype_of(x):
+    return getattr(x, "dtype", "float32")
+
+
 def _x64_off():
     """Trace-scope guard: the framework enables jax x64 globally (reference
     parity for int64/float64 tensors), but under x64 Python-int constants
@@ -652,8 +656,17 @@ def flash_attention(q, k, v, is_causal=False, scale=None,
 # Measured crossover on v5e (BENCH r3): at seq 128 XLA's native fused
 # attention beats the flash kernel (BERT 47.6 vs 35.9 steps/s — the full
 # S^2 matrix is tiny and XLA's bf16 fusion wins), while at seq 1024 the
-# flash kernel wins 1.16x (GPT-2). Dispatch to Pallas only where it pays.
+# flash kernel wins 1.16x (GPT-2). This heuristic is only the DEFAULT:
+# the shape-class autotune cache (ops/autotune_cache.py, r3 verdict
+# item 9) overrides it wherever a measured winner is recorded, and
+# tune_attention() records winners per device kind.
 FLASH_MIN_SEQ = 512
+
+
+def _sdpa_key(b, h, sq, sk, d, dtype, is_causal):
+    from . import autotune_cache as _at
+    return _at.shape_class(b * h, sq, sk, d, dtype=str(dtype),
+                           causal=bool(is_causal))
 
 
 def _fa_supported(q, k, v, mask, dropout_key, dropout_p, is_causal,
@@ -665,16 +678,50 @@ def _fa_supported(q, k, v, mask, dropout_key, dropout_p, is_causal,
     sk = ks[1]
     if is_causal and sq != sk:
         return False
-    if max(sq, sk) < FLASH_MIN_SEQ and not flag_value(
-            "FLAGS_pallas_force"):
-        return False  # short-seq: XLA's native attention is faster
     bq, bk = min(block_q, sq), min(block_k, sk)
+    # structural requirements first — an unlowrable shape never dispatches
+    # to Pallas regardless of what the cache says.
     # streaming kernels: VMEM holds only (block_q + 2*block_k) x d tiles
     # plus scratch regardless of sequence length, so there is no seq cap —
     # long context is bounded by HBM for Q/K/V themselves (e.g. 128k x 128
     # bf16 = 32MB per head-batch).
-    return (sq % bq == 0 and sk % bk == 0 and d <= 256 and
-            sq >= 8 and sk >= 8)
+    if not (sq % bq == 0 and sk % bk == 0 and d <= 256 and
+            sq >= 8 and sk >= 8):
+        return False
+    if flag_value("FLAGS_pallas_force"):
+        return True
+    from . import autotune_cache as _at
+    default = "pallas" if max(sq, sk) >= FLASH_MIN_SEQ else "lax"
+    choice = _at.choose("scaled_dot_product_attention",
+                        _sdpa_key(b, h, sq, sk, d, _dtype_of(q),
+                                  is_causal),
+                        default=default)
+    return choice == "pallas"
+
+
+def tune_attention(q, a_k, v, is_causal=False, persist=True):
+    """Measure pallas-vs-lax for this shape class on CONCRETE arrays and
+    record the winner in the autotune cache (the reference's warmup-step
+    measurement, made explicit). Returns the winning tier name."""
+    import jax.numpy as jnp
+
+    from . import autotune_cache as _at
+    from .registry import get_op
+
+    q = jnp.asarray(q._data if hasattr(q, "_data") else q)
+    a_k = jnp.asarray(a_k._data if hasattr(a_k, "_data") else a_k)
+    v = jnp.asarray(v._data if hasattr(v, "_data") else v)
+    b, sq, h, d = q.shape
+    sk = a_k.shape[1]
+    lax_fn = get_op("scaled_dot_product_attention").fn
+    jl = jax.jit(functools.partial(lax_fn, is_causal=is_causal))
+    jp = jax.jit(functools.partial(flash_attention, is_causal=is_causal))
+    return _at.measure(
+        "scaled_dot_product_attention",
+        _sdpa_key(b, h, sq, sk, d, q.dtype, is_causal),
+        {"lax": lambda: jl(q, a_k, v),
+         "pallas": lambda: jp(q, a_k, v)},
+        persist=persist)
 
 
 def _sdpa_pallas(q, k, v, mask=None, dropout_key=None, dropout_p=0.0,

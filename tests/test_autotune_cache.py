@@ -1,0 +1,127 @@
+"""Shape-class autotune cache (r3 verdict item 9).
+
+Reference: paddle/phi/kernels/autotune/cache.h (+ switch_autotune.h
+warmup measurement). Here: ops/autotune_cache.py keyed on pow2 shape
+classes, persisted per device kind, consulted by the sdpa dispatch
+predicate in ops/pallas_kernels.py.
+"""
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops import autotune_cache as at
+
+
+@pytest.fixture(autouse=True)
+def isolated_cache(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_AUTOTUNE_CACHE_DIR", str(tmp_path))
+    at.set_device_kind("testdev")
+    at.clear()
+    yield
+    at.clear()
+    at.set_device_kind(None)  # back to backend autodetection
+
+
+class TestShapeClass:
+    def test_pow2_bucketing(self):
+        assert at.shape_class(1000) == at.shape_class(1024)
+        assert at.shape_class(1025) != at.shape_class(1024)
+        assert at.shape_class(7, 100) == "8x128"
+
+    def test_tags_in_key(self):
+        a = at.shape_class(128, dtype="float32", causal=True)
+        b = at.shape_class(128, dtype="bfloat16", causal=True)
+        assert a != b
+
+
+class TestChooseRecord:
+    def test_default_then_recorded(self):
+        key = at.shape_class(4, 1024, 64)
+        assert at.choose("sdpa", key, default="lax") == "lax"
+        at.record("sdpa", key, "pallas")
+        assert at.choose("sdpa", key, default="lax") == "pallas"
+        s = at.stats()
+        assert s["hits"] == 1 and s["misses"] == 1
+
+    def test_persistence_across_reload(self):
+        key = at.shape_class(8, 512)
+        at.record("op", key, "streaming")
+        assert os.path.exists(at.cache_path())
+        # simulate a fresh process: force reload from disk
+        at.set_device_kind("testdev")
+        assert at.choose("op", key, default="lax") == "streaming"
+
+    def test_per_device_namespacing(self):
+        key = at.shape_class(16)
+        at.record("op", key, "pallas")
+        at.set_device_kind("otherdev")
+        assert at.choose("op", key, default="lax") == "lax"
+
+
+class TestMeasure:
+    def test_measure_picks_faster(self):
+        import time
+        x = jnp.ones((64, 64))
+
+        def fast():
+            return x + 1
+
+        def slow():
+            time.sleep(0.02)
+            return x + 1
+
+        win = at.measure("op", "k", {"slow": slow, "fast": fast},
+                         n_warmup=0, n_iters=1, persist=False)
+        assert win == "fast"
+        assert at.choose("op", "k", default="slow") == "fast"
+
+    def test_crashing_candidate_never_wins(self):
+        x = jnp.ones((8,))
+
+        def boom():
+            raise RuntimeError("no lowering")
+
+        win = at.measure("op", "k2", {"boom": boom,
+                                      "ok": lambda: x * 2},
+                         persist=False)
+        assert win == "ok"
+
+    def test_all_crash_raises(self):
+        with pytest.raises(RuntimeError, match="no runnable"):
+            at.measure("op", "k3",
+                       {"a": lambda: 1 / 0}, persist=False)
+
+
+class TestSdpaIntegration:
+    def test_cache_overrides_heuristic(self):
+        from paddle_tpu.framework.flags import flag_value
+        from paddle_tpu.ops.pallas_kernels import (
+            FLASH_MIN_SEQ, _fa_supported, _sdpa_key)
+        if not flag_value("FLAGS_use_pallas"):
+            pytest.skip("pallas tier disabled")
+        q = jnp.zeros((2, 128, 4, 64), jnp.float32)  # short seq
+        # heuristic default: short seq -> lax
+        assert not _fa_supported(q, q, q, None, None, 0.0, True)
+        # a recorded pallas win flips the dispatch for this shape class
+        at.record("scaled_dot_product_attention",
+                  _sdpa_key(2, 4, 128, 128, 64, q.dtype, True),
+                  "pallas", persist=False)
+        assert _fa_supported(q, q, q, None, None, 0.0, True)
+        # and a recorded lax win above the crossover flips it off
+        q2 = jnp.zeros((2, 1024, 4, 64), jnp.float32)
+        assert _fa_supported(q2, q2, q2, None, None, 0.0, True)
+        at.record("scaled_dot_product_attention",
+                  _sdpa_key(2, 4, 1024, 1024, 64, q2.dtype, True),
+                  "lax", persist=False)
+        assert not _fa_supported(q2, q2, q2, None, None, 0.0, True)
+
+    def test_tune_attention_records(self):
+        from paddle_tpu import incubate
+        rng = np.random.RandomState(0)
+        q = rng.randn(1, 128, 2, 32).astype("float32")
+        win = incubate.autotune.tune_attention(q, q, q, is_causal=True)
+        assert win in ("lax", "pallas")
+        s = incubate.autotune.stats()
+        assert s["measures"] == 1 and s["entries"] >= 1

@@ -1,6 +1,7 @@
 """Launcher tests: 2-process CPU "multi-host" job through the real CLI
 (reference analog: test_dist_base.py's subprocess-spawned trainers).
 """
+import json
 import os
 import subprocess
 import sys
@@ -130,3 +131,157 @@ class TestLaunch:
         assert logs == ["workerlog.0", "workerlog.1"]
         content = (tmp_path / "logs" / "workerlog.0").read_text()
         assert "hello from worker" in content
+
+
+class TestElasticMembership:
+    """r3 verdict item 6: heartbeat membership, dead-rank detection via
+    TTL lapse, rebuild with rewritten world size, checkpoint continuity
+    (reference: fleet/elastic/manager.py ETCD registry + scale events)."""
+
+    WORKER = """
+        import json, os, time
+        from paddle_tpu.distributed import env
+        env.init_parallel_env()
+        import jax
+        rank = env.get_rank()
+        world = env.get_world_size()
+        with open("world_log.txt", "a") as f:
+            f.write(f"{rank} {world}\\n")
+
+        N = 30
+        ckpt = "ckpt.json"
+        state = {"step": 0, "w": 0.0, "losses": []}
+        if rank == 0 and os.path.exists(ckpt):
+            state = json.load(open(ckpt))
+            with open("resume_log.txt", "a") as f:
+                f.write(f"resumed at {state['step']} world {world}\\n")
+        while state["step"] < N:
+            if world == 2 and rank == 0 and state["step"] >= 10:
+                # idle until the dead rank's TTL lapses and the launcher
+                # rebuilds us at world 1 — keeps the test timing-proof on
+                # a loaded 1-core box (training resumes post-rebuild)
+                time.sleep(0.2)
+                continue
+            w = state["w"]
+            state["losses"].append((w - 3.0) ** 2)
+            state["w"] = w - 0.2 * 2 * (w - 3.0)
+            state["step"] += 1
+            if rank == 0:
+                json.dump(state, open(ckpt, "w"))
+            if world == 2 and rank == 1 and state["step"] == 3:
+                os._exit(17)  # simulated hard rank failure
+            # slow while degraded so the rebuild catches us mid-training
+            time.sleep(0.5 if world == 2 else 0.02)
+        if rank == 0:
+            json.dump(state, open("done_0.json", "w"))
+    """
+
+    def test_dead_rank_triggers_rebuild_and_resume(self, tmp_path):
+        import socket as socketlib
+        import textwrap
+        import time as timelib
+
+        script = tmp_path / "worker.py"
+        script.write_text(textwrap.dedent(self.WORKER))
+        with socketlib.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env["PALLAS_AXON_POOL_IPS"] = ""
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+
+        def spawn(node_rank):
+            cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+                   "--nnodes", "2", "--node_rank", str(node_rank),
+                   "--elastic_master", f"127.0.0.1:{port}",
+                   "--elastic_ttl", "3", str(script)]
+            return subprocess.Popen(cmd, env=env, cwd=str(tmp_path),
+                                    stdout=subprocess.PIPE,
+                                    stderr=subprocess.PIPE, text=True)
+
+        a = spawn(0)
+        timelib.sleep(0.5)
+        b = spawn(1)
+        try:
+            b_out, b_err = b.communicate(timeout=240)
+            assert b.returncode == 17, b_err[-2000:]
+            a_out, a_err = a.communicate(timeout=240)
+            assert a.returncode == 0, a_err[-2000:]
+        finally:
+            for p in (a, b):
+                if p.poll() is None:
+                    p.kill()
+
+        # re-rendezvous: rank 0 saw world 2, then world 1 after the
+        # dead rank's heartbeats lapsed
+        worlds = (tmp_path / "world_log.txt").read_text().splitlines()
+        assert "0 2" in worlds and "0 1" in worlds, worlds
+        assert "membership changed" in a_err, a_err[-2000:]
+        # continuity: training resumed from the checkpoint, not step 0
+        resume = (tmp_path / "resume_log.txt").read_text()
+        resumed_step = int(resume.split("resumed at ")[1].split()[0])
+        assert 0 < resumed_step < 30, resume
+        done = json.loads((tmp_path / "done_0.json").read_text())
+        assert done["step"] == 30
+        losses = done["losses"]
+        assert len(losses) == 30  # no restart-from-scratch double-count
+        assert losses[-1] < losses[0]
+
+
+class TestElasticMasterUnit:
+    def test_register_heartbeat_leave_versioning(self):
+        from paddle_tpu.distributed.elastic import (ElasticAgent,
+                                                    ElasticMaster)
+        master = ElasticMaster(0, ttl=1.0, sweep_interval=0.1)
+        try:
+            a = ElasticAgent(f"127.0.0.1:{master.port}", "node#0",
+                             heartbeat_interval=0.2)
+            b = ElasticAgent(f"127.0.0.1:{master.port}", "node#1",
+                             heartbeat_interval=0.2)
+            v1 = a.register()["version"]
+            st = b.register()
+            assert st["version"] > v1
+            assert st["nodes"] == ["node#0", "node#1"]
+            port1 = st["pjrt_port"]
+            b.leave()
+            st = a.status()
+            assert st["nodes"] == ["node#0"]
+            assert st["pjrt_port"] != port1  # fresh rendezvous per change
+        finally:
+            master.shutdown()
+
+    def test_ttl_expiry_detects_dead_node(self):
+        import time as timelib
+
+        from paddle_tpu.distributed.elastic import (ElasticAgent,
+                                                    ElasticMaster)
+        master = ElasticMaster(0, ttl=0.5, sweep_interval=0.1)
+        try:
+            a = ElasticAgent(f"127.0.0.1:{master.port}", "alive#0",
+                             heartbeat_interval=0.1)
+            d = ElasticAgent(f"127.0.0.1:{master.port}", "dead#1")
+            a.register()
+            a.start_heartbeat()
+            d.register()  # never heartbeats: simulates a crashed host
+            v = a.status()["version"]
+            deadline = timelib.time() + 5
+            while timelib.time() < deadline:
+                st = a.status()
+                if st["version"] != v:
+                    break
+                timelib.sleep(0.1)
+            assert st["nodes"] == ["alive#0"], st
+            a.stop_heartbeat()
+        finally:
+            master.shutdown()
+
+    def test_sort_nodes_puts_master_host_first(self):
+        # r4 review pin: rank order must follow the node_rank suffix, not
+        # lexicographic host names — the master host (rank 0) binds the
+        # PjRt coordinator and must stay global rank 0
+        from paddle_tpu.distributed.elastic import sort_nodes
+        assert sort_nodes(["anode#1", "zmaster#0"]) == \
+            ["zmaster#0", "anode#1"]
+        assert sort_nodes(["h#2", "h#0", "h#1"]) == ["h#0", "h#1", "h#2"]
