@@ -217,14 +217,17 @@ def _embedding(ids, weight, padding_idx=None):
 # -- conv / pool ------------------------------------------------------------
 
 def _conv_dims(nd, data_format):
+    # the WEIGHT is always OIHW-family (reference layout, independent of
+    # data_format); only activations change layout. XLA accepts mixed
+    # specs like ("NHWC", "OIHW", "NHWC") directly.
     if data_format in ("NCHW", "NCL", "NCDHW"):
         spec = ("NCHW", "OIHW", "NCHW") if nd == 2 else \
                (("NCH", "OIH", "NCH") if nd == 1 else
                 ("NCDHW", "OIDHW", "NCDHW"))
     else:
-        spec = ("NHWC", "HWIO", "NHWC") if nd == 2 else \
-               (("NHC", "HIO", "NHC") if nd == 1 else
-                ("NDHWC", "DHWIO", "NDHWC"))
+        spec = ("NHWC", "OIHW", "NHWC") if nd == 2 else \
+               (("NHC", "OIH", "NHC") if nd == 1 else
+                ("NDHWC", "OIDHW", "NDHWC"))
     return spec
 
 

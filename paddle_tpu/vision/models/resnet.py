@@ -1,8 +1,11 @@
 """ResNet family (reference: python/paddle/vision/models/resnet.py).
 
-North-star configs 2 & 4 model. TPU note: NCHW is kept at the API for
-reference parity; XLA's layout assignment re-tiles weights/activations for
-the MXU internally, so no NHWC rewrite is needed at this layer.
+North-star configs 2 & 4 model. TPU note: NCHW stays the default for
+reference parity, but every model accepts ``data_format="NHWC"``
+(channels-last) — the TPU-preferred conv layout. With NHWC the whole
+network runs channels-last end to end (convs, BN, pools), so XLA tiles
+activations onto the MXU without any layout-change ops; weights stay OIHW
+(the reference layout) in both modes, so checkpoints are interchangeable.
 """
 from __future__ import annotations
 
@@ -16,15 +19,22 @@ class BasicBlock(nn.Layer):
     expansion = 1
 
     def __init__(self, inplanes, planes, stride=1, downsample=None,
-                 groups=1, base_width=64, dilation=1, norm_layer=None):
+                 groups=1, base_width=64, dilation=1, norm_layer=None,
+                 data_format="NCHW"):
         super().__init__()
-        norm_layer = norm_layer or nn.BatchNorm2D
+        if norm_layer is None:
+            # default norm gets the layout; a CUSTOM norm_layer keeps the
+            # reference's norm_layer(planes) call contract
+            import functools
+            norm_layer = functools.partial(nn.BatchNorm2D,
+                                           data_format=data_format)
+        df = {"data_format": data_format}
         self.conv1 = nn.Conv2D(inplanes, planes, 3, stride=stride,
-                               padding=1, bias_attr=False)
+                               padding=1, bias_attr=False, **df)
         self.bn1 = norm_layer(planes)
         self.relu = nn.ReLU()
         self.conv2 = nn.Conv2D(planes, planes, 3, padding=1,
-                               bias_attr=False)
+                               bias_attr=False, **df)
         self.bn2 = norm_layer(planes)
         self.downsample = downsample
         self.stride = stride
@@ -42,18 +52,23 @@ class BottleneckBlock(nn.Layer):
     expansion = 4
 
     def __init__(self, inplanes, planes, stride=1, downsample=None,
-                 groups=1, base_width=64, dilation=1, norm_layer=None):
+                 groups=1, base_width=64, dilation=1, norm_layer=None,
+                 data_format="NCHW"):
         super().__init__()
-        norm_layer = norm_layer or nn.BatchNorm2D
+        if norm_layer is None:
+            import functools
+            norm_layer = functools.partial(nn.BatchNorm2D,
+                                           data_format=data_format)
         width = int(planes * (base_width / 64.0)) * groups
-        self.conv1 = nn.Conv2D(inplanes, width, 1, bias_attr=False)
+        df = {"data_format": data_format}
+        self.conv1 = nn.Conv2D(inplanes, width, 1, bias_attr=False, **df)
         self.bn1 = norm_layer(width)
         self.conv2 = nn.Conv2D(width, width, 3, padding=dilation,
                                stride=stride, groups=groups,
-                               dilation=dilation, bias_attr=False)
+                               dilation=dilation, bias_attr=False, **df)
         self.bn2 = norm_layer(width)
         self.conv3 = nn.Conv2D(width, planes * self.expansion, 1,
-                               bias_attr=False)
+                               bias_attr=False, **df)
         self.bn3 = norm_layer(planes * self.expansion)
         self.relu = nn.ReLU()
         self.downsample = downsample
@@ -70,8 +85,12 @@ class BottleneckBlock(nn.Layer):
 
 class ResNet(nn.Layer):
     def __init__(self, block, depth=50, width=64, num_classes=1000,
-                 with_pool=True, groups=1):
+                 with_pool=True, groups=1, data_format="NCHW"):
         super().__init__()
+        if data_format not in ("NCHW", "NHWC"):
+            raise ValueError(f"data_format must be NCHW or NHWC, "
+                             f"got {data_format!r}")
+        self.data_format = data_format
         layer_cfg = {18: [2, 2, 2, 2], 34: [3, 4, 6, 3], 50: [3, 4, 6, 3],
                      101: [3, 4, 23, 3], 152: [3, 8, 36, 3]}
         layers = layer_cfg[depth]
@@ -81,17 +100,19 @@ class ResNet(nn.Layer):
         self.with_pool = with_pool
         self.inplanes = 64
         self.dilation = 1
+        df = {"data_format": data_format}
         self.conv1 = nn.Conv2D(3, self.inplanes, 7, stride=2, padding=3,
-                               bias_attr=False)
-        self.bn1 = nn.BatchNorm2D(self.inplanes)
+                               bias_attr=False, **df)
+        self.bn1 = nn.BatchNorm2D(self.inplanes, **df)
         self.relu = nn.ReLU()
-        self.maxpool = nn.MaxPool2D(3, stride=2, padding=1)
+        self.maxpool = nn.MaxPool2D(3, stride=2, padding=1, **df)
         self.layer1 = self._make_layer(block, 64, layers[0])
         self.layer2 = self._make_layer(block, 128, layers[1], stride=2)
         self.layer3 = self._make_layer(block, 256, layers[2], stride=2)
         self.layer4 = self._make_layer(block, 512, layers[3], stride=2)
         if with_pool:
-            self.avgpool = nn.AdaptiveAvgPool2D((1, 1))
+            self.avgpool = nn.AdaptiveAvgPool2D((1, 1),
+                                                data_format=data_format)
         if num_classes > 0:
             self.fc = nn.Linear(512 * block.expansion, num_classes)
 
@@ -100,15 +121,19 @@ class ResNet(nn.Layer):
         if stride != 1 or self.inplanes != planes * block.expansion:
             downsample = nn.Sequential(
                 nn.Conv2D(self.inplanes, planes * block.expansion, 1,
-                          stride=stride, bias_attr=False),
-                nn.BatchNorm2D(planes * block.expansion),
+                          stride=stride, bias_attr=False,
+                          data_format=self.data_format),
+                nn.BatchNorm2D(planes * block.expansion,
+                               data_format=self.data_format),
             )
         layers = [block(self.inplanes, planes, stride, downsample,
-                        self.groups, self.base_width)]
+                        self.groups, self.base_width,
+                        data_format=self.data_format)]
         self.inplanes = planes * block.expansion
         for _ in range(1, blocks):
             layers.append(block(self.inplanes, planes, groups=self.groups,
-                                base_width=self.base_width))
+                                base_width=self.base_width,
+                                data_format=self.data_format))
         return nn.Sequential(*layers)
 
     def forward(self, x):
