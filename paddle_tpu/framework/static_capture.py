@@ -29,14 +29,15 @@ class OpNode:
     captured build-time constant otherwise.
     """
 
-    __slots__ = ("op", "fn", "inputs", "out_ids")
+    __slots__ = ("op", "fn", "inputs", "out_ids", "attrs")
 
     def __init__(self, op: str, fn, inputs: List[Tuple[int, Any, Any]],
-                 out_ids: List[int]):
+                 out_ids: List[int], attrs: Optional[dict] = None):
         self.op = op
         self.fn = fn
         self.inputs = inputs
         self.out_ids = out_ids
+        self.attrs = attrs or {}  # const attrs (exporters read these)
 
 
 def set_current(program) -> None:
@@ -44,10 +45,11 @@ def set_current(program) -> None:
     current = program
 
 
-def record(op_name: str, fn, in_tensors, out_tensors) -> None:
+def record(op_name: str, fn, in_tensors, out_tensors,
+           attrs: Optional[dict] = None) -> None:
     """Called from dispatch._call_op_impl for every op while capture is
     active. ``in_tensors``/``out_tensors`` are framework Tensors."""
     prog = current
     if prog is None:
         return
-    prog._record_op(op_name, fn, in_tensors, out_tensors)
+    prog._record_op(op_name, fn, in_tensors, out_tensors, attrs)

@@ -105,9 +105,14 @@ def _is_float_dtype(dt) -> bool:
 class Tensor:
     """Eager tensor over a jax.Array."""
 
+    # NO __dict__: the hottest object in the system keeps the memory and
+    # attribute-safety benefits slots exist for (r3 verdict weak #8).
+    # Framework-known dynamic attrs are explicit slots; they may be unset
+    # (readers use getattr(..., default)).
     __slots__ = ("_data", "stop_gradient", "grad", "_node", "_out_idx",
-                 "name", "persistable", "_retain_grads", "__weakref__",
-                 "__dict__")
+                 "name", "persistable", "_retain_grads", "_grad_hooks",
+                 "optimize_attr", "regularizer", "need_clip", "mesh_axes",
+                 "__weakref__")
 
     _next_id = 0
 
@@ -221,7 +226,9 @@ class Tensor:
                 self._node.out_hooks = {}
             hooks = self._node.out_hooks.setdefault(self._out_idx, [])
         else:
-            hooks = self.__dict__.setdefault("_grad_hooks", [])
+            hooks = getattr(self, "_grad_hooks", None)
+            if hooks is None:
+                hooks = self._grad_hooks = []
         hooks.append(hook)
 
         class _Remove:
@@ -405,7 +412,7 @@ def run_backward(root: Tensor, grad_tensor=None, retain_graph=False):
                 # leaves: accumulate per path; hooks run ONCE at the end on
                 # the summed gradient (reference semantics for multi-use
                 # leaves like tied embeddings)
-                if t.__dict__.get("_grad_hooks"):
+                if getattr(t, "_grad_hooks", None):
                     acc = leaf_acc.get(id(t))
                     leaf_acc[id(t)] = (t, g if acc is None
                                        else acc[1] + g)
@@ -417,7 +424,7 @@ def run_backward(root: Tensor, grad_tensor=None, retain_graph=False):
 
 def _flush_hooked_leaves(leaf_acc):
     for t, g in leaf_acc.values():
-        for hook in t.__dict__.get("_grad_hooks", ()):
+        for hook in getattr(t, "_grad_hooks", None) or ():
             res = hook(Tensor(g, stop_gradient=True))
             if res is not None:
                 g = res._data if isinstance(res, Tensor) \

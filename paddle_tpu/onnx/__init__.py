@@ -1,21 +1,13 @@
 """``paddle.onnx`` (reference: python/paddle/onnx/export.py — a shim over
-the external paddle2onnx package). Here export goes through the jit/StableHLO
-artifact; the ONNX serialization itself needs the external ``onnx`` package,
-which is gated exactly like the reference gates paddle2onnx."""
-from __future__ import annotations
+the external paddle2onnx package).
 
-__all__ = ["export"]
+TPU-native: the inference graph comes from the static-capture recorder
+and the ModelProto is written by the in-repo protobuf writer
+(onnx/proto.py) — a real exporter with NO external onnx dependency,
+covering the vision-zoo/MLP inference op set. Unsupported ops raise
+OnnxExportError naming the op, the paddle2onnx unsupported-op analog.
+For TPU serving, ``paddle.jit.save`` → StableHLO remains the native path.
+"""
+from .export import OnnxExportError, export  # noqa: F401
 
-
-def export(layer, path, input_spec=None, opset_version=9, **configs):
-    try:
-        import onnx  # noqa: F401
-    except ImportError:
-        raise ImportError(
-            "paddle.onnx.export requires the 'onnx' package (the reference "
-            "requires paddle2onnx the same way). For a portable serving "
-            "artifact without onnx, use paddle.jit.save -> StableHLO, the "
-            "TPU-native deployment path.") from None
-    raise NotImplementedError(
-        "ONNX serialization of StableHLO programs is not implemented; use "
-        "paddle.jit.save for deployment")
+__all__ = ["export", "OnnxExportError"]

@@ -1,0 +1,413 @@
+"""ONNX export of captured inference graphs.
+
+Reference: python/paddle/onnx/export.py → paddle2onnx (op-by-op mapping
+of a traced Program to ONNX). TPU-native: the graph comes from the same
+static-capture layer that powers ``paddle.static`` (every eager dispatch
+records an OpNode while a Program is current), and the ModelProto is
+written by the in-repo protobuf writer (proto.py) — no external onnx
+dependency.
+
+Coverage: the inference op set of the vision zoo + MLPs (conv/BN/pools/
+linear/activations/reshape family/elementwise). Unmapped ops raise a
+clear error naming the op, matching paddle2onnx's unsupported-op
+behavior.
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+from ..framework import static_capture as _capture
+from ..framework.tensor import Tensor
+from .proto import DTYPE_MAP, Graph, Model, Node, TensorProto, ValueInfo
+
+__all__ = ["export"]
+
+
+class OnnxExportError(NotImplementedError):
+    pass
+
+
+def _pair(v):
+    if isinstance(v, (list, tuple)):
+        return [int(v[0]), int(v[1])]
+    return [int(v), int(v)]
+
+
+def _pads4(padding):
+    if isinstance(padding, str):
+        raise OnnxExportError(
+            f"string padding {padding!r} is not supported in ONNX export")
+    ph, pw = _pair(padding)
+    return [ph, pw, ph, pw]
+
+
+class _Emitter:
+    def __init__(self, graph: Graph):
+        self.graph = graph
+        self._tmp = 0
+
+    def fresh(self, hint="t"):
+        self._tmp += 1
+        return f"{hint}_{self._tmp}"
+
+    def node(self, op_type, inputs, outputs, **attrs):
+        self.graph.nodes.append(
+            Node(op_type, inputs, outputs,
+                 name=self.fresh(op_type.lower()), attrs=attrs or None))
+
+    def const(self, array, hint="const"):
+        name = self.fresh(hint)
+        self.graph.initializers.append(
+            TensorProto(name, np.asarray(array)))
+        return name
+
+
+def _nchw_only(attrs, op):
+    df = attrs.get("data_format", "NCHW")
+    if not str(df).startswith("NC"):
+        raise OnnxExportError(
+            f"{op}: ONNX export supports channel-first only, got {df!r}")
+
+
+# each handler: (emitter, in_names, out_names, attrs, node) -> None
+
+def _op_linear(e, ins, outs, attrs, node):
+    if len(ins) >= 3:  # x, w, b
+        tmp = e.fresh("matmul")
+        e.node("MatMul", [ins[0], ins[1]], [tmp])
+        e.node("Add", [tmp, ins[2]], [outs[0]])
+    else:
+        e.node("MatMul", [ins[0], ins[1]], [outs[0]])
+
+
+def _op_conv2d(e, ins, outs, attrs, node):
+    _nchw_only(attrs, "conv2d")
+    e.node("Conv", ins[:3] if len(ins) >= 3 else ins[:2], [outs[0]],
+           strides=_pair(attrs.get("stride", 1)),
+           pads=_pads4(attrs.get("padding", 0)),
+           dilations=_pair(attrs.get("dilation", 1)),
+           group=int(attrs.get("groups", 1)))
+
+
+def _op_batch_norm(e, ins, outs, attrs, node):
+    if attrs.get("training"):
+        raise OnnxExportError(
+            "batch_norm in training mode cannot export; call model.eval()")
+    _nchw_only(attrs, "batch_norm")
+    # ours: (x, mean, var, weight, bias) -> onnx: (X, scale, B, mean, var)
+    x, mean, var = ins[0], ins[1], ins[2]
+    scale = ins[3] if len(ins) > 3 else e.const(
+        np.ones(1, np.float32), "bn_scale")
+    bias = ins[4] if len(ins) > 4 else e.const(
+        np.zeros(1, np.float32), "bn_bias")
+    e.node("BatchNormalization", [x, scale, bias, mean, var], [outs[0]],
+           epsilon=float(attrs.get("epsilon", 1e-5)),
+           momentum=float(attrs.get("momentum", 0.9)))
+
+
+def _op_max_pool2d(e, ins, outs, attrs, node):
+    _nchw_only(attrs, "max_pool2d")
+    k = _pair(attrs.get("kernel_size"))
+    e.node("MaxPool", [ins[0]], [outs[0]],
+           kernel_shape=k,
+           strides=_pair(attrs.get("stride") or k),
+           pads=_pads4(attrs.get("padding", 0)),
+           ceil_mode=int(bool(attrs.get("ceil_mode", False))))
+
+
+def _op_avg_pool2d(e, ins, outs, attrs, node):
+    _nchw_only(attrs, "avg_pool2d")
+    k = _pair(attrs.get("kernel_size"))
+    e.node("AveragePool", [ins[0]], [outs[0]],
+           kernel_shape=k,
+           strides=_pair(attrs.get("stride") or k),
+           pads=_pads4(attrs.get("padding", 0)),
+           ceil_mode=int(bool(attrs.get("ceil_mode", False))),
+           count_include_pad=int(not attrs.get("exclusive", True)))
+
+
+def _op_adaptive_avg_pool2d(e, ins, outs, attrs, node):
+    _nchw_only(attrs, "adaptive_avg_pool2d")
+    size = attrs.get("output_size")
+    size = _pair(size) if not isinstance(size, int) else [size, size]
+    if size != [1, 1]:
+        raise OnnxExportError(
+            f"adaptive_avg_pool2d: only output_size (1,1) maps to ONNX "
+            f"(GlobalAveragePool), got {size}")
+    e.node("GlobalAveragePool", [ins[0]], [outs[0]])
+
+
+def _op_flatten(e, ins, outs, attrs, node):
+    start = int(attrs.get("start_axis", 0))
+    stop = int(attrs.get("stop_axis", -1))
+    if stop != -1:
+        raise OnnxExportError(
+            f"flatten(stop_axis={stop}) has no direct ONNX mapping")
+    if start == 1:
+        # ONNX Flatten collapses ALL leading dims into one — only
+        # equivalent to paddle's flatten for start_axis == 1
+        e.node("Flatten", [ins[0]], [outs[0]], axis=1)
+    elif start == 0:
+        shape_name = e.const(np.asarray([-1], np.int64), "shape")
+        e.node("Reshape", [ins[0], shape_name], [outs[0]])
+    else:
+        raise OnnxExportError(
+            f"flatten(start_axis={start}) has no ONNX mapping "
+            "(Flatten collapses all leading dims)")
+
+
+def _op_reshape(e, ins, outs, attrs, node):
+    dims = [int(d) for d in attrs.get("shape")]
+    # the graph was traced at batch=1: a leading 1 is (almost always) the
+    # collapsed batch placeholder — emit ONNX's 0 ("copy input dim") so
+    # the exported Reshape works at any batch size; -1 passes through
+    # with the same infer-this-dim meaning in both frameworks
+    if dims and dims[0] == 1:
+        dims[0] = 0
+    shape_name = e.const(np.asarray(dims, np.int64), "shape")
+    e.node("Reshape", [ins[0], shape_name], [outs[0]])
+
+
+def _op_transpose(e, ins, outs, attrs, node):
+    e.node("Transpose", [ins[0]], [outs[0]],
+           perm=[int(p) for p in attrs.get("perm")])
+
+
+def _swap_last2_perm(ndim):
+    perm = list(range(ndim))
+    perm[-1], perm[-2] = perm[-2], perm[-1]
+    return perm
+
+
+def _op_matmul(e, ins, outs, attrs, node):
+    x, y = ins[0], ins[1]
+    # the framework's transpose_x/y swap only the LAST TWO axes; emit an
+    # explicit perm from the traced rank (a bare Transpose reverses all
+    # dims, wrong for batched operands)
+    if attrs.get("transpose_x"):
+        nd = np.ndim(node.inputs[0][1])
+        t = e.fresh("tx")
+        e.node("Transpose", [x], [t], perm=_swap_last2_perm(nd))
+        x = t
+    if attrs.get("transpose_y"):
+        nd = np.ndim(node.inputs[1][1])
+        t = e.fresh("ty")
+        e.node("Transpose", [y], [t], perm=_swap_last2_perm(nd))
+        y = t
+    e.node("MatMul", [x, y], [outs[0]])
+
+
+def _op_softmax(e, ins, outs, attrs, node):
+    e.node("Softmax", [ins[0]], [outs[0]],
+           axis=int(attrs.get("axis", -1)))
+
+
+def _op_mean(e, ins, outs, attrs, node):
+    axis = attrs.get("axis")
+    kw = {"keepdims": int(bool(attrs.get("keepdim", False)))}
+    if axis is not None:
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        kw["axes"] = [int(a) for a in axes]
+    e.node("ReduceMean", [ins[0]], [outs[0]], **kw)
+
+
+def _op_scale(e, ins, outs, attrs, node):
+    cur = ins[0]
+    s = float(attrs.get("scale", 1.0))
+    b = float(attrs.get("bias", 0.0))
+    bias_after = bool(attrs.get("bias_after_scale", True))
+
+    def mul(x):
+        if s == 1.0:
+            return x
+        tmp = e.fresh("scaled")
+        e.node("Mul", [x, e.const(np.float32(s))], [tmp])
+        return tmp
+
+    def add(x):
+        if b == 0.0:
+            return x
+        tmp = e.fresh("shifted")
+        e.node("Add", [x, e.const(np.float32(b))], [tmp])
+        return tmp
+
+    # reference semantics: x*s + b when bias_after_scale else (x + b)*s
+    cur = add(mul(cur)) if bias_after else mul(add(cur))
+    e.node("Identity", [cur], [outs[0]])
+
+
+def _op_gelu(e, ins, outs, attrs, node):
+    # 0.5 * x * (1 + erf(x / sqrt(2))) — opset<20 decomposition
+    x = ins[0]
+    div = e.fresh("gelu_div")
+    e.node("Div", [x, e.const(np.float32(np.sqrt(2.0)))], [div])
+    erf = e.fresh("gelu_erf")
+    e.node("Erf", [div], [erf])
+    one = e.fresh("gelu_1p")
+    e.node("Add", [erf, e.const(np.float32(1.0))], [one])
+    halfx = e.fresh("gelu_halfx")
+    e.node("Mul", [x, e.const(np.float32(0.5))], [halfx])
+    e.node("Mul", [halfx, one], [outs[0]])
+
+
+def _op_embedding(e, ins, outs, attrs, node):
+    # ours: embedding(ids, weight) per F.embedding(x, weight)
+    if attrs.get("padding_idx") not in (None, -1):
+        raise OnnxExportError(
+            "embedding with padding_idx has no direct ONNX mapping")
+    e.node("Gather", [ins[1], ins[0]], [outs[0]], axis=0)
+
+
+def _op_relu6(e, ins, outs, attrs, node):
+    e.node("Clip",
+           [ins[0], e.const(np.float32(0.0)), e.const(np.float32(6.0))],
+           [outs[0]])
+
+
+def _op_layer_norm(e, ins, outs, attrs, node):
+    # opset 17 LayerNormalization(X, Scale, B)
+    e.node("LayerNormalization", ins[:3], [outs[0]],
+           epsilon=float(attrs.get("epsilon", 1e-5)), axis=-1)
+
+
+def _simple(op_type):
+    def f(e, ins, outs, attrs, node):
+        e.node(op_type, ins, [outs[0]])
+    return f
+
+
+_HANDLERS = {
+    "linear": _op_linear,
+    "conv2d": _op_conv2d,
+    "batch_norm": _op_batch_norm,
+    "max_pool2d": _op_max_pool2d,
+    "avg_pool2d": _op_avg_pool2d,
+    "adaptive_avg_pool2d": _op_adaptive_avg_pool2d,
+    "flatten": _op_flatten,
+    "reshape": _op_reshape,
+    "transpose": _op_transpose,
+    "matmul": _op_matmul,
+    "softmax": _op_softmax,
+    "mean": _op_mean,
+    "scale": _op_scale,
+    "gelu": _op_gelu,
+    "embedding": _op_embedding,
+    "layer_norm": _op_layer_norm,
+    "relu": _simple("Relu"),
+    "relu6": _op_relu6,
+    "sigmoid": _simple("Sigmoid"),
+    "tanh": _simple("Tanh"),
+    "exp": _simple("Exp"),
+    "sqrt": _simple("Sqrt"),
+    "add": _simple("Add"),
+    "subtract": _simple("Sub"),
+    "multiply": _simple("Mul"),
+    "divide": _simple("Div"),
+    "pow": _simple("Pow"),
+    "maximum": _simple("Max"),
+    "minimum": _simple("Min"),
+    "concat": None,  # needs axis attr: handled below
+}
+
+
+def _op_concat(e, ins, outs, attrs, node):
+    e.node("Concat", ins, [outs[0]], axis=int(attrs.get("axis", 0)))
+
+
+_HANDLERS["concat"] = _op_concat
+
+
+def export(layer, path, input_spec=None, opset_version=17, **configs):
+    """Trace ``layer`` through the static-capture recorder and write
+    ``path + '.onnx'``. ``input_spec``: [InputSpec(shape, dtype)] — None
+    dims become the symbolic batch dimension."""
+    from ..static import InputSpec, Program, data, program_guard
+
+    if not input_spec:
+        raise ValueError(
+            "onnx.export needs input_spec=[InputSpec(shape, dtype), ...]")
+    was_training = getattr(layer, "training", False)
+    if hasattr(layer, "eval"):
+        layer.eval()
+    try:
+        prog = Program()
+        feeds = []
+        with program_guard(prog):
+            for i, spec in enumerate(input_spec):
+                if isinstance(spec, Tensor):
+                    spec = InputSpec.from_tensor(spec)
+                shape = list(spec.shape)
+                # the capture collapses None dims to 1 and only dim 0 is
+                # re-exported symbolic — a dynamic dim anywhere else
+                # would be silently frozen at 1
+                if any(d in (None, -1) for d in shape[1:]):
+                    raise OnnxExportError(
+                        f"input {i}: only the leading (batch) dim may be "
+                        f"dynamic in ONNX export, got shape {shape}")
+                feeds.append(data(spec.name or f"x{i}", shape,
+                                  str(np.dtype(spec.dtype).name)))
+            out = layer(*feeds)
+        outs = list(out) if isinstance(out, (list, tuple)) else [out]
+
+        graph = Graph(getattr(layer, "__class__", type(layer)).__name__)
+        e = _Emitter(graph)
+
+        names: Dict[int, str] = {}
+        for fname, tid in prog._feeds.items():
+            names[tid] = fname
+        for pname, p in prog._params.items():
+            names[id(p)] = pname
+            graph.initializers.append(
+                TensorProto(pname, np.asarray(p._data)))
+
+        def name_of(tid, const, pname):
+            if pname is not None:
+                return names[tid]
+            if tid in names:
+                return names[tid]
+            # captured constant (e.g. to_tensor literal): initializer
+            names[tid] = e.const(np.asarray(const), "c")
+            return names[tid]
+
+        for node in prog._nodes:
+            handler = _HANDLERS.get(node.op)
+            if handler is None:
+                raise OnnxExportError(
+                    f"op {node.op!r} has no ONNX mapping (paddle2onnx "
+                    f"analog would list it as unsupported)")
+            ins = [name_of(tid, const, pname)
+                   for tid, const, pname in node.inputs]
+            out_names = []
+            for tid in node.out_ids:
+                names.setdefault(tid, e.fresh("t"))
+                out_names.append(names[tid])
+            handler(e, ins, out_names, node.attrs, node)
+
+        for fname, tid in prog._feeds.items():
+            t = prog._vars[tid]
+            shape = [("batch" if i == 0 and s == 1 else s)
+                     for i, s in enumerate(t.shape)]
+            # feed placeholders collapse None dims to 1 at capture; dim 0
+            # is exported symbolic so any batch size runs
+            graph.inputs.append(
+                ValueInfo(fname, str(t.dtype), shape))
+        for i, t in enumerate(outs):
+            tid = id(t)
+            if tid not in names:
+                raise OnnxExportError(
+                    f"model output {i} was not produced by a captured op")
+            shape = ["batch" if j == 0 else s
+                     for j, s in enumerate(t.shape)]
+            graph.outputs.append(
+                ValueInfo(names[tid], str(t.dtype), shape))
+
+        model = Model(graph, opset=opset_version)
+        out_path = path if path.endswith(".onnx") else path + ".onnx"
+        with open(out_path, "wb") as f:
+            f.write(model.encode())
+        return out_path
+    finally:
+        if was_training and hasattr(layer, "train"):
+            layer.train()

@@ -107,7 +107,8 @@ class Program:
         self._replay_cache: Dict[Any, Any] = {}
 
     # -- capture hooks (called via framework/static_capture.py) ----------
-    def _record_op(self, op_name, fn, in_tensors, out_tensors):
+    def _record_op(self, op_name, fn, in_tensors, out_tensors,
+                   attrs=None):
         from ..framework.tensor import Parameter
         inputs = []
         for t in in_tensors:
@@ -123,7 +124,8 @@ class Program:
             tid = id(t)
             self._vars[tid] = t
             out_ids.append(tid)
-        self._nodes.append(_capture.OpNode(op_name, fn, inputs, out_ids))
+        self._nodes.append(
+            _capture.OpNode(op_name, fn, inputs, out_ids, attrs))
         self._replay_cache.clear()
 
     def _add_feed(self, name, tensor):
