@@ -28,7 +28,8 @@ __all__ = ["Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
            "SequenceSampler", "RandomSampler", "WeightedRandomSampler",
            "BatchSampler", "DistributedBatchSampler", "DataLoader",
            "get_worker_info", "default_collate_fn", "device_prefetch",
-           "DeviceDataLoader"]
+           "DeviceDataLoader", "BucketedBatchSampler",
+           "pad_sequence_collate_fn"]
 
 
 class Dataset:
@@ -259,6 +260,132 @@ class DistributedBatchSampler(BatchSampler):
         if self.drop_last:
             return self.num_samples // self.batch_size
         return (self.num_samples + self.batch_size - 1) // self.batch_size
+
+
+class BucketedBatchSampler(BatchSampler):
+    """Length-bucketed batching for variable-length data — the DataLoader
+    half of the TPU-native LoD replacement (ops/sequence_ops.py is the
+    compute half).
+
+    The reference carries ragged batches as LoDTensors
+    (/root/reference/paddle/fluid/framework/lod_tensor.h:1) so it never
+    pads; on TPU every batch must have a static shape, and naive padding
+    to the corpus max wastes compute while per-batch maxlens force one
+    XLA recompile per distinct length. This sampler does the standard
+    TPU resolution: sort-ish grouping by length into a FIXED, small set
+    of bucket boundaries, so (a) padding waste is bounded by the bucket
+    granularity and (b) the train step compiles once per bucket, not
+    once per batch.
+
+    Sample lengths come from (in priority order) ``lengths`` — a
+    precomputed sequence, so datasets whose ``__getitem__`` does real
+    work (file decode, tokenization) are never materialized just to be
+    measured — or ``length_fn(dataset[i]) -> int`` (default:
+    ``len(sample[0])``). ``bucket_boundaries`` are the padded lengths;
+    samples longer than the last boundary are dropped (counted in
+    ``n_dropped``).
+
+    DataLoader integration: pass this as ``batch_sampler`` together with
+    ``collate_fn=pad_sequence_collate_fn(boundaries=...)`` — because all
+    samples of a batch share one bucket, the collate fn recovers the
+    bucket's static padded shape by rounding the batch max length up to
+    the nearest boundary; no side channel is needed. For hand-rolled
+    loops ``yield_boundary=True`` yields (indices, boundary) pairs
+    instead (NOT valid as a DataLoader batch_sampler).
+    """
+
+    def __init__(self, dataset, batch_size, bucket_boundaries,
+                 length_fn=None, lengths=None, shuffle=True,
+                 drop_last=False, seed=0, yield_boundary=False):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.bucket_boundaries = sorted(int(b) for b in bucket_boundaries)
+        self.length_fn = length_fn or (lambda s: len(s[0]))
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.seed = seed
+        self.epoch = 0
+        self.yield_boundary = yield_boundary
+        # bucket assignment is data-dependent but cheap; do it once
+        self._buckets = {b: [] for b in self.bucket_boundaries}
+        self.n_dropped = 0
+        for i in range(len(dataset)):
+            ln = int(lengths[i]) if lengths is not None \
+                else self.length_fn(dataset[i])
+            for b in self.bucket_boundaries:
+                if ln <= b:
+                    self._buckets[b].append(i)
+                    break
+            else:
+                self.n_dropped += 1
+
+    def set_epoch(self, epoch):
+        self.epoch = epoch
+
+    def __iter__(self):
+        g = np.random.RandomState(self.seed + self.epoch)
+        batches = []
+        for b, idxs in self._buckets.items():
+            idxs = list(idxs)
+            if self.shuffle:
+                g.shuffle(idxs)
+            for k in range(0, len(idxs), self.batch_size):
+                chunk = idxs[k:k + self.batch_size]
+                if self.drop_last and len(chunk) < self.batch_size:
+                    continue
+                batches.append((chunk, b))
+        if self.shuffle:
+            g.shuffle(batches)
+        for chunk, b in batches:
+            yield (chunk, b) if self.yield_boundary else chunk
+
+    def __len__(self):
+        n = 0
+        for idxs in self._buckets.values():
+            if self.drop_last:
+                n += len(idxs) // self.batch_size
+            else:
+                n += (len(idxs) + self.batch_size - 1) // self.batch_size
+        return n
+
+
+def pad_sequence_collate_fn(boundary=None, pad_value=0,
+                            length_dtype="int64", boundaries=None):
+    """Collate variable-length samples to a dense (batch, maxlen, ...)
+    array + lengths vector — the producer side of sequence_pad. Each
+    sample is (sequence, *rest); rest fields are stacked unchanged.
+
+    The padded length is either ``boundary`` (fixed) or, with
+    ``boundaries``, the smallest boundary >= the batch's max length —
+    the DataLoader-compatible form: BucketedBatchSampler guarantees each
+    batch stays within one bucket, so rounding up reproduces the
+    bucket's static shape without a side channel (one XLA compile per
+    bucket, not per batch)."""
+    if (boundary is None) == (boundaries is None):
+        raise ValueError("pass exactly one of boundary= or boundaries=")
+    bset = sorted(int(b) for b in boundaries) if boundaries else None
+
+    def collate(batch):
+        bsz = len(batch)
+        first = np.asarray(batch[0][0])
+        if bset is not None:
+            mx = max(len(np.asarray(s[0])) for s in batch)
+            pad_to = next((b for b in bset if mx <= b), bset[-1])
+        else:
+            pad_to = boundary
+        out = np.full((bsz, pad_to) + first.shape[1:], pad_value,
+                      dtype=first.dtype)
+        lengths = np.zeros((bsz,), dtype=length_dtype)
+        for i, sample in enumerate(batch):
+            seq = np.asarray(sample[0])
+            ln = min(len(seq), pad_to)
+            out[i, :ln] = seq[:ln]
+            lengths[i] = ln
+        rest = [np.stack([np.asarray(s[j]) for s in batch])
+                for j in range(1, len(batch[0]))]
+        return (out, lengths, *rest)
+
+    return collate
 
 
 # ---------------------------------------------------------------------------

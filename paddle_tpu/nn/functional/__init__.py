@@ -264,6 +264,67 @@ def sequence_mask(x, maxlen=None, dtype="int64", name=None):
 
 
 # ---------------------------------------------------------------------------
+# variable-length sequence ops over the dense (padded, lengths) encoding —
+# the TPU-native LoD replacement (ops/sequence_ops.py; reference:
+# paddle/fluid/operators/sequence_ops/)
+# ---------------------------------------------------------------------------
+
+@_export
+def sequence_pad(x, lengths, pad_value=0.0, maxlen=None, name=None):
+    return _op("sequence_pad", x, lengths, pad_value=pad_value,
+               maxlen=maxlen)
+
+
+@_export
+def sequence_unpad(x, lengths, total_length=None, name=None):
+    return _op("sequence_unpad", x, lengths, total_length=total_length)
+
+
+@_export
+def sequence_pool(x, lengths, pool_type="sum", name=None):
+    return _op("sequence_pool", x, lengths, pool_type=pool_type)
+
+
+@_export
+def sequence_softmax(x, lengths, name=None):
+    return _op("sequence_softmax", x, lengths)
+
+
+@_export
+def sequence_reverse(x, lengths, name=None):
+    return _op("sequence_reverse", x, lengths)
+
+
+@_export
+def sequence_expand(x, ref_lengths, maxlen=None, name=None):
+    return _op("sequence_expand", x, ref_lengths, maxlen=maxlen)
+
+
+@_export
+def sequence_slice(x, lengths, offset, length, maxlen=None, name=None):
+    return _op("sequence_slice", x, lengths, offset, length, maxlen=maxlen)
+
+
+@_export
+def sequence_enumerate(ids, lengths, win_size, pad_value=0, name=None):
+    return _op("sequence_enumerate", ids, lengths, win_size=win_size,
+               pad_value=pad_value)
+
+
+@_export
+def sequence_concat(xs, lengths_list, maxlen=None, name=None):
+    return _op("sequence_concat", xs, lengths_list, maxlen=maxlen)
+
+
+@_export
+def sequence_conv(x, lengths, weight, bias=None, context_length=3,
+                  context_start=None, pad_value=0.0, name=None):
+    return _op("sequence_conv", x, lengths, weight, bias,
+               context_length=context_length, context_start=context_start,
+               pad_value=pad_value)
+
+
+# ---------------------------------------------------------------------------
 # conv / pooling (reference: conv.py, pooling.py)
 # ---------------------------------------------------------------------------
 

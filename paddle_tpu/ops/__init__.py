@@ -14,4 +14,5 @@ from . import linalg_ops  # noqa: F401
 from . import nn_ops  # noqa: F401
 from . import extra_nn_ops  # noqa: F401
 from . import extra_math_ops  # noqa: F401
+from . import sequence_ops  # noqa: F401
 from . import pallas_kernels  # noqa: F401  (registers TPU overrides)

@@ -1,0 +1,288 @@
+"""Variable-length sequence ops — the TPU-native LoD replacement.
+
+The reference models ragged batches with LoDTensor
+(/root/reference/paddle/fluid/framework/lod_tensor.h:1): one flat value
+tensor plus level-of-detail offsets, and a family of sequence ops that
+walk those offsets per sequence
+(/root/reference/paddle/fluid/operators/sequence_ops/sequence_pad_op.cc:1
+and pool/expand/softmax/conv/reverse/slice siblings).
+
+Offset-walking scalar loops don't map to the MXU, and dynamic per-batch
+shapes defeat XLA compilation. The TPU-native encoding is therefore:
+
+  * a DENSE padded tensor   x : (batch, maxlen, ...)   — static maxlen
+  * a lengths vector        lengths : (batch,) int32/int64
+
+Every op here consumes/produces that pair with masking, so the whole
+family jit-compiles to fused vector code with no data-dependent shapes.
+``sequence_pad``/``sequence_unpad`` convert between the reference's flat
+(packed) encoding and the dense one; the DataLoader's bucketing sampler
+(io.BucketedBatchSampler) bounds the padding waste by grouping samples
+of similar length, quantizing maxlen to a few bucket boundaries so each
+bucket compiles once (SURVEY.md §7 "hard parts": padding/bucketing baked
+into the DataLoader).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op
+
+_NEG_INF = -1e30
+
+
+def _mask2d(lengths, maxlen):
+    """(batch, maxlen) validity mask from a lengths vector."""
+    r = jnp.arange(maxlen)
+    return r[None, :] < lengths.reshape(-1, 1)
+
+
+def _expand_mask(mask, x):
+    """Broadcast a (batch, maxlen) mask over x's trailing feature dims."""
+    return mask.reshape(mask.shape + (1,) * (x.ndim - 2))
+
+
+def _offsets(lengths):
+    """Exclusive cumsum: start offset of each sequence in the packed
+    layout (the analog of the reference's LoD level-0 offsets)."""
+    return jnp.concatenate(
+        [jnp.zeros((1,), lengths.dtype), jnp.cumsum(lengths)[:-1]])
+
+
+# ---------------------------------------------------------------------------
+# pack <-> pad conversion
+# ---------------------------------------------------------------------------
+
+@register_op("sequence_pad")
+def _sequence_pad(flat, lengths, pad_value=0.0, maxlen=None):
+    """Packed (total, ...) + lengths -> dense (batch, maxlen, ...).
+
+    Reference: sequence_ops/sequence_pad_op.cc:1 (LoDTensor -> padded).
+    ``maxlen`` must be static (jit); positions past each length hold
+    ``pad_value``. A pure gather: out[b, t] = flat[off[b] + t].
+    """
+    if maxlen is None:
+        raise ValueError("sequence_pad: maxlen must be a static int "
+                         "(dynamic output shapes cannot compile)")
+    m = int(maxlen)
+    idx = _offsets(lengths)[:, None] + jnp.arange(m)[None, :]
+    idx = jnp.clip(idx, 0, flat.shape[0] - 1)
+    out = jnp.take(flat, idx.reshape(-1), axis=0).reshape(
+        (lengths.shape[0], m) + flat.shape[1:])
+    mask = _expand_mask(_mask2d(lengths, m), out)
+    return jnp.where(mask, out, jnp.asarray(pad_value, out.dtype))
+
+
+@register_op("sequence_unpad")
+def _sequence_unpad(x, lengths, total_length=None):
+    """Dense (batch, maxlen, ...) -> packed (total_length, ...).
+
+    Reference: sequence_ops/sequence_unpad_op.cc. ``total_length`` must
+    be static under jit; rows past sum(lengths) are zero-filled. The
+    packed row i lives at (b, t) with b = searchsorted(ends, i) and
+    t = i - off[b].
+    """
+    batch, maxlen = x.shape[0], x.shape[1]
+    total = int(total_length) if total_length is not None \
+        else batch * maxlen
+    ends = jnp.cumsum(lengths)
+    i = jnp.arange(total)
+    b = jnp.searchsorted(ends, i, side="right")
+    b = jnp.clip(b, 0, batch - 1)
+    t = i - _offsets(lengths)[b]
+    valid = i < ends[-1]
+    t = jnp.clip(t, 0, maxlen - 1)
+    out = x[b, t]
+    vm = valid.reshape((total,) + (1,) * (x.ndim - 2))
+    return jnp.where(vm, out, jnp.zeros((), x.dtype))
+
+
+# ---------------------------------------------------------------------------
+# masked reductions / normalization
+# ---------------------------------------------------------------------------
+
+@register_op("sequence_pool")
+def _sequence_pool(x, lengths, pool_type="sum"):
+    """Per-sequence reduction over the time axis.
+
+    Reference: sequence_ops/sequence_pool_op.cc (SUM/MEAN/MAX/MIN/
+    SQRT/FIRST/LAST over each LoD span) — here a masked reduce over
+    axis 1 of the dense layout.
+    """
+    pt = pool_type.lower()
+    maxlen = x.shape[1]
+    mask = _expand_mask(_mask2d(lengths, maxlen), x)
+    ln = jnp.maximum(lengths, 1).astype(
+        x.dtype if jnp.issubdtype(x.dtype, jnp.floating) else jnp.float32)
+    ln = ln.reshape((-1,) + (1,) * (x.ndim - 2))
+    if jnp.issubdtype(x.dtype, jnp.floating):
+        lo = jnp.asarray(_NEG_INF, x.dtype)
+    else:  # keep integer dtypes integer (no silent float64 promotion)
+        lo = jnp.asarray(jnp.iinfo(x.dtype).min, x.dtype)
+    if pt == "sum":
+        return jnp.where(mask, x, 0).sum(axis=1)
+    if pt == "average" or pt == "mean":
+        return jnp.where(mask, x, 0).sum(axis=1) / ln
+    if pt == "sqrt":
+        return jnp.where(mask, x, 0).sum(axis=1) / jnp.sqrt(ln)
+    if pt == "max":
+        return jnp.where(mask, x, lo).max(axis=1)
+    if pt == "min":
+        hi = -lo if jnp.issubdtype(x.dtype, jnp.floating) else \
+            jnp.asarray(jnp.iinfo(x.dtype).max, x.dtype)
+        return jnp.where(mask, x, hi).min(axis=1)
+    if pt == "first":
+        return x[:, 0]
+    if pt == "last":
+        idx = jnp.maximum(lengths - 1, 0)
+        return jnp.take_along_axis(
+            x, idx.reshape((-1, 1) + (1,) * (x.ndim - 2)).astype(jnp.int32),
+            axis=1).squeeze(1)
+    raise ValueError(f"sequence_pool: unknown pool_type {pool_type!r}")
+
+
+@register_op("sequence_softmax")
+def _sequence_softmax(x, lengths):
+    """Masked softmax over the time axis (axis 1); padded positions get
+    probability 0. Reference: sequence_ops/sequence_softmax_op.cc."""
+    mask = _expand_mask(_mask2d(lengths, x.shape[1]), x)
+    logits = jnp.where(mask, x, _NEG_INF)
+    m = logits.max(axis=1, keepdims=True)
+    e = jnp.exp(logits - lax.stop_gradient(m))
+    e = jnp.where(mask, e, 0)
+    return e / jnp.maximum(e.sum(axis=1, keepdims=True), 1e-30)
+
+
+# ---------------------------------------------------------------------------
+# reordering / expansion
+# ---------------------------------------------------------------------------
+
+@register_op("sequence_reverse")
+def _sequence_reverse(x, lengths):
+    """Reverse each valid prefix; padding stays in place.
+    Reference: sequence_ops/sequence_reverse_op.h."""
+    maxlen = x.shape[1]
+    t = jnp.arange(maxlen)[None, :]
+    ln = lengths.reshape(-1, 1)
+    src = jnp.where(t < ln, ln - 1 - t, t).astype(jnp.int32)
+    return jnp.take_along_axis(
+        x, src.reshape(src.shape + (1,) * (x.ndim - 2)), axis=1)
+
+
+@register_op("sequence_expand")
+def _sequence_expand(x, ref_lengths, maxlen=None):
+    """Broadcast per-sequence features across timesteps: (batch, d...) ->
+    (batch, maxlen, d...), valid for t < ref_lengths[b], zero after.
+
+    Reference: sequence_ops/sequence_expand_op.cc — the common case
+    (expand a one-step sequence to the length of a reference sequence).
+    The general two-level-LoD form collapses to this under the dense
+    encoding.
+    """
+    if maxlen is None:
+        raise ValueError("sequence_expand: maxlen must be a static int")
+    m = int(maxlen)
+    out = jnp.broadcast_to(
+        x[:, None], (x.shape[0], m) + x.shape[1:])
+    mask = _expand_mask(_mask2d(ref_lengths, m), out)
+    return jnp.where(mask, out, jnp.zeros((), x.dtype))
+
+
+@register_op("sequence_slice")
+def _sequence_slice(x, lengths, offset, length, maxlen=None):
+    """Per-sequence slice: out[b, t] = x[b, offset[b] + t] for
+    t < length[b]. Reference: sequence_ops/sequence_slice_op.h. The
+    output time axis is ``maxlen`` (static; default: input maxlen)."""
+    m = int(maxlen) if maxlen is not None else x.shape[1]
+    off = jnp.asarray(offset).reshape(-1, 1)
+    ln = jnp.asarray(length).reshape(-1, 1)
+    t = jnp.arange(m)[None, :]
+    src = jnp.clip(off + t, 0, x.shape[1] - 1).astype(jnp.int32)
+    out = jnp.take_along_axis(
+        x, src.reshape(src.shape + (1,) * (x.ndim - 2)), axis=1)
+    mask = _expand_mask(t < ln, out)
+    return jnp.where(mask, out, jnp.zeros((), x.dtype))
+
+
+@register_op("sequence_enumerate", nondiff=True)
+def _sequence_enumerate(ids, lengths, win_size, pad_value=0):
+    """Sliding windows of token ids: (batch, maxlen) int ->
+    (batch, maxlen, win_size); window positions past the sequence end
+    (or window cells past it) hold ``pad_value``.
+    Reference: sequence_ops/sequence_enumerate_op.cc."""
+    maxlen = ids.shape[1]
+    w = int(win_size)
+    t = jnp.arange(maxlen)[:, None] + jnp.arange(w)[None, :]  # (T, W)
+    src = jnp.clip(t, 0, maxlen - 1)
+    out = ids[:, src]  # (B, T, W)
+    ln = lengths.reshape(-1, 1, 1)
+    valid = t[None] < ln
+    return jnp.where(valid, out, jnp.asarray(pad_value, ids.dtype))
+
+
+# ---------------------------------------------------------------------------
+# sequence conv — context-window projection (an MXU-friendly matmul)
+# ---------------------------------------------------------------------------
+
+@register_op("sequence_conv")
+def _sequence_conv(x, lengths, weight, bias=None, context_length=3,
+                   context_start=None, pad_value=0.0):
+    """Context-window convolution over each sequence.
+
+    Reference: sequence_ops/sequence_conv_op.cc — im2col over each LoD
+    span then GEMM with a (context_length*d, out) filter. Dense version:
+    zero the padding, stack ``context_length`` shifted copies along the
+    feature axis, one matmul. Timesteps outside a sequence contribute
+    ``pad_value`` exactly as the reference's sequence-boundary padding.
+
+    x: (batch, maxlen, d_in); weight: (context_length * d_in, d_out).
+    """
+    cl = int(context_length)
+    cs = int(context_start) if context_start is not None else -(cl // 2)
+    mask = _expand_mask(_mask2d(lengths, x.shape[1]), x)
+    xz = jnp.where(mask, x, jnp.asarray(pad_value, x.dtype))
+    cols = []
+    for k in range(cl):
+        shift = cs + k
+        rolled = jnp.roll(xz, -shift, axis=1)
+        t = jnp.arange(x.shape[1])
+        inside = (t + shift >= 0) & (t + shift < x.shape[1])
+        rolled = jnp.where(
+            inside.reshape((1, -1) + (1,) * (x.ndim - 2)), rolled,
+            jnp.asarray(pad_value, x.dtype))
+        cols.append(rolled)
+    stacked = jnp.concatenate(cols, axis=-1)  # (B, T, cl*d)
+    out = jnp.einsum("btd,do->bto", stacked, weight,
+                     preferred_element_type=jnp.float32).astype(x.dtype)
+    if bias is not None:
+        out = out + bias
+    # padded output positions are zeroed (they carry no sequence data)
+    omask = _expand_mask(_mask2d(lengths, x.shape[1]), out)
+    return jnp.where(omask, out, jnp.zeros((), out.dtype))
+
+
+@register_op("sequence_concat")
+def _sequence_concat(xs, lengths_list, maxlen=None):
+    """Concatenate sequences element-wise across inputs: output sequence
+    b = concat(x1[b][:l1[b]], x2[b][:l2[b]], ...). Reference:
+    sequence_ops/sequence_concat_op.cc. Returns (padded, total_lengths).
+    ``maxlen`` static; default sum of input maxlens."""
+    m = int(maxlen) if maxlen is not None else sum(x.shape[1] for x in xs)
+    total_len = sum(lengths_list)
+    batch = xs[0].shape[0]
+    # build by scattering each input at its running offset
+    out = jnp.zeros((batch, m) + xs[0].shape[2:], xs[0].dtype)
+    t = jnp.arange(m)[None, :]
+    running = jnp.zeros((batch, 1), lengths_list[0].dtype)
+    for x, ln in zip(xs, lengths_list):
+        lnc = ln.reshape(-1, 1)
+        # position t in out takes x[b, t - running[b]] when
+        # running <= t < running + ln
+        src = jnp.clip(t - running, 0, x.shape[1] - 1).astype(jnp.int32)
+        gathered = jnp.take_along_axis(
+            x, src.reshape(src.shape + (1,) * (x.ndim - 2)), axis=1)
+        sel = (t >= running) & (t < running + lnc)
+        out = jnp.where(_expand_mask(sel, out), gathered, out)
+        running = running + lnc
+    return out, total_len

@@ -1,0 +1,387 @@
+"""Variable-length sequence ops + bucketing DataLoader tests.
+
+The TPU-native replacement for the reference's LoDTensor machinery
+(/root/reference/paddle/fluid/framework/lod_tensor.h:1) and sequence-op
+family (/root/reference/paddle/fluid/operators/sequence_ops/). Parity is
+checked against per-example numpy computation over ragged python lists —
+the ground truth the reference computes by walking LoD offsets.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu import io
+
+rng = np.random.RandomState(0)
+
+
+def _ragged(batch=4, maxlen=7, d=3, seed=0):
+    g = np.random.RandomState(seed)
+    lengths = g.randint(1, maxlen + 1, batch)
+    seqs = [g.randn(ln, d).astype("float32") for ln in lengths]
+    padded = np.zeros((batch, maxlen, d), "float32")
+    for i, s in enumerate(seqs):
+        padded[i, : len(s)] = s
+    return seqs, padded, lengths.astype("int64")
+
+
+class TestPadUnpad:
+    def test_pad_matches_manual(self):
+        seqs, padded, lengths = _ragged()
+        flat = np.concatenate(seqs, axis=0)
+        out = F.sequence_pad(paddle.to_tensor(flat),
+                             paddle.to_tensor(lengths),
+                             pad_value=0.0, maxlen=7)
+        np.testing.assert_allclose(out.numpy(), padded, rtol=1e-6)
+
+    def test_pad_value(self):
+        seqs, _, lengths = _ragged()
+        flat = np.concatenate(seqs, axis=0)
+        out = F.sequence_pad(paddle.to_tensor(flat),
+                             paddle.to_tensor(lengths),
+                             pad_value=-5.0, maxlen=9).numpy()
+        for i, ln in enumerate(lengths):
+            assert (out[i, ln:] == -5.0).all()
+
+    def test_unpad_roundtrip(self):
+        seqs, padded, lengths = _ragged()
+        flat = np.concatenate(seqs, axis=0)
+        out = F.sequence_unpad(paddle.to_tensor(padded),
+                               paddle.to_tensor(lengths),
+                               total_length=len(flat))
+        np.testing.assert_allclose(out.numpy(), flat, rtol=1e-6)
+
+    def test_unpad_zero_fills_tail(self):
+        _, padded, lengths = _ragged()
+        total = int(lengths.sum())
+        out = F.sequence_unpad(paddle.to_tensor(padded),
+                               paddle.to_tensor(lengths),
+                               total_length=total + 5).numpy()
+        assert (out[total:] == 0).all()
+
+
+class TestPool:
+    @pytest.mark.parametrize("pt,np_fn", [
+        ("sum", lambda s: s.sum(0)),
+        ("mean", lambda s: s.mean(0)),
+        ("sqrt", lambda s: s.sum(0) / np.sqrt(len(s))),
+        ("max", lambda s: s.max(0)),
+        ("min", lambda s: s.min(0)),
+        ("first", lambda s: s[0]),
+        ("last", lambda s: s[-1]),
+    ])
+    def test_parity(self, pt, np_fn):
+        seqs, padded, lengths = _ragged(seed=3)
+        ref = np.stack([np_fn(s) for s in seqs])
+        out = F.sequence_pool(paddle.to_tensor(padded),
+                              paddle.to_tensor(lengths), pool_type=pt)
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5, atol=1e-6)
+
+    def test_grad_masks_padding(self):
+        _, padded, lengths = _ragged(seed=4)
+        x = paddle.to_tensor(padded, stop_gradient=False)
+        out = F.sequence_pool(x, paddle.to_tensor(lengths), pool_type="sum")
+        out.backward(paddle.to_tensor(np.ones(out.shape, "float32")))
+        g = x.grad.numpy()
+        for i, ln in enumerate(lengths):
+            assert (g[i, :ln] == 1.0).all()
+            assert (g[i, ln:] == 0.0).all()
+
+    def test_mean_grad(self):
+        seqs, padded, lengths = _ragged(seed=5)
+        x = paddle.to_tensor(padded, stop_gradient=False)
+        out = F.sequence_pool(x, paddle.to_tensor(lengths), pool_type="mean")
+        out.backward(paddle.to_tensor(np.ones(out.shape, "float32")))
+        g = x.grad.numpy()
+        for i, ln in enumerate(lengths):
+            np.testing.assert_allclose(g[i, :ln], 1.0 / ln, rtol=1e-5)
+            assert (g[i, ln:] == 0.0).all()
+
+
+class TestSoftmaxReverse:
+    def test_softmax_parity(self):
+        seqs, padded, lengths = _ragged(d=1, seed=6)
+        out = F.sequence_softmax(paddle.to_tensor(padded),
+                                 paddle.to_tensor(lengths)).numpy()
+        for i, s in enumerate(seqs):
+            e = np.exp(s - s.max(0))
+            np.testing.assert_allclose(out[i, : len(s)], e / e.sum(0),
+                                       rtol=1e-5)
+            assert (out[i, len(s):] == 0).all()
+            np.testing.assert_allclose(out[i].sum(), 1.0, rtol=1e-5)
+
+    def test_reverse_parity(self):
+        seqs, padded, lengths = _ragged(seed=7)
+        out = F.sequence_reverse(paddle.to_tensor(padded),
+                                 paddle.to_tensor(lengths)).numpy()
+        for i, s in enumerate(seqs):
+            np.testing.assert_allclose(out[i, : len(s)], s[::-1], rtol=1e-6)
+
+
+class TestExpandSliceEnumerate:
+    def test_expand(self):
+        x = rng.randn(3, 4).astype("float32")
+        ref_len = np.array([2, 5, 1], "int64")
+        out = F.sequence_expand(paddle.to_tensor(x),
+                                paddle.to_tensor(ref_len), maxlen=5).numpy()
+        for i, ln in enumerate(ref_len):
+            for t in range(5):
+                if t < ln:
+                    np.testing.assert_allclose(out[i, t], x[i])
+                else:
+                    assert (out[i, t] == 0).all()
+
+    def test_slice(self):
+        seqs, padded, lengths = _ragged(maxlen=8, seed=8)
+        offset = np.minimum(1, lengths - 1).astype("int64")
+        ln_out = np.maximum(lengths - 1, 1).astype("int64")
+        out = F.sequence_slice(paddle.to_tensor(padded),
+                               paddle.to_tensor(lengths),
+                               paddle.to_tensor(offset),
+                               paddle.to_tensor(ln_out), maxlen=8).numpy()
+        for i in range(len(lengths)):
+            expect = padded[i, offset[i]: offset[i] + ln_out[i]]
+            np.testing.assert_allclose(out[i, : ln_out[i]], expect)
+            assert (out[i, ln_out[i]:] == 0).all()
+
+    def test_enumerate(self):
+        ids = np.array([[1, 2, 3, 4, 0], [5, 6, 0, 0, 0]], "int64")
+        lengths = np.array([4, 2], "int64")
+        out = F.sequence_enumerate(paddle.to_tensor(ids),
+                                   paddle.to_tensor(lengths),
+                                   win_size=2, pad_value=0).numpy()
+        # windows clipped at the padded buffer edge; positions past the
+        # sequence end are pad_value
+        np.testing.assert_array_equal(out[0, 0], [1, 2])
+        np.testing.assert_array_equal(out[0, 3], [4, 0])
+        assert (out[0, 4:] == 0).all()
+        assert (out[1, 2:] == 0).all()
+
+
+class TestSequenceConv:
+    def test_parity_vs_per_example(self):
+        d_in, d_out, cl = 3, 5, 3
+        seqs, padded, lengths = _ragged(batch=3, maxlen=6, d=d_in, seed=9)
+        w = rng.randn(cl * d_in, d_out).astype("float32")
+        out = F.sequence_conv(paddle.to_tensor(padded),
+                              paddle.to_tensor(lengths),
+                              paddle.to_tensor(w),
+                              context_length=cl, context_start=-1).numpy()
+        # numpy reference: per sequence, im2col with zero boundary pad
+        for i, s in enumerate(seqs):
+            T = len(s)
+            col = np.zeros((T, cl * d_in), "float32")
+            for t in range(T):
+                for k in range(cl):
+                    src = t + (-1) + k
+                    if 0 <= src < T:
+                        col[t, k * d_in:(k + 1) * d_in] = s[src]
+            ref = col @ w
+            np.testing.assert_allclose(out[i, :T], ref, rtol=1e-4,
+                                       atol=1e-5)
+            assert (out[i, T:] == 0).all()
+
+
+class TestBucketedSampler:
+    def _ds(self, n=50, seed=0):
+        g = np.random.RandomState(seed)
+        lengths = g.randint(1, 40, n)
+
+        class DS(io.Dataset):
+            def __len__(self):
+                return n
+
+            def __getitem__(self, i):
+                ln = int(lengths[i])
+                return np.arange(ln, dtype="int64"), np.int64(ln % 2)
+
+        return DS(), lengths
+
+    def test_bucket_assignment_and_len(self):
+        ds, lengths = self._ds()
+        bs = io.BucketedBatchSampler(ds, batch_size=8,
+                                     bucket_boundaries=[10, 20, 40],
+                                     shuffle=False)
+        assert bs.n_dropped == 0
+        seen = set()
+        total = 0
+        for batch in bs:
+            total += len(batch)
+            for i in batch:
+                assert i not in seen
+                seen.add(i)
+        assert total == len(ds)
+        assert len(list(bs)) == len(bs)
+
+    def test_drops_overlong(self):
+        ds, lengths = self._ds()
+        bs = io.BucketedBatchSampler(ds, batch_size=8,
+                                     bucket_boundaries=[10],
+                                     shuffle=False)
+        assert bs.n_dropped == int((lengths > 10).sum())
+
+    def test_batches_respect_boundary(self):
+        ds, lengths = self._ds()
+        bs = io.BucketedBatchSampler(ds, batch_size=8,
+                                     bucket_boundaries=[10, 20, 40],
+                                     shuffle=True, yield_boundary=True)
+        for batch, boundary in bs:
+            for i in batch:
+                assert lengths[i] <= boundary
+
+    def test_collate_pads_to_boundary(self):
+        ds, lengths = self._ds()
+        collate = io.pad_sequence_collate_fn(20)
+        batch = [ds[i] for i in range(4)]
+        padded, lns, labels = collate(batch)
+        assert padded.shape == (4, 20)
+        assert labels.shape == (4,)
+        for row, ln in zip(padded, lns):
+            assert (row[:ln] == np.arange(ln)).all()
+            assert (row[ln:] == 0).all()
+
+
+class TestIntPoolDtype:
+    def test_max_min_keep_int_dtype(self):
+        ids = np.array([[5, 9, 1, 0], [7, 0, 0, 0]], "int64")
+        lengths = np.array([3, 1], "int64")
+        mx = F.sequence_pool(paddle.to_tensor(ids),
+                             paddle.to_tensor(lengths), pool_type="max")
+        mn = F.sequence_pool(paddle.to_tensor(ids),
+                             paddle.to_tensor(lengths), pool_type="min")
+        assert mx.numpy().dtype == np.int64
+        np.testing.assert_array_equal(mx.numpy(), [9, 7])
+        np.testing.assert_array_equal(mn.numpy(), [1, 7])
+
+
+class TestSequenceConcat:
+    def test_concat_parity(self):
+        g = np.random.RandomState(2)
+        l1 = np.array([2, 1], "int64")
+        l2 = np.array([1, 3], "int64")
+        x1 = np.zeros((2, 3, 2), "float32")
+        x2 = np.zeros((2, 4, 2), "float32")
+        s1 = [g.randn(int(n), 2).astype("float32") for n in l1]
+        s2 = [g.randn(int(n), 2).astype("float32") for n in l2]
+        for i in range(2):
+            x1[i, : len(s1[i])] = s1[i]
+            x2[i, : len(s2[i])] = s2[i]
+        out, total = F.sequence_concat(
+            [paddle.to_tensor(x1), paddle.to_tensor(x2)],
+            [paddle.to_tensor(l1), paddle.to_tensor(l2)], maxlen=7)
+        np.testing.assert_array_equal(total.numpy(), l1 + l2)
+        for i in range(2):
+            ref = np.concatenate([s1[i], s2[i]], axis=0)
+            np.testing.assert_allclose(out.numpy()[i, : len(ref)], ref,
+                                       rtol=1e-6)
+            assert (out.numpy()[i, len(ref):] == 0).all()
+
+
+class TestDataLoaderIntegration:
+    """BucketedBatchSampler + pad_sequence_collate_fn(boundaries=...)
+    must work THROUGH io.DataLoader (code-review finding r5)."""
+
+    def test_dataloader_buckets(self):
+        g = np.random.RandomState(3)
+        n = 40
+        lengths = g.randint(1, 30, n)
+        seqs = [np.arange(ln, dtype="int64") for ln in lengths]
+
+        class DS(io.Dataset):
+            def __len__(self):
+                return n
+
+            def __getitem__(self, i):
+                return seqs[i], np.int64(i)
+
+        boundaries = [8, 16, 32]
+        sampler = io.BucketedBatchSampler(
+            DS(), batch_size=8, bucket_boundaries=boundaries,
+            lengths=lengths, shuffle=True)
+        loader = io.DataLoader(
+            DS(), batch_sampler=sampler,
+            collate_fn=io.pad_sequence_collate_fn(boundaries=boundaries))
+        seen = 0
+        shapes = set()
+        def _np(a):
+            return np.asarray(a.numpy() if hasattr(a, "numpy") else a)
+
+        for ids, lns, idx in loader:
+            ids, lns, idx = _np(ids), _np(lns), _np(idx)
+            assert ids.shape[1] in boundaries
+            shapes.add(ids.shape[1])
+            for row, ln, i in zip(ids, lns, idx):
+                assert ln == lengths[i]
+                assert (row[:ln] == seqs[i][:ln]).all()
+                assert (row[ln:] == 0).all()
+            seen += len(ids)
+        assert seen == n
+        assert len(shapes) <= len(boundaries)
+
+
+class TestVariableLengthPipeline:
+    """End-to-end: bucketed variable-length classification trains and the
+    padded computation matches per-example computation (the r4 verdict's
+    'done' bar for coverage row 49)."""
+
+    def test_train_and_parity(self):
+        g = np.random.RandomState(1)
+        n, vocab, maxb = 64, 50, 16
+        lengths = g.randint(2, maxb + 1, n)
+        seqs = [g.randint(1, vocab, ln).astype("int64") for ln in lengths]
+        labels = (np.array([s.sum() for s in seqs]) % 2).astype("int64")
+
+        class DS(io.Dataset):
+            def __len__(self):
+                return n
+
+            def __getitem__(self, i):
+                return seqs[i], labels[i]
+
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.emb = nn.Embedding(vocab, 16)
+                self.fc = nn.Linear(16, 2)
+
+            def forward(self, ids, lns):
+                h = self.emb(ids)
+                pooled = F.sequence_pool(h, lns, pool_type="mean")
+                return self.fc(pooled)
+
+        paddle.framework.random.seed(0)
+        net = Net()
+        opt = paddle.optimizer.Adam(learning_rate=0.05,
+                                    parameters=net.parameters())
+        loss_fn = nn.CrossEntropyLoss()
+        sampler = io.BucketedBatchSampler(
+            DS(), batch_size=16, bucket_boundaries=[8, 16],
+            shuffle=True, yield_boundary=True)
+        losses = []
+        for epoch in range(4):
+            sampler.set_epoch(epoch)
+            ep = []
+            for batch_idx, boundary in sampler:
+                collate = io.pad_sequence_collate_fn(boundary)
+                ids, lns, ys = collate([DS()[i] for i in batch_idx])
+                logits = net(paddle.to_tensor(ids), paddle.to_tensor(lns))
+                loss = loss_fn(logits, paddle.to_tensor(ys))
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+                ep.append(float(loss.numpy()))
+            losses.append(np.mean(ep))
+        assert losses[-1] < losses[0], losses
+
+        # parity: padded-batch forward == per-example forward
+        ids, lns, ys = io.pad_sequence_collate_fn(16)(
+            [DS()[i] for i in range(8)])
+        batched = net(paddle.to_tensor(ids), paddle.to_tensor(lns)).numpy()
+        for i in range(8):
+            one_ids = ids[i: i + 1, : lns[i]]
+            one = net(paddle.to_tensor(one_ids),
+                      paddle.to_tensor(lns[i: i + 1])).numpy()
+            np.testing.assert_allclose(batched[i], one[0], rtol=1e-4,
+                                       atol=1e-5)
