@@ -363,23 +363,34 @@ def pad_sequence_collate_fn(boundary=None, pad_value=0,
     bucket, not per batch)."""
     if (boundary is None) == (boundaries is None):
         raise ValueError("pass exactly one of boundary= or boundaries=")
+    if boundaries is not None and not list(boundaries):
+        raise ValueError("boundaries= must be a non-empty list")
     bset = sorted(int(b) for b in boundaries) if boundaries else None
 
     def collate(batch):
         bsz = len(batch)
         first = np.asarray(batch[0][0])
+        mx = max(len(np.asarray(s[0])) for s in batch)
         if bset is not None:
-            mx = max(len(np.asarray(s[0])) for s in batch)
-            pad_to = next((b for b in bset if mx <= b), bset[-1])
+            pad_to = next((b for b in bset if mx <= b), None)
+            if pad_to is None:
+                raise ValueError(
+                    f"batch max length {mx} exceeds the largest boundary "
+                    f"{bset[-1]}; add a boundary or filter long samples "
+                    f"(truncating silently would corrupt training data)")
         else:
             pad_to = boundary
+            if mx > pad_to:
+                raise ValueError(
+                    f"batch max length {mx} exceeds boundary={pad_to}; "
+                    f"raise boundary= or pre-truncate in the dataset")
         out = np.full((bsz, pad_to) + first.shape[1:], pad_value,
                       dtype=first.dtype)
         lengths = np.zeros((bsz,), dtype=length_dtype)
         for i, sample in enumerate(batch):
             seq = np.asarray(sample[0])
-            ln = min(len(seq), pad_to)
-            out[i, :ln] = seq[:ln]
+            ln = len(seq)
+            out[i, :ln] = seq
             lengths[i] = ln
         rest = [np.stack([np.asarray(s[j]) for s in batch])
                 for j in range(1, len(batch[0]))]

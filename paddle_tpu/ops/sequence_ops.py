@@ -116,10 +116,18 @@ def _sequence_pool(x, lengths, pool_type="sum"):
     ln = jnp.maximum(lengths, 1).astype(
         x.dtype if jnp.issubdtype(x.dtype, jnp.floating) else jnp.float32)
     ln = ln.reshape((-1,) + (1,) * (x.ndim - 2))
-    if jnp.issubdtype(x.dtype, jnp.floating):
-        lo = jnp.asarray(_NEG_INF, x.dtype)
-    else:  # keep integer dtypes integer (no silent float64 promotion)
-        lo = jnp.asarray(jnp.iinfo(x.dtype).min, x.dtype)
+    def _extreme(largest):
+        # identity element for max/min; computed only in those branches so
+        # sum/mean on bool (where iinfo is undefined) still works
+        if jnp.issubdtype(x.dtype, jnp.floating):
+            v = _NEG_INF if largest else -_NEG_INF
+        elif x.dtype == jnp.bool_:
+            v = not largest
+        else:  # keep integer dtypes integer (no silent float64 promotion)
+            info = jnp.iinfo(x.dtype)
+            v = info.min if largest else info.max
+        return jnp.asarray(v, x.dtype)
+
     if pt == "sum":
         return jnp.where(mask, x, 0).sum(axis=1)
     if pt == "average" or pt == "mean":
@@ -127,11 +135,9 @@ def _sequence_pool(x, lengths, pool_type="sum"):
     if pt == "sqrt":
         return jnp.where(mask, x, 0).sum(axis=1) / jnp.sqrt(ln)
     if pt == "max":
-        return jnp.where(mask, x, lo).max(axis=1)
+        return jnp.where(mask, x, _extreme(True)).max(axis=1)
     if pt == "min":
-        hi = -lo if jnp.issubdtype(x.dtype, jnp.floating) else \
-            jnp.asarray(jnp.iinfo(x.dtype).max, x.dtype)
-        return jnp.where(mask, x, hi).min(axis=1)
+        return jnp.where(mask, x, _extreme(False)).min(axis=1)
     if pt == "first":
         return x[:, 0]
     if pt == "last":

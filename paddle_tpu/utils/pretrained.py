@@ -1,0 +1,156 @@
+"""Reference-format ``.pdparams`` checkpoint loading.
+
+The reference ships downloadable ImageNet weights for every vision model
+(reference: python/paddle/vision/models/resnet.py:26-62 model_urls,
+python/paddle/utils/download.py:1 resolution, python/paddle/framework/io.py:791
+load). Its ``.pdparams`` files are pickles of ``{structured_name: ndarray}``
+— Tensors are converted to numpy before pickling — plus an optional
+``StructuredToParameterName@@`` bookkeeping entry.
+
+This module reads that exact on-disk format so reference checkpoints drop
+straight into paddle_tpu models:
+
+* unpickling is RESTRICTED to numpy reconstruction + builtin containers —
+  a ``.pdparams`` from an untrusted cache cannot execute code;
+* structured names match 1:1 (paddle_tpu layers use the reference naming,
+  including BatchNorm's ``_mean``/``_variance`` buffers), so conversion is
+  key filtering + dtype alignment, not a rename table;
+* conv weights stay OIHW in both frameworks (paddle_tpu's NHWC mode
+  transposes activations, never weights — vision/models/resnet.py:7), so
+  the same file serves both layouts.
+
+No network egress exists in this environment, so ``pretrained=True``
+resolves against the local weights cache (``PADDLE_WEIGHTS_HOME``) and a
+model's ``pretrained=`` argument also accepts a direct file path.
+"""
+from __future__ import annotations
+
+import io
+import pickle
+
+import numpy as np
+
+__all__ = ["load_pdparams", "load_pretrained"]
+
+# reference python/paddle/fluid/framework.py: extra key carried in saved
+# state dicts mapping structured names -> parameter names
+_STRUCT_KEY = "StructuredToParameterName@@"
+
+_ALLOWED = {
+    ("numpy.core.multiarray", "_reconstruct"),
+    ("numpy.core.multiarray", "scalar"),
+    ("numpy._core.multiarray", "_reconstruct"),  # numpy 2.x module path
+    ("numpy._core.multiarray", "scalar"),
+    ("numpy", "ndarray"),
+    ("numpy", "dtype"),
+    ("numpy.core.numeric", "_frombuffer"),
+    ("numpy._core.numeric", "_frombuffer"),
+    ("collections", "OrderedDict"),
+    # protocol<=2 numpy array payloads are latin-1 strings decoded via this
+    ("_codecs", "encode"),
+}
+
+
+class _RestrictedUnpickler(pickle.Unpickler):
+    """Only numpy array reconstruction and builtin containers may load.
+
+    A ``.pdparams`` is a pickle; pickles execute arbitrary callables on
+    load. Reference files only ever contain numpy arrays in dicts, so
+    everything else is rejected loudly (defense for a tampered local
+    weights cache)."""
+
+    def find_class(self, module, name):
+        if (module, name) in _ALLOWED:
+            return super().find_class(module, name)
+        # numpy scalar types (float32, int64, ...) used by dtype pickling;
+        # scalar TYPES only — np.save/np.load/etc. are callables an
+        # attacker could smuggle in via REDUCE
+        if module in ("numpy", "numpy.core.multiarray",
+                      "numpy._core.multiarray") and hasattr(np, name):
+            obj = getattr(np, name)
+            if isinstance(obj, type) and issubclass(obj, np.generic):
+                return obj
+        raise pickle.UnpicklingError(
+            f"refusing to unpickle {module}.{name}: .pdparams files may "
+            f"only contain numpy arrays")
+
+
+def load_pdparams(path: str) -> dict:
+    """Load a reference-format ``.pdparams`` into ``{name: np.ndarray}``.
+
+    Drops the ``StructuredToParameterName@@`` bookkeeping entry and
+    flattens one level of nesting (optimizer checkpoints store master
+    weights in a sub-dict)."""
+    with open(path, "rb") as f:
+        raw = _RestrictedUnpickler(f).load()
+    if not isinstance(raw, dict):
+        raise ValueError(
+            f"{path}: expected a pickled state dict, got {type(raw)}")
+    out = {}
+    for k, v in raw.items():
+        if k == _STRUCT_KEY:
+            continue
+        if isinstance(v, np.ndarray):
+            out[str(k)] = v
+        elif isinstance(v, dict):
+            for kk, vv in v.items():
+                if isinstance(vv, np.ndarray):
+                    out[f"{k}.{kk}"] = vv
+        elif np.isscalar(v):
+            out[str(k)] = np.asarray(v)
+    return out
+
+
+def convert_state_dict(raw: dict, model) -> dict:
+    """Align a raw ``{name: ndarray}`` dict to ``model``'s state_dict:
+    keep matching keys, cast dtypes to the model's, verify shapes.
+    Returns the Tensor-valued dict ready for ``set_state_dict``."""
+    from ..framework.tensor import Tensor
+    import jax.numpy as jnp
+
+    target = model.state_dict()
+    missing = [k for k in target if k not in raw]
+    if missing:
+        raise ValueError(
+            f"checkpoint is missing {len(missing)} keys, e.g. "
+            f"{missing[:5]} — architecture mismatch?")
+    def _squeezed(shape):
+        return tuple(d for d in shape if d != 1)
+
+    out = {}
+    for k, t in target.items():
+        arr = raw[k]
+        if tuple(arr.shape) != tuple(t.shape):
+            # only rank-1 padding differences ((N,) vs (N,1)) may reshape;
+            # an arbitrary same-size reshape would silently load a
+            # transposed matrix as garbage
+            if _squeezed(arr.shape) != _squeezed(t.shape):
+                raise ValueError(
+                    f"shape mismatch for {k}: checkpoint {arr.shape} vs "
+                    f"model {tuple(t.shape)}")
+            arr = arr.reshape(tuple(t.shape))
+        out[k] = Tensor(jnp.asarray(arr, dtype=t._data.dtype),
+                        stop_gradient=True)
+    return out
+
+
+def load_pretrained(model, arch: str, model_urls: dict, pretrained):
+    """Shared ``pretrained=`` implementation for the model zoo.
+
+    ``pretrained`` may be a direct ``.pdparams`` path (offline-friendly) or
+    ``True``, which resolves ``model_urls[arch]`` against the local weights
+    cache exactly like the reference's ``get_weights_path_from_url``
+    (reference resnet.py:317-323)."""
+    if isinstance(pretrained, str):
+        path = pretrained
+    else:
+        if arch not in model_urls:
+            raise ValueError(
+                f"{arch} has no pretrained weights; set pretrained=False "
+                f"or pass a .pdparams path")
+        from .download import get_weights_path_from_url
+        url, md5 = model_urls[arch]
+        path = get_weights_path_from_url(url, md5)
+    state = convert_state_dict(load_pdparams(path), model)
+    model.set_state_dict(state)
+    return model

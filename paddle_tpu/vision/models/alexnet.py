@@ -35,7 +35,14 @@ class AlexNet(nn.Layer):
         return x
 
 
+model_urls = {"alexnet": (
+    "https://paddle-imagenet-models-name.bj.bcebos.com/dygraph/"
+    "AlexNet_pretrained.pdparams", "7f0f9f737132e02732d75a1459d98a43")}
+
+
 def alexnet(pretrained=False, **kwargs):
+    model = AlexNet(**kwargs)
     if pretrained:
-        raise NotImplementedError("pretrained weights are not bundled")
-    return AlexNet(**kwargs)
+        from ...utils.pretrained import load_pretrained
+        load_pretrained(model, "alexnet", model_urls, pretrained)
+    return model

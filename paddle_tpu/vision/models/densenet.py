@@ -87,11 +87,25 @@ class DenseNet(nn.Layer):
         return x
 
 
+model_urls = {
+    f"densenet{n}": (
+        "https://paddle-imagenet-models-name.bj.bcebos.com/dygraph/"
+        f"DenseNet{n}_pretrained.pdparams", md5)
+    for n, md5 in [(121, "db1b239ed80a905290fd8b01d3af08e4"),
+                   (161, "62158869cb315098bd25ddbfd308a853"),
+                   (169, "82cc7c635c3f19098c748850efb2d796"),
+                   (201, "16ca29565a7712329cf9e36e02caaf58"),
+                   (264, "3270ce516b85370bba88cfdd9f60bff4")]}
+
+
 def _make(layers):
     def fn(pretrained=False, **kwargs):
+        model = DenseNet(layers=layers, **kwargs)
         if pretrained:
-            raise NotImplementedError("pretrained weights are not bundled")
-        return DenseNet(layers=layers, **kwargs)
+            from ...utils.pretrained import load_pretrained
+            load_pretrained(model, f"densenet{layers}", model_urls,
+                            pretrained)
+        return model
     fn.__name__ = f"densenet{layers}"
     return fn
 

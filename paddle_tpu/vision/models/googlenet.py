@@ -93,7 +93,14 @@ class GoogLeNet(nn.Layer):
         return x
 
 
+model_urls = {"googlenet": (
+    "https://paddle-imagenet-models-name.bj.bcebos.com/dygraph/"
+    "GoogLeNet_pretrained.pdparams", "80c06f038e905c53ab32c40eca6e26ae")}
+
+
 def googlenet(pretrained=False, **kwargs):
+    model = GoogLeNet(**kwargs)
     if pretrained:
-        raise NotImplementedError("pretrained weights are not bundled")
-    return GoogLeNet(**kwargs)
+        from ...utils.pretrained import load_pretrained
+        load_pretrained(model, "googlenet", model_urls, pretrained)
+    return model

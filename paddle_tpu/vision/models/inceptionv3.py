@@ -149,7 +149,14 @@ class InceptionV3(nn.Layer):
         return x
 
 
+model_urls = {"inception_v3": (
+    "https://paddle-hapi.bj.bcebos.com/models/inception_v3.pdparams",
+    "649a4547c3243e8b59c656f41fe330b8")}
+
+
 def inception_v3(pretrained=False, **kwargs):
+    model = InceptionV3(**kwargs)
     if pretrained:
-        raise NotImplementedError("pretrained weights are not bundled")
-    return InceptionV3(**kwargs)
+        from ...utils.pretrained import load_pretrained
+        load_pretrained(model, "inception_v3", model_urls, pretrained)
+    return model

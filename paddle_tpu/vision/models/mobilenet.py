@@ -123,13 +123,29 @@ class MobileNetV2(nn.Layer):
         return x
 
 
+model_urls = {
+    "mobilenetv1_1.0": (
+        "https://paddle-hapi.bj.bcebos.com/models/mobilenetv1_1.0.pdparams",
+        "3033ab1975b1670bef51545feb65fc45"),
+    "mobilenetv2_1.0": (
+        "https://paddle-hapi.bj.bcebos.com/models/mobilenet_v2_x1.0.pdparams",
+        "0340af0a901346c8d46f4529882fb63d"),
+}
+
+
 def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    model = MobileNetV1(scale=scale, **kwargs)
     if pretrained:
-        raise NotImplementedError("pretrained weights are not bundled")
-    return MobileNetV1(scale=scale, **kwargs)
+        from ...utils.pretrained import load_pretrained
+        load_pretrained(model, f"mobilenetv1_{scale}", model_urls,
+                        pretrained)
+    return model
 
 
 def mobilenet_v2(pretrained=False, scale=1.0, **kwargs):
+    model = MobileNetV2(scale=scale, **kwargs)
     if pretrained:
-        raise NotImplementedError("pretrained weights are not bundled")
-    return MobileNetV2(scale=scale, **kwargs)
+        from ...utils.pretrained import load_pretrained
+        load_pretrained(model, f"mobilenetv2_{scale}", model_urls,
+                        pretrained)
+    return model

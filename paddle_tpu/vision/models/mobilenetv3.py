@@ -132,13 +132,31 @@ class MobileNetV3Small(_MobileNetV3):
         super().__init__(_SMALL, 576, 1024, scale, num_classes, with_pool)
 
 
+model_urls = {
+    "mobilenet_v3_small_x1.0": (
+        "https://paddle-hapi.bj.bcebos.com/models/"
+        "mobilenet_v3_small_x1.0.pdparams",
+        "34fe0e7c1f8b00b2b056ad6788d0590c"),
+    "mobilenet_v3_large_x1.0": (
+        "https://paddle-hapi.bj.bcebos.com/models/"
+        "mobilenet_v3_large_x1.0.pdparams",
+        "118db5792b4e183b925d8e8e334db3df"),
+}
+
+
 def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
+    model = MobileNetV3Small(scale=scale, **kwargs)
     if pretrained:
-        raise NotImplementedError("pretrained weights are not bundled")
-    return MobileNetV3Small(scale=scale, **kwargs)
+        from ...utils.pretrained import load_pretrained
+        load_pretrained(model, f"mobilenet_v3_small_x{scale}", model_urls,
+                        pretrained)
+    return model
 
 
 def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
+    model = MobileNetV3Large(scale=scale, **kwargs)
     if pretrained:
-        raise NotImplementedError("pretrained weights are not bundled")
-    return MobileNetV3Large(scale=scale, **kwargs)
+        from ...utils.pretrained import load_pretrained
+        load_pretrained(model, f"mobilenet_v3_large_x{scale}", model_urls,
+                        pretrained)
+    return model

@@ -151,39 +151,68 @@ class ResNet(nn.Layer):
         return x
 
 
-def _resnet(block, depth, width=64, pretrained=False, **kwargs):
+# reference resnet.py:25-62 — same published files; weights stay OIHW so
+# one file serves both NCHW and NHWC models (utils/pretrained.py)
+model_urls = {
+    "resnet18": ("https://paddle-hapi.bj.bcebos.com/models/resnet18.pdparams",
+                 "cf548f46534aa3560945be4b95cd11c4"),
+    "resnet34": ("https://paddle-hapi.bj.bcebos.com/models/resnet34.pdparams",
+                 "8d2275cf8706028345f78ac0e1d31969"),
+    "resnet50": ("https://paddle-hapi.bj.bcebos.com/models/resnet50.pdparams",
+                 "ca6f485ee1ab0492d38f323885b0ad80"),
+    "resnet101": (
+        "https://paddle-hapi.bj.bcebos.com/models/resnet101.pdparams",
+        "02f35f034ca3858e1e54d4036443c92d"),
+    "resnet152": (
+        "https://paddle-hapi.bj.bcebos.com/models/resnet152.pdparams",
+        "7ad16a2f1e7333859ff986138630fd7a"),
+    "wide_resnet50_2": (
+        "https://paddle-hapi.bj.bcebos.com/models/wide_resnet50_2.pdparams",
+        "0282f804d73debdab289bd9fea3fa6dc"),
+    "wide_resnet101_2": (
+        "https://paddle-hapi.bj.bcebos.com/models/wide_resnet101_2.pdparams",
+        "d4360a2d23657f059216f5d5a1a9ac93"),
+}
+
+
+def _resnet(arch, block, depth, width=64, pretrained=False, **kwargs):
+    model = ResNet(block, depth, width=width, **kwargs)
     if pretrained:
-        raise NotImplementedError(
-            "pretrained weights are not bundled; load a checkpoint with "
-            "model.set_state_dict instead")
-    return ResNet(block, depth, width=width, **kwargs)
+        from ...utils.pretrained import load_pretrained
+        load_pretrained(model, arch, model_urls, pretrained)
+    return model
 
 
 def resnet18(pretrained=False, **kwargs):
-    return _resnet(BasicBlock, 18, pretrained=pretrained, **kwargs)
+    return _resnet("resnet18", BasicBlock, 18, pretrained=pretrained,
+                   **kwargs)
 
 
 def resnet34(pretrained=False, **kwargs):
-    return _resnet(BasicBlock, 34, pretrained=pretrained, **kwargs)
+    return _resnet("resnet34", BasicBlock, 34, pretrained=pretrained,
+                   **kwargs)
 
 
 def resnet50(pretrained=False, **kwargs):
-    return _resnet(BottleneckBlock, 50, pretrained=pretrained, **kwargs)
+    return _resnet("resnet50", BottleneckBlock, 50, pretrained=pretrained,
+                   **kwargs)
 
 
 def resnet101(pretrained=False, **kwargs):
-    return _resnet(BottleneckBlock, 101, pretrained=pretrained, **kwargs)
+    return _resnet("resnet101", BottleneckBlock, 101, pretrained=pretrained,
+                   **kwargs)
 
 
 def resnet152(pretrained=False, **kwargs):
-    return _resnet(BottleneckBlock, 152, pretrained=pretrained, **kwargs)
+    return _resnet("resnet152", BottleneckBlock, 152, pretrained=pretrained,
+                   **kwargs)
 
 
 def wide_resnet50_2(pretrained=False, **kwargs):
-    return _resnet(BottleneckBlock, 50, width=128, pretrained=pretrained,
-                   **kwargs)
+    return _resnet("wide_resnet50_2", BottleneckBlock, 50, width=128,
+                   pretrained=pretrained, **kwargs)
 
 
 def wide_resnet101_2(pretrained=False, **kwargs):
-    return _resnet(BottleneckBlock, 101, width=128, pretrained=pretrained,
-                   **kwargs)
+    return _resnet("wide_resnet101_2", BottleneckBlock, 101, width=128,
+                   pretrained=pretrained, **kwargs)

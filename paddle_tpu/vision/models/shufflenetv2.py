@@ -104,11 +104,25 @@ class ShuffleNetV2(nn.Layer):
         return x
 
 
+model_urls = {
+    name: (f"https://paddle-hapi.bj.bcebos.com/models/{name}.pdparams", md5)
+    for name, md5 in [
+        ("shufflenet_v2_x0_25", "1e509b4c140eeb096bb16e214796d03b"),
+        ("shufflenet_v2_x0_33", "3d7b3ab0eaa5c0927ff1026d31b729bd"),
+        ("shufflenet_v2_x0_5", "5e5cee182a7793c4e4c73949b1a71bd4"),
+        ("shufflenet_v2_x1_0", "122d42478b9e81eb49f8a9ede327b1a4"),
+        ("shufflenet_v2_x1_5", "faced5827380d73531d0ee027c67826d"),
+        ("shufflenet_v2_x2_0", "cd3dddcd8305e7bcd8ad14d1c69a5784"),
+        ("shufflenet_v2_swish", "adde0aa3b023e5b0c94a68be1c394b84")]}
+
+
 def _make(scale, act="relu", name=None):
     def fn(pretrained=False, **kwargs):
+        model = ShuffleNetV2(scale=scale, act=act, **kwargs)
         if pretrained:
-            raise NotImplementedError("pretrained weights are not bundled")
-        return ShuffleNetV2(scale=scale, act=act, **kwargs)
+            from ...utils.pretrained import load_pretrained
+            load_pretrained(model, name, model_urls, pretrained)
+        return model
     fn.__name__ = name
     return fn
 

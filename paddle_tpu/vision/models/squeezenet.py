@@ -67,13 +67,29 @@ class SqueezeNet(nn.Layer):
         return x
 
 
+model_urls = {
+    "squeezenet1_0": (
+        "https://paddle-imagenet-models-name.bj.bcebos.com/dygraph/"
+        "SqueezeNet1_0_pretrained.pdparams",
+        "30b95af60a2178f03cf9b66cd77e1db1"),
+    "squeezenet1_1": (
+        "https://paddle-imagenet-models-name.bj.bcebos.com/dygraph/"
+        "SqueezeNet1_1_pretrained.pdparams",
+        "a11250d3a1f91d7131fd095ebbf09eee"),
+}
+
+
 def squeezenet1_0(pretrained=False, **kwargs):
+    model = SqueezeNet("1.0", **kwargs)
     if pretrained:
-        raise NotImplementedError("pretrained weights are not bundled")
-    return SqueezeNet("1.0", **kwargs)
+        from ...utils.pretrained import load_pretrained
+        load_pretrained(model, "squeezenet1_0", model_urls, pretrained)
+    return model
 
 
 def squeezenet1_1(pretrained=False, **kwargs):
+    model = SqueezeNet("1.1", **kwargs)
     if pretrained:
-        raise NotImplementedError("pretrained weights are not bundled")
-    return SqueezeNet("1.1", **kwargs)
+        from ...utils.pretrained import load_pretrained
+        load_pretrained(model, "squeezenet1_1", model_urls, pretrained)
+    return model

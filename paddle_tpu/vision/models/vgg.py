@@ -60,23 +60,33 @@ class VGG(nn.Layer):
         return x
 
 
-def _vgg(cfg, batch_norm=False, pretrained=False, **kwargs):
+model_urls = {
+    "vgg16": ("https://paddle-hapi.bj.bcebos.com/models/vgg16.pdparams",
+              "89bbffc0f87d260be9b8cdc169c991c4"),
+    "vgg19": ("https://paddle-hapi.bj.bcebos.com/models/vgg19.pdparams",
+              "23b18bb13d8894f60f54e642be79a0dd"),
+}
+
+
+def _vgg(arch, cfg, batch_norm=False, pretrained=False, **kwargs):
+    model = VGG(_make_features(_CFGS[cfg], batch_norm), **kwargs)
     if pretrained:
-        raise NotImplementedError("pretrained weights are not bundled")
-    return VGG(_make_features(_CFGS[cfg], batch_norm), **kwargs)
+        from ...utils.pretrained import load_pretrained
+        load_pretrained(model, arch, model_urls, pretrained)
+    return model
 
 
 def vgg11(pretrained=False, batch_norm=False, **kwargs):
-    return _vgg("A", batch_norm, pretrained, **kwargs)
+    return _vgg("vgg11", "A", batch_norm, pretrained, **kwargs)
 
 
 def vgg13(pretrained=False, batch_norm=False, **kwargs):
-    return _vgg("B", batch_norm, pretrained, **kwargs)
+    return _vgg("vgg13", "B", batch_norm, pretrained, **kwargs)
 
 
 def vgg16(pretrained=False, batch_norm=False, **kwargs):
-    return _vgg("D", batch_norm, pretrained, **kwargs)
+    return _vgg("vgg16", "D", batch_norm, pretrained, **kwargs)
 
 
 def vgg19(pretrained=False, batch_norm=False, **kwargs):
-    return _vgg("E", batch_norm, pretrained, **kwargs)
+    return _vgg("vgg19", "E", batch_norm, pretrained, **kwargs)

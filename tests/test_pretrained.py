@@ -1,0 +1,110 @@
+"""Reference-format .pdparams checkpoint compatibility.
+
+The reference saves vision-model weights as pickled {structured_name:
+ndarray} dicts plus a StructuredToParameterName@@ bookkeeping entry
+(reference python/paddle/framework/io.py:574). These tests write that
+exact format with plain pickle (no paddle_tpu involvement on the save
+side) and prove ``pretrained=`` loads it: keys map 1:1, logits reproduce,
+NCHW and NHWC models load the same file, and a malicious pickle is
+rejected by the restricted unpickler.
+"""
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.utils.pretrained import (convert_state_dict, load_pdparams,
+                                         load_pretrained)
+from paddle_tpu.vision.models import resnet18
+
+
+def _reference_format_checkpoint(model, path):
+    """Write model.state_dict() the way the reference's paddle.save does:
+    numpy values, structured-name keys, bookkeeping entry."""
+    raw = {k: np.asarray(v.numpy()) for k, v in model.state_dict().items()}
+    raw["StructuredToParameterName@@"] = {
+        k: k for k in raw if k.endswith(".weight")}
+    with open(path, "wb") as f:
+        pickle.dump(raw, f, protocol=2)
+
+
+@pytest.fixture(scope="module")
+def ckpt(tmp_path_factory):
+    paddle.framework.random.seed(7)
+    src = resnet18(num_classes=10)
+    path = str(tmp_path_factory.mktemp("weights") / "resnet18.pdparams")
+    _reference_format_checkpoint(src, path)
+    x = np.random.RandomState(0).randn(2, 3, 32, 32).astype("float32")
+    ref_logits = src(paddle.to_tensor(x)).numpy()
+    return path, x, ref_logits
+
+
+def test_load_pdparams_drops_bookkeeping(ckpt):
+    path, _, _ = ckpt
+    raw = load_pdparams(path)
+    assert "StructuredToParameterName@@" not in raw
+    assert all(isinstance(v, np.ndarray) for v in raw.values())
+    assert "conv1.weight" in raw and "bn1._mean" in raw
+
+
+def test_pretrained_path_reproduces_logits(ckpt):
+    path, x, ref_logits = ckpt
+    paddle.framework.random.seed(123)  # different init than the source
+    model = resnet18(pretrained=path, num_classes=10)
+    model.eval()
+    src = resnet18(num_classes=10)
+    src.set_state_dict(convert_state_dict(load_pdparams(path), src))
+    src.eval()
+    got = model(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(got, src(paddle.to_tensor(x)).numpy(),
+                               rtol=1e-5, atol=1e-5)
+    assert got.shape == ref_logits.shape
+
+
+def test_same_file_loads_nhwc_model(ckpt):
+    """Weights are OIHW in both layouts; only activations transpose."""
+    path, x, _ = ckpt
+    nchw = resnet18(pretrained=path, num_classes=10)
+    nhwc = resnet18(pretrained=path, num_classes=10, data_format="NHWC")
+    nchw.eval(), nhwc.eval()
+    y1 = nchw(paddle.to_tensor(x)).numpy()
+    y2 = nhwc(paddle.to_tensor(
+        np.ascontiguousarray(x.transpose(0, 2, 3, 1)))).numpy()
+    np.testing.assert_allclose(y1, y2, rtol=1e-4, atol=1e-4)
+
+
+def test_architecture_mismatch_raises(ckpt):
+    path, _, _ = ckpt
+    with pytest.raises(ValueError, match="missing|shape"):
+        resnet18(pretrained=path, num_classes=77)
+
+
+def test_missing_url_entry_raises():
+    model = resnet18(num_classes=10)
+    with pytest.raises(ValueError, match="no pretrained weights"):
+        load_pretrained(model, "nonexistent_arch", {}, True)
+
+
+def test_malicious_pickle_rejected(tmp_path):
+    class Evil:
+        def __reduce__(self):
+            return (os.system, ("true",))
+
+    path = tmp_path / "evil.pdparams"
+    with open(path, "wb") as f:
+        pickle.dump({"conv1.weight": Evil()}, f)
+    with pytest.raises(pickle.UnpicklingError, match="refusing"):
+        load_pdparams(str(path))
+
+
+def test_bookkeeping_entry_optional(tmp_path):
+    """Files saved without the StructuredToParameterName@@ entry (plain
+    state-dict pickles) load identically."""
+    arr = np.arange(6, dtype="float32").reshape(2, 3)
+    path = tmp_path / "plain.pdparams"
+    with open(path, "wb") as f:
+        pickle.dump({"w": arr}, f)
+    raw = load_pdparams(str(path))
+    np.testing.assert_array_equal(raw["w"], arr)
