@@ -24,7 +24,11 @@ from ..nn.layer.layers import Layer
 
 __all__ = ["fake_quant", "FakeQuantAbsMax", "MovingAverageAbsMaxScale",
            "QuantizedLinear", "QuantizedConv2D", "ImperativeQuantAware",
-           "PostTrainingQuantization"]
+           "PostTrainingQuantization", "QuantizationTransformPass",
+           "PostTrainingQuantizationProgram", "calibrate_program"]
+
+from .passes import (PostTrainingQuantizationProgram,  # noqa: E402
+                     QuantizationTransformPass, calibrate_program)
 
 
 def fake_quant(x, scale, bits: int = 8):

@@ -1,0 +1,197 @@
+"""Program-level quantization passes over the captured static graph.
+
+Reference:
+python/paddle/fluid/contrib/slim/quantization/quantization_pass.py:1
+(QuantizationTransformPass rewrites the IR graph, inserting
+quant/dequant ops around quantizable operators) and
+post_training_quantization.py:1 (PostTrainingQuantization drives
+calibration over sample data, then applies the pass with frozen scales).
+
+TPU-native design: the static Program (static/__init__.py) replays a
+recorded op-node list as a pure jitted function, so "inserting an op" is
+wrapping a node's callable — the quant/dequant simulation expressed in
+jnp fuses into the surrounding matmul/conv when XLA compiles the replay.
+Calibration rides the replay's observer hook eagerly (no jit, host-side
+abs-max/percentile accumulation), exactly one pass per batch like the
+reference's sampling executor runs. Weights quantize per OUTPUT CHANNEL
+(conv OIHW axis 0, matmul/linear last axis) — the reference's
+channel_wise_abs_max; activations per tensor.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["QuantizationTransformPass", "PostTrainingQuantizationProgram",
+           "calibrate_program"]
+
+# ops whose (activation, weight) inputs take quant/dequant simulation;
+# axis is the weight's output-channel axis for per-channel scales
+_QUANTIZABLE = {"conv2d": 0, "linear": -1, "matmul": -1}
+
+
+def _weight_and_act_indices(node):
+    """Locate the weight (a Parameter input with rank >= 2) and the
+    activation (first non-parameter input) in a recorded node."""
+    widx = aidx = None
+    for j, (tid, const, pname) in enumerate(node.inputs):
+        if pname is not None and widx is None and \
+                getattr(const, "ndim", 0) >= 2:
+            widx = j
+        elif pname is None and aidx is None:
+            aidx = j
+    return widx, aidx
+
+
+def _fake_quant_sim(x, scale, bits):
+    """Symmetric quant→dequant in jnp (STE gradient): the int8 grid
+    simulation XLA fuses into the consuming op."""
+    import jax
+    import jax.numpy as jnp
+    qmax = float(2 ** (bits - 1) - 1)
+    s = jnp.maximum(jnp.asarray(scale, x.dtype), 1e-8)
+    q = jnp.round(jnp.clip(x, -s, s) / s * qmax) * s / qmax
+    return x + jax.lax.stop_gradient(q - x)
+
+
+def _quant_weight_sim(w, axis, bits):
+    """Per-output-channel symmetric quant→dequant of a weight array."""
+    import jax.numpy as jnp
+    qmax = float(2 ** (bits - 1) - 1)
+    axes = tuple(i for i in range(w.ndim) if i != axis % w.ndim)
+    scale = jnp.max(jnp.abs(w), axis=axes, keepdims=True)
+    scale = jnp.where(scale == 0, 1.0, scale)
+    q = jnp.round(jnp.clip(w, -scale, scale) / scale * qmax)
+    return (q * scale / qmax).astype(w.dtype)
+
+
+class QuantizationTransformPass:
+    """Rewrite a Program so every quantizable node runs int8 simulation.
+
+    With ``act_scales`` (node-index → float, from calibration) the
+    activation quant uses frozen scales — the PTQ emission. Without, the
+    activation scale is computed from the live tensor (dynamic abs-max),
+    which is the QAT-on-static form: train the rewritten program and the
+    STE gradient pulls weights onto the int8 grid.
+    """
+
+    def __init__(self, weight_bits: int = 8, activation_bits: int = 8,
+                 quantizable_op_type: Sequence[str] = tuple(_QUANTIZABLE)):
+        unknown = set(quantizable_op_type) - set(_QUANTIZABLE)
+        if unknown:
+            raise ValueError(f"cannot quantize op types {sorted(unknown)}; "
+                             f"supported: {sorted(_QUANTIZABLE)}")
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.op_types = set(quantizable_op_type)
+
+    def _wrap(self, node, act_scale: Optional[float]):
+        import jax.numpy as jnp
+        from ..framework import static_capture as _capture
+
+        widx, aidx = _weight_and_act_indices(node)
+        axis = _QUANTIZABLE[node.op]
+        inner, wbits, abits = node.fn, self.weight_bits, self.activation_bits
+
+        def quantized_fn(*args):
+            args = list(args)
+            if widx is not None:
+                args[widx] = _quant_weight_sim(args[widx], axis, wbits)
+            if aidx is not None:
+                x = args[aidx]
+                s = jnp.max(jnp.abs(x)) if act_scale is None else act_scale
+                args[aidx] = _fake_quant_sim(x, s, abits)
+            return inner(*args)
+
+        attrs = dict(node.attrs)
+        attrs["quantized"] = {"weight_bits": wbits, "act_bits": abits,
+                              "act_scale": act_scale, "channel_axis": axis}
+        return _capture.OpNode(node.op, quantized_fn, node.inputs,
+                               node.out_ids, attrs)
+
+    def apply(self, program, act_scales: Optional[Dict[int, float]] = None):
+        """Return a for-test clone of ``program`` with quantizable nodes
+        rewritten; the original is untouched (reference pass semantics:
+        a new IrGraph)."""
+        act_scales = act_scales or {}
+        out = program.clone(for_test=True)
+        out._nodes = [
+            self._wrap(n, act_scales.get(i)) if n.op in self.op_types
+            else n
+            for i, n in enumerate(program._nodes)]
+        out._replay_cache.clear()
+        quantized = [i for i, n in enumerate(program._nodes)
+                     if n.op in self.op_types]
+        out._quant_info = {"nodes": quantized,
+                           "weight_bits": self.weight_bits,
+                           "act_bits": self.activation_bits,
+                           "act_scales": dict(act_scales)}
+        return out
+
+
+def calibrate_program(program, feed_list: Iterable[Dict[str, np.ndarray]],
+                      quantizable_op_type: Sequence[str] =
+                      tuple(_QUANTIZABLE),
+                      algo: str = "abs_max",
+                      percentile: float = 99.99) -> Dict[int, float]:
+    """Replay ``program`` over calibration feeds, recording an activation
+    scale per quantizable node (reference PostTrainingQuantization's
+    sampling phase). ``algo``: ``abs_max`` (max over all batches) or
+    ``percentile`` (given percentile of |x| per batch, max over batches —
+    robust to activation outliers, reference's hist/percentile family).
+    """
+    import jax.numpy as jnp
+
+    if algo not in ("abs_max", "percentile"):
+        raise ValueError(f"unknown calibration algo {algo!r}")
+    op_types = set(quantizable_op_type)
+    params = {n: p._data for n, p in program._params.items()}
+    scales: Dict[int, float] = {}
+
+    def observer(i, node, ins):
+        if node.op not in op_types:
+            return
+        _, aidx = _weight_and_act_indices(node)
+        if aidx is None:
+            return
+        x = jnp.abs(jnp.asarray(ins[aidx]))
+        cur = float(jnp.percentile(x, percentile)) \
+            if algo == "percentile" else float(jnp.max(x))
+        scales[i] = max(scales.get(i, 0.0), cur)
+
+    for feed in feed_list:
+        feeds = {k: jnp.asarray(v) for k, v in feed.items()}
+        program._forward_env(feeds, params, _observer=observer)
+    return scales
+
+
+class PostTrainingQuantizationProgram:
+    """End-to-end program PTQ driver (reference
+    post_training_quantization.py:PostTrainingQuantization): calibrate →
+    transform → return the quantized inference program.
+
+    ``feed_list`` is an iterable of Executor-style feed dicts covering the
+    program's declared ``static.data`` inputs.
+    """
+
+    def __init__(self, program, feed_list,
+                 weight_bits: int = 8, activation_bits: int = 8,
+                 quantizable_op_type: Sequence[str] = tuple(_QUANTIZABLE),
+                 algo: str = "abs_max", percentile: float = 99.99):
+        self.program = program
+        self.feed_list = list(feed_list)
+        if not self.feed_list:
+            raise ValueError("PTQ needs at least one calibration feed")
+        self.pass_ = QuantizationTransformPass(
+            weight_bits, activation_bits, quantizable_op_type)
+        self.quantizable_op_type = quantizable_op_type
+        self.algo = algo
+        self.percentile = percentile
+        self.scales: Dict[int, float] = {}
+
+    def quantize(self):
+        self.scales = calibrate_program(
+            self.program, self.feed_list, self.quantizable_op_type,
+            self.algo, self.percentile)
+        return self.pass_.apply(self.program, self.scales)

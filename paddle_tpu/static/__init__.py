@@ -176,15 +176,21 @@ class Program:
             raise KeyError(f"no variable named {item!r} in this program")
         raise TypeError(f"cannot fetch {type(item).__name__}")
 
-    def _forward_env(self, feeds: Dict[str, Any], params: Dict[str, Any]):
-        """Replay the node list; returns {tensor_id: array}."""
+    def _forward_env(self, feeds: Dict[str, Any], params: Dict[str, Any],
+                     _observer=None):
+        """Replay the node list; returns {tensor_id: array}.
+
+        ``_observer(index, node, resolved_inputs)`` is called before each
+        node executes — the calibration hook for program-level
+        quantization (quantization/passes.py); jitted replays pass None
+        so it costs nothing in the compiled path."""
         env: Dict[int, Any] = {}
         for name, tid in self._feeds.items():
             if name in feeds:
                 env[tid] = feeds[name]
         for name, value in params.items():
             env[id(self._params[name])] = value
-        for node in self._nodes:
+        for i, node in enumerate(self._nodes):
             ins = []
             for tid, const, pname in node.inputs:
                 if pname is not None:
@@ -193,6 +199,8 @@ class Program:
                     ins.append(env[tid])
                 else:
                     ins.append(const)
+            if _observer is not None:
+                _observer(i, node, ins)
             out = node.fn(*ins)
             flat = jax.tree_util.tree_leaves(out)
             for tid, a in zip(node.out_ids, flat):
