@@ -21,7 +21,7 @@ from ..framework.tensor import Tensor
 __all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
            "SparseCsrTensor", "is_sparse", "add", "subtract", "multiply",
            "divide", "matmul", "masked_matmul", "relu", "sqrt", "sin",
-           "tanh", "abs", "pow", "neg", "cast", "to_dense"]
+           "tanh", "abs", "pow", "neg", "cast", "to_dense", "nn"]
 
 
 def _bcoo():
@@ -63,7 +63,10 @@ class SparseCooTensor:
         return Tensor(self._mat.indices.T)
 
     def values(self) -> Tensor:
-        return Tensor(self._mat.data)
+        # ops that thread the eager autograd tape (sparse/nn.py conv/norm)
+        # stash their tape-connected values Tensor here so training flows
+        vt = getattr(self, "_values_tensor", None)
+        return vt if vt is not None else Tensor(self._mat.data)
 
     def to_dense(self) -> Tensor:
         return Tensor(self._mat.todense())
@@ -305,3 +308,6 @@ def cast(x, index_dtype=None, value_dtype=None):
         return SparseCooTensor(jsparse.BCOO((vals, idx),
                                             shape=x._mat.shape))
     raise TypeError("cast expects a SparseCooTensor")
+
+
+from . import nn  # noqa: E402  (conv3d/pool layers; reference sparse/nn/)

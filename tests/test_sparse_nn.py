@@ -1,0 +1,214 @@
+"""Sparse conv3d / pooling (sparse/nn.py).
+
+Reference: paddle/phi/kernels/sparse/conv_kernel.h (gather-GEMM-scatter
+rulebook conv), python/paddle/incubate/sparse/nn/. Acceptance bar from
+the round-4 review: sparse conv3d matches dense conv on masked input.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import sparse
+from paddle_tpu.sparse import nn as snn
+
+
+def _random_sparse_ndhwc(shape, density=0.1, seed=0):
+    """(SparseCooTensor, dense ndarray) pair with matching content."""
+    rng = np.random.RandomState(seed)
+    site = rng.rand(*shape[:-1]) < density
+    dense = rng.randn(*shape).astype("float32") * site[..., None]
+    idx = np.argwhere(site)                     # [nnz, 4]
+    vals = dense[tuple(idx.T)]                  # [nnz, C]
+    sp = sparse.SparseCooTensor.from_parts(idx.T, vals, shape)
+    return sp, dense
+
+
+def _reached_mask(dense, k, stride=1, padding=0):
+    """Sites a kernel window reaches (>=1 active input site), NDHW."""
+    from jax import lax
+    occ = jnp.asarray(np.abs(dense).sum(-1) > 0)
+    return np.asarray(lax.reduce_window(
+        occ, False, jnp.logical_or,
+        (1, k, k, k), (1, stride, stride, stride),
+        ((0, 0),) + ((padding, padding),) * 3))
+
+
+def _dense_conv3d_ndhwc(dense, w, bias, stride=1, padding=0):
+    """Independent dense reference via the registered conv3d op (NCDHW
+    layout, OIDHW weights) — a different code path than sparse/nn.py."""
+    x_ncdhw = paddle.to_tensor(np.transpose(dense, (0, 4, 1, 2, 3)))
+    w_oidhw = paddle.to_tensor(
+        np.ascontiguousarray(np.transpose(w, (4, 3, 0, 1, 2))))
+    out = paddle.nn.functional.conv3d(
+        x_ncdhw, w_oidhw,
+        bias=None if bias is None else paddle.to_tensor(bias),
+        stride=stride, padding=padding)
+    return np.transpose(out.numpy(), (0, 2, 3, 4, 1))
+
+
+class TestSparseConv3D:
+    def test_conv3d_matches_dense_on_masked_input(self):
+        shape = (2, 6, 6, 6, 3)
+        sp, dense = _random_sparse_ndhwc(shape, density=0.15)
+        rng = np.random.RandomState(1)
+        w = rng.randn(3, 3, 3, 3, 8).astype("float32")   # DHWIO
+        b = rng.randn(8).astype("float32")
+        out = snn.conv3d(sp, w, bias=b, stride=1, padding=1)
+        expect = _dense_conv3d_ndhwc(dense, w, b, stride=1, padding=1)
+        # parity holds at reached sites (the output pattern); unreached
+        # sites are implicit zeros in the sparse result, where the dense
+        # conv still adds the bias — the reference's rulebook semantics
+        reached = _reached_mask(dense, 3, padding=1)
+        got = np.asarray(out.to_dense().numpy())
+        np.testing.assert_allclose(got[reached], expect[reached],
+                                   rtol=2e-4, atol=2e-4)
+        np.testing.assert_array_equal(got[~reached], 0.0)
+        # the pattern IS reachability, value != 0 or not
+        site = np.zeros(dense.shape[:-1], bool)
+        site[tuple(np.asarray(out.indices().numpy()))] = True
+        np.testing.assert_array_equal(site, reached)
+
+    def test_conv3d_strided_no_bias(self):
+        shape = (1, 8, 8, 8, 2)
+        sp, dense = _random_sparse_ndhwc(shape, density=0.1, seed=3)
+        w = np.random.RandomState(4).randn(2, 2, 2, 2, 4).astype("float32")
+        out = snn.conv3d(sp, w, stride=2, padding=0)
+        expect = _dense_conv3d_ndhwc(dense, w, None, stride=2, padding=0)
+        # without bias, unreached sites are 0 in both results
+        np.testing.assert_allclose(np.asarray(out.to_dense().numpy()),
+                                   expect, rtol=2e-4, atol=2e-4)
+        assert out.shape == [1, 4, 4, 4, 4]
+
+    def test_subm_conv3d_preserves_pattern(self):
+        shape = (1, 6, 6, 6, 2)
+        sp, dense = _random_sparse_ndhwc(shape, density=0.12, seed=5)
+        w = np.random.RandomState(6).randn(3, 3, 3, 2, 5).astype("float32")
+        out = snn.subm_conv3d(sp, w, padding=1)
+        np.testing.assert_array_equal(np.asarray(out.indices().numpy()),
+                                      np.asarray(sp.indices().numpy()))
+        # values = dense conv sampled at the input pattern
+        expect = _dense_conv3d_ndhwc(dense, w, None, padding=1)
+        idx = np.asarray(sp.indices().numpy())
+        np.testing.assert_allclose(np.asarray(out.values().numpy()),
+                                   expect[tuple(idx)], rtol=2e-4,
+                                   atol=2e-4)
+
+    def test_subm_conv3d_is_jittable(self):
+        """Static nse -> the whole op traces under jit (the TPU win)."""
+        shape = (1, 4, 4, 4, 2)
+        sp, _ = _random_sparse_ndhwc(shape, density=0.2, seed=7)
+        w = jnp.asarray(
+            np.random.RandomState(8).randn(3, 3, 3, 2, 3).astype("float32"))
+
+        @jax.jit
+        def f(data, indices, w):
+            from jax.experimental import sparse as jsparse
+            mat = jsparse.BCOO((data, indices), shape=tuple(shape))
+            out = snn.subm_conv3d(sparse.SparseCooTensor(mat), w, padding=1)
+            return out._mat.data
+
+        vals = f(sp._mat.data, sp._mat.indices, w)
+        eager = snn.subm_conv3d(sp, w, padding=1)
+        np.testing.assert_allclose(np.asarray(vals),
+                                   np.asarray(eager.values().numpy()),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_subm_conv3d_rejects_stride(self):
+        sp, _ = _random_sparse_ndhwc((1, 4, 4, 4, 1), seed=9)
+        w = np.zeros((3, 3, 3, 1, 1), "float32")
+        with pytest.raises(ValueError, match="stride"):
+            snn.subm_conv3d(sp, w, stride=2)
+
+    def test_subm_conv3d_rejects_shape_changing_padding(self):
+        """kernel 3 with padding 0 shrinks the spatial shape; indexing
+        the smaller output with input-site coords would silently clamp."""
+        sp, _ = _random_sparse_ndhwc((1, 4, 4, 4, 1), seed=9)
+        w = np.zeros((3, 3, 3, 1, 1), "float32")
+        with pytest.raises(ValueError, match="shape-preserving"):
+            snn.subm_conv3d(sp, w)   # default padding=0
+
+    def test_conv3d_layer_trains_eagerly(self):
+        paddle.framework.random.seed(0)
+        layer = snn.SubmConv3D(2, 4, 3, padding=1)
+        sp, _ = _random_sparse_ndhwc((1, 4, 4, 4, 2), density=0.3, seed=10)
+        out = layer(sp)
+        loss = paddle.mean(paddle.square(out.values()))
+        loss.backward()
+        g = layer.weight.grad
+        assert g is not None and np.isfinite(np.asarray(g.numpy())).all()
+
+
+class TestSparseMaxPool3D:
+    def test_matches_dense_pool_when_all_active(self):
+        """With a fully-active input the sparse pool is a dense pool."""
+        rng = np.random.RandomState(11)
+        dense = rng.randn(1, 4, 4, 4, 3).astype("float32") + 5.0  # all > 0
+        idx = np.argwhere(np.ones(dense.shape[:-1], bool))
+        sp = sparse.SparseCooTensor.from_parts(
+            idx.T, dense[tuple(idx.T)], dense.shape)
+        out = snn.max_pool3d(sp, 2, stride=2)
+        x_ncdhw = paddle.to_tensor(np.transpose(dense, (0, 4, 1, 2, 3)))
+        expect = paddle.nn.functional.max_pool3d(x_ncdhw, 2, stride=2)
+        np.testing.assert_allclose(
+            np.asarray(out.to_dense().numpy()),
+            np.transpose(expect.numpy(), (0, 2, 3, 4, 1)), rtol=1e-6)
+
+    def test_only_active_sites_compete(self):
+        """A negative active value must beat inactive (implicit-zero)
+        sites — the reference pools over the rulebook, not over zeros."""
+        shape = (1, 2, 2, 2, 1)
+        idx = np.array([[0, 0, 0, 0]]).T
+        sp = sparse.SparseCooTensor.from_parts(
+            idx, np.array([[-3.0]], dtype="float32"), shape)
+        out = snn.max_pool3d(sp, 2)
+        assert out.nnz() == 1
+        np.testing.assert_allclose(
+            np.asarray(out.values().numpy()), [[-3.0]])
+
+    def test_zero_valued_active_max_keeps_its_site(self):
+        """A window whose active max is exactly 0.0 (post-ReLU is full of
+        these) must stay in the pattern — dropping it would change the
+        downstream active-site set vs the reference's rulebook."""
+        shape = (1, 2, 2, 2, 1)
+        idx = np.array([[0, 0, 0, 0]]).T
+        sp = sparse.SparseCooTensor.from_parts(
+            idx, np.array([[0.0]], dtype="float32"), shape)
+        out = snn.max_pool3d(sp, 2)
+        assert out.nnz() == 1
+        np.testing.assert_allclose(np.asarray(out.values().numpy()),
+                                   [[0.0]])
+
+    def test_empty_windows_produce_no_sites(self):
+        shape = (1, 4, 4, 4, 1)
+        idx = np.array([[0, 0, 0, 0]]).T   # one active site in one octant
+        sp = sparse.SparseCooTensor.from_parts(
+            idx, np.array([[2.0]], dtype="float32"), shape)
+        out = snn.max_pool3d(sp, 2, stride=2)
+        assert out.nnz() == 1              # the other 7 windows are empty
+
+
+class TestSparseBatchNorm:
+    def test_normalizes_values_only(self):
+        paddle.framework.random.seed(0)
+        bn = snn.BatchNorm(3)
+        sp, _ = _random_sparse_ndhwc((2, 4, 4, 4, 3), density=0.5, seed=12)
+        bn.train()
+        out = bn(sp)
+        vals = np.asarray(out.values().numpy())
+        # normalized over active sites: near zero-mean unit-var per channel
+        np.testing.assert_allclose(vals.mean(0), 0.0, atol=1e-4)
+        np.testing.assert_allclose(vals.std(0), 1.0, atol=1e-2)
+        np.testing.assert_array_equal(
+            np.asarray(out.indices().numpy()),
+            np.asarray(sp.indices().numpy()))
+
+    def test_eval_uses_running_stats(self):
+        bn = snn.BatchNorm(2)
+        sp, _ = _random_sparse_ndhwc((1, 4, 4, 4, 2), density=0.4, seed=13)
+        bn.eval()
+        out = bn(sp)   # running stats are (0, 1) at init
+        np.testing.assert_allclose(np.asarray(out.values().numpy()),
+                                   np.asarray(sp.values().numpy()),
+                                   rtol=1e-4, atol=1e-4)
