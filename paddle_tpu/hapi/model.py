@@ -41,6 +41,141 @@ def _to_list(x):
     return list(x) if isinstance(x, (list, tuple)) else [x]
 
 
+class _StaticGraphAdapter:
+    """Routes Model.fit/evaluate/predict through the static
+    Program/Executor when ``paddle.enable_static()`` is active — the
+    analog of the reference's StaticGraphAdapter (hapi/model.py:290),
+    which builds ProgramDescs instead of running the dygraph engine.
+
+    The network's forward is captured ONCE into a main Program under
+    ``program_guard`` (feeds from the Model's InputSpecs), the loss and
+    optimizer are appended, and every train_batch is one Executor.run.
+    Eval/predict run a ``for_test`` clone of the same capture.
+
+    Train and eval are captured as SEPARATE programs — the train capture
+    records train-mode ops (active dropout, batch-stat BN) and the
+    test capture records eval-mode ops, mirroring the reference's
+    main/test ProgramDesc pair.
+
+    Known gaps vs the dynamic path (both from the replay being pure over
+    build-time constants): BatchNorm running stats do not update across
+    static training steps (train-mode normalization itself is exact),
+    and dropout masks are frozen at capture — active in the train
+    program but identical every step. The reference regenerates both via
+    in-graph ops."""
+
+    def __init__(self, model: "Model"):
+        self.model = model
+        self._built = False
+
+    def _spec_name(self, spec, prefix, i):
+        return getattr(spec, "name", None) or f"{prefix}_{i}"
+
+    def _capture(self, program, startup=None, with_optimizer=False):
+        from .. import static
+        m = self.model
+        with static.program_guard(program, startup):
+            in_vars = [
+                static.data(self._spec_name(s, "input", i),
+                            list(s.shape), str(s.dtype))
+                for i, s in enumerate(m._inputs)]
+            label_vars = [
+                static.data(self._spec_name(s, "label", i),
+                            list(s.shape), str(s.dtype))
+                for i, s in enumerate(m._labels)]
+            outputs = m.network(*in_vars)
+            outs = outputs if isinstance(outputs, (list, tuple)) \
+                else [outputs]
+            loss = None
+            if m._loss is not None and label_vars:
+                loss = m._loss(*outs, *label_vars)
+                if with_optimizer and m._optimizer is not None:
+                    m._optimizer.minimize(loss)
+        return loss, outs
+
+    def _build(self):
+        from .. import static
+        m = self.model
+        if not m._inputs:
+            raise ValueError(
+                "static-graph Model requires inputs=[InputSpec(...)] at "
+                "construction (the reference StaticGraphAdapter contract: "
+                "feeds must be declared before the program is built)")
+        was_training = m.network.training
+        main, startup = static.Program(), static.Program()
+        try:
+            m.network.train()
+            self._loss_var, self._out_vars = self._capture(
+                main, startup, with_optimizer=True)
+            m.network.eval()
+            test = static.Program()
+            self._test_loss_var, self._test_out_vars = self._capture(test)
+        finally:
+            m.network.train() if was_training else m.network.eval()
+        self._exe = static.Executor()
+        self._exe.run(startup)
+        self._main, self._test = main, test
+        self._in_names = [self._spec_name(s, "input", i)
+                          for i, s in enumerate(m._inputs)]
+        self._label_names = [self._spec_name(s, "label", i)
+                             for i, s in enumerate(m._labels)]
+        self._built = True
+
+    def _feed(self, inputs, labels, need_labels):
+        if need_labels and self._label_names and not labels:
+            raise ValueError(
+                f"this batch must include labels for declared feed(s) "
+                f"{self._label_names} (the fetched loss depends on them)")
+        arrays = _as_arrays(inputs) + (_as_arrays(labels) if labels else [])
+        names = self._in_names + (self._label_names if labels else [])
+        if len(arrays) != len(names):
+            raise ValueError(
+                f"batch has {len(arrays)} arrays but the static program "
+                f"declares {len(names)} feeds ({names})")
+        return dict(zip(names, arrays))
+
+    def train_batch(self, inputs, labels=None):
+        if not self._built:
+            self._build()
+        if self._loss_var is None:
+            raise RuntimeError("no loss/labels declared: static-mode "
+                               "training needs labels=[InputSpec] + loss")
+        fetches = [self._loss_var] + self._out_vars
+        res = self._exe.run(self._main,
+                            feed=self._feed(inputs, labels, True),
+                            fetch_list=fetches)
+        loss, outs = res[0], res[1:]
+        metrics = self.model._update_metrics(
+            outs, _as_arrays(labels) if labels else [])
+        loss = float(np.asarray(loss).ravel()[0])
+        return (loss, metrics) if metrics else loss
+
+    def eval_batch(self, inputs, labels=None):
+        if not self._built:
+            self._build()
+        with_loss = self._test_loss_var is not None and bool(labels)
+        fetches = ([self._test_loss_var] if with_loss else []) \
+            + self._test_out_vars
+        res = self._exe.run(self._test,
+                            feed=self._feed(inputs, labels, with_loss),
+                            fetch_list=fetches)
+        if with_loss:
+            loss, outs = float(np.asarray(res[0]).ravel()[0]), res[1:]
+        else:
+            loss, outs = 0.0, res
+        metrics = self.model._update_metrics(
+            outs, _as_arrays(labels) if labels else [])
+        return (loss, metrics) if metrics else loss
+
+    def predict_batch(self, inputs):
+        if not self._built:
+            self._build()
+        res = self._exe.run(self._test, feed=self._feed(inputs, None,
+                                                        False),
+                            fetch_list=self._test_out_vars)
+        return [np.asarray(o) for o in res]
+
+
 def _as_arrays(batch):
     import jax
 
@@ -73,7 +208,18 @@ class Model:
         self._step_counter = 0
         self._amp_level = "O0"
         self._amp_dtype = "bfloat16"
+        self._static_adapter = None
         self.stop_training = False
+
+    def _static(self):
+        """The StaticGraphAdapter when ``paddle.enable_static()`` is on
+        (mode is sampled per call, like the reference's _run_backend)."""
+        from ..static import in_dynamic_mode
+        if in_dynamic_mode():
+            return None
+        if self._static_adapter is None:
+            self._static_adapter = _StaticGraphAdapter(self)
+        return self._static_adapter
 
     # -- preparation --------------------------------------------------------
     def prepare(self, optimizer=None, loss=None, metrics=None,
@@ -203,6 +349,9 @@ class Model:
         synchronous by construction; on TPU a per-step host sync costs
         tens of ms through the runtime, so the non-blocking form is the
         fast path for tight loops)."""
+        adapter = self._static()
+        if adapter is not None:
+            return adapter.train_batch(inputs, labels)
         if self._train_step_fn is None:
             self.network.train()
             self._sync_state_from_network()
@@ -223,6 +372,9 @@ class Model:
         return (loss, metrics) if metrics else loss
 
     def eval_batch(self, inputs, labels=None):
+        adapter = self._static()
+        if adapter is not None:
+            return adapter.eval_batch(inputs, labels)
         if self._eval_step_fn is None:
             self._build_eval_step()
         if self._params is None:
@@ -237,6 +389,9 @@ class Model:
         return (loss, metrics) if metrics else loss
 
     def predict_batch(self, inputs):
+        adapter = self._static()
+        if adapter is not None:
+            return adapter.predict_batch(inputs)
         if self._eval_step_fn is None:
             self._build_eval_step()
         if self._params is None:
@@ -282,9 +437,10 @@ class Model:
             save_dir=save_dir, metrics=self._metric_names())
         self.stop_training = False
         self.network.train()
-        self._sync_state_from_network()
-        if self._train_step_fn is None:
-            self._build_train_step()
+        if self._static() is None:
+            self._sync_state_from_network()
+            if self._train_step_fn is None:
+                self._build_train_step()
         cbks.on_train_begin()
         for epoch in range(epochs):
             if self.stop_training:
@@ -312,9 +468,10 @@ class Model:
         loader = self._as_loader(eval_data, batch_size, False, num_workers,
                                  False)
         self.network.eval()
-        if self._params is None:
-            self._sync_state_from_network()
-        self._eval_step_fn = None  # re-trace in eval mode
+        if self._static() is None:
+            if self._params is None:
+                self._sync_state_from_network()
+            self._eval_step_fn = None  # re-trace in eval mode
         for m in self._metrics:
             m.reset()
         cbks = callbacks if _inside_fit else config_callbacks(
@@ -346,9 +503,10 @@ class Model:
         loader = self._as_loader(test_data, batch_size, False, num_workers,
                                  False)
         self.network.eval()
-        if self._params is None:
-            self._sync_state_from_network()
-        self._eval_step_fn = None
+        if self._static() is None:
+            if self._params is None:
+                self._sync_state_from_network()
+            self._eval_step_fn = None
         outputs = []
         for batch in loader:
             inputs, _ = self._split_batch(batch, predict=True)
