@@ -1,0 +1,121 @@
+"""Serve-path hardening: batched serving engine + loud inert knobs.
+
+Reference: paddle/fluid/inference/api/analysis_predictor.cc (the serve
+loop), analysis_config.cc (the GPU/TRT knob surface, inert on TPU).
+"""
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference import BatchingEngine, Config
+
+
+class _EchoPredictor:
+    """Predictor stand-in recording the batch sizes it was run with."""
+
+    def __init__(self):
+        self.batches = []
+        self.lock = threading.Lock()
+
+    def run(self, feeds):
+        with self.lock:
+            self.batches.append(feeds[0].shape[0])
+        return [feeds[0] * 2.0]
+
+
+class TestBatchingEngine:
+    def test_single_request_roundtrip(self):
+        eng = BatchingEngine(_EchoPredictor(), max_delay_ms=0)
+        x = np.arange(6, dtype="float32").reshape(2, 3)
+        (out,) = eng.infer(x)
+        np.testing.assert_allclose(out, x * 2)
+        eng.close()
+
+    def test_concurrent_requests_are_batched(self):
+        pred = _EchoPredictor()
+        eng = BatchingEngine(pred, max_batch_size=16, max_delay_ms=50)
+        results = {}
+
+        def client(i):
+            x = np.full((1, 4), float(i), "float32")
+            (out,) = eng.infer(x)
+            results[i] = out
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        eng.close()
+        for i in range(8):
+            np.testing.assert_allclose(results[i], 2.0 * i)
+        # at least one multi-request batch formed, and every run used a
+        # power-of-two bucket (one compile per bucket)
+        assert max(pred.batches) > 1, pred.batches
+        assert all(b & (b - 1) == 0 for b in pred.batches), pred.batches
+
+    def test_padding_rows_are_dropped(self):
+        pred = _EchoPredictor()
+        eng = BatchingEngine(pred, max_batch_size=8, max_delay_ms=0)
+        x = np.ones((3, 2), "float32")     # pads to bucket 4
+        (out,) = eng.infer(x)
+        assert out.shape == (3, 2)
+        assert pred.batches == [4]
+        eng.close()
+
+    def test_error_propagates_to_caller(self):
+        class _Boom:
+            def run(self, feeds):
+                raise RuntimeError("kaboom")
+
+        eng = BatchingEngine(_Boom(), max_delay_ms=0)
+        with pytest.raises(RuntimeError, match="kaboom"):
+            eng.infer(np.ones((1, 2), "float32"))
+        eng.close()
+
+    def test_closed_engine_rejects(self):
+        eng = BatchingEngine(_EchoPredictor(), max_delay_ms=0)
+        eng.close()
+        with pytest.raises(RuntimeError, match="closed"):
+            eng.infer(np.ones((1, 1), "float32"))
+
+    def test_end_to_end_with_real_predictor(self, tmp_path):
+        """jit.save -> create_predictor -> BatchingEngine round-trip."""
+        from paddle_tpu import inference, jit
+        from paddle_tpu.static import InputSpec
+
+        paddle.framework.random.seed(0)
+        net = paddle.nn.Linear(4, 2)
+        net.eval()
+        path = str(tmp_path / "m")
+        jit.save(net, path, input_spec=[InputSpec([None, 4], "float32")])
+        pred = inference.create_predictor(Config(path + ".pdmodel"))
+        eng = BatchingEngine(pred, max_batch_size=8, max_delay_ms=0)
+        x = np.random.RandomState(0).randn(3, 4).astype("float32")
+        (out,) = eng.infer(x)
+        expect = net(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(out, expect, rtol=1e-5, atol=1e-5)
+        eng.close()
+
+
+class TestInertKnobsWarn:
+    def test_trt_and_gpu_knobs_warn(self):
+        cfg = Config()
+        with pytest.warns(UserWarning, match="no effect"):
+            cfg.enable_tensorrt_engine(workspace_size=1 << 30)
+        with pytest.warns(UserWarning, match="no effect"):
+            cfg.enable_use_gpu(100, 0)
+        with pytest.warns(UserWarning, match="no effect"):
+            cfg.switch_ir_optim(False)
+        with pytest.warns(UserWarning, match="no effect"):
+            cfg.enable_memory_optim()
+
+    def test_disable_gpu_is_silent(self):
+        import warnings
+        cfg = Config()
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            cfg.disable_gpu()     # already the TPU truth: no warning
