@@ -112,8 +112,11 @@ def _tune_attention(state, batch, seq, heads, head_dim, dtype="bfloat16",
         if dtype == "bfloat16":
             import jax.numpy as jnp
             q = jnp.asarray(q, jnp.bfloat16)
+        # skip_if_cached: the per-device cache persists in ~/.cache, so
+        # only the first run (e.g. the mid-round watcher) pays the
+        # block-config search; later children and the driver reuse it
         state["attn_tuned"] = incubate.autotune.tune_attention(
-            q, q, q, is_causal=is_causal)
+            q, q, q, is_causal=is_causal, skip_if_cached=True)
     except Exception as e:  # tuning is best-effort
         state["attn_tune_error"] = str(e)[-200:]
 
@@ -144,7 +147,10 @@ def _timeit_async(step_fn, n_warmup, n_steps):
 # individual benchmarks (run inside the child process)
 # ---------------------------------------------------------------------------
 
-def bench_gpt2(amp_o2=False):
+def bench_gpt2(amp_o2=True):
+    """GPT-2 124M train step. bf16 AMP O2 is the PRIMARY config (r4
+    verdict item 3: fp32 params capped MFU at 0.26 on a bf16-first
+    chip); the fp32 variant stays as a secondary parity point."""
     import numpy as np
     import paddle_tpu as paddle
     from paddle_tpu import amp
@@ -157,7 +163,8 @@ def bench_gpt2(amp_o2=False):
     if _smoke():
         cfg, batch, seq = GPTConfig.tiny(), 2, 32
     else:
-        cfg, batch, seq = GPTConfig.gpt2_small(), 4, 1024
+        # bf16 halves activation memory: batch 8 keeps the MXU fed
+        cfg, batch, seq = GPTConfig.gpt2_small(), (8 if amp_o2 else 4), 1024
         cfg.hidden_dropout_prob = 0.0
         cfg.attention_dropout_prob = 0.0
         _tune_attention(pallas_state, batch, seq,
@@ -187,7 +194,7 @@ def bench_gpt2(amp_o2=False):
     # so the measured mesh is dp=1 — the mp dimension is validated by the
     # driver's CPU dryrun only. Say so in the JSON (r2 verdict weak #10).
     metric = "gpt2_124m_train_tokens_per_sec_1chip_dp1" + (
-        "_bf16" if amp_o2 else "")
+        "_bf16" if amp_o2 else "_fp32")
     out = {"metric": metric,
            "value": round(tokens_per_sec, 1), "unit": "tokens/sec",
            "n_params": n_params, "batch": batch, "seq": seq,
@@ -533,7 +540,7 @@ def bench_probe():
 
 BENCHES = {"gpt2": bench_gpt2, "resnet50": bench_resnet50,
            "bert": bench_bert, "lenet": bench_lenet,
-           "gpt2_bf16": lambda: bench_gpt2(amp_o2=True),
+           "gpt2_fp32": lambda: bench_gpt2(amp_o2=False),
            "resnet50_pipeline": bench_resnet50_pipeline,
            "eager": bench_eager, "serve": bench_serve,
            "probe": bench_probe}
@@ -706,14 +713,14 @@ def main():
                     results[name] = retry
         _emit(results)
 
-    # --- second pass, strictly best-effort: bf16 AMP GPT-2 (perf headroom
-    # beyond the fp32 parity config) and the with/without-Pallas delta for
-    # the attention-heavy configs (r2 verdict item 1c)
+    # --- second pass, strictly best-effort: fp32 GPT-2 parity point
+    # (the primary gpt2 bench is bf16 AMP O2, r4 verdict item 3) and the
+    # with/without-Pallas delta for the attention-heavy configs
     if not _smoke() and remaining() > 90 and \
             "error" not in results.get("gpt2", {}):
-        extra = _run_child("gpt2_bf16", timeout=child_timeout())
+        extra = _run_child("gpt2_fp32", timeout=child_timeout())
         if "error" not in extra:
-            results["gpt2_bf16"] = extra
+            results["gpt2_fp32"] = extra
             _emit(results)
     if not _smoke() and remaining() > 90 and \
             "error" not in results.get("resnet50", {}):

@@ -45,8 +45,9 @@ def stats():
     return autotune_cache.stats()
 
 
-def tune_attention(q, k, v, is_causal=False):
-    """Measure pallas-vs-lax attention for this shape class and persist
-    the winner per device kind (ops/pallas_kernels.py tune_attention)."""
+def tune_attention(q, k, v, is_causal=False, **kwargs):
+    """Measure lax vs pallas block configs for this shape class and
+    persist the winner per device kind (ops/pallas_kernels.py
+    tune_attention; kwargs: include_bwd, skip_if_cached, persist)."""
     from ..ops.pallas_kernels import tune_attention as _tune
-    return _tune(q, k, v, is_causal=is_causal)
+    return _tune(q, k, v, is_causal=is_causal, **kwargs)

@@ -665,8 +665,11 @@ FLASH_MIN_SEQ = 512
 
 def _sdpa_key(b, h, sq, sk, d, dtype, is_causal):
     from . import autotune_cache as _at
+    # tune=bwd2: key-format version. Pre-r5 entries were measured
+    # fwd-only at default blocks; the r5 tuner measures fwd+bwd across
+    # block configs — stale entries must miss, not veto the new search.
     return _at.shape_class(b * h, sq, sk, d, dtype=str(dtype),
-                           causal=bool(is_causal))
+                           causal=bool(is_causal), tune="bwd2")
 
 
 def _fa_supported(q, k, v, mask, dropout_key, dropout_p, is_causal,
@@ -696,13 +699,23 @@ def _fa_supported(q, k, v, mask, dropout_key, dropout_p, is_causal,
                         _sdpa_key(b, h, sq, sk, d, _dtype_of(q),
                                   is_causal),
                         default=default)
-    return choice == "pallas"
+    return choice.startswith("pallas")   # incl. "pallas:BQxBK" configs
 
 
-def tune_attention(q, a_k, v, is_causal=False, persist=True):
-    """Measure pallas-vs-lax for this shape class on CONCRETE arrays and
-    record the winner in the autotune cache (the reference's warmup-step
-    measurement, made explicit). Returns the winning tier name."""
+# block-size search space for tune_attention (r4 verdict item 3: the
+# flash bwd was undertuned at the default 128x128). Unlowerable or
+# non-dividing combos simply fail their measurement and never win.
+_TUNE_BLOCKS = [(128, 128), (256, 128), (128, 256), (256, 256)]
+
+
+def tune_attention(q, a_k, v, is_causal=False, persist=True,
+                   include_bwd=True, skip_if_cached=False):
+    """Measure lax vs pallas (across block-size configs) for this shape
+    class on CONCRETE arrays and record the winner in the autotune cache
+    (the reference's warmup-step measurement, made explicit). With
+    ``include_bwd`` the timed quantity is a full fwd+bwd — the training
+    crossover, which is what the benches dispatch on. Returns the
+    winning tier name (``lax``, ``pallas``, or ``pallas:BQxBK``)."""
     import jax.numpy as jnp
 
     from . import autotune_cache as _at
@@ -713,20 +726,66 @@ def tune_attention(q, a_k, v, is_causal=False, persist=True):
     v = jnp.asarray(v._data if hasattr(v, "_data") else v)
     b, sq, h, d = q.shape
     sk = a_k.shape[1]
+    key = _sdpa_key(b, h, sq, sk, d, q.dtype, is_causal)
+    if skip_if_cached:
+        got = _at.choose("scaled_dot_product_attention", key, default="")
+        if got:
+            return got    # measured in an earlier run; cache persists
     lax_fn = get_op("scaled_dot_product_attention").fn
-    jl = jax.jit(functools.partial(lax_fn, is_causal=is_causal))
-    jp = jax.jit(functools.partial(flash_attention, is_causal=is_causal))
-    return _at.measure(
+
+    def thunk(f):
+        if not include_bwd:
+            jf = jax.jit(f)
+            return lambda: jf(q, a_k, v)
+        jg = jax.jit(jax.grad(
+            lambda q_, k_, v_: f(q_, k_, v_).astype(jnp.float32).sum(),
+            argnums=(0, 1, 2)))
+        return lambda: jg(q, a_k, v)
+
+    candidates = {
+        "lax": thunk(functools.partial(lax_fn, is_causal=is_causal))}
+    for bq, bk in _TUNE_BLOCKS:
+        if min(bq, sq) == DEFAULT_BLOCK_Q and \
+                min(bk, sk) == DEFAULT_BLOCK_K:
+            name = "pallas"       # default blocks keep the plain name
+        else:
+            name = f"pallas:{bq}x{bk}"
+        candidates[name] = thunk(functools.partial(
+            flash_attention, is_causal=is_causal, block_q=bq, block_k=bk))
+    return _at.measure("scaled_dot_product_attention", key, candidates,
+                       persist=persist)
+
+
+def _tuned_blocks(q, k, is_causal):
+    """Dispatch-time lookup of the measured block config (host-side dict
+    read; shapes are static under trace). Falls back to the defaults
+    when the tuned blocks do not divide THIS shape — the pow2-bucketed
+    shape class can contain members the winning config cannot tile."""
+    from . import autotune_cache as _at
+    b, sq, h, d = _shape_of(q)
+    sk = _shape_of(k)[1]
+    choice = _at.choose(
         "scaled_dot_product_attention",
-        _sdpa_key(b, h, sq, sk, d, q.dtype, is_causal),
-        {"lax": lambda: jl(q, a_k, v),
-         "pallas": lambda: jp(q, a_k, v)},
-        persist=persist)
+        _sdpa_key(b, h, sq, sk, d, _dtype_of(q), is_causal),
+        default="pallas")
+    if choice.startswith("pallas:"):
+        try:
+            bq, bk = (int(x) for x in choice.split(":", 1)[1].split("x"))
+        except ValueError:
+            import warnings
+            warnings.warn(f"malformed autotune entry {choice!r}; using "
+                          f"default flash blocks", RuntimeWarning)
+            return DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K
+        if sq % min(bq, sq) == 0 and sk % min(bk, sk) == 0:
+            return bq, bk
+    return DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K
 
 
 def _sdpa_pallas(q, k, v, mask=None, dropout_key=None, dropout_p=0.0,
                  is_causal=False, scale=None):
-    return flash_attention(q, k, v, is_causal=is_causal, scale=scale)
+    bq, bk = _tuned_blocks(q, k, is_causal)
+    return flash_attention(q, k, v, is_causal=is_causal, scale=scale,
+                           block_q=bq, block_k=bk)
 
 
 register_override(

@@ -122,6 +122,31 @@ class TestSdpaIntegration:
         rng = np.random.RandomState(0)
         q = rng.randn(1, 128, 2, 32).astype("float32")
         win = incubate.autotune.tune_attention(q, q, q, is_causal=True)
-        assert win in ("lax", "pallas")
+        assert win == "lax" or win.startswith("pallas")
         s = incubate.autotune.stats()
         assert s["measures"] == 1 and s["entries"] >= 1
+
+    def test_tuned_block_config_round_trips(self):
+        """A recorded 'pallas:BQxBK' winner drives both the dispatch
+        gate and the block sizes flash_attention is called with."""
+        from paddle_tpu.framework.flags import flag_value
+        from paddle_tpu.ops.pallas_kernels import (
+            _fa_supported, _sdpa_key, _tuned_blocks)
+        if not flag_value("FLAGS_use_pallas"):
+            pytest.skip("pallas tier disabled")
+        q = jnp.zeros((2, 512, 4, 64), jnp.float32)
+        at.record("scaled_dot_product_attention",
+                  _sdpa_key(2, 4, 512, 512, 64, q.dtype, True),
+                  "pallas:256x128", persist=False)
+        assert _fa_supported(q, q, q, None, None, 0.0, True)
+        assert _tuned_blocks(q, q, True) == (256, 128)
+        # unrecorded shape classes keep the defaults
+        q2 = jnp.zeros((2, 256, 4, 64), jnp.float32)
+        assert _tuned_blocks(q2, q2, True) == (128, 128)
+        # a class member the tuned blocks cannot tile falls back to the
+        # defaults instead of crashing flash_attention at trace time
+        q3 = jnp.zeros((2, 640, 4, 64), jnp.float32)   # same 1024 bucket
+        at.record("scaled_dot_product_attention",
+                  _sdpa_key(2, 4, 640, 640, 64, q3.dtype, True),
+                  "pallas:256x256", persist=False)
+        assert _tuned_blocks(q3, q3, True) == (128, 128)

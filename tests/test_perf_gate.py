@@ -1,0 +1,77 @@
+"""Eager-dispatch performance regression gate.
+
+Reference analog: tools/check_op_benchmark_result.py — the op-benchmark
+CI gate that FAILS a change which regresses per-op dispatch. Absolute
+times flake across machines, so the gate is RELATIVE: framework dispatch
+per op is compared against a raw jnp op chain measured in the same
+process. Measured healthy ratios (1-core CI box): no-grad ~1.0x (the
+jit-cached dispatch is free), grad-tape ~40x (jax.vjp per op).
+Thresholds carry ~4x headroom — they only trip on structural
+regressions (losing the dispatch cache, re-tracing per call, accidental
+device syncs), not scheduler noise.
+"""
+import time
+
+import numpy as np
+import pytest
+
+
+def _per_op(fn, first, n):
+    y = first
+    for _ in range(50):
+        y = fn(y)          # warm caches outside the timed window
+    t0 = time.perf_counter()
+    y = first
+    for _ in range(n):
+        y = fn(y)
+    return y, (time.perf_counter() - t0) / n
+
+
+def test_eager_dispatch_overhead_vs_raw_jnp():
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+
+    n = 2000
+    xj = jnp.ones(16, jnp.float32)
+    yj, t_jnp = _per_op(lambda v: v + 1.0, xj, n)
+    float(yj[0])
+
+    x = paddle.to_tensor(np.ones(16, "float32"))
+    y, t_nograd = _per_op(lambda v: v + 1.0, x, n)
+    float(y.numpy()[0])
+
+    xg = paddle.to_tensor(np.ones(16, "float32"), stop_gradient=False)
+    yg, t_tape = _per_op(lambda v: v + 1.0, xg, n)
+    float(yg.numpy()[0])
+
+    nograd_ratio = t_nograd / t_jnp
+    tape_ratio = t_tape / t_jnp
+    # healthy: ~1.0 / ~40. A lost dispatch cache or per-op retrace blows
+    # the first; a tape restructure that re-traces vjp blows the second.
+    assert nograd_ratio < 5.0, (
+        f"no-grad dispatch is {nograd_ratio:.1f}x raw jnp "
+        f"({t_nograd * 1e6:.0f}us/op) — dispatch cache regression?")
+    assert tape_ratio < 160.0, (
+        f"grad-tape dispatch is {tape_ratio:.1f}x raw jnp "
+        f"({t_tape * 1e6:.0f}us/op) — tape/vjp regression?")
+
+
+def test_dispatch_cache_actually_caches():
+    """Same op+shape+dtype must reuse the compiled callable — the
+    structural property the ratio gate protects."""
+    import paddle_tpu as paddle
+    from paddle_tpu.framework import monitor
+
+    x = paddle.to_tensor(np.ones((4, 4), "float32"))
+    _ = x * 2.0
+    before = monitor.stat_get("op_count/multiply")
+    for _ in range(25):
+        _ = x * 2.0
+    # counter moved (dispatches happened)...
+    assert monitor.stat_get("op_count/multiply") >= before + 25
+    # ...and re-dispatching is fast enough that compile cannot be inside
+    t0 = time.perf_counter()
+    for _ in range(25):
+        _ = x * 2.0
+    assert (time.perf_counter() - t0) / 25 < 0.01, \
+        "per-op dispatch >10ms — likely re-tracing every call"
