@@ -1,0 +1,112 @@
+"""Mesh-sharded large-embedding ranking.
+
+The TPU-native counterpart of the reference's parameter-server sparse
+tables (paddle/fluid/distributed/ps/table/ sharded embeddings,
+accessor/ frequency+decay bookkeeping; see README.md "Scope decision"
+— the async brpc PS product itself is descoped, THIS is what replaces
+its workload on a TPU mesh):
+
+* the table is one dense [vocab, dim] parameter ROW-SHARDED over a mesh
+  axis — each device holds vocab/n rows in HBM, so table capacity
+  scales linearly with the mesh exactly like adding PS shards;
+* lookup is a plain gather: GSPMD partitions it and inserts the ICI
+  collectives that play the role of the PS's pull RPCs — synchronous,
+  inside the jitted train step, on interconnect that is orders of
+  magnitude faster than the PS's commodity ethernet;
+* the gradient of a gather is a scatter-add onto the sharded rows —
+  the push RPC analog, again compiled to collectives;
+* per-row hit counters (the accessor's frequency statistic) ride along
+  as a sharded int32 buffer updated in-graph; eviction/compaction is an
+  OFFLINE pass over the counters (``hot_rows``/``reset_frequency``),
+  not a dynamic-shape table mutation — XLA requires static shapes, and
+  CTR practice compacts between training runs anyway.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import nn
+from ..framework.tensor import Tensor
+from ..nn import functional as F
+from .fleet.meta_parallel.parallel_layers.mp_layers import (
+    constrain, mark_sharding,
+)
+
+__all__ = ["ShardedEmbedding"]
+
+
+class ShardedEmbedding(nn.Layer):
+    """Embedding with rows sharded over ``shard_axis`` of the mesh.
+
+    Unlike ``VocabParallelEmbedding`` (mp_layers.py — tensor-parallel
+    vocab split inside one transformer), this is the CAPACITY-scaling
+    form for ranking workloads: shard over the large axis of the mesh
+    ("sharding"/"data"), track row frequencies, and expect vocabularies
+    that only fit because they are spread across every device.
+    """
+
+    def __init__(self, num_embeddings: int, embedding_dim: int,
+                 shard_axis: str = "sharding", sparse: bool = False,
+                 padding_idx=None, track_frequency: bool = False,
+                 weight_attr=None, name=None):
+        super().__init__()
+        import jax.numpy as jnp
+        self._num = int(num_embeddings)
+        self._dim = int(embedding_dim)
+        self._padding_idx = padding_idx
+        self._track = bool(track_frequency)
+        # `sparse=True` in the reference selects sparse gradient rows;
+        # here the gather's transpose IS a scatter-add — accepted for
+        # API parity, nothing to switch
+        self.weight = self.create_parameter(
+            [num_embeddings, embedding_dim], attr=weight_attr,
+            default_initializer=nn.initializer.XavierNormal())
+        mark_sharding(self.weight, shard_axis, None)
+        if self._track:
+            counts = Tensor(jnp.zeros([num_embeddings], jnp.int32))
+            self.register_buffer("_counts", counts)
+            mark_sharding(self._buffers["_counts"], shard_axis)
+
+    def forward(self, ids):
+        import jax.numpy as jnp
+        out = F.embedding(ids, self.weight,
+                          padding_idx=self._padding_idx)
+        if self._track and self.training:
+            arr = ids._data if isinstance(ids, Tensor) else jnp.asarray(ids)
+            batch_counts = jnp.bincount(
+                arr.reshape(-1).astype(jnp.int32), length=self._num
+            ).astype(jnp.int32)
+            if self._padding_idx is not None:
+                # padding slots are not real lookups — counting them
+                # would make the padding row the "hottest" and corrupt
+                # the eviction signal these counters feed
+                batch_counts = batch_counts.at[
+                    int(self._padding_idx) % self._num].set(0)
+            # buffer write: functional_state threads it through jitted
+            # steps exactly like BatchNorm running stats
+            self._buffers["_counts"]._data = \
+                self._counts._data + batch_counts
+        # batch stays split over "data" whatever the table's axis is
+        nd = len(out.shape)
+        return constrain(out, *(("data",) + (None,) * (nd - 1)))
+
+    # -- offline accessor surface (reference accessor/: show/click
+    # frequency stats feeding admission & eviction) ----------------------
+    def frequency(self) -> np.ndarray:
+        if not self._track:
+            raise RuntimeError(
+                "construct with track_frequency=True to record hits")
+        return np.asarray(self._counts.numpy())
+
+    def hot_rows(self, k: int) -> np.ndarray:
+        """Ids of the k most-frequently-looked-up rows (descending)."""
+        freq = self.frequency()
+        k = min(int(k), freq.shape[0])
+        top = np.argpartition(-freq, k - 1)[:k]
+        return top[np.argsort(-freq[top], kind="stable")]
+
+    def reset_frequency(self) -> None:
+        import jax.numpy as jnp
+        if self._track:
+            self._buffers["_counts"]._data = jnp.zeros(
+                [self._num], jnp.int32)

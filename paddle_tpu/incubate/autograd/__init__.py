@@ -14,6 +14,12 @@ _prim = {"enabled": False}
 
 
 def enable_prim():
+    if not _prim["enabled"]:
+        import logging
+        logging.getLogger("paddle_tpu").info(
+            "enable_prim(): jax's jvp/vjp machinery IS the primitive "
+            "layer on this backend — the flag is recorded for parity "
+            "but changes no behavior")
     _prim["enabled"] = True
 
 

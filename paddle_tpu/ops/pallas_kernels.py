@@ -744,9 +744,16 @@ def tune_attention(q, a_k, v, is_causal=False, persist=True,
 
     candidates = {
         "lax": thunk(functools.partial(lax_fn, is_causal=is_causal))}
+    seen_effective = set()
     for bq, bk in _TUNE_BLOCKS:
-        if min(bq, sq) == DEFAULT_BLOCK_Q and \
-                min(bk, sk) == DEFAULT_BLOCK_K:
+        # dedup on the CLAMPED blocks: at short seq several configs
+        # collapse to the same kernel — measuring it repeatedly under
+        # different names is pure tuning-budget waste
+        eff = (min(bq, sq), min(bk, sk))
+        if eff in seen_effective:
+            continue
+        seen_effective.add(eff)
+        if eff == (min(DEFAULT_BLOCK_Q, sq), min(DEFAULT_BLOCK_K, sk)):
             name = "pallas"       # default blocks keep the plain name
         else:
             name = f"pallas:{bq}x{bk}"
