@@ -5,6 +5,8 @@ Analog of the reference's ``python/paddle/vision/models/``.
 from .lenet import LeNet  # noqa: F401
 from .resnet import (  # noqa: F401
     ResNet, resnet18, resnet34, resnet50, resnet101, resnet152,
+    resnext50_32x4d, resnext50_64x4d, resnext101_32x4d,
+    resnext101_64x4d, resnext152_32x4d, resnext152_64x4d,
     wide_resnet50_2, wide_resnet101_2,
 )
 from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401

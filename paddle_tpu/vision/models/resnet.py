@@ -12,7 +12,9 @@ from __future__ import annotations
 from ... import nn
 
 __all__ = ["ResNet", "resnet18", "resnet34", "resnet50", "resnet101",
-           "resnet152", "wide_resnet50_2", "wide_resnet101_2"]
+           "resnet152", "resnext50_32x4d", "resnext50_64x4d",
+           "resnext101_32x4d", "resnext101_64x4d", "resnext152_32x4d",
+           "resnext152_64x4d", "wide_resnet50_2", "wide_resnet101_2"]
 
 
 class BasicBlock(nn.Layer):
@@ -172,6 +174,24 @@ model_urls = {
     "wide_resnet101_2": (
         "https://paddle-hapi.bj.bcebos.com/models/wide_resnet101_2.pdparams",
         "d4360a2d23657f059216f5d5a1a9ac93"),
+    "resnext50_32x4d": (
+        "https://paddle-hapi.bj.bcebos.com/models/resnext50_32x4d.pdparams",
+        "dc47483169be7d6f018fcbb7baf8775d"),
+    "resnext50_64x4d": (
+        "https://paddle-hapi.bj.bcebos.com/models/resnext50_64x4d.pdparams",
+        "063d4b483e12b06388529450ad7576db"),
+    "resnext101_32x4d": (
+        "https://paddle-hapi.bj.bcebos.com/models/resnext101_32x4d.pdparams",
+        "967b090039f9de2c8d06fe994fb9095f"),
+    "resnext101_64x4d": (
+        "https://paddle-hapi.bj.bcebos.com/models/resnext101_64x4d.pdparams",
+        "98e04e7ca616a066699230d769d03008"),
+    "resnext152_32x4d": (
+        "https://paddle-hapi.bj.bcebos.com/models/resnext152_32x4d.pdparams",
+        "18ff0beee21f2efc99c4b31786107121"),
+    "resnext152_64x4d": (
+        "https://paddle-hapi.bj.bcebos.com/models/resnext152_64x4d.pdparams",
+        "77c4af00ca42c405fa7f841841959379"),
 }
 
 
@@ -206,6 +226,37 @@ def resnet101(pretrained=False, **kwargs):
 def resnet152(pretrained=False, **kwargs):
     return _resnet("resnet152", BottleneckBlock, 152, pretrained=pretrained,
                    **kwargs)
+
+
+def _resnext(arch, depth, groups, base_width, pretrained, **kwargs):
+    # reference resnet.py resnext*: BottleneckBlock with grouped 3x3
+    # convs; base_width=4 shrinks each group's channels
+    return _resnet(arch, BottleneckBlock, depth, width=base_width,
+                   pretrained=pretrained, groups=groups, **kwargs)
+
+
+def resnext50_32x4d(pretrained=False, **kwargs):
+    return _resnext("resnext50_32x4d", 50, 32, 4, pretrained, **kwargs)
+
+
+def resnext50_64x4d(pretrained=False, **kwargs):
+    return _resnext("resnext50_64x4d", 50, 64, 4, pretrained, **kwargs)
+
+
+def resnext101_32x4d(pretrained=False, **kwargs):
+    return _resnext("resnext101_32x4d", 101, 32, 4, pretrained, **kwargs)
+
+
+def resnext101_64x4d(pretrained=False, **kwargs):
+    return _resnext("resnext101_64x4d", 101, 64, 4, pretrained, **kwargs)
+
+
+def resnext152_32x4d(pretrained=False, **kwargs):
+    return _resnext("resnext152_32x4d", 152, 32, 4, pretrained, **kwargs)
+
+
+def resnext152_64x4d(pretrained=False, **kwargs):
+    return _resnext("resnext152_64x4d", 152, 64, 4, pretrained, **kwargs)
 
 
 def wide_resnet50_2(pretrained=False, **kwargs):
