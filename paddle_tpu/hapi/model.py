@@ -559,8 +559,15 @@ class Model:
     # -- persistence ---------------------------------------------------------
     def save(self, path, training=True):
         self._sync_state_to_network()
+        if not training:
+            # reference hapi/model.py save(training=False): export the
+            # inference artifact instead of raw weights. jit.save owns
+            # the eval-capture/mode-restore dance.
+            from .. import jit
+            jit.save(self.network, path, input_spec=self._inputs or None)
+            return
         _save(self.network.state_dict(), path + ".pdparams")
-        if training and self._optimizer is not None:
+        if self._optimizer is not None:
             _save(self._optimizer.state_dict(), path + ".pdopt")
 
     def load(self, path, skip_mismatch=False, reset_optimizer=False):

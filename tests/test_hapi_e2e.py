@@ -198,3 +198,29 @@ class TestVisualDLCallback:
         assert any(t.startswith("train/") for t in tags), tags
         assert any(t.startswith("epoch/") for t in tags), tags
         assert all(np.isfinite(r["value"]) for r in recs)
+
+
+def test_model_save_inference_export(tmp_path):
+    """Model.save(path, training=False) exports the inference artifact
+    (reference hapi/model.py: save routes to jit.save when not
+    training); round-trips through jit.load with logits parity."""
+    import numpy as np
+    from paddle_tpu import jit
+    from paddle_tpu.static import InputSpec
+
+    paddle.framework.random.seed(0)
+    net = paddle.nn.Sequential(paddle.nn.Linear(6, 8), paddle.nn.ReLU(),
+                               paddle.nn.Linear(8, 3))
+    model = paddle.Model(net, inputs=[InputSpec([None, 6], "float32",
+                                                "x")])
+    model.prepare()
+    path = str(tmp_path / "export" / "m")
+    assert net.training is True
+    model.save(path, training=False)
+    assert net.training is True   # export restored the pre-save mode
+    loaded = jit.load(path)
+    x = np.random.RandomState(0).randn(4, 6).astype("float32")
+    net.eval()
+    np.testing.assert_allclose(
+        np.asarray(loaded(paddle.to_tensor(x)).numpy()),
+        net(paddle.to_tensor(x)).numpy(), rtol=1e-5, atol=1e-5)

@@ -266,3 +266,21 @@ class TestChannelsLast:
         from paddle_tpu.vision.models import resnet18
         with pytest.raises(ValueError):
             resnet18(data_format="NWHC")
+
+
+def test_mobilenet_nhwc_matches_nchw():
+    """Channels-last MobileNet (TPU layout for depthwise convs) matches
+    NCHW numerically — weights stay OIHW so one checkpoint serves both."""
+    import numpy as np
+    from paddle_tpu.vision.models import MobileNetV2
+
+    paddle.framework.random.seed(0)
+    a = MobileNetV2(scale=0.25, num_classes=7)
+    b = MobileNetV2(scale=0.25, num_classes=7, data_format="NHWC")
+    b.set_state_dict(a.state_dict())
+    a.eval(), b.eval()
+    x = np.random.RandomState(0).randn(2, 3, 32, 32).astype("float32")
+    ya = a(paddle.to_tensor(x)).numpy()
+    yb = b(paddle.to_tensor(
+        np.ascontiguousarray(x.transpose(0, 2, 3, 1)))).numpy()
+    np.testing.assert_allclose(ya, yb, rtol=2e-4, atol=2e-4)
