@@ -1,0 +1,92 @@
+"""C inference API (native/tpu_infer_capi.cc + inference/capi.py).
+
+Reference: paddle/fluid/inference/capi_exp/pd_inference_api.h — C ABI
+over the predictor for non-Python serving processes. The test plays the
+C caller through ctypes: same symbols, same buffers a C program would
+pass.
+"""
+import ctypes
+import shutil
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+pytestmark = pytest.mark.skipif(shutil.which("g++") is None,
+                                reason="no C++ toolchain")
+
+
+@pytest.fixture(scope="module")
+def capi():
+    from paddle_tpu.inference.capi import load_capi
+    try:
+        lib, path = load_capi()
+    except RuntimeError as e:       # no libpython to embed against
+        pytest.skip(f"capi build unavailable: {e}")
+    assert lib.PDT_Init(None) == 0
+    return lib
+
+
+@pytest.fixture(scope="module")
+def artifact(tmp_path_factory):
+    from paddle_tpu import jit
+    from paddle_tpu.static import InputSpec
+    paddle.framework.random.seed(0)
+    net = paddle.nn.Sequential(paddle.nn.Linear(4, 8), paddle.nn.ReLU(),
+                               paddle.nn.Linear(8, 3))
+    net.eval()
+    prefix = str(tmp_path_factory.mktemp("capi") / "m")
+    jit.save(net, prefix, input_spec=[InputSpec([None, 4], "float32")])
+    return prefix, net
+
+
+def _run(lib, handle, x):
+    shape = (ctypes.c_int64 * x.ndim)(*x.shape)
+    data = x.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+    out = ctypes.POINTER(ctypes.c_float)()
+    out_shape = ctypes.POINTER(ctypes.c_int64)()
+    out_ndim = ctypes.c_int()
+    rc = lib.PDT_PredictorRun(handle, data, shape, x.ndim,
+                              ctypes.byref(out), ctypes.byref(out_shape),
+                              ctypes.byref(out_ndim))
+    assert rc == 0, lib.PDT_LastError().decode()
+    dims = [out_shape[i] for i in range(out_ndim.value)]
+    n = int(np.prod(dims))
+    result = np.ctypeslib.as_array(out, shape=(n,)).reshape(dims).copy()
+    lib.PDT_BufferFree(out)
+    lib.PDT_BufferFree(out_shape)
+    return result
+
+
+class TestCApi:
+    def test_create_run_destroy_parity(self, capi, artifact):
+        prefix, net = artifact
+        h = capi.PDT_PredictorCreate(prefix.encode())
+        assert h, capi.PDT_LastError().decode()
+        x = np.random.RandomState(0).randn(2, 4).astype("float32")
+        got = _run(capi, h, x)
+        expect = net(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-5)
+        # second call reuses the compiled executable
+        got2 = _run(capi, h, x)
+        np.testing.assert_allclose(got2, expect, rtol=1e-5, atol=1e-5)
+        capi.PDT_PredictorDestroy(h)
+
+    def test_missing_model_sets_error(self, capi):
+        h = capi.PDT_PredictorCreate(b"/nonexistent/model")
+        assert not h
+        assert capi.PDT_LastError()
+
+    def test_null_arguments_rejected(self, capi, artifact):
+        prefix, _ = artifact
+        h = capi.PDT_PredictorCreate(prefix.encode())
+        out = ctypes.POINTER(ctypes.c_float)()
+        out_shape = ctypes.POINTER(ctypes.c_int64)()
+        out_ndim = ctypes.c_int()
+        rc = capi.PDT_PredictorRun(h, None, None, 0, ctypes.byref(out),
+                                   ctypes.byref(out_shape),
+                                   ctypes.byref(out_ndim))
+        assert rc == -1
+        assert b"null" in capi.PDT_LastError()
+        capi.PDT_PredictorDestroy(h)
