@@ -422,3 +422,8 @@ def load_inference_model(path_prefix, executor=None, **kwargs):
     from .. import jit as _jit
     layer = _jit.load(path_prefix)
     return layer, layer.input_names, None
+
+
+from .extras import *  # noqa: E402,F401,F403  (legacy/compat surface)
+from . import extras as _extras  # noqa: E402
+__all__ += _extras.__all__

@@ -1,0 +1,564 @@
+"""Legacy/compat surface of ``paddle.static`` beyond the core
+Program/Executor (reference python/paddle/static/__init__.py __all__).
+
+Grouping:
+* REAL over existing machinery — program state save/load, serialization
+  (over the StableHLO exporter), gradients, create_parameter/global_var,
+  py_func (host callback node), accuracy/auc expressions, EMA, Print,
+  CompiledProgram/ParallelExecutor facades (XLA replaced what they
+  configured, so they delegate to Executor and keep the knobs as
+  recorded-but-inert attrs).
+* REFERENCE-MATCHING ERRORS — the IPU family raises exactly like a
+  reference build without IPU support; ctr_metric_bundle raises per the
+  PS/CTR scope decision (README.md).
+* Device place lists (cuda/xpu/npu/mlu) return [] on this backend —
+  the truthful answer to "which CUDA devices do you see".
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = [
+    "Variable", "BuildStrategy", "ExecutionStrategy", "CompiledProgram",
+    "ParallelExecutor", "Scope", "global_scope", "scope_guard",
+    "create_parameter", "create_global_var", "gradients", "py_func",
+    "save", "load", "save_to_file", "load_from_file",
+    "load_program_state", "set_program_state", "serialize_program",
+    "deserialize_program", "serialize_persistables",
+    "deserialize_persistables", "normalize_program", "accuracy", "auc",
+    "exponential_decay", "Print", "ExponentialMovingAverage",
+    "WeightNormParamAttr", "cuda_places", "xpu_places", "npu_places",
+    "mlu_places", "IpuStrategy", "IpuCompiledProgram", "ipu_shard_guard",
+    "set_ipu_shard", "ctr_metric_bundle",
+]
+
+
+def _tensor_mod():
+    from ..framework import tensor as t
+    return t
+
+
+# --------------------------------------------------------------------------
+# aliases + strategy facades
+# --------------------------------------------------------------------------
+
+class _LazyVariableMeta(type):
+    def __instancecheck__(cls, obj):
+        return isinstance(obj, _tensor_mod().Tensor)
+
+
+class Variable(metaclass=_LazyVariableMeta):
+    """Alias for the framework Tensor (reference fluid Variable — one
+    type serves both graph modes here)."""
+
+    def __new__(cls, *a, **k):
+        return _tensor_mod().Tensor(*a, **k)
+
+
+class BuildStrategy:
+    """Reference BuildStrategy: pass toggles for the old graph compiler.
+    XLA owns fusion/memory decisions, so every knob is recorded and
+    inert — kept so tuning scripts port without edits."""
+
+    def __init__(self):
+        self.__dict__["_opts"] = {}
+
+    def __setattr__(self, k, v):
+        self._opts[k] = v
+
+    def __getattr__(self, k):
+        if k.startswith("_"):
+            raise AttributeError(k)
+        return self.__dict__["_opts"].get(k)
+
+
+class ExecutionStrategy(BuildStrategy):
+    """Reference ExecutionStrategy (thread counts etc.) — inert."""
+
+
+class CompiledProgram:
+    """Reference CompiledProgram(program).with_data_parallel(...) —
+    compilation happens per-shape inside Executor.run (XLA), so this
+    wraps and forwards."""
+
+    def __init__(self, program, build_strategy=None):
+        self._program = program
+        self._build_strategy = build_strategy
+
+    def with_data_parallel(self, loss_name=None, build_strategy=None,
+                           exec_strategy=None, places=None):
+        self._build_strategy = build_strategy
+        return self
+
+
+class ParallelExecutor:
+    """Legacy fluid ParallelExecutor facade -> Executor (the SPMD engine
+    replaced its multi-device scheduling)."""
+
+    def __init__(self, use_cuda=False, loss_name=None, main_program=None,
+                 build_strategy=None, exec_strategy=None, scope=None,
+                 share_vars_from=None):
+        from . import Executor, default_main_program
+        self._program = main_program or default_main_program()
+        self._exe = Executor()
+
+    def run(self, fetch_list, feed=None, return_numpy=True):
+        return self._exe.run(self._program, feed=feed,
+                             fetch_list=fetch_list,
+                             return_numpy=return_numpy)
+
+
+# --------------------------------------------------------------------------
+# scope (name -> value view over the default program)
+# --------------------------------------------------------------------------
+
+class _VarView:
+    def __init__(self, arr):
+        self._arr = arr
+
+    def get_tensor(self):
+        return self._arr
+
+    def __array__(self):
+        return np.asarray(self._arr)
+
+
+class Scope:
+    """Minimal scope: resolves names against tracked program params
+    plus locally set vars (reference Scope is the C++ variable table;
+    XLA buffers replaced it, so this is the debugging view)."""
+
+    def __init__(self):
+        self._vars: Dict[str, np.ndarray] = {}
+
+    def var(self, name):
+        self._vars.setdefault(name, np.zeros((), np.float32))
+        return _VarView(self._vars[name])
+
+    def set(self, name, value):
+        self._vars[name] = np.asarray(value)
+
+    def find_var(self, name):
+        if name in self._vars:
+            return _VarView(self._vars[name])
+        from . import default_main_program
+        prog = default_main_program()
+        if name in prog._params:
+            return _VarView(np.asarray(prog._params[name]._data))
+        if name in prog._var_names:
+            t = prog._vars[prog._var_names[name]]
+            return _VarView(np.asarray(t._data))
+        return None
+
+
+_global_scope = Scope()
+_scope_stack: List[Scope] = []
+
+
+def global_scope() -> Scope:
+    return _scope_stack[-1] if _scope_stack else _global_scope
+
+
+class scope_guard:
+    def __init__(self, scope):
+        self._scope = scope
+
+    def __enter__(self):
+        _scope_stack.append(self._scope)
+        return self._scope
+
+    def __exit__(self, *a):
+        _scope_stack.pop()
+        return False
+
+
+# --------------------------------------------------------------------------
+# var/parameter creation + autodiff + host callback
+# --------------------------------------------------------------------------
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """Reference static.create_parameter — registered into the current
+    program's parameter table so minimize()/save() see it."""
+    import jax.numpy as jnp
+    from ..framework import static_capture as _capture
+    from ..framework.dtypes import convert_dtype
+    from ..nn.initializer import Constant, XavierUniform
+    t = _tensor_mod()
+    init = default_initializer or (Constant(0.0) if is_bias
+                                   else XavierUniform())
+    data = init(tuple(int(s) for s in shape), convert_dtype(dtype))
+    p = t.Parameter(jnp.asarray(data), name=name)
+    from . import default_main_program
+    prog = _capture.current or default_main_program()
+    prog._params.setdefault(p.name, p)
+    return p
+
+
+def create_global_var(shape, value, dtype, persistable=False, name=None,
+                      force_cpu=False):
+    import jax.numpy as jnp
+    from ..framework import static_capture as _capture
+    from ..framework.dtypes import convert_dtype
+    t = _tensor_mod()
+    var = t.Tensor(jnp.full(tuple(int(s) for s in shape), value,
+                            convert_dtype(dtype)), stop_gradient=True)
+    if name:
+        var.name = name
+    from . import default_main_program
+    prog = _capture.current or default_main_program()
+    prog._vars[id(var)] = var
+    if name:
+        prog._var_names[name] = id(var)
+    return var
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """Reference static.gradients: grads of ``targets`` w.r.t. program
+    PARAMETERS among ``inputs`` (feed-var gradients would need a
+    different replay closure — unsupported, loudly)."""
+    from . import append_backward
+    t = _tensor_mod()
+    targets = targets if isinstance(targets, (list, tuple)) else [targets]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    non_params = [x for x in inputs if not isinstance(x, t.Parameter)]
+    if non_params:
+        raise NotImplementedError(
+            "static.gradients supports gradients w.r.t. Parameters; got "
+            f"{len(non_params)} non-parameter input(s). Use "
+            "append_backward/fetch of @GRAD vars for parameters, or "
+            "autograd.grad in dynamic mode for arbitrary inputs")
+    pairs = append_backward(targets[0], parameter_list=[p.name
+                                                       for p in inputs])
+    by_param = {id(p): g for p, g in pairs}
+    return [by_param.get(id(p)) for p in inputs]
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Host-python node inside a program (reference static.nn.py_func over
+    the py_func op): runs ``func`` via jax.pure_callback so the captured
+    program stays jittable. ``out`` is a template Tensor carrying the
+    result shape/dtype. Gradients don't flow through (as the reference
+    without backward_func); backward_func is unsupported."""
+    import jax
+    import jax.numpy as jnp
+    from ..framework.dispatch import call_op
+    from ..ops.registry import get_op, register_op
+    t = _tensor_mod()
+    if backward_func is not None:
+        raise NotImplementedError(
+            "py_func backward_func is not supported; wrap the op with "
+            "autograd.PyLayer in dynamic mode instead")
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    template = out
+    t_shape = tuple(template.shape)
+    t_dtype = template._data.dtype
+
+    from ..ops import registry as _registry
+    # key the memo on the OUTPUT CONTRACT too: the same func with a
+    # different template must register a fresh op, not reuse stale specs
+    sig = "x".join(map(str, t_shape)) + str(t_dtype)
+    opname = f"py_func_{id(func)}_{sig}"
+    if opname not in _registry._OPS:
+        def _impl(*arrays):
+            # the template's LEADING dim is the batch: follow the traced
+            # input's batch so the node replays under any feed size
+            shape = t_shape
+            if shape and arrays and getattr(arrays[0], "ndim", 0) >= 1:
+                shape = (arrays[0].shape[0],) + shape[1:]
+            spec = jax.ShapeDtypeStruct(shape, t_dtype)
+
+            def host(*np_arrays):
+                r = func(*[np.asarray(a) for a in np_arrays])
+                return np.asarray(r, dtype=spec.dtype).reshape(spec.shape)
+            return jax.pure_callback(host, spec, *arrays)
+        register_op(opname, jit=False)(_impl)
+    return call_op(opname, *xs)
+
+
+# --------------------------------------------------------------------------
+# program state persistence + serialization
+# --------------------------------------------------------------------------
+
+def load_program_state(model_path, var_list=None) -> Dict[str, np.ndarray]:
+    """Reference static.load_program_state: path(.pdparams) -> dict."""
+    path = model_path if model_path.endswith(".pdparams") \
+        else model_path + ".pdparams"
+    from ..utils.pretrained import load_pdparams
+    return load_pdparams(path)
+
+
+def set_program_state(program, state_dict) -> None:
+    import jax.numpy as jnp
+    missing = []
+    for name, param in program._params.items():
+        if name in state_dict:
+            arr = state_dict[name]
+            param._data = jnp.asarray(arr, dtype=param._data.dtype)
+        else:
+            missing.append(name)
+    if missing:
+        raise ValueError(f"state dict is missing parameters {missing[:5]}"
+                         f"{'...' if len(missing) > 5 else ''}")
+
+
+def save(program, model_path, protocol=4) -> None:
+    """Reference static.save: program params -> .pdparams (+ .pdopt when
+    an optimizer is attached)."""
+    from ..framework.io import save as _fsave
+    _fsave({n: p for n, p in program._params.items()},
+           model_path + ".pdparams", protocol=protocol)
+    if program._optimizer is not None and program._opt_state is not None:
+        _fsave(program._opt_state, model_path + ".pdopt",
+               protocol=protocol)
+
+
+def load(program, model_path, executor=None, var_list=None) -> None:
+    from ..framework.io import load as _fload
+    state = _fload(model_path + ".pdparams")
+    set_program_state(
+        program, {k: np.asarray(v.numpy() if hasattr(v, "numpy") else v)
+                  for k, v in state.items()})
+    opt_path = model_path + ".pdopt"
+    if program._optimizer is not None and os.path.exists(opt_path):
+        program._opt_state = _fload(opt_path)
+
+
+def save_to_file(path, content: bytes) -> None:
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path) -> bytes:
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def serialize_program(feed_vars, fetch_vars, program=None, **kwargs) -> bytes:
+    """Reference serialize_program returns the ProgramDesc bytes; here
+    the portable compiled form is the StableHLO artifact
+    (save_inference_model), returned as bytes."""
+    import tempfile
+    from . import save_inference_model
+    d = tempfile.mkdtemp()
+    prefix = os.path.join(d, "prog")
+    save_inference_model(prefix, feed_vars, fetch_vars, program=program)
+    return load_from_file(prefix + ".pdmodel")
+
+
+def deserialize_program(data: bytes):
+    """bytes -> runnable artifact (jit.load'ed TranslatedLayer)."""
+    import tempfile
+    from .. import jit
+    d = tempfile.mkdtemp()
+    prefix = os.path.join(d, "prog")
+    save_to_file(prefix + ".pdmodel", data)
+    return jit.load(prefix)
+
+
+def serialize_persistables(feed_vars, fetch_vars, program=None,
+                           **kwargs) -> bytes:
+    prog = program
+    if prog is None:
+        from . import default_main_program
+        prog = default_main_program()
+    return pickle.dumps({n: np.asarray(p._data)
+                         for n, p in prog._params.items()}, protocol=4)
+
+
+def deserialize_persistables(program, data: bytes, executor=None) -> None:
+    set_program_state(program, pickle.loads(data))
+
+
+def normalize_program(program, feed_vars, fetch_vars, **kwargs):
+    """Reference normalize_program prunes to the inference graph — the
+    for_test clone (optimizer stripped) is that here."""
+    return program.clone(for_test=True)
+
+
+# --------------------------------------------------------------------------
+# metric expressions + debug + EMA + lr compat
+# --------------------------------------------------------------------------
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    """Top-k accuracy as a recordable expression (reference
+    static.accuracy over the accuracy op)."""
+    from ..framework.dispatch import call_op
+    topk = call_op("topk", input, k=k)[1]              # indices [N, k]
+    lab = call_op("reshape", label, shape=[-1, 1])
+    eq = call_op("equal", topk, call_op("cast", lab, dtype="int64"))
+    hits = call_op("cast", call_op("any", eq, axis=-1), dtype="float32")
+    return call_op("mean", hits)
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, **kwargs):
+    """Batch AUC expression (reference static.auc). ``input`` holds
+    per-class probabilities [N, 2]; rank-statistic formulation keeps it
+    one jittable expression."""
+    from ..framework.dispatch import call_op
+    pos_score = call_op("slice", input, axes=[1], starts=[1], ends=[2])
+    pos_score = call_op("reshape", pos_score, shape=[-1])
+    lab = call_op("cast", call_op("reshape", label, shape=[-1]),
+                  dtype="float32")
+    order = call_op("argsort", pos_score)
+    ranked = call_op("cast", call_op("argsort", order), dtype="float32")
+    n_pos = call_op("sum", lab)
+    n_neg = call_op("sum", 1.0 - lab)
+    pos_rank_sum = call_op("sum", ranked * lab) + n_pos  # 1-based ranks
+    a = (pos_rank_sum - n_pos * (n_pos + 1.0) / 2.0) / \
+        call_op("maximum", n_pos * n_neg,
+                call_op("full", shape=[], fill_value=1.0,
+                        dtype="float32"))
+    return a
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    """Old fluid lr-decay API -> the modern scheduler (reference maps it
+    the same way in 2.x)."""
+    from ..optimizer import lr as lr_mod
+    gamma = decay_rate ** (1.0 / decay_steps) if not staircase \
+        else decay_rate
+    if staircase:
+        return lr_mod.StepDecay(learning_rate=learning_rate,
+                                step_size=decay_steps, gamma=decay_rate)
+    return lr_mod.ExponentialDecay(learning_rate=learning_rate,
+                                   gamma=gamma)
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=True, print_phase="both"):
+    """Identity with a device-side print (reference Print op ->
+    jax.debug.print, which survives jit)."""
+    import jax
+    from ..autograd import differentiable_apply
+
+    def fn(arr):
+        jax.debug.print((message or "Print") + ": {x}", x=arr)
+        return arr
+
+    return differentiable_apply(fn, input)
+
+
+class ExponentialMovingAverage:
+    """EMA over the current program's parameters (reference
+    static.ExponentialMovingAverage): ``update()`` after each step,
+    ``apply()/restore()`` context swaps the shadow weights in/out."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self.decay = float(decay)
+        self._shadow: Dict[str, np.ndarray] = {}
+        self._backup: Dict[str, np.ndarray] = {}
+
+    def _params(self):
+        from . import default_main_program
+        from ..framework import static_capture as _capture
+        prog = _capture.current or default_main_program()
+        return prog._params
+
+    def update(self):
+        import jax.numpy as jnp
+        for n, p in self._params().items():
+            cur = p._data
+            prev = self._shadow.get(n)
+            self._shadow[n] = cur if prev is None else \
+                self.decay * prev + (1 - self.decay) * cur
+
+    def apply(self, executor=None, need_restore=True):
+        import contextlib
+
+        @contextlib.contextmanager
+        def ctx():
+            params = self._params()
+            self._backup = {n: p._data for n, p in params.items()}
+            for n, p in params.items():
+                if n in self._shadow:
+                    p._data = self._shadow[n]
+            try:
+                yield self
+            finally:
+                if need_restore:
+                    self.restore()
+        return ctx()
+
+    def restore(self, executor=None):
+        params = self._params()
+        for n, arr in self._backup.items():
+            if n in params:
+                params[n]._data = arr
+        self._backup = {}
+
+
+class WeightNormParamAttr:
+    """Accepted for API parity; the weight-norm reparameterization is
+    nn.utils.weight_norm's job in 2.x — constructing this warns and
+    behaves as a plain ParamAttr."""
+
+    def __new__(cls, dim=None, **kwargs):
+        import warnings
+        from ..nn.layer.layers import ParamAttr
+        warnings.warn(
+            "WeightNormParamAttr: use paddle.nn.utils.weight_norm for "
+            "the reparameterization; treating as plain ParamAttr",
+            UserWarning, stacklevel=2)
+        kwargs.pop("dim", None)
+        return ParamAttr(**kwargs)
+
+
+# --------------------------------------------------------------------------
+# device place lists + IPU family + PS metric bundle
+# --------------------------------------------------------------------------
+
+def cuda_places(device_ids=None):
+    return []     # no CUDA devices on this backend — the truthful answer
+
+
+def xpu_places(device_ids=None):
+    return []
+
+
+def npu_places(device_ids=None):
+    return []
+
+
+def mlu_places(device_ids=None):
+    return []
+
+
+def _no_ipu(*a, **k):
+    # matches the reference's behavior when paddle is not compiled with
+    # IPU support (python/paddle/device/__init__.py is_compiled_with_ipu)
+    raise RuntimeError(
+        "IPU support is not available: this backend targets TPU via "
+        "XLA (the reference raises identically unless built with IPU)")
+
+
+class IpuStrategy:
+    def __init__(self, *a, **k):
+        _no_ipu()
+
+
+class IpuCompiledProgram:
+    def __init__(self, *a, **k):
+        _no_ipu()
+
+
+def ipu_shard_guard(*a, **k):
+    _no_ipu()
+
+
+def set_ipu_shard(*a, **k):
+    _no_ipu()
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):
+    raise NotImplementedError(
+        "ctr_metric_bundle belongs to the descoped PS/CTR stack (see "
+        "README.md scope decision); use paddle.metric.Auc or "
+        "static.auc for AUC over program vars")

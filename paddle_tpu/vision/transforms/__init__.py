@@ -9,9 +9,52 @@ import numbers
 
 import numpy as np
 
-__all__ = ["Compose", "ToTensor", "Normalize", "Resize", "CenterCrop",
-           "RandomCrop", "RandomHorizontalFlip", "RandomVerticalFlip",
-           "Transpose", "BrightnessTransform", "Pad"]
+from . import functional  # noqa: F401
+from .functional import (  # noqa: F401
+    adjust_brightness, adjust_contrast, adjust_hue, adjust_saturation,
+    affine, center_crop, crop, erase, hflip, normalize, pad, perspective,
+    resize, rotate, to_grayscale, to_tensor, vflip,
+)
+
+__all__ = ["Compose", "BaseTransform", "ToTensor", "Normalize", "Resize",
+           "CenterCrop", "RandomCrop", "RandomHorizontalFlip",
+           "RandomVerticalFlip", "Transpose", "BrightnessTransform",
+           "ContrastTransform", "SaturationTransform", "HueTransform",
+           "ColorJitter", "Grayscale", "Pad", "RandomRotation",
+           "RandomAffine", "RandomPerspective", "RandomErasing",
+           "RandomResizedCrop",
+           # functional forms (reference transforms/functional.py)
+           "to_tensor", "resize", "crop", "center_crop", "hflip",
+           "vflip", "pad", "normalize", "rotate", "affine",
+           "perspective", "erase", "adjust_brightness",
+           "adjust_contrast", "adjust_saturation", "adjust_hue",
+           "to_grayscale"]
+
+
+class BaseTransform:
+    """Reference transforms.BaseTransform: subclasses implement
+    ``_apply_image`` (and optionally ``_get_params``); __call__ routes
+    tuple inputs by ``keys`` — only "image" entries go through
+    ``_apply_image``, everything else (labels, boxes) passes through
+    untouched, exactly so targets are never color-jittered."""
+
+    def __init__(self, keys=None):
+        self.keys = tuple(keys) if keys is not None else ("image",)
+
+    def _get_params(self, inputs):
+        return None
+
+    def _apply_image(self, img):
+        raise NotImplementedError
+
+    def __call__(self, inputs):
+        self.params = self._get_params(inputs)
+        if isinstance(inputs, (list, tuple)):
+            keys = self.keys + ("image",) * (len(inputs) - len(self.keys))
+            return type(inputs)(
+                self._apply_image(v) if k == "image" else v
+                for k, v in zip(keys, inputs))
+        return self._apply_image(inputs)
 
 
 class Compose:
@@ -31,25 +74,17 @@ class ToTensor:
         self.data_format = data_format
 
     def __call__(self, img):
-        arr = np.asarray(img)
-        if arr.ndim == 2:
-            arr = arr[None]
-        elif arr.ndim == 3 and arr.shape[-1] in (1, 3, 4) and \
-                arr.shape[0] not in (1, 3, 4):
-            arr = arr.transpose(2, 0, 1)
-        arr = arr.astype(np.float32)
-        if arr.max() > 1.5:
-            arr = arr / 255.0
-        return arr
+        return functional.to_tensor(img, self.data_format)
 
 
 class Normalize:
     def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False):
-        self.mean = np.asarray(mean, np.float32).reshape(-1, 1, 1)
-        self.std = np.asarray(std, np.float32).reshape(-1, 1, 1)
+        self.mean, self.std = mean, std
+        self.data_format = data_format
 
     def __call__(self, img):
-        return (np.asarray(img, np.float32) - self.mean) / self.std
+        return functional.normalize(img, self.mean, self.std,
+                                    self.data_format)
 
 
 class Transpose:
@@ -60,37 +95,24 @@ class Transpose:
         return np.asarray(img).transpose(self.order)
 
 
-def _interp_resize(img_chw, size):
-    """Nearest-neighbor resize (no PIL dependency on the data path)."""
-    c, h, w = img_chw.shape
-    nh, nw = size
-    ri = (np.arange(nh) * h / nh).astype(np.int64)
-    ci = (np.arange(nw) * w / nw).astype(np.int64)
-    return img_chw[:, ri][:, :, ci]
-
-
 class Resize:
+    """functional.resize semantics (reference Resize): int size scales
+    the SHORTER side keeping aspect; real bilinear by default."""
+
     def __init__(self, size, interpolation="bilinear"):
-        if isinstance(size, numbers.Number):
-            size = (int(size), int(size))
         self.size = size
+        self.interpolation = interpolation
 
     def __call__(self, img):
-        return _interp_resize(np.asarray(img, np.float32), self.size)
+        return functional.resize(img, self.size, self.interpolation)
 
 
 class CenterCrop:
     def __init__(self, size):
-        if isinstance(size, numbers.Number):
-            size = (int(size), int(size))
         self.size = size
 
     def __call__(self, img):
-        c, h, w = img.shape
-        th, tw = self.size
-        i = max(0, (h - th) // 2)
-        j = max(0, (w - tw) // 2)
-        return img[:, i:i + th, j:j + tw]
+        return functional.center_crop(img, self.size)
 
 
 class RandomCrop:
@@ -137,18 +159,206 @@ class BrightnessTransform:
         self.value = value
 
     def __call__(self, img):
-        alpha = 1 + np.random.uniform(-self.value, self.value)
+        alpha = np.random.uniform(max(0.0, 1 - self.value),
+                                  1 + self.value)
         return np.asarray(img, np.float32) * alpha
 
 
 class Pad:
     def __init__(self, padding, fill=0, padding_mode="constant"):
-        self.padding = padding if not isinstance(padding, int) \
-            else (padding,) * 4
-        self.fill = fill
+        self.padding, self.fill = padding, fill
+        self.padding_mode = padding_mode
 
     def __call__(self, img):
-        l, t, r, b = self.padding if len(self.padding) == 4 else \
-            (self.padding[0], self.padding[1]) * 2
-        return np.pad(np.asarray(img), [(0, 0), (t, b), (l, r)],
-                      constant_values=self.fill)
+        return functional.pad(img, self.padding, self.fill,
+                              self.padding_mode)
+
+
+class ContrastTransform:
+    """Random contrast in [1-value, 1+value] (reference
+    transforms.ContrastTransform)."""
+
+    def __init__(self, value):
+        self.value = float(value)
+
+    def __call__(self, img):
+        f = np.random.uniform(max(0.0, 1 - self.value), 1 + self.value)
+        return functional.adjust_contrast(img, f)
+
+
+class SaturationTransform:
+    def __init__(self, value):
+        self.value = float(value)
+
+    def __call__(self, img):
+        f = np.random.uniform(max(0.0, 1 - self.value), 1 + self.value)
+        return functional.adjust_saturation(img, f)
+
+
+class HueTransform:
+    def __init__(self, value):
+        if not 0 <= value <= 0.5:
+            raise ValueError("hue value must be in [0, 0.5]")
+        self.value = float(value)
+
+    def __call__(self, img):
+        f = np.random.uniform(-self.value, self.value)
+        return functional.adjust_hue(img, f)
+
+
+class ColorJitter:
+    """Random brightness/contrast/saturation/hue in random order
+    (reference transforms.ColorJitter)."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        self.transforms = []
+        if brightness:
+            self.transforms.append(BrightnessTransform(brightness))
+        if contrast:
+            self.transforms.append(ContrastTransform(contrast))
+        if saturation:
+            self.transforms.append(SaturationTransform(saturation))
+        if hue:
+            self.transforms.append(HueTransform(hue))
+
+    def __call__(self, img):
+        order = np.random.permutation(len(self.transforms))
+        for i in order:
+            img = self.transforms[i](img)
+        return img
+
+
+class Grayscale:
+    def __init__(self, num_output_channels=1):
+        self.num_output_channels = num_output_channels
+
+    def __call__(self, img):
+        return functional.to_grayscale(img, self.num_output_channels)
+
+
+class RandomRotation:
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0):
+        if isinstance(degrees, numbers.Number):
+            degrees = (-abs(degrees), abs(degrees))
+        self.degrees = degrees
+        self.center, self.fill = center, fill
+
+    def __call__(self, img):
+        angle = np.random.uniform(*self.degrees)
+        return functional.rotate(img, angle, center=self.center,
+                                 fill=self.fill)
+
+
+class RandomAffine:
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None):
+        if isinstance(degrees, numbers.Number):
+            degrees = (-abs(degrees), abs(degrees))
+        self.degrees = degrees
+        self.translate, self.scale_rng = translate, scale
+        self.shear, self.fill, self.center = shear, fill, center
+
+    def __call__(self, img):
+        img = np.asarray(img)
+        h, w = img.shape[-2:]
+        angle = np.random.uniform(*self.degrees)
+        tx = ty = 0.0
+        if self.translate is not None:
+            tx = np.random.uniform(-self.translate[0], self.translate[0]) * w
+            ty = np.random.uniform(-self.translate[1], self.translate[1]) * h
+        scale = np.random.uniform(*self.scale_rng) if self.scale_rng \
+            else 1.0
+        shear = (0.0, 0.0)
+        if self.shear is not None:
+            s = self.shear
+            if isinstance(s, numbers.Number):
+                s = (-abs(s), abs(s))
+            shear = (np.random.uniform(s[0], s[1]), 0.0)
+        return functional.affine(img, angle, (tx, ty), scale, shear,
+                                 fill=self.fill, center=self.center)
+
+
+class RandomPerspective:
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0):
+        self.prob, self.scale, self.fill = prob, distortion_scale, fill
+
+    def __call__(self, img):
+        if np.random.rand() >= self.prob:
+            return img
+        img = np.asarray(img)
+        h, w = img.shape[-2:]
+        dx, dy = self.scale * w / 2, self.scale * h / 2
+        start = [[0, 0], [w - 1, 0], [w - 1, h - 1], [0, h - 1]]
+        end = [[np.random.uniform(0, dx), np.random.uniform(0, dy)],
+               [w - 1 - np.random.uniform(0, dx),
+                np.random.uniform(0, dy)],
+               [w - 1 - np.random.uniform(0, dx),
+                h - 1 - np.random.uniform(0, dy)],
+               [np.random.uniform(0, dx),
+                h - 1 - np.random.uniform(0, dy)]]
+        return functional.perspective(img, start, end, fill=self.fill)
+
+
+class RandomErasing:
+    """Random rectangle erase (reference transforms.RandomErasing)."""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False):
+        self.prob, self.scale, self.ratio = prob, scale, ratio
+        self.value, self.inplace = value, inplace
+
+    def __call__(self, img):
+        if np.random.rand() >= self.prob:
+            return img
+        img = np.asarray(img, np.float32)
+        if img.ndim == 2:
+            img = img[None]
+        c, h, w = img.shape
+        for _ in range(10):
+            area = h * w * np.random.uniform(*self.scale)
+            ar = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                          np.log(self.ratio[1])))
+            eh = int(round(np.sqrt(area * ar)))
+            ew = int(round(np.sqrt(area / ar)))
+            if eh < h and ew < w:
+                i = np.random.randint(0, h - eh + 1)
+                j = np.random.randint(0, w - ew + 1)
+                v = np.random.randn(c, eh, ew).astype(np.float32) \
+                    if self.value == "random" else self.value
+                return functional.erase(img, i, j, eh, ew, v,
+                                        inplace=self.inplace)
+        return img
+
+
+class RandomResizedCrop:
+    """Random area/aspect crop resized to ``size`` (reference
+    transforms.RandomResizedCrop — the ImageNet training transform)."""
+
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear"):
+        if isinstance(size, numbers.Number):
+            size = (int(size), int(size))
+        self.size, self.scale, self.ratio = size, scale, ratio
+        self.interpolation = interpolation
+
+    def __call__(self, img):
+        img = np.asarray(img, np.float32)
+        if img.ndim == 2:
+            img = img[None]
+        c, h, w = img.shape
+        for _ in range(10):
+            area = h * w * np.random.uniform(*self.scale)
+            ar = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                          np.log(self.ratio[1])))
+            ch = int(round(np.sqrt(area / ar)))
+            cw = int(round(np.sqrt(area * ar)))
+            if 0 < ch <= h and 0 < cw <= w:
+                i = np.random.randint(0, h - ch + 1)
+                j = np.random.randint(0, w - cw + 1)
+                patch = img[:, i:i + ch, j:j + cw]
+                return functional.resize(patch, self.size,
+                                         self.interpolation)
+        return functional.resize(functional.center_crop(
+            img, (min(h, w), min(h, w))), self.size, self.interpolation)

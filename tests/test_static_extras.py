@@ -1,0 +1,213 @@
+"""Legacy/compat static surface (static/extras.py).
+
+Reference: python/paddle/static/__init__.py __all__ — program state
+persistence, serialization, EMA, metric expressions, py_func, scope,
+CompiledProgram/ParallelExecutor facades.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+
+
+@pytest.fixture
+def static_mode():
+    paddle.enable_static()
+    try:
+        yield
+    finally:
+        paddle.disable_static()
+
+
+def _build_linear_program():
+    main = static.Program()
+    startup = static.Program()
+    with static.program_guard(main, startup):
+        x = static.data(name="x", shape=[None, 4], dtype="float32")
+        y = static.nn.fc(x, size=2)
+    return main, startup, x, y
+
+
+class TestProgramStatePersistence:
+    def test_save_load_roundtrip(self, static_mode, tmp_path):
+        main, startup, x, y = _build_linear_program()
+        exe = static.Executor()
+        exe.run(startup)
+        xs = np.random.RandomState(0).randn(3, 4).astype("float32")
+        (before,) = exe.run(main, feed={"x": xs}, fetch_list=[y])
+        path = str(tmp_path / "prog")
+        static.save(main, path)
+
+        # trash the params, then restore
+        import jax.numpy as jnp
+        for p in main._params.values():
+            p._data = jnp.zeros_like(p._data)
+        (zeroed,) = exe.run(main, feed={"x": xs}, fetch_list=[y])
+        assert not np.allclose(zeroed, before)
+        static.load(main, path)
+        (after,) = exe.run(main, feed={"x": xs}, fetch_list=[y])
+        np.testing.assert_allclose(after, before, rtol=1e-6)
+
+    def test_load_program_state_dict(self, static_mode, tmp_path):
+        main, startup, *_ = _build_linear_program()
+        static.Executor().run(startup)
+        path = str(tmp_path / "st")
+        static.save(main, path)
+        state = static.load_program_state(path)
+        assert set(state) == set(main._params)
+        fresh = {k: np.zeros_like(v) for k, v in state.items()}
+        static.set_program_state(main, fresh)
+        assert all(np.allclose(np.asarray(p._data), 0)
+                   for p in main._params.values())
+
+    def test_serialize_persistables_roundtrip(self, static_mode):
+        main, startup, x, y = _build_linear_program()
+        static.Executor().run(startup)
+        blob = static.serialize_persistables([x], [y], main)
+        import jax.numpy as jnp
+        orig = {n: np.asarray(p._data) for n, p in main._params.items()}
+        for p in main._params.values():
+            p._data = jnp.zeros_like(p._data)
+        static.deserialize_persistables(main, blob)
+        for n, p in main._params.items():
+            np.testing.assert_allclose(np.asarray(p._data), orig[n])
+
+    def test_serialize_program_roundtrip(self, static_mode):
+        net = paddle.nn.Linear(4, 2)
+        spec = static.InputSpec([None, 4], "float32")
+        blob = static.serialize_program([spec], net)
+        runner = static.deserialize_program(blob)
+        xs = np.random.RandomState(1).randn(2, 4).astype("float32")
+        net.eval()
+        np.testing.assert_allclose(
+            np.asarray(runner(paddle.to_tensor(xs)).numpy()),
+            net(paddle.to_tensor(xs)).numpy(), rtol=1e-5, atol=1e-5)
+
+
+class TestMetricExpressions:
+    def test_accuracy_expression(self, static_mode):
+        main = static.Program()
+        with static.program_guard(main):
+            logits = static.data(name="lg", shape=[None, 3],
+                                 dtype="float32")
+            label = static.data(name="lb", shape=[None, 1], dtype="int64")
+            acc = static.accuracy(logits, label, k=1)
+        exe = static.Executor()
+        lg = np.array([[9, 0, 0], [0, 9, 0], [0, 0, 9], [9, 0, 0]],
+                      "float32")
+        lb = np.array([[0], [1], [2], [1]], "int64")
+        (val,) = exe.run(main, feed={"lg": lg, "lb": lb},
+                         fetch_list=[acc])
+        np.testing.assert_allclose(val, 0.75, rtol=1e-6)
+
+    def test_auc_expression_matches_sklearn_style(self, static_mode):
+        rng = np.random.RandomState(0)
+        probs = rng.rand(64).astype("float32")
+        labels = (probs + 0.3 * rng.randn(64) > 0.5).astype("int64")
+        inp = np.stack([1 - probs, probs], axis=1)
+        main = static.Program()
+        with static.program_guard(main):
+            p = static.data(name="p", shape=[None, 2], dtype="float32")
+            lb = static.data(name="lb", shape=[None, 1], dtype="int64")
+            a = static.auc(p, lb)
+        (val,) = static.Executor().run(
+            main, feed={"p": inp, "lb": labels.reshape(-1, 1)},
+            fetch_list=[a])
+        # rank-statistic ground truth
+        order = probs.argsort()
+        ranks = np.empty(64)
+        ranks[order] = np.arange(1, 65)
+        n_pos, n_neg = labels.sum(), 64 - labels.sum()
+        expect = (ranks[labels == 1].sum() - n_pos * (n_pos + 1) / 2) / \
+            (n_pos * n_neg)
+        np.testing.assert_allclose(float(val), expect, rtol=1e-4)
+
+
+class TestMiscFacades:
+    def test_py_func_in_program(self, static_mode):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data(name="x", shape=[None, 3], dtype="float32")
+            template = paddle.to_tensor(np.zeros((2, 3), "float32"))
+            out = static.py_func(lambda a: a * 3.0, x, template)
+        xs = np.ones((2, 3), "float32")
+        (val,) = static.Executor().run(main, feed={"x": xs},
+                                       fetch_list=[out])
+        np.testing.assert_allclose(val, 3.0)
+
+    def test_compiled_program_and_parallel_executor(self, static_mode):
+        main, startup, x, y = _build_linear_program()
+        exe = static.Executor()
+        exe.run(startup)
+        compiled = static.CompiledProgram(main).with_data_parallel()
+        xs = np.random.RandomState(2).randn(2, 4).astype("float32")
+        (via_compiled,) = exe.run(compiled._program, feed={"x": xs},
+                                  fetch_list=[y])
+        pe = static.ParallelExecutor(main_program=main)
+        (via_pe,) = pe.run(fetch_list=[y], feed={"x": xs})
+        np.testing.assert_allclose(via_compiled, via_pe)
+
+    def test_scope_finds_program_params(self, static_mode):
+        main, startup, *_ = _build_linear_program()
+        static.Executor().run(startup)
+        name = next(iter(main._params))
+        # the default-program scope path needs the program current
+        with static.program_guard(main):
+            pass
+        scope = static.Scope()
+        scope.set("custom", np.arange(3.0))
+        np.testing.assert_allclose(np.asarray(scope.find_var("custom")
+                                              .get_tensor()),
+                                   [0.0, 1.0, 2.0])
+        with static.scope_guard(scope):
+            assert static.global_scope() is scope
+
+    def test_ema_apply_restore(self, static_mode):
+        import jax.numpy as jnp
+        main = static.Program()
+        with static.program_guard(main):
+            p = static.create_parameter([2], "float32", name="ema_p")
+            p._data = jnp.ones(2)
+            ema = static.ExponentialMovingAverage(decay=0.5)
+            ema.update()
+            p._data = jnp.full((2,), 3.0)
+            ema.update()                    # shadow = 0.5*1 + 0.5*3 = 2
+            with ema.apply():
+                np.testing.assert_allclose(np.asarray(p._data), 2.0)
+            np.testing.assert_allclose(np.asarray(p._data), 3.0)
+
+    def test_variable_alias_and_places(self):
+        t = paddle.to_tensor(np.zeros(2, "float32"))
+        assert isinstance(t, static.Variable)
+        assert static.cuda_places() == []
+        assert static.npu_places() == []
+
+    def test_ipu_family_raises_like_reference(self):
+        with pytest.raises(RuntimeError, match="IPU"):
+            static.IpuStrategy()
+        with pytest.raises(RuntimeError, match="IPU"):
+            static.ipu_shard_guard()
+
+    def test_ctr_metric_bundle_descoped(self):
+        with pytest.raises(NotImplementedError, match="PS/CTR"):
+            static.ctr_metric_bundle(None, None)
+
+    def test_gradients_for_parameters(self, static_mode):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data(name="x", shape=[None, 2], dtype="float32")
+            w = static.create_parameter([2, 1], "float32")
+            y = paddle.matmul(x, w)
+            loss = paddle.mean(y)
+            (g,) = static.gradients(loss, [w])
+        xs = np.ones((4, 2), "float32")
+        (gv,) = static.Executor().run(main, feed={"x": xs},
+                                      fetch_list=[g])
+        # loss = mean_i(x_i . w); d/dw_j = mean_i x_ij = 1 for all-ones x
+        np.testing.assert_allclose(gv, 1.0, rtol=1e-6)
+
+    def test_print_is_identity(self):
+        x = paddle.to_tensor(np.arange(3.0, dtype="float32"))
+        out = static.Print(x, message="dbg")
+        np.testing.assert_allclose(out.numpy(), x.numpy())
