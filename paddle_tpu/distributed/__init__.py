@@ -23,9 +23,19 @@ from .fleet.meta_parallel.parallel_layers.mp_layers import (  # noqa: F401
     VocabParallelEmbedding,
 )
 
+from .ps_compat import (  # noqa: F401
+    CountFilterEntry, InMemoryDataset, ParallelMode, ProbabilityEntry,
+    QueueDataset, ShowClickEntry, gloo_barrier, gloo_init_parallel_env,
+    gloo_release, split,
+)
+from . import embedding  # noqa: F401
+
 __all__ = [
     "ReduceOp", "all_gather", "all_reduce", "alltoall", "barrier",
     "broadcast", "get_group", "new_group", "recv", "reduce", "scatter",
     "send", "get_rank", "get_world_size", "init_parallel_env",
-    "is_initialized", "fleet", "spmd",
+    "is_initialized", "fleet", "spmd", "split", "ParallelMode",
+    "gloo_init_parallel_env", "gloo_barrier", "gloo_release",
+    "InMemoryDataset", "QueueDataset", "CountFilterEntry",
+    "ProbabilityEntry", "ShowClickEntry", "embedding",
 ]

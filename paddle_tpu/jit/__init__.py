@@ -32,6 +32,7 @@ from ..framework.tensor import Tensor, no_grad_guard
 from ..static import InputSpec
 
 __all__ = ["to_static", "save", "load", "TranslatedLayer", "not_to_static",
+           "TracedLayer", "set_code_level", "set_verbosity",
            "ProgramTranslator", "enable_to_static", "ignore_module"]
 
 _FORMAT_VERSION = 1
@@ -362,3 +363,62 @@ def load(path, **configs) -> TranslatedLayer:
         with open(path + ".meta.json") as f:
             meta = json.load(f)
     return TranslatedLayer(exported, state, meta)
+
+
+# --------------------------------------------------------------------------
+# dy2static logging knobs + legacy TracedLayer (reference jit/api.py)
+# --------------------------------------------------------------------------
+
+def set_verbosity(level=0, also_to_stdout=False):
+    """Reference jit.set_verbosity: dy2static transform log level."""
+    import logging
+    logger = logging.getLogger("paddle_tpu.dy2static")
+    logger.setLevel(max(logging.DEBUG,
+                        logging.WARNING - 10 * int(level)))
+    if also_to_stdout and not logger.handlers:
+        import sys
+        logger.addHandler(logging.StreamHandler(sys.stdout))
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """Reference jit.set_code_level: log the transformed code. Here the
+    AST converter (jit/dy2static.py) logs its rewritten source at DEBUG;
+    this lowers the logger to show it."""
+    set_verbosity(3 if level else 0, also_to_stdout)
+
+
+class TracedLayer:
+    """Legacy trace API (reference fluid/dygraph/jit.py TracedLayer):
+    ``TracedLayer.trace(layer, inputs)`` -> (outputs, traced); the traced
+    object replays the jitted forward and exports via
+    ``save_inference_model``. On this backend tracing IS jax tracing of
+    one concrete call."""
+
+    def __init__(self, layer, example_inputs):
+        self._layer = layer
+        self._examples = list(example_inputs)
+
+    @classmethod
+    def trace(cls, layer, inputs):
+        traced = cls(layer, inputs)
+        outputs = traced(*inputs)
+        return outputs, traced
+
+    def __call__(self, *inputs):
+        return self._layer(*inputs)
+
+    def save_inference_model(self, path, feed=None, fetch=None, **kwargs):
+        from ..static import InputSpec
+        if feed is not None or fetch is not None:
+            import warnings
+            warnings.warn(
+                "TracedLayer.save_inference_model feed=/fetch= subsetting "
+                "is not supported on this backend; exporting the FULL "
+                "traced signature", UserWarning, stacklevel=2)
+        specs = [InputSpec.from_tensor(t) for t in self._examples]
+        was_training = self._layer.training
+        self._layer.eval()
+        try:
+            return save(self._layer, path, input_spec=specs)
+        finally:
+            self._layer.train() if was_training else self._layer.eval()

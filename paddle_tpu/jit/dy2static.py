@@ -478,6 +478,12 @@ def _convert_function(func):
         ast.copy_location(factory, fdef)
         module = ast.Module(body=[factory], type_ignores=[])
     ast.fix_missing_locations(module)
+    import logging
+    logger = logging.getLogger("paddle_tpu.dy2static")
+    if logger.isEnabledFor(logging.DEBUG):
+        # jit.set_code_level/set_verbosity surface the rewritten source
+        logger.debug("dy2static transformed %s:\n%s", func.__qualname__,
+                     ast.unparse(fdef))
     try:
         lineno = func.__code__.co_firstlineno
         ast.increment_lineno(module, lineno - 1)

@@ -172,3 +172,24 @@ class TestStaticInferenceModel:
         assert feed_names == ["x"]
         np.testing.assert_allclose(layer(x).numpy(), ref, rtol=1e-5,
                                    atol=1e-6)
+
+
+def test_traced_layer_roundtrip(tmp_path):
+    """Legacy TracedLayer.trace -> save_inference_model -> jit.load
+    (reference fluid/dygraph/jit.py TracedLayer)."""
+    from paddle_tpu import jit
+
+    paddle.framework.random.seed(0)
+    net = paddle.nn.Linear(4, 2)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(2, 4)
+                         .astype("float32"))
+    out, traced = jit.TracedLayer.trace(net, [x])
+    np.testing.assert_allclose(out.numpy(), net(x).numpy())
+    path = str(tmp_path / "traced")
+    traced.save_inference_model(path)
+    loaded = jit.load(path)
+    net.eval()
+    np.testing.assert_allclose(np.asarray(loaded(x).numpy()),
+                               net(x).numpy(), rtol=1e-5, atol=1e-5)
+    jit.set_verbosity(1)
+    jit.set_code_level(100)
