@@ -2,8 +2,8 @@
 of the hapi callback family."""
 from .hapi.callbacks import (  # noqa: F401
     Callback, EarlyStopping, LRScheduler, ModelCheckpoint, ProgBarLogger,
-    VisualDL,
+    ReduceLROnPlateau, VisualDL,
 )
 
 __all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "LRScheduler",
-           "EarlyStopping", "VisualDL"]
+           "EarlyStopping", "ReduceLROnPlateau", "VisualDL"]

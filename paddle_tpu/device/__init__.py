@@ -125,3 +125,85 @@ class _CudaAlias:
 
 
 cuda = _CudaAlias()
+
+
+# --------------------------------------------------------------------------
+# device-family compat surface (reference python/paddle/device/__init__.py)
+# the truthful answers on a TPU/XLA backend: no CUDA/XPU/NPU/MLU/IPU
+# compilation, no cudnn; device discovery reports what PjRt sees
+# --------------------------------------------------------------------------
+
+class _UnavailablePlace:
+    _kind = "device"
+
+    def __init__(self, dev_id=0):
+        raise RuntimeError(
+            f"{type(self).__name__}: this backend is TPU-over-XLA; "
+            f"{self._kind} devices do not exist here (the reference "
+            f"raises identically unless compiled with that device)")
+
+
+class XPUPlace(_UnavailablePlace):
+    _kind = "XPU"
+
+
+class MLUPlace(_UnavailablePlace):
+    _kind = "MLU"
+
+
+class IPUPlace(_UnavailablePlace):
+    _kind = "IPU"
+
+
+def is_compiled_with_ipu() -> bool:
+    return False
+
+
+def is_compiled_with_mlu() -> bool:
+    return False
+
+
+def is_compiled_with_npu() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_cinn() -> bool:
+    return False
+
+
+def is_compiled_with_rocm() -> bool:
+    return False
+
+
+def get_cudnn_version():
+    return None     # no cuDNN in an XLA/TPU build
+
+
+def get_all_device_type():
+    import jax
+    return sorted({d.platform for d in jax.devices()})
+
+
+def get_all_custom_device_type():
+    return []
+
+
+def get_available_device():
+    import jax
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def get_available_custom_device():
+    return []
+
+
+__all__ += ["XPUPlace", "MLUPlace", "IPUPlace", "is_compiled_with_ipu",
+            "is_compiled_with_mlu", "is_compiled_with_npu",
+            "is_compiled_with_xpu", "is_compiled_with_cinn",
+            "is_compiled_with_rocm", "get_cudnn_version",
+            "get_all_device_type", "get_all_custom_device_type",
+            "get_available_device", "get_available_custom_device"]

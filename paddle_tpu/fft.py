@@ -10,6 +10,7 @@ import jax.numpy as jnp
 from .framework.tensor import Tensor
 
 __all__ = ["fft", "ifft", "rfft", "irfft", "hfft", "ihfft",
+           "hfft2", "ihfft2", "hfftn", "ihfftn",
            "fft2", "ifft2", "rfft2", "irfft2",
            "fftn", "ifftn", "rfftn", "irfftn",
            "fftfreq", "rfftfreq", "fftshift", "ifftshift"]
@@ -50,6 +51,49 @@ fft2 = _wrap2(jnp.fft.fft2)
 ifft2 = _wrap2(jnp.fft.ifft2)
 rfft2 = _wrap2(jnp.fft.rfft2)
 irfft2 = _wrap2(jnp.fft.irfft2)
+def _hfftn_impl(a, s=None, axes=None, norm="backward"):
+    """hfftn per scipy semantics (the reference follows scipy.fft):
+    FFT over all axes but the last, then a Hermitian FFT (real output)
+    over the last axis. With ``s`` given and axes omitted, the LAST
+    len(s) axes transform (scipy's alignment rule)."""
+    if axes is None:
+        axes = tuple(range(a.ndim)) if s is None else \
+            tuple(range(a.ndim - len(s), a.ndim))
+    axes = tuple(axes)
+    head, last = axes[:-1], axes[-1]
+    if head:
+        a = jnp.fft.fftn(a, s=None if s is None else s[:-1], axes=head,
+                         norm=norm)
+    n_last = None if s is None else s[-1]
+    return jnp.fft.hfft(a, n=n_last, axis=last, norm=norm)
+
+
+def _ihfftn_impl(a, s=None, axes=None, norm="backward"):
+    if axes is None:
+        axes = tuple(range(a.ndim)) if s is None else \
+            tuple(range(a.ndim - len(s), a.ndim))
+    axes = tuple(axes)
+    head, last = axes[:-1], axes[-1]
+    n_last = None if s is None else s[-1]
+    a = jnp.fft.ihfft(a, n=n_last, axis=last, norm=norm)
+    if head:
+        a = jnp.fft.ifftn(a, s=None if s is None else s[:-1], axes=head,
+                          norm=norm)
+    return a
+
+
+hfftn = _wrapn(_hfftn_impl)
+ihfftn = _wrapn(_ihfftn_impl)
+
+
+def _fix2(fn):
+    def two_d(a, s=None, axes=(-2, -1), norm="backward"):
+        return fn(a, s=s, axes=axes, norm=norm)
+    return two_d
+
+
+hfft2 = _wrapn(_fix2(_hfftn_impl))
+ihfft2 = _wrapn(_fix2(_ihfftn_impl))
 fftn = _wrapn(jnp.fft.fftn)
 ifftn = _wrapn(jnp.fft.ifftn)
 rfftn = _wrapn(jnp.fft.rfftn)

@@ -12,6 +12,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 __all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "EarlyStopping",
+           "ReduceLROnPlateau",
            "LRScheduler", "History", "VisualDL", "config_callbacks"]
 
 
@@ -294,3 +295,65 @@ class VisualDL(Callback):
         if self._fh is not None:
             self._fh.close()
             self._fh = None
+
+
+class ReduceLROnPlateau(Callback):
+    """Shrink the lr when the monitored metric plateaus (reference
+    hapi/callbacks.py ReduceLROnPlateau). Works with either a plain
+    float lr or an optimizer.lr scheduler (via set_lr)."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10, verbose=1,
+                 mode="auto", min_delta=1e-4, cooldown=0, min_lr=0):
+        super().__init__()
+        self.monitor = monitor
+        self.factor = float(factor)
+        if self.factor >= 1.0:
+            raise ValueError("factor must be < 1.0")
+        self.patience = patience
+        self.verbose = verbose
+        self.min_delta = abs(min_delta)
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        if mode == "max" or (mode == "auto" and "acc" in monitor):
+            self.monitor_op = np.greater
+        else:
+            self.monitor_op = np.less
+            self.min_delta = -self.min_delta
+        self.best = None
+        self.wait = 0
+        self.cooldown_counter = 0
+
+    def on_train_begin(self, logs=None):
+        self.best = None
+        self.wait = 0
+        self.cooldown_counter = 0
+
+    def _current(self, logs):
+        v = (logs or {}).get(self.monitor)
+        return None if v is None else float(np.asarray(v).ravel()[0])
+
+    def on_eval_end(self, logs=None):
+        current = self._current(logs)
+        if current is None:
+            return
+        if self.cooldown_counter > 0:
+            self.cooldown_counter -= 1
+            self.wait = 0
+        if self.best is None or self.monitor_op(
+                current - self.min_delta, self.best):
+            self.best = current
+            self.wait = 0
+            return
+        self.wait += 1
+        if self.wait >= self.patience:
+            opt = getattr(self.model, "_optimizer", None)
+            if opt is None:
+                return
+            old = float(opt.get_lr())
+            new = max(old * self.factor, self.min_lr)
+            if new < old:
+                opt.set_lr(new)
+                if self.verbose:
+                    print(f"ReduceLROnPlateau: lr {old:.3g} -> {new:.3g}")
+            self.cooldown_counter = self.cooldown
+            self.wait = 0

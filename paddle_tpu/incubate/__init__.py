@@ -4,5 +4,10 @@ Analog of the reference's ``python/paddle/incubate/`` (fused transformer
 layers, MoE, functional autograd, sparse, autotune).
 """
 from . import asp, autograd, autotune, moe, nn, optimizer  # noqa: F401
+from .graph_ops import (  # noqa: F401
+    graph_khop_sampler, graph_reindex, graph_sample_neighbors,
+    graph_send_recv, segment_max, segment_mean, segment_min, segment_sum,
+    softmax_mask_fuse, softmax_mask_fuse_upper_triangle,
+)
 from .moe import MoELayer  # noqa: F401
 from .optimizer import LookAhead, ModelAverage  # noqa: F401

@@ -1,0 +1,248 @@
+"""Graph-learning ops (reference: python/paddle/incubate/operators/
+graph_send_recv.py, graph_khop_sampler.py, graph_reindex.py,
+graph_sample_neighbors.py; python/paddle/incubate/tensor/math.py
+segment_*; softmax_mask_fuse*.py).
+
+TPU mapping: the dense message-passing compute (segment reductions,
+send/recv aggregation, masked softmax) is jax segment ops / XLA-fused
+expressions — static-shaped and differentiable. The SAMPLING ops
+(khop/neighbors/reindex) are data-dependent-shape graph preprocessing:
+they run host-side on numpy (exactly where the reference's CPU kernels
+run them in a sampler worker) and feed static batches to the device.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..framework.tensor import Tensor
+
+__all__ = ["segment_sum", "segment_mean", "segment_max", "segment_min",
+           "graph_send_recv", "graph_khop_sampler", "graph_reindex",
+           "graph_sample_neighbors", "softmax_mask_fuse",
+           "softmax_mask_fuse_upper_triangle"]
+
+
+def _arr(x):
+    import jax.numpy as jnp
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _segment(data, ids, mode):
+    """Shared segment reduction; num_segments = max(ids)+1 (host-read,
+    like the reference's dynamic output) — inside jit pass concrete
+    arrays only through the functional forms below."""
+    import jax
+    import jax.numpy as jnp
+    d, i = _arr(data), _arr(ids).astype(jnp.int32)
+    n = int(jax.device_get(i.max())) + 1 if i.size else 0
+    from ..autograd import differentiable_apply
+
+    def fn(dd):
+        if mode == "sum":
+            return jax.ops.segment_sum(dd, i, num_segments=n)
+        if mode == "mean":
+            s = jax.ops.segment_sum(dd, i, num_segments=n)
+            cnt = jax.ops.segment_sum(jnp.ones_like(i, dd.dtype), i,
+                                      num_segments=n)
+            shape = (n,) + (1,) * (dd.ndim - 1)
+            return s / jnp.maximum(cnt, 1).reshape(shape)
+        if mode == "max":
+            return jax.ops.segment_max(dd, i, num_segments=n)
+        return jax.ops.segment_min(dd, i, num_segments=n)
+
+    return differentiable_apply(
+        fn, data if isinstance(data, Tensor) else Tensor(d))
+
+
+def segment_sum(data, segment_ids, name=None):
+    return _segment(data, segment_ids, "sum")
+
+
+def segment_mean(data, segment_ids, name=None):
+    return _segment(data, segment_ids, "mean")
+
+
+def segment_max(data, segment_ids, name=None):
+    return _segment(data, segment_ids, "max")
+
+
+def segment_min(data, segment_ids, name=None):
+    return _segment(data, segment_ids, "min")
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum",
+                    out_size=None, name=None):
+    """Message passing: gather x at src, segment-reduce onto dst
+    (reference graph_send_recv op)."""
+    import jax
+    import jax.numpy as jnp
+    xv = _arr(x)
+    src = _arr(src_index).astype(jnp.int32)
+    dst = _arr(dst_index).astype(jnp.int32)
+    n = int(out_size) if out_size else xv.shape[0]
+    mode = pool_type.lower()
+    from ..autograd import differentiable_apply
+
+    def fn(xx):
+        msgs = xx[src]
+        if mode == "sum":
+            return jax.ops.segment_sum(msgs, dst, num_segments=n)
+        if mode == "mean":
+            s = jax.ops.segment_sum(msgs, dst, num_segments=n)
+            cnt = jax.ops.segment_sum(jnp.ones_like(dst, xx.dtype), dst,
+                                      num_segments=n)
+            return s / jnp.maximum(cnt, 1).reshape(
+                (n,) + (1,) * (xx.ndim - 1))
+        if mode == "max":
+            out = jax.ops.segment_max(msgs, dst, num_segments=n)
+            return jnp.where(jnp.isfinite(out), out, 0)  # empty dst -> 0
+        if mode == "min":
+            out = jax.ops.segment_min(msgs, dst, num_segments=n)
+            return jnp.where(jnp.isfinite(out), out, 0)
+        raise ValueError(f"unknown pool_type {pool_type!r}")
+
+    return differentiable_apply(
+        fn, x if isinstance(x, Tensor) else Tensor(xv))
+
+
+# --------------------------------------------------------------------------
+# host-side samplers (data-dependent shapes; run where the reference's
+# CPU sampler kernels run — in the input pipeline)
+# --------------------------------------------------------------------------
+
+def _np(x):
+    return np.asarray(x.numpy() if isinstance(x, Tensor) else x)
+
+
+def graph_sample_neighbors(row, colptr, input_nodes, sample_size=-1,
+                           eids=None, return_eids=False, perm_buffer=None,
+                           name=None):
+    """Uniform neighbor sampling on a CSC graph (reference
+    graph_sample_neighbors): returns (out_neighbors, out_count[, eids])."""
+    rng = np.random
+    row_np, colptr_np = _np(row), _np(colptr)
+    nodes = _np(input_nodes)
+    eids_np = _np(eids) if eids is not None else None
+    out, out_eids, counts = [], [], []
+    for v in nodes.reshape(-1):
+        lo, hi = int(colptr_np[v]), int(colptr_np[v + 1])
+        neigh = row_np[lo:hi]
+        idx = np.arange(lo, hi)
+        if sample_size >= 0 and len(neigh) > sample_size:
+            pick = rng.choice(len(neigh), sample_size, replace=False)
+            neigh, idx = neigh[pick], idx[pick]
+        out.append(neigh)
+        counts.append(len(neigh))
+        if eids_np is not None:
+            out_eids.append(eids_np[idx])
+    out_neigh = Tensor(np.concatenate(out) if out else
+                       np.zeros((0,), row_np.dtype))
+    out_count = Tensor(np.asarray(counts, np.int32))
+    if return_eids:
+        if eids_np is None:
+            raise ValueError("return_eids=True requires eids")
+        return out_neigh, out_count, Tensor(
+            np.concatenate(out_eids) if out_eids else
+            np.zeros((0,), eids_np.dtype))
+    return out_neigh, out_count
+
+
+def graph_reindex(x, neighbors, count, value_buffer=None,
+                  index_buffer=None, flag_buffer_hashtable=False,
+                  name=None):
+    """Compact global node ids to local ids (reference graph_reindex):
+    returns (reindexed_src, reindexed_dst, out_nodes)."""
+    xs, neigh, cnt = _np(x).reshape(-1), _np(neighbors).reshape(-1), \
+        _np(count).reshape(-1)
+    order: dict = {}
+    for v in xs:
+        order.setdefault(int(v), len(order))
+    for v in neigh:
+        order.setdefault(int(v), len(order))
+    out_nodes = np.fromiter(order.keys(), dtype=xs.dtype,
+                            count=len(order))
+    re_src = np.asarray([order[int(v)] for v in neigh], np.int64)
+    re_dst = np.repeat(np.arange(len(xs), dtype=np.int64), cnt)
+    return Tensor(re_src), Tensor(re_dst), Tensor(out_nodes)
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """K-hop sampling = repeated neighbor sampling + one final reindex
+    (reference graph_khop_sampler). Returns (edge_src, edge_dst,
+    sample_index, reindex_nodes): local-id edges, the global ids of all
+    touched nodes, and the center nodes' local ids."""
+    if return_eids and sorted_eids is None:
+        raise ValueError("return_eids=True requires sorted_eids")
+    centers = _np(input_nodes).reshape(-1)
+    all_src, all_dst, all_eids = [], [], []
+    frontier = centers
+    for size in sample_sizes:
+        res = graph_sample_neighbors(row, colptr, frontier,
+                                     sample_size=size, eids=sorted_eids,
+                                     return_eids=return_eids)
+        neigh, cnt = res[0], res[1]
+        neigh_np, cnt_np = _np(neigh), _np(cnt)
+        all_src.append(neigh_np)
+        all_dst.append(np.repeat(frontier, cnt_np))
+        if return_eids:
+            all_eids.append(_np(res[2]))
+        frontier = np.unique(neigh_np)
+    src = np.concatenate(all_src) if all_src else np.zeros((0,), np.int64)
+    dst = np.concatenate(all_dst) if all_dst else np.zeros((0,), np.int64)
+    # compact global -> local: centers first, then new neighbors
+    order: dict = {}
+    for v in centers:
+        order.setdefault(int(v), len(order))
+    for v in src:
+        order.setdefault(int(v), len(order))
+    nodes = np.fromiter(order.keys(), dtype=np.int64, count=len(order))
+    edge_src = np.asarray([order[int(v)] for v in src], np.int64)
+    edge_dst = np.asarray([order[int(v)] for v in dst], np.int64)
+    center_local = np.asarray([order[int(v)] for v in centers], np.int64)
+    out = (Tensor(edge_src), Tensor(edge_dst), Tensor(nodes),
+           Tensor(center_local))
+    if return_eids:
+        eids_cat = np.concatenate(all_eids) if all_eids else \
+            np.zeros((0,), np.int64)
+        return out + (Tensor(eids_cat),)
+    return out
+
+
+# --------------------------------------------------------------------------
+# fused masked softmax (reference softmax_mask_fuse*.py — CUDA fused
+# kernels; XLA fuses the same expression on TPU)
+# --------------------------------------------------------------------------
+
+def softmax_mask_fuse(x, mask, name=None):
+    """softmax(x + mask) along the last axis, fp32 accumulation."""
+    import jax
+    import jax.numpy as jnp
+    from ..autograd import differentiable_apply
+    m = _arr(mask)
+
+    def fn(xx):
+        z = xx.astype(jnp.float32) + m.astype(jnp.float32)
+        return jax.nn.softmax(z, axis=-1).astype(xx.dtype)
+
+    return differentiable_apply(
+        fn, x if isinstance(x, Tensor) else Tensor(_arr(x)))
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    """Causal masked softmax: positions j > i get -inf (reference's
+    fused upper-triangle variant for GPT attention scores)."""
+    import jax
+    import jax.numpy as jnp
+    from ..autograd import differentiable_apply
+
+    def fn(xx):
+        s = xx.shape[-1]
+        causal = jnp.tril(jnp.ones((s, s), jnp.bool_))
+        z = jnp.where(causal, xx.astype(jnp.float32), -1e9)
+        return jax.nn.softmax(z, axis=-1).astype(xx.dtype)
+
+    return differentiable_apply(
+        fn, x if isinstance(x, Tensor) else Tensor(_arr(x)))

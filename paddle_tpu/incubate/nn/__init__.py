@@ -15,9 +15,12 @@ the reference bakes into its fused ops.
 """
 from . import functional  # noqa: F401
 from .layer.fused_transformer import (  # noqa: F401
-    FusedFeedForward, FusedMultiHeadAttention,
+    FusedBiasDropoutResidualLayerNorm, FusedFeedForward, FusedLinear,
+    FusedMultiHeadAttention, FusedMultiTransformer,
     FusedTransformerEncoderLayer,
 )
 
 __all__ = ["FusedMultiHeadAttention", "FusedFeedForward",
-           "FusedTransformerEncoderLayer", "functional"]
+           "FusedTransformerEncoderLayer", "FusedLinear",
+           "FusedBiasDropoutResidualLayerNorm", "FusedMultiTransformer",
+           "functional"]

@@ -15,7 +15,8 @@ from .framework.dispatch import call_op as _op
 __all__ = ["cholesky", "det", "slogdet", "norm", "cond", "inv", "pinv",
            "svd", "qr", "lu", "eig", "eigvals", "eigh", "eigvalsh",
            "matrix_power", "matrix_rank", "solve", "triangular_solve",
-           "lstsq", "multi_dot"]
+           "lstsq", "multi_dot", "cholesky_solve", "corrcoef", "cov",
+           "lu_unpack"]
 
 
 def cholesky(x, upper=False, name=None):
@@ -114,3 +115,66 @@ def lstsq(x, y, rcond=None, driver=None, name=None):
 
 def multi_dot(xs, name=None):
     return _op("multi_dot", xs)
+
+
+def _a(v):
+    from .framework.tensor import Tensor
+    import jax.numpy as jnp
+    return v._data if isinstance(v, Tensor) else jnp.asarray(v)
+
+
+def _t(v):
+    from .framework.tensor import Tensor
+    return None if v is None else Tensor(v, stop_gradient=True)
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    """Solve A @ out = x given y = chol(A) (reference
+    linalg.cholesky_solve over the cholesky_solve kernel)."""
+    from jax.scipy.linalg import cho_solve
+    return _t(cho_solve((_a(y), not upper), _a(x)))
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None,
+        name=None):
+    """Covariance matrix (reference linalg.cov)."""
+    import jax.numpy as jnp
+    fw = None if fweights is None else _a(fweights)
+    aw = None if aweights is None else _a(aweights)
+    return _t(jnp.cov(_a(x), rowvar=rowvar,
+                      ddof=1 if ddof else 0, fweights=fw, aweights=aw))
+
+
+def corrcoef(x, rowvar=True, name=None):
+    import jax.numpy as jnp
+    return _t(jnp.corrcoef(_a(x), rowvar=rowvar))
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """(LU, pivots) -> (P, L, U) (reference linalg.lu_unpack). ``y`` is
+    the 1-based sequential pivot vector paddle.linalg.lu returns.
+    Supports arbitrary leading batch dims (host-side unpack — this is a
+    checkpoint/debug utility, not a jitted hot path)."""
+    import numpy as _np
+    lu_mat = _np.asarray(_a(x))
+    piv = _np.asarray(_a(y))
+    m, n = lu_mat.shape[-2:]
+    k = min(m, n)
+    L = U = P = None
+    if unpack_ludata:
+        L = _np.tril(lu_mat[..., :, :k], -1) + _np.eye(
+            m, k, dtype=lu_mat.dtype)
+        U = _np.triu(lu_mat[..., :k, :])
+    if unpack_pivots:
+        batch = piv.shape[:-1]
+        piv2 = piv.reshape(-1, piv.shape[-1])
+        Ps = _np.empty(piv2.shape[:1] + (m, m), lu_mat.dtype)
+        for b in range(piv2.shape[0]):
+            # sequential 1-based transpositions -> permutation
+            perm = _np.arange(m)
+            for i in range(piv2.shape[1]):
+                j = int(piv2[b, i]) - 1
+                perm[i], perm[j] = perm[j], perm[i]
+            Ps[b] = _np.eye(m, dtype=lu_mat.dtype)[perm].T
+        P = Ps.reshape(batch + (m, m))
+    return _t(P), _t(L), _t(U)

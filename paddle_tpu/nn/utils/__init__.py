@@ -1,0 +1,156 @@
+"""``paddle.nn.utils`` (reference: python/paddle/nn/utils/ —
+weight_norm_hook.py, spectral_norm_hook.py, transform_parameters.py).
+
+TPU note: weight norm is a reparameterization ``w = g * v / ||v||``
+recomputed every forward; expressed in jnp it fuses into the consuming
+matmul under jit, so there is no runtime cost to keeping it exact.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...framework.tensor import Tensor
+
+__all__ = ["weight_norm", "remove_weight_norm", "spectral_norm",
+           "parameters_to_vector", "vector_to_parameters"]
+
+
+def _norm_except(v, dim):
+    import jax.numpy as jnp
+    if dim is None:
+        return jnp.sqrt(jnp.sum(v * v))
+    axes = tuple(i for i in range(v.ndim) if i != dim)
+    return jnp.sqrt(jnp.sum(v * v, axis=axes, keepdims=True))
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Reparameterize ``layer.<name>`` as g * v/||v|| (reference
+    weight_norm_hook.py): registers <name>_g and <name>_v parameters and
+    a pre-forward hook that rebuilds the weight each call."""
+    import jax.numpy as jnp
+    w = getattr(layer, name)
+    if not isinstance(w, Tensor):
+        raise ValueError(f"layer has no parameter {name!r}")
+    g0 = _norm_except(w._data, dim)
+    from ...framework.tensor import Parameter
+    v = Parameter(jnp.asarray(w._data), name=f"{w.name}_v")
+    g = Parameter(jnp.asarray(g0), name=f"{w.name}_g")
+    # replace the original parameter; v/g are what the optimizer sees
+    del layer._parameters[name]
+    layer._parameters[f"{name}_v"] = v
+    layer._parameters[f"{name}_g"] = g
+
+    def hook(lyr, inputs):
+        vv, gg = lyr._parameters[f"{name}_v"], \
+            lyr._parameters[f"{name}_g"]
+        # thread the tape so grads reach v and g in eager mode
+        from ...autograd import differentiable_apply
+        built = differentiable_apply(
+            lambda a, b: b * a / jnp.maximum(_norm_except(a, dim), 1e-12),
+            vv, gg)
+        object.__setattr__(lyr, name, built)
+        return None
+
+    handle = layer.register_forward_pre_hook(hook)
+    layer._weight_norm_handle = (handle, name, dim)
+    hook(layer, ())          # materialize once so .weight exists now
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    """Bake the current normalized weight back into a plain parameter."""
+    import jax.numpy as jnp
+    handle, nm, dim = getattr(layer, "_weight_norm_handle",
+                              (None, name, 0))
+    if handle is None:
+        raise ValueError("layer has no weight norm applied")
+    handle.remove()
+    from ...framework.tensor import Parameter
+    v = layer._parameters.pop(f"{nm}_v")
+    g = layer._parameters.pop(f"{nm}_g")
+    norm = _norm_except(v._data, dim)
+    w = Parameter(g._data * v._data / jnp.maximum(norm, 1e-12))
+    layer._parameters[nm] = w
+    object.__setattr__(layer, nm, w)
+    del layer._weight_norm_handle
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    """Spectral normalization hook (reference spectral_norm_hook.py):
+    divides the weight by its leading singular value, estimated by
+    power iteration refreshed each forward."""
+    import jax.numpy as jnp
+    w = getattr(layer, name)
+    if dim is None:
+        dim = 0
+    mat = np.asarray(w._data)
+    h = mat.shape[dim]
+    rest = int(np.prod(mat.shape)) // h
+    rng = np.random.RandomState(0)
+    layer._sn_u = jnp.asarray(rng.randn(h).astype(np.float32))
+    layer._sn_state = (name, dim, int(n_power_iterations), float(eps))
+
+    def hook(lyr, inputs):
+        import jax
+        nm, d, iters, e = lyr._sn_state
+        ww = lyr._parameters[nm + "_orig"]
+        m = jnp.moveaxis(ww._data, d, 0).reshape(h, rest)
+        u = lyr._sn_u
+        # v is always derived once from the stored u so iters=0 (reuse
+        # the converged estimate, reference-legal) still defines sigma
+        v = m.T @ u
+        v = v / jnp.maximum(jnp.linalg.norm(v), e)
+        for _ in range(iters):
+            u = m @ v
+            u = u / jnp.maximum(jnp.linalg.norm(u), e)
+            v = m.T @ u
+            v = v / jnp.maximum(jnp.linalg.norm(v), e)
+        if not isinstance(u, jax.core.Tracer):
+            lyr._sn_u = u       # persist only concrete estimates
+        sigma = u @ m @ v
+        from ...autograd import differentiable_apply
+        built = differentiable_apply(
+            lambda a: a / jnp.maximum(sigma, e), ww)
+        object.__setattr__(lyr, nm, built)
+        return None
+
+    from ...framework.tensor import Parameter
+    orig = Parameter(jnp.asarray(w._data), name=f"{w.name}_orig")
+    del layer._parameters[name]
+    layer._parameters[name + "_orig"] = orig
+    layer.register_forward_pre_hook(hook)
+    # converge the power iteration once at apply time (the reference
+    # refines 1 step/forward; starting converged avoids an early phase
+    # where sigma is underestimated and the "normalized" weight isn't)
+    layer._sn_state = (name, dim, max(10, int(n_power_iterations)),
+                       float(eps))
+    hook(layer, ())
+    layer._sn_state = (name, dim, int(n_power_iterations), float(eps))
+    return layer
+
+
+def parameters_to_vector(parameters, name=None) -> Tensor:
+    """Flatten+concat parameters (reference transform_parameters.py)."""
+    import jax.numpy as jnp
+    return Tensor(jnp.concatenate(
+        [p._data.reshape(-1) for p in parameters]))
+
+
+def vector_to_parameters(vec, parameters, name=None) -> None:
+    """Write a flat vector back into the parameter list, in order."""
+    import jax.numpy as jnp
+    arr = vec._data if isinstance(vec, Tensor) else jnp.asarray(vec)
+    parameters = list(parameters)
+    total = sum(int(np.prod(p.shape)) for p in parameters)
+    if total != arr.shape[0]:
+        raise ValueError(
+            f"vector has {arr.shape[0]} elements but parameters hold "
+            f"{total}")
+    offset = 0
+    for p in parameters:
+        n = int(np.prod(p.shape))
+        p._data = arr[offset:offset + n].reshape(tuple(p.shape)).astype(
+            p._data.dtype)
+        offset += n

@@ -18,10 +18,11 @@ stop_trace`` so one object drives both timelines.
 """
 from .profiler import (  # noqa: F401
     Profiler, ProfilerState, ProfilerTarget, RecordEvent, load_profiler_result,
-    make_scheduler, export_chrome_tracing,
+    make_scheduler, export_chrome_tracing, export_protobuf, SortedKeys,
 )
 from .xplane import device_op_table, summary_table  # noqa: F401
 
 __all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
-           "make_scheduler", "export_chrome_tracing",
-           "load_profiler_result", "device_op_table", "summary_table"]
+           "make_scheduler", "export_chrome_tracing", "export_protobuf",
+           "SortedKeys", "load_profiler_result", "device_op_table",
+           "summary_table"]

@@ -364,3 +364,40 @@ class Profiler:
     def reset(self):
         _recorder.clear()
         self._step_times = []
+
+
+class SortedKeys:
+    """Sort keys for Profiler.summary (reference profiler/profiler.py
+    SortedKeys enum)."""
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    GPUTotal = 4
+    GPUAvg = 5
+    GPUMax = 6
+    GPUMin = 7
+
+
+def export_protobuf(dir_name: str, worker_name: Optional[str] = None):
+    """Reference: profiler.export_protobuf — an ``on_trace_ready``
+    handler keeping the protobuf-format device trace. Here the XPlane
+    .pb files ARE the protobuf result (written by the XLA profiler into
+    the Profiler's trace_dir); the handler copies the newest into
+    ``dir_name``."""
+
+    def handler(prof: "Profiler"):
+        import glob
+        import shutil
+        os.makedirs(dir_name, exist_ok=True)
+        trace_dir = getattr(prof, "_trace_dir", None) or \
+            getattr(prof, "trace_dir", None)
+        if not trace_dir:
+            return
+        files = glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"),
+                          recursive=True)
+        name = worker_name or f"host_{os.getpid()}"
+        for i, f in enumerate(sorted(files, key=os.path.getmtime)[-1:]):
+            shutil.copy(f, os.path.join(dir_name, f"{name}.xplane.pb"))
+
+    return handler

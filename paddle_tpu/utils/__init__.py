@@ -14,7 +14,7 @@ from ..framework import monitor  # noqa: F401  (STAT counters)
 from . import unique_name  # noqa: F401
 
 __all__ = ["unique_name", "deprecated", "try_import", "monitor",
-           "dlpack", "download"]
+           "dlpack", "download", "require_version", "run_check"]
 from . import dlpack  # noqa: E402,F401
 from . import download  # noqa: E402,F401
 
@@ -49,3 +49,35 @@ def try_import(module_name: str, err_msg: str = None):
         raise ImportError(
             err_msg or f"{module_name} is required but not installed"
         ) from e
+
+
+def require_version(min_version, max_version=None):
+    """Reference utils.require_version: raise unless this framework's
+    version is within [min_version, max_version]."""
+    from ..version import full_version
+
+    def parse(v):
+        return tuple(int(p) for p in str(v).split(".")[:3] if p.isdigit())
+
+    cur = parse(full_version)
+    if parse(min_version) > cur:
+        raise Exception(
+            f"installed version {full_version} < required {min_version}")
+    if max_version is not None and parse(max_version) < cur:
+        raise Exception(
+            f"installed version {full_version} > allowed {max_version}")
+
+
+def run_check():
+    """Reference utils.run_check: verify the install can compute on the
+    available device(s); prints a summary like the reference."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    devs = jax.devices()
+    x = jnp.ones((128, 128), jnp.float32)
+    out = np.asarray(x @ x)
+    assert float(out[0, 0]) == 128.0
+    print(f"PaddlePaddle (paddle_tpu) works on {len(devs)} "
+          f"{devs[0].platform} device(s) [{devs[0].device_kind}].")
+    print("PaddlePaddle (paddle_tpu) is installed successfully!")

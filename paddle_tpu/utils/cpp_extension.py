@@ -26,7 +26,8 @@ from typing import Dict, Optional, Sequence
 
 import numpy as np
 
-__all__ = ["load", "get_build_directory", "CppExtension"]
+__all__ = ["load", "get_build_directory", "CppExtension",
+           "CUDAExtension", "setup"]
 
 _CACHE_ENV = "PADDLE_EXTENSION_DIR"
 
@@ -183,3 +184,35 @@ def load(name: str, sources: Sequence[str],
 
         setattr(ns, fname, make())
     return ns
+
+
+class CUDAExtension(CppExtension):
+    """Accepted for porting convenience: on this backend there is no
+    nvcc — the sources build as host C++ (device compute belongs to
+    XLA/Pallas). Construction warns so the port is a conscious one."""
+
+    def __init__(self, sources, *args, **kwargs):
+        import warnings
+        warnings.warn(
+            "CUDAExtension: no CUDA toolchain on the TPU backend; "
+            "building sources as host C++ (.cu files are rejected). "
+            "Port device kernels to Pallas (ops/pallas_kernels.py "
+            "pattern) instead", UserWarning, stacklevel=2)
+        bad = [s for s in sources if str(s).endswith((".cu", ".cuh"))]
+        if bad:
+            raise ValueError(f"cannot compile CUDA sources here: {bad}")
+        super().__init__(sources, *args, **kwargs)
+
+
+def setup(name=None, ext_modules=None, **kwargs):
+    """Reference cpp_extension.setup: build the extension(s) at install
+    time. Here it eagerly JIT-builds each extension through load() and
+    returns the namespaces (no setuptools involvement — the .so cache
+    under get_build_directory() is the 'install')."""
+    exts = ext_modules if isinstance(ext_modules, (list, tuple)) else \
+        [ext_modules] if ext_modules else []
+    built = []
+    for i, ext in enumerate(exts):
+        srcs = getattr(ext, "sources", ext)
+        built.append(load(f"{name or 'ext'}_{i}", list(srcs)))
+    return built[0] if len(built) == 1 else built

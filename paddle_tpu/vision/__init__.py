@@ -7,3 +7,40 @@ from . import models  # noqa: F401
 from . import ops  # noqa: F401
 from . import transforms  # noqa: F401
 from .models import LeNet  # noqa: F401
+
+
+# --------------------------------------------------------------------------
+# image backend (reference vision/image.py): pillow decodes on the host;
+# a "cv2" backend isn't bundled, and set_image_backend says so loudly
+# --------------------------------------------------------------------------
+
+_image_backend = "pil"
+
+
+def get_image_backend() -> str:
+    return _image_backend
+
+
+def set_image_backend(backend: str) -> None:
+    global _image_backend
+    if backend not in ("pil", "cv2", "tensor"):
+        raise ValueError(f"unknown image backend {backend!r} "
+                         f"(pil | cv2 | tensor)")
+    if backend == "cv2":
+        raise RuntimeError("cv2 is not bundled in this environment; the "
+                           "pil backend serves all decode paths")
+    _image_backend = backend
+
+
+def image_load(path, backend=None):
+    """Load an image file (reference vision/image.py image_load):
+    returns an HWC uint8 numpy array ('tensor' backend) or a PIL image
+    ('pil')."""
+    import numpy as np
+    from PIL import Image
+    img = Image.open(path)
+    b = backend or _image_backend
+    if b == "pil":
+        return img
+    return np.asarray(img.convert("RGB") if img.mode not in
+                      ("RGB", "L") else img)

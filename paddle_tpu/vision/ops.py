@@ -602,3 +602,39 @@ class DeformConv2D(nn.Layer):
     def forward(self, x, offset, mask=None):
         return deform_conv2d(x, offset, self.weight, self.bias,
                              mask=mask, **self._attrs)
+
+
+def read_file(filename, name=None):
+    """File bytes as a uint8 Tensor (reference vision/ops.py read_file)."""
+    import numpy as np
+    from ..framework.tensor import Tensor
+    with open(filename, "rb") as f:
+        data = f.read()
+    return Tensor(np.frombuffer(data, np.uint8).copy())
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """Decode a JPEG byte Tensor to CHW uint8 (reference vision/ops.py
+    decode_jpeg — nvjpeg there; pillow on the host here)."""
+    import io
+    import numpy as np
+    from PIL import Image
+    from ..framework.tensor import Tensor
+    raw = bytes(np.asarray(x.numpy() if hasattr(x, "numpy") else x,
+                           np.uint8))
+    img = Image.open(io.BytesIO(raw))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode == "rgb" and img.mode != "RGB":
+        img = img.convert("RGB")
+    # mode == "unchanged": keep the stored channel count (a grayscale
+    # JPEG stays 1xHxW, reference semantics)
+    arr = np.asarray(img, np.uint8)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(arr)
+
+
+__all__ += ["read_file", "decode_jpeg"]
