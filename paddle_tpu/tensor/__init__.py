@@ -971,7 +971,9 @@ def _attach_methods():
     Tensor.__rtruediv__ = lambda s, o: divide(
         to_tensor(o, dtype=s.dtype) if not isinstance(o, Tensor) else o, s)
     Tensor.__floordiv__ = lambda s, o: floor_divide(s, o)
-    Tensor.__mod__ = lambda s, o: mod(s, o)
+    # globals() lookup: the local `mod = sys.modules[...]` above
+    # shadows the module-level mod() op inside this closure
+    Tensor.__mod__ = lambda s, o: globals()["mod"](s, o)
     Tensor.__pow__ = lambda s, o: globals()["pow"](s, o)
     Tensor.__rpow__ = lambda s, o: globals()["pow"](
         to_tensor(o, dtype=s.dtype) if not isinstance(o, Tensor) else o, s)
@@ -1002,8 +1004,32 @@ def _attach_methods():
 
     for base in ["add", "subtract", "multiply", "divide", "clip", "scale",
                  "floor", "ceil", "exp", "sqrt", "reciprocal", "round",
-                 "tanh", "abs"]:
+                 "tanh", "abs", "erfinv", "rsqrt", "lerp",
+                 "put_along_axis", "flatten"]:
         setattr(Tensor, base + "_", _make_inplace(getattr(mod, base)))
+
+    def _exponential_(self, lam=1.0):
+        """Fill with Exponential(lam) samples (reference exponential_)."""
+        u = jax.random.uniform(_random.next_key(), tuple(self.shape),
+                               minval=1e-7, maxval=1.0)
+        # _rebind keeps the tape bookkeeping honest (and raises on
+        # in-place mutation of a grad-requiring leaf, like every *_ op)
+        self._rebind(Tensor((-jnp.log(u) / lam).astype(self._data.dtype)))
+        return self
+    Tensor.exponential_ = _exponential_
+
+    # reference patches these module functions as methods too
+    Tensor.is_tensor = lambda self: True
+    Tensor.broadcast_tensors = \
+        lambda self, *others: mod.broadcast_tensors([self, *others])
+
+    def _triangular_solve(self, y, upper=True, transpose=False,
+                          unitriangular=False, name=None):
+        from .. import linalg as _lin
+        return _lin.triangular_solve(self, y, upper=upper,
+                                     transpose=transpose,
+                                     unitriangular=unitriangular)
+    Tensor.triangular_solve = _triangular_solve
 
     def _fill_(self, value):
         self._rebind(full_like(self, value))
