@@ -292,3 +292,38 @@ def _sequence_concat(xs, lengths_list, maxlen=None):
         out = jnp.where(_expand_mask(sel, out), gathered, out)
         running = running + lnc
     return out, total_len
+
+
+@register_op("row_conv")
+def _row_conv(x, weight):
+    """Lookahead row convolution (reference row_conv op, DeepSpeech2):
+    out[t] = sum_{i=0..k-1} weight[i] * x[t+i], zero-padded tail."""
+    k, d = weight.shape
+    t = x.shape[-2]
+    pad = jnp.zeros(x.shape[:-2] + (k - 1, d), x.dtype)
+    xp = jnp.concatenate([x, pad], axis=-2)
+    out = jnp.zeros_like(x)
+    for i in range(k):     # k is small and static: unrolled adds fuse
+        out = out + xp[..., i:i + t, :] * weight[i]
+    return out
+
+
+@register_op("sequence_scatter")
+def _sequence_scatter(x, index, updates):
+    """Add ``updates`` at per-row time positions ``index`` (reference
+    sequence_scatter_op.cc, dense [B, T, ...] form)."""
+    rows = jnp.arange(x.shape[0])[:, None]
+    return x.at[rows, index.astype(jnp.int32)].add(updates)
+
+
+@register_op("nce_loss")
+def _nce_loss(x, label, weight, bias, neg_samples):
+    """Noise-contrastive estimation loss (reference nce op): logistic
+    loss over the true class + the given negative sample ids."""
+    import jax
+    lab = label.reshape(-1).astype(jnp.int32)
+    pos_logit = (x * weight[lab]).sum(-1) + bias[lab]
+    neg = neg_samples.astype(jnp.int32)
+    neg_logit = jnp.einsum("bd,bkd->bk", x, weight[neg]) + bias[neg]
+    loss = jax.nn.softplus(-pos_logit) + jax.nn.softplus(neg_logit).sum(-1)
+    return loss.reshape(-1, 1)

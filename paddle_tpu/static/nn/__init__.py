@@ -198,3 +198,11 @@ def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
 
 
 __all__.append("fc")
+
+
+from .layers_compat import *  # noqa: E402,F401,F403  (fluid layer builders)
+from . import layers_compat as _compat  # noqa: E402
+__all__ += [n for n in _compat.__all__ if n != "fc_compat_registry"]
+
+from ..extras import py_func  # noqa: E402,F401  (shared with static.py_func)
+__all__.append("py_func")

@@ -1,0 +1,564 @@
+"""Fluid-style ``paddle.static.nn`` layer builders.
+
+Reference: python/paddle/static/nn/__init__.py re-exporting
+fluid/layers/nn.py — functional builders that create parameters at the
+call site and append ops to the current program. Here each builder
+constructs the corresponding nn.Layer (parameters register into the
+captured program automatically through dispatch) and applies it; layers
+are cached per ``name=``/config so repeated executions of user build
+code reuse one parameter set, mirroring fluid's unique-name behavior.
+
+Sequence builders operate on the dense (padded, lengths) encoding
+(ops/sequence_ops.py — the TPU-native LoD replacement): ``lengths`` is
+an optional keyword everywhere; omitted, every row counts as full
+length (an unpadded batch).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+__all__ = [
+    "fc_compat_registry",  # introspection/testing
+    "embedding", "sparse_embedding", "conv2d", "conv3d",
+    "conv2d_transpose", "conv3d_transpose", "batch_norm", "layer_norm",
+    "instance_norm", "group_norm", "spectral_norm", "data_norm", "prelu",
+    "bilinear_tensor_product", "deform_conv2d", "row_conv", "nce",
+    "crf_decoding", "multi_box_head", "StaticRNN",
+    "sequence_concat", "sequence_conv", "sequence_enumerate",
+    "sequence_expand", "sequence_expand_as", "sequence_first_step",
+    "sequence_last_step", "sequence_pad", "sequence_pool",
+    "sequence_reshape", "sequence_reverse", "sequence_scatter",
+    "sequence_slice", "sequence_softmax", "sequence_unpad",
+]
+
+# call-site layer cache (fluid's unique_name equivalent): one parameter
+# set per name/config across repeated build executions
+_LAYERS: Dict[tuple, object] = {}
+fc_compat_registry = _LAYERS
+
+
+def _callsite():
+    """(filename, lineno) of the first frame outside this module — the
+    fluid unique-name analog: two UNNAMED builders at different source
+    lines get distinct parameters, while re-running the same build code
+    reuses one set."""
+    import sys
+    f = sys._getframe(2)
+    here = __file__
+    while f is not None and f.f_code.co_filename == here:
+        f = f.f_back
+    return (f.f_code.co_filename, f.f_lineno) if f is not None \
+        else ("<unknown>", 0)
+
+
+def _cached(key, factory, name=None):
+    if name is None:
+        key = key + _callsite()
+    layer = _LAYERS.get(key)
+    if layer is None:
+        layer = factory()
+        _LAYERS[key] = layer
+    return layer
+
+
+def _pkg_nn():
+    from ... import nn
+    return nn
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None,
+              param_attr=None, dtype="float32", name=None):
+    nn = _pkg_nn()
+    layer = _cached(("embedding", name, tuple(size), padding_idx),
+                    lambda: nn.Embedding(size[0], size[1],
+                                         padding_idx=padding_idx,
+                                         weight_attr=param_attr),
+                    name=name)
+    return layer(input)
+
+
+def sparse_embedding(input, size, padding_idx=None, param_attr=None,
+                     is_test=False, entry=None, table_class=None,
+                     dtype="float32", name=None):
+    """Reference sparse_embedding feeds the PS sparse table; here the
+    TPU-native mesh-sharded table (distributed/embedding.py)."""
+    from ...distributed.embedding import ShardedEmbedding
+    layer = _cached(("sparse_embedding", name, tuple(size), padding_idx),
+                    lambda: ShardedEmbedding(size[0], size[1],
+                                             padding_idx=padding_idx,
+                                             track_frequency=entry
+                                             is not None),
+                    name=name)
+    return layer(input)
+
+
+def _conv(nd, transpose, input, num_filters, filter_size, stride=1,
+          padding=0, dilation=1, groups=1, param_attr=None,
+          bias_attr=None, data_format=None, name=None, **kwargs):
+    nn = _pkg_nn()
+    df = data_format or ("NCHW" if nd == 2 else "NCDHW")
+    in_c = int(input.shape[1] if df.startswith("NC")
+               else input.shape[-1])
+    cls = {(2, False): nn.Conv2D, (3, False): nn.Conv3D,
+           (2, True): nn.Conv2DTranspose, (3, True): nn.Conv3DTranspose}[
+        (nd, transpose)]
+    layer = _cached(
+        ("conv", nd, transpose, name, in_c, num_filters,
+         tuple(np.atleast_1d(filter_size)), tuple(np.atleast_1d(stride)),
+         tuple(np.atleast_1d(padding)), tuple(np.atleast_1d(dilation)),
+         groups, df),
+        lambda: cls(in_c, num_filters, filter_size, stride=stride,
+                    padding=padding, dilation=dilation, groups=groups,
+                    weight_attr=param_attr, bias_attr=bias_attr,
+                    data_format=df),
+        name=name)
+    return layer(input)
+
+
+def conv2d(input, num_filters, filter_size, **kwargs):
+    return _conv(2, False, input, num_filters, filter_size, **kwargs)
+
+
+def conv3d(input, num_filters, filter_size, **kwargs):
+    return _conv(3, False, input, num_filters, filter_size, **kwargs)
+
+
+def conv2d_transpose(input, num_filters, filter_size=None, **kwargs):
+    return _conv(2, True, input, num_filters, filter_size, **kwargs)
+
+
+def conv3d_transpose(input, num_filters, filter_size=None, **kwargs):
+    return _conv(3, True, input, num_filters, filter_size, **kwargs)
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9,
+               epsilon=1e-5, param_attr=None, bias_attr=None,
+               data_layout="NCHW", name=None, **kwargs):
+    nn = _pkg_nn()
+    c = int(input.shape[1] if data_layout.startswith("NC")
+            else input.shape[-1])
+    rank = len(input.shape)
+    if rank == 5:
+        factory = lambda: nn.BatchNorm3D(c, momentum=momentum,
+                                         epsilon=epsilon)
+    elif rank == 4:
+        factory = lambda: nn.BatchNorm2D(c, momentum=momentum,
+                                         epsilon=epsilon,
+                                         data_format=data_layout)
+    else:
+        factory = lambda: nn.BatchNorm1D(c, momentum=momentum,
+                                         epsilon=epsilon)
+    layer = _cached(("batch_norm", name, c, data_layout, rank), factory,
+                    name=name)
+    out = layer(input)
+    return _act(out, act)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    nn = _pkg_nn()
+    shape = tuple(int(s) for s in input.shape[begin_norm_axis:])
+    layer = _cached(("layer_norm", name, shape),
+                    lambda: nn.LayerNorm(list(shape), epsilon=epsilon),
+                    name=name)
+    return _act(layer(input), act)
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
+                  name=None):
+    nn = _pkg_nn()
+    c = int(input.shape[1])
+    layer = _cached(("instance_norm", name, c),
+                    lambda: nn.InstanceNorm2D(c, epsilon=epsilon),
+                    name=name)
+    return layer(input)
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None,
+               bias_attr=None, act=None, data_layout="NCHW", name=None):
+    nn = _pkg_nn()
+    c = int(input.shape[1])
+    layer = _cached(("group_norm", name, c, groups),
+                    lambda: nn.GroupNorm(groups, c, epsilon=epsilon),
+                    name=name)
+    return _act(layer(input), act)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """Functional spectral normalization of a weight VAR (reference
+    fluid spectral_norm op) via the registered ``spectral_norm`` op —
+    so it RECORDS into captured programs. The reference persists
+    weight_u/v across steps so power_iters=1 converges over training;
+    this one-shot form runs >= 10 internal iterations instead."""
+    import jax.numpy as jnp
+    from ...framework.dispatch import call_op
+    from ...framework.tensor import Tensor
+    h = int(weight.shape[dim])
+    rest = int(np.prod(weight.shape)) // h
+    rng = np.random.RandomState(0)
+    u0 = Tensor(jnp.asarray(rng.randn(h).astype(np.float32)))
+    v0 = Tensor(jnp.asarray(rng.randn(rest).astype(np.float32)))
+    return call_op("spectral_norm", weight, u0, v0, dim=dim,
+                   power_iters=max(int(power_iters), 10), eps=eps)
+
+
+def data_norm(input, epsilon=1e-5, param_attr=None, name=None, **kwargs):
+    """Reference data_norm: normalize by accumulated batch statistics
+    without scale/shift — the CTR stack's feature normalizer. Dense
+    form: running mean/var buffers, batch stats in training."""
+    nn = _pkg_nn()
+    c = int(input.shape[-1])
+
+    class _DataNorm(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            import jax.numpy as jnp
+            from ...framework.tensor import Tensor
+            self.register_buffer("_mean", Tensor(jnp.zeros([c])))
+            self.register_buffer("_var", Tensor(jnp.ones([c])))
+
+        def forward(self, x):
+            import jax.numpy as jnp
+            arr = x._data
+            if self.training:
+                mean = arr.mean(axis=0)
+                var = arr.var(axis=0)
+                # ACCUMULATE (momentum blend) — the buffers hold running
+                # statistics, not the last batch; functional_state
+                # threads the update through jitted steps like BN
+                m = 0.9
+                self._buffers["_mean"]._data = \
+                    m * self._mean._data + (1 - m) * mean
+                self._buffers["_var"]._data = \
+                    m * self._var._data + (1 - m) * var
+            else:
+                mean, var = self._mean._data, self._var._data
+            from ...framework.tensor import Tensor
+            return Tensor((arr - mean) / jnp.sqrt(var + epsilon),
+                          stop_gradient=x.stop_gradient)
+
+    layer = _cached(("data_norm", name, c), _DataNorm,
+                    name=name)
+    return layer(input)
+
+
+def prelu(x, mode="all", param_attr=None, data_format="NCHW", name=None):
+    nn = _pkg_nn()
+    if mode == "all":
+        num = 1
+    elif mode == "channel":
+        num = int(x.shape[1] if data_format.startswith("NC")
+                  else x.shape[-1])
+    else:
+        raise NotImplementedError(
+            "prelu mode='element' (per-element alphas) is not provided; "
+            "use mode='channel' or nn.PReLU directly")
+    layer = _cached(("prelu", name, mode, num),
+                    lambda: nn.PReLU(num_parameters=num,
+                                     weight_attr=param_attr),
+                    name=name)
+    return layer(x)
+
+
+def bilinear_tensor_product(x, y, size, act=None, param_attr=None,
+                            bias_attr=None, name=None):
+    nn = _pkg_nn()
+    layer = _cached(("bilinear", name, int(x.shape[-1]),
+                     int(y.shape[-1]), size),
+                    lambda: nn.Bilinear(int(x.shape[-1]),
+                                        int(y.shape[-1]), size,
+                                        weight_attr=param_attr,
+                                        bias_attr=bias_attr),
+                    name=name)
+    return _act(layer(x, y), act)
+
+
+def deform_conv2d(x, offset, mask, num_filters, filter_size, stride=1,
+                  padding=0, dilation=1, groups=1,
+                  deformable_groups=1, im2col_step=1, param_attr=None,
+                  bias_attr=None, name=None):
+    from ...vision.ops import DeformConv2D
+    layer = _cached(("deform_conv2d", name, int(x.shape[1]), num_filters,
+                     filter_size),
+                    lambda: DeformConv2D(int(x.shape[1]), num_filters,
+                                         filter_size, stride=stride,
+                                         padding=padding,
+                                         dilation=dilation,
+                                         groups=groups,
+                                         deformable_groups=
+                                         deformable_groups),
+                    name=name)
+    return layer(x, offset, mask)
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None,
+             name=None):
+    """Lookahead row convolution (reference row_conv op, DeepSpeech2)
+    via the registered ``row_conv`` op (records into programs)."""
+    import jax.numpy as jnp
+    from ...framework.dispatch import call_op
+    from ...framework.tensor import Parameter
+    d = int(input.shape[-1])
+    k = int(future_context_size) + 1
+    w = _cached(("row_conv", name, d, k),
+                lambda: Parameter(jnp.full((k, d), 1.0 / k, jnp.float32)),
+                name=name)
+    return _act(call_op("row_conv", input, w), act)
+
+
+def nce(input, label, num_total_classes, sample_weight=None,
+        param_attr=None, bias_attr=None, num_neg_samples=None, name=None,
+        sampler="uniform", custom_dist=None, seed=0, is_sparse=False):
+    """Noise-contrastive estimation loss (reference nce op): logistic
+    loss over the true class + k uniform negative samples. Routed
+    through the registered ``nce_loss`` op, so in a captured program the
+    LABEL is a recorded input (feeds flow at replay); the negative
+    sample ids are drawn once per call site (fixed negatives per
+    program, re-drawn per step only in eager mode by calling again)."""
+    import jax
+    import jax.numpy as jnp
+    from ...framework import random as _random
+    from ...framework.dispatch import call_op
+    from ...framework.tensor import Parameter, Tensor
+    d = int(input.shape[-1])
+    k = int(num_neg_samples or 5)
+    w, b = _cached(
+        ("nce", name, num_total_classes, d),
+        lambda: (Parameter(jnp.asarray(
+                     (np.random.RandomState(seed)
+                      .randn(num_total_classes, d) / np.sqrt(d))
+                     .astype(np.float32))),
+                 Parameter(jnp.zeros((num_total_classes,), jnp.float32))),
+        name=name)
+    n_rows = int(np.asarray(label.shape)[0])
+    neg = Tensor(jax.random.randint(_random.next_key(), (n_rows, k), 0,
+                                    num_total_classes))
+    return call_op("nce_loss", input, label, w, b, neg)
+
+
+def crf_decoding(input, param_attr=None, label=None, length=None):
+    """Viterbi decode over emissions with a learned transition matrix
+    (reference crf_decoding op; text/ ViterbiDecoder is the engine)."""
+    from ...framework.tensor import Parameter, Tensor
+    import jax.numpy as jnp
+    n = int(input.shape[-1])
+    trans = _cached(("crf_transition", None, n),
+                    lambda: Parameter(jnp.zeros((n + 2, n), jnp.float32)))
+    from ...text import viterbi_decode
+    lengths = length if length is not None else Tensor(
+        jnp.full((input.shape[0],), input.shape[1], jnp.int64))
+    # body transitions only (the reference keeps start/stop rows extra)
+    body = Tensor(trans._data[2:], stop_gradient=True)
+    _, path = viterbi_decode(input, body, lengths)
+    return path
+
+
+def multi_box_head(*args, **kwargs):
+    raise NotImplementedError(
+        "multi_box_head (SSD prior-box head) is not provided as a fluid "
+        "builder; compose paddle.vision.ops detection primitives "
+        "(yolo_box/nms/RoI ops) or a model-zoo detector instead")
+
+
+def _act(out, act):
+    if act is None:
+        return out
+    from ...nn import functional as F
+    return getattr(F, act)(out)
+
+
+# --------------------------------------------------------------------------
+# sequence builders over the dense (padded, lengths) encoding
+# --------------------------------------------------------------------------
+
+def _full_lengths(x):
+    import jax.numpy as jnp
+    from ...framework.tensor import Tensor
+    return Tensor(jnp.full((x.shape[0],), x.shape[1], jnp.int64))
+
+
+def _seq(fname, x, lengths=None, **kwargs):
+    from ...nn import functional as F
+    return getattr(F, fname)(x, lengths if lengths is not None
+                             else _full_lengths(x), **kwargs)
+
+
+def sequence_pool(input, pool_type, lengths=None, is_test=False,
+                  pad_value=0.0):
+    return _seq("sequence_pool", input, lengths, pool_type=pool_type)
+
+
+def sequence_softmax(input, lengths=None, use_cudnn=False, name=None):
+    return _seq("sequence_softmax", input, lengths)
+
+
+def sequence_reverse(x, lengths=None, name=None):
+    return _seq("sequence_reverse", x, lengths)
+
+
+def sequence_first_step(input, lengths=None):
+    return sequence_pool(input, "first", lengths)
+
+
+def sequence_last_step(input, lengths=None):
+    return sequence_pool(input, "last", lengths)
+
+
+def sequence_conv(input, num_filters, filter_size=3, lengths=None,
+                  filter_stride=1, padding=True, padding_start=None,
+                  param_attr=None, bias_attr=None, act=None, name=None):
+    from ...framework.dispatch import call_op
+    from ...framework.tensor import Parameter
+    import jax.numpy as jnp
+    d = int(input.shape[-1])
+    w = _cached(
+        ("sequence_conv", name, d, num_filters, filter_size),
+        lambda: Parameter(jnp.asarray(
+            (np.random.RandomState(0).randn(filter_size * d, num_filters)
+             / np.sqrt(filter_size * d)).astype(np.float32))),
+        name=name)
+    out = call_op("sequence_conv", input,
+                  lengths if lengths is not None else _full_lengths(input),
+                  w, context_length=filter_size)
+    return _act(out, act)
+
+
+def sequence_pad(x, pad_value=0.0, maxlen=None, lengths=None, name=None):
+    from ...nn import functional as F
+    return F.sequence_pad(x, lengths if lengths is not None
+                          else _full_lengths(x), maxlen=maxlen,
+                          pad_value=pad_value)
+
+
+def sequence_unpad(x, length, name=None):
+    from ...nn import functional as F
+    return F.sequence_unpad(x, length)
+
+
+def sequence_expand(x, y, ref_level=-1, lengths=None, name=None):
+    """Dense form: repeat x's rows per y's (or explicit) lengths."""
+    from ...nn import functional as F
+    return F.sequence_expand(x, lengths if lengths is not None
+                             else _full_lengths(y))
+
+
+def sequence_expand_as(x, y, name=None):
+    return sequence_expand(x, y)
+
+
+def sequence_concat(input, lengths_list=None, name=None):
+    from ...nn import functional as F
+    if lengths_list is None:
+        lengths_list = [_full_lengths(x) for x in input]
+    return F.sequence_concat(list(input), list(lengths_list))
+
+
+def sequence_enumerate(input, win_size, lengths=None, pad_value=0,
+                       name=None):
+    from ...nn import functional as F
+    return F.sequence_enumerate(
+        input, lengths if lengths is not None else _full_lengths(input),
+        win_size=win_size, pad_value=pad_value)
+
+
+def sequence_slice(input, offset, length, lengths=None, name=None):
+    from ...nn import functional as F
+    return F.sequence_slice(
+        input, lengths if lengths is not None else _full_lengths(input),
+        offset, length)
+
+
+def sequence_reshape(input, new_dim):
+    """Reference sequence_reshape: re-chunk the feature dim (dense form:
+    [B, T, D] -> [B, T*D//new_dim, new_dim])."""
+    from ...framework.dispatch import call_op
+    t, d = (int(s) for s in input.shape[1:])
+    if (t * d) % new_dim:
+        raise ValueError(f"cannot reshape T*D={t*d} into rows of "
+                         f"{new_dim}")
+    # batch stays symbolic (-1): static programs replay at any batch
+    return call_op("reshape", input, shape=[-1, (t * d) // new_dim,
+                                            new_dim])
+
+
+def sequence_scatter(input, index, updates, name=None):
+    """Reference sequence_scatter: add ``updates`` at per-row positions
+    ``index`` (dense form over [B, T, ...]); registered op, records."""
+    import jax.numpy as jnp
+    from ...framework.dispatch import call_op
+    from ...framework.tensor import Tensor
+    idx = index if isinstance(index, Tensor) else Tensor(
+        jnp.asarray(index))
+    upd = updates if isinstance(updates, Tensor) else Tensor(
+        jnp.asarray(updates))
+    return call_op("sequence_scatter", input, idx, upd)
+
+
+class StaticRNN:
+    """Fluid StaticRNN builder (reference fluid/layers/control_flow.py
+    StaticRNN). The dense equivalent unrolls the step function over
+    axis 1 at build time — exactly what fluid's sub-block execution did
+    T times, expressed jit-friendly:
+
+        rnn = StaticRNN()
+        rnn.step_input(x)                       # [B, T, D]
+        rnn.memory(init=h0)
+        out = rnn.unroll(lambda x_t, h: (h_new, h_new))
+
+    The fluid ``with rnn.step():`` recording protocol needs deferred
+    python tracing (a sub-block IR); it raises with this guidance —
+    ``nn.RNN``/``nn.LSTM`` (lax.scan) serve the layer-level use."""
+
+    def __init__(self, name=None):
+        self._inputs = []
+        self._memories = []
+        self._seq_len = None
+
+    def step(self):
+        raise NotImplementedError(
+            "the fluid step-recording protocol is replaced by "
+            "StaticRNN.unroll(step_fn) here (or nn.RNN/nn.LSTM for "
+            "layer-level recurrence over lax.scan)")
+
+    def step_input(self, x):
+        self._inputs.append(x)
+        self._seq_len = int(x.shape[1])
+        return x
+
+    def memory(self, init=None, shape=None, batch_ref=None,
+               init_value=0.0, init_batch_dim_idx=0, ref_batch_dim_idx=0):
+        import jax.numpy as jnp
+        from ...framework.tensor import Tensor
+        if init is None:
+            if shape is None or batch_ref is None:
+                raise ValueError("memory() needs init= or "
+                                 "(shape=, batch_ref=)")
+            b = int(batch_ref.shape[ref_batch_dim_idx])
+            init = Tensor(jnp.full((b,) + tuple(shape), init_value,
+                                   jnp.float32))
+        self._memories.append(init)
+        return init
+
+    def unroll(self, step_fn):
+        """Run ``step_fn(x_t, *states) -> (out, *new_states)`` over
+        axis 1 of the first step_input, eagerly unrolled; returns
+        stacked outputs [B, T, ...]."""
+        from ...framework.dispatch import call_op
+        if not self._inputs:
+            raise RuntimeError("call step_input(x) before unroll()")
+        x = self._inputs[0]
+        states = list(self._memories)
+        outs = []
+        for t in range(self._seq_len):
+            xt = call_op("slice", x, axes=[1], starts=[t], ends=[t + 1])
+            xt = call_op("reshape", xt,
+                         shape=[x.shape[0]] + list(x.shape[2:]))
+            res = step_fn(xt, *states)
+            if not isinstance(res, (tuple, list)):
+                res = (res,)
+            out, states = res[0], list(res[1:]) or states
+            outs.append(out)
+        return call_op("stack", outs, axis=1)

@@ -1,0 +1,209 @@
+"""Fluid-style static.nn layer builders (static/nn/layers_compat.py).
+
+Reference: python/paddle/static/nn/__init__.py (the fluid layers API).
+Builders create parameters at the call site (cached per name/config)
+and record into captured programs; sequence builders ride the dense
+(padded, lengths) encoding.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+
+rng = np.random.RandomState(0)
+
+
+@pytest.fixture
+def static_mode():
+    paddle.enable_static()
+    try:
+        yield
+    finally:
+        paddle.disable_static()
+
+
+class TestFluidBuilders:
+    def test_conv_bn_emb_program_trains(self, static_mode):
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [None, 3, 8, 8], "float32")
+            h = static.nn.conv2d(x, 6, 3, padding=1, name="c1")
+            h = static.nn.batch_norm(h, act="relu", name="bn1")
+            h = static.nn.sequence_reshape(
+                paddle.flatten(h, start_axis=2), 32)
+            pooled = static.nn.sequence_pool(h, "max")
+            ids = static.data("ids", [None, 4], "int64")
+            e = static.nn.embedding(ids, (50, 8), name="emb")
+            feat = paddle.concat(
+                [pooled, paddle.flatten(e, start_axis=1)], axis=1)
+            logits = static.nn.fc(feat, size=3)
+            y = static.data("y", [None, 1], "int64")
+            loss = paddle.mean(
+                paddle.nn.functional.cross_entropy(logits, y))
+            paddle.optimizer.Adam(learning_rate=1e-2).minimize(loss)
+        exe = static.Executor()
+        exe.run(startup)
+        feed = {"x": rng.randn(8, 3, 8, 8).astype("float32"),
+                "ids": rng.randint(0, 50, (8, 4)).astype("int64"),
+                "y": rng.randint(0, 3, (8, 1)).astype("int64")}
+        (l0,) = exe.run(main, feed=feed, fetch_list=[loss])
+        for _ in range(20):
+            (l,) = exe.run(main, feed=feed, fetch_list=[loss])
+        assert float(l) < float(l0)
+
+    def test_layer_cache_reuses_parameters(self):
+        x = paddle.to_tensor(rng.randn(2, 4).astype("float32"))
+        e1 = static.nn.embedding(
+            paddle.to_tensor(np.array([[1]], "int64")), (10, 4),
+            name="cache_probe")
+        e2 = static.nn.embedding(
+            paddle.to_tensor(np.array([[1]], "int64")), (10, 4),
+            name="cache_probe")
+        np.testing.assert_allclose(e1.numpy(), e2.numpy())
+
+    def test_static_rnn_unroll_cumsum(self):
+        rnn = static.nn.StaticRNN()
+        xs = paddle.to_tensor(rng.randn(2, 5, 4).astype("float32"))
+        rnn.step_input(xs)
+        rnn.memory(shape=(4,), batch_ref=xs)
+        out = rnn.unroll(lambda xt, h: (xt + h, xt + h))
+        np.testing.assert_allclose(out.numpy(),
+                                   np.cumsum(xs.numpy(), axis=1),
+                                   rtol=1e-5)
+        with pytest.raises(NotImplementedError, match="unroll"):
+            rnn.step()
+
+    def test_sequence_builders_default_full_length(self):
+        xs = paddle.to_tensor(rng.randn(2, 5, 4).astype("float32"))
+        np.testing.assert_allclose(
+            static.nn.sequence_first_step(xs).numpy(), xs.numpy()[:, 0])
+        np.testing.assert_allclose(
+            static.nn.sequence_last_step(xs).numpy(), xs.numpy()[:, -1])
+        rev = static.nn.sequence_reverse(xs)
+        np.testing.assert_allclose(rev.numpy(), xs.numpy()[:, ::-1])
+        sm = static.nn.sequence_softmax(xs)
+        np.testing.assert_allclose(np.asarray(sm.numpy()).sum(1), 1.0,
+                                   rtol=1e-5)
+
+    def test_sequence_builders_respect_lengths(self):
+        xs = paddle.to_tensor(rng.randn(2, 5, 4).astype("float32"))
+        lengths = paddle.to_tensor(np.array([3, 5]))
+        last = static.nn.sequence_last_step(xs, lengths=lengths)
+        np.testing.assert_allclose(last.numpy()[0], xs.numpy()[0, 2])
+        np.testing.assert_allclose(last.numpy()[1], xs.numpy()[1, 4])
+
+    def test_spectral_norm_functional(self):
+        w = paddle.to_tensor(rng.randn(6, 4).astype("float32"))
+        wn = static.nn.spectral_norm(w)
+        sigma = np.linalg.svd(wn.numpy(), compute_uv=False)[0]
+        assert abs(sigma - 1.0) < 0.05
+
+    def test_nce_and_row_conv_and_data_norm(self):
+        x = paddle.to_tensor(rng.randn(4, 8).astype("float32"))
+        nl = static.nn.nce(x, paddle.to_tensor(
+            rng.randint(0, 20, (4, 1))), 20)
+        assert tuple(nl.shape) == (4, 1)
+        assert np.isfinite(nl.numpy()).all() and (nl.numpy() > 0).all()
+        seq = paddle.to_tensor(rng.randn(2, 5, 4).astype("float32"))
+        rc = static.nn.row_conv(seq, 2)
+        assert tuple(rc.shape) == (2, 5, 4)
+        dn = static.nn.data_norm(x, name="dn1")
+        np.testing.assert_allclose(dn.numpy().mean(0), 0, atol=1e-5)
+
+    def test_bilinear_and_prelu(self):
+        a = paddle.to_tensor(rng.randn(3, 4).astype("float32"))
+        b = paddle.to_tensor(rng.randn(3, 5).astype("float32"))
+        out = static.nn.bilinear_tensor_product(a, b, 6, name="bt")
+        assert tuple(out.shape) == (3, 6)
+        x = paddle.to_tensor(rng.randn(2, 3, 4, 4).astype("float32"))
+        assert tuple(static.nn.prelu(x, name="pr").shape) == (2, 3, 4, 4)
+
+    def test_sparse_embedding_is_sharded_table(self):
+        from paddle_tpu.distributed.embedding import ShardedEmbedding
+        from paddle_tpu.static.nn.layers_compat import fc_compat_registry
+        ids = paddle.to_tensor(rng.randint(0, 30, (2, 3)).astype("int64"))
+        out = static.nn.sparse_embedding(ids, (30, 8), name="sp1")
+        assert tuple(out.shape) == (2, 3, 8)
+        layer = fc_compat_registry[("sparse_embedding", "sp1", (30, 8),
+                                    None)]
+        assert isinstance(layer, ShardedEmbedding)
+
+    def test_multi_box_head_raises(self):
+        with pytest.raises(NotImplementedError, match="vision.ops"):
+            static.nn.multi_box_head()
+
+    def test_crf_decoding_runs(self):
+        emissions = paddle.to_tensor(rng.randn(2, 6, 5).astype("float32"))
+        path = static.nn.crf_decoding(emissions)
+        arr = np.asarray(path.numpy())
+        assert arr.shape[0] == 2 and (arr < 5).all() and (arr >= 0).all()
+
+
+class TestBuilderRecordingAndCaching:
+    def test_nce_label_feeds_flow_in_program(self, static_mode):
+        """nce routes through a registered op, so the LABEL is a
+        recorded program input — different feeds give different losses
+        (the closure form would bake build-time zeros)."""
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [None, 8], "float32")
+            y = static.data("y", [None, 1], "int64")
+            loss = paddle.mean(static.nn.nce(x, y, 20, name="nce_t"))
+        exe = static.Executor()
+        xs = rng.randn(4, 8).astype("float32")
+        (l1,) = exe.run(main, feed={"x": xs,
+                                    "y": np.zeros((4, 1), "int64")},
+                        fetch_list=[loss])
+        (l2,) = exe.run(main, feed={"x": xs,
+                                    "y": np.full((4, 1), 7, "int64")},
+                        fetch_list=[loss])
+        assert abs(float(l1) - float(l2)) > 1e-6
+
+    def test_spectral_norm_records_in_program(self, static_mode):
+        main = static.Program()
+        with static.program_guard(main):
+            w = static.data("w", [6, 4], "float32")
+            out = static.nn.spectral_norm(w)
+        exe = static.Executor()
+        arr = rng.randn(6, 4).astype("float32")
+        (got,) = exe.run(main, feed={"w": arr}, fetch_list=[out])
+        sigma = np.linalg.svd(got, compute_uv=False)[0]
+        assert abs(sigma - 1.0) < 0.05   # computed from the FED weight
+
+    def test_unnamed_builders_get_distinct_parameters(self):
+        ids = paddle.to_tensor(np.array([[1]], "int64"))
+        a = static.nn.embedding(ids, (10, 4))   # two call sites,
+        b = static.nn.embedding(ids, (10, 4))   # both unnamed
+        assert not np.allclose(a.numpy(), b.numpy()), \
+            "distinct unnamed call sites must not share parameters"
+
+    def test_conv_dilation_in_cache_key(self):
+        x = paddle.to_tensor(rng.randn(1, 2, 8, 8).astype("float32"))
+        o1 = static.nn.conv2d(x, 3, 3, padding=2, dilation=1, name="cd")
+        o2 = static.nn.conv2d(x, 3, 3, padding=2, dilation=2, name="cd")
+        assert tuple(o1.shape) != tuple(o2.shape) or \
+            not np.allclose(o1.numpy(), o2.numpy())
+
+    def test_batch_norm_5d(self):
+        x = paddle.to_tensor(rng.randn(2, 3, 4, 4, 4).astype("float32"))
+        out = static.nn.batch_norm(x, name="bn5d")
+        assert tuple(out.shape) == (2, 3, 4, 4, 4)
+
+    def test_prelu_element_mode_rejected(self):
+        x = paddle.to_tensor(rng.randn(1, 2, 4, 4).astype("float32"))
+        with pytest.raises(NotImplementedError, match="element"):
+            static.nn.prelu(x, mode="element")
+
+    def test_data_norm_accumulates(self):
+        from paddle_tpu.static.nn.layers_compat import fc_compat_registry
+        x1 = paddle.to_tensor(np.full((4, 3), 10.0, "float32"))
+        static.nn.data_norm(x1, name="dn_acc")
+        layer = next(v for k, v in fc_compat_registry.items()
+                     if k[0] == "data_norm" and k[1] == "dn_acc")
+        m1 = np.asarray(layer._mean.numpy()).copy()
+        x2 = paddle.to_tensor(np.full((4, 3), -10.0, "float32"))
+        static.nn.data_norm(x2, name="dn_acc")
+        m2 = np.asarray(layer._mean.numpy())
+        # blended, not replaced: still positive after one negative batch
+        assert (m2 < m1).all() and (m2 > -10.0).all()
