@@ -138,3 +138,184 @@ def is_first_worker():
 def barrier_worker():
     from ..collective import barrier
     barrier()
+
+
+# --------------------------------------------------------------------------
+# reference fleet surface: the Fleet facade class, role makers, UtilBase,
+# CTR data generators (reference fleet/__init__.py + base/role_maker.py,
+# base/util_factory.py, data_generator/)
+# --------------------------------------------------------------------------
+
+from .base.topology import CommunicateTopology  # noqa: E402,F401
+
+
+class Role:
+    """Reference role_maker.Role constants."""
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+
+
+class PaddleCloudRoleMaker:
+    """Reference PaddleCloudRoleMaker: role from PADDLE_* env. On this
+    backend every process is a collective WORKER (the PS server role is
+    descoped; see README.md)."""
+
+    def __init__(self, is_collective=True, **kwargs):
+        self._is_collective = is_collective
+
+    def _worker_index(self):
+        import os
+        return int(os.environ.get("PADDLE_TRAINER_ID", 0))
+
+    def _worker_num(self):
+        import os
+        return int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+
+    def _role(self):
+        return Role.WORKER
+
+    def _is_worker(self):
+        return True
+
+    def _is_server(self):
+        return False
+
+
+class UserDefinedRoleMaker(PaddleCloudRoleMaker):
+    """Reference UserDefinedRoleMaker: explicit rank/world size."""
+
+    def __init__(self, is_collective=True, current_id=0, worker_num=1,
+                 role=Role.WORKER, **kwargs):
+        super().__init__(is_collective=is_collective)
+        self._cur = int(current_id)
+        self._num = int(worker_num)
+
+    def _worker_index(self):
+        return self._cur
+
+    def _worker_num(self):
+        return self._num
+
+
+class UtilBase:
+    """Reference base/util_factory.py UtilBase: cross-worker helpers
+    over the collective API."""
+
+    def all_reduce(self, input, mode="sum", comm_world="worker"):
+        import numpy as np
+        from .. import env as _env
+        if not _env.is_initialized() or _env.get_world_size() <= 1:
+            return np.asarray(input)
+        from ..collective import ReduceOp, all_reduce as _ar
+        from ...framework.tensor import Tensor
+        op = {"sum": ReduceOp.SUM, "max": ReduceOp.MAX,
+              "min": ReduceOp.MIN}[mode.lower()]
+        t = Tensor(np.asarray(input))
+        _ar(t, op=op)
+        return np.asarray(t.numpy())
+
+    def barrier(self, comm_world="worker"):
+        from .. import env as _env
+        if _env.is_initialized():
+            from ..collective import barrier as _b
+            _b()
+
+    def all_gather(self, input, comm_world="worker"):
+        import numpy as np
+        from .. import env as _env
+        if not _env.is_initialized() or _env.get_world_size() <= 1:
+            return [input]
+        from ..collective import all_gather as _ag
+        from ...framework.tensor import Tensor
+        out = []
+        _ag(out, Tensor(np.asarray(input)))
+        return [np.asarray(o.numpy()) for o in out]
+
+    def get_file_shard(self, files):
+        """Split a file list evenly across workers (reference
+        UtilBase.get_file_shard)."""
+        n = worker_num()
+        i = worker_index()
+        per, rem = divmod(len(files), n)
+        start = i * per + min(i, rem)
+        return list(files[start:start + per + (1 if i < rem else 0)])
+
+
+util = UtilBase()
+
+
+class Fleet:
+    """Reference fleet_base.Fleet — the class behind the module-level
+    singleton. Methods delegate to this module's functions so both
+    ``fleet.init(...)`` and ``Fleet().init(...)`` work."""
+
+    def init(self, role_maker=None, is_collective=False, strategy=None,
+             log_level=20):
+        return init(role_maker, is_collective, strategy, log_level)
+
+    def distributed_model(self, model):
+        return distributed_model(model)
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        return distributed_optimizer(optimizer, strategy)
+
+    def worker_index(self):
+        return worker_index()
+
+    def worker_num(self):
+        return worker_num()
+
+    def is_first_worker(self):
+        return is_first_worker()
+
+    def barrier_worker(self):
+        return barrier_worker()
+
+    @property
+    def util(self):
+        return util
+
+
+class MultiSlotDataGenerator:
+    """CTR slot-format data generator (reference fleet/data_generator/
+    data_generator.py): subclass, implement ``generate_sample(line)``
+    yielding [(slot_name, [feasigns...]), ...]; ``run_from_stdin`` /
+    ``run_from_memory`` emit the MultiSlot text protocol."""
+
+    def generate_sample(self, line):
+        raise NotImplementedError
+
+    def _format(self, sample) -> str:
+        parts = []
+        for _name, feasigns in sample:
+            parts.append(str(len(feasigns)))
+            parts.extend(str(v) for v in feasigns)
+        return " ".join(parts)
+
+    def _emit(self, lines, out):
+        for line in lines:
+            gen = self.generate_sample(line)
+            for sample in (gen() if callable(gen) else gen):
+                out.write(self._format(sample) + "\n")
+
+    def run_from_stdin(self):
+        import sys
+        self._emit(sys.stdin, sys.stdout)
+
+    def run_from_memory(self, lines):
+        import io
+        out = io.StringIO()
+        self._emit(lines, out)
+        return out.getvalue()
+
+
+class MultiSlotStringDataGenerator(MultiSlotDataGenerator):
+    """String-feasign variant (reference data_generator.py)."""
+
+
+__all__ += ["Fleet", "Role", "PaddleCloudRoleMaker",
+            "UserDefinedRoleMaker", "UtilBase", "CommunicateTopology",
+            "MultiSlotDataGenerator", "MultiSlotStringDataGenerator",
+            "util"]

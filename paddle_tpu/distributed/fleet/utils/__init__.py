@@ -1,1 +1,2 @@
+from .fs import DistributedInfer, HDFSClient, LocalFS  # noqa: F401
 from .recompute import RecomputeLayer, recompute  # noqa: F401

@@ -1,0 +1,173 @@
+"""Filesystem clients (reference: python/paddle/distributed/fleet/utils/
+fs.py — LocalFS over os/shutil, HDFSClient shelling out to `hadoop fs`).
+
+LocalFS is fully real. HDFSClient drives a ``hadoop`` binary when one
+exists on PATH (same mechanism as the reference); without one, every
+call raises with that diagnosis instead of hanging on a missing
+subprocess.
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+from typing import List, Optional, Tuple
+
+__all__ = ["LocalFS", "HDFSClient", "DistributedInfer"]
+
+
+class ExecuteError(Exception):
+    pass
+
+
+class LocalFS:
+    """Reference fs.py LocalFS — local filesystem with the FS client
+    interface checkpoint/elastic code uses."""
+
+    def ls_dir(self, fs_path) -> Tuple[List[str], List[str]]:
+        if not self.is_exist(fs_path):
+            return [], []
+        dirs, files = [], []
+        for name in sorted(os.listdir(fs_path)):
+            (dirs if os.path.isdir(os.path.join(fs_path, name))
+             else files).append(name)
+        return dirs, files
+
+    def mkdirs(self, fs_path) -> None:
+        os.makedirs(fs_path, exist_ok=True)
+
+    def rename(self, src, dst) -> None:
+        os.rename(src, dst)
+
+    def delete(self, fs_path) -> None:
+        if self.is_dir(fs_path):
+            shutil.rmtree(fs_path)
+        elif self.is_file(fs_path):
+            os.remove(fs_path)
+
+    def need_upload_download(self) -> bool:
+        return False
+
+    def is_file(self, fs_path) -> bool:
+        return os.path.isfile(fs_path)
+
+    def is_dir(self, fs_path) -> bool:
+        return os.path.isdir(fs_path)
+
+    def is_exist(self, fs_path) -> bool:
+        return os.path.exists(fs_path)
+
+    def touch(self, fs_path, exist_ok=True) -> None:
+        if self.is_exist(fs_path):
+            if not exist_ok:
+                raise FileExistsError(fs_path)
+            return
+        with open(fs_path, "w"):
+            pass
+
+    def upload(self, local_path, fs_path) -> None:
+        shutil.copy(local_path, fs_path)
+
+    def download(self, fs_path, local_path) -> None:
+        shutil.copy(fs_path, local_path)
+
+    def mv(self, src_path, dst_path, overwrite=False, test_exists=True):
+        if test_exists and not self.is_exist(src_path):
+            raise FileNotFoundError(src_path)
+        if self.is_exist(dst_path):
+            if not overwrite:
+                # reference raises FSFileExistsError here — a checkpoint
+                # rotation must never silently clobber the destination
+                raise FileExistsError(
+                    f"{dst_path} exists (pass overwrite=True)")
+            self.delete(dst_path)
+        shutil.move(src_path, dst_path)
+
+    def cat(self, fs_path) -> str:
+        with open(fs_path) as f:
+            return f.read()
+
+    def list_dirs(self, fs_path) -> List[str]:
+        return self.ls_dir(fs_path)[0]
+
+
+class HDFSClient:
+    """Reference fs.py HDFSClient: every operation shells out to
+    ``hadoop fs`` with the configured name node. Works when a hadoop
+    binary exists; raises a clear diagnosis otherwise."""
+
+    def __init__(self, hadoop_home: Optional[str] = None, configs=None,
+                 time_out=5 * 60 * 1000, sleep_inter=1000):
+        self._hadoop = os.path.join(hadoop_home, "bin", "hadoop") \
+            if hadoop_home else shutil.which("hadoop")
+        self._configs = configs or {}
+        self._timeout = time_out / 1000.0
+
+    def _run(self, *args) -> str:
+        if not self._hadoop or not os.path.exists(self._hadoop):
+            raise ExecuteError(
+                "no hadoop binary available (pass hadoop_home= or put "
+                "`hadoop` on PATH); this environment has no HDFS — use "
+                "LocalFS or sharded checkpoints (distributed/checkpoint)")
+        cmd = [self._hadoop, "fs"]
+        for k, v in self._configs.items():
+            cmd += ["-D", f"{k}={v}"]
+        cmd += list(args)
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=self._timeout)
+        if proc.returncode != 0:
+            raise ExecuteError(f"hadoop {' '.join(args)} failed: "
+                               f"{proc.stderr[-500:]}")
+        return proc.stdout
+
+    def ls_dir(self, fs_path):
+        out = self._run("-ls", fs_path)
+        dirs, files = [], []
+        for line in out.splitlines():
+            parts = line.split()
+            if len(parts) < 8:
+                continue
+            name = os.path.basename(parts[-1])
+            (dirs if parts[0].startswith("d") else files).append(name)
+        return dirs, files
+
+    def mkdirs(self, fs_path):
+        self._run("-mkdir", "-p", fs_path)
+
+    def delete(self, fs_path):
+        self._run("-rm", "-r", "-f", fs_path)
+
+    def is_exist(self, fs_path) -> bool:
+        try:
+            self._run("-test", "-e", fs_path)
+            return True
+        except ExecuteError:
+            return False
+
+    def upload(self, local_path, fs_path):
+        self._run("-put", local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        self._run("-get", fs_path, local_path)
+
+    def need_upload_download(self) -> bool:
+        return True
+
+
+class DistributedInfer:
+    """Reference utils/ps_util.py DistributedInfer: swaps a trained PS
+    program for inference. On this backend inference programs are
+    for-test clones already; the facade wires that path."""
+
+    def __init__(self, main_program=None, startup_program=None):
+        from ... import static
+        self._main = main_program or static.default_main_program()
+
+    def init_distributed_infer_env(self, exe, loss, role_maker=None,
+                                   dirname=None):
+        if dirname is not None:
+            from ... import static
+            static.load(self._main, dirname)
+
+    def get_dist_infer_program(self):
+        return self._main.clone(for_test=True)

@@ -1,0 +1,289 @@
+"""``paddle.distributed.utils`` (reference: python/paddle/distributed/
+utils.py — the launcher-era cluster/pod/trainer helpers plus the MoE
+``global_scatter``/``global_gather`` collectives).
+
+The cluster bookkeeping classes are real (the elastic launcher uses the
+same shapes); process management wraps the spawn machinery. The MoE
+collectives map to the expert-parallel all_to_all the reference built
+them for (incubate/moe.py owns the jitted path; these are the eager
+count-driven forms).
+"""
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import socket
+import subprocess
+import time
+from contextlib import closing
+from typing import List, Optional
+
+import numpy as np
+
+__all__ = ["get_host_name_ip", "get_cluster", "get_logger",
+           "find_free_ports", "add_arguments", "terminate_local_procs",
+           "start_local_trainers", "watch_local_trainers",
+           "pull_worker_log", "global_scatter", "global_gather",
+           "Cluster", "Pod", "Trainer", "TrainerProc", "JobServer",
+           "Hdfs"]
+
+
+def get_logger(log_level=20, name="root"):
+    logger = logging.getLogger(name)
+    logger.setLevel(log_level)
+    if not logger.handlers:
+        h = logging.StreamHandler()
+        h.setFormatter(logging.Formatter(
+            "%(asctime)s-%(levelname)s: %(message)s"))
+        logger.addHandler(h)
+    return logger
+
+
+def get_host_name_ip():
+    try:
+        host = socket.gethostname()
+        return host, socket.gethostbyname(socket.getfqdn(host))
+    except OSError:
+        return None
+
+
+def find_free_ports(num: int):
+    ports = set()
+    for _ in range(num * 10):
+        if len(ports) >= num:
+            break
+        with closing(socket.socket(socket.AF_INET,
+                                   socket.SOCK_STREAM)) as s:
+            s.bind(("", 0))
+            ports.add(s.getsockname()[1])
+    return ports if len(ports) >= num else None
+
+
+def add_arguments(argname, type, default, help, argparser):  # noqa: A002
+    """Reference utils.add_arguments (argparse helper)."""
+    argparser.add_argument("--" + argname, default=default, type=type,
+                           help=f"{help} Default: %(default)s.")
+
+
+# --------------------------------------------------------------------------
+# cluster bookkeeping (reference utils.py Cluster/Pod/Trainer/...)
+# --------------------------------------------------------------------------
+
+class Trainer:
+    def __init__(self):
+        self.gpus: List[int] = []
+        self.endpoint: Optional[str] = None
+        self.rank: Optional[int] = None
+
+    def __eq__(self, other):
+        return (self.gpus, self.endpoint, self.rank) == \
+            (other.gpus, other.endpoint, other.rank)
+
+    def __ne__(self, other):
+        return not self.__eq__(other)
+
+
+class Pod:
+    def __init__(self):
+        self.rank: Optional[int] = None
+        self.id: Optional[str] = None
+        self.addr: Optional[str] = None
+        self.port: Optional[int] = None
+        self.trainers: List[Trainer] = []
+        self.gpus: List[int] = []
+
+    def rank_of(self, trainer) -> int:
+        try:
+            return self.trainers.index(trainer)
+        except ValueError:
+            return -1
+
+
+class Cluster:
+    def __init__(self, hdfs=None):
+        self.job_server = None
+        self.pods: List[Pod] = []
+        self.hdfs = hdfs
+
+    def trainers_nranks(self) -> int:
+        return len(self.trainers_endpoints())
+
+    def trainers_endpoints(self) -> List[str]:
+        return [t.endpoint for pod in self.pods for t in pod.trainers]
+
+    def pods_endpoints(self) -> List[str]:
+        return [f"{p.addr}:{p.port}" for p in self.pods]
+
+    def get_pod_by_id(self, pod_id):
+        for p in self.pods:
+            if p.id == pod_id:
+                return p
+        return None
+
+
+class JobServer:
+    def __init__(self):
+        self.endpoint: Optional[str] = None
+
+
+class Hdfs:
+    def __init__(self):
+        self.hdfs_ugi = None
+        self.hdfs_name = None
+        self.hdfs_path = None
+
+    def is_valid(self):
+        return all((self.hdfs_ugi, self.hdfs_name, self.hdfs_path))
+
+
+class TrainerProc:
+    def __init__(self):
+        self.proc = None
+        self.log_fn = None
+        self.log_offset = 0
+        self.rank = None
+        self.local_rank = None
+        self.cmd = None
+
+
+def get_cluster(node_ips, node_ip, trainer_endpoints, device_mode=None,
+                devices_per_proc=None):
+    """Assemble a Cluster from endpoint lists (reference get_cluster)."""
+    cluster = Cluster()
+    rank = 0
+    for pod_rank, ip in enumerate(node_ips):
+        pod = Pod()
+        pod.rank = pod_rank
+        pod.addr = ip
+        pod.id = str(pod_rank)
+        eps = trainer_endpoints[pod_rank] \
+            if trainer_endpoints and isinstance(trainer_endpoints[0],
+                                                (list, tuple)) \
+            else [e for e in (trainer_endpoints or [])
+                  if e.split(":")[0] == ip]
+        for ep in eps:
+            t = Trainer()
+            t.endpoint = ep
+            t.rank = rank
+            rank += 1
+            pod.trainers.append(t)
+        cluster.pods.append(pod)
+    return cluster, cluster.get_pod_by_id(str(node_ips.index(node_ip)))
+
+
+def start_local_trainers(cluster, pod, training_script,
+                         training_script_args, log_dir=None, envs=None):
+    """Spawn one process per trainer in ``pod`` with PADDLE_* env wiring
+    (reference start_local_trainers; the launch module owns the richer
+    restart/elastic path)."""
+    procs = []
+    eps = cluster.trainers_endpoints()
+    for local_rank, t in enumerate(pod.trainers):
+        env = dict(os.environ, **(envs or {}))
+        env.update({
+            "PADDLE_TRAINER_ID": str(t.rank),
+            "PADDLE_TRAINERS_NUM": str(cluster.trainers_nranks()),
+            "PADDLE_CURRENT_ENDPOINT": t.endpoint or "",
+            "PADDLE_TRAINER_ENDPOINTS": ",".join(e or "" for e in eps),
+        })
+        log_fn = None
+        stdout = None
+        if log_dir:
+            os.makedirs(log_dir, exist_ok=True)
+            log_fn = open(os.path.join(log_dir,
+                                       f"workerlog.{local_rank}"), "w")
+            stdout = log_fn
+        import sys
+        proc = subprocess.Popen(
+            [sys.executable, "-u", training_script,
+             *training_script_args],
+            env=env, stdout=stdout, stderr=stdout)
+        tp = TrainerProc()
+        tp.proc = proc
+        tp.rank = t.rank
+        tp.local_rank = local_rank
+        tp.log_fn = log_fn
+        procs.append(tp)
+    return procs
+
+
+def watch_local_trainers(procs, nranks):
+    """Poll once: return alive procs; raise if any died nonzero
+    (reference watch_local_trainers semantics, sans the global abort)."""
+    alive = []
+    for tp in procs:
+        rc = tp.proc.poll()
+        if rc is None:
+            alive.append(tp)
+        elif rc != 0:
+            terminate_local_procs(procs)
+            raise RuntimeError(
+                f"trainer rank {tp.rank} exited with code {rc}")
+    return alive
+
+
+def terminate_local_procs(procs):
+    for tp in procs:
+        if tp.proc is not None and tp.proc.poll() is None:
+            tp.proc.terminate()
+    deadline = time.time() + 10
+    for tp in procs:
+        if tp.proc is None:
+            continue
+        try:
+            tp.proc.wait(timeout=max(0.1, deadline - time.time()))
+        except subprocess.TimeoutExpired:
+            tp.proc.send_signal(signal.SIGKILL)
+        if tp.log_fn:
+            tp.log_fn.close()
+
+
+def pull_worker_log(tp) -> None:
+    """Stream new bytes of a trainer's log to stdout (reference
+    pull_worker_log)."""
+    if tp.log_fn is None:
+        return
+    with open(tp.log_fn.name, "rb") as f:
+        f.seek(tp.log_offset)
+        data = f.read()
+        tp.log_offset = f.tell()
+    if data:
+        print(data.decode(errors="replace"), end="")
+
+
+# --------------------------------------------------------------------------
+# MoE count-driven collectives (reference utils.py global_scatter/
+# global_gather over alltoall; incubate/moe.py owns the jitted dispatch)
+# --------------------------------------------------------------------------
+
+def _counts_np(v):
+    from ..framework.tensor import Tensor
+    return np.asarray(v.numpy() if isinstance(v, Tensor) else v,
+                      np.int64)
+
+
+def global_scatter(x, local_count, global_count, group=None,
+                   use_calc_stream=True):
+    """Reorganize rows of ``x`` from expert-major-local to the layout
+    each expert receives (reference global_scatter). Single-process
+    form: with world size 1 the alltoall is an identity over the local
+    counts, so x passes through partitioned by ``local_count``."""
+    from . import get_world_size
+    if get_world_size() > 1:
+        raise NotImplementedError(
+            "multi-process global_scatter is served by the jitted "
+            "expert-parallel dispatch (incubate.moe, all_to_all over "
+            "the mesh); the eager count-driven form is single-process")
+    return x
+
+
+def global_gather(x, local_count, global_count, group=None,
+                  use_calc_stream=True):
+    """Inverse of global_scatter (reference global_gather)."""
+    from . import get_world_size
+    if get_world_size() > 1:
+        raise NotImplementedError(
+            "multi-process global_gather is served by the jitted "
+            "expert-parallel combine (incubate.moe)")
+    return x

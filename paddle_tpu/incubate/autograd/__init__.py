@@ -8,7 +8,7 @@ functional entry points delegate to the autograd facade.
 from __future__ import annotations
 
 __all__ = ["enable_prim", "disable_prim", "prim_enabled", "forward_grad",
-           "grad", "jvp", "vjp"]
+           "grad", "jvp", "vjp", "Jacobian", "Hessian", "prim2orig"]
 
 _prim = {"enabled": False}
 
@@ -52,3 +52,38 @@ def grad(outputs_fn, xs, v=None):
     """Reverse-mode gradients (reference primapi.grad)."""
     _, grads = vjp(outputs_fn, xs, v)
     return grads
+
+
+def prim2orig(block=None):
+    """Reference primapi.prim2orig: lower primitive ops back to original
+    ops. jax's jaxprs ARE the primitive layer and XLA lowers them — a
+    recorded program never holds prim ops, so this is a checked no-op."""
+    return None
+
+
+class Jacobian:
+    """Lazy Jacobian view (reference incubate/autograd/functional.py
+    Jacobian): J = Jacobian(func, xs); J[:] materializes, rows/cols
+    index. Built on autograd.jacobian."""
+
+    def __init__(self, func, xs, is_batched=False):
+        from ...autograd import jacobian as _jac
+        self._mat = _jac(func, xs)
+
+    def __getitem__(self, idx):
+        return self._mat[idx]
+
+    @property
+    def shape(self):
+        return self._mat.shape
+
+    def numpy(self):
+        return self._mat.numpy()
+
+
+class Hessian(Jacobian):
+    """Lazy Hessian view (reference functional.py Hessian)."""
+
+    def __init__(self, func, xs, is_batched=False):
+        from ...autograd import hessian as _hes
+        self._mat = _hes(func, xs)

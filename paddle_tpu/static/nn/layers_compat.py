@@ -96,11 +96,28 @@ def sparse_embedding(input, size, padding_idx=None, param_attr=None,
 
 def _conv(nd, transpose, input, num_filters, filter_size, stride=1,
           padding=0, dilation=1, groups=1, param_attr=None,
-          bias_attr=None, data_format=None, name=None, **kwargs):
+          bias_attr=None, data_format=None, name=None, act=None,
+          output_size=None, **kwargs):
+    if kwargs:
+        raise TypeError(f"unsupported conv argument(s) {sorted(kwargs)}; "
+                        f"silently ignoring fluid knobs would change the "
+                        f"computed network")
     nn = _pkg_nn()
     df = data_format or ("NCHW" if nd == 2 else "NCDHW")
     in_c = int(input.shape[1] if df.startswith("NC")
                else input.shape[-1])
+    if transpose and filter_size is None:
+        if output_size is None:
+            raise ValueError("conv transpose needs filter_size= or "
+                             "output_size=")
+        # reference semantics: derive the kernel so stride x input +
+        # kernel - stride == output (padding 0)
+        in_sp = (input.shape[2:2 + nd] if df.startswith("NC")
+                 else input.shape[1:1 + nd])
+        outs = np.atleast_1d(output_size)
+        st = np.broadcast_to(np.atleast_1d(stride), (nd,))
+        filter_size = tuple(int(o - (int(i) - 1) * int(s))
+                            for o, i, s in zip(outs, in_sp, st))
     cls = {(2, False): nn.Conv2D, (3, False): nn.Conv3D,
            (2, True): nn.Conv2DTranspose, (3, True): nn.Conv3DTranspose}[
         (nd, transpose)]
@@ -114,7 +131,9 @@ def _conv(nd, transpose, input, num_filters, filter_size, stride=1,
                     weight_attr=param_attr, bias_attr=bias_attr,
                     data_format=df),
         name=name)
-    return layer(input)
+    out = layer(input, output_size=output_size) if transpose and \
+        output_size is not None else layer(input)
+    return _act(out, act)
 
 
 def conv2d(input, num_filters, filter_size, **kwargs):
@@ -141,15 +160,17 @@ def batch_norm(input, act=None, is_test=False, momentum=0.9,
             else input.shape[-1])
     rank = len(input.shape)
     if rank == 5:
+        df5 = "NCDHW" if data_layout.startswith("NC") else "NDHWC"
         factory = lambda: nn.BatchNorm3D(c, momentum=momentum,
-                                         epsilon=epsilon)
+                                         epsilon=epsilon, data_format=df5)
     elif rank == 4:
         factory = lambda: nn.BatchNorm2D(c, momentum=momentum,
                                          epsilon=epsilon,
                                          data_format=data_layout)
     else:
+        df1 = "NCL" if data_layout.startswith("NC") else "NLC"
         factory = lambda: nn.BatchNorm1D(c, momentum=momentum,
-                                         epsilon=epsilon)
+                                         epsilon=epsilon, data_format=df1)
     layer = _cached(("batch_norm", name, c, data_layout, rank), factory,
                     name=name)
     out = layer(input)
@@ -413,16 +434,24 @@ def sequence_conv(input, num_filters, filter_size=3, lengths=None,
     from ...framework.dispatch import call_op
     from ...framework.tensor import Parameter
     import jax.numpy as jnp
+    if filter_stride != 1 or padding_start is not None:
+        raise NotImplementedError(
+            "sequence_conv supports filter_stride=1 with centered "
+            "padding (the common configuration); other strides/starts "
+            "would silently change the computation")
     d = int(input.shape[-1])
-    w = _cached(
-        ("sequence_conv", name, d, num_filters, filter_size),
-        lambda: Parameter(jnp.asarray(
+    w, b = _cached(
+        ("sequence_conv", name, d, num_filters, filter_size,
+         bias_attr is not False),
+        lambda: (Parameter(jnp.asarray(
             (np.random.RandomState(0).randn(filter_size * d, num_filters)
              / np.sqrt(filter_size * d)).astype(np.float32))),
+            None if bias_attr is False else Parameter(
+                jnp.zeros((num_filters,), jnp.float32))),
         name=name)
     out = call_op("sequence_conv", input,
                   lengths if lengths is not None else _full_lengths(input),
-                  w, context_length=filter_size)
+                  w, bias=b, context_length=filter_size)
     return _act(out, act)
 
 
@@ -543,20 +572,23 @@ class StaticRNN:
         return init
 
     def unroll(self, step_fn):
-        """Run ``step_fn(x_t, *states) -> (out, *new_states)`` over
-        axis 1 of the first step_input, eagerly unrolled; returns
-        stacked outputs [B, T, ...]."""
+        """Run ``step_fn(*x_ts, *states) -> (out, *new_states)`` over
+        axis 1 of EVERY step_input (in declaration order), eagerly
+        unrolled; returns stacked outputs [B, T, ...]."""
         from ...framework.dispatch import call_op
         if not self._inputs:
             raise RuntimeError("call step_input(x) before unroll()")
-        x = self._inputs[0]
+
+        def _slice_t(x, t):
+            xt = call_op("slice", x, axes=[1], starts=[t], ends=[t + 1])
+            return call_op("reshape", xt,
+                           shape=[-1] + list(x.shape[2:]))
+
         states = list(self._memories)
         outs = []
         for t in range(self._seq_len):
-            xt = call_op("slice", x, axes=[1], starts=[t], ends=[t + 1])
-            xt = call_op("reshape", xt,
-                         shape=[x.shape[0]] + list(x.shape[2:]))
-            res = step_fn(xt, *states)
+            xts = [_slice_t(x, t) for x in self._inputs]
+            res = step_fn(*xts, *states)
             if not isinstance(res, (tuple, list)):
                 res = (res,)
             out, states = res[0], list(res[1:]) or states

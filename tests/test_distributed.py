@@ -644,3 +644,99 @@ class TestDistributedCompatSurface:
         import paddle_tpu.distributed as dist
         with pytest.raises(ValueError, match="batch_size"):
             dist.InMemoryDataset().init(batch_size=0)
+
+
+class TestFleetSurface:
+    """Fleet facade / role makers / UtilBase / fs clients /
+    distributed.utils (reference fleet/__init__.py, base/role_maker.py,
+    utils/fs.py, distributed/utils.py)."""
+
+    def test_fleet_class_delegates(self):
+        import paddle_tpu.distributed.fleet as fleet
+        f = fleet.Fleet()
+        assert f.worker_num() >= 1 and f.worker_index() >= 0
+        assert isinstance(f.util, fleet.UtilBase)
+
+    def test_role_makers(self):
+        import paddle_tpu.distributed.fleet as fleet
+        rm = fleet.UserDefinedRoleMaker(current_id=2, worker_num=4)
+        assert rm._worker_index() == 2 and rm._worker_num() == 4
+        assert rm._is_worker() and not rm._is_server()
+        assert fleet.PaddleCloudRoleMaker()._role() == fleet.Role.WORKER
+
+    def test_util_file_shard(self, monkeypatch):
+        import paddle_tpu.distributed.fleet as fleet
+        files = [f"f{i}" for i in range(7)]
+        monkeypatch.setattr(fleet, "worker_num", lambda: 3)
+        monkeypatch.setattr(fleet, "worker_index", lambda: 0)
+        s0 = fleet.util.get_file_shard(files)
+        monkeypatch.setattr(fleet, "worker_index", lambda: 2)
+        s2 = fleet.util.get_file_shard(files)
+        assert len(s0) == 3 and len(s2) == 2
+
+    def test_local_fs(self, tmp_path):
+        from paddle_tpu.distributed.fleet.utils import LocalFS
+        fs = LocalFS()
+        d = str(tmp_path / "a")
+        fs.mkdirs(d)
+        fs.touch(d + "/x.txt")
+        assert fs.is_exist(d + "/x.txt") and fs.is_file(d + "/x.txt")
+        dirs, files = fs.ls_dir(str(tmp_path))
+        assert dirs == ["a"]
+        fs.mv(d + "/x.txt", d + "/y.txt")
+        assert fs.cat(d + "/y.txt") == ""
+        fs.delete(d)
+        assert not fs.is_exist(d)
+
+    def test_hdfs_client_without_hadoop_diagnoses(self):
+        from paddle_tpu.distributed.fleet.utils.fs import (ExecuteError,
+                                                           HDFSClient)
+        c = HDFSClient(hadoop_home="/nonexistent")
+        with pytest.raises(ExecuteError, match="hadoop"):
+            c.mkdirs("/tmp/x")
+
+    def test_distributed_utils_cluster(self):
+        from paddle_tpu.distributed import utils as du
+        cluster, pod = du.get_cluster(
+            ["10.0.0.1", "10.0.0.2"], "10.0.0.2",
+            [["10.0.0.1:6170"], ["10.0.0.2:6170", "10.0.0.2:6171"]])
+        assert cluster.trainers_nranks() == 3
+        assert pod.addr == "10.0.0.2" and len(pod.trainers) == 2
+        assert len(du.find_free_ports(2)) == 2
+        assert du.get_host_name_ip() is not None
+
+    def test_multislot_data_generator(self):
+        import paddle_tpu.distributed.fleet as fleet
+
+        class Gen(fleet.MultiSlotDataGenerator):
+            def generate_sample(self, line):
+                a, b = line.strip().split()
+                yield [("show", [int(a)]), ("click", [int(b)])]
+
+        out = Gen().run_from_memory(["1 0\n", "3 1\n"])
+        assert out == "1 1 1 0\n1 3 1 1\n"
+
+    def test_incubate_autograd_classes(self):
+        import numpy as np
+        from paddle_tpu import incubate
+        import paddle_tpu as paddle
+        x = paddle.to_tensor(np.array([1.0, 2.0], "float32"),
+                             stop_gradient=False)
+        J = incubate.autograd.Jacobian(
+            lambda t: paddle.square(t).sum(), x)
+        np.testing.assert_allclose(np.asarray(J.numpy()).reshape(-1),
+                                   [2.0, 4.0], rtol=1e-5)
+        assert incubate.autograd.prim2orig() is None
+
+    def test_local_fs_mv_no_clobber(self, tmp_path):
+        from paddle_tpu.distributed.fleet.utils import LocalFS
+        fs = LocalFS()
+        a, b = str(tmp_path / "a"), str(tmp_path / "b")
+        for p, content in ((a, "A"), (b, "B")):
+            with open(p, "w") as f:
+                f.write(content)
+        with pytest.raises(FileExistsError):
+            fs.mv(a, b)
+        assert fs.cat(b) == "B"
+        fs.mv(a, b, overwrite=True)
+        assert fs.cat(b) == "A"

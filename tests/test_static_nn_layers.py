@@ -207,3 +207,36 @@ class TestBuilderRecordingAndCaching:
         m2 = np.asarray(layer._mean.numpy())
         # blended, not replaced: still positive after one negative batch
         assert (m2 < m1).all() and (m2 > -10.0).all()
+
+    def test_conv_act_and_transpose_output_size(self):
+        x = paddle.to_tensor(rng.randn(1, 2, 6, 6).astype("float32"))
+        out = static.nn.conv2d(x, 3, 3, padding=1, act="relu",
+                               name="act_c")
+        assert float(np.asarray(out.numpy()).min()) >= 0.0
+        up = static.nn.conv2d_transpose(x, 3, None, stride=2,
+                                        output_size=[12, 12], name="up")
+        assert tuple(up.shape)[-2:] == (12, 12)
+        with pytest.raises(TypeError, match="unsupported"):
+            static.nn.conv2d(x, 3, 3, use_cudnn=True)
+
+    def test_batch_norm_ndhwc(self):
+        x = paddle.to_tensor(rng.randn(2, 4, 4, 4, 3).astype("float32"))
+        out = static.nn.batch_norm(x, data_layout="NDHWC", name="bn_dl")
+        assert tuple(out.shape) == (2, 4, 4, 4, 3)
+
+    def test_static_rnn_multi_input(self):
+        rnn = static.nn.StaticRNN()
+        xs = paddle.to_tensor(rng.randn(2, 4, 3).astype("float32"))
+        mask = paddle.to_tensor(np.ones((2, 4, 1), "float32"))
+        rnn.step_input(xs)
+        rnn.step_input(mask)
+        rnn.memory(shape=(3,), batch_ref=xs)
+        out = rnn.unroll(lambda xt, mt, h: (h + xt * mt, h + xt * mt))
+        np.testing.assert_allclose(out.numpy(),
+                                   np.cumsum(xs.numpy(), axis=1),
+                                   rtol=1e-5)
+
+    def test_sequence_conv_unsupported_knobs_raise(self):
+        xs = paddle.to_tensor(rng.randn(2, 5, 4).astype("float32"))
+        with pytest.raises(NotImplementedError, match="stride"):
+            static.nn.sequence_conv(xs, 3, filter_stride=2)
