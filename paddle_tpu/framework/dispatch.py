@@ -184,6 +184,30 @@ def _get_callable(name: str, impl, template, attrs_key, attrs,
     return fn
 
 
+def _get_bwd_callable(name: str, impl, template, attrs_key, fwd_fn,
+                      arr_attr_names=(), jit_ok=True):
+    """Jitted pullback for (op, attrs, structure): ``bwd(ct, *arrays)``
+    recomputes the forward linearization inside jit and returns input
+    cotangents. Cached like the forward callable, so after the first
+    backward per shape class the eager tape pays ONE compiled call per
+    node instead of an eager jax.vjp re-trace (the pre-r5 ~40x per-op
+    overhead). ``fwd_fn`` is the already-cached forward callable —
+    jax.vjp through it reuses its trace under this jit."""
+    key = ("bwd", name, id(impl), _template_key(template), attrs_key,
+           tuple(arr_attr_names))
+    fn = _fn_cache.get(key)
+    if fn is None:
+        def bwd_raw(ct, *arrays):
+            _, vjp = jax.vjp(fwd_fn, *arrays)
+            return vjp(ct)
+
+        fn = jax.jit(bwd_raw) if (jit_ok
+                                  and flag_value("FLAGS_eager_jit_ops")) \
+            else bwd_raw
+        _fn_cache[key] = fn
+    return fn
+
+
 def _attrs_key(attrs: dict):
     items = []
     for k in sorted(attrs):
@@ -252,16 +276,25 @@ def _call_op_impl(name, opdef, args, attrs):
     if amp.is_auto_cast_enabled():
         arrays = amp.amp_cast_inputs(name, arrays)
     impl = opdef.select(args, attrs)
-    fn = _get_callable(name, impl, template, _attrs_key(const_attrs),
-                       const_attrs, arr_attr_names, jit_ok=opdef.jit)
+    akey = _attrs_key(const_attrs)
+    fn = _get_callable(name, impl, template, akey, const_attrs,
+                       arr_attr_names, jit_ok=opdef.jit)
 
     needs_grad = (is_grad_enabled() and not opdef.nondiff
                   and any(t._requires_grad() for t in tensors))
 
+    # grads-on takes the SAME cached forward call as grads-off; the
+    # pullback is a separate jit-cached recompute-backward bound lazily
+    # (residual-free — backward re-linearizes inside its own jit)
+    out = fn(*arrays)
     if needs_grad:
-        out, vjp_fn = jax.vjp(fn, *arrays)
+        bwd = _get_bwd_callable(name, impl, template, akey, fn,
+                                arr_attr_names, jit_ok=opdef.jit)
+        bound = tuple(arrays)
+
+        def vjp_fn(ct, _bwd=bwd, _arrays=bound):
+            return _bwd(ct, *_arrays)
     else:
-        out = fn(*arrays)
         vjp_fn = None
 
     flat_out, out_treedef = jax.tree_util.tree_flatten(out)
