@@ -1,0 +1,56 @@
+"""Serve a jit.save'd artifact with request batching.
+
+Usage:
+    python examples/serve_model.py --export   # make a demo artifact
+    python examples/serve_model.py            # serve + client demo
+
+The same artifact serves C/C++ processes through the PDT_* C API
+(native/tpu_infer_capi.cc; build via paddle_tpu.inference.capi).
+"""
+import argparse
+import threading
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import inference, jit
+from paddle_tpu.static import InputSpec
+
+PREFIX = "served_mlp"
+
+
+def export():
+    paddle.seed(0)
+    net = paddle.nn.Sequential(paddle.nn.Linear(16, 64), paddle.nn.ReLU(),
+                               paddle.nn.Linear(64, 4))
+    net.eval()
+    jit.save(net, PREFIX, input_spec=[InputSpec([None, 16], "float32")])
+    print(f"exported {PREFIX}.pdmodel")
+
+
+def serve():
+    pred = inference.create_predictor(inference.Config(PREFIX + ".pdmodel"))
+    engine = inference.BatchingEngine(pred, max_batch_size=32,
+                                     max_delay_ms=2.0)
+    results = {}
+
+    def client(i):
+        x = np.random.RandomState(i).randn(1, 16).astype("float32")
+        (logits,) = engine.infer(x)
+        results[i] = int(logits.argmax())
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(16)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    engine.close()
+    print("16 concurrent requests ->", results)
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--export", action="store_true")
+    args = ap.parse_args()
+    export() if args.export else serve()

@@ -1,0 +1,41 @@
+"""Classic static-graph workflow: program_guard build, Executor.run
+training, program-level post-training quantization.
+
+Usage:
+    python examples/static_graph.py
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+from paddle_tpu.quantization import PostTrainingQuantizationProgram
+
+paddle.enable_static()
+main, startup = static.Program(), static.Program()
+with static.program_guard(main, startup):
+    x = static.data("x", [None, 8], "float32")
+    y = static.data("y", [None, 1], "float32")
+    h = static.nn.fc(x, size=32)
+    pred = static.nn.fc(h, size=1)
+    loss = paddle.mean(paddle.nn.functional.square_error_cost(pred, y))
+    paddle.optimizer.Adam(learning_rate=0.01).minimize(loss)
+
+exe = static.Executor()
+exe.run(startup)
+rng = np.random.RandomState(0)
+xs = rng.randn(256, 8).astype("float32")
+ys = xs.sum(1, keepdims=True).astype("float32")
+for step in range(100):
+    (l,) = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+    if step % 20 == 0:
+        print(f"step {step}: loss {float(l):.4f}")
+
+# post-training quantization of the captured graph
+test_prog = main.clone(for_test=True)
+q_prog = PostTrainingQuantizationProgram(
+    test_prog, [{"x": xs[:64]}]).quantize()
+(fp,) = exe.run(test_prog, feed={"x": xs[:8]}, fetch_list=[pred])
+(qp,) = exe.run(q_prog, feed={"x": xs[:8]}, fetch_list=[pred])
+print("float vs int8-sim max diff:",
+      float(np.abs(fp - qp).max()))
+paddle.disable_static()

@@ -1,0 +1,59 @@
+"""Train a vision model end to end (the reference's quickstart shape).
+
+Usage:
+    python examples/train_vision.py --model resnet18 --layout NHWC \
+        --epochs 2 --synthetic
+
+Loads reference-format pretrained weights with --pretrained /path.pdparams
+(see paddle_tpu/utils/pretrained.py). NHWC runs channels-last end to end
+(the TPU-preferred conv layout).
+"""
+import argparse
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import datasets, models
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="resnet18")
+    ap.add_argument("--layout", default="NCHW", choices=["NCHW", "NHWC"])
+    ap.add_argument("--epochs", type=int, default=1)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--num-classes", type=int, default=10)
+    ap.add_argument("--pretrained", default=None,
+                    help=".pdparams path (reference format)")
+    ap.add_argument("--synthetic", action="store_true",
+                    help="FakeData instead of real files (offline env)")
+    args = ap.parse_args()
+
+    paddle.seed(0)
+    net = getattr(models, args.model)(
+        num_classes=args.num_classes,
+        pretrained=args.pretrained or False,
+        **({"data_format": args.layout}
+           if args.model.startswith(("resnet", "wide_", "resnext"))
+           else {}))
+    from paddle_tpu.static import InputSpec
+    shape = (3, 32, 32) if args.layout == "NCHW" else (32, 32, 3)
+    model = paddle.Model(net, inputs=[InputSpec([None, *shape],
+                                                "float32", "image")])
+    model.prepare(paddle.optimizer.Momentum(
+                      learning_rate=0.01, momentum=0.9,
+                      parameters=net.parameters()),
+                  paddle.nn.CrossEntropyLoss(),
+                  paddle.metric.Accuracy())
+
+    data = datasets.FakeData(size=args.batch_size * 8, image_shape=shape,
+                             num_classes=args.num_classes)
+    model.fit(data, batch_size=args.batch_size, epochs=args.epochs,
+              verbose=1)
+    model.save("vision_ckpt")                  # .pdparams + .pdopt
+    model.save("vision_infer", training=False)  # StableHLO artifact
+    print("saved vision_ckpt.pdparams + vision_infer.pdmodel")
+
+
+if __name__ == "__main__":
+    main()
