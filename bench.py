@@ -180,8 +180,14 @@ def bench_gpt2(amp_o2=True):
     denv.build_mesh({"data": 1})
     eng = ParallelEngine(model, opt, loss_fn=None, mesh=denv.get_mesh())
     rng = np.random.RandomState(0)
-    ids = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
-    (dev_ids,), (dev_lbl,) = eng.device_put_batch([ids], [ids])
+    tokens = rng.randint(0, cfg.vocab_size,
+                         (batch, seq + 1)).astype(np.int32)
+    # next-token objective (position t predicts t+1) at IDENTICAL
+    # shapes/FLOPs: feeding ids as their own labels would train a
+    # degenerate copy task (r5 review finding)
+    ids, labels = tokens[:, :-1], tokens[:, 1:]
+    (dev_ids,), (dev_lbl,) = eng.device_put_batch(
+        [ids], [np.ascontiguousarray(labels)])
 
     n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
     n_warm, n_steps = (1, 2) if _smoke() else (5, 20)

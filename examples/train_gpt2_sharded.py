@@ -41,9 +41,12 @@ def main():
     eng = ParallelEngine(model, opt, loss_fn=None, mesh=denv.get_mesh())
 
     rng = np.random.RandomState(0)
-    ids = rng.randint(0, cfg.vocab_size,
-                      (args.batch, seq)).astype(np.int32)
-    (dev_ids,), (dev_lbl,) = eng.device_put_batch([ids], [ids])
+    tokens = rng.randint(0, cfg.vocab_size,
+                         (args.batch, seq + 1)).astype(np.int32)
+    # next-token objective: position t predicts token t+1
+    ids, labels = tokens[:, :-1], tokens[:, 1:]
+    (dev_ids,), (dev_lbl,) = eng.device_put_batch([ids],
+                                                  [labels.astype(np.int32)])
     for step in range(args.steps):
         loss = eng.train_step([dev_ids], [dev_lbl])
         print(f"step {step}: loss {loss:.4f}")

@@ -34,7 +34,8 @@ def main():
         num_classes=args.num_classes,
         pretrained=args.pretrained or False,
         **({"data_format": args.layout}
-           if args.model.startswith(("resnet", "wide_", "resnext"))
+           if args.model.startswith(("resnet", "wide_", "resnext",
+                                     "mobilenet_v1", "mobilenet_v2"))
            else {}))
     from paddle_tpu.static import InputSpec
     shape = (3, 32, 32) if args.layout == "NCHW" else (32, 32, 3)
