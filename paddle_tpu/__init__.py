@@ -53,7 +53,8 @@ for _m in ("autograd", "optimizer", "amp", "io", "metric", "static", "jit",
            "incubate", "models", "utils", "inference", "distribution",
            "sparse", "text", "device", "quantization", "linalg", "fft",
            "signal", "regularizer", "sysconfig", "compat", "hub", "reader",
-           "dataset", "onnx", "callbacks", "cost_model", "version"):
+           "dataset", "onnx", "callbacks", "cost_model", "version",
+           "fluid"):
     _mod = _import_if_built(_m)
     if _mod is not None:
         globals()[_m] = _mod

@@ -1,0 +1,2 @@
+"""``fluid.backward`` shim submodule."""
+from ..static import append_backward, gradients  # noqa: F401

@@ -1,0 +1,40 @@
+"""``fluid.dygraph`` shim: 1.x imperative API."""
+from __future__ import annotations
+
+import contextlib
+
+from ..nn.layer.layers import Layer  # noqa: F401
+
+
+def to_variable(value, name=None, zero_copy=None, dtype=None):
+    import paddle_tpu as _p
+    return _p.to_tensor(value, dtype=dtype)
+
+
+@contextlib.contextmanager
+def guard(place=None):
+    """1.x dygraph.guard: dynamic mode is the default here; the guard
+    just ensures it (and restores static mode after, if it was on)."""
+    from .. import static as _s
+    was_static = not _s.in_dynamic_mode()
+    if was_static:
+        _s.disable_static()
+    try:
+        yield
+    finally:
+        if was_static:
+            _s.enable_static()
+
+
+def no_grad(fn=None):
+    """1.x no_grad: context manager AND decorator."""
+    import functools
+    import paddle_tpu as _p
+    if fn is None:
+        return _p.no_grad()
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        with _p.no_grad():
+            return fn(*args, **kwargs)
+    return wrapped

@@ -1,0 +1,59 @@
+"""``fluid.layers`` shim: the 1.x op namespace. Resolution order:
+static.nn builders (fc/conv2d/batch_norm/sequence_*...), then the
+top-level functional API (mean/concat/reshape/...), then
+nn.functional — covering the names 1.x model code actually calls.
+"""
+from __future__ import annotations
+
+from ..static.nn import *  # noqa: F401,F403
+from ..static.nn import fc  # noqa: F401
+from ..static.nn import cond, while_loop, case, switch_case  # noqa: F401
+
+
+def __getattr__(name):
+    import paddle_tpu as _p
+    from paddle_tpu.nn import functional as _F
+    for src in (_p, _F):
+        if hasattr(src, name):
+            return getattr(src, name)
+    raise AttributeError(
+        f"fluid.layers.{name} is not mapped; use the paddle 2.x API "
+        f"(paddle.{name} / paddle.nn.functional.{name} / "
+        f"paddle.static.nn.{name})")
+
+
+def data(name, shape, dtype="float32", append_batch_size=True,
+         lod_level=0):
+    """1.x fluid.layers.data: ``shape`` is PER-SAMPLE and a batch dim is
+    prepended (append_batch_size=True default) — unlike 2.x static.data
+    whose shape is the full tensor shape."""
+    from ..static import data as _data
+    shape = list(shape)
+    if append_batch_size:
+        shape = [-1] + shape
+    return _data(name, shape, dtype)
+
+
+def cross_entropy(input, label, soft_label=False, ignore_index=-100):
+    """1.x cross_entropy took PROBABILITIES (post-softmax). Supports
+    arbitrary leading dims ([N, ..., C] with label [N, ..., 1]) and
+    ignore_index masking, returning a loss shaped like ``label``."""
+    import paddle_tpu as _p
+    logp = _p.log(_p.clip(input, 1e-8, 1.0))
+    if soft_label:
+        return -(_p.sum(label * logp, axis=-1, keepdim=True))
+    c = input.shape[-1]
+    flat_logp = _p.reshape(logp, [-1, c])
+    flat_label = _p.reshape(label, [-1])
+    safe = _p.clip(flat_label, 0, c - 1)
+    picked = -_p.squeeze(
+        _p.take_along_axis(flat_logp,
+                           _p.reshape(safe, [-1, 1]), axis=1), axis=1)
+    mask = _p.cast(flat_label != ignore_index, picked.dtype)
+    return _p.reshape(picked * mask, label.shape)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, axis=-1):
+    from paddle_tpu.nn import functional as _F
+    return _F.softmax_with_cross_entropy(logits, label,
+                                         soft_label=soft_label, axis=axis)

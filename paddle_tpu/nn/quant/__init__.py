@@ -1,0 +1,12 @@
+"""``paddle.nn.quant`` import-path parity (reference
+python/paddle/nn/quant/ — empty __all__, the quantized layer classes
+live here for the slim tooling). The layers themselves are implemented
+in paddle_tpu.quantization; this module re-exports them under the
+reference path.
+"""
+from ...quantization import (  # noqa: F401
+    FakeQuantAbsMax, MovingAverageAbsMaxScale, QuantizedConv2D,
+    QuantizedLinear,
+)
+
+__all__ = []

@@ -407,12 +407,38 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
                          program=None, **kwargs):
     """Reference: static.save_inference_model (fluid/io.py) — here wired
     onto jit.save's StableHLO artifact. ``fetch_vars`` may be a Layer or a
-    callable producing the fetches from the feeds."""
+    callable producing the fetches from the feeds; with a RECORDED
+    ``program``, the pruned replay (feeds -> fetches, params baked) is
+    what exports."""
     from .. import jit as _jit
-    target = program if program is not None else fetch_vars
+    feed_list = (feed_vars if isinstance(feed_vars, (list, tuple))
+                 else [feed_vars])
     specs = [v if isinstance(v, InputSpec) else InputSpec.from_tensor(v)
-             for v in (feed_vars if isinstance(feed_vars, (list, tuple))
-                       else [feed_vars])]
+             for v in feed_list]
+    if isinstance(program, Program) and program._nodes:
+        fetch_list = (fetch_vars if isinstance(fetch_vars, (list, tuple))
+                      else [fetch_vars])
+        fetch_ids = [program._resolve_fetch(v) for v in fetch_list]
+        id2name = {tid: n for n, tid in program._feeds.items()}
+        feed_names = []
+        for v in feed_list:
+            tid = id(v) if isinstance(v, Tensor) else \
+                program._var_names.get(getattr(v, "name", None) or v)
+            if tid not in id2name:
+                raise ValueError(
+                    "feed_vars must be this program's declared "
+                    "static.data variables")
+            feed_names.append(id2name[tid])
+        params = {n: p._data for n, p in program._params.items()}
+
+        def replay(*arrays):
+            env = program._forward_env(dict(zip(feed_names, arrays)),
+                                       params)
+            outs = [env[i] for i in fetch_ids]
+            return outs[0] if len(outs) == 1 else tuple(outs)
+
+        return _jit.save(replay, path_prefix, input_spec=specs)
+    target = program if program is not None else fetch_vars
     return _jit.save(target, path_prefix, input_spec=specs)
 
 

@@ -1,0 +1,133 @@
+"""paddle.fluid legacy-namespace shim (paddle_tpu/fluid/) — 1.x-style
+code paths run unchanged (reference python/paddle/fluid, still shipped
+in 2.3 for legacy users).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+
+
+@pytest.fixture
+def static_mode():
+    paddle.enable_static()
+    try:
+        yield
+    finally:
+        paddle.disable_static()
+
+
+class TestFluidShim:
+    def test_fit_a_line_1x_style(self, static_mode):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data(name="x", shape=[None, 13],
+                                  dtype="float32")
+            y = fluid.layers.data(name="y", shape=[None, 1],
+                                  dtype="float32")
+            pred = fluid.layers.fc(x, size=1)
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(pred, y))
+            fluid.optimizer.SGD(learning_rate=0.02).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        xs = rng.randn(64, 13).astype("float32")
+        ys = (xs @ rng.randn(13, 1)).astype("float32")
+        (l0,) = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+        for _ in range(40):
+            (l,) = exe.run(main, feed={"x": xs, "y": ys},
+                           fetch_list=[loss])
+        assert float(l) < float(l0) * 0.5
+
+    def test_dygraph_guard_and_variable(self):
+        with fluid.dygraph.guard():
+            v = fluid.dygraph.to_variable(np.ones(3, "float32"))
+            assert isinstance(v, fluid.Variable)
+            assert fluid.in_dygraph_mode()
+
+    def test_layers_fallthrough_and_error(self):
+        t = paddle.to_tensor(np.ones((2, 3), "float32"))
+        out = fluid.layers.reshape(t, [3, 2])      # top-level API name
+        assert tuple(out.shape) == (3, 2)
+        out = fluid.layers.relu(t)                 # nn.functional name
+        assert tuple(out.shape) == (2, 3)
+        with pytest.raises(AttributeError, match="not mapped"):
+            fluid.layers.definitely_not_an_op
+
+    def test_1x_cross_entropy_takes_probabilities(self):
+        probs = paddle.to_tensor(
+            np.array([[0.9, 0.1], [0.2, 0.8]], "float32"))
+        label = paddle.to_tensor(np.array([[0], [1]], "int64"))
+        ce = fluid.layers.cross_entropy(probs, label)
+        np.testing.assert_allclose(
+            np.asarray(ce.numpy()).reshape(-1),
+            -np.log([0.9, 0.8]), rtol=1e-5)
+
+    def test_io_1x_calling_convention(self, static_mode, tmp_path):
+        import jax.numpy as jnp
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data(name="x", shape=[4])  # per-sample shape
+            out = fluid.layers.fc(x, size=2)
+        exe = fluid.Executor()
+        exe.run(startup)
+        d = str(tmp_path / "ckpt_dir")
+        # 1.x order: executor first, then dirname
+        fluid.io.save_persistables(exe, d, main)
+        orig = {n: np.asarray(p._data) for n, p in main._params.items()}
+        for p_ in main._params.values():
+            p_._data = jnp.zeros_like(p_._data)
+        fluid.io.load_persistables(exe, d, main)
+        for n, p_ in main._params.items():
+            np.testing.assert_allclose(np.asarray(p_._data), orig[n])
+        # 1.x inference export: feed vars by NAME
+        fluid.io.save_inference_model(str(tmp_path / "inf"), ["x"],
+                                      [out], exe, main)
+        runner, feeds, fetches = fluid.io.load_inference_model(
+            str(tmp_path / "inf"), exe)
+        assert feeds == ["x"]
+
+    def test_framework_backward_are_submodules(self):
+        from paddle_tpu.fluid.framework import (Program,
+                                                in_dygraph_mode)
+        from paddle_tpu.fluid.backward import append_backward
+        assert Program is fluid.Program
+        assert callable(append_backward)
+        assert in_dygraph_mode() in (True, False)
+
+    def test_data_prepends_batch_dim(self, static_mode):
+        main = fluid.Program()
+        with fluid.program_guard(main):
+            x = fluid.layers.data(name="x", shape=[13])
+            pred = fluid.layers.fc(x, size=1)
+        exe = fluid.Executor()
+        # any batch size feeds: the declared shape was per-sample
+        for n in (3, 7):
+            (v,) = exe.run(main, feed={"x": np.zeros((n, 13),
+                                                     "float32")},
+                           fetch_list=[pred])
+            assert v.shape == (n, 1)
+
+    def test_no_grad_decorator(self):
+        @fluid.dygraph.no_grad
+        def eval_fn(t):
+            return t * 2
+
+        x = paddle.to_tensor(np.ones(2, "float32"), stop_gradient=False)
+        out = eval_fn(x)
+        assert out.stop_gradient
+
+    def test_cross_entropy_ignore_index_and_rank3(self):
+        probs = paddle.to_tensor(
+            np.full((2, 3, 4), 0.25, "float32"))
+        label = np.zeros((2, 3, 1), "int64")
+        label[0, 1, 0] = -100                       # ignored position
+        ce = fluid.layers.cross_entropy(
+            probs, paddle.to_tensor(label), ignore_index=-100)
+        arr = np.asarray(ce.numpy())
+        assert arr.shape == (2, 3, 1)
+        assert arr[0, 1, 0] == 0.0                  # masked
+        np.testing.assert_allclose(arr[0, 0, 0], -np.log(0.25),
+                                   rtol=1e-5)
