@@ -1,0 +1,48 @@
+"""The examples/ scripts must keep running end to end (they are the
+migration-facing quickstarts; reference analog: the book tests under
+python/paddle/fluid/tests/book/)."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(args, tmp_path, extra_env=None, timeout=420):
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
+               PALLAS_AXON_POOL_IPS="")
+    env.update(extra_env or {})
+    proc = subprocess.run([sys.executable, *args], cwd=str(tmp_path),
+                          env=env, capture_output=True, text=True,
+                          timeout=timeout)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    return proc.stdout
+
+
+@pytest.mark.parametrize("script,args,expect", [
+    ("train_vision.py", ["--synthetic", "--epochs", "1",
+                         "--batch-size", "16"], "saved vision_ckpt"),
+    ("static_graph.py", [], "int8-sim max diff"),
+])
+def test_example_runs(script, args, expect, tmp_path):
+    out = _run([os.path.join(REPO, "examples", script), *args], tmp_path)
+    assert expect in out
+
+
+def test_serve_example(tmp_path):
+    _run([os.path.join(REPO, "examples", "serve_model.py"), "--export"],
+         tmp_path)
+    out = _run([os.path.join(REPO, "examples", "serve_model.py")],
+               tmp_path)
+    assert "16 concurrent requests" in out
+
+
+def test_gpt2_sharded_example(tmp_path):
+    out = _run([os.path.join(REPO, "examples", "train_gpt2_sharded.py"),
+                "--dp", "4", "--mp", "2", "--tiny", "--steps", "2"],
+               tmp_path,
+               extra_env={"XLA_FLAGS":
+                          "--xla_force_host_platform_device_count=8"})
+    assert "step 1: loss" in out
