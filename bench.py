@@ -493,9 +493,10 @@ def bench_serve():
         t0 = time.perf_counter()
         eng.infer(one)
         lat.append((time.perf_counter() - t0) * 1000)
+    import math
     lat.sort()
     p50 = lat[len(lat) // 2]
-    p95 = lat[int(len(lat) * 0.95) - 1]
+    p95 = lat[max(0, math.ceil(0.95 * len(lat)) - 1)]
 
     # batched throughput: 8 concurrent clients, gather window on
     eng2 = inference.BatchingEngine(pred, max_batch_size=64,

@@ -52,14 +52,25 @@ def constrain(x, *axes):
         return x
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
-    data = x._data if isinstance(x, Tensor) else x
+
+    def _constrain(d):
+        return jax.lax.with_sharding_constraint(
+            d, NamedSharding(mesh, P(*axes)))
+
+    if not isinstance(x, Tensor):
+        try:
+            return _constrain(x)
+        except ValueError:
+            return x  # outside jit, incompatible placement: best-effort
     try:
-        out = jax.lax.with_sharding_constraint(
-            data, NamedSharding(mesh, P(*axes)))
+        # differentiable_apply threads the EAGER tape: a bare
+        # Tensor(out) here would sever grads for every constrain user
+        # (e.g. ShardedEmbedding trained in a plain eager loop on a
+        # multi-device mesh)
+        from .....autograd import differentiable_apply
+        return differentiable_apply(_constrain, x)
     except ValueError:
-        return x  # outside jit with incompatible placement: best-effort
-    return Tensor(out, stop_gradient=x.stop_gradient) \
-        if isinstance(x, Tensor) else out
+        return x
 
 
 class VocabParallelEmbedding(nn.Layer):

@@ -336,7 +336,8 @@ class ReduceLROnPlateau(Callback):
         current = self._current(logs)
         if current is None:
             return
-        if self.cooldown_counter > 0:
+        in_cooldown = self.cooldown_counter > 0
+        if in_cooldown:
             self.cooldown_counter -= 1
             self.wait = 0
         if self.best is None or self.monitor_op(
@@ -344,6 +345,8 @@ class ReduceLROnPlateau(Callback):
             self.best = current
             self.wait = 0
             return
+        if in_cooldown:
+            return            # frozen: stagnation doesn't count yet
         self.wait += 1
         if self.wait >= self.patience:
             opt = getattr(self.model, "_optimizer", None)

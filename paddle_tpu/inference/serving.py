@@ -87,13 +87,22 @@ class BatchingEngine:
                 r = self._queue.get_nowait()
             except queue.Empty:
                 break
-            if r is not None and not r.event.is_set():
+            if r is None:
+                if self._worker.is_alive():
+                    # a slow batch outlived the join timeout: the worker
+                    # still needs its shutdown sentinel — put it back
+                    self._queue.put(None)
+                    break
+                continue
+            if not r.event.is_set():
                 r.error = RuntimeError("BatchingEngine is closed")
                 r.event.set()
 
     # -- worker side -------------------------------------------------------
     def _gather(self) -> Optional[List[_Request]]:
         first = self._queue.get()
+        while first is not None and not self._valid(first):
+            first = self._queue.get()      # malformed: already failed
         if first is None:
             return None
         batch = [first]
@@ -113,16 +122,36 @@ class BatchingEngine:
             if nxt is None:
                 self._queue.put(None)   # re-post the close sentinel
                 break
+            if not self._valid(nxt):
+                continue
             batch.append(nxt)
             rows += nxt.arrays[0].shape[0]
         return batch
 
     @staticmethod
+    def _valid(req) -> bool:
+        """Fail malformed requests HERE instead of letting them raise in
+        the gather loop and kill the worker thread (which would hang
+        every subsequent caller forever)."""
+        if req.arrays and all(getattr(a, "ndim", 0) >= 1
+                              for a in req.arrays):
+            return True
+        req.error = ValueError(
+            "infer() needs at least one array, each with a leading "
+            "batch dimension")
+        req.event.set()
+        return False
+
+    @staticmethod
     def _bucket(n: int, cap: int) -> int:
+        """Next power of two >= n — ALWAYS a pow2, even above
+        max_batch_size, so oversize client batches land in O(log n)
+        compile buckets instead of one XLA compile per distinct row
+        count."""
         b = 1
         while b < n:
             b *= 2
-        return min(b, max(cap, n))
+        return b
 
     def _loop(self):
         while True:

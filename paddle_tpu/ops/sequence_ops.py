@@ -198,16 +198,22 @@ def _sequence_expand(x, ref_lengths, maxlen=None):
 @register_op("sequence_slice")
 def _sequence_slice(x, lengths, offset, length, maxlen=None):
     """Per-sequence slice: out[b, t] = x[b, offset[b] + t] for
-    t < length[b]. Reference: sequence_ops/sequence_slice_op.h. The
-    output time axis is ``maxlen`` (static; default: input maxlen)."""
+    t < length[b]. Reference: sequence_ops/sequence_slice_op.h aborts
+    when offset+length exceeds the sequence; data-dependent aborts can't
+    compile, so the jit-safe analog TRUNCATES the slice at each
+    sequence's valid end (no padding rows ever leak into the output).
+    The output time axis is ``maxlen`` (static; default: input maxlen)."""
     m = int(maxlen) if maxlen is not None else x.shape[1]
     off = jnp.asarray(offset).reshape(-1, 1)
     ln = jnp.asarray(length).reshape(-1, 1)
+    seq_ln = jnp.asarray(lengths).reshape(-1, 1)
+    # clamp: a slice may not extend past the sequence's valid prefix
+    eff = jnp.clip(jnp.minimum(ln, seq_ln - off), 0)
     t = jnp.arange(m)[None, :]
     src = jnp.clip(off + t, 0, x.shape[1] - 1).astype(jnp.int32)
     out = jnp.take_along_axis(
         x, src.reshape(src.shape + (1,) * (x.ndim - 2)), axis=1)
-    mask = _expand_mask(t < ln, out)
+    mask = _expand_mask(t < eff, out)
     return jnp.where(mask, out, jnp.zeros((), x.dtype))
 
 

@@ -232,8 +232,11 @@ def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
             f"{len(non_params)} non-parameter input(s). Use "
             "append_backward/fetch of @GRAD vars for parameters, or "
             "autograd.grad in dynamic mode for arbitrary inputs")
-    pairs = append_backward(targets[0], parameter_list=[p.name
-                                                       for p in inputs])
+    total = targets[0]
+    for extra in targets[1:]:
+        total = total + extra     # grad of sum == summed grads
+    pairs = append_backward(total, parameter_list=[p.name
+                                                   for p in inputs])
     by_param = {id(p): g for p, g in pairs}
     return [by_param.get(id(p)) for p in inputs]
 

@@ -39,23 +39,13 @@ _LAYERS: Dict[tuple, object] = {}
 fc_compat_registry = _LAYERS
 
 
-def _callsite():
-    """(filename, lineno) of the first frame outside this module — the
-    fluid unique-name analog: two UNNAMED builders at different source
-    lines get distinct parameters, while re-running the same build code
-    reuses one set."""
-    import sys
-    f = sys._getframe(2)
-    here = __file__
-    while f is not None and f.f_code.co_filename == here:
-        f = f.f_back
-    return (f.f_code.co_filename, f.f_lineno) if f is not None \
-        else ("<unknown>", 0)
-
-
 def _cached(key, factory, name=None):
+    """fluid unique_name semantics: an UNNAMED builder creates FRESH
+    parameters on every call (fluid increments fc_0, fc_1, ... even in
+    a Python loop over one source line); only an explicit ``name=``
+    shares a parameter set across calls."""
     if name is None:
-        key = key + _callsite()
+        return factory()
     layer = _LAYERS.get(key)
     if layer is None:
         layer = factory()
@@ -366,8 +356,9 @@ def crf_decoding(input, param_attr=None, label=None, length=None):
     from ...framework.tensor import Parameter, Tensor
     import jax.numpy as jnp
     n = int(input.shape[-1])
-    trans = _cached(("crf_transition", None, n),
-                    lambda: Parameter(jnp.zeros((n + 2, n), jnp.float32)))
+    trans = _cached(("crf_transition", "crfw", n),
+                    lambda: Parameter(jnp.zeros((n + 2, n), jnp.float32)),
+                    name="crfw")
     from ...text import viterbi_decode
     lengths = length if length is not None else Tensor(
         jnp.full((input.shape[0],), input.shape[1], jnp.int64))
@@ -468,10 +459,12 @@ def sequence_unpad(x, length, name=None):
 
 
 def sequence_expand(x, y, ref_level=-1, lengths=None, name=None):
-    """Dense form: repeat x's rows per y's (or explicit) lengths."""
+    """Dense form: repeat x's rows per y's (or explicit) lengths; the
+    static maxlen comes from y's time axis."""
     from ...nn import functional as F
+    maxlen = int(y.shape[1]) if len(y.shape) >= 2 else 1
     return F.sequence_expand(x, lengths if lengths is not None
-                             else _full_lengths(y))
+                             else _full_lengths(y), maxlen=maxlen)
 
 
 def sequence_expand_as(x, y, name=None):

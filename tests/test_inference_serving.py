@@ -119,3 +119,22 @@ class TestInertKnobsWarn:
         with warnings.catch_warnings():
             warnings.simplefilter("error")
             cfg.disable_gpu()     # already the TPU truth: no warning
+
+
+class TestEngineRobustness:
+    def test_malformed_request_fails_cleanly_engine_survives(self):
+        eng = BatchingEngine(_EchoPredictor(), max_delay_ms=0)
+        with pytest.raises(ValueError, match="batch dimension"):
+            eng.infer(np.float32(1.0))          # 0-d array
+        # the worker is still alive and serving
+        (out,) = eng.infer(np.ones((2, 2), "float32"))
+        np.testing.assert_allclose(out, 2.0)
+        eng.close()
+
+    def test_oversize_batches_use_pow2_buckets(self):
+        pred = _EchoPredictor()
+        eng = BatchingEngine(pred, max_batch_size=8, max_delay_ms=0)
+        for n in (33, 47):
+            eng.infer(np.ones((n, 2), "float32"))
+        eng.close()
+        assert pred.batches == [64, 64]   # one compile bucket, not two

@@ -328,3 +328,25 @@ class TestTensorMethodParity:
             paddle.to_tensor(np.ones((3, 1), "float32")))
         assert np.isfinite(out.numpy()).all()
         assert paddle.to_tensor(np.zeros(1, "float32")).is_tensor()
+
+
+class TestReduceLRCooldown:
+    def test_cooldown_freezes_reduction(self):
+        from paddle_tpu.callbacks import ReduceLROnPlateau
+        net = paddle.nn.Linear(2, 2)
+        model = paddle.Model(net)
+        opt = paddle.optimizer.SGD(learning_rate=1.0,
+                                   parameters=net.parameters())
+        model.prepare(opt, paddle.nn.CrossEntropyLoss())
+        cb = ReduceLROnPlateau(monitor="loss", factor=0.5, patience=1,
+                               cooldown=3, verbose=0)
+        cb.model = model
+        cb.on_train_begin()
+        cb.on_eval_end({"loss": 1.0})       # best
+        cb.on_eval_end({"loss": 1.0})       # stagnant -> reduce, cooldown
+        assert abs(float(opt.get_lr()) - 0.5) < 1e-6
+        for _ in range(3):                  # cooldown epochs: frozen
+            cb.on_eval_end({"loss": 1.0})
+        assert abs(float(opt.get_lr()) - 0.5) < 1e-6
+        cb.on_eval_end({"loss": 1.0})       # past cooldown -> reduce
+        assert abs(float(opt.get_lr()) - 0.25) < 1e-6

@@ -132,3 +132,21 @@ class TestShardedEmbedding:
         freq = emb.frequency()
         assert freq[0] == 0, "padding lookups must not pollute eviction"
         assert freq[3] == 2 and freq[5] == 1
+
+    def test_eager_training_on_mesh_threads_tape(self):
+        """constrain() must keep the eager tape intact: training a
+        sharded table in a PLAIN eager loop (no ParallelEngine) on a
+        multi-device mesh has to move the weight."""
+        _mesh()
+        paddle.framework.random.seed(5)
+        emb = ShardedEmbedding(64, 8)
+        opt = paddle.optimizer.SGD(learning_rate=0.5,
+                                   parameters=emb.parameters())
+        ids = paddle.to_tensor(np.array([[1, 2]], dtype="int64"))
+        w0 = np.asarray(emb.weight.numpy()).copy()
+        out = emb(ids)
+        loss = paddle.mean(paddle.square(out))
+        loss.backward()
+        assert emb.weight.grad is not None
+        opt.step()
+        assert not np.allclose(np.asarray(emb.weight.numpy()), w0)

@@ -240,3 +240,44 @@ class TestBuilderRecordingAndCaching:
         xs = paddle.to_tensor(rng.randn(2, 5, 4).astype("float32"))
         with pytest.raises(NotImplementedError, match="stride"):
             static.nn.sequence_conv(xs, 3, filter_stride=2)
+
+    def test_sequence_expand_builder_callable(self):
+        x = paddle.to_tensor(rng.randn(3, 4).astype("float32"))
+        y = paddle.to_tensor(rng.randn(3, 5, 4).astype("float32"))
+        out = static.nn.sequence_expand(x, y)
+        assert tuple(out.shape) == (3, 5, 4)
+
+    def test_unnamed_builders_in_loop_get_fresh_params(self):
+        """fluid unique_name: a loop over one source line creates a NEW
+        parameter set per iteration — sharing would silently train a
+        tied 'deep' net."""
+        x = paddle.to_tensor(rng.randn(1, 4, 8, 8).astype("float32"))
+        outs = []
+        for _ in range(2):
+            outs.append(static.nn.conv2d(x, 4, 3, padding=1))
+        assert not np.allclose(outs[0].numpy(), outs[1].numpy())
+
+    def test_gradients_multi_target_sums(self, static_mode):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [None, 2], "float32")
+            w = static.create_parameter([2, 1], "float32")
+            a = paddle.mean(paddle.matmul(x, w))
+            b = paddle.mean(paddle.matmul(x, w)) * 2.0
+            (g,) = static.gradients([a, b], [w])
+        xs = np.ones((4, 2), "float32")
+        (gv,) = static.Executor().run(main, feed={"x": xs},
+                                      fetch_list=[g])
+        np.testing.assert_allclose(gv, 3.0, rtol=1e-6)  # 1x + 2x
+
+    def test_sequence_slice_truncates_at_valid_end(self):
+        from paddle_tpu.nn import functional as F
+        x = paddle.to_tensor(np.arange(16, dtype="float32")
+                             .reshape(2, 8, 1))
+        lengths = paddle.to_tensor(np.array([3, 8]))
+        out = F.sequence_slice(x, lengths, np.array([2, 0]),
+                               np.array([4, 4]))
+        arr = np.asarray(out.numpy())
+        # row 0: only position 2 is valid (len 3, offset 2) -> 1 value
+        assert arr[0, 0, 0] == 2.0 and (arr[0, 1:] == 0).all()
+        np.testing.assert_allclose(arr[1, :4, 0], [8, 9, 10, 11])
