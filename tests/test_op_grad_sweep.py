@@ -99,3 +99,54 @@ def test_binary_tape_grad(name):
         numeric = _numeric(partial, ins[wrt].copy())
         np.testing.assert_allclose(analytic, numeric, rtol=2e-2,
                                    atol=2e-3, err_msg=f"{name} wrt {wrt}")
+
+
+_REDUCTIONS = [("sum", {}), ("mean", {}), ("max", {}), ("min", {}),
+               ("prod", {}), ("logsumexp", {}),
+               ("sum", {"axis": 1}), ("mean", {"axis": 0}),
+               ("max", {"axis": 1, "keepdim": True})]
+
+
+@pytest.mark.parametrize("name,kwargs", _REDUCTIONS,
+                         ids=[f"{n}-{k}" for n, k in _REDUCTIONS])
+def test_reduction_tape_grad(name, kwargs):
+    fn = getattr(paddle, name)
+    x_np = rng.rand(3, 4) + 0.5          # distinct values: max/min stable
+    x_np += np.arange(12).reshape(3, 4) * 0.01
+
+    def apply(t):
+        return fn(t, **kwargs)
+
+    t = paddle.to_tensor(x_np.astype("float64"), stop_gradient=False)
+    paddle.sum(apply(t)).backward()
+    analytic = np.asarray(t.grad.numpy())
+    numeric = _numeric(apply, x_np.copy())
+    np.testing.assert_allclose(analytic, numeric, rtol=2e-2, atol=2e-3)
+
+
+_SHAPE_OPS = [
+    ("transpose", lambda t: paddle.transpose(t, [1, 0])),
+    ("reshape", lambda t: paddle.reshape(t, [4, 3])),
+    ("flip", lambda t: paddle.flip(t, axis=[0])),
+    ("roll", lambda t: paddle.roll(t, shifts=1, axis=0)),
+    ("pad_like", lambda t: paddle.concat([t, t * 2.0], axis=0)),
+    ("split_first", lambda t: paddle.split(t, 2, axis=1)[0]),
+    ("gather", lambda t: paddle.gather(
+        t, paddle.to_tensor(np.array([2, 0])), axis=0)),
+    ("squeeze_unsqueeze", lambda t: paddle.squeeze(
+        paddle.unsqueeze(t, axis=0), axis=0)),
+    ("slice", lambda t: t[1:, :2]),
+    ("matmul_self", lambda t: paddle.matmul(t, paddle.transpose(t,
+                                                                [1, 0]))),
+]
+
+
+@pytest.mark.parametrize("name,apply", _SHAPE_OPS,
+                         ids=[n for n, _ in _SHAPE_OPS])
+def test_shape_op_tape_grad(name, apply):
+    x_np = rng.rand(3, 4) + 0.1
+    t = paddle.to_tensor(x_np.astype("float64"), stop_gradient=False)
+    paddle.sum(apply(t)).backward()
+    analytic = np.asarray(t.grad.numpy())
+    numeric = _numeric(apply, x_np.copy())
+    np.testing.assert_allclose(analytic, numeric, rtol=2e-2, atol=2e-3)
