@@ -1,0 +1,64 @@
+"""The migration story end to end: reference-format weights -> frozen
+backbone fine-tune -> inference export -> batched serving. One test
+spanning pretrained loading, parameter freezing, hapi fit, jit export,
+and the serve engine — the path a reference user walks on day one.
+"""
+import pickle
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import inference, jit
+from paddle_tpu.static import InputSpec
+from paddle_tpu.vision.models import resnet18
+
+
+def test_pretrained_finetune_export_serve(tmp_path):
+    rng = np.random.RandomState(0)
+
+    # 1. a "published" reference-format checkpoint (plain pickle)
+    paddle.framework.random.seed(1)
+    src = resnet18(num_classes=10)
+    ckpt = str(tmp_path / "resnet18.pdparams")
+    with open(ckpt, "wb") as f:
+        pickle.dump({k: np.asarray(v.numpy())
+                     for k, v in src.state_dict().items()}, f, protocol=2)
+
+    # 2. load it, swap the head, freeze the backbone
+    paddle.framework.random.seed(2)
+    net = resnet18(pretrained=ckpt, num_classes=10)
+    net.fc = paddle.nn.Linear(512, 3)            # new 3-class head
+    for name, p in net.named_parameters():
+        if not name.startswith("fc."):
+            p.stop_gradient = True
+    trainable = [p for p in net.parameters() if not p.stop_gradient]
+    assert len(trainable) == 2                   # fc weight + bias
+    backbone_before = net.conv1.weight.numpy().copy()
+
+    # 3. fine-tune the head on a separable toy task
+    x = rng.randn(24, 3, 32, 32).astype("float32")
+    y = rng.randint(0, 3, (24, 1)).astype("int64")
+    model = paddle.Model(net, inputs=[InputSpec([None, 3, 32, 32],
+                                                "float32", "img")])
+    model.prepare(paddle.optimizer.Adam(learning_rate=1e-2,
+                                        parameters=trainable),
+                  paddle.nn.CrossEntropyLoss())
+    l0 = model.train_batch([x], [y])
+    for _ in range(8):
+        l = model.train_batch([x], [y])
+    assert l < l0
+    np.testing.assert_array_equal(net.conv1.weight.numpy(),
+                                  backbone_before)   # frozen stayed put
+
+    # 4. export the fine-tuned model and serve it with batching
+    prefix = str(tmp_path / "deploy" / "m")
+    model.save(prefix, training=False)
+    pred = inference.create_predictor(inference.Config(prefix))
+    eng = inference.BatchingEngine(pred, max_batch_size=8,
+                                   max_delay_ms=0)
+    (served,) = eng.infer(x[:2])
+    eng.close()
+    net.eval()
+    np.testing.assert_allclose(served, net(paddle.to_tensor(x[:2]))
+                               .numpy(), rtol=1e-4, atol=1e-4)
