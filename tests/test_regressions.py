@@ -146,3 +146,49 @@ class TestDispatchCacheScalarAliasing:
         assert str(out.dtype) in ("int32", "paddle.int32"), out.dtype
         b = paddle.to_tensor(np.array([True, False]))
         assert "bool" in str((b == True).dtype)  # noqa: E712
+
+
+class TestRecomputeBackwardRegressions:
+    """r5 eager-tape rework (dispatch.py recompute-backward): paths with
+    nontrivial pullbacks must keep working through the jitted bwd."""
+
+    def test_eager_sdpa_backward(self):
+        from paddle_tpu.nn import functional as F
+        rng = np.random.RandomState(0)
+        q = paddle.to_tensor(rng.randn(1, 16, 2, 8).astype("float32"),
+                             stop_gradient=False)
+        out = F.scaled_dot_product_attention(q, q, q, is_causal=True)
+        paddle.mean(out).backward()
+        g = np.asarray(q.grad.numpy())
+        assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+    def test_eager_amp_o2_step(self):
+        from paddle_tpu import amp
+        rng = np.random.RandomState(0)
+        net = paddle.nn.Linear(8, 4)
+        amp.decorate(net, level="O2", dtype="bfloat16")
+        opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                     parameters=net.parameters(),
+                                     multi_precision=True)
+        x = paddle.to_tensor(rng.randn(4, 8).astype("float32"))
+        w0 = np.asarray(net.weight.numpy().astype("float32")).copy()
+        loss = paddle.mean(paddle.square(net(x)))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        assert not np.allclose(
+            np.asarray(net.weight.numpy().astype("float32")), w0)
+
+    def test_dropout_backward_mask_consistency(self):
+        """The recompute-bwd re-runs the forward inside its own jit; the
+        dropout mask must come from the SAME traced key so fwd and bwd
+        agree (zeroed positions get zero grad)."""
+        from paddle_tpu.nn import functional as F
+        paddle.seed(7)
+        x = paddle.to_tensor(np.ones((64,), "float32"),
+                             stop_gradient=False)
+        out = F.dropout(x, p=0.5, training=True)
+        paddle.sum(out).backward()
+        o = np.asarray(out.numpy())
+        g = np.asarray(x.grad.numpy())
+        np.testing.assert_array_equal(o == 0.0, g == 0.0)
