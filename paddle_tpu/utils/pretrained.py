@@ -93,6 +93,14 @@ def load_pdparams(path: str) -> dict:
         if isinstance(v, np.ndarray):
             out[str(k)] = v
         elif isinstance(v, dict):
+            if v.get("__bf16__") and isinstance(v.get("data"),
+                                                np.ndarray):
+                # this framework's own save() tags bfloat16 arrays as a
+                # uint16 view (framework/io.py) — decode under the
+                # ORIGINAL key, not a mangled "name.data"
+                import ml_dtypes
+                out[str(k)] = v["data"].view(ml_dtypes.bfloat16)
+                continue
             for kk, vv in v.items():
                 if isinstance(vv, np.ndarray):
                     out[f"{k}.{kk}"] = vv

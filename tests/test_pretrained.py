@@ -124,3 +124,25 @@ def test_resnext_variants_forward():
                  for p in resnet50(num_classes=10).parameters())
     # grouped convs cut 3x3 params: resnext50_32x4d ~= 25M vs resnet50 ~25.6M
     assert 0.8 < n_next / n_base < 1.1, (n_next, n_base)
+
+
+def test_own_bf16_checkpoint_loads_unmangled(tmp_path):
+    """A checkpoint saved by THIS framework with bf16 params (tagged
+    uint16 view, framework/io.py) must come back under the original
+    keys with bfloat16 values — not as mangled 'name.data' uint16."""
+    from paddle_tpu import amp
+    paddle.framework.random.seed(0)
+    net = paddle.nn.Linear(4, 2)
+    amp.decorate(net, level="O2", dtype="bfloat16")
+    path = str(tmp_path / "bf16.pdparams")
+    paddle.save(net.state_dict(), path)
+    raw = load_pdparams(path)
+    assert sorted(raw) == ["bias", "weight"]
+    assert str(raw["weight"].dtype) == "bfloat16"
+    # and it round-trips into a fresh decorated model
+    net2 = paddle.nn.Linear(4, 2)
+    amp.decorate(net2, level="O2", dtype="bfloat16")
+    net2.set_state_dict(convert_state_dict(raw, net2))
+    np.testing.assert_array_equal(
+        net2.weight.numpy().astype("float32"),
+        net.weight.numpy().astype("float32"))
