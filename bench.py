@@ -590,7 +590,9 @@ def _run_child(name: str, timeout: float, force_cpu: bool = False,
 
 # benches the headline should prefer, most-informative first; the RUN
 # order is cheapest-first so a driver timeout still leaves results behind
-_HEADLINE_PREF = ["gpt2", "resnet50", "bert", "lenet", "lenet_cpu_fallback"]
+_HEADLINE_PREF = ["gpt2", "resnet50", "bert", "lenet",
+                  "gpt2_cpu_fallback", "bert_cpu_fallback",
+                  "lenet_cpu_fallback"]
 
 
 def _emit(results):
@@ -686,13 +688,21 @@ def main():
             else:
                 results["lenet_tpu_attempt"] = tpu_try  # driver-visible
     if probe is None:
-        # backend unusable: record the forced-CPU smoke number and stop —
-        # every heavy bench would hang the same way the probe did.
-        cpu = _run_child("lenet", timeout=max(120.0, child_timeout()),
-                         force_cpu=True)
-        if "error" not in cpu:
-            cpu["metric"] += "_cpu_fallback"
-            results["lenet_cpu_fallback"] = cpu
+        # backend unusable: every heavy bench would hang the way the
+        # probe did. Record forced-CPU smoke numbers for SEVERAL benches
+        # (not just lenet) so the round still shows the full stack
+        # executing — engine, transformer models, serve path — even
+        # when the TPU relay is down (observed down for 7+ hours
+        # mid-round 5).
+        for name in ("lenet", "bert", "gpt2", "serve", "eager"):
+            if remaining() < 60:
+                break
+            cpu = _run_child(name, timeout=min(240.0, remaining() - 20),
+                             force_cpu=True)
+            if "error" not in cpu:
+                cpu["metric"] += "_cpu_fallback"
+                results[f"{name}_cpu_fallback"] = cpu
+                _emit(results)
         _emit(results)
         return
 
