@@ -1,0 +1,194 @@
+"""Autoregressive generation, compiled once — the TPU serving decode path.
+
+Design (TPU-first):
+- The whole generate loop (prefill + ``lax.while_loop`` over decode steps)
+  is ONE jitted XLA program. The KV cache is preallocated at
+  ``[B, prompt+max_new, H, D]`` per layer and written with
+  ``dynamic_update_slice`` — shapes never change, so there is exactly one
+  compile per (batch, prompt_len, max_new, sampling-mode) class.
+  Temperature is a traced scalar: changing it never recompiles.
+- Early exit: the while_loop condition stops as soon as every sequence
+  has emitted EOS — unlike a fixed-length scan, short answers don't pay
+  for the full budget.
+- Sampling (greedy / temperature / top-k / top-p) runs on-device with
+  ``jax.random.categorical``; no host round-trip per token.
+
+Reference analog: the reference serves decoder LMs through
+fused_multi_transformer's fixed-capacity CacheKV
+(paddle/fluid/operators/fused/fused_multi_transformer_op.cu:1) driven by
+a Python sampling loop; here the loop itself is compiled.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from ..framework.tensor import Tensor, no_grad_guard
+
+__all__ = ["GenerationConfig", "generate"]
+
+
+@dataclass
+class GenerationConfig:
+    max_new_tokens: int = 32
+    do_sample: bool = False
+    temperature: float = 1.0
+    top_k: int = 0            # 0 = disabled
+    top_p: float = 1.0        # 1.0 = disabled
+    eos_token_id: Optional[int] = None
+    pad_token_id: int = 0
+    seed: Optional[int] = None
+
+
+def _pick_token(logits, key, do_sample, top_k, top_p, temperature):
+    """logits: jnp [B, V] f32 -> jnp [B] int32. top_k/top_p are static
+    (part of the compile key); temperature is traced."""
+    import jax
+    import jax.numpy as jnp
+    if not do_sample:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / jnp.maximum(temperature, 1e-6)
+    if top_k and top_k > 0:
+        kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p < 1.0:
+        sort_idx = jnp.argsort(-logits, axis=-1)
+        sorted_logits = jnp.take_along_axis(logits, sort_idx, axis=-1)
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum_excl = jnp.cumsum(probs, axis=-1) - probs
+        keep_sorted = cum_excl < top_p          # always keeps the top-1
+        inv = jnp.argsort(sort_idx, axis=-1)
+        keep = jnp.take_along_axis(keep_sorted, inv, axis=-1)
+        logits = jnp.where(keep, logits, -jnp.inf)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
+def _build_generate_fn(model, batch, prompt_len, static_key):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ..nn.layer.layers import functional_state
+
+    (max_new, do_sample, top_k, top_p, eos, pad) = static_key
+    gpt = model.gpt if hasattr(model, "gpt") else model
+    if max_new < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new}")
+    total_len = prompt_len + max_new
+    if total_len > gpt.cfg.max_position_embeddings:
+        raise ValueError(
+            f"prompt_len+max_new_tokens={total_len} exceeds "
+            f"max_position_embeddings={gpt.cfg.max_position_embeddings}")
+
+    def fn(params, buffers, ids, key, temperature):
+        with functional_state(model, params, buffers):
+            with no_grad_guard():
+                dtype = params[next(iter(params))].dtype
+                caches = gpt.init_cache(batch, total_len, dtype)
+                hidden, caches = gpt.prefill(
+                    Tensor(ids, stop_gradient=True), caches)
+                logits = gpt.logits(hidden)._data[:, 0].astype(jnp.float32)
+                key, sub = jax.random.split(key)
+                first = _pick_token(logits, sub, do_sample, top_k, top_p,
+                                    temperature)
+                finished = ((first == eos) if eos is not None
+                            else jnp.zeros((batch,), bool))
+                tokens = jnp.concatenate(
+                    [ids.astype(jnp.int32),
+                     jnp.full((batch, max_new), pad, jnp.int32)], axis=1)
+                tokens = lax.dynamic_update_slice(
+                    tokens, first[:, None], (jnp.int32(0), jnp.int32(prompt_len)))
+
+                def cond(state):
+                    tokens, caches, pos, finished, key = state
+                    return (pos < total_len - 1) & ~jnp.all(finished)
+
+                def body(state):
+                    tokens, caches, pos, finished, key = state
+                    z = jnp.int32(0)
+                    tok = lax.dynamic_slice(tokens, (z, pos), (batch, 1))
+                    hidden, caches = gpt.decode_step(
+                        Tensor(tok, stop_gradient=True), caches, pos)
+                    logits = gpt.logits(hidden)._data[:, 0].astype(
+                        jnp.float32)
+                    key, sub = jax.random.split(key)
+                    nxt = _pick_token(logits, sub, do_sample, top_k, top_p,
+                                      temperature)
+                    if eos is not None:
+                        nxt = jnp.where(finished, pad, nxt)
+                        finished = finished | (nxt == eos)
+                    tokens = lax.dynamic_update_slice(
+                        tokens, nxt[:, None], (z, pos + 1))
+                    return tokens, caches, pos + 1, finished, key
+
+                state = (tokens, caches, jnp.int32(prompt_len), finished,
+                         key)
+                tokens = lax.while_loop(cond, body, state)[0]
+        return tokens
+
+    return jax.jit(fn)
+
+
+def generate(model, input_ids, max_new_tokens=32, do_sample=False,
+             temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
+             pad_token_id=0, seed=None, config=None):
+    """Generate ``max_new_tokens`` continuations of ``input_ids`` [B, S].
+
+    Returns a Tensor [B, S+max_new_tokens]; positions after an
+    ``eos_token_id`` are filled with ``pad_token_id``. Prompts are assumed
+    uniform-length (pad + mask-free — the standard batched-serve shape
+    class; ragged prompts should be bucketed by the caller, see
+    io.BucketedBatchSampler). A ``GenerationConfig`` may be passed as
+    ``config=`` instead of the individual kwargs.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..nn.layer.layers import get_buffers_tree
+
+    if config is not None:
+        max_new_tokens = config.max_new_tokens
+        do_sample = config.do_sample
+        temperature = config.temperature
+        top_k = config.top_k
+        top_p = config.top_p
+        eos_token_id = config.eos_token_id
+        pad_token_id = config.pad_token_id
+        seed = config.seed
+
+    ids = input_ids._data if isinstance(input_ids, Tensor) else \
+        jnp.asarray(np.asarray(input_ids))
+    if ids.ndim == 1:
+        ids = ids[None, :]
+    batch, prompt_len = ids.shape
+    static_key = (int(max_new_tokens), bool(do_sample), int(top_k),
+                  float(top_p),
+                  None if eos_token_id is None else int(eos_token_id),
+                  int(pad_token_id))
+    cache = getattr(model, "_generate_fns", None)
+    if cache is None:
+        cache = model._generate_fns = {}
+    fn_key = (batch, prompt_len) + static_key
+    if fn_key not in cache:
+        cache[fn_key] = _build_generate_fn(model, batch, prompt_len,
+                                           static_key)
+    was_training = model.training
+    model.eval()
+    try:
+        params = {k: p._data for k, p in model.named_parameters()}
+        buffers = get_buffers_tree(model)
+        if seed is None:
+            # fresh draw per call, controlled by paddle.seed(): an unseeded
+            # sampling loop must not return identical "samples" every call
+            from ..framework import random as _random
+            key = _random.next_key()
+        else:
+            key = jax.random.PRNGKey(int(seed))
+        out = cache[fn_key](params, buffers, ids, key,
+                            jnp.float32(temperature))
+    finally:
+        if was_training:
+            model.train()
+    return Tensor(out, stop_gradient=True)

@@ -62,29 +62,77 @@ class GPTBlock(nn.Layer):
         self.mlp_proj = nn.Linear(cfg.intermediate_size, h)
         self.dropout = nn.Dropout(cfg.hidden_dropout_prob)
 
-    def forward(self, x, cache=None):
-        # attention with implicit causal masking
+    def _qkv(self, x):
+        """ln_1 + split-head q/k/v projections (shared by train/serve)."""
         h = self.ln_1(x)
         q = self.attn._split_heads(self.attn.q_proj(h))
-        if cache is not None:
-            k = self.attn._split_heads(self.attn.k_proj(h))
-            v = self.attn._split_heads(self.attn.v_proj(h))
-            k = call_op("concat", [cache.k, k], axis=1)
-            v = call_op("concat", [cache.v, v], axis=1)
-            cache = nn.MultiHeadAttention.Cache(k, v)
-        else:
-            k = self.attn._split_heads(self.attn.k_proj(h))
-            v = self.attn._split_heads(self.attn.v_proj(h))
-        a = F.scaled_dot_product_attention(
-            q, k, v, is_causal=True,
-            dropout_p=self.attn.dropout if self.training else 0.0,
-            training=self.training)
+        k = self.attn._split_heads(self.attn.k_proj(h))
+        v = self.attn._split_heads(self.attn.v_proj(h))
+        return q, k, v
+
+    def _tail(self, x, a):
+        """out-proj + residual + MLP half of the block (shared)."""
         a = self.attn.out_proj(self.attn._merge_heads(a))
         x = x + self.dropout(a)
         m = self.mlp_proj(F.gelu(self.mlp_fc(self.ln_2(x)),
                                  approximate=True))
-        x = x + self.dropout(m)
+        return x + self.dropout(m)
+
+    def forward(self, x, cache=None):
+        # attention with implicit causal masking
+        q, k, v = self._qkv(x)
+        if cache is not None:
+            k = call_op("concat", [cache.k, k], axis=1)
+            v = call_op("concat", [cache.v, v], axis=1)
+            cache = nn.MultiHeadAttention.Cache(k, v)
+        a = F.scaled_dot_product_attention(
+            q, k, v, is_causal=True,
+            dropout_p=self.attn.dropout if self.training else 0.0,
+            training=self.training)
+        x = self._tail(x, a)
         return x if cache is None else (x, cache)
+
+    # -- static-cache decode path (serving) ---------------------------------
+    # The concat cache above grows the seq axis every step, so each decode
+    # step is a NEW XLA program — fine eagerly, fatal under jit.  These two
+    # methods keep the cache at a FIXED [B, max_len, H, D] shape and write
+    # into it with dynamic_update_slice, so the whole generate loop compiles
+    # once (reference analog: the fixed-capacity CacheKV of
+    # paddle/fluid/operators/fused/fused_multi_transformer_op.cu:1).
+    def prefill(self, x, cache_k, cache_v):
+        """Process the whole prompt; write its K/V into the cache at [0:S).
+
+        x: [B, S, E]; cache_k/v: jnp [B, max_len, H, D] (zeros). Returns
+        (hidden, cache_k, cache_v) with caches as raw jnp arrays.
+        """
+        from jax import lax
+        q, k, v = self._qkv(x)
+        cache_k = lax.dynamic_update_slice(
+            cache_k, k._data.astype(cache_k.dtype), (0, 0, 0, 0))
+        cache_v = lax.dynamic_update_slice(
+            cache_v, v._data.astype(cache_v.dtype), (0, 0, 0, 0))
+        a = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        return self._tail(x, a), cache_k, cache_v
+
+    def decode_step(self, x, cache_k, cache_v, pos):
+        """One token: x [B, 1, E], pos scalar (traced) — attend over the
+        first pos+1 cache rows. Cache shapes never change."""
+        import jax.numpy as jnp
+        from jax import lax
+        q, k, v = self._qkv(x)
+        z = jnp.int32(0)
+        pos = jnp.asarray(pos, jnp.int32)
+        cache_k = lax.dynamic_update_slice(
+            cache_k, k._data.astype(cache_k.dtype), (z, pos, z, z))
+        cache_v = lax.dynamic_update_slice(
+            cache_v, v._data.astype(cache_v.dtype), (z, pos, z, z))
+        # valid-position mask, broadcast over [B, H, q=1, max_len]
+        max_len = cache_k.shape[1]
+        mask = (jnp.arange(max_len) <= pos)[None, None, None, :]
+        a = F.scaled_dot_product_attention(
+            q, Tensor(cache_k, stop_gradient=True),
+            Tensor(cache_v, stop_gradient=True), attn_mask=Tensor(mask))
+        return self._tail(x, a), cache_k, cache_v
 
 
 class GPTModel(nn.Layer):
@@ -117,6 +165,45 @@ class GPTModel(nn.Layer):
         """LM head tied to wte (matmul against the embedding table)."""
         return call_op("matmul", hidden, self.wte.weight, transpose_y=True)
 
+    # -- static-cache decode path (serving) ---------------------------------
+    def init_cache(self, batch, max_len, dtype):
+        """Preallocate per-layer K/V buffers: tuple of (k, v) jnp arrays,
+        each [B, max_len, num_heads, head_dim]."""
+        import jax.numpy as jnp
+        cfg = self.cfg
+        hd = cfg.hidden_size // cfg.num_attention_heads
+        shape = (batch, max_len, cfg.num_attention_heads, hd)
+        return tuple(
+            (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+            for _ in range(cfg.num_hidden_layers))
+
+    def prefill(self, input_ids, caches):
+        """Run the prompt through all blocks, filling `caches` in place
+        (functionally). Returns (last-position hidden [B, 1, E], caches)."""
+        import jax.numpy as jnp
+        seq = input_ids.shape[1]
+        position_ids = Tensor(jnp.arange(seq, dtype=jnp.int32)[None, :])
+        x = self.wte(input_ids) + self.wpe(position_ids)
+        new_caches = []
+        for block, (ck, cv) in zip(self.blocks, caches):
+            x, ck, cv = block.prefill(x, ck, cv)
+            new_caches.append((ck, cv))
+        x = self.ln_f(x)
+        last = call_op("slice", x, axes=[1], starts=[seq - 1], ends=[seq])
+        return last, tuple(new_caches)
+
+    def decode_step(self, token_ids, caches, pos):
+        """One decode step: token_ids [B, 1], pos scalar (may be traced).
+        Returns (hidden [B, 1, E], caches)."""
+        import jax.numpy as jnp
+        pos_ids = Tensor(jnp.full((1, 1), pos, dtype=jnp.int32))
+        x = self.wte(token_ids) + self.wpe(pos_ids)
+        new_caches = []
+        for block, (ck, cv) in zip(self.blocks, caches):
+            x, ck, cv = block.decode_step(x, ck, cv, pos)
+            new_caches.append((ck, cv))
+        return self.ln_f(x), tuple(new_caches)
+
 
 class GPTForPretraining(nn.Layer):
     def __init__(self, cfg: GPTConfig):
@@ -133,3 +220,9 @@ class GPTForPretraining(nn.Layer):
             call_op("reshape", labels, shape=(-1,)),
             reduction="mean")
         return loss, logits
+
+    def generate(self, input_ids, **kwargs):
+        """Compiled static-cache autoregressive decode; see
+        models.generation.generate."""
+        from .generation import generate
+        return generate(self, input_ids, **kwargs)

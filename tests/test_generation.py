@@ -1,0 +1,156 @@
+"""Compiled autoregressive generation (static KV cache, models/generation.py).
+
+The decode loop is one jitted XLA program over a fixed-shape cache; these
+tests pin its semantics against the eager concat-cache path (reference
+analog: fused_multi_transformer's fixed-capacity CacheKV decode,
+paddle/fluid/operators/fused/fused_multi_transformer_op.cu:1).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.framework.tensor import Tensor
+from paddle_tpu.models import GPTConfig, GPTForPretraining, generate
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    paddle.seed(7)
+    m = GPTForPretraining(GPTConfig.tiny())
+    m.eval()
+    return m
+
+
+def _prompt(batch=2, length=8):
+    return np.arange(1, 1 + length, dtype=np.int32)[None, :].repeat(
+        batch, axis=0)
+
+
+def _eager_greedy(model, ids, steps):
+    """Step-by-step greedy decode through the ordinary forward (full
+    recompute each step) — the semantics oracle."""
+    import jax.numpy as jnp
+    cur = jnp.asarray(ids)
+    for _ in range(steps):
+        logits = model(Tensor(cur))._data
+        nxt = jnp.argmax(logits[:, -1].astype(jnp.float32),
+                         axis=-1).astype(jnp.int32)
+        cur = jnp.concatenate([cur, nxt[:, None]], axis=1)
+    return np.asarray(cur)
+
+
+def test_greedy_matches_eager_full_recompute(tiny_model):
+    ids = _prompt()
+    out = generate(tiny_model, ids, max_new_tokens=6)
+    ref = _eager_greedy(tiny_model, ids, 6)
+    assert tuple(out.shape) == (2, 14)
+    np.testing.assert_array_equal(out.numpy(), ref)
+
+
+def test_prompt_is_preserved(tiny_model):
+    ids = _prompt()
+    out = generate(tiny_model, ids, max_new_tokens=3).numpy()
+    np.testing.assert_array_equal(out[:, :8], ids)
+
+
+def test_eos_early_stop_pads_tail(tiny_model):
+    ids = _prompt()
+    first = int(generate(tiny_model, ids, max_new_tokens=1).numpy()[0, 8])
+    out = generate(tiny_model, ids, max_new_tokens=6,
+                   eos_token_id=first, pad_token_id=99).numpy()
+    # greedy emits `first` immediately -> everything after is pad
+    assert out[0, 8] == first
+    np.testing.assert_array_equal(out[:, 9:], np.full((2, 5), 99))
+
+
+def test_sampling_deterministic_by_seed(tiny_model):
+    ids = _prompt()
+    kw = dict(max_new_tokens=5, do_sample=True, top_k=8, temperature=0.9)
+    a = generate(tiny_model, ids, seed=3, **kw).numpy()
+    b = generate(tiny_model, ids, seed=3, **kw).numpy()
+    c = generate(tiny_model, ids, seed=4, **kw).numpy()
+    np.testing.assert_array_equal(a, b)
+    assert not np.array_equal(a, c)  # different seed, different draw
+
+
+def test_top_k_restricts_support(tiny_model):
+    """Every sampled first token must be inside the top-k of the prompt's
+    next-token logits."""
+    import jax.numpy as jnp
+    ids = _prompt(batch=1)
+    logits = tiny_model(Tensor(jnp.asarray(ids)))._data[0, -1]
+    topk_set = set(np.argsort(-np.asarray(
+        logits, dtype=np.float32))[:4].tolist())
+    for seed in range(5):
+        out = generate(tiny_model, ids, max_new_tokens=1, do_sample=True,
+                       top_k=4, seed=seed).numpy()
+        assert int(out[0, 8]) in topk_set
+
+
+def test_top_p_restricts_support(tiny_model):
+    """Every sampled first token must be inside the nucleus (smallest set
+    of tokens whose cumulative probability reaches top_p)."""
+    import jax.numpy as jnp
+    ids = _prompt(batch=1)
+    logits = np.asarray(tiny_model(Tensor(jnp.asarray(ids)))._data[0, -1],
+                        dtype=np.float64)
+    probs = np.exp(logits - logits.max())
+    probs /= probs.sum()
+    order = np.argsort(-probs)
+    cum_excl = np.cumsum(probs[order]) - probs[order]
+    nucleus = set(order[cum_excl < 0.5].tolist())
+    for seed in range(5):
+        out = generate(tiny_model, ids, max_new_tokens=1, do_sample=True,
+                       top_p=0.5, seed=seed).numpy()
+        assert int(out[0, 8]) in nucleus
+
+
+def test_generation_config_object(tiny_model):
+    from paddle_tpu.models import GenerationConfig
+    ids = _prompt()
+    cfg = GenerationConfig(max_new_tokens=4, do_sample=True, top_k=8,
+                           temperature=0.9, seed=3)
+    a = generate(tiny_model, ids, config=cfg).numpy()
+    b = generate(tiny_model, ids, max_new_tokens=4, do_sample=True, top_k=8,
+                 temperature=0.9, seed=3).numpy()
+    np.testing.assert_array_equal(a, b)
+
+
+def test_temperature_change_does_not_recompile(tiny_model):
+    ids = _prompt()
+    generate(tiny_model, ids, max_new_tokens=4, do_sample=True, seed=0,
+             temperature=1.0)
+    n = len(tiny_model._generate_fns)
+    generate(tiny_model, ids, max_new_tokens=4, do_sample=True, seed=0,
+             temperature=0.3)
+    assert len(tiny_model._generate_fns) == n  # traced scalar, same program
+
+
+def test_budget_exceeding_positions_raises(tiny_model):
+    ids = _prompt(length=60)  # tiny cfg: max_position_embeddings=64
+    with pytest.raises(ValueError, match="max_position_embeddings"):
+        generate(tiny_model, ids, max_new_tokens=10)
+
+
+def test_zero_new_tokens_raises(tiny_model):
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        generate(tiny_model, _prompt(), max_new_tokens=0)
+
+
+def test_unseeded_sampling_varies_across_calls(tiny_model):
+    ids = _prompt()
+    kw = dict(max_new_tokens=8, do_sample=True, temperature=1.5)
+    a = generate(tiny_model, ids, **kw).numpy()
+    b = generate(tiny_model, ids, **kw).numpy()
+    assert not np.array_equal(a, b)  # fresh key per unseeded call
+
+
+def test_model_method_and_training_mode_restored(tiny_model):
+    tiny_model.train()
+    try:
+        ids = _prompt()
+        out = tiny_model.generate(ids, max_new_tokens=2)
+        assert tuple(out.shape) == (2, 10)
+        assert tiny_model.training  # generate() must restore train mode
+    finally:
+        tiny_model.eval()
