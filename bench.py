@@ -524,6 +524,52 @@ def bench_serve():
             "clients": 8, "device_kind": _device_kind(), **pallas_state}
 
 
+def bench_gpt2_decode():
+    """GPT-2 124M autoregressive decode (serving): tokens/sec through the
+    compiled static-KV-cache generate loop (models/generation.py — prefill
+    + lax.while_loop in ONE XLA program, bf16 params). Greedy with no EOS
+    so every run does the full token budget: deterministic work, honest
+    tokens/s. Reference analog: fused_multi_transformer decode serving
+    (paddle/fluid/operators/fused/fused_multi_transformer_op.cu:1)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import amp
+    from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining
+
+    pallas_state = _setup_pallas()
+    if _smoke():
+        cfg, batch, prompt, new = GPTConfig.tiny(), 2, 8, 8
+    else:
+        cfg, batch, prompt, new = GPTConfig.gpt2_small(), 8, 128, 128
+    cfg.hidden_dropout_prob = 0.0
+    cfg.attention_dropout_prob = 0.0
+    paddle.framework.random.seed(0)
+    model = GPTForPretraining(cfg)
+    amp.decorate(model, level="O2", dtype="bfloat16")  # bf16 weights+cache
+    model.eval()
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (batch, prompt)).astype(np.int32)
+
+    t0 = time.perf_counter()
+    out = model.generate(ids, max_new_tokens=new)
+    out.numpy()  # value barrier: compile + first run
+    t_compile = time.perf_counter() - t0
+    reps = 1 if _smoke() else 3
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = model.generate(ids, max_new_tokens=new)
+    last = out.numpy()  # the final tokens bound the whole queued chain
+    dt = time.perf_counter() - t0
+    assert last.shape == (batch, prompt + new)
+    tokens_per_sec = batch * new * reps / dt
+    return {"metric": "gpt2_124m_decode_tokens_per_sec_1chip",
+            "value": round(tokens_per_sec, 1), "unit": "tokens/sec",
+            "batch": batch, "prompt_len": prompt, "new_tokens": new,
+            "dtype": "bf16", "compile_sec": round(t_compile, 1),
+            "ms_per_token_per_seq": round(1000.0 * dt / (reps * new), 2),
+            "device_kind": _device_kind(), **pallas_state}
+
+
 def bench_probe():
     """Backend health probe: bare jax (no framework import), one tiny
     matmul on the real backend. Healthy backend: seconds. The parent
@@ -550,6 +596,7 @@ BENCHES = {"gpt2": bench_gpt2, "resnet50": bench_resnet50,
            "gpt2_fp32": lambda: bench_gpt2(amp_o2=False),
            "resnet50_pipeline": bench_resnet50_pipeline,
            "eager": bench_eager, "serve": bench_serve,
+           "gpt2_decode": bench_gpt2_decode,
            "probe": bench_probe}
 
 
@@ -694,7 +741,8 @@ def main():
         # executing — engine, transformer models, serve path — even
         # when the TPU relay is down (observed down for 7+ hours
         # mid-round 5).
-        for name in ("lenet", "bert", "gpt2", "serve", "eager"):
+        for name in ("lenet", "bert", "gpt2", "serve", "eager",
+                     "gpt2_decode"):
             if remaining() < 60:
                 break
             cpu = _run_child(name, timeout=min(240.0, remaining() - 20),
@@ -766,6 +814,12 @@ def main():
         extra = _run_child("serve", timeout=min(180.0, child_timeout()))
         if "error" not in extra:
             results["serve"] = extra
+            _emit(results)
+    if remaining() > 90:
+        # compiled static-cache decode throughput (serving headline)
+        extra = _run_child("gpt2_decode", timeout=child_timeout())
+        if "error" not in extra:
+            results["gpt2_decode"] = extra
             _emit(results)
     if not _smoke():
         for name in ("gpt2", "bert"):

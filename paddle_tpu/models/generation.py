@@ -149,6 +149,18 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
     from ..nn.layer.layers import get_buffers_tree
 
     if config is not None:
+        explicit = {k: v for k, v in [
+            ("max_new_tokens", max_new_tokens != 32),
+            ("do_sample", do_sample is not False),
+            ("temperature", temperature != 1.0),
+            ("top_k", top_k != 0), ("top_p", top_p != 1.0),
+            ("eos_token_id", eos_token_id is not None),
+            ("pad_token_id", pad_token_id != 0),
+            ("seed", seed is not None)] if v}
+        if explicit:
+            raise ValueError(
+                f"pass either config= or individual kwargs, not both "
+                f"(got config plus {sorted(explicit)})")
         max_new_tokens = config.max_new_tokens
         do_sample = config.do_sample
         temperature = config.temperature
@@ -179,11 +191,19 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
     try:
         params = {k: p._data for k, p in model.named_parameters()}
         buffers = get_buffers_tree(model)
-        if seed is None:
+        if not do_sample:
+            # greedy never consumes the key; a fixed one avoids advancing
+            # the global generator (would desync seed-pinned experiments)
+            key = jax.random.PRNGKey(0)
+        elif seed is None:
             # fresh draw per call, controlled by paddle.seed(): an unseeded
             # sampling loop must not return identical "samples" every call
             from ..framework import random as _random
             key = _random.next_key()
+            if jnp.issubdtype(key.dtype, jax.dtypes.prng_key):
+                # normalize new-style typed keys to the legacy uint32 form
+                # so seeded and unseeded calls share ONE compiled program
+                key = jax.random.key_data(key)
         else:
             key = jax.random.PRNGKey(int(seed))
         out = cache[fn_key](params, buffers, ids, key,

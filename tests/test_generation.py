@@ -145,6 +145,38 @@ def test_unseeded_sampling_varies_across_calls(tiny_model):
     assert not np.array_equal(a, b)  # fresh key per unseeded call
 
 
+def test_greedy_does_not_advance_global_rng(tiny_model):
+    """A deterministic greedy decode interleaved with a seed-pinned
+    experiment must not desynchronize it."""
+    import jax
+    from paddle_tpu.framework import random as _random
+    paddle.seed(123)
+    k1 = np.asarray(jax.random.key_data(_random.next_key()))
+    paddle.seed(123)
+    generate(tiny_model, _prompt(), max_new_tokens=2)
+    k2 = np.asarray(jax.random.key_data(_random.next_key()))
+    np.testing.assert_array_equal(k1, k2)
+
+
+def test_seeded_and_unseeded_share_one_compile(tiny_model):
+    """Legacy/typed key mismatch would silently retrace the whole decode
+    program; both paths must feed the same abstract key type."""
+    ids = _prompt(batch=3)
+    kw = dict(max_new_tokens=3, do_sample=True)
+    generate(tiny_model, ids, seed=5, **kw)
+    fn = tiny_model._generate_fns[(3, 8, 3, True, 0, 1.0, None, 0)]
+    n = fn._cache_size()
+    generate(tiny_model, ids, **kw)  # unseeded -> framework next_key()
+    assert fn._cache_size() == n
+
+
+def test_config_plus_explicit_kwargs_raises(tiny_model):
+    from paddle_tpu.models import GenerationConfig
+    cfg = GenerationConfig(max_new_tokens=4, do_sample=True)
+    with pytest.raises(ValueError, match="not both"):
+        generate(tiny_model, _prompt(), config=cfg, temperature=0.2)
+
+
 def test_model_method_and_training_mode_restored(tiny_model):
     tiny_model.train()
     try:
