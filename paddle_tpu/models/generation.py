@@ -76,6 +76,13 @@ def _build_generate_fn(model, batch, prompt_len, static_key):
     gpt = model.gpt if hasattr(model, "gpt") else model
     if max_new < 1:
         raise ValueError(f"max_new_tokens must be >= 1, got {max_new}")
+    if not 0.0 < top_p <= 1.0:
+        # top_p=0 would mask EVERY logit to -inf and categorical would
+        # silently emit token 0 each step
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    if top_k < 0:
+        raise ValueError(f"top_k must be >= 0, got {top_k}")
+    top_k = min(top_k, gpt.cfg.vocab_size)  # lax.top_k caps at vocab
     total_len = prompt_len + max_new
     if total_len > gpt.cfg.max_position_embeddings:
         raise ValueError(

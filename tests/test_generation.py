@@ -137,6 +137,17 @@ def test_zero_new_tokens_raises(tiny_model):
         generate(tiny_model, _prompt(), max_new_tokens=0)
 
 
+def test_bad_top_p_raises_and_overlarge_top_k_clamps(tiny_model):
+    with pytest.raises(ValueError, match="top_p"):
+        generate(tiny_model, _prompt(), max_new_tokens=1, do_sample=True,
+                 top_p=0.0)
+    # top_k beyond the vocab must clamp (== plain temperature sampling),
+    # not explode inside the jitted trace
+    out = generate(tiny_model, _prompt(), max_new_tokens=2, do_sample=True,
+                   top_k=10_000, seed=0)
+    assert tuple(out.shape) == (2, 10)
+
+
 def test_unseeded_sampling_varies_across_calls(tiny_model):
     ids = _prompt()
     kw = dict(max_new_tokens=8, do_sample=True, temperature=1.5)
