@@ -40,6 +40,8 @@ class GenerationConfig:
     eos_token_id: Optional[int] = None
     pad_token_id: int = 0
     seed: Optional[int] = None
+    num_beams: int = 1        # >1 = deterministic beam search
+    length_penalty: float = 0.0   # GNMT ((5+len)/6)^alpha; 0 = off
 
 
 def _pick_token(logits, key, do_sample, top_k, top_p, temperature):
@@ -138,9 +140,121 @@ def _build_generate_fn(model, batch, prompt_len, static_key):
     return jax.jit(fn)
 
 
+def _build_beam_fn(model, batch, prompt_len, static_key):
+    """Batched beam search, compiled: beams live as a flattened [B*K]
+    batch so the SAME decode_step program serves both strategies; each
+    step reorders the KV cache by beam parent with one gather. Finished
+    beams stay in the pool with frozen scores (only the pad continuation
+    is allowed, at logprob 0). Reference analog:
+    python/paddle/nn/decode.py BeamSearchDecoder semantics (tile_beam /
+    gather_tree), rebuilt as one XLA program."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ..nn.layer.layers import functional_state
+
+    (max_new, num_beams, eos, pad, length_penalty) = static_key
+    gpt = model.gpt if hasattr(model, "gpt") else model
+    K = num_beams
+    vocab = gpt.cfg.vocab_size
+    if max_new < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new}")
+    if not 2 <= K <= vocab:
+        raise ValueError(f"num_beams must be in [2, vocab], got {K}")
+    total_len = prompt_len + max_new
+    if total_len > gpt.cfg.max_position_embeddings:
+        raise ValueError(
+            f"prompt_len+max_new_tokens={total_len} exceeds "
+            f"max_position_embeddings={gpt.cfg.max_position_embeddings}")
+
+    def lp(length):
+        # GNMT length penalty ((5+len)/6)^alpha; alpha=0 -> pure logprob
+        if length_penalty == 0.0:
+            return jnp.ones_like(length, jnp.float32)
+        return ((5.0 + length.astype(jnp.float32)) / 6.0) ** length_penalty
+
+    def fn(params, buffers, ids):
+        with functional_state(model, params, buffers):
+            with no_grad_guard():
+                dtype = params[next(iter(params))].dtype
+                z = jnp.int32(0)
+                # prefill once at [B], then tile the caches to [B*K]
+                caches = gpt.init_cache(batch, total_len, dtype)
+                hidden, caches = gpt.prefill(
+                    Tensor(ids, stop_gradient=True), caches)
+                logp0 = jax.nn.log_softmax(
+                    gpt.logits(hidden)._data[:, 0].astype(jnp.float32))
+                scores, first = lax.top_k(logp0, K)        # [B, K]
+                first = first.astype(jnp.int32)
+                caches = tuple(
+                    (jnp.repeat(ck, K, axis=0), jnp.repeat(cv, K, axis=0))
+                    for ck, cv in caches)
+                tokens = jnp.concatenate(
+                    [ids.astype(jnp.int32),
+                     jnp.full((batch, max_new), pad, jnp.int32)], axis=1)
+                tokens = jnp.repeat(tokens[:, None, :], K, axis=1)
+                tokens = lax.dynamic_update_slice(
+                    tokens, first[:, :, None], (z, z, jnp.int32(prompt_len)))
+                finished = (first == eos) if eos is not None else \
+                    jnp.zeros((batch, K), bool)
+                gen_len = jnp.ones((batch, K), jnp.int32)
+                # one-hot pad row at -inf elsewhere: the only allowed
+                # continuation of a finished beam, contributing logprob 0
+                pad_row = jnp.where(jnp.arange(vocab) == pad, 0.0,
+                                    -jnp.inf)[None, None, :]
+                barange = jnp.arange(batch, dtype=jnp.int32)[:, None] * K
+
+                def cond(state):
+                    tokens, caches, scores, finished, gen_len, pos = state
+                    return (pos < total_len - 1) & ~jnp.all(finished)
+
+                def body(state):
+                    tokens, caches, scores, finished, gen_len, pos = state
+                    tok = lax.dynamic_slice(
+                        tokens, (z, z, pos), (batch, K, 1)).reshape(
+                            batch * K, 1)
+                    hidden, caches = gpt.decode_step(
+                        Tensor(tok, stop_gradient=True), caches, pos)
+                    logp = jax.nn.log_softmax(
+                        gpt.logits(hidden)._data[:, 0].astype(jnp.float32)
+                    ).reshape(batch, K, vocab)
+                    allowed = jnp.where(finished[:, :, None], pad_row, logp)
+                    cand = (scores[:, :, None] + allowed).reshape(
+                        batch, K * vocab)
+                    scores, idx = lax.top_k(cand, K)       # [B, K]
+                    parent = (idx // vocab).astype(jnp.int32)
+                    nxt = (idx % vocab).astype(jnp.int32)
+                    # reorder beam state by parent
+                    tokens = jnp.take_along_axis(
+                        tokens, parent[:, :, None], axis=1)
+                    finished = jnp.take_along_axis(finished, parent, axis=1)
+                    gen_len = jnp.take_along_axis(gen_len, parent, axis=1)
+                    fp = (barange + parent).reshape(-1)
+                    caches = tuple((ck[fp], cv[fp]) for ck, cv in caches)
+                    tokens = lax.dynamic_update_slice(
+                        tokens, nxt[:, :, None], (z, z, pos + 1))
+                    gen_len = gen_len + (~finished).astype(jnp.int32)
+                    if eos is not None:
+                        finished = finished | (nxt == eos)
+                    return tokens, caches, scores, finished, gen_len, pos + 1
+
+                state = (tokens, caches, scores, finished, gen_len,
+                         jnp.int32(prompt_len))
+                tokens, _, scores, _, gen_len, _ = lax.while_loop(
+                    cond, body, state)
+                best = jnp.argmax(scores / lp(gen_len), axis=1)   # [B]
+                out = jnp.take_along_axis(
+                    tokens, best[:, None, None], axis=1)[:, 0]
+        return out
+
+    return jax.jit(fn)
+
+
 def generate(model, input_ids, max_new_tokens=32, do_sample=False,
              temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
-             pad_token_id=0, seed=None, config=None):
+             pad_token_id=0, seed=None, num_beams=1, length_penalty=0.0,
+             config=None):
     """Generate ``max_new_tokens`` continuations of ``input_ids`` [B, S].
 
     Returns a Tensor [B, S+max_new_tokens]; positions after an
@@ -148,7 +262,9 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
     uniform-length (pad + mask-free — the standard batched-serve shape
     class; ragged prompts should be bucketed by the caller, see
     io.BucketedBatchSampler). A ``GenerationConfig`` may be passed as
-    ``config=`` instead of the individual kwargs.
+    ``config=`` instead of the individual kwargs. ``num_beams > 1``
+    selects compiled beam search (deterministic; ``length_penalty`` is
+    the GNMT alpha applied at final selection).
     """
     import jax
     import jax.numpy as jnp
@@ -163,7 +279,9 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
             ("top_k", top_k != 0), ("top_p", top_p != 1.0),
             ("eos_token_id", eos_token_id is not None),
             ("pad_token_id", pad_token_id != 0),
-            ("seed", seed is not None)] if v}
+            ("seed", seed is not None),
+            ("num_beams", num_beams != 1),
+            ("length_penalty", length_penalty != 0.0)] if v}
         if explicit:
             raise ValueError(
                 f"pass either config= or individual kwargs, not both "
@@ -176,45 +294,76 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
         eos_token_id = config.eos_token_id
         pad_token_id = config.pad_token_id
         seed = config.seed
+        num_beams = config.num_beams
+        length_penalty = config.length_penalty
+
+    if num_beams < 1:
+        raise ValueError(f"num_beams must be >= 1, got {num_beams}")
+    if num_beams > 1:
+        if do_sample:
+            raise ValueError("num_beams > 1 requires do_sample=False "
+                             "(deterministic beam search)")
+        ignored = [n for n, c in (("temperature", temperature != 1.0),
+                                  ("top_k", top_k != 0),
+                                  ("top_p", top_p != 1.0),
+                                  ("seed", seed is not None)) if c]
+        if ignored:
+            raise ValueError(f"{ignored} have no effect with "
+                             f"num_beams > 1 (beam search is deterministic)")
+    elif length_penalty != 0.0:
+        raise ValueError("length_penalty requires num_beams > 1")
 
     ids = input_ids._data if isinstance(input_ids, Tensor) else \
         jnp.asarray(np.asarray(input_ids))
     if ids.ndim == 1:
         ids = ids[None, :]
     batch, prompt_len = ids.shape
-    static_key = (int(max_new_tokens), bool(do_sample), int(top_k),
-                  float(top_p),
-                  None if eos_token_id is None else int(eos_token_id),
-                  int(pad_token_id))
+    if num_beams > 1:
+        static_key = ("beam", int(max_new_tokens), int(num_beams),
+                      None if eos_token_id is None else int(eos_token_id),
+                      int(pad_token_id), float(length_penalty))
+        builder = _build_beam_fn
+    else:
+        static_key = (int(max_new_tokens), bool(do_sample), int(top_k),
+                      float(top_p),
+                      None if eos_token_id is None else int(eos_token_id),
+                      int(pad_token_id))
+        builder = _build_generate_fn
     cache = getattr(model, "_generate_fns", None)
     if cache is None:
         cache = model._generate_fns = {}
     fn_key = (batch, prompt_len) + static_key
     if fn_key not in cache:
-        cache[fn_key] = _build_generate_fn(model, batch, prompt_len,
-                                           static_key)
+        cache[fn_key] = builder(
+            model, batch, prompt_len,
+            static_key[1:] if num_beams > 1 else static_key)
     was_training = model.training
     model.eval()
     try:
         params = {k: p._data for k, p in model.named_parameters()}
         buffers = get_buffers_tree(model)
-        if not do_sample:
-            # greedy never consumes the key; a fixed one avoids advancing
-            # the global generator (would desync seed-pinned experiments)
-            key = jax.random.PRNGKey(0)
-        elif seed is None:
-            # fresh draw per call, controlled by paddle.seed(): an unseeded
-            # sampling loop must not return identical "samples" every call
-            from ..framework import random as _random
-            key = _random.next_key()
-            if jnp.issubdtype(key.dtype, jax.dtypes.prng_key):
-                # normalize new-style typed keys to the legacy uint32 form
-                # so seeded and unseeded calls share ONE compiled program
-                key = jax.random.key_data(key)
+        if num_beams > 1:
+            out = cache[fn_key](params, buffers, ids)
         else:
-            key = jax.random.PRNGKey(int(seed))
-        out = cache[fn_key](params, buffers, ids, key,
-                            jnp.float32(temperature))
+            if not do_sample:
+                # greedy never consumes the key; a fixed one avoids
+                # advancing the global generator (would desync seed-pinned
+                # experiments)
+                key = jax.random.PRNGKey(0)
+            elif seed is None:
+                # fresh draw per call, controlled by paddle.seed(): an
+                # unseeded sampling loop must not return identical
+                # "samples" every call
+                from ..framework import random as _random
+                key = _random.next_key()
+                if jnp.issubdtype(key.dtype, jax.dtypes.prng_key):
+                    # normalize new-style typed keys to the legacy uint32
+                    # form so seeded and unseeded calls share ONE program
+                    key = jax.random.key_data(key)
+            else:
+                key = jax.random.PRNGKey(int(seed))
+            out = cache[fn_key](params, buffers, ids, key,
+                                jnp.float32(temperature))
     finally:
         if was_training:
             model.train()
