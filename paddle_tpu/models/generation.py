@@ -74,7 +74,7 @@ def _build_generate_fn(model, batch, prompt_len, static_key):
 
     from ..nn.layer.layers import functional_state
 
-    (max_new, do_sample, top_k, top_p, eos, pad) = static_key
+    (max_new, do_sample, top_k, top_p, eos, pad, has_mask) = static_key
     gpt = model.gpt if hasattr(model, "gpt") else model
     if max_new < 1:
         raise ValueError(f"max_new_tokens must be >= 1, got {max_new}")
@@ -91,13 +91,27 @@ def _build_generate_fn(model, batch, prompt_len, static_key):
             f"prompt_len+max_new_tokens={total_len} exceeds "
             f"max_position_embeddings={gpt.cfg.max_position_embeddings}")
 
-    def fn(params, buffers, ids, key, temperature):
+    def fn(params, buffers, ids, key, temperature, attn_mask):
         with functional_state(model, params, buffers):
             with no_grad_guard():
                 dtype = params[next(iter(params))].dtype
+                z = jnp.int32(0)
                 caches = gpt.init_cache(batch, total_len, dtype)
+                if has_mask:
+                    # ragged (left-padded) prompts: pads are masked out of
+                    # attention forever; logical positions count only real
+                    # tokens, so each example decodes at real_len + t
+                    key_valid = jnp.concatenate(
+                        [attn_mask.astype(bool),
+                         jnp.zeros((batch, max_new), bool)], axis=1)
+                    real_len = attn_mask.astype(jnp.int32).sum(
+                        axis=1, keepdims=True)                 # [B, 1]
+                else:
+                    key_valid, real_len = None, None
                 hidden, caches = gpt.prefill(
-                    Tensor(ids, stop_gradient=True), caches)
+                    Tensor(ids, stop_gradient=True), caches,
+                    key_valid=None if key_valid is None
+                    else key_valid[:, :prompt_len])
                 logits = gpt.logits(hidden)._data[:, 0].astype(jnp.float32)
                 key, sub = jax.random.split(key)
                 first = _pick_token(logits, sub, do_sample, top_k, top_p,
@@ -108,7 +122,7 @@ def _build_generate_fn(model, batch, prompt_len, static_key):
                     [ids.astype(jnp.int32),
                      jnp.full((batch, max_new), pad, jnp.int32)], axis=1)
                 tokens = lax.dynamic_update_slice(
-                    tokens, first[:, None], (jnp.int32(0), jnp.int32(prompt_len)))
+                    tokens, first[:, None], (z, jnp.int32(prompt_len)))
 
                 def cond(state):
                     tokens, caches, pos, finished, key = state
@@ -116,10 +130,19 @@ def _build_generate_fn(model, batch, prompt_len, static_key):
 
                 def body(state):
                     tokens, caches, pos, finished, key = state
-                    z = jnp.int32(0)
                     tok = lax.dynamic_slice(tokens, (z, pos), (batch, 1))
+                    if has_mask:
+                        # every generated slot [prompt_len, pos] is valid
+                        # for all examples; prompt slots keep their mask
+                        r = jnp.arange(total_len)
+                        kv = key_valid | (
+                            (r >= prompt_len) & (r <= pos))[None, :]
+                        positions = real_len + (pos - prompt_len)  # [B, 1]
+                    else:
+                        kv, positions = None, None
                     hidden, caches = gpt.decode_step(
-                        Tensor(tok, stop_gradient=True), caches, pos)
+                        Tensor(tok, stop_gradient=True), caches, pos,
+                        key_valid=kv, positions=positions)
                     logits = gpt.logits(hidden)._data[:, 0].astype(
                         jnp.float32)
                     key, sub = jax.random.split(key)
@@ -254,17 +277,19 @@ def _build_beam_fn(model, batch, prompt_len, static_key):
 def generate(model, input_ids, max_new_tokens=32, do_sample=False,
              temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
              pad_token_id=0, seed=None, num_beams=1, length_penalty=0.0,
-             config=None):
+             attention_mask=None, config=None):
     """Generate ``max_new_tokens`` continuations of ``input_ids`` [B, S].
 
     Returns a Tensor [B, S+max_new_tokens]; positions after an
-    ``eos_token_id`` are filled with ``pad_token_id``. Prompts are assumed
-    uniform-length (pad + mask-free — the standard batched-serve shape
-    class; ragged prompts should be bucketed by the caller, see
-    io.BucketedBatchSampler). A ``GenerationConfig`` may be passed as
-    ``config=`` instead of the individual kwargs. ``num_beams > 1``
-    selects compiled beam search (deterministic; ``length_penalty`` is
-    the GNMT alpha applied at final selection).
+    ``eos_token_id`` are filled with ``pad_token_id``. Ragged prompts are
+    supported via ``attention_mask`` [B, S] (1 = real token, 0 = pad):
+    prompts must be LEFT-padded so the last column is each example's
+    final real token; pads are invisible to attention and position
+    embeddings (each example decodes at its own logical positions). A
+    ``GenerationConfig`` may be passed as ``config=`` instead of the
+    individual kwargs. ``num_beams > 1`` selects compiled beam search
+    (deterministic; ``length_penalty`` is the GNMT alpha applied at final
+    selection; ragged masks not yet supported there).
     """
     import jax
     import jax.numpy as jnp
@@ -318,6 +343,27 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
     if ids.ndim == 1:
         ids = ids[None, :]
     batch, prompt_len = ids.shape
+    mask = None
+    if attention_mask is not None:
+        m = attention_mask._data if isinstance(attention_mask, Tensor) \
+            else np.asarray(attention_mask)
+        m = np.asarray(m)
+        if m.shape != (batch, prompt_len):
+            raise ValueError(
+                f"attention_mask shape {m.shape} != input_ids shape "
+                f"{(batch, prompt_len)}")
+        # decode logits come from the LAST prompt column, so real tokens
+        # must be right-aligned (left padding, the batched-serve layout)
+        if (np.diff(m.astype(np.int8), axis=1) < 0).any():
+            raise ValueError(
+                "attention_mask must be left-padded (0s then 1s per row)")
+        if (m.sum(axis=1) < 1).any():
+            raise ValueError("attention_mask has an all-pad row")
+        if not m.all():  # an all-ones mask is just the uniform path
+            mask = jnp.asarray(m.astype(np.int32))
+        if num_beams > 1 and mask is not None:
+            raise ValueError(
+                "attention_mask with num_beams > 1 is not supported yet")
     if num_beams > 1:
         static_key = ("beam", int(max_new_tokens), int(num_beams),
                       None if eos_token_id is None else int(eos_token_id),
@@ -327,7 +373,7 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
         static_key = (int(max_new_tokens), bool(do_sample), int(top_k),
                       float(top_p),
                       None if eos_token_id is None else int(eos_token_id),
-                      int(pad_token_id))
+                      int(pad_token_id), mask is not None)
         builder = _build_generate_fn
     cache = getattr(model, "_generate_fns", None)
     if cache is None:
@@ -363,7 +409,8 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
             else:
                 key = jax.random.PRNGKey(int(seed))
             out = cache[fn_key](params, buffers, ids, key,
-                                jnp.float32(temperature))
+                                jnp.float32(temperature),
+                                jnp.int32(0) if mask is None else mask)
     finally:
         if was_training:
             model.train()

@@ -99,11 +99,13 @@ class GPTBlock(nn.Layer):
     # into it with dynamic_update_slice, so the whole generate loop compiles
     # once (reference analog: the fixed-capacity CacheKV of
     # paddle/fluid/operators/fused/fused_multi_transformer_op.cu:1).
-    def prefill(self, x, cache_k, cache_v):
+    def prefill(self, x, cache_k, cache_v, key_valid=None):
         """Process the whole prompt; write its K/V into the cache at [0:S).
 
-        x: [B, S, E]; cache_k/v: jnp [B, max_len, H, D] (zeros). Returns
-        (hidden, cache_k, cache_v) with caches as raw jnp arrays.
+        x: [B, S, E]; cache_k/v: jnp [B, max_len, H, D] (zeros);
+        key_valid: optional jnp bool [B, S] — False marks left-pad
+        positions no query may attend to. Returns (hidden, cache_k,
+        cache_v) with caches as raw jnp arrays.
         """
         from jax import lax
         q, k, v = self._qkv(x)
@@ -111,12 +113,17 @@ class GPTBlock(nn.Layer):
             cache_k, k._data.astype(cache_k.dtype), (0, 0, 0, 0))
         cache_v = lax.dynamic_update_slice(
             cache_v, v._data.astype(cache_v.dtype), (0, 0, 0, 0))
-        a = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        mask = None if key_valid is None else \
+            Tensor(key_valid[:, None, None, :])  # [B, 1(h), 1(q), S]
+        a = F.scaled_dot_product_attention(q, k, v, is_causal=True,
+                                           attn_mask=mask)
         return self._tail(x, a), cache_k, cache_v
 
-    def decode_step(self, x, cache_k, cache_v, pos):
+    def decode_step(self, x, cache_k, cache_v, pos, key_valid=None):
         """One token: x [B, 1, E], pos scalar (traced) — attend over the
-        first pos+1 cache rows. Cache shapes never change."""
+        first pos+1 cache rows (or the rows marked True in key_valid
+        [B, max_len] when prompts are ragged/left-padded). Cache shapes
+        never change."""
         import jax.numpy as jnp
         from jax import lax
         q, k, v = self._qkv(x)
@@ -128,7 +135,10 @@ class GPTBlock(nn.Layer):
             cache_v, v._data.astype(cache_v.dtype), (z, pos, z, z))
         # valid-position mask, broadcast over [B, H, q=1, max_len]
         max_len = cache_k.shape[1]
-        mask = (jnp.arange(max_len) <= pos)[None, None, None, :]
+        if key_valid is None:
+            mask = (jnp.arange(max_len) <= pos)[None, None, None, :]
+        else:
+            mask = key_valid[:, None, None, :]
         a = F.scaled_dot_product_attention(
             q, Tensor(cache_k, stop_gradient=True),
             Tensor(cache_v, stop_gradient=True), attn_mask=Tensor(mask))
@@ -177,30 +187,45 @@ class GPTModel(nn.Layer):
             (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
             for _ in range(cfg.num_hidden_layers))
 
-    def prefill(self, input_ids, caches):
+    def prefill(self, input_ids, caches, key_valid=None):
         """Run the prompt through all blocks, filling `caches` in place
-        (functionally). Returns (last-position hidden [B, 1, E], caches)."""
+        (functionally). key_valid: optional jnp bool [B, S] marking real
+        (non-left-pad) prompt positions; position embeddings then count
+        only real tokens per example. Returns (last-position hidden
+        [B, 1, E], caches)."""
         import jax.numpy as jnp
         seq = input_ids.shape[1]
-        position_ids = Tensor(jnp.arange(seq, dtype=jnp.int32)[None, :])
+        if key_valid is None:
+            position_ids = Tensor(jnp.arange(seq, dtype=jnp.int32)[None, :])
+        else:
+            # left-padded: pads get position 0, reals count 0,1,2,...
+            position_ids = Tensor(jnp.maximum(
+                jnp.cumsum(key_valid.astype(jnp.int32), axis=1) - 1, 0))
         x = self.wte(input_ids) + self.wpe(position_ids)
         new_caches = []
         for block, (ck, cv) in zip(self.blocks, caches):
-            x, ck, cv = block.prefill(x, ck, cv)
+            x, ck, cv = block.prefill(x, ck, cv, key_valid=key_valid)
             new_caches.append((ck, cv))
         x = self.ln_f(x)
         last = call_op("slice", x, axes=[1], starts=[seq - 1], ends=[seq])
         return last, tuple(new_caches)
 
-    def decode_step(self, token_ids, caches, pos):
+    def decode_step(self, token_ids, caches, pos, key_valid=None,
+                    positions=None):
         """One decode step: token_ids [B, 1], pos scalar (may be traced).
-        Returns (hidden [B, 1, E], caches)."""
+        positions: optional per-example LOGICAL positions [B, 1] (ragged
+        prompts — the cache slot `pos` is shared but position embeddings
+        differ per example). Returns (hidden [B, 1, E], caches)."""
         import jax.numpy as jnp
-        pos_ids = Tensor(jnp.full((1, 1), pos, dtype=jnp.int32))
+        if positions is None:
+            pos_ids = Tensor(jnp.full((1, 1), pos, dtype=jnp.int32))
+        else:
+            pos_ids = Tensor(positions.astype(jnp.int32))
         x = self.wte(token_ids) + self.wpe(pos_ids)
         new_caches = []
         for block, (ck, cv) in zip(self.blocks, caches):
-            x, ck, cv = block.decode_step(x, ck, cv, pos)
+            x, ck, cv = block.decode_step(x, ck, cv, pos,
+                                          key_valid=key_valid)
             new_caches.append((ck, cv))
         return self.ln_f(x), tuple(new_caches)
 

@@ -175,7 +175,7 @@ def test_seeded_and_unseeded_share_one_compile(tiny_model):
     ids = _prompt(batch=3)
     kw = dict(max_new_tokens=3, do_sample=True)
     generate(tiny_model, ids, seed=5, **kw)
-    fn = tiny_model._generate_fns[(3, 8, 3, True, 0, 1.0, None, 0)]
+    fn = tiny_model._generate_fns[(3, 8, 3, True, 0, 1.0, None, 0, False)]
     n = fn._cache_size()
     generate(tiny_model, ids, **kw)  # unseeded -> framework next_key()
     assert fn._cache_size() == n
@@ -186,6 +186,50 @@ def test_config_plus_explicit_kwargs_raises(tiny_model):
     cfg = GenerationConfig(max_new_tokens=4, do_sample=True)
     with pytest.raises(ValueError, match="not both"):
         generate(tiny_model, _prompt(), config=cfg, temperature=0.2)
+
+
+def test_ragged_prompts_match_per_example_decode(tiny_model):
+    """Left-padded batch: every example's greedy continuation must equal
+    its OWN unpadded single-example decode — pads must be invisible to
+    attention and to position embeddings."""
+    lens = [5, 8, 3]
+    P = 8
+    rng = np.random.RandomState(9)
+    rows, mask = [], []
+    prompts = [rng.randint(1, 200, (n,)).astype(np.int32) for n in lens]
+    for p in prompts:
+        rows.append(np.concatenate([np.zeros(P - len(p), np.int32), p]))
+        mask.append(np.concatenate([np.zeros(P - len(p), np.int32),
+                                    np.ones(len(p), np.int32)]))
+    ids = np.stack(rows)
+    out = generate(tiny_model, ids, max_new_tokens=6,
+                   attention_mask=np.stack(mask)).numpy()
+    for i, p in enumerate(prompts):
+        solo = generate(tiny_model, p[None, :], max_new_tokens=6).numpy()
+        np.testing.assert_array_equal(out[i, P:], solo[0, len(p):],
+                                      err_msg=f"example {i} len {len(p)}")
+
+
+def test_all_ones_mask_equals_no_mask(tiny_model):
+    ids = _prompt()
+    a = generate(tiny_model, ids, max_new_tokens=4).numpy()
+    b = generate(tiny_model, ids, max_new_tokens=4,
+                 attention_mask=np.ones_like(ids)).numpy()
+    np.testing.assert_array_equal(a, b)
+
+
+def test_bad_attention_masks_raise(tiny_model):
+    ids = _prompt()
+    with pytest.raises(ValueError, match="left-padded"):
+        generate(tiny_model, ids, max_new_tokens=2,
+                 attention_mask=np.array([[1, 1, 1, 1, 0, 0, 1, 1],
+                                          [1, 1, 1, 1, 1, 1, 1, 1]]))
+    with pytest.raises(ValueError, match="all-pad"):
+        generate(tiny_model, ids, max_new_tokens=2,
+                 attention_mask=np.array([[0] * 8, [1] * 8]))
+    with pytest.raises(ValueError, match="shape"):
+        generate(tiny_model, ids, max_new_tokens=2,
+                 attention_mask=np.ones((2, 4), np.int32))
 
 
 def test_model_method_and_training_mode_restored(tiny_model):
