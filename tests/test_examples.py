@@ -39,6 +39,13 @@ def test_serve_example(tmp_path):
     assert "16 concurrent requests" in out
 
 
+def test_generate_text_example(tmp_path):
+    out = _run([os.path.join(REPO, "examples", "generate_text.py")],
+               tmp_path, timeout=600)
+    assert "ragged left-padded batch" in out
+    assert "beam k=4" in out
+
+
 def test_gpt2_sharded_example(tmp_path):
     out = _run([os.path.join(REPO, "examples", "train_gpt2_sharded.py"),
                 "--dp", "4", "--mp", "2", "--tiny", "--steps", "2"],
