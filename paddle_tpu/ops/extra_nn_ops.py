@@ -18,7 +18,8 @@ import jax.numpy as jnp
 from jax import lax
 
 from .registry import register_op
-from .nn_ops import _norm_tuple, _conv_padding, _adaptive_pool
+from .nn_ops import (_norm_tuple, _conv_padding, _adaptive_pool,
+                     _transpose_str_pads)
 
 
 # ---------------------------------------------------------------------------
@@ -35,7 +36,11 @@ def _conv_transpose_nd(x, w, bias, stride, padding, output_padding, dilation,
     dil = _norm_tuple(dilation, nd)
     opad = _norm_tuple(output_padding, nd)
     if isinstance(pads, str):
-        raise NotImplementedError("string padding for conv_transpose")
+        spatial = x.shape[2:2 + nd] if data_format.startswith("NC") \
+            else x.shape[1:1 + nd]
+        if pads.upper() == "SAME":
+            dil = (1,) * nd  # reference forces dilation=1 under SAME
+        pads = _transpose_str_pads(pads, spatial, w.shape[2:], strides)
     ch_axis = 1 if data_format.startswith("NC") else x.ndim - 1
     if groups != 1:
         xs = jnp.split(x, groups, axis=ch_axis)

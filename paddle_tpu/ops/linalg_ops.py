@@ -222,9 +222,94 @@ def _bincount(x, weights=None, minlength=0):
     return jnp.bincount(x, weights=weights, minlength=int(minlength))
 
 
+def _mnms_iou(boxes, normalized):
+    """Pairwise IoU [m, m] for [m, 4] xyxy boxes. Unnormalized boxes count
+    inclusive pixels (+1), so touching integer boxes share a 1-pixel strip;
+    overlap is zero only on strict separation per axis — the reference's
+    JaccardOverlap convention (paddle/fluid/operators/detection/nms_util.h:71)."""
+    import numpy as np
+    off = 0.0 if normalized else 1.0
+    lt = np.maximum(boxes[:, None, :2], boxes[None, :, :2])
+    rb = np.minimum(boxes[:, None, 2:], boxes[None, :, 2:])
+    separated = (lt > rb).any(-1)
+    wh = np.clip(rb - lt + off, 0.0, None)
+    inter = np.where(separated, 0.0, wh[..., 0] * wh[..., 1])
+    area = np.prod(boxes[:, 2:] - boxes[:, :2] + off, axis=1)
+    return inter / (area[:, None] + area[None, :] - inter + 1e-10)
+
+
 @register_op("matrix_nms", nondiff=True, jit=False)
-def _unavailable(*a, **k):
-    raise NotImplementedError("matrix_nms pending detection-op milestone")
+def _matrix_nms(bboxes, scores, score_threshold=0.0, post_threshold=0.0,
+                nms_top_k=-1, keep_top_k=-1, use_gaussian=False,
+                gaussian_sigma=2.0, background_label=0, normalized=True):
+    """Matrix NMS (SOLOv2): instead of hard suppression, every candidate's
+    score decays by min_i f(iou_i,j)/f(comp_i) over all higher-scored boxes
+    i, where comp_i is box i's own max-IoU with anything above it —
+    entirely matrix arithmetic, no sequential suppression loop. Reference:
+    paddle/fluid/operators/detection/matrix_nms_op.cc:1,
+    python/paddle/fluid/layers/detection.py:3573 (API contract).
+
+    bboxes [N, M, 4] xyxy, scores [N, C, M]. Returns (out [No, 6] rows of
+    [label, score, x1, y1, x2, y2] sorted per image by decayed score,
+    index [No, 1] absolute box indices n*M + m, rois_num [N]).
+    Host-side numpy: the output count is data-dependent (jit=False, like
+    bincount)."""
+    import numpy as np
+    B = np.asarray(bboxes)
+    S = np.asarray(scores)
+    N, M, _ = B.shape
+    C = S.shape[1]
+    dtype = S.dtype if S.dtype in (np.float32, np.float64) else np.float32
+    det_rows, det_idx, rois_num = [], [], []
+    for n in range(N):
+        cls_l, score_l, box_l, idx_l = [], [], [], []
+        for c in range(C):
+            if c == background_label:
+                continue
+            sc = S[n, c]
+            cand = np.where(sc > score_threshold)[0]
+            if cand.size == 0:
+                continue
+            order = cand[np.argsort(-sc[cand], kind="stable")]
+            if 0 <= nms_top_k < order.size:
+                order = order[:nms_top_k]
+            iou = np.triu(_mnms_iou(B[n, order], normalized), k=1)
+            comp = iou.max(axis=0)          # box i's max IoU with its betters
+            if use_gaussian:
+                decay = np.exp((comp[:, None] ** 2 - iou ** 2)
+                               * gaussian_sigma)
+            else:
+                decay = (1.0 - iou) / (1.0 - comp[:, None])
+            new_sc = sc[order] * decay.min(axis=0)
+            # unconditional, like the reference kernel: even at
+            # post_threshold=0 a fully-decayed (0.0) box is dropped
+            keep = np.where(new_sc > post_threshold)[0]
+            cls_l.append(np.full(keep.size, c, dtype))
+            score_l.append(new_sc[keep].astype(dtype))
+            box_l.append(B[n, order[keep]].astype(dtype))
+            idx_l.append(n * M + order[keep])
+        if cls_l:
+            cls_a = np.concatenate(cls_l)
+            score_a = np.concatenate(score_l)
+            box_a = np.concatenate(box_l)
+            idx_a = np.concatenate(idx_l)
+            top = np.argsort(-score_a, kind="stable")
+            if 0 <= keep_top_k < top.size:
+                top = top[:keep_top_k]
+            det_rows.append(np.concatenate(
+                [cls_a[top, None], score_a[top, None], box_a[top]], axis=1))
+            det_idx.append(idx_a[top])
+            rois_num.append(top.size)
+        else:
+            rois_num.append(0)
+    if det_rows:
+        out = np.concatenate(det_rows).astype(dtype)
+        index = np.concatenate(det_idx).astype(np.int64)[:, None]
+    else:
+        out = np.zeros((0, 6), dtype)
+        index = np.zeros((0, 1), np.int64)
+    return (jnp.asarray(out), jnp.asarray(index),
+            jnp.asarray(np.asarray(rois_num, np.int32)))
 
 @register_op("cond")
 def _cond(x, p=None):

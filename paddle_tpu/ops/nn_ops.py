@@ -301,6 +301,22 @@ def _convnd(x, w, bias, stride, padding, dilation, groups, data_format, nd):
     return out
 
 
+def _transpose_str_pads(s, in_sizes, ksizes, strides):
+    """Explicit pads for conv_transpose string padding, matching the
+    reference's UpdatePaddingAndDilation (phi/kernels/cpu/conv_util.h:50):
+    VALID = no pad; SAME computes per-dim
+    pad_sum = max((ceil(in/stride)-1)*stride + k - in, 0) from the INPUT
+    size, split left-light. The caller must also force dilation to 1
+    under SAME, as the reference does."""
+    if s.upper() == "VALID":
+        return [(0, 0)] * len(ksizes)
+    pads = []
+    for L, k, st in zip(in_sizes, ksizes, strides):
+        pt = max((-(-L // st) - 1) * st + k - L, 0)
+        pads.append((pt // 2, pt - pt // 2))
+    return pads
+
+
 @register_op("conv2d_transpose")
 def _conv2d_transpose(x, w, bias=None, stride=1, padding=0,
                       output_padding=0, dilation=1, groups=1,
@@ -311,7 +327,11 @@ def _conv2d_transpose(x, w, bias=None, stride=1, padding=0,
     dil = _norm_tuple(dilation, nd)
     opad = _norm_tuple(output_padding, nd)
     if isinstance(pads, str):
-        raise NotImplementedError("string padding for conv_transpose")
+        spatial = x.shape[2:2 + nd] if data_format == "NCHW" \
+            else x.shape[1:1 + nd]
+        if pads.upper() == "SAME":
+            dil = (1,) * nd  # reference forces dilation=1 under SAME
+        pads = _transpose_str_pads(pads, spatial, w.shape[2:], strides)
     # w layout: (in, out/groups, kh, kw) in paddle
     lhs_spec = "NCHW" if data_format == "NCHW" else "NHWC"
     if groups != 1:

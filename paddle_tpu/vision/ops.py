@@ -22,7 +22,7 @@ from .. import nn
 
 __all__ = ["yolo_box", "yolo_loss", "deform_conv2d", "DeformConv2D",
            "psroi_pool", "PSRoIPool", "roi_pool", "RoIPool", "roi_align",
-           "RoIAlign", "nms"]
+           "RoIAlign", "nms", "matrix_nms"]
 
 
 # ---------------------------------------------------------------------------
@@ -526,6 +526,27 @@ def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
     if top_k is not None:
         kept = kept[:int(top_k)]
     return Tensor(jnp.asarray(kept))
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold, nms_top_k,
+               keep_top_k, use_gaussian=False, gaussian_sigma=2.0,
+               background_label=0, normalized=True, return_index=False,
+               return_rois_num=True, name=None):
+    """Matrix NMS: score-decay suppression (SOLOv2) over [N, M, 4] boxes
+    and [N, C, M] scores. Out rows are [label, score, x1, y1, x2, y2].
+    Reference: python/paddle/fluid/layers/detection.py:3573."""
+    out, index, rois_num = _op(
+        "matrix_nms", bboxes, scores, score_threshold=score_threshold,
+        post_threshold=post_threshold, nms_top_k=nms_top_k,
+        keep_top_k=keep_top_k, use_gaussian=use_gaussian,
+        gaussian_sigma=gaussian_sigma, background_label=background_label,
+        normalized=normalized)
+    res = [out]
+    if return_rois_num:
+        res.append(rois_num)
+    if return_index:
+        res.append(index)
+    return tuple(res) if len(res) > 1 else out
 
 
 def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,

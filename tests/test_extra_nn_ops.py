@@ -61,6 +61,43 @@ class TestConvTranspose:
         assert tuple(y1.shape) == (2, 6, 17)
 
 
+class TestConvTransposeStringPadding:
+    def test_same_shape_matches_reference_formula(self):
+        # reference UpdatePaddingAndDilation (conv_util.h): pad_sum =
+        # max((ceil(in/st)-1)*st + k - in, 0), computed from INPUT size
+        # -> out = (in-1)*st - pad_sum + k. For in=7/9, k=3, st=2:
+        # pad_sum=2 -> out 13/17 (NOT in*stride).
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 3, 7, 9).astype("float32")
+        w = rng.randn(3, 4, 3, 3).astype("float32")
+        out = F.conv2d_transpose(t(x), t(w), stride=2, padding="SAME")
+        assert tuple(out.shape) == (2, 4, 13, 17)
+        x1 = rng.randn(2, 3, 11).astype("float32")
+        w1 = rng.randn(3, 4, 4).astype("float32")
+        # in=11, k=4, st=3: pad_sum = max(9+4-11, 0)=2 -> out 32
+        out1 = F.conv1d_transpose(t(x1), t(w1), stride=3, padding="SAME")
+        assert tuple(out1.shape) == (2, 4, 32)
+
+    def test_same_stride1_matches_torch_symmetric_pad(self):
+        # k=3, s=1 -> SAME total pad 2 = symmetric (1,1)
+        rng = np.random.RandomState(1)
+        x = rng.randn(1, 2, 6, 6).astype("float32")
+        w = rng.randn(2, 3, 3, 3).astype("float32")
+        ours = F.conv2d_transpose(t(x), t(w), stride=1, padding="SAME")
+        ref = TF.conv_transpose2d(torch.tensor(x), torch.tensor(w),
+                                  stride=1, padding=1)
+        np.testing.assert_allclose(ours.numpy(), ref.numpy(),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_valid_equals_zero_padding(self):
+        rng = np.random.RandomState(2)
+        x = rng.randn(1, 2, 5, 5).astype("float32")
+        w = rng.randn(2, 3, 3, 3).astype("float32")
+        a = F.conv2d_transpose(t(x), t(w), stride=2, padding="VALID")
+        b = F.conv2d_transpose(t(x), t(w), stride=2, padding=0)
+        np.testing.assert_allclose(a.numpy(), b.numpy(), rtol=1e-6)
+
+
 class TestPooling3D:
     def test_adaptive_avg_pool3d(self):
         rng = np.random.RandomState(3)

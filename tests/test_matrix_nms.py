@@ -1,0 +1,132 @@
+"""Matrix NMS (ops/linalg_ops.py _matrix_nms, vision.ops.matrix_nms).
+
+Semantics pinned against hand-computed decays from the published Matrix
+NMS recurrence (decay_j = min_i f(iou_ij)/f(comp_i)); reference contract:
+python/paddle/fluid/layers/detection.py:3573,
+paddle/fluid/operators/detection/matrix_nms_op.cc.
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.vision.ops import matrix_nms
+
+
+def _run(boxes, scores, **kw):
+    kw.setdefault("score_threshold", 0.0)
+    kw.setdefault("post_threshold", 0.0)
+    kw.setdefault("nms_top_k", -1)
+    kw.setdefault("keep_top_k", -1)
+    kw.setdefault("background_label", -1)
+    out, rois_num, index = matrix_nms(
+        boxes.astype(np.float32), scores.astype(np.float32),
+        return_index=True, **kw)
+    return out.numpy(), rois_num.numpy(), index.numpy()
+
+
+def test_single_box_passes_through():
+    boxes = np.array([[[0, 0, 10, 10]]], np.float32)
+    scores = np.array([[[0.9]]], np.float32)
+    out, rois_num, index = _run(boxes, scores)
+    assert rois_num.tolist() == [1]
+    np.testing.assert_allclose(
+        out, [[0, 0.9, 0, 0, 10, 10]], rtol=1e-6)
+    assert index.tolist() == [[0]]
+
+
+def test_disjoint_boxes_keep_scores_sorted():
+    boxes = np.array([[[0, 0, 10, 10], [100, 100, 110, 110]]], np.float32)
+    scores = np.array([[[0.5, 0.8]]], np.float32)
+    out, rois_num, _ = _run(boxes, scores)
+    assert rois_num.tolist() == [2]
+    np.testing.assert_allclose(out[:, 1], [0.8, 0.5], rtol=1e-6)  # sorted
+    np.testing.assert_allclose(out[0, 2:], [100, 100, 110, 110])
+
+
+def test_identical_boxes_linear_decay_drops_duplicate():
+    boxes = np.tile(np.array([[0, 0, 10, 10]], np.float32), (2, 1))[None]
+    scores = np.array([[[0.9, 0.7]]], np.float32)
+    # iou = 1 -> linear decay to exactly 0.0; the reference filter is
+    # strictly > post_threshold even at 0, so the duplicate is DROPPED
+    out, rois_num, _ = _run(boxes, scores)
+    assert rois_num.tolist() == [1]
+    np.testing.assert_allclose(out[:, 1], [0.9], atol=1e-6)
+    out, rois_num, _ = _run(boxes, scores, post_threshold=0.1)
+    assert rois_num.tolist() == [1]
+
+
+def test_unnormalized_touching_boxes_share_a_pixel():
+    # integer pixel boxes sharing the x=10 column: inclusive-pixel IoU
+    # is 11/(121+121-11); normalized IoU of the same boxes is 0
+    boxes = np.array([[[0, 0, 10, 10], [10, 0, 20, 10]]], np.float32)
+    scores = np.array([[[0.8, 0.6]]], np.float32)
+    out, _, _ = _run(boxes, scores, normalized=False)
+    iou = 11.0 / (121 + 121 - 11)
+    np.testing.assert_allclose(out[1, 1], 0.6 * (1 - iou), rtol=1e-5)
+    out, _, _ = _run(boxes, scores, normalized=True)
+    np.testing.assert_allclose(out[1, 1], 0.6, rtol=1e-6)  # no overlap
+
+
+def test_gaussian_decay_hand_computed():
+    # two unit-height boxes overlapping half: iou = 1/3
+    boxes = np.array([[[0, 0, 10, 1], [5, 0, 15, 1]]], np.float32)
+    scores = np.array([[[0.8, 0.6]]], np.float32)
+    out, _, _ = _run(boxes, scores, use_gaussian=True, gaussian_sigma=2.0)
+    iou = (5.0) / (10 + 10 - 5)
+    expected = 0.6 * np.exp((0.0 - iou ** 2) * 2.0)
+    np.testing.assert_allclose(out[1, 1], expected, rtol=1e-5)
+    # linear variant: decay (1-iou)/(1-0)
+    out, _, _ = _run(boxes, scores)
+    np.testing.assert_allclose(out[1, 1], 0.6 * (1 - iou), rtol=1e-5)
+
+
+def test_chained_compensation():
+    """Third box overlaps the second, which overlaps the first: box 3's
+    decay against box 2 is compensated by box 2's own overlap with box 1
+    — the 'matrix' part of Matrix NMS."""
+    boxes = np.array([[[0, 0, 10, 1], [5, 0, 15, 1],
+                       [10, 0, 20, 1]]], np.float32)
+    scores = np.array([[[0.9, 0.8, 0.7]]], np.float32)
+    out, _, _ = _run(boxes, scores)
+    iou = 1.0 / 3.0  # each adjacent pair
+    # box2 decays by (1-iou)/1; box3's decay vs box2 is fully compensated
+    # by box2's own overlap with box1 ((1-iou)/(1-iou) = 1), so box3 keeps
+    # 0.7 and OUTRANKS the decayed box2 in the score-sorted output
+    expected = sorted([0.9, 0.8 * (1 - iou), 0.7], reverse=True)
+    np.testing.assert_allclose(out[:, 1], expected, rtol=1e-5)
+
+
+def test_multiclass_background_and_batch_index():
+    M = 3
+    boxes = np.array([[[0, 0, 1, 1], [2, 2, 3, 3], [4, 4, 5, 5]],
+                      [[0, 0, 1, 1], [2, 2, 3, 3], [4, 4, 5, 5]]],
+                     np.float32)
+    scores = np.zeros((2, 3, M), np.float32)
+    scores[0, 0, 0] = 0.9   # class 0 = background, must be skipped
+    scores[0, 1, 1] = 0.8
+    scores[1, 2, 2] = 0.7
+    out, rois_num, index = _run(boxes, scores, background_label=0,
+                                score_threshold=0.1)
+    assert rois_num.tolist() == [1, 1]
+    assert out[0, 0] == 1.0 and out[1, 0] == 2.0     # labels
+    assert index[:, 0].tolist() == [1, 1 * M + 2]    # absolute across batch
+
+
+def test_top_k_limits():
+    rng = np.random.RandomState(0)
+    boxes = np.concatenate(
+        [rng.uniform(0, 50, (1, 20, 2)),
+         rng.uniform(51, 100, (1, 20, 2))], axis=2).astype(np.float32)
+    scores = rng.uniform(0.1, 1.0, (1, 2, 20)).astype(np.float32)
+    out, rois_num, _ = _run(boxes, scores, keep_top_k=5)
+    assert rois_num.tolist() == [5] and out.shape == (5, 6)
+    # nms_top_k caps per-class candidates before decay
+    out2, rois_num2, _ = _run(boxes, scores, nms_top_k=3)
+    assert rois_num2.tolist() == [6]  # 3 per class x 2 classes
+
+
+def test_empty_result():
+    boxes = np.zeros((1, 2, 4), np.float32)
+    scores = np.full((1, 1, 2), 0.01, np.float32)
+    out, rois_num, index = _run(boxes, scores, score_threshold=0.5)
+    assert out.shape == (0, 6) and rois_num.tolist() == [0]
+    assert index.shape == (0, 1)
