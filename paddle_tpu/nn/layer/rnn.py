@@ -195,22 +195,42 @@ class GRUCell(RNNCellBase):
         return out, nh
 
 
-def _scan_cell(cell, x_seq, init_states, param_arrays, reverse=False):
-    """lax.scan a cell's _step over time. x_seq: [T, B, I] arrays."""
+def _scan_cell(cell, x_seq, init_states, param_arrays, reverse=False,
+               mask=None):
+    """lax.scan a cell's _step over time. x_seq: [T, B, I] arrays.
+
+    mask: optional [T, B, 1] bool — variable-length semantics: outputs
+    at masked steps are ZERO and the state copies through unchanged, so
+    the final state is each example's state at its last valid step.
+    State copy-through matches fluid/layers/rnn.py _rnn_dynamic_graph
+    (_maybe_copy); zeroed padded outputs follow the rnn OP / the
+    tests' rnn_numpy.py oracle (np.where(m_t, y, 0.)) — the fluid
+    wrapper itself leaves padded outputs as raw cell outputs, which is
+    garbage either way. With reverse=True, lax.scan consumes xs (and
+    the aligned mask) back to front — the reference's
+    flip(inputs)+flip(mask) formulation."""
     import jax
     import jax.numpy as jnp
 
     is_lstm = isinstance(cell, LSTMCell)
 
     def tick(carry, xt):
+        if mask is not None:
+            xt, mt = xt
         if is_lstm:
             h, c = carry
             nh, nc = cell._step(xt, h, c, *param_arrays)
+            if mask is not None:
+                return ((jnp.where(mt, nh, h), jnp.where(mt, nc, c)),
+                        jnp.where(mt, nh, 0))
             return (nh, nc), nh
         nh, _ = cell._step(xt, carry, *param_arrays)
+        if mask is not None:
+            return jnp.where(mt, nh, carry), jnp.where(mt, nh, 0)
         return nh, nh
 
-    carry, ys = jax.lax.scan(tick, init_states, x_seq, reverse=reverse)
+    xs = x_seq if mask is None else (x_seq, mask)
+    carry, ys = jax.lax.scan(tick, init_states, xs, reverse=reverse)
     return ys, carry
 
 
@@ -231,11 +251,6 @@ class RNN(Layer):
         from ... import autograd
         import jax.numpy as jnp
 
-        if sequence_length is not None:
-            raise NotImplementedError(
-                "sequence_length masking is not implemented; pre-mask or "
-                "bucket padded batches (TPU-native padding strategy, "
-                "SURVEY §7 hard-parts)")
         cell = self.cell
         if initial_states is None:
             batch = inputs.shape[0] if not self.time_major else \
@@ -252,20 +267,35 @@ class RNN(Layer):
         n_state = len(state_tensors)
         time_major = self.time_major
         reverse = self.is_reverse
+        has_len = sequence_length is not None
+        if has_len:
+            sl = sequence_length._data if isinstance(
+                sequence_length, Tensor) else jnp.asarray(
+                    np.asarray(sequence_length))
+            len_tensors = [Tensor(sl.astype(jnp.int32),
+                                  stop_gradient=True)]
+        else:
+            len_tensors = []
 
         def fn(x, *rest):
             states = rest[:n_state]
-            ws = rest[n_state:]
+            ws = rest[n_state:n_state + len(params)]
             x_seq = x if time_major else jnp.swapaxes(x, 0, 1)
+            mask = None
+            if has_len:
+                slen = rest[-1]
+                T = x_seq.shape[0]
+                mask = (jnp.arange(T)[:, None] <
+                        slen[None, :])[:, :, None]   # [T, B, 1]
             init = tuple(states) if is_lstm else states[0]
             ys, carry = _scan_cell(cell, x_seq, init, list(ws),
-                                   reverse=reverse)
+                                   reverse=reverse, mask=mask)
             out = ys if time_major else jnp.swapaxes(ys, 0, 1)
             final = carry if is_lstm else (carry,)
             return (out, *final)
 
         res = autograd.differentiable_apply(
-            fn, inputs, *state_tensors, *params)
+            fn, inputs, *state_tensors, *params, *len_tensors)
         out = res[0]
         final = tuple(res[1:])
         return out, (final if is_lstm else final[0])
@@ -285,14 +315,10 @@ class BiRNN(Layer):
 
     def forward(self, inputs, initial_states=None, sequence_length=None):
         from ...framework.dispatch import call_op
-        if sequence_length is not None:
-            raise NotImplementedError(
-                "sequence_length masking is not implemented; pre-mask or "
-                "bucket padded batches")
         states_fw, states_bw = (initial_states if initial_states
                                 is not None else (None, None))
-        out_fw, st_fw = self.rnn_fw(inputs, states_fw)
-        out_bw, st_bw = self.rnn_bw(inputs, states_bw)
+        out_fw, st_fw = self.rnn_fw(inputs, states_fw, sequence_length)
+        out_bw, st_bw = self.rnn_bw(inputs, states_bw, sequence_length)
         out = call_op("concat", [out_fw, out_bw], axis=-1)
         return out, (st_fw, st_bw)
 
@@ -364,15 +390,11 @@ class RNNBase(Layer):
 
     def forward(self, inputs, initial_states=None, sequence_length=None):
         from ..functional import dropout as F_dropout
-        if sequence_length is not None:
-            raise NotImplementedError(
-                "sequence_length masking is not implemented; pre-mask or "
-                "bucket padded batches")
         x = inputs
         finals = []
         per_layer_states = self._split_initial(initial_states)
         for i, layer in enumerate(self._layers):
-            x, st = layer(x, per_layer_states[i])
+            x, st = layer(x, per_layer_states[i], sequence_length)
             finals.append(st)
             if self.dropout and i < self.num_layers - 1 and self.training:
                 x = F_dropout(x, p=self.dropout, training=True)

@@ -337,13 +337,15 @@ class TestReviewFixes:
         np.testing.assert_allclose(out_explicit_zero.numpy(),
                                    out_zero.numpy(), atol=1e-6)
 
-    def test_sequence_length_raises(self):
-        import pytest as _pytest
+    def test_sequence_length_masks(self):
+        # was a NotImplementedError guard; now implemented — see
+        # tests/test_rnn_sequence_length.py for the full parity suite
         gru = nn.GRU(4, 8)
         x = paddle.to_tensor(rng.randn(2, 5, 4).astype(np.float32))
-        with _pytest.raises(NotImplementedError):
-            gru(x, sequence_length=paddle.to_tensor(
-                np.array([5, 3], np.int64)))
+        out, _ = gru(x, sequence_length=paddle.to_tensor(
+            np.array([5, 3], np.int64)))
+        assert (out.numpy()[1, 3:] == 0).all()
+        assert (out.numpy()[0, 3:] != 0).any()
 
     def test_rsample_differentiable(self):
         from paddle_tpu.distribution import Normal

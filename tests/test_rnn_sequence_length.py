@@ -1,0 +1,141 @@
+"""Variable-length RNN/LSTM/GRU via sequence_length (nn/layer/rnn.py).
+
+Reference semantics (fluid/layers/rnn.py _rnn_dynamic_graph + the
+rnn_numpy.py test oracle): outputs at steps >= length are ZERO, states
+copy through unchanged (final state = state at the last valid step),
+and the reverse direction flips inputs AND mask together. Oracle here:
+per-example runs on the unpadded prefix."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.framework.tensor import Tensor
+
+
+def _pad_batch(prompts_len, T, I, seed=0):
+    rng = np.random.RandomState(seed)
+    xs = [rng.randn(n, I).astype("float32") for n in prompts_len]
+    pad = np.zeros((len(xs), T, I), np.float32)
+    for i, x in enumerate(xs):
+        pad[i, :len(x)] = x
+    return xs, pad
+
+
+class TestForward:
+    def test_rnn_matches_per_example_prefix(self):
+        paddle.seed(0)
+        lens, T, I, H = [3, 6, 1], 6, 4, 5
+        cell = nn.SimpleRNNCell(I, H)
+        rnn = nn.RNN(cell)
+        xs, pad = _pad_batch(lens, T, I)
+        out, final = rnn(Tensor(pad), sequence_length=np.array(lens))
+        out = out.numpy()
+        for i, x in enumerate(xs):
+            o_i, f_i = rnn(Tensor(x[None]))
+            np.testing.assert_allclose(out[i, :lens[i]], o_i.numpy()[0],
+                                       rtol=1e-5, atol=1e-5)
+            np.testing.assert_allclose(final.numpy()[i], f_i.numpy()[0],
+                                       rtol=1e-5, atol=1e-5)
+        # padded tail is exactly zero
+        for i, n in enumerate(lens):
+            assert (out[i, n:] == 0).all()
+
+    def test_lstm_layer_final_states(self):
+        paddle.seed(1)
+        lens, T, I, H = [2, 4], 4, 3, 6
+        lstm = nn.LSTM(I, H)
+        xs, pad = _pad_batch(lens, T, I, seed=1)
+        out, (h, c) = lstm(Tensor(pad), sequence_length=np.array(lens))
+        for i, x in enumerate(xs):
+            _, (h_i, c_i) = lstm(Tensor(x[None]))
+            np.testing.assert_allclose(h.numpy()[0, i], h_i.numpy()[0, 0],
+                                       rtol=1e-5, atol=1e-5)
+            np.testing.assert_allclose(c.numpy()[0, i], c_i.numpy()[0, 0],
+                                       rtol=1e-5, atol=1e-5)
+
+
+class TestReverse:
+    def test_reverse_gru_matches_per_example(self):
+        """Reverse + mask flip: the padded tail is consumed first as
+        no-ops, so outputs[0:len] equal the unpadded reverse run."""
+        paddle.seed(2)
+        lens, T, I, H = [3, 5], 5, 4, 4
+        cell = nn.GRUCell(I, H)
+        rnn = nn.RNN(cell, is_reverse=True)
+        xs, pad = _pad_batch(lens, T, I, seed=2)
+        out, final = rnn(Tensor(pad), sequence_length=np.array(lens))
+        for i, x in enumerate(xs):
+            o_i, f_i = rnn(Tensor(x[None]))
+            np.testing.assert_allclose(out.numpy()[i, :lens[i]],
+                                       o_i.numpy()[0], rtol=1e-5, atol=1e-5)
+            np.testing.assert_allclose(final.numpy()[i], f_i.numpy()[0],
+                                       rtol=1e-5, atol=1e-5)
+
+    def test_bidirectional_lstm_with_lengths(self):
+        paddle.seed(3)
+        lens, T, I, H = [4, 2, 6], 6, 3, 5
+        bi = nn.LSTM(I, H, direction="bidirect")
+        xs, pad = _pad_batch(lens, T, I, seed=3)
+        out, (h, c) = bi(Tensor(pad), sequence_length=np.array(lens))
+        assert tuple(out.shape) == (3, 6, 2 * H)
+        for i, x in enumerate(xs):
+            o_i, (h_i, c_i) = bi(Tensor(x[None]))
+            np.testing.assert_allclose(out.numpy()[i, :lens[i]],
+                                       o_i.numpy()[0], rtol=1e-5, atol=1e-5)
+            np.testing.assert_allclose(h.numpy()[:, i], h_i.numpy()[:, 0],
+                                       rtol=1e-5, atol=1e-5)
+
+
+class TestTraining:
+    def test_grads_flow_through_masked_scan(self):
+        paddle.seed(4)
+        lens, T, I, H = [2, 3], 3, 4, 4
+        lstm = nn.LSTM(I, H)
+        _, pad = _pad_batch(lens, T, I, seed=4)
+        out, _ = lstm(Tensor(pad), sequence_length=np.array(lens))
+        out.sum().backward()
+        g = lstm.parameters()[0].grad
+        assert g is not None and np.isfinite(g.numpy()).all()
+
+    def test_masked_steps_do_not_affect_grads(self):
+        """Changing pad-region inputs must not change the loss gradient."""
+        paddle.seed(5)
+        lens, T, I, H = [2], 4, 3, 3
+        cell = nn.SimpleRNNCell(I, H)
+        rnn = nn.RNN(cell)
+
+        def loss_grad(pad_fill):
+            for p in rnn.parameters():
+                p.clear_grad()
+            x = np.full((1, T, I), pad_fill, np.float32)
+            x[0, :2] = 1.0
+            out, _ = rnn(Tensor(x), sequence_length=np.array(lens))
+            out.sum().backward()
+            return rnn.parameters()[0].grad.numpy().copy()
+
+        np.testing.assert_allclose(loss_grad(0.0), loss_grad(99.0),
+                                   rtol=1e-6)
+
+    def test_inside_jit(self):
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.nn.layer.layers import functional_call, \
+            get_params_tree
+        paddle.seed(6)
+        lens, T, I, H = [2, 4], 4, 3, 4
+        gru = nn.GRU(I, H)
+        _, pad = _pad_batch(lens, T, I, seed=6)
+        params = get_params_tree(gru)
+        sl = jnp.asarray(np.array(lens, np.int32))
+
+        @jax.jit
+        def f(p, x, sl):
+            (out, _), _ = functional_call(gru, p, {}, x,
+                                          sequence_length=Tensor(sl))
+            return out._data
+
+        jit_out = np.asarray(f(params, jnp.asarray(pad), sl))
+        eager_out, _ = gru(Tensor(pad), sequence_length=np.array(lens))
+        np.testing.assert_allclose(jit_out, eager_out.numpy(),
+                                   rtol=1e-5, atol=1e-5)
