@@ -67,6 +67,31 @@ def _pick_token(logits, key, do_sample, top_k, top_p, temperature):
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 
+def _mask_preamble(attn_mask, batch, max_new):
+    """(key_valid [B, total_len] bool over the prompt, real_len [B, 1])
+    for a left-padded prompt mask — shared by the greedy/sampling and
+    beam builders so the left-pad invariant lives in one place."""
+    import jax.numpy as jnp
+    key_valid = jnp.concatenate(
+        [attn_mask.astype(bool), jnp.zeros((batch, max_new), bool)], axis=1)
+    real_len = attn_mask.astype(jnp.int32).sum(axis=1, keepdims=True)
+    return key_valid, real_len
+
+
+def _step_mask(key_valid, real_len, prompt_len, total_len, pos, tile=1):
+    """Per-decode-step key validity (prompt mask | generated slots up to
+    pos) and per-example logical positions; tile>1 repeats rows for
+    flattened beams."""
+    import jax.numpy as jnp
+    r = jnp.arange(total_len)
+    kv = key_valid | ((r >= prompt_len) & (r <= pos))[None, :]
+    positions = real_len + (pos - prompt_len)
+    if tile > 1:
+        kv = jnp.repeat(kv, tile, axis=0)
+        positions = jnp.repeat(positions, tile, axis=0)
+    return kv, positions
+
+
 def _build_generate_fn(model, batch, prompt_len, static_key):
     import jax
     import jax.numpy as jnp
@@ -101,11 +126,8 @@ def _build_generate_fn(model, batch, prompt_len, static_key):
                     # ragged (left-padded) prompts: pads are masked out of
                     # attention forever; logical positions count only real
                     # tokens, so each example decodes at real_len + t
-                    key_valid = jnp.concatenate(
-                        [attn_mask.astype(bool),
-                         jnp.zeros((batch, max_new), bool)], axis=1)
-                    real_len = attn_mask.astype(jnp.int32).sum(
-                        axis=1, keepdims=True)                 # [B, 1]
+                    key_valid, real_len = _mask_preamble(
+                        attn_mask, batch, max_new)
                 else:
                     key_valid, real_len = None, None
                 hidden, caches = gpt.prefill(
@@ -132,12 +154,9 @@ def _build_generate_fn(model, batch, prompt_len, static_key):
                     tokens, caches, pos, finished, key = state
                     tok = lax.dynamic_slice(tokens, (z, pos), (batch, 1))
                     if has_mask:
-                        # every generated slot [prompt_len, pos] is valid
-                        # for all examples; prompt slots keep their mask
-                        r = jnp.arange(total_len)
-                        kv = key_valid | (
-                            (r >= prompt_len) & (r <= pos))[None, :]
-                        positions = real_len + (pos - prompt_len)  # [B, 1]
+                        kv, positions = _step_mask(
+                            key_valid, real_len, prompt_len, total_len,
+                            pos)
                     else:
                         kv, positions = None, None
                     hidden, caches = gpt.decode_step(
@@ -177,7 +196,7 @@ def _build_beam_fn(model, batch, prompt_len, static_key):
 
     from ..nn.layer.layers import functional_state
 
-    (max_new, num_beams, eos, pad, length_penalty) = static_key
+    (max_new, num_beams, eos, pad, length_penalty, has_mask) = static_key
     gpt = model.gpt if hasattr(model, "gpt") else model
     K = num_beams
     vocab = gpt.cfg.vocab_size
@@ -197,15 +216,22 @@ def _build_beam_fn(model, batch, prompt_len, static_key):
             return jnp.ones_like(length, jnp.float32)
         return ((5.0 + length.astype(jnp.float32)) / 6.0) ** length_penalty
 
-    def fn(params, buffers, ids):
+    def fn(params, buffers, ids, attn_mask):
         with functional_state(model, params, buffers):
             with no_grad_guard():
                 dtype = params[next(iter(params))].dtype
                 z = jnp.int32(0)
+                if has_mask:
+                    key_valid, real_len = _mask_preamble(
+                        attn_mask, batch, max_new)
+                else:
+                    key_valid, real_len = None, None
                 # prefill once at [B], then tile the caches to [B*K]
                 caches = gpt.init_cache(batch, total_len, dtype)
                 hidden, caches = gpt.prefill(
-                    Tensor(ids, stop_gradient=True), caches)
+                    Tensor(ids, stop_gradient=True), caches,
+                    key_valid=None if key_valid is None
+                    else key_valid[:, :prompt_len])
                 logp0 = jax.nn.log_softmax(
                     gpt.logits(hidden)._data[:, 0].astype(jnp.float32))
                 scores, first = lax.top_k(logp0, K)        # [B, K]
@@ -237,8 +263,15 @@ def _build_beam_fn(model, batch, prompt_len, static_key):
                     tok = lax.dynamic_slice(
                         tokens, (z, z, pos), (batch, K, 1)).reshape(
                             batch * K, 1)
+                    if has_mask:
+                        kv, positions = _step_mask(
+                            key_valid, real_len, prompt_len, total_len,
+                            pos, tile=K)
+                    else:
+                        kv, positions = None, None
                     hidden, caches = gpt.decode_step(
-                        Tensor(tok, stop_gradient=True), caches, pos)
+                        Tensor(tok, stop_gradient=True), caches, pos,
+                        key_valid=kv, positions=positions)
                     logp = jax.nn.log_softmax(
                         gpt.logits(hidden)._data[:, 0].astype(jnp.float32)
                     ).reshape(batch, K, vocab)
@@ -289,7 +322,7 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
     ``GenerationConfig`` may be passed as ``config=`` instead of the
     individual kwargs. ``num_beams > 1`` selects compiled beam search
     (deterministic; ``length_penalty`` is the GNMT alpha applied at final
-    selection; ragged masks not yet supported there).
+    selection; ragged masks compose with beams).
     """
     import jax
     import jax.numpy as jnp
@@ -361,13 +394,11 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
             raise ValueError("attention_mask has an all-pad row")
         if not m.all():  # an all-ones mask is just the uniform path
             mask = jnp.asarray(m.astype(np.int32))
-        if num_beams > 1 and mask is not None:
-            raise ValueError(
-                "attention_mask with num_beams > 1 is not supported yet")
     if num_beams > 1:
         static_key = ("beam", int(max_new_tokens), int(num_beams),
                       None if eos_token_id is None else int(eos_token_id),
-                      int(pad_token_id), float(length_penalty))
+                      int(pad_token_id), float(length_penalty),
+                      mask is not None)
         builder = _build_beam_fn
     else:
         static_key = (int(max_new_tokens), bool(do_sample), int(top_k),
@@ -389,7 +420,8 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
         params = {k: p._data for k, p in model.named_parameters()}
         buffers = get_buffers_tree(model)
         if num_beams > 1:
-            out = cache[fn_key](params, buffers, ids)
+            out = cache[fn_key](params, buffers, ids,
+                                jnp.int32(0) if mask is None else mask)
         else:
             if not do_sample:
                 # greedy never consumes the key; a fixed one avoids

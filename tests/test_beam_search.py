@@ -131,6 +131,27 @@ def test_inconsistent_knobs_raise(tiny_model):
         generate(tiny_model, ids, max_new_tokens=2, length_penalty=0.6)
 
 
+def test_ragged_beam_matches_per_example_beam(tiny_model):
+    """Left-padded beam batch: each example must decode exactly as its
+    own unpadded beam run — pads invisible to beams too."""
+    lens = [4, 6]
+    P = 6
+    rng = np.random.RandomState(11)
+    prompts = [rng.randint(1, 200, (n,)).astype(np.int32) for n in lens]
+    ids = np.stack([np.concatenate(
+        [np.zeros(P - len(p), np.int32), p]) for p in prompts])
+    mask = np.stack([np.concatenate(
+        [np.zeros(P - len(p), np.int32),
+         np.ones(len(p), np.int32)]) for p in prompts])
+    out = generate(tiny_model, ids, max_new_tokens=4, num_beams=3,
+                   attention_mask=mask).numpy()
+    for i, p in enumerate(prompts):
+        solo = generate(tiny_model, p[None, :], max_new_tokens=4,
+                        num_beams=3).numpy()
+        np.testing.assert_array_equal(out[i, P:], solo[0, len(p):],
+                                      err_msg=f"example {i}")
+
+
 def test_beam_via_config(tiny_model):
     from paddle_tpu.models import GenerationConfig
     ids = _prompt()
