@@ -14,6 +14,28 @@ __all__ = ["list", "help", "load"]
 _HUBCONF = "hubconf.py"
 
 
+def _check_dependencies(mod):
+    """A hubconf may declare ``dependencies = ["pkg", ...]``; fail fast
+    with the full missing list before any entrypoint runs (reference
+    hapi/hub.py:158)."""
+    deps = getattr(mod, "dependencies", None)
+    if not deps:
+        return
+    missing = []
+    for pkg in deps:
+        try:
+            found = importlib.util.find_spec(pkg) is not None
+        except (ModuleNotFoundError, ValueError):
+            # dotted names raise when the parent is absent; a stale
+            # sys.modules entry with __spec__=None raises ValueError —
+            # both mean "not usable", which is what we are reporting
+            found = False
+        if not found:
+            missing.append(pkg)
+    if missing:
+        raise RuntimeError("Missing dependencies: " + ", ".join(missing))
+
+
 def _load_hubconf(repo_dir):
     path = os.path.join(repo_dir, _HUBCONF)
     if not os.path.isfile(path):
@@ -25,6 +47,7 @@ def _load_hubconf(repo_dir):
         spec.loader.exec_module(mod)
     finally:
         sys.path.remove(repo_dir)
+    _check_dependencies(mod)
     return mod
 
 

@@ -1,0 +1,59 @@
+import os
+import numpy as np
+import pytest
+import paddle_tpu as paddle
+
+
+def _write_hubconf(d, body):
+    with open(os.path.join(str(d), "hubconf.py"), "w") as f:
+        f.write(body)
+
+
+def test_hub_list_help_load(tmp_path):
+    _write_hubconf(tmp_path, '''
+dependencies = ["numpy"]
+
+def lenet(num_classes=10):
+    """A LeNet entrypoint."""
+    from paddle_tpu.vision.models import LeNet
+    return LeNet(num_classes=num_classes)
+''')
+    names = paddle.hub.list(str(tmp_path), source="local")
+    assert "lenet" in names
+    assert "LeNet entrypoint" in paddle.hub.help(
+        str(tmp_path), "lenet", source="local")
+    net = paddle.hub.load(str(tmp_path), "lenet", source="local",
+                          num_classes=7)
+    out = net(paddle.to_tensor(
+        np.zeros((1, 1, 28, 28), np.float32)))
+    assert tuple(out.shape) == (1, 7)
+
+
+def test_hub_missing_dependency_fails_fast(tmp_path):
+    _write_hubconf(tmp_path, '''
+dependencies = ["numpy", "not_a_real_pkg_xyz"]
+
+def m():
+    return 1
+''')
+    with pytest.raises(RuntimeError, match="not_a_real_pkg_xyz"):
+        paddle.hub.load(str(tmp_path), "m", source="local")
+
+
+def test_hub_dotted_missing_dependency_reports_not_raises(tmp_path):
+    """find_spec on a dotted name under an absent parent raises
+    ModuleNotFoundError internally; the hub must still aggregate it into
+    the documented RuntimeError."""
+    _write_hubconf(tmp_path, '''
+dependencies = ["no_such_parent_pkg.sub", "numpy"]
+
+def m():
+    return 1
+''')
+    with pytest.raises(RuntimeError, match="no_such_parent_pkg.sub"):
+        paddle.hub.list(str(tmp_path), source="local")
+
+
+def test_hub_github_raises_offline(tmp_path):
+    with pytest.raises(RuntimeError, match="network"):
+        paddle.hub.list("owner/repo", source="github")
