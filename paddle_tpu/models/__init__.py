@@ -7,3 +7,4 @@ incubate/nn/layer/fused_transformer.py.
 from .gpt import GPTConfig, GPTModel, GPTForPretraining  # noqa: F401
 from .bert import BertConfig, BertModel, BertForQuestionAnswering  # noqa: F401
 from .generation import GenerationConfig, generate  # noqa: F401
+from .seq2seq import TransformerModel  # noqa: F401

@@ -1,0 +1,183 @@
+"""Encoder-decoder machine-translation model with compiled decoding.
+
+The reference's flagship seq2seq workload is the WMT Transformer built
+on nn.Transformer (python/paddle/nn/layer/transformer.py) with a python
+beam-search loop. Here the whole inference pass — encoder once, then a
+``lax.while_loop`` over single-token decoder steps against preallocated
+self-attention K/V caches — is ONE jitted XLA program, greedy or beam
+(same recurrences as models/generation.py).
+
+TPU-first notes:
+- decoder self-attn caches are fixed [B, max_len, H, D] buffers written
+  with dynamic_update_slice (no growing concat -> no recompiles);
+- cross-attention K/V of the (fixed) encoder memory are computed ONCE
+  per layer at prefill and reused every step;
+- padded source positions are masked via a [B, S] source mask, padded
+  TARGET history via the step's valid-slot mask.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import nn
+from ..framework.dispatch import call_op
+from ..framework.tensor import Tensor, no_grad_guard
+
+__all__ = ["TransformerModel"]
+
+
+def _sinusoid_table(max_len, d_model):
+    pos = np.arange(max_len)[:, None].astype("float32")
+    dim = np.arange(0, d_model, 2).astype("float32")
+    angle = pos / np.power(10000.0, dim / d_model)
+    table = np.zeros((max_len, d_model), "float32")
+    table[:, 0::2] = np.sin(angle)
+    table[:, 1::2] = np.cos(angle)
+    return table
+
+
+class TransformerModel(nn.Layer):
+    """Transformer MT model: token embeddings (scaled by sqrt(d_model)),
+    sinusoidal positions, nn.Transformer core, tied-or-free output head.
+    Reference analog: the WMT transformer example over nn.Transformer."""
+
+    def __init__(self, src_vocab_size, tgt_vocab_size, d_model=512,
+                 nhead=8, num_encoder_layers=6, num_decoder_layers=6,
+                 dim_feedforward=2048, dropout=0.1, max_length=256,
+                 bos_id=0, eos_id=1, pad_id=0):
+        super().__init__()
+        self.d_model = d_model
+        self.max_length = max_length
+        self.bos_id, self.eos_id, self.pad_id = bos_id, eos_id, pad_id
+        self.src_embed = nn.Embedding(src_vocab_size, d_model)
+        self.tgt_embed = nn.Embedding(tgt_vocab_size, d_model)
+        self.register_buffer(
+            "pos_table", Tensor(_sinusoid_table(max_length, d_model)))
+        self.transformer = nn.Transformer(
+            d_model=d_model, nhead=nhead,
+            num_encoder_layers=num_encoder_layers,
+            num_decoder_layers=num_decoder_layers,
+            dim_feedforward=dim_feedforward, dropout=dropout)
+        self.out_proj = nn.Linear(d_model, tgt_vocab_size)
+
+    # -- embedding helpers --------------------------------------------------
+    def _embed(self, table, ids, pos_offset=0):
+        import jax.numpy as jnp
+        x = table(ids) * (self.d_model ** 0.5)
+        seq = ids.shape[1]
+        if isinstance(pos_offset, int) and pos_offset + seq > \
+                self.max_length:
+            raise ValueError(
+                f"sequence length {pos_offset + seq} exceeds the model's "
+                f"positional table (max_length={self.max_length})")
+        if isinstance(pos_offset, int) and pos_offset == 0:
+            pe = self.pos_table._data[:seq]
+        else:
+            idx = pos_offset + jnp.arange(seq)
+            pe = jnp.take(self.pos_table._data, idx, axis=0)
+        return Tensor(x._data + pe[None, :, :].astype(x._data.dtype))
+
+    @staticmethod
+    def _src_key_mask(src, pad_id):
+        """[B, 1, 1, S] bool: True = attend (non-pad source token)."""
+        import jax.numpy as jnp
+        ids = src._data if isinstance(src, Tensor) else jnp.asarray(src)
+        return Tensor((ids != pad_id)[:, None, None, :])
+
+    def forward(self, src, tgt):
+        """Teacher-forcing logits [B, T, V]; source pads masked, target
+        causal."""
+        import jax.numpy as jnp
+        src = src if isinstance(src, Tensor) else Tensor(jnp.asarray(src))
+        tgt = tgt if isinstance(tgt, Tensor) else Tensor(jnp.asarray(tgt))
+        smask = self._src_key_mask(src, self.pad_id)
+        T = tgt.shape[1]
+        causal = Tensor(
+            (jnp.arange(T)[:, None] >= jnp.arange(T)[None, :])
+            [None, None, :, :])
+        mem = self.transformer.encoder(
+            self._embed(self.src_embed, src), src_mask=smask)
+        out = self.transformer.decoder(
+            self._embed(self.tgt_embed, tgt), mem, tgt_mask=causal,
+            memory_mask=smask)
+        return self.out_proj(out)
+
+    # -- compiled decode ----------------------------------------------------
+    def _decoder_prefill(self, mem, batch, max_len, dtype):
+        """Returns (self-attn caches, memory K/V): per decoder layer,
+        preallocated self-attn K/V buffers, and the cross-attention K/V
+        of the fixed memory computed ONCE. They are separate structures
+        because only the self-attn caches are beam-reordered per step —
+        memory K/V rows are identical across an example's beams."""
+        import jax.numpy as jnp
+        caches, mem_kv = [], []
+        for layer in self.transformer.decoder.layers:
+            a = layer.self_attn
+            shape = (batch, max_len, a.num_heads, a.head_dim)
+            caches.append((jnp.zeros(shape, dtype),
+                           jnp.zeros(shape, dtype)))
+            mk = layer.cross_attn._split_heads(layer.cross_attn.k_proj(mem))
+            mv = layer.cross_attn._split_heads(layer.cross_attn.v_proj(mem))
+            mem_kv.append((mk._data, mv._data))
+        return caches, mem_kv
+
+    def _decoder_step(self, x, caches, mem_kv, pos, smask_data):
+        """One decoder token x [B, 1, E] at slot pos; returns (hidden,
+        caches). Pre-LN/post-LN follows the layer's configuration via its
+        norms, mirroring TransformerDecoderLayer.forward with cache."""
+        import jax.numpy as jnp
+        from jax import lax
+        from ..nn import functional as F
+        dec = self.transformer.decoder
+        z = jnp.int32(0)
+        pos = jnp.asarray(pos, jnp.int32)
+        new_caches = []
+        out = x
+        for layer, (ck, cv), (mk, mv) in zip(dec.layers, caches, mem_kv):
+            residual = out
+            h = layer.norm1(out) if layer.normalize_before else out
+            a = layer.self_attn
+            q = a._split_heads(a.q_proj(h))
+            k = a._split_heads(a.k_proj(h))
+            v = a._split_heads(a.v_proj(h))
+            ck = lax.dynamic_update_slice(
+                ck, k._data.astype(ck.dtype), (z, pos, z, z))
+            cv = lax.dynamic_update_slice(
+                cv, v._data.astype(cv.dtype), (z, pos, z, z))
+            valid = (jnp.arange(ck.shape[1]) <= pos)[None, None, None, :]
+            sa = F.scaled_dot_product_attention(
+                q, Tensor(ck), Tensor(cv), attn_mask=Tensor(valid))
+            sa = a.out_proj(a._merge_heads(sa))
+            out = residual + sa
+            if not layer.normalize_before:
+                out = layer.norm1(out)
+            residual = out
+            h = layer.norm2(out) if layer.normalize_before else out
+            c = layer.cross_attn
+            qc = c._split_heads(c.q_proj(h))
+            ca = F.scaled_dot_product_attention(
+                qc, Tensor(mk), Tensor(mv),
+                attn_mask=Tensor(smask_data))
+            ca = c.out_proj(c._merge_heads(ca))
+            out = residual + ca
+            if not layer.normalize_before:
+                out = layer.norm2(out)
+            residual = out
+            h = layer.norm3(out) if layer.normalize_before else out
+            h = layer.linear2(call_op(layer.activation, layer.linear1(h)))
+            out = residual + h
+            if not layer.normalize_before:
+                out = layer.norm3(out)
+            new_caches.append((ck, cv))
+        if dec.norm is not None:
+            out = dec.norm(out)
+        return out, new_caches
+
+    def generate(self, src, max_length=None, num_beams=1,
+                 length_penalty=0.0):
+        """Compiled translation: encoder once + while_loop decode from
+        bos_id until eos_id or the length budget; greedy (num_beams=1)
+        or beam search. Returns [B, max_length] with pads after EOS."""
+        from .seq2seq_decode import run_generate
+        return run_generate(self, src, max_length, num_beams,
+                            length_penalty)

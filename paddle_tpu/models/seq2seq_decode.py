@@ -1,0 +1,197 @@
+"""Compiled decode loops for TransformerModel (models/seq2seq.py).
+
+Same one-XLA-program structure as models/generation.py (encoder prefill +
+lax.while_loop decode over fixed-shape caches; greedy or flattened-beam),
+specialised to the encoder-decoder wiring: cross-attention K/V computed
+once, source-pad mask applied every step, decode starts at BOS.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework.tensor import Tensor, no_grad_guard
+
+__all__ = ["run_generate"]
+
+
+def _build_seq2seq_fn(model, batch, src_len, static_key):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ..nn.layer.layers import functional_state
+
+    (max_len, num_beams, lp_alpha) = static_key
+    K = num_beams
+    bos, eos, pad = model.bos_id, model.eos_id, model.pad_id
+    if max_len < 2:
+        raise ValueError(f"max_length must be >= 2, got {max_len}")
+    if max_len > model.max_length:
+        raise ValueError(
+            f"max_length={max_len} exceeds the model's positional table "
+            f"({model.max_length})")
+
+    def lp(length):
+        if lp_alpha == 0.0:
+            return jnp.ones_like(length, jnp.float32)
+        return ((5.0 + length.astype(jnp.float32)) / 6.0) ** lp_alpha
+
+    def _encode(src):
+        smask = model._src_key_mask(Tensor(src), pad)
+        mem = model.transformer.encoder(
+            model._embed(model.src_embed, Tensor(src)), src_mask=smask)
+        return mem, smask._data
+
+    def _logits(hidden):
+        return model.out_proj(hidden)._data[:, 0].astype(jnp.float32)
+
+    def greedy_fn(params, buffers, src):
+        with functional_state(model, params, buffers):
+            with no_grad_guard():
+                z = jnp.int32(0)
+                mem, smask = _encode(src)
+                dtype = mem._data.dtype
+                caches, mem_kv = model._decoder_prefill(
+                    mem, batch, max_len, dtype)
+                tokens = jnp.full((batch, max_len), pad, jnp.int32)
+                tokens = tokens.at[:, 0].set(bos)
+                finished = jnp.zeros((batch,), bool)
+
+                def cond(state):
+                    tokens, caches, pos, finished = state
+                    return (pos < max_len - 1) & ~jnp.all(finished)
+
+                def body(state):
+                    tokens, caches, pos, finished = state
+                    tok = lax.dynamic_slice(tokens, (z, pos), (batch, 1))
+                    x = model._embed(model.tgt_embed, Tensor(tok),
+                                     pos_offset=pos)
+                    hidden, caches = model._decoder_step(
+                        x, caches, mem_kv, pos, smask)
+                    nxt = jnp.argmax(_logits(hidden),
+                                     axis=-1).astype(jnp.int32)
+                    nxt = jnp.where(finished, pad, nxt)
+                    finished = finished | (nxt == eos)
+                    tokens = lax.dynamic_update_slice(
+                        tokens, nxt[:, None], (z, pos + 1))
+                    return tokens, caches, pos + 1, finished
+
+                state = (tokens, caches, z, finished)
+                tokens = lax.while_loop(cond, body, state)[0]
+        return tokens
+
+    def beam_fn(params, buffers, src):
+        with functional_state(model, params, buffers):
+            with no_grad_guard():
+                z = jnp.int32(0)
+                mem, smask = _encode(src)
+                dtype = mem._data.dtype
+                caches, mem_kv = model._decoder_prefill(
+                    mem, batch, max_len, dtype)
+                # flatten beams into the batch like generation.py: tile
+                # to B*K. mem_kv/smask are tiled ONCE and never reordered
+                # (identical across an example's beams); only the growing
+                # self-attn caches are gathered by beam parent per step
+                caches = tuple(
+                    (jnp.repeat(ck, K, axis=0), jnp.repeat(cv, K, axis=0))
+                    for ck, cv in caches)
+                mem_kv = tuple(
+                    (jnp.repeat(mk, K, axis=0), jnp.repeat(mv, K, axis=0))
+                    for mk, mv in mem_kv)
+                smask_k = jnp.repeat(smask, K, axis=0)
+                vocab = model.out_proj.weight.shape[-1]
+                tokens = jnp.full((batch, K, max_len), pad, jnp.int32)
+                tokens = tokens.at[:, :, 0].set(bos)
+                # beam 0 active, the rest start at -inf so the first
+                # expansion draws K DISTINCT tokens from beam 0
+                scores = jnp.tile(
+                    jnp.where(jnp.arange(K) == 0, 0.0, -jnp.inf)[None, :],
+                    (batch, 1))
+                finished = jnp.zeros((batch, K), bool)
+                gen_len = jnp.zeros((batch, K), jnp.int32)
+                pad_row = jnp.where(jnp.arange(vocab) == pad, 0.0,
+                                    -jnp.inf)[None, None, :]
+                barange = jnp.arange(batch, dtype=jnp.int32)[:, None] * K
+
+                def cond(state):
+                    tokens, caches, scores, finished, gen_len, pos = state
+                    return (pos < max_len - 1) & ~jnp.all(finished)
+
+                def body(state):
+                    tokens, caches, scores, finished, gen_len, pos = state
+                    tok = lax.dynamic_slice(
+                        tokens, (z, z, pos), (batch, K, 1)).reshape(
+                            batch * K, 1)
+                    x = model._embed(model.tgt_embed, Tensor(tok),
+                                     pos_offset=pos)
+                    hidden, caches = model._decoder_step(
+                        x, caches, mem_kv, pos, smask_k)
+                    logp = jax.nn.log_softmax(_logits(hidden)).reshape(
+                        batch, K, vocab)
+                    allowed = jnp.where(finished[:, :, None], pad_row,
+                                        logp)
+                    cand = (scores[:, :, None] + allowed).reshape(
+                        batch, K * vocab)
+                    scores, idx = lax.top_k(cand, K)
+                    parent = (idx // vocab).astype(jnp.int32)
+                    nxt = (idx % vocab).astype(jnp.int32)
+                    tokens = jnp.take_along_axis(
+                        tokens, parent[:, :, None], axis=1)
+                    finished = jnp.take_along_axis(finished, parent,
+                                                   axis=1)
+                    gen_len = jnp.take_along_axis(gen_len, parent, axis=1)
+                    fp = (barange + parent).reshape(-1)
+                    caches = tuple((ck[fp], cv[fp]) for ck, cv in caches)
+                    tokens = lax.dynamic_update_slice(
+                        tokens, nxt[:, :, None], (z, z, pos + 1))
+                    gen_len = gen_len + (~finished).astype(jnp.int32)
+                    finished = finished | (nxt == eos)
+                    return (tokens, caches, scores, finished, gen_len,
+                            pos + 1)
+
+                state = (tokens, caches, scores, finished, gen_len, z)
+                tokens, _, scores, _, gen_len, _ = lax.while_loop(
+                    cond, body, state)
+                best = jnp.argmax(scores / lp(gen_len), axis=1)
+                tokens = jnp.take_along_axis(
+                    tokens, best[:, None, None], axis=1)[:, 0]
+        return tokens
+
+    return jax.jit(greedy_fn if K == 1 else beam_fn)
+
+
+def run_generate(model, src, max_length=None, num_beams=1,
+                 length_penalty=0.0):
+    import jax.numpy as jnp
+
+    from ..nn.layer.layers import get_buffers_tree
+
+    if num_beams < 1:
+        raise ValueError(f"num_beams must be >= 1, got {num_beams}")
+    ids = src._data if isinstance(src, Tensor) else \
+        jnp.asarray(np.asarray(src))
+    if ids.ndim == 1:
+        ids = ids[None, :]
+    batch, src_len = ids.shape
+    max_len = int(max_length if max_length is not None else
+                  model.max_length)
+    if num_beams == 1 and length_penalty != 0.0:
+        raise ValueError("length_penalty requires num_beams > 1")
+    static_key = (max_len, int(num_beams), float(length_penalty))
+    cache = getattr(model, "_generate_fns", None)
+    if cache is None:
+        cache = model._generate_fns = {}
+    fn_key = (batch, src_len) + static_key
+    if fn_key not in cache:
+        cache[fn_key] = _build_seq2seq_fn(model, batch, src_len,
+                                          static_key)
+    was_training = model.training
+    model.eval()
+    try:
+        params = {k: p._data for k, p in model.named_parameters()}
+        buffers = get_buffers_tree(model)
+        out = cache[fn_key](params, buffers, ids)
+    finally:
+        if was_training:
+            model.train()
+    return Tensor(out, stop_gradient=True)
