@@ -795,7 +795,7 @@ def _cosine_similarity(x, y, axis=1, eps=1e-8):
 
 
 @register_op("label_smooth")
-def _label_smooth(label, epsilon=0.1, prior_dist=None):
+def _label_smooth(label, prior_dist=None, epsilon=0.1):
     n = label.shape[-1]
     if prior_dist is not None:
         return (1 - epsilon) * label + epsilon * prior_dist

@@ -1,0 +1,226 @@
+"""Call-path smoke for every functional wrapper no other test touches.
+
+The API-surface audit proves names RESOLVE; this proves they RUN —
+a wrapper whose positional order disagrees with its op's signature only
+fails at call time (the label_smooth epsilon/prior_dist swap survived
+three rounds that way). Values are checked against torch where the
+mapping is one-line, otherwise against hand-computed facts."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+torch = pytest.importorskip("torch")
+import torch.nn.functional as TF  # noqa: E402
+
+rng = np.random.RandomState(0)
+
+
+def t(x):
+    return paddle.to_tensor(np.asarray(x))
+
+
+def _cmp(ours, ref, rtol=1e-4, atol=1e-5):
+    np.testing.assert_allclose(np.asarray(ours.numpy(), dtype=np.float32),
+                               ref.numpy(), rtol=rtol, atol=atol)
+
+
+X = rng.randn(2, 6).astype("float32")
+
+
+class TestActivations:
+    def test_celu(self):
+        _cmp(F.celu(t(X), alpha=1.2), TF.celu(torch.tensor(X), 1.2))
+
+    def test_selu(self):
+        _cmp(F.selu(t(X)), TF.selu(torch.tensor(X)))
+
+    def test_hardtanh(self):
+        _cmp(F.hardtanh(t(X), min=-0.5, max=0.4),
+             TF.hardtanh(torch.tensor(X), -0.5, 0.4))
+
+    def test_hardshrink(self):
+        _cmp(F.hardshrink(t(X), threshold=0.3),
+             TF.hardshrink(torch.tensor(X), 0.3))
+
+    def test_softshrink(self):
+        _cmp(F.softshrink(t(X), threshold=0.3),
+             TF.softshrink(torch.tensor(X), 0.3))
+
+    def test_thresholded_relu(self):
+        _cmp(F.thresholded_relu(t(X), threshold=0.2),
+             TF.threshold(torch.tensor(X), 0.2, 0.0))
+
+    def test_rrelu_eval_is_mean_slope(self):
+        out = F.rrelu(t(X), lower=0.1, upper=0.3, training=False).numpy()
+        exp = np.where(X >= 0, X, X * 0.2)
+        np.testing.assert_allclose(out, exp, rtol=1e-5)
+
+    def test_gumbel_softmax(self):
+        out = F.gumbel_softmax(t(X), temperature=0.5).numpy()
+        np.testing.assert_allclose(out.sum(-1), np.ones(2), rtol=1e-5)
+        hard = F.gumbel_softmax(t(X), temperature=0.5, hard=True).numpy()
+        assert ((hard == 0) | (hard == 1)).all()
+        np.testing.assert_allclose(hard.sum(-1), np.ones(2))
+
+    def test_maxout(self):
+        x = rng.randn(1, 4, 2, 2).astype("float32")
+        out = F.maxout(t(x), groups=2).numpy()
+        exp = x.reshape(1, 2, 2, 2, 2).max(2)
+        np.testing.assert_allclose(out, exp, rtol=1e-6)
+
+    def test_glu(self):
+        _cmp(F.glu(t(X), axis=-1), TF.glu(torch.tensor(X), -1))
+
+
+class TestDropoutPad:
+    def test_dropout3d_shapes_and_eval(self):
+        x = rng.randn(2, 3, 4, 4, 4).astype("float32")
+        out = F.dropout3d(t(x), p=0.5, training=False).numpy()
+        np.testing.assert_allclose(out, x)
+        tr = F.dropout3d(t(x), p=0.5, training=True).numpy()
+        # whole channels dropped: every channel all-zero or fully scaled
+        ch = tr.reshape(2, 3, -1)
+        zeroed = (ch == 0).all(-1)
+        kept = np.isclose(ch, x.reshape(2, 3, -1) * 2.0, atol=1e-5).all(-1)
+        assert (zeroed | kept).all()
+
+    def test_alpha_dropout_eval_identity(self):
+        out = F.alpha_dropout(t(X), p=0.4, training=False).numpy()
+        np.testing.assert_allclose(out, X)
+
+    def test_zeropad2d(self):
+        x = rng.randn(1, 2, 3, 3).astype("float32")
+        out = F.zeropad2d(t(x), padding=[1, 2, 0, 1]).numpy()
+        assert out.shape == (1, 2, 4, 6)
+        np.testing.assert_allclose(out[:, :, 0:3, 1:4], x)
+
+
+class TestMiscNN:
+    def test_label_smooth(self):
+        onehot = np.eye(4, dtype="float32")[None]
+        out = F.label_smooth(t(onehot), epsilon=0.2).numpy()
+        np.testing.assert_allclose(out[0, 0],
+                                   [0.85, 0.05, 0.05, 0.05], rtol=1e-6)
+        prior = np.array([0.4, 0.3, 0.2, 0.1], "float32")
+        out2 = F.label_smooth(t(onehot), prior_dist=t(prior),
+                              epsilon=0.2).numpy()
+        np.testing.assert_allclose(out2[0, 0], [0.88, 0.06, 0.04, 0.02],
+                                   rtol=1e-6)
+
+    def test_cosine_similarity(self):
+        a = rng.randn(3, 5).astype("float32")
+        b = rng.randn(3, 5).astype("float32")
+        _cmp(F.cosine_similarity(t(a), t(b), axis=1),
+             TF.cosine_similarity(torch.tensor(a), torch.tensor(b), 1))
+
+    def test_sequence_mask(self):
+        out = F.sequence_mask(t(np.array([1, 3])), maxlen=4).numpy()
+        np.testing.assert_array_equal(
+            out, [[1, 0, 0, 0], [1, 1, 1, 0]])
+
+    def test_diag_embed(self):
+        x = rng.randn(2, 3).astype("float32")
+        _cmp(F.diag_embed(t(x)), torch.diag_embed(torch.tensor(x)))
+
+
+class TestPooling:
+    def test_avg_pool1d(self):
+        x = rng.randn(2, 3, 8).astype("float32")
+        _cmp(F.avg_pool1d(t(x), kernel_size=2, stride=2),
+             TF.avg_pool1d(torch.tensor(x), 2, 2))
+
+    def test_adaptive_pools(self):
+        x = rng.randn(2, 3, 9).astype("float32")
+        _cmp(F.adaptive_avg_pool1d(t(x), output_size=3),
+             TF.adaptive_avg_pool1d(torch.tensor(x), 3))
+        _cmp(F.adaptive_max_pool1d(t(x), output_size=3),
+             TF.adaptive_max_pool1d(torch.tensor(x), 3))
+        x2 = rng.randn(2, 3, 8, 8).astype("float32")
+        _cmp(F.adaptive_avg_pool2d(t(x2), output_size=[4, 2]),
+             TF.adaptive_avg_pool2d(torch.tensor(x2), (4, 2)))
+
+
+class TestNorms:
+    def test_instance_norm(self):
+        x = rng.randn(2, 3, 8, 8).astype("float32")
+        _cmp(F.instance_norm(t(x)), TF.instance_norm(torch.tensor(x)),
+             rtol=1e-3, atol=1e-4)
+
+    def test_rms_norm(self):
+        x = rng.randn(2, 6).astype("float32")
+        w = np.ones(6, "float32")
+        out = F.rms_norm(t(x), t(w)).numpy()
+        exp = x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-5)
+        np.testing.assert_allclose(out, exp, rtol=1e-4, atol=1e-5)
+
+    def test_local_response_norm(self):
+        # paddle convention: k + alpha * SUM (reference
+        # nn/functional/norm.py:468); torch divides the sum by size, so
+        # torch(alpha*size) == paddle(alpha)
+        x = rng.randn(2, 6, 5, 5).astype("float32")
+        _cmp(F.local_response_norm(t(x), size=3, alpha=1e-4),
+             TF.local_response_norm(torch.tensor(x), 3, alpha=3e-4),
+             rtol=1e-4, atol=1e-5)
+
+
+class TestLosses:
+    def test_softmax_with_cross_entropy(self):
+        logits = rng.randn(4, 7).astype("float32")
+        labels = rng.randint(0, 7, (4, 1)).astype(np.int64)
+        out = F.softmax_with_cross_entropy(t(logits), t(labels)).numpy()
+        ref = TF.cross_entropy(torch.tensor(logits),
+                               torch.tensor(labels[:, 0]),
+                               reduction="none").numpy()
+        np.testing.assert_allclose(out.reshape(-1), ref, rtol=1e-5)
+
+    def test_l1_and_smooth_l1(self):
+        a, b = X, rng.randn(2, 6).astype("float32")
+        _cmp(F.l1_loss(t(a), t(b)),
+             TF.l1_loss(torch.tensor(a), torch.tensor(b)))
+        _cmp(F.smooth_l1_loss(t(a), t(b)),
+             TF.smooth_l1_loss(torch.tensor(a), torch.tensor(b)))
+
+    def test_nll_loss(self):
+        logp = np.log(rng.dirichlet(np.ones(5), 4).astype("float32"))
+        y = rng.randint(0, 5, 4).astype(np.int64)
+        _cmp(F.nll_loss(t(logp), t(y)),
+             TF.nll_loss(torch.tensor(logp), torch.tensor(y)))
+
+    def test_hinge_embedding_loss(self):
+        y = np.sign(rng.randn(2, 6)).astype("float32")
+        _cmp(F.hinge_embedding_loss(t(X), t(y)),
+             TF.hinge_embedding_loss(torch.tensor(X), torch.tensor(y)))
+
+    def test_margin_ranking_loss(self):
+        a, b = X, rng.randn(2, 6).astype("float32")
+        y = np.sign(rng.randn(2, 6)).astype("float32")
+        _cmp(F.margin_ranking_loss(t(a), t(b), t(y)),
+             TF.margin_ranking_loss(torch.tensor(a), torch.tensor(b),
+                                    torch.tensor(y)))
+
+    def test_huber_loss(self):
+        a, b = X, rng.randn(2, 6).astype("float32")
+        _cmp(F.huber_loss(t(a), t(b), delta=1.0),
+             TF.huber_loss(torch.tensor(a), torch.tensor(b)))
+
+    def test_sigmoid_focal_loss(self):
+        logit = rng.randn(3, 4).astype("float32")
+        label = rng.randint(0, 2, (3, 4)).astype("float32")
+        out = F.sigmoid_focal_loss(t(logit), t(label),
+                                   reduction="none").numpy()
+        p = 1 / (1 + np.exp(-logit))
+        ce = -(label * np.log(p) + (1 - label) * np.log(1 - p))
+        pt = label * p + (1 - label) * (1 - p)
+        alpha_t = label * 0.25 + (1 - label) * 0.75
+        np.testing.assert_allclose(out, alpha_t * (1 - pt) ** 2 * ce,
+                                   rtol=1e-3, atol=1e-5)
+
+    def test_triplet_margin_with_distance_loss(self):
+        a = rng.randn(3, 5).astype("float32")
+        p = rng.randn(3, 5).astype("float32")
+        n = rng.randn(3, 5).astype("float32")
+        _cmp(F.triplet_margin_with_distance_loss(t(a), t(p), t(n)),
+             TF.triplet_margin_with_distance_loss(
+                 torch.tensor(a), torch.tensor(p), torch.tensor(n)))
