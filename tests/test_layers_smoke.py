@@ -1,0 +1,190 @@
+"""Construct-and-forward smoke for every nn.Layer class no other test
+instantiates (the layer-class analog of test_functional_smoke: names
+resolving is not enough — constructors and forwards must RUN)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def t(shape, seed=0, scale=1.0):
+    return paddle.to_tensor(
+        (np.random.RandomState(seed).randn(*shape) * scale
+         ).astype("float32"))
+
+
+def ti(shape, hi, seed=0):
+    return paddle.to_tensor(
+        np.random.RandomState(seed).randint(0, hi, shape).astype("int64"))
+
+
+# (class name, ctor kwargs, input builder) — builder returns the args
+# tuple passed to forward
+UNARY = [
+    ("AdaptiveAvgPool1D", dict(output_size=2), (2, 3, 8)),
+    ("AdaptiveAvgPool2D", dict(output_size=2), (2, 3, 8, 8)),
+    ("AdaptiveAvgPool3D", dict(output_size=2), (2, 3, 4, 4, 4)),
+    ("AdaptiveMaxPool1D", dict(output_size=2), (2, 3, 8)),
+    ("AdaptiveMaxPool2D", dict(output_size=2), (2, 3, 8, 8)),
+    ("AdaptiveMaxPool3D", dict(output_size=2), (2, 3, 4, 4, 4)),
+    ("AlphaDropout", dict(p=0.3), (2, 6)),
+    ("AvgPool1D", dict(kernel_size=2), (2, 3, 8)),
+    ("AvgPool2D", dict(kernel_size=2), (2, 3, 8, 8)),
+    ("AvgPool3D", dict(kernel_size=2), (2, 3, 4, 4, 4)),
+    ("MaxPool1D", dict(kernel_size=2), (2, 3, 8)),
+    ("MaxPool2D", dict(kernel_size=2), (2, 3, 8, 8)),
+    ("MaxPool3D", dict(kernel_size=2), (2, 3, 4, 4, 4)),
+    ("BatchNorm1D", dict(num_features=3), (2, 3, 8)),
+    ("BatchNorm3D", dict(num_features=3), (2, 3, 4, 4, 4)),
+    ("CELU", dict(alpha=1.1), (2, 6)),
+    ("ChannelShuffle", dict(groups=2), (2, 4, 5, 5)),
+    ("Conv1D", dict(in_channels=3, out_channels=4, kernel_size=3),
+     (2, 3, 8)),
+    ("Conv2DTranspose", dict(in_channels=3, out_channels=4,
+                             kernel_size=3, stride=2), (2, 3, 5, 5)),
+    ("Conv3D", dict(in_channels=2, out_channels=3, kernel_size=3),
+     (1, 2, 5, 5, 5)),
+    ("Dropout2D", dict(p=0.4), (2, 3, 5, 5)),
+    ("Dropout3D", dict(p=0.4), (2, 3, 4, 4, 4)),
+    ("ELU", dict(), (2, 6)),
+    ("Flatten", dict(), (2, 3, 4)),
+    ("GLU", dict(), (2, 6)),
+    ("Hardshrink", dict(), (2, 6)),
+    ("Hardsigmoid", dict(), (2, 6)),
+    ("Hardtanh", dict(), (2, 6)),
+    ("Identity", dict(), (2, 6)),
+    ("InstanceNorm1D", dict(num_features=3), (2, 3, 8)),
+    ("InstanceNorm2D", dict(num_features=3), (2, 3, 5, 5)),
+    ("InstanceNorm3D", dict(num_features=3), (2, 3, 4, 4, 4)),
+    ("LocalResponseNorm", dict(size=3), (2, 6, 5, 5)),
+    ("LogSigmoid", dict(), (2, 6)),
+    ("LogSoftmax", dict(), (2, 6)),
+    ("Maxout", dict(groups=2), (1, 4, 2, 2)),
+    ("PReLU", dict(), (2, 6)),
+    ("Pad1D", dict(padding=[1, 2]), (2, 3, 5)),
+    ("Pad2D", dict(padding=[1, 1, 2, 0]), (2, 3, 5, 5)),
+    ("Pad3D", dict(padding=[1, 1, 1, 1, 0, 0]), (1, 2, 3, 3, 3)),
+    ("PixelShuffle", dict(upscale_factor=2), (1, 8, 3, 3)),
+    ("PixelUnshuffle", dict(downscale_factor=2), (1, 2, 6, 6)),
+    ("RMSNorm", dict(normalized_shape=6), (2, 6)),
+    ("RReLU", dict(), (2, 6)),
+    ("ReLU6", dict(), (2, 6)),
+    ("SELU", dict(), (2, 6)),
+    ("Softmax", dict(), (2, 6)),
+    ("Softmax2D", dict(), (2, 3, 4, 4)),
+    ("Softshrink", dict(), (2, 6)),
+    ("Softsign", dict(), (2, 6)),
+    ("Swish", dict(), (2, 6)),
+    ("Tanhshrink", dict(), (2, 6)),
+    ("ThresholdedReLU", dict(), (2, 6)),
+    ("Unfold", dict(kernel_sizes=2), (1, 2, 5, 5)),
+    ("Upsample", dict(scale_factor=2), (1, 2, 4, 4)),
+    ("UpsamplingBilinear2D", dict(scale_factor=2), (1, 2, 4, 4)),
+    ("UpsamplingNearest2D", dict(scale_factor=2), (1, 2, 4, 4)),
+    ("ZeroPad2D", dict(padding=[1, 1, 1, 1]), (1, 2, 4, 4)),
+]
+
+
+@pytest.mark.parametrize("name,kwargs,shape",
+                         UNARY, ids=[c[0] for c in UNARY])
+def test_unary_layer_runs(name, kwargs, shape):
+    paddle.seed(0)
+    layer = getattr(nn, name)(**kwargs)
+    out = layer(t(shape))
+    arr = out.numpy()
+    assert np.isfinite(arr).all(), name
+    repr(layer)  # extra_repr paths must not crash either
+
+
+PAIR_LOSSES = [
+    ("BCELoss", dict(), lambda: (paddle.nn.functional.sigmoid(t((4, 3))),
+                                 ti((4, 3), 2).astype("float32"))),
+    ("BCEWithLogitsLoss", dict(),
+     lambda: (t((4, 3)), ti((4, 3), 2).astype("float32"))),
+    ("HuberLoss", dict(), lambda: (t((4, 3)), t((4, 3), seed=1))),
+    ("KLDivLoss", dict(),
+     lambda: (paddle.nn.functional.log_softmax(t((4, 3))),
+              paddle.nn.functional.softmax(t((4, 3), seed=1)))),
+    ("L1Loss", dict(), lambda: (t((4, 3)), t((4, 3), seed=1))),
+    ("NLLLoss", dict(),
+     lambda: (paddle.nn.functional.log_softmax(t((4, 5))), ti((4,), 5))),
+    ("SmoothL1Loss", dict(), lambda: (t((4, 3)), t((4, 3), seed=1))),
+    ("HingeEmbeddingLoss", dict(),
+     lambda: (t((4, 3)),
+              paddle.to_tensor(np.sign(np.random.RandomState(1).randn(
+                  4, 3)).astype("float32")))),
+    ("MultiLabelSoftMarginLoss", dict(),
+     lambda: (t((4, 3)), ti((4, 3), 2).astype("float32"))),
+    ("SigmoidFocalLoss", dict(),
+     lambda: (t((4, 3)), ti((4, 3), 2).astype("float32"))),
+]
+
+
+@pytest.mark.parametrize("name,kwargs,build", PAIR_LOSSES,
+                         ids=[c[0] for c in PAIR_LOSSES])
+def test_loss_layer_runs(name, kwargs, build):
+    paddle.seed(0)
+    layer = getattr(nn, name)(**kwargs)
+    out = layer(*build())
+    assert np.isfinite(out.numpy()).all(), name
+
+
+def test_three_input_losses():
+    paddle.seed(0)
+    a, b = t((4, 5)), t((4, 5), seed=1)
+    y = paddle.to_tensor(np.sign(
+        np.random.RandomState(2).randn(4)).astype("float32"))
+    assert np.isfinite(float(nn.MarginRankingLoss()(
+        t((4,)), t((4,), seed=1), y)))
+    assert np.isfinite(float(nn.CosineEmbeddingLoss()(a, b, y)))
+    n = t((4, 5), seed=2)
+    assert np.isfinite(float(nn.TripletMarginLoss()(a, b, n)))
+    assert np.isfinite(float(nn.TripletMarginWithDistanceLoss()(a, b, n)))
+    assert tuple(nn.CosineSimilarity(axis=1)(a, b).shape) == (4,)
+    assert tuple(nn.PairwiseDistance()(a, b).shape) == (4,)
+
+
+def test_structured_layers():
+    paddle.seed(0)
+    # Fold/Unfold round shapes
+    unfold = nn.Unfold(kernel_sizes=2)
+    patches = unfold(t((1, 2, 4, 4)))
+    fold = nn.Fold(output_sizes=[4, 4], kernel_sizes=2)
+    assert tuple(fold(patches).shape) == (1, 2, 4, 4)
+    # unpooling with indices
+    x = t((1, 2, 6))
+    pooled, idx = paddle.nn.functional.max_pool1d(
+        x, kernel_size=2, return_mask=True)
+    assert tuple(nn.MaxUnPool1D(kernel_size=2)(
+        pooled, idx).shape) == (1, 2, 6)
+    x3 = t((1, 2, 4, 4, 4))
+    pooled3, idx3 = paddle.nn.functional.max_pool3d(
+        x3, kernel_size=2, return_mask=True)
+    assert tuple(nn.MaxUnPool3D(kernel_size=2)(
+        pooled3, idx3).shape) == (1, 2, 4, 4, 4)
+    # SpectralNorm normalizes the weight's largest singular value to ~1
+    sn = nn.SpectralNorm(weight_shape=[4, 6], power_iters=20)
+    w = sn(t((4, 6)))
+    s = np.linalg.svd(w.numpy(), compute_uv=False)
+    np.testing.assert_allclose(s[0], 1.0, atol=0.15)
+    # containers
+    ld = nn.LayerDict({"a": nn.Linear(3, 3)})
+    assert "a" in ld
+    pl = nn.ParameterList([paddle.create_parameter([2, 2], "float32")])
+    assert len(list(pl)) == 1
+
+
+def test_rnn_wrappers_and_sync_bn():
+    paddle.seed(0)
+    rnn = nn.SimpleRNN(4, 6)
+    out, h = rnn(t((2, 5, 4)))
+    assert tuple(out.shape) == (2, 5, 6)
+    bi = nn.BiRNN(nn.GRUCell(4, 6), nn.GRUCell(4, 6))
+    out, _ = bi(t((2, 5, 4)))
+    assert tuple(out.shape) == (2, 5, 12)
+    # SyncBatchNorm degenerates to BatchNorm without a live mesh
+    sbn = nn.SyncBatchNorm(3)
+    sbn.train()
+    out = sbn(t((2, 3, 4, 4)))
+    assert np.isfinite(out.numpy()).all()
