@@ -123,10 +123,9 @@ class RandomCrop:
         self.padding = padding
 
     def __call__(self, img):
-        img = np.asarray(img)
+        img = functional._chw(img)
         if self.padding:
-            p = self.padding
-            img = np.pad(img, [(0, 0), (p, p), (p, p)])
+            img = functional.pad(img, self.padding)
         c, h, w = img.shape
         th, tw = self.size
         i = np.random.randint(0, h - th + 1)
@@ -139,8 +138,9 @@ class RandomHorizontalFlip:
         self.prob = prob
 
     def __call__(self, img):
+        img = functional._chw(img)  # layout must not depend on the coin
         if np.random.rand() < self.prob:
-            return np.asarray(img)[:, :, ::-1].copy()
+            return functional.hflip(img)
         return img
 
 
@@ -149,8 +149,9 @@ class RandomVerticalFlip:
         self.prob = prob
 
     def __call__(self, img):
+        img = functional._chw(img)  # layout must not depend on the coin
         if np.random.rand() < self.prob:
-            return np.asarray(img)[:, ::-1].copy()
+            return functional.vflip(img)
         return img
 
 
@@ -161,7 +162,7 @@ class BrightnessTransform:
     def __call__(self, img):
         alpha = np.random.uniform(max(0.0, 1 - self.value),
                                   1 + self.value)
-        return np.asarray(img, np.float32) * alpha
+        return functional._chw(img).astype(np.float32) * alpha
 
 
 class Pad:
@@ -260,7 +261,7 @@ class RandomAffine:
         self.shear, self.fill, self.center = shear, fill, center
 
     def __call__(self, img):
-        img = np.asarray(img)
+        img = functional._chw(img)
         h, w = img.shape[-2:]
         angle = np.random.uniform(*self.degrees)
         tx = ty = 0.0
@@ -285,9 +286,9 @@ class RandomPerspective:
         self.prob, self.scale, self.fill = prob, distortion_scale, fill
 
     def __call__(self, img):
+        img = functional._chw(img)  # layout must not depend on the coin
         if np.random.rand() >= self.prob:
             return img
-        img = np.asarray(img)
         h, w = img.shape[-2:]
         dx, dy = self.scale * w / 2, self.scale * h / 2
         start = [[0, 0], [w - 1, 0], [w - 1, h - 1], [0, h - 1]]
@@ -310,11 +311,9 @@ class RandomErasing:
         self.value, self.inplace = value, inplace
 
     def __call__(self, img):
+        img = functional._chw(img).astype(np.float32)
         if np.random.rand() >= self.prob:
             return img
-        img = np.asarray(img, np.float32)
-        if img.ndim == 2:
-            img = img[None]
         c, h, w = img.shape
         for _ in range(10):
             area = h * w * np.random.uniform(*self.scale)
@@ -344,9 +343,7 @@ class RandomResizedCrop:
         self.interpolation = interpolation
 
     def __call__(self, img):
-        img = np.asarray(img, np.float32)
-        if img.ndim == 2:
-            img = img[None]
+        img = functional._chw(img).astype(np.float32)
         c, h, w = img.shape
         for _ in range(10):
             area = h * w * np.random.uniform(*self.scale)

@@ -22,6 +22,8 @@ __all__ = ["to_tensor", "resize", "crop", "center_crop", "hflip",
 
 
 def _chw(img):
+    if hasattr(img, "_data"):  # paddle Tensor (e.g. ToTensor output)
+        img = img._data
     arr = np.asarray(img)
     if arr.ndim == 2:
         arr = arr[None]
@@ -32,12 +34,18 @@ def _chw(img):
 
 
 def to_tensor(pic, data_format="CHW"):
-    arr = _chw(pic).astype(np.float32)
-    if arr.max() > 1.5:
+    """Returns a paddle Tensor (the reference contract — F.to_tensor is
+    the pipeline step that leaves numpy-land); uint8 inputs scale to
+    [0, 1]."""
+    from ...framework.tensor import Tensor
+    arr = _chw(pic)
+    is_uint8 = arr.dtype == np.uint8
+    arr = arr.astype(np.float32)
+    if is_uint8:  # dtype decides, not value range: float inputs pass
         arr = arr / 255.0
     if data_format == "HWC":
         arr = arr.transpose(1, 2, 0)
-    return arr
+    return Tensor(arr)
 
 
 def resize(img, size, interpolation="bilinear"):
@@ -114,11 +122,18 @@ def pad(img, padding, fill=0, padding_mode="constant"):
 
 
 def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    is_tensor = hasattr(img, "_data")
+    if is_tensor:
+        img = np.asarray(img._data)
     arr = np.asarray(img, np.float32)
     shape = (-1, 1, 1) if data_format == "CHW" else (1, 1, -1)
     mean = np.asarray(mean, np.float32).reshape(shape)
     std = np.asarray(std, np.float32).reshape(shape)
-    return (arr - mean) / std
+    out = (arr - mean) / std
+    if is_tensor:  # Tensor in -> Tensor out (reference semantics)
+        from ...framework.tensor import Tensor
+        return Tensor(out.astype(np.float32))
+    return out
 
 
 def _inverse_sample(img, inv, fill=0.0):

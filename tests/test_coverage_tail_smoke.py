@@ -1,0 +1,115 @@
+"""Call-path smoke for the remaining unexercised public names:
+fft variants, linalg.det, and the vision transforms no other test runs.
+Values pinned against numpy/torch equivalents."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import transforms as T
+
+rng = np.random.RandomState(0)
+
+
+def t(x):
+    return paddle.to_tensor(np.asarray(x))
+
+
+class TestFFTTail:
+    X2 = rng.randn(4, 6).astype("float32")
+
+    def test_rfft2_irfft2_roundtrip(self):
+        f = paddle.fft.rfft2(t(self.X2))
+        np.testing.assert_allclose(np.asarray(f.numpy()),
+                                   np.fft.rfft2(self.X2), rtol=1e-4,
+                                   atol=1e-5)
+        back = paddle.fft.irfft2(f, s=self.X2.shape)
+        np.testing.assert_allclose(back.numpy(), self.X2, rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_rfftn_irfftn(self):
+        x = rng.randn(3, 4, 5).astype("float32")
+        f = paddle.fft.rfftn(t(x))
+        np.testing.assert_allclose(np.asarray(f.numpy()), np.fft.rfftn(x),
+                                   rtol=1e-4, atol=1e-5)
+        back = paddle.fft.irfftn(f, s=x.shape)
+        np.testing.assert_allclose(back.numpy(), x, rtol=1e-4, atol=1e-5)
+
+    def test_ifft2_ifftn(self):
+        x = (rng.randn(4, 4) + 1j * rng.randn(4, 4)).astype("complex64")
+        np.testing.assert_allclose(
+            np.asarray(paddle.fft.ifft2(t(x)).numpy()), np.fft.ifft2(x),
+            rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(paddle.fft.ifftn(t(x)).numpy()), np.fft.ifftn(x),
+            rtol=1e-4, atol=1e-5)
+
+    def test_shift_and_freqs(self):
+        x = rng.randn(5).astype("float32")
+        np.testing.assert_allclose(
+            np.asarray(paddle.fft.ifftshift(
+                paddle.fft.fftshift(t(x))).numpy()), x, rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(paddle.fft.rfftfreq(8, d=0.5).numpy()),
+            np.fft.rfftfreq(8, d=0.5), rtol=1e-6)
+
+
+def test_linalg_det():
+    x = rng.randn(3, 3).astype("float32")
+    np.testing.assert_allclose(float(paddle.linalg.det(t(x))),
+                               np.linalg.det(x), rtol=1e-4)
+    batch = rng.randn(4, 2, 2).astype("float32")
+    np.testing.assert_allclose(paddle.linalg.det(t(batch)).numpy(),
+                               np.linalg.det(batch), rtol=1e-4)
+
+
+class TestTransformsTail:
+    """This backend's transforms pipeline is numpy-CHW internally (see
+    transforms/functional.py docstring); ToTensor/Normalize are the
+    Tensor boundary, matching the reference's contract there."""
+
+    IMG = (rng.rand(16, 16, 3) * 255).astype("uint8")
+    CHW = IMG.transpose(2, 0, 1)
+
+    def test_to_tensor_returns_scaled_tensor(self):
+        out = T.ToTensor()(self.IMG)
+        arr = out.numpy()  # must BE a Tensor (reference contract)
+        assert arr.shape == (3, 16, 16)
+        np.testing.assert_allclose(arr, self.CHW / 255.0, rtol=1e-6)
+        # float input: dtype (not value range) decides scaling
+        f = T.ToTensor()(self.IMG.astype("float32"))
+        np.testing.assert_allclose(f.numpy(), self.CHW.astype("float32"))
+
+    def test_normalize_tensor_round_trip(self):
+        out = T.ToTensor()(self.IMG)
+        nrm = T.Normalize(mean=[0.5] * 3, std=[0.5] * 3)(out)
+        np.testing.assert_allclose(nrm.numpy(),
+                                   (self.CHW / 255.0 - 0.5) / 0.5,
+                                   rtol=1e-4, atol=1e-6)
+        f = T.normalize(out, mean=[0.5] * 3, std=[0.5] * 3)
+        np.testing.assert_allclose(f.numpy(), nrm.numpy(), rtol=1e-4,
+                                   atol=1e-6)
+
+    def test_crops_and_pad(self):
+        out = np.asarray(T.CenterCrop(8)(self.IMG))
+        np.testing.assert_array_equal(out, self.CHW[:, 4:12, 4:12])
+        assert np.asarray(T.RandomCrop(8)(self.IMG)).shape == (3, 8, 8)
+        padded = np.asarray(T.Pad(2)(self.IMG))
+        assert padded.shape == (3, 20, 20)
+        np.testing.assert_array_equal(padded[:, 2:-2, 2:-2], self.CHW)
+
+    def test_flips_and_rotations_run(self):
+        flipped = np.asarray(T.RandomVerticalFlip(prob=1.0)(self.IMG))
+        np.testing.assert_array_equal(flipped, self.CHW[:, ::-1])
+        for tr in (T.RandomRotation(15), T.RandomAffine(10),
+                   T.RandomPerspective(prob=1.0)):
+            out = np.asarray(tr(self.IMG))
+            assert out.shape == (3, 16, 16)
+
+    def test_color_jitters_run(self):
+        for tr in (T.BrightnessTransform(0.4), T.ContrastTransform(0.4),
+                   T.SaturationTransform(0.4), T.HueTransform(0.2)):
+            out = np.asarray(tr(self.IMG))
+            assert out.shape[0] == 3 and np.isfinite(out).all()
+        np.testing.assert_allclose(
+            np.asarray(T.BrightnessTransform(0.0)(self.IMG)),
+            self.CHW.astype("float32"))
