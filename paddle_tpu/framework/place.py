@@ -65,17 +65,20 @@ class TPUPlace(Place):
 
 
 class NPUPlace(Place):
-    """Accepted for reference API parity; resolves to the TPU backend."""
+    """Accepted for reference API parity; resolves to the TPU backend
+    (same mapping as set_device's 'xpu' alias)."""
 
-    def __init__(self, device_id: int = 0):
-        super().__init__("npu", device_id)
+    device_type = "tpu"
 
 
 class CUDAPinnedPlace(Place):
-    """Reference parity: pinned host memory is PjRt's concern on TPU."""
+    """Reference parity: pinned host memory lives on the HOST, so this
+    resolves to CPU; actual pinning is PjRt's concern on TPU."""
+
+    device_type = "cpu"
 
     def __init__(self):
-        super().__init__("cuda_pinned", 0)
+        super().__init__(0)
 
 
 class CUDAPlace(Place):
@@ -118,7 +121,8 @@ def set_device(device) -> Place:
         name, sidx = name.split(":", 1)
         idx = int(sidx)
     cls = {"cpu": CPUPlace, "tpu": TPUPlace, "gpu": CUDAPlace,
-           "cuda": CUDAPlace, "xpu": TPUPlace}.get(name)
+           "cuda": CUDAPlace, "xpu": TPUPlace, "npu": NPUPlace,
+           "cuda_pinned": CUDAPinnedPlace}.get(name)
     if cls is None:
         raise ValueError(f"Unknown device {device!r}")
     _current_place = cls(idx)
