@@ -6,5 +6,6 @@ incubate/nn/layer/fused_transformer.py.
 """
 from .gpt import GPTConfig, GPTModel, GPTForPretraining  # noqa: F401
 from .bert import BertConfig, BertModel, BertForQuestionAnswering  # noqa: F401
-from .generation import GenerationConfig, generate  # noqa: F401
+from .generation import (GenerationConfig, generate,  # noqa: F401
+                         save_for_serving)
 from .seq2seq import TransformerModel  # noqa: F401

@@ -27,7 +27,44 @@ import numpy as np
 
 from ..framework.tensor import Tensor, no_grad_guard
 
-__all__ = ["GenerationConfig", "generate"]
+__all__ = ["GenerationConfig", "generate", "save_for_serving"]
+
+
+def save_for_serving(model, path, batch, prompt_len, **generate_kwargs):
+    """Export the COMPILED generate loop as an inference artifact: one
+    StableHLO program (prefill + while_loop decode + sampling, weights
+    baked in) serving ``ids [batch, prompt_len] -> tokens``. Loadable by
+    jit.load / inference.create_predictor — including from C via the
+    PDT_* API — with no Python model code at serve time. Sampling
+    strategy and budgets are FROZEN into the artifact (pass them here);
+    shapes are fixed to the serving shape class, the same contract as
+    the BatchingEngine's pow2 buckets. Reference analog: exporting
+    fused_multi_transformer inference programs for analysis_predictor
+    (paddle/fluid/inference/api/analysis_predictor.cc:1).
+
+    Sampling caveat: the PRNG key is a trace CONSTANT in the artifact,
+    so a sampled export returns the same tokens for a given prompt on
+    every call — sampling picks a fixed draw per artifact, it does not
+    re-randomize per request. That is only sane when the caller chose
+    the draw, so an unseeded ``do_sample=True`` export is rejected."""
+    from .. import jit
+    from ..static import InputSpec
+
+    if generate_kwargs.get("do_sample") and \
+            generate_kwargs.get("seed") is None:
+        raise ValueError(
+            "save_for_serving(do_sample=True) requires an explicit seed: "
+            "the key is baked into the artifact as a constant, so the "
+            "export freezes ONE draw per prompt — make that choice "
+            "explicit (and avoid silently advancing the global RNG at "
+            "export time)")
+
+    def _serve(ids):
+        return generate(model, ids, **generate_kwargs)
+
+    return jit.save(_serve, path,
+                    input_spec=[InputSpec([int(batch), int(prompt_len)],
+                                          "int32")])
 
 
 @dataclass

@@ -232,6 +232,38 @@ def test_bad_attention_masks_raise(tiny_model):
                  attention_mask=np.ones((2, 4), np.int32))
 
 
+def test_save_for_serving_roundtrip(tiny_model, tmp_path):
+    """The compiled decode loop must survive StableHLO export: saved
+    artifact == live generate, greedy and beam, through jit.load AND the
+    Predictor (the C-API-compatible serve path)."""
+    from paddle_tpu import inference, jit
+    from paddle_tpu.models import save_for_serving
+
+    ids = _prompt()
+    path = str(tmp_path / "gen")
+    save_for_serving(tiny_model, path, batch=2, prompt_len=8,
+                     max_new_tokens=5, eos_token_id=3, pad_token_id=0)
+    direct = generate(tiny_model, ids, max_new_tokens=5, eos_token_id=3,
+                      pad_token_id=0).numpy()
+    loaded = jit.load(path)
+    np.testing.assert_array_equal(
+        loaded(paddle.to_tensor(ids)).numpy(), direct)
+    pred = inference.create_predictor(inference.Config(path + ".pdmodel"))
+    np.testing.assert_array_equal(np.asarray(pred.run([ids])[0]), direct)
+
+    with pytest.raises(ValueError, match="explicit seed"):
+        save_for_serving(tiny_model, str(tmp_path / "x"), batch=2,
+                         prompt_len=8, max_new_tokens=2, do_sample=True)
+
+    bpath = str(tmp_path / "gen_beam")
+    save_for_serving(tiny_model, bpath, batch=2, prompt_len=8,
+                     max_new_tokens=4, num_beams=3)
+    beam = generate(tiny_model, ids, max_new_tokens=4,
+                    num_beams=3).numpy()
+    np.testing.assert_array_equal(
+        jit.load(bpath)(paddle.to_tensor(ids)).numpy(), beam)
+
+
 def test_model_method_and_training_mode_restored(tiny_model):
     tiny_model.train()
     try:
