@@ -248,3 +248,40 @@ def test_precision_recall_metrics():
     assert isinstance(m.name(), str)
     m.reset()
     assert np.isnan(m.accumulate()) or m.accumulate() in (0.0,)
+
+
+def test_incubate_fused_matmul_bias():
+    import paddle_tpu.incubate.nn.functional as incf
+    x, w = A[:2], B.T[:, :2]
+    b = np.float32([0.5, -0.5])
+    out = incf.fused_matmul_bias(t(x), t(w), t(b))
+    np.testing.assert_allclose(out.numpy(), x @ w + b, rtol=1e-5)
+
+
+def test_fluid_sequence_tail():
+    import paddle_tpu.static as static
+    x = t(np.arange(6, dtype=np.float32).reshape(2, 3, 1))
+    y = t(np.zeros((2, 3, 1), np.float32))
+    out = static.nn.sequence_expand_as(x, y)
+    # each row's sequence tiled once per y-row timestep: [B, Ty, Tx, D]
+    ref = np.tile(np.arange(6, dtype=np.float32).reshape(2, 1, 3, 1),
+                  (1, 3, 1, 1))
+    np.testing.assert_allclose(np.asarray(out.numpy()), ref)
+    upd = t(np.ones((2, 2, 1), np.float32))
+    idx = t(np.array([[0, 2], [1, 0]]))
+    sc = static.nn.sequence_scatter(x, idx, upd)
+    ref = np.arange(6, dtype=np.float32).reshape(2, 3, 1).copy()
+    ref[0, 0] += 1; ref[0, 2] += 1; ref[1, 1] += 1; ref[1, 0] += 1
+    np.testing.assert_allclose(np.asarray(sc.numpy()), ref)
+
+
+def test_static_nn_tail_builders():
+    paddle.enable_static()
+    try:
+        import paddle_tpu.static as static
+        with static.program_guard(static.Program()):
+            x = static.data("x", [2, 6, 4, 4], "float32")
+            g = static.nn.group_norm(x, groups=2)
+            assert list(g.shape) == [2, 6, 4, 4]
+    finally:
+        paddle.disable_static()
