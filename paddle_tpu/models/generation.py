@@ -27,7 +27,33 @@ import numpy as np
 
 from ..framework.tensor import Tensor, no_grad_guard
 
-__all__ = ["GenerationConfig", "generate", "save_for_serving"]
+__all__ = ["GenerationConfig", "generate", "save_for_serving",
+           "shard_params_megatron"]
+
+
+def shard_params_megatron(model, mesh, mp_axis="mp"):
+    """Place the model's parameters in the Megatron tensor-parallel
+    layout over ``mesh``: attention q/k/v and MLP-in column-sharded on
+    the output dim, out-proj/MLP-out row-sharded on the input dim
+    (weights are [in, out]), everything else replicated. One shared
+    policy for the sharded-decode tests and the multichip dryrun."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    col = NamedSharding(mesh, P(None, mp_axis))
+    row = NamedSharding(mesh, P(mp_axis, None))
+    rep = NamedSharding(mesh, P())
+    for name, p in model.named_parameters():
+        if p._data.ndim == 2 and any(k in name for k in (
+                "q_proj.weight", "k_proj.weight", "v_proj.weight",
+                "mlp_fc.weight")):
+            sh = col
+        elif p._data.ndim == 2 and any(k in name for k in (
+                "out_proj.weight", "mlp_proj.weight")):
+            sh = row
+        else:
+            sh = rep
+        p._data = jax.device_put(p._data, sh)
 
 
 def save_for_serving(model, path, batch, prompt_len, **generate_kwargs):

@@ -26,26 +26,8 @@ def model_and_prompt():
     return m, ids
 
 
-def _shard_params(model, mesh):
-    """Megatron layout: attention qkv/mlp_fc column-sharded, out_proj /
-    mlp_proj row-sharded over the 'mp' axis; everything else replicated."""
-    import jax
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
-    col = NamedSharding(mesh, P(None, "mp"))   # [in, out] split on out
-    row = NamedSharding(mesh, P("mp", None))   # [in, out] split on in
-    rep = NamedSharding(mesh, P())
-    for name, p in model.named_parameters():
-        if p._data.ndim == 2 and any(
-                k in name for k in ("q_proj.weight", "k_proj.weight",
-                                    "v_proj.weight", "mlp_fc.weight")):
-            sh = col
-        elif p._data.ndim == 2 and any(
-                k in name for k in ("out_proj.weight", "mlp_proj.weight")):
-            sh = row
-        else:
-            sh = rep
-        p._data = jax.device_put(p._data, sh)
+from paddle_tpu.models.generation import \
+    shard_params_megatron as _shard_params  # one shared layout policy
 
 
 def test_tp_sharded_greedy_matches_unsharded(model_and_prompt):
