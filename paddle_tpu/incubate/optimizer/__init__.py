@@ -7,6 +7,8 @@ arrays.
 """
 from __future__ import annotations
 
+import contextlib
+
 import jax.numpy as jnp
 
 from ...framework.tensor import Tensor
@@ -100,15 +102,25 @@ class ModelAverage(Optimizer):
                 self._num[name] = window
         self._step_count += 1
 
+    @contextlib.contextmanager
     def apply(self, executor=None, need_restore=True):
+        """Context manager (reference contract: ``with ma.apply(): ...``,
+        modelaverage.py:377 @signature_safe_contextmanager): swaps the
+        running averages into the parameters for the block's duration and
+        restores the live weights on exit unless need_restore=False."""
         self._backup = {p.name: p._data
                         for p in self._parameter_list or []}
         for p in self._parameter_list or []:
             n = self._num.get(p.name, 0)
             if n > 0:
                 p._data = self._sum[p.name] / n
-        if not need_restore:
-            self._backup = None
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+            else:
+                self._backup = None
 
     def restore(self, executor=None):
         if self._backup is None:

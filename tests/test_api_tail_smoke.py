@@ -285,3 +285,61 @@ def test_static_nn_tail_builders():
             assert list(g.shape) == [2, 6, 4, 4]
     finally:
         paddle.disable_static()
+
+
+def test_lookahead_alpha_extremes():
+    """alpha=0: every k-boundary snaps the fast weights BACK to the
+    initial slow copy; alpha=1: the sync is a no-op (pure inner SGD)."""
+    def run(alpha, k=2, steps=2):
+        paddle.seed(0)
+        lin = paddle.nn.Linear(2, 1)
+        w0 = lin.weight.numpy().copy()
+        inner = paddle.optimizer.SGD(learning_rate=0.5,
+                                     parameters=lin.parameters())
+        la = paddle.incubate.optimizer.LookAhead(inner, alpha=alpha, k=k)
+        x = t(np.array([[1.0, 2.0], [3.0, -1.0]], "float32"))
+        for _ in range(steps):
+            loss = (lin(x) ** 2).mean()
+            loss.backward()
+            la.step()
+            la.clear_grad()
+        return w0, lin.weight.numpy().copy()
+
+    w0, w = run(alpha=0.0)
+    np.testing.assert_allclose(w, w0, rtol=1e-6)   # snapped back
+
+    paddle.seed(0)
+    ref = paddle.nn.Linear(2, 1)
+    sgd = paddle.optimizer.SGD(learning_rate=0.5,
+                               parameters=ref.parameters())
+    x = t(np.array([[1.0, 2.0], [3.0, -1.0]], "float32"))
+    for _ in range(2):
+        loss = (ref(x) ** 2).mean()
+        loss.backward()
+        sgd.step()
+        sgd.clear_grad()
+    _, w1 = run(alpha=1.0)
+    np.testing.assert_allclose(w1, ref.weight.numpy(), rtol=1e-6)
+
+
+def test_model_average_context_manager():
+    paddle.seed(1)
+    lin = paddle.nn.Linear(3, 1)
+    ps = lin.parameters()
+    ma = paddle.incubate.optimizer.ModelAverage(0.15, parameters=ps)
+    snaps = []
+    # drive the weights on a deliberately moving trajectory
+    for i in range(3):
+        lin.weight._data = lin.weight._data + np.float32(0.1 * (i + 1))
+        ma.step()
+        snaps.append(lin.weight.numpy().copy())
+    live = snaps[-1]
+    with ma.apply():
+        inside = lin.weight.numpy().copy()
+    np.testing.assert_allclose(inside, np.mean(snaps, axis=0), rtol=1e-6)
+    assert not np.allclose(inside, live)
+    np.testing.assert_allclose(lin.weight.numpy(), live)  # restored
+    with ma.apply(need_restore=False):
+        pass
+    np.testing.assert_allclose(lin.weight.numpy(),
+                               np.mean(snaps, axis=0), rtol=1e-6)

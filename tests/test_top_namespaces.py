@@ -212,10 +212,11 @@ class TestIncubateOptimizers:
             ma.step()
             opt.clear_grad()
         cur = np.asarray(lin.weight._data).copy()
-        ma.apply()
-        avg = np.asarray(lin.weight._data).copy()
-        assert not np.allclose(cur, avg)
-        ma.restore()
+        # reference contract: apply() is a context manager
+        # (modelaverage.py:377 @signature_safe_contextmanager)
+        with ma.apply():
+            avg = np.asarray(lin.weight._data).copy()
+            assert not np.allclose(cur, avg)
         np.testing.assert_allclose(np.asarray(lin.weight._data), cur)
 
 
