@@ -256,6 +256,21 @@ def test_lockstep_training_tracks_torch():
     np.testing.assert_allclose(ours[0], theirs[0], rtol=1e-5)
 
 
+def test_export_translation_artifact(model, tmp_path):
+    """The compiled seq2seq decode (encoder + while_loop beam) must
+    survive StableHLO export and serve src -> tokens standalone."""
+    from paddle_tpu import jit
+    from paddle_tpu.static import InputSpec
+
+    src = _src(seed=9)
+    direct = model.generate(src, max_length=7, num_beams=3).numpy()
+    path = str(tmp_path / "mt")
+    jit.save(lambda s: model.generate(s, max_length=7, num_beams=3),
+             path, input_spec=[InputSpec([2, 6], "int32")])
+    out = jit.load(path)(paddle.to_tensor(src)).numpy()
+    np.testing.assert_array_equal(out, direct)
+
+
 def test_length_budget_validation(model):
     with pytest.raises(ValueError, match="positional table"):
         model.generate(_src(), max_length=100)
