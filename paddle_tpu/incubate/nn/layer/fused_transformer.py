@@ -3,6 +3,8 @@ fused_transformer.py:176/437/641)."""
 from __future__ import annotations
 
 import math
+
+import numpy as np
 from typing import Optional
 
 from .... import nn
@@ -57,11 +59,7 @@ class FusedMultiHeadAttention(Layer):
         self.out_proj = nn.Linear(embed_dim, embed_dim)
         self.norm = nn.LayerNorm(embed_dim, epsilon=epsilon)
 
-    def forward(self, x, attn_mask=None, cache=None):
-        if cache is not None:
-            raise NotImplementedError(
-                "incremental-decode cache is not supported by the fused "
-                "attention layer; use nn.MultiHeadAttention")
+    def forward(self, x, attn_mask=None, cache=None, time_step=None):
         b, s, d = x.shape
         residual = x
         if self.normalize_before:
@@ -72,9 +70,14 @@ class FusedMultiHeadAttention(Layer):
         q = qkv[:, :, 0]
         k = qkv[:, :, 1]
         v = qkv[:, :, 2]                             # [B, S, H, Dh]
-        out = F.scaled_dot_product_attention(
-            q, k, v, attn_mask=attn_mask,
-            dropout_p=self.attn_dropout_rate if self.training else 0.0)
+        if cache is None:
+            out = F.scaled_dot_product_attention(
+                q, k, v, attn_mask=attn_mask,
+                dropout_p=self.attn_dropout_rate if self.training else 0.0)
+            new_cache = None
+        else:
+            out, new_cache = self._cached_attention(q, k, v, cache,
+                                                    time_step, attn_mask)
         out = call_op("reshape", out, shape=(b, s, d))
         out = self.out_proj(out)
         if self.dropout_rate and self.training:
@@ -82,7 +85,61 @@ class FusedMultiHeadAttention(Layer):
         out = residual + out
         if not self.normalize_before:
             out = self.norm(out)
-        return out
+        return out if cache is None else (out, new_cache)
+
+    def _cached_attention(self, q, k, v, cache, time_step, attn_mask):
+        """Fixed-capacity CacheKV attention, the reference kernel's
+        layout: cache [2, B, H, max_len, Dh]
+        (fused_multi_transformer_op.cu:1). time_step=None is the context
+        (prefill) stage — the prompt's K/V land at slots [0, S); an
+        int/Tensor time_step writes the chunk at [t, t+S) (S=1 is the
+        usual decode step). Queries attend causally to slots <= their
+        own, intersected with any caller attn_mask. Functional update:
+        the new cache is RETURNED, not aliased."""
+        import jax.numpy as jnp
+        from jax import lax
+
+        from ....framework.tensor import Tensor
+        ckv = cache._data if isinstance(cache, Tensor) else \
+            jnp.asarray(cache)
+        max_len = ckv.shape[3]
+        # [B, S, H, Dh] -> the cache's [B, H, S, Dh]
+        kv = jnp.stack([jnp.swapaxes(k._data, 1, 2),
+                        jnp.swapaxes(v._data, 1, 2)]).astype(ckv.dtype)
+        z = jnp.int32(0)
+        s = q.shape[1]
+        if time_step is None:                         # prefill
+            start = 0
+        else:
+            ts = time_step._data if isinstance(time_step, Tensor) else \
+                time_step
+            start = ts
+        if isinstance(start, (int, np.integer)):
+            if int(start) + s > max_len:
+                raise ValueError(
+                    f"time_step {int(start)} + chunk {s} exceeds the "
+                    f"cache capacity {max_len} — dynamic_update_slice "
+                    f"would silently clamp and corrupt slot "
+                    f"{max_len - 1}")
+        pos = jnp.asarray(start, jnp.int32).reshape(())
+        # query at slot pos+i attends to cache slots <= pos+i
+        valid = (jnp.arange(max_len)[None, :] <=
+                 (pos + jnp.arange(s))[:, None])[None, None]  # [1,1,S,L]
+        if attn_mask is not None:
+            m = attn_mask._data if isinstance(attn_mask, Tensor) else \
+                jnp.asarray(attn_mask)
+            if m.dtype == jnp.bool_:
+                mask = valid & m
+            else:  # additive float mask: keep it, kill invalid slots
+                mask = jnp.where(valid, m.astype(jnp.float32), -1e30)
+        else:
+            mask = valid
+        ckv = lax.dynamic_update_slice(ckv, kv, (z, z, z, pos, z))
+        k_full = Tensor(jnp.swapaxes(ckv[0], 1, 2))   # [B, L, H, Dh]
+        v_full = Tensor(jnp.swapaxes(ckv[1], 1, 2))
+        out = F.scaled_dot_product_attention(
+            q, k_full, v_full, attn_mask=Tensor(mask))
+        return out, Tensor(ckv, stop_gradient=True)
 
 
 class FusedFeedForward(Layer):
@@ -149,12 +206,13 @@ class FusedTransformerEncoderLayer(Layer):
             act_dropout_rate=act_dropout_rate,
             normalize_before=normalize_before)
 
-    def forward(self, src, src_mask=None, cache=None):
-        # pass cache through: the inner layer raises NotImplementedError
-        # for it — silently dropping decode state would recompute full
-        # attention with no diagnostic
-        out = self.fused_attn(src, attn_mask=src_mask, cache=cache)
-        return self.ffn(out)
+    def forward(self, src, src_mask=None, cache=None, time_step=None):
+        if cache is None:
+            out = self.fused_attn(src, attn_mask=src_mask)
+            return self.ffn(out)
+        out, new_cache = self.fused_attn(src, attn_mask=src_mask,
+                                         cache=cache, time_step=time_step)
+        return self.ffn(out), new_cache
 
 
 class FusedLinear(Layer):
@@ -238,11 +296,37 @@ class FusedMultiTransformer(Layer):
                 normalize_before=True)
             for _ in range(num_layers)])
 
+    def gen_cache(self, batch, max_len, dtype="float32"):
+        """Preallocate the per-layer CacheKV tensors the reference makes
+        callers build by hand: list of [2, B, num_heads, max_len,
+        head_dim] zeros (fused_multi_transformer_op.cu CacheKV layout)."""
+        import jax.numpy as jnp
+
+        from ....framework.tensor import Tensor
+        a = self.layers[0].fused_attn
+        shape = (2, batch, a.num_heads, max_len, a.head_dim)
+        return [Tensor(jnp.zeros(shape, jnp.dtype(dtype)),
+                       stop_gradient=True) for _ in self.layers]
+
     def forward(self, src, attn_mask=None, caches=None, time_step=None):
-        if caches is not None or time_step is not None:
-            raise NotImplementedError(
-                "decode-cache stepping is served by models/gpt.py's "
-                "cached decoding on this backend")
+        if time_step is not None and caches is None:
+            raise ValueError(
+                "time_step requires caches (decode steps read/write the "
+                "CacheKV tensors); pass caches=gen_cache(...)")
+        if caches is not None:
+            # inference stages (reference contract: returns (out, caches)):
+            # time_step None = context/prefill, else chunk decode at t
+            if len(caches) != len(self.layers):
+                raise ValueError(
+                    f"got {len(caches)} cache tensors for "
+                    f"{len(self.layers)} layers")
+            out = src
+            new_caches = []
+            for layer, c in zip(self.layers, caches):
+                out, nc = layer(out, src_mask=attn_mask, cache=c,
+                                time_step=time_step)
+                new_caches.append(nc)
+            return out, new_caches
         if attn_mask is None:
             # the reference kernel is a CAUSAL decoder by construction —
             # ported callers pass no mask and still expect causality

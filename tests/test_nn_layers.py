@@ -225,6 +225,73 @@ class TestFusedTransformerLayers:
     """incubate.nn fused layers (reference fused_transformer.py) — parity
     with the unfused composition and trainability."""
 
+    def test_fused_multi_transformer_cachekv_matches_full(self):
+        """Reference serving contract (fused_multi_transformer_op.cu
+        CacheKV): prefill the prompt into [2, B, H, max_len, Dh] caches,
+        then decode token-by-token with time_step — every incremental
+        hidden state must equal the full causal forward's."""
+        import numpy as np
+        from paddle_tpu.incubate.nn import FusedMultiTransformer
+        paddle.framework.random.seed(44)
+        fmt = FusedMultiTransformer(32, 4, 64, dropout_rate=0.0,
+                                    normalize_before=True, num_layers=2)
+        fmt.eval()
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(2, 7, 32).astype("float32"))
+        full = fmt(x).numpy()                      # causal by construction
+
+        S, L = 4, 7
+        caches = fmt.gen_cache(batch=2, max_len=L)
+        pre, caches = fmt(x[:, :S], caches=caches)  # context stage
+        np.testing.assert_allclose(pre.numpy(), full[:, :S],
+                                   rtol=1e-4, atol=1e-5)
+        for t in range(S, L):                       # decode stage
+            step, caches = fmt(x[:, t:t + 1], caches=caches, time_step=t)
+            np.testing.assert_allclose(step.numpy(), full[:, t:t + 1],
+                                       rtol=1e-4, atol=1e-5,
+                                       err_msg=f"step {t}")
+
+    def test_fused_multi_transformer_cache_guards(self):
+        import numpy as np
+        import pytest as _pytest
+        from paddle_tpu.incubate.nn import FusedMultiTransformer
+        paddle.framework.random.seed(45)
+        fmt = FusedMultiTransformer(16, 2, 32, dropout_rate=0.0,
+                                    num_layers=2, normalize_before=True)
+        fmt.eval()
+        x = paddle.to_tensor(np.zeros((1, 3, 16), "float32"))
+        with _pytest.raises(ValueError, match="time_step requires caches"):
+            fmt(x, time_step=2)
+        with _pytest.raises(ValueError, match="cache tensors"):
+            fmt(x, caches=fmt.gen_cache(1, 8)[:1])
+        caches = fmt.gen_cache(1, 4)
+        _, caches = fmt(x, caches=caches)
+        with _pytest.raises(ValueError, match="capacity"):
+            fmt(x[:, :1], caches=caches, time_step=4)  # cache full
+
+    def test_fused_multi_transformer_chunked_decode(self):
+        """A 2-token chunk with time_step must equal two single steps —
+        each chunk token attends to itself and everything before it."""
+        import numpy as np
+        from paddle_tpu.incubate.nn import FusedMultiTransformer
+        paddle.framework.random.seed(46)
+        fmt = FusedMultiTransformer(32, 4, 64, dropout_rate=0.0,
+                                    num_layers=2, normalize_before=True)
+        fmt.eval()
+        rng = np.random.RandomState(2)
+        x = paddle.to_tensor(rng.randn(2, 6, 32).astype("float32"))
+        c1 = fmt.gen_cache(2, 6)
+        _, c1 = fmt(x[:, :4], caches=c1)
+        chunk, _ = fmt(x[:, 4:6], caches=c1, time_step=4)
+        c2 = fmt.gen_cache(2, 6)
+        _, c2 = fmt(x[:, :4], caches=c2)
+        s4, c2 = fmt(x[:, 4:5], caches=c2, time_step=4)
+        s5, _ = fmt(x[:, 5:6], caches=c2, time_step=5)
+        np.testing.assert_allclose(chunk.numpy()[:, 0], s4.numpy()[:, 0],
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(chunk.numpy()[:, 1], s5.numpy()[:, 0],
+                                   rtol=1e-4, atol=1e-5)
+
     def test_fused_mha_shapes_and_train(self):
         from paddle_tpu.incubate.nn import FusedMultiHeadAttention
         paddle.framework.random.seed(40)
