@@ -56,7 +56,8 @@ def main():
     prompt = np.frombuffer(b"the quick", np.uint8).astype(np.int32)[None, :]
     n = prompt.shape[1]
     print("\ndecoding 'the quick' with each strategy (compiled loop):")
-    show("greedy", model.generate(prompt, max_new_tokens=24), n)
+    greedy = model.generate(prompt, max_new_tokens=24)
+    show("greedy", greedy, n)
     show("sampled t=0.8 top_k=12",
          model.generate(prompt, max_new_tokens=24, do_sample=True,
                         temperature=0.8, top_k=12, seed=1), n)
@@ -80,6 +81,19 @@ def main():
         txt = bytes(int(c) for c in out.numpy()[i, P:]
                     if 0 < c < 128).decode(errors="replace")
         print(f"  {t.decode()!r:20s} -> {txt!r}")
+
+    # export the greedy decode as a standalone serving artifact: one
+    # StableHLO program (weights baked), loadable from Python or C
+    import tempfile
+    from paddle_tpu import jit
+    from paddle_tpu.models import save_for_serving
+    path = tempfile.mkdtemp() + "/charlm"
+    save_for_serving(model, path, batch=1, prompt_len=n,
+                     max_new_tokens=24)
+    art = jit.load(path)(prompt).numpy()
+    same = bool((art == greedy.numpy()).all())
+    print(f"\nexported serving artifact at {path}.pdmodel "
+          f"(matches live decode: {same})")
 
 
 if __name__ == "__main__":
