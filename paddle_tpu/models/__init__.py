@@ -5,7 +5,9 @@ blocks in python/paddle/nn/layer/transformer.py and
 incubate/nn/layer/fused_transformer.py.
 """
 from .gpt import GPTConfig, GPTModel, GPTForPretraining  # noqa: F401
-from .bert import BertConfig, BertModel, BertForQuestionAnswering  # noqa: F401
+from .bert import (BertConfig, BertModel,  # noqa: F401
+                   BertForQuestionAnswering, BertForMaskedLM,
+                   BertForSequenceClassification)
 from .generation import (GenerationConfig, generate,  # noqa: F401
                          save_for_serving)
 from .seq2seq import TransformerModel  # noqa: F401

@@ -15,7 +15,7 @@ from ..framework.tensor import Tensor
 from ..nn import functional as F
 
 __all__ = ["BertConfig", "BertModel", "BertForQuestionAnswering",
-           "BertForSequenceClassification"]
+           "BertForSequenceClassification", "BertForMaskedLM"]
 
 
 @dataclass
@@ -120,6 +120,37 @@ class BertForQuestionAnswering(nn.Layer):
         loss = (F.cross_entropy(start_logits, start_positions) +
                 F.cross_entropy(end_logits, end_positions)) / 2.0
         return loss, start_logits, end_logits
+
+
+class BertForMaskedLM(nn.Layer):
+    """Masked-LM pretraining head: transform (dense + gelu + LN) then a
+    decoder TIED to the word-embedding table, with its own output bias —
+    the standard BERT pretraining objective. Positions labeled
+    ``ignore_index`` (-100, the masking convention) contribute no loss."""
+
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.bert = BertModel(cfg)
+        self.transform = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+        self.layer_norm = nn.LayerNorm(cfg.hidden_size)
+        self.decoder_bias = self.create_parameter(
+            [cfg.vocab_size], is_bias=True)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None, labels=None):
+        seq, _ = self.bert(input_ids, token_type_ids, position_ids,
+                           attention_mask)
+        h = self.layer_norm(F.gelu(self.transform(seq)))
+        logits = call_op(
+            "matmul", h, self.bert.embeddings.word_embeddings.weight,
+            transpose_y=True) + self.decoder_bias
+        if labels is None:
+            return logits
+        loss = F.cross_entropy(
+            call_op("reshape", logits, shape=(-1, logits.shape[-1])),
+            call_op("reshape", labels, shape=(-1,)),
+            ignore_index=-100, reduction="mean")
+        return loss, logits
 
 
 class BertForSequenceClassification(nn.Layer):

@@ -1,0 +1,43 @@
+"""BertForMaskedLM pretraining head (models/bert.py)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.models.bert import BertConfig, BertForMaskedLM
+
+
+def test_mlm_trains_and_ignores_unmasked():
+    paddle.seed(0)
+    cfg = BertConfig.tiny()
+    m = BertForMaskedLM(cfg)
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                parameters=m.parameters())
+    rng = np.random.RandomState(0)
+    ids = rng.randint(4, cfg.vocab_size, (4, 12)).astype(np.int64)
+    masked = ids.copy()
+    labels = np.full_like(ids, -100)
+    pos = rng.rand(*ids.shape) < 0.3
+    labels[pos] = ids[pos]
+    masked[pos] = 3  # [MASK]
+    losses = []
+    for _ in range(4):
+        loss, logits = m(paddle.to_tensor(masked),
+                         labels=paddle.to_tensor(labels))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+    assert tuple(logits.shape) == (4, 12, cfg.vocab_size)
+
+    # decoder is tied: the MLM loss backprops into the embedding table
+    loss, _ = m(paddle.to_tensor(masked), labels=paddle.to_tensor(labels))
+    loss.backward()
+    w = m.bert.embeddings.word_embeddings.weight
+    assert w.grad is not None and np.isfinite(w.grad.numpy()).all()
+    assert float(np.abs(w.grad.numpy()).max()) > 0.0
+    opt.clear_grad()
+
+    # ignore_index: all-ignored labels give zero loss contribution
+    allign = np.full_like(ids, -100)
+    loss0, _ = m(paddle.to_tensor(masked), labels=paddle.to_tensor(allign))
+    assert float(loss0) == 0.0
