@@ -84,7 +84,7 @@ class BertModel(nn.Layer):
         self.pooler = nn.Linear(cfg.hidden_size, cfg.hidden_size)
 
     def forward(self, input_ids, token_type_ids=None, position_ids=None,
-                attention_mask=None):
+                attention_mask=None, with_pool=True):
         import jax.numpy as jnp
         if attention_mask is not None and attention_mask.ndim == 2:
             # [B, L] 1/0 padding mask -> additive [B, 1, 1, L]
@@ -95,6 +95,8 @@ class BertModel(nn.Layer):
                  -1e9)[:, None, None, :])
         x = self.embeddings(input_ids, token_type_ids, position_ids)
         x = self.encoder(x, attention_mask)
+        if not with_pool:  # MLM pretraining never reads the pooler
+            return x, None
         pooled = call_op("tanh", self.pooler(x[:, 0]))
         return x, pooled
 
@@ -131,7 +133,10 @@ class BertForMaskedLM(nn.Layer):
     def __init__(self, cfg: BertConfig):
         super().__init__()
         self.bert = BertModel(cfg)
-        self.transform = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+        init = nn.ParamAttr(initializer=nn.initializer.Normal(
+            0.0, cfg.initializer_range))
+        self.transform = nn.Linear(cfg.hidden_size, cfg.hidden_size,
+                                   weight_attr=init)
         self.layer_norm = nn.LayerNorm(cfg.hidden_size)
         self.decoder_bias = self.create_parameter(
             [cfg.vocab_size], is_bias=True)
@@ -139,7 +144,7 @@ class BertForMaskedLM(nn.Layer):
     def forward(self, input_ids, token_type_ids=None, position_ids=None,
                 attention_mask=None, labels=None):
         seq, _ = self.bert(input_ids, token_type_ids, position_ids,
-                           attention_mask)
+                           attention_mask, with_pool=False)
         h = self.layer_norm(F.gelu(self.transform(seq)))
         logits = call_op(
             "matmul", h, self.bert.embeddings.word_embeddings.weight,
