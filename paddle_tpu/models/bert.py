@@ -113,7 +113,7 @@ class BertForQuestionAnswering(nn.Layer):
                 attention_mask=None, start_positions=None,
                 end_positions=None):
         seq, _ = self.bert(input_ids, token_type_ids, position_ids,
-                           attention_mask)
+                           attention_mask, with_pool=False)
         logits = self.classifier(seq)  # [B, L, 2]
         start_logits = logits[:, :, 0]
         end_logits = logits[:, :, 1]

@@ -1,0 +1,99 @@
+"""paddle.io samplers/datasets that no other test exercises, value-pinned
+(reference: python/paddle/io — fluid/dataloader/{sampler,dataset}.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import io
+
+
+class _Range(io.Dataset):
+    def __init__(self, n):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        return np.float32(i)
+
+
+class _Stream(io.IterableDataset):
+    def __init__(self, items):
+        self.items = list(items)
+
+    def __iter__(self):
+        return iter(self.items)
+
+
+def test_sequence_and_random_samplers():
+    ds = _Range(7)
+    assert list(io.SequenceSampler(ds)) == list(range(7))
+    np.random.seed(0)  # samplers draw from numpy, not the paddle RNG
+    order = list(io.RandomSampler(ds))
+    assert sorted(order) == list(range(7))
+    # with replacement + num_samples
+    r = list(io.RandomSampler(ds, replacement=True, num_samples=20))
+    assert len(r) == 20 and all(0 <= i < 7 for i in r)
+
+
+def test_weighted_random_sampler():
+    np.random.seed(0)
+    w = [0.0, 0.0, 1.0, 1.0]
+    picks = list(io.WeightedRandomSampler(w, num_samples=50,
+                                          replacement=True))
+    assert len(picks) == 50
+    assert set(picks) <= {2, 3}  # zero-weight rows never drawn
+
+
+def test_batch_sampler_drop_last():
+    ds = _Range(10)
+    bs = list(io.BatchSampler(ds, batch_size=4, drop_last=False))
+    assert [len(b) for b in bs] == [4, 4, 2]
+    bs2 = list(io.BatchSampler(ds, batch_size=4, drop_last=True))
+    assert [len(b) for b in bs2] == [4, 4]
+    # sampler-driven form
+    bs3 = list(io.BatchSampler(sampler=io.SequenceSampler(ds),
+                               batch_size=5))
+    assert bs3[0] == [0, 1, 2, 3, 4]
+
+
+def test_subset_and_random_split():
+    ds = _Range(10)
+    sub = io.Subset(ds, [2, 5, 7])
+    assert len(sub) == 3 and float(sub[1]) == 5.0
+    np.random.seed(3)
+    a, b = io.random_split(_Range(10), [6, 4])
+    assert len(a) == 6 and len(b) == 4
+    seen = sorted(float(a[i]) for i in range(6)) + \
+        sorted(float(b[i]) for i in range(4))
+    assert sorted(seen) == [float(i) for i in range(10)]
+
+
+def test_chain_and_compose_datasets():
+    chained = io.ChainDataset([_Stream([1, 2]), _Stream([3])])
+    # list(chained) would probe __len__ (length_hint), which raises by
+    # contract on IterableDataset (same as the reference) — iterate
+    assert [x for x in chained] == [1, 2, 3]
+    with pytest.raises(RuntimeError, match="len"):
+        len(chained)
+    comp = io.ComposeDataset([_Range(4), _Range(4)])
+    first = comp[1]
+    assert len(comp) == 4 and [float(x) for x in first] == [1.0, 1.0]
+
+
+def test_default_collate_and_worker_info():
+    batch = [(np.ones(2, np.float32), 1), (np.zeros(2, np.float32), 0)]
+    xs, ys = io.default_collate_fn(batch)
+    assert np.asarray(xs).shape == (2, 2)
+    assert np.asarray(ys).tolist() == [1, 0]
+    assert io.get_worker_info() is None  # main process
+
+
+def test_dataloader_with_batch_sampler():
+    ds = _Range(9)
+    dl = io.DataLoader(ds, batch_sampler=io.BatchSampler(
+        ds, batch_size=3, shuffle=False), num_workers=0)
+    batches = [np.asarray(b) for b in dl]
+    assert [b.shape[0] for b in batches] == [3, 3, 3]
+    np.testing.assert_allclose(batches[0].reshape(-1), [0, 1, 2])
