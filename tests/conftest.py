@@ -41,6 +41,9 @@ _SMOKE_MODULES = {
     "test_optimizer_amp",     # optimizers, lr schedulers, AMP O1/O2
     "test_ops_manipulation",  # reshape/concat/split family
     "test_regressions",       # past-bug pins
+    "test_functional_smoke",  # call-path sweep of every F.* wrapper
+    "test_io_samplers",       # samplers/datasets/collate
+    "test_matrix_nms",        # detection post-processing
 }
 
 
