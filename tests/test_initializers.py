@@ -1,0 +1,77 @@
+"""Statistical/structural pins for the initializers no other test runs.
+
+A wrong fan or gain silently degrades training, so each family is
+checked against its defining property (variance law, orthogonality,
+identity-convolution, truncation bounds, documented gains)."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def _param(shape, init, seed=0):
+    paddle.seed(seed)
+    return paddle.create_parameter(
+        shape, "float32",
+        attr=nn.ParamAttr(initializer=init)).numpy()
+
+
+class TestVarianceLaws:
+    def test_xavier_normal_variance(self):
+        w = _param([256, 384], nn.initializer.XavierNormal())
+        # var = 2 / (fan_in + fan_out)
+        expected = 2.0 / (256 + 384)
+        np.testing.assert_allclose(w.var(), expected, rtol=0.1)
+        np.testing.assert_allclose(w.mean(), 0.0, atol=3e-3)
+
+    def test_xavier_uniform_bound(self):
+        w = _param([256, 384], nn.initializer.XavierUniform())
+        bound = np.sqrt(6.0 / (256 + 384))
+        assert w.min() >= -bound - 1e-6 and w.max() <= bound + 1e-6
+        np.testing.assert_allclose(w.var(), bound ** 2 / 3.0, rtol=0.1)
+
+    def test_kaiming_normal_variance(self):
+        w = _param([256, 384], nn.initializer.KaimingNormal())
+        # relu gain: var = 2 / fan_in
+        np.testing.assert_allclose(w.var(), 2.0 / 256, rtol=0.1)
+
+    def test_kaiming_uniform_bound(self):
+        w = _param([256, 384], nn.initializer.KaimingUniform())
+        bound = np.sqrt(6.0 / 256)
+        assert w.min() >= -bound - 1e-6 and w.max() <= bound + 1e-6
+
+    def test_kaiming_conv_fan(self):
+        # conv weight fan_in includes the receptive field
+        w = _param([64, 32, 3, 3], nn.initializer.KaimingNormal())
+        np.testing.assert_allclose(w.var(), 2.0 / (32 * 9), rtol=0.12)
+
+    def test_truncated_normal(self):
+        tn = nn.initializer.TruncatedNormal(mean=0.0, std=1.0)
+        w = _param([64, 64], tn)
+        assert np.abs(w).max() <= 2.0 + 1e-5   # +-2 std truncation
+        np.testing.assert_allclose(w.mean(), 0.0, atol=0.05)
+
+
+class TestStructural:
+    def test_orthogonal(self):
+        w = _param([48, 64], nn.initializer.Orthogonal())
+        np.testing.assert_allclose(w @ w.T, np.eye(48), atol=1e-4)
+        # gain scales the whole matrix
+        w2 = _param([48, 64], nn.initializer.Orthogonal(gain=2.0))
+        np.testing.assert_allclose(w2 @ w2.T, 4.0 * np.eye(48), atol=1e-3)
+
+    def test_dirac_preserves_identity_conv(self):
+        import paddle_tpu.nn.functional as F
+        w = _param([4, 4, 3, 3], nn.initializer.Dirac())
+        x = np.random.RandomState(0).randn(1, 4, 8, 8).astype("float32")
+        out = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w),
+                       padding=1).numpy()
+        np.testing.assert_allclose(out, x, atol=1e-6)
+
+    def test_calculate_gain(self):
+        g = nn.initializer.calculate_gain
+        np.testing.assert_allclose(g("relu"), np.sqrt(2.0))
+        np.testing.assert_allclose(g("tanh"), 5.0 / 3.0)
+        np.testing.assert_allclose(g("leaky_relu", 0.1),
+                                   np.sqrt(2.0 / (1 + 0.01)))
+        np.testing.assert_allclose(g("linear"), 1.0)
