@@ -1,0 +1,106 @@
+"""hapi callbacks no other test drives (reference: hapi/callbacks.py):
+LRScheduler stepping inside Model.fit, ModelCheckpoint artifacts,
+ProgBarLogger, and custom Callback hook ordering."""
+import os
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def _dataset(n=32):
+    class DS(paddle.io.Dataset):
+        def __len__(self):
+            return n
+
+        def __getitem__(self, i):
+            rng = np.random.RandomState(i)
+            x = rng.randn(4).astype("float32")
+            return x, np.int64(i % 2)
+
+    return DS()
+
+
+def _model():
+    paddle.seed(0)
+    m = paddle.Model(nn.Sequential(nn.Linear(4, 8), nn.ReLU(),
+                                   nn.Linear(8, 2)))
+    return m
+
+
+def test_lr_scheduler_callback_steps_per_epoch():
+    m = _model()
+    sched = paddle.optimizer.lr.StepDecay(0.1, step_size=1, gamma=0.5)
+    opt = paddle.optimizer.SGD(learning_rate=sched,
+                               parameters=m.network.parameters())
+    m.prepare(opt, nn.CrossEntropyLoss())
+    # one scheduler tick per EPOCH
+    m.fit(_dataset(), batch_size=8, epochs=3, verbose=0,
+          callbacks=[paddle.callbacks.LRScheduler(by_step=False,
+                                                  by_epoch=True)])
+    np.testing.assert_allclose(sched(), 0.1 * 0.5 ** 3, rtol=1e-6)
+
+
+def test_model_checkpoint_writes_epoch_dirs(tmp_path):
+    m = _model()
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=m.network.parameters())
+    m.prepare(opt, nn.CrossEntropyLoss())
+    m.fit(_dataset(), batch_size=8, epochs=2, verbose=0,
+          callbacks=[paddle.callbacks.ModelCheckpoint(
+              save_freq=1, save_dir=str(tmp_path))])
+    written = sorted(os.listdir(tmp_path))
+    assert any(p.startswith("0.") for p in written), written
+    assert any(p.startswith("final.") for p in written), written
+    # the checkpoint round-trips
+    m2 = _model()
+    m2.prepare(paddle.optimizer.SGD(
+        learning_rate=0.1, parameters=m2.network.parameters()),
+        nn.CrossEntropyLoss())
+    m2.load(str(tmp_path / "final"))
+    for a, b in zip(m.network.parameters(), m2.network.parameters()):
+        np.testing.assert_allclose(a.numpy(), b.numpy(), rtol=1e-6)
+
+
+def test_progbar_logger_runs(capsys):
+    m = _model()
+    m.prepare(paddle.optimizer.SGD(
+        learning_rate=0.1, parameters=m.network.parameters()),
+        nn.CrossEntropyLoss())
+    m.fit(_dataset(), batch_size=8, epochs=1, verbose=2,
+          callbacks=[paddle.callbacks.ProgBarLogger(verbose=2)])
+    out = capsys.readouterr().out
+    assert "loss" in out and ("step" in out or "Epoch" in out)
+
+
+def test_custom_callback_hook_order():
+    events = []
+
+    class Tracker(paddle.callbacks.Callback):
+        def on_train_begin(self, logs=None):
+            events.append("train_begin")
+
+        def on_epoch_begin(self, epoch, logs=None):
+            events.append(f"epoch_begin:{epoch}")
+
+        def on_train_batch_end(self, step, logs=None):
+            events.append("batch_end")
+
+        def on_epoch_end(self, epoch, logs=None):
+            events.append(f"epoch_end:{epoch}")
+
+        def on_train_end(self, logs=None):
+            events.append("train_end")
+
+    m = _model()
+    m.prepare(paddle.optimizer.SGD(
+        learning_rate=0.1, parameters=m.network.parameters()),
+        nn.CrossEntropyLoss())
+    m.fit(_dataset(16), batch_size=8, epochs=2, verbose=0,
+          callbacks=[Tracker()])
+    assert events[0] == "train_begin" and events[-1] == "train_end"
+    assert events.count("batch_end") == 4  # 2 batches x 2 epochs
+    assert "epoch_begin:0" in events and "epoch_end:1" in events
+    assert events.index("epoch_begin:0") < events.index("epoch_end:0") < \
+        events.index("epoch_begin:1")
