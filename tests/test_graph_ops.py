@@ -1,0 +1,72 @@
+"""incubate.graph_ops smoke (reference: incubate/operators/graph_*.py,
+segment_pool ops): segment reductions, message passing, neighbor
+sampling/reindex, fused softmax masks — value-pinned on tiny graphs."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.incubate import graph_ops as G
+
+
+def t(x):
+    return paddle.to_tensor(np.asarray(x))
+
+
+SEG = np.array([0, 0, 1, 1, 1], np.int64)
+VAL = np.array([[1.0], [3.0], [2.0], [4.0], [6.0]], np.float32)
+
+
+def test_segment_reductions():
+    np.testing.assert_allclose(
+        G.segment_sum(t(VAL), t(SEG)).numpy(), [[4.0], [12.0]])
+    np.testing.assert_allclose(
+        G.segment_mean(t(VAL), t(SEG)).numpy(), [[2.0], [4.0]])
+    np.testing.assert_allclose(
+        G.segment_max(t(VAL), t(SEG)).numpy(), [[3.0], [6.0]])
+    np.testing.assert_allclose(
+        G.segment_min(t(VAL), t(SEG)).numpy(), [[1.0], [2.0]])
+
+
+def test_graph_send_recv():
+    # edges 0->1, 2->1: dst 1 accumulates src features
+    x = np.array([[1.0], [10.0], [5.0]], np.float32)
+    src = np.array([0, 2], np.int64)
+    dst = np.array([1, 1], np.int64)
+    out = G.graph_send_recv(t(x), t(src), t(dst), pool_type="sum")
+    np.testing.assert_allclose(out.numpy(), [[0.0], [6.0], [0.0]])
+    out = G.graph_send_recv(t(x), t(src), t(dst), pool_type="max")
+    np.testing.assert_allclose(out.numpy()[1], [5.0])
+
+
+def test_softmax_mask_fuse_upper_triangle():
+    x = np.random.RandomState(0).randn(1, 1, 4, 4).astype("float32")
+    out = G.softmax_mask_fuse_upper_triangle(t(x)).numpy()
+    # causal: each row softmaxes over columns <= row
+    np.testing.assert_allclose(out[0, 0, 0], [1.0, 0, 0, 0], atol=1e-6)
+    np.testing.assert_allclose(out[0, 0].sum(-1), np.ones(4), rtol=1e-5)
+    assert (np.triu(out[0, 0], k=1) == 0).all()
+
+
+def test_sample_and_reindex():
+    # star graph: node 0 connected to 1, 2, 3 (CSR)
+    row = np.array([1, 2, 3], np.int64)       # neighbors of node 0
+    ptr = np.array([0, 3, 3, 3, 3], np.int64)
+    np.random.seed(0)  # the sampler draws from numpy's RNG
+    out_n, out_cnt = G.graph_sample_neighbors(
+        t(row), t(ptr), t(np.array([0], np.int64)), sample_size=2)
+    n = np.asarray(out_n.numpy())
+    assert len(n) == 2 and set(n.tolist()) <= {1, 2, 3}
+    assert np.asarray(out_cnt.numpy()).tolist() == [2]
+
+    # reindex: centers [10, 1], neighbors [10, 2, 2] with counts [2, 1]
+    centers = np.array([10, 1], np.int64)
+    neigh = np.array([10, 2, 2], np.int64)
+    cnt = np.array([2, 1], np.int64)
+    re_src, re_dst, out_nodes = G.graph_reindex(
+        t(centers), t(neigh), t(cnt))
+    nodes = np.asarray(out_nodes.numpy())
+    rs = np.asarray(re_src.numpy())
+    rd = np.asarray(re_dst.numpy())
+    # locals map back to the original globals
+    np.testing.assert_array_equal(nodes[rs], neigh)
+    np.testing.assert_array_equal(nodes[rd], [10, 10, 1])
