@@ -2,7 +2,6 @@
 segment_pool ops): segment reductions, message passing, neighbor
 sampling/reindex, fused softmax masks — value-pinned on tiny graphs."""
 import numpy as np
-import pytest
 
 import paddle_tpu as paddle
 from paddle_tpu.incubate import graph_ops as G
@@ -47,6 +46,30 @@ def test_softmax_mask_fuse_upper_triangle():
     assert (np.triu(out[0, 0], k=1) == 0).all()
 
 
+def test_softmax_mask_fuse_explicit_mask():
+    x = np.zeros((1, 1, 2, 4), "float32")
+    mask = np.array([0.0, 0.0, -1e9, -1e9], "float32").reshape(1, 1, 1, 4)
+    out = G.softmax_mask_fuse(t(x), t(mask)).numpy()
+    np.testing.assert_allclose(out[0, 0, 0], [0.5, 0.5, 0.0, 0.0],
+                               atol=1e-6)
+
+
+def test_khop_sampler():
+    # chain 0 -> {1}, 1 -> {2} in CSR; 2 hops from node 0 touch 0,1,2
+    row = np.array([1, 2], np.int64)
+    ptr = np.array([0, 1, 2, 2], np.int64)
+    np.random.seed(0)
+    src, dst, nodes, center_local = G.graph_khop_sampler(
+        t(row), t(ptr), t(np.array([0], np.int64)), [1, 1])
+    uniq = np.asarray(nodes.numpy())
+    assert np.asarray(center_local.numpy()).tolist() == [0]
+    assert set(uniq.tolist()) == {0, 1, 2}
+    s = np.asarray(src.numpy()); d = np.asarray(dst.numpy())
+    # local-id edges map back to global chain edges (1->0, 2->1)
+    pairs = {(int(uniq[a]), int(uniq[b])) for a, b in zip(s, d)}
+    assert pairs == {(1, 0), (2, 1)}
+
+
 def test_sample_and_reindex():
     # star graph: node 0 connected to 1, 2, 3 (CSR)
     row = np.array([1, 2, 3], np.int64)       # neighbors of node 0
@@ -55,7 +78,8 @@ def test_sample_and_reindex():
     out_n, out_cnt = G.graph_sample_neighbors(
         t(row), t(ptr), t(np.array([0], np.int64)), sample_size=2)
     n = np.asarray(out_n.numpy())
-    assert len(n) == 2 and set(n.tolist()) <= {1, 2, 3}
+    assert set(n.tolist()) <= {1, 2, 3}
+    assert len(set(n.tolist())) == 2  # without replacement
     assert np.asarray(out_cnt.numpy()).tolist() == [2]
 
     # reindex: centers [10, 1], neighbors [10, 2, 2] with counts [2, 1]
