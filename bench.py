@@ -172,7 +172,10 @@ def bench_gpt2(amp_o2=True):
                         cfg.hidden_size // cfg.num_attention_heads,
                         dtype="bfloat16" if amp_o2 else "float32")
     paddle.framework.random.seed(0)
-    model = GPTForPretraining(cfg)
+    # chunked tied-head CE: never materializes the [B, S, 50304] logits
+    # (1.6 GB fp32 at this config) — parity-tested vs the dense path in
+    # tests/test_chunked_lm_loss.py
+    model = GPTForPretraining(cfg, lm_loss_chunks=8)
     if amp_o2:
         amp.decorate(model, level="O2", dtype="bfloat16")
     opt = AdamW(learning_rate=1e-4, weight_decay=0.01,

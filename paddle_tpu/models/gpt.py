@@ -230,16 +230,75 @@ class GPTModel(nn.Layer):
         return self.ln_f(x), tuple(new_caches)
 
 
+def _chunked_lm_loss(hidden, labels, table, n_chunks):
+    """Tied-head softmax cross-entropy WITHOUT materializing the full
+    [B, S, V] logits tensor: lax.scan over sequence chunks, each chunk
+    rematerialized in backward (jax.checkpoint), so peak memory is one
+    [B, S/n, V] block instead of the whole thing. At GPT-2 scale
+    (b8 x s1024 x v50304) the full tensor is 1.6 GB fp32 — the classic
+    HBM squeeze on small-model-large-vocab training. Reference analog:
+    the fused softmax-with-cross-entropy kernels
+    (paddle/phi/kernels/softmax_with_cross_entropy* and
+    fused c_softmax_with_cross_entropy), which exist for the same
+    memory/bandwidth reason."""
+    import jax
+    import jax.numpy as jnp
+
+    B, S, H = hidden.shape
+    C = S // n_chunks
+    hs = jnp.moveaxis(hidden.reshape(B, n_chunks, C, H), 1, 0)
+    ys = jnp.moveaxis(labels.reshape(B, n_chunks, C), 1, 0)
+
+    @jax.checkpoint
+    def chunk_nll(h_c, y_c):
+        logits = jnp.einsum("bch,vh->bcv", h_c, table,
+                            preferred_element_type=jnp.float32)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        valid = y_c != -100                     # ignore_index convention
+        safe = jnp.where(valid, y_c, 0).astype(jnp.int32)
+        gold = jnp.take_along_axis(logits, safe[..., None],
+                                   axis=-1)[..., 0]
+        nll = jnp.where(valid, lse - gold, 0.0)
+        return nll.sum(), valid.sum().astype(jnp.int32)
+
+    def body(acc, xs):
+        h_c, y_c = xs
+        nll, n = chunk_nll(h_c, y_c)
+        return (acc[0] + nll, acc[1] + n), None
+
+    (total, count), _ = jax.lax.scan(
+        body, (jnp.float32(0.0), jnp.int32(0)), (hs, ys))
+    return total / jnp.maximum(count, 1).astype(jnp.float32)
+
+
 class GPTForPretraining(nn.Layer):
-    def __init__(self, cfg: GPTConfig):
+    def __init__(self, cfg: GPTConfig, lm_loss_chunks: int = 1):
         super().__init__()
         self.gpt = GPTModel(cfg)
+        if lm_loss_chunks < 1:
+            raise ValueError(f"lm_loss_chunks must be >= 1, "
+                             f"got {lm_loss_chunks}")
+        self.lm_loss_chunks = int(lm_loss_chunks)
 
     def forward(self, input_ids, labels=None, position_ids=None):
         hidden = self.gpt(input_ids, position_ids)
-        logits = self.gpt.logits(hidden)
         if labels is None:
-            return logits
+            return self.gpt.logits(hidden)
+        if self.lm_loss_chunks > 1:
+            if hidden.shape[1] % self.lm_loss_chunks:
+                # a silent dense fallback would re-materialize the very
+                # [B, S, V] tensor this flag exists to avoid (and flip
+                # the logits output between None and real) — refuse
+                raise ValueError(
+                    f"sequence length {hidden.shape[1]} is not divisible "
+                    f"by lm_loss_chunks={self.lm_loss_chunks}")
+            from ..autograd import differentiable_apply
+            loss = differentiable_apply(
+                lambda h, y, w: _chunked_lm_loss(h, y, w,
+                                                 self.lm_loss_chunks),
+                hidden, labels, self.gpt.wte.weight)
+            return loss, None
+        logits = self.gpt.logits(hidden)
         loss = F.cross_entropy(
             call_op("reshape", logits, shape=(-1, logits.shape[-1])),
             call_op("reshape", labels, shape=(-1,)),
