@@ -8,6 +8,21 @@ import pytest
 import paddle_tpu as paddle
 from paddle_tpu.vision import models as M
 
+
+@pytest.fixture(scope="module", autouse=True)
+def _fresh_compile_caches():
+    """This module compiles some of the suite's biggest CPU programs
+    (inception 299px, alexnet 224px) and runs near the END of the
+    alphabetical order, on top of ~1100 accumulated executables — the
+    combination has segfaulted inside XLA's CPU compiler (resource
+    exhaustion, not a logic bug: the module passes standalone). Dropping
+    the accumulated jit caches first keeps it comfortably inside the
+    process limits; later modules simply recompile on demand."""
+    import jax
+    jax.clear_caches()
+    yield
+    jax.clear_caches()
+
 # (constructor name, kwargs, input hw) — 32px keeps pooling valid
 CASES = [
     ("alexnet", {}, 224),            # big stem: needs full-size input
