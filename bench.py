@@ -849,9 +849,75 @@ def main():
     _emit(results)
 
 
+def dry_run():
+    """Offline observability smoke (tier-1 gate: tests/test_bench_dryrun.py).
+
+    Runs ONE tiny train step on the CPU backend under an armed
+    profiler.profile() session and asserts the whole metrics surface
+    works end to end: monitor counters non-empty, a chrome trace with
+    nested span categories, and a Prometheus exposition. Prints the
+    stats summary to stderr and ONE JSON line to stdout; exits nonzero
+    when any assertion fails, so CI catches an instrumentation
+    regression before it costs a real benchmark round."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import tempfile
+
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu import profiler
+    from paddle_tpu.framework import monitor
+
+    net = nn.Sequential(nn.Linear(16, 8), nn.ReLU(), nn.Linear(8, 4))
+    model = paddle.Model(net)
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                parameters=net.parameters())
+    model.prepare(opt, nn.CrossEntropyLoss())
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, 16).astype(np.float32)
+    y = rng.randint(0, 4, (8, 1)).astype(np.int64)
+
+    monitor.stat_reset()
+    with profiler.profile() as sess:
+        loss = model.train_batch([x], [y])
+
+    counters = monitor.all_stats()
+    trace_path = os.path.join(tempfile.mkdtemp(prefix="paddle_dryrun_"),
+                              "trace.json")
+    sess.export_chrome_trace(trace_path)
+    with open(trace_path) as f:
+        doc = json.load(f)
+    cats = sorted({e["cat"] for e in doc["traceEvents"]
+                   if e.get("ph") == "X"})
+    prom = sess.export_prometheus()
+
+    checks = {
+        "counters_nonempty": len(counters) > 0,
+        "op_counts_present": any(k.startswith("op_count/")
+                                 for k in counters),
+        "cache_counters_present": ("op_cache_miss" in counters
+                                   or "op_cache_hit" in counters),
+        "step_histogram_present":
+            monitor.stat_histogram("hapi/step_time_ms") is not None,
+        "trace_categories": len(cats) >= 3,
+        "prometheus_nonempty": "paddle_tpu_counter{name=" in prom,
+        "loss_finite": bool(np.isfinite(loss)),
+    }
+    print(monitor.stats_summary(), file=sys.stderr)
+    ok = all(checks.values())
+    print(json.dumps({"metric": "dry_run", "ok": ok,
+                      "counters": len(counters),
+                      "span_categories": cats, "trace": trace_path,
+                      "loss": round(float(loss), 4), "checks": checks}),
+          flush=True)
+    sys.exit(0 if ok else 1)
+
+
 if __name__ == "__main__":
     if len(sys.argv) >= 3 and sys.argv[1] == "--child":
         result = BENCHES[sys.argv[2]]()
         print("RESULT " + json.dumps(result))
+    elif "--dry-run" in sys.argv[1:]:
+        dry_run()
     else:
         main()

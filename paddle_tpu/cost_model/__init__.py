@@ -31,15 +31,17 @@ class CostModel:
                 fn_or_program(*args, **kwargs)
         finally:
             _flags.set_flags({"FLAGS_benchmark": bool(old)})
-        stats = _monitor.all_stats()
+        # op_time_ms/<op> is a DISTRIBUTION (monitor histograms): mean
+        # comes straight from its sum/count, and the tails ride along for
+        # tuners that want tail latency, not just the average
         self._costs = {}
-        for key, total_ms in stats.items():
+        for key, h in _monitor.all_histograms().items():
             if not key.startswith("op_time_ms/"):
                 continue
             op = key[len("op_time_ms/"):]
-            count = stats.get(f"op_count/{op}", 1)
-            self._costs[op] = {"time": total_ms / 1e3 / max(count, 1),
-                               "calls": int(count)}
+            self._costs[op] = {"time": h["sum"] / 1e3 / max(h["count"], 1),
+                               "calls": int(h["count"]),
+                               "p95_ms": h["p95"], "p99_ms": h["p99"]}
         return self._costs
 
     def static_cost_data(self):

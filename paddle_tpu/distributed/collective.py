@@ -24,7 +24,9 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
+from ..framework.monitor import stat_add
 from ..framework.tensor import Tensor
+from ..profiler import span as _prof
 from . import env
 
 __all__ = ["ReduceOp", "Group", "new_group", "get_group", "all_reduce",
@@ -88,29 +90,77 @@ def get_group(gid=0):
 
 def psum_in_axis(x, axis_name: str):
     import jax
-    return jax.lax.psum(x, axis_name)
+    with _traced("psum_in_axis", x):
+        return jax.lax.psum(x, axis_name)
 
 
 def all_gather_in_axis(x, axis_name: str, tiled=True, axis=0):
     import jax
-    return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+    with _traced("all_gather_in_axis", x):
+        return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
 
 
 def ppermute_in_axis(x, axis_name: str, perm):
     import jax
-    return jax.lax.ppermute(x, axis_name, perm)
+    with _traced("ppermute_in_axis", x):
+        return jax.lax.ppermute(x, axis_name, perm)
 
 
 def all_to_all_in_axis(x, axis_name: str, split_axis=0, concat_axis=0):
     import jax
-    return jax.lax.all_to_all(x, axis_name, split_axis=split_axis,
-                              concat_axis=concat_axis, tiled=True)
+    with _traced("all_to_all_in_axis", x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=split_axis,
+                                  concat_axis=concat_axis, tiled=True)
 
 
 def reduce_scatter_in_axis(x, axis_name: str, scatter_axis=0):
     import jax
-    return jax.lax.psum_scatter(x, axis_name, scatter_dimension=scatter_axis,
-                                tiled=True)
+    with _traced("reduce_scatter_in_axis", x):
+        return jax.lax.psum_scatter(x, axis_name,
+                                    scatter_dimension=scatter_axis,
+                                    tiled=True)
+
+
+# ---------------------------------------------------------------------------
+# observability: every EAGER collective that executes is counted
+# (collective_count/<kind> + collective_bytes, monitor counters) and,
+# under an active profiler.profile() session, recorded as a span carrying
+# its byte count — per-call telemetry like the reference's NCCL event
+# hooks. The *_in_axis helpers run INSIDE jit traces, so their counters
+# and spans fire once per TRACE (compile), not per device execution —
+# they answer "which collectives does this program contain and how big",
+# not "how many ran"; steady-state device-side timing comes from the
+# XPlane trace (profiler/xplane.py).
+# ---------------------------------------------------------------------------
+
+def _payload_bytes(*tensors) -> int:
+    n = 0
+    for t in tensors:
+        data = getattr(t, "_data", t)
+        try:
+            n += int(data.nbytes)
+        except Exception:
+            try:  # tracers/avals: size * itemsize
+                n += int(np.prod(data.shape)) * data.dtype.itemsize
+            except Exception:
+                pass
+    return n
+
+
+import contextlib as _contextlib
+
+
+@_contextlib.contextmanager
+def _traced(kind: str, *tensors):
+    n = _payload_bytes(*tensors)
+    with _prof.record(f"collective/{kind}", "collective",
+                      args={"bytes": n}):
+        yield
+    # reached only when the body did NOT raise: a failed collective must
+    # not inflate the telemetry
+    stat_add(f"collective_count/{kind}")
+    if n:
+        stat_add("collective_bytes", n)
 
 
 # ---------------------------------------------------------------------------
@@ -137,28 +187,29 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     Under SPMD the data-parallel grad sync happens inside the jitted step;
     this eager entry point exists for reference API parity (e.g. manual
     metric reduction)."""
-    if _degenerate():
+    with _traced("all_reduce", tensor):
+        if _degenerate():
+            return tensor
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        mesh = env.get_mesh()
+        axes = _axis_of(group)
+        axes = (axes,) if isinstance(axes, str) else tuple(axes)
+
+        def f(x):
+            red = {"sum": jax.lax.psum, "max": jax.lax.pmax,
+                   "min": jax.lax.pmin}[op if op != ReduceOp.AVG else "sum"]
+            y = red(x, axes)
+            if op == ReduceOp.AVG:
+                y = y / np.prod([mesh.shape[a] for a in axes])
+            return y
+
+        spec = P(axes if len(axes) > 1 else axes[0])
+        out = jax.jit(
+            jax.shard_map(f, mesh=mesh, in_specs=spec, out_specs=spec))(
+            _sharded_like(tensor._data, mesh, spec))
+        tensor._data = out
         return tensor
-    import jax
-    from jax.sharding import NamedSharding, PartitionSpec as P
-    mesh = env.get_mesh()
-    axes = _axis_of(group)
-    axes = (axes,) if isinstance(axes, str) else tuple(axes)
-
-    def f(x):
-        red = {"sum": jax.lax.psum, "max": jax.lax.pmax,
-               "min": jax.lax.pmin}[op if op != ReduceOp.AVG else "sum"]
-        y = red(x, axes)
-        if op == ReduceOp.AVG:
-            y = y / np.prod([mesh.shape[a] for a in axes])
-        return y
-
-    spec = P(axes if len(axes) > 1 else axes[0])
-    out = jax.jit(
-        jax.shard_map(f, mesh=mesh, in_specs=spec, out_specs=spec))(
-        _sharded_like(tensor._data, mesh, spec))
-    tensor._data = out
-    return tensor
 
 
 def _sharded_like(arr, mesh, spec):
@@ -169,8 +220,11 @@ def _sharded_like(arr, mesh, spec):
 
 def all_gather(tensor_list, tensor, group=None, sync_op=True):
     if _degenerate():
-        tensor_list.append(Tensor(tensor._data))
-        return tensor_list
+        # counters/spans only on the path that executes — a call that
+        # raises NotImplementedError must not inflate the telemetry
+        with _traced("all_gather", tensor):
+            tensor_list.append(Tensor(tensor._data))
+            return tensor_list
     raise NotImplementedError(
         "eager all_gather over a live mesh: express the gather inside the "
         "jitted step (all_gather_in_axis) — eager loops over mesh shards "
@@ -178,15 +232,16 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True):
 
 
 def broadcast(tensor, src=0, group=None, sync_op=True):
-    if _degenerate():
+    with _traced("broadcast", tensor):
+        if _degenerate():
+            return tensor
+        # replicated arrays are already consistent; broadcast is the act
+        # of resharding to full replication
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        tensor._data = jax.device_put(
+            tensor._data, NamedSharding(env.get_mesh(), P()))
         return tensor
-    # replicated arrays are already consistent; broadcast is the act of
-    # resharding to full replication
-    import jax
-    from jax.sharding import NamedSharding, PartitionSpec as P
-    tensor._data = jax.device_put(
-        tensor._data, NamedSharding(env.get_mesh(), P()))
-    return tensor
 
 
 def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
@@ -205,11 +260,12 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
 
 def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
     if _degenerate():
-        outs = [Tensor(t._data) for t in in_tensor_list]
-        if out_tensor_list is not None:
-            out_tensor_list.extend(outs)
-            return out_tensor_list
-        return outs
+        with _traced("alltoall", *in_tensor_list):
+            outs = [Tensor(t._data) for t in in_tensor_list]
+            if out_tensor_list is not None:
+                out_tensor_list.extend(outs)
+                return out_tensor_list
+            return outs
     raise NotImplementedError(
         "eager alltoall over a live mesh: use all_to_all_in_axis inside "
         "the jitted step (see MoELayer)")
@@ -234,8 +290,9 @@ def recv(tensor, src=0, group=None, sync_op=True):
 def barrier(group=None):
     """Host-level barrier: forces completion of all outstanding work."""
     import jax
-    arr = jax.numpy.zeros(())
-    jax.block_until_ready(arr)
+    with _traced("barrier"):
+        arr = jax.numpy.zeros(())
+        jax.block_until_ready(arr)
     if env.get_world_size() > 1:
         # cross-host rendezvous via a tiny global psum
         from jax.sharding import PartitionSpec as P
@@ -257,11 +314,13 @@ def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None,
     entry point; inside jitted steps this is lax.psum_scatter riding ICI
     (reduce_scatter_in_axis)."""
     if _degenerate():
-        summed = tensor_list[0]
-        for t in tensor_list[1:]:
-            summed = summed + t
-        tensor._data = summed._data if hasattr(summed, "_data") else summed
-        return tensor
+        with _traced("reduce_scatter", *tensor_list):
+            summed = tensor_list[0]
+            for t in tensor_list[1:]:
+                summed = summed + t
+            tensor._data = summed._data if hasattr(summed, "_data") \
+                else summed
+            return tensor
     raise NotImplementedError(
         "multi-rank eager reduce_scatter: use reduce_scatter_in_axis inside "
         "shard_map (the SPMD engine emits it for ZeRO grads)")

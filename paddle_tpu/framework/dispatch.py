@@ -30,8 +30,9 @@ from .infermeta import maybe_check as _infermeta_check
 from . import dtypes as _dtypes
 from . import static_capture as _capture
 from .flags import flag_value
-from .monitor import stat_add
+from .monitor import stat_add, stat_observe
 from .tensor import GradNode, Tensor, is_grad_enabled
+from ..profiler import span as _prof
 
 Array = Any
 
@@ -168,20 +169,51 @@ def _get_callable(name: str, impl, template, attrs_key, attrs,
            tuple(arr_attr_names))
     fn = _fn_cache.get(key)
     if fn is None:
-        n_attr = len(arr_attr_names)
-
-        def raw(*arrays):
-            pos = arrays[:len(arrays) - n_attr] if n_attr else arrays
-            kw = dict(attrs)
-            if n_attr:
-                kw.update(zip(arr_attr_names,
-                              arrays[len(arrays) - n_attr:]))
-            return impl(*_rebuild(template, pos), **kw)
-
-        fn = jax.jit(raw) if (jit_ok and flag_value("FLAGS_eager_jit_ops")) \
-            else raw
+        # a miss means a NEW (op, attrs, structure) class: a jit wrapper
+        # is built here and XLA compiles on its first call. The counter
+        # pair makes cache-thrash regressions (e.g. an attrs key aliasing
+        # bug exhausting XLA, 3edc4ce) a visible metric, not a post-mortem.
+        stat_add("op_cache_miss")
+        stat_add(f"op_cache_miss/{name}")
+        fn = _build_callable(impl, template, attrs, arr_attr_names, jit_ok)
+        if _prof._active:
+            fn = _first_call_span(name, key, fn)
         _fn_cache[key] = fn
+    else:
+        stat_add("op_cache_hit")
     return fn
+
+
+def _first_call_span(name, key, built):
+    """Attribute the REAL compile cost to the trace: the jax.jit wrapper
+    is cheap, XLA compiles at the first invocation — so on a miss while
+    profiling, span that first call as jit_compile/<op> ("cache"
+    category; duration = trace+compile+first run) and self-replace the
+    cache entry with the raw callable, leaving zero steady-state
+    overhead."""
+    def traced(*arrays):
+        if _fn_cache.get(key) is not built:
+            _fn_cache[key] = built
+            with _prof.record(f"jit_compile/{name}", "cache"):
+                return built(*arrays)
+        return built(*arrays)  # replayed wrapper ref (static capture)
+
+    return traced
+
+
+def _build_callable(impl, template, attrs, arr_attr_names, jit_ok):
+    n_attr = len(arr_attr_names)
+
+    def raw(*arrays):
+        pos = arrays[:len(arrays) - n_attr] if n_attr else arrays
+        kw = dict(attrs)
+        if n_attr:
+            kw.update(zip(arr_attr_names,
+                          arrays[len(arrays) - n_attr:]))
+        return impl(*_rebuild(template, pos), **kw)
+
+    return jax.jit(raw) if (jit_ok and flag_value("FLAGS_eager_jit_ops")) \
+        else raw
 
 
 def _get_bwd_callable(name: str, impl, template, attrs_key, fwd_fn,
@@ -197,6 +229,9 @@ def _get_bwd_callable(name: str, impl, template, attrs_key, fwd_fn,
            tuple(arr_attr_names))
     fn = _fn_cache.get(key)
     if fn is None:
+        stat_add("op_cache_miss")
+        stat_add(f"op_cache_miss/{name}.bwd")
+
         def bwd_raw(ct, *arrays):
             _, vjp = jax.vjp(fwd_fn, *arrays)
             return vjp(ct)
@@ -204,7 +239,13 @@ def _get_bwd_callable(name: str, impl, template, attrs_key, fwd_fn,
         fn = jax.jit(bwd_raw) if (jit_ok
                                   and flag_value("FLAGS_eager_jit_ops")) \
             else bwd_raw
+        if _prof._active:
+            # backward compiles (often the larger cost) get the same
+            # first-call compile attribution as the forward
+            fn = _first_call_span(f"{name}.bwd", key, fn)
         _fn_cache[key] = fn
+    else:
+        stat_add("op_cache_hit")
     return fn
 
 
@@ -235,9 +276,13 @@ def call_op(name: str, *args, **attrs):
         # run per kernel launch); raises ShapeError at the call site instead
         # of an XLA error deep inside jit
         _infermeta_check(name, args, attrs)
-    if flag_value("FLAGS_benchmark"):
-        return _call_op_timed(name, opdef, args, attrs)
-    return _call_op_impl(name, opdef, args, attrs)
+    run = _call_op_timed if flag_value("FLAGS_benchmark") else _call_op_impl
+    if _prof._active:
+        # guarded so the inactive hot path pays ONE bool check, no span
+        # object (perf-gate budget: tests/test_perf_gate.py)
+        with _prof.record(f"op/{name}", "dispatch"):
+            return run(name, opdef, args, attrs)
+    return run(name, opdef, args, attrs)
 
 
 def _call_op_timed(name, opdef, args, attrs):
@@ -252,7 +297,8 @@ def _call_op_timed(name, opdef, args, attrs):
             is_leaf=lambda t: isinstance(t, Tensor)))
     except Exception:
         pass  # tracers under jit: timing is trace-time only
-    stat_add(f"op_time_ms/{name}", (time.perf_counter() - t0) * 1e3)
+    # distribution, not a lossy sum: p50/p95/p99 per op via stat_histogram
+    stat_observe(f"op_time_ms/{name}", (time.perf_counter() - t0) * 1e3)
     return out
 
 

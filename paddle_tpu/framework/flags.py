@@ -88,6 +88,13 @@ define_flag("FLAGS_eager_jit_ops", True,
             "Route eager op calls through cached jax.jit wrappers")
 define_flag("FLAGS_allocator_strategy", "auto_growth",
             "Parity flag; HBM allocation is managed by PjRt")
+define_flag("FLAGS_enable_profiler", False,
+            "Arm the structured span profiler for the whole process at "
+            "import (profiler/span.py); equivalent to wrapping main() in "
+            "profiler.profile(). Env-seeded: FLAGS_enable_profiler=1")
+define_flag("FLAGS_profiler_max_events", 1_000_000,
+            "Span buffer cap: past it events are dropped (and counted in "
+            "profiler.dropped()) instead of growing host memory")
 define_flag("FLAGS_cudnn_deterministic", False, "Parity flag")
 define_flag("FLAGS_embedding_deterministic", False, "Parity flag")
 define_flag("FLAGS_conv_workspace_size_limit", 512, "Parity flag (MB)")

@@ -1,48 +1,78 @@
-"""Runtime counters + per-op timing (observability).
+"""Runtime counters + value distributions (observability).
 
 Reference: paddle/fluid/platform/monitor.h:78 (``StatRegistry`` /
 ``STAT_ADD`` — process-wide named int counters, e.g. GPU mem stats in
 memory/stats.cc) and the ``benchmark`` flag that prints per-op timing
 (platform/flags.cc).
 
-The dispatch layer feeds two families automatically:
-  * ``op_count/<name>`` — calls per op (always on, ~free);
-  * ``op_time_ms/<name>`` — accumulated wall ms per op when
-    ``FLAGS_benchmark`` is set (forces a block_until_ready per call, so
-    ONLY for debugging — it serializes the device).
+Two stat families:
+
+* **counters** (``stat_add``/``stat_get``) — monotonically accumulated
+  floats, e.g. ``op_count/<name>`` (calls per op, always on, ~free) and
+  ``op_cache_hit``/``op_cache_miss`` (jit executable cache);
+* **histograms** (``stat_observe``/``stat_histogram``) — value
+  distributions with count/sum/min/max and p50/p95/p99 over a bounded
+  reservoir, e.g. ``op_time_ms/<name>`` (per-call wall ms when
+  ``FLAGS_benchmark`` is set — forces a block_until_ready per call, so
+  ONLY for debugging: it serializes the device) and
+  ``hapi/step_time_ms`` (host wall time per train step, always on).
+
+THREADING CONTRACT (the one place it is stated): writers —
+``stat_add``/``stat_observe`` — are lock-free on the hot path; a racing
+pair of threads may lose an increment or a sample, which is acceptable
+for observability and the reason taking a lock per eager op dispatch is
+not. Readers — ``stat_get``/``stat_histogram``/``all_stats``/
+``all_histograms``/``stats_summary`` — take ``_lock`` and copy, so they
+never observe a dict mid-resize; values they return are a consistent
+snapshot only to within that writer race. ``stat_reset`` also locks.
+The reservoir append rides on deque's GIL-atomic append, bounded by
+``maxlen`` so a hot histogram cannot grow without bound.
+
+The richer span profiler (nesting, chrome-trace export) lives in
+``paddle_tpu/profiler/span.py`` and exports these stats alongside its
+spans in one Prometheus exposition.
 """
 from __future__ import annotations
 
+import math
 import threading
-from typing import Dict
+from collections import deque
+from typing import Dict, Optional
 
 __all__ = ["stat_add", "stat_get", "stat_reset", "stats_summary",
-           "all_stats"]
+           "all_stats", "stat_observe", "stat_histogram", "all_histograms"]
 
 _lock = threading.Lock()
 _stats: Dict[str, float] = {}
+_RESERVOIR = 4096
+_hists: Dict[str, "_Hist"] = {}
 
 
 def stat_add(name: str, value: float = 1) -> None:
-    """STAT_ADD analog (monitor.h:131).
-
-    Lock-free on the hot path: a racing pair of threads may lose an
-    increment, which is acceptable for observability counters — taking a
-    lock per eager op dispatch is not."""
+    """STAT_ADD analog (monitor.h:131). Lock-free writer — see the
+    threading contract in the module docstring."""
     _stats[name] = _stats.get(name, 0) + value
 
 
 def stat_get(name: str) -> float:
+    """Counter value; for a histogram name, its accumulated sum (so code
+    written against the old ``op_time_ms`` counter keeps reading a
+    meaningful total now that timings are distributions)."""
     with _lock:
-        return _stats.get(name, 0)
+        if name in _stats:
+            return _stats[name]
+        h = _hists.get(name)
+        return h.total if h is not None else 0
 
 
 def stat_reset(name: str = None) -> None:
     with _lock:
         if name is None:
             _stats.clear()
+            _hists.clear()
         else:
             _stats.pop(name, None)
+            _hists.pop(name, None)
 
 
 def all_stats() -> Dict[str, float]:
@@ -50,11 +80,84 @@ def all_stats() -> Dict[str, float]:
         return dict(_stats)
 
 
+# ---------------------------------------------------------------------------
+# histograms
+# ---------------------------------------------------------------------------
+
+class _Hist:
+    __slots__ = ("count", "total", "vmin", "vmax", "ring")
+
+    def __init__(self, maxlen: int = _RESERVOIR):
+        self.count = 0
+        self.total = 0.0
+        self.vmin = math.inf
+        self.vmax = -math.inf
+        self.ring = deque(maxlen=maxlen)
+
+
+def stat_observe(name: str, value: float) -> None:
+    """Record one sample into the named distribution. Lock-free writer
+    (module-docstring contract); creation of a new histogram is the only
+    locked step, paid once per name."""
+    h = _hists.get(name)
+    if h is None:
+        with _lock:
+            h = _hists.setdefault(name, _Hist())
+    value = float(value)
+    h.count += 1
+    h.total += value
+    if value < h.vmin:
+        h.vmin = value
+    if value > h.vmax:
+        h.vmax = value
+    h.ring.append(value)
+
+
+def _percentile(sorted_vals, q: float) -> float:
+    """Nearest-rank percentile over an already-sorted sample."""
+    if not sorted_vals:
+        return 0.0
+    k = max(0, min(len(sorted_vals) - 1,
+                   math.ceil(q * len(sorted_vals)) - 1))
+    return sorted_vals[k]
+
+
+def stat_histogram(name: str) -> Optional[dict]:
+    """Summary of a distribution: count/sum/min/max + p50/p95/p99
+    (percentiles over the bounded reservoir — exact until ``count``
+    exceeds the reservoir size, then over the most recent samples)."""
+    with _lock:
+        h = _hists.get(name)
+        if h is None or h.count == 0:
+            return None
+        vals = sorted(h.ring)
+        return {"count": h.count, "sum": h.total, "min": h.vmin,
+                "max": h.vmax, "p50": _percentile(vals, 0.5),
+                "p95": _percentile(vals, 0.95),
+                "p99": _percentile(vals, 0.99)}
+
+
+def all_histograms() -> Dict[str, dict]:
+    with _lock:
+        names = list(_hists)
+    out = {}
+    for n in names:
+        h = stat_histogram(n)
+        if h is not None:
+            out[n] = h
+    return out
+
+
 def stats_summary(prefix: str = "") -> str:
-    """Human-readable counter table (≙ StatRegistry::publish)."""
-    rows = sorted((k, v) for k, v in all_stats().items()
-                  if k.startswith(prefix))
+    """Human-readable table of counters and distributions
+    (≙ StatRegistry::publish)."""
+    rows = [(k, f"{v:g}") for k, v in all_stats().items()
+            if k.startswith(prefix)]
+    rows += [(k, f"n={h['count']} sum={h['sum']:g} p50={h['p50']:g} "
+                 f"p95={h['p95']:g} p99={h['p99']:g} max={h['max']:g}")
+             for k, h in all_histograms().items() if k.startswith(prefix)]
+    rows.sort()
     if not rows:
         return "(no stats)"
     w = max(len(k) for k, _ in rows)
-    return "\n".join(f"{k:<{w}}  {v:g}" for k, v in rows)
+    return "\n".join(f"{k:<{w}}  {v}" for k, v in rows)

@@ -12,7 +12,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 __all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "EarlyStopping",
-           "ReduceLROnPlateau",
+           "ReduceLROnPlateau", "ProfilerCallback",
            "LRScheduler", "History", "VisualDL", "config_callbacks"]
 
 
@@ -31,6 +31,13 @@ class Callback:
         pass
 
     def on_train_end(self, logs=None):
+        pass
+
+    def on_train_abort(self):
+        """Teardown when fit raises: release resources/global state
+        WITHOUT the success-path side effects of on_train_end. Exceptions
+        raised here are swallowed by Model.fit so they can never mask the
+        training error."""
         pass
 
     def on_eval_begin(self, logs=None):
@@ -83,6 +90,17 @@ class CallbackList:
                     getattr(c, name)(*args, **kwargs)
             return dispatch
         raise AttributeError(name)
+
+    def on_train_abort(self):
+        """Error-isolated teardown fan-out (unlike the generic on_*
+        dispatch): when fit fails, EVERY callback's abort hook runs even
+        if an earlier one raises, so e.g. ProfilerCallback's armed global
+        session is always released."""
+        for c in self.callbacks:
+            try:
+                c.on_train_abort()
+            except Exception:
+                pass
 
 
 class ProgBarLogger(Callback):
@@ -295,6 +313,107 @@ class VisualDL(Callback):
         if self._fh is not None:
             self._fh.close()
             self._fh = None
+
+    def on_train_abort(self):
+        self.on_train_end()   # flush+close is safe teardown either way
+
+
+class ProfilerCallback(Callback):
+    """Profile a window of training steps with the structured span
+    profiler (paddle_tpu.profiler.profile) from inside Model.fit.
+
+    Steps ``[start_step, stop_step)`` — counted globally across epochs —
+    run under an armed span buffer: every op dispatch, jit-cache miss,
+    collective and hapi step lands in the trace. When the window closes
+    (or training ends) the callback exports a chrome trace and/or a
+    Prometheus text file and optionally prints the span summary table.
+    Skipping step 0 (the default ``start_step=1``) keeps the one-off
+    trace+compile of the train step out of the steady-state profile;
+    pass ``start_step=0`` to capture compilation instead.
+
+    Reference analog: the profiler hooks of hapi's train loop
+    (paddle.profiler used as a fit callback) — here rebuilt on span.py.
+    """
+
+    def __init__(self, start_step=1, stop_step=4, chrome_trace_path=None,
+                 prometheus_path=None, summary=True, verbose=1):
+        super().__init__()
+        if stop_step is not None and stop_step <= start_step:
+            raise ValueError("ProfilerCallback: need stop_step > "
+                             "start_step (or stop_step=None)")
+        self.start_step = start_step
+        self.stop_step = stop_step
+        self.chrome_trace_path = chrome_trace_path
+        self.prometheus_path = prometheus_path
+        self.summary = summary
+        self.verbose = verbose
+        self._session = None
+        self._step_span = None
+        self._global_step = 0
+
+    def on_train_begin(self, logs=None):
+        self._global_step = 0
+        self._session = None
+        self._step_span = None
+
+    def on_train_batch_begin(self, step, logs=None):
+        from .. import profiler
+        g = self._global_step
+        if self._session is None and g >= self.start_step and \
+                (self.stop_step is None or g < self.stop_step):
+            self._session = profiler.profile().__enter__()
+        if self._session is not None:
+            self._step_span = profiler.record(
+                "hapi/step", "hapi", args={"global_step": g}).begin()
+
+    def on_train_batch_end(self, step, logs=None):
+        # per-step wall time already lands in the hapi/step_time_ms
+        # histogram (Model.train_batch) — no duplicate series here
+        if self._step_span is not None:
+            self._step_span.end()
+            self._step_span = None
+        self._global_step += 1
+        if self._session is not None and self.stop_step is not None and \
+                self._global_step >= self.stop_step:
+            self._finish()
+
+    def on_train_end(self, logs=None):
+        if self._session is not None:
+            self._finish()
+        elif self._global_step <= self.start_step and \
+                (self.chrome_trace_path or self.prometheus_path):
+            import warnings
+            warnings.warn(
+                f"ProfilerCallback: training ended after "
+                f"{self._global_step} step(s), before the profiling "
+                f"window at start_step={self.start_step} opened — no "
+                f"trace/metrics files were written")
+
+    def on_train_abort(self):
+        # still export: the trace of a crashed run is precisely the
+        # artifact you want on the way down
+        if self._session is not None:
+            self._finish()
+
+    def _finish(self):
+        from .. import profiler
+        if self._step_span is not None:   # step aborted mid-span: close it
+            self._step_span.end()
+            self._step_span = None
+        session, self._session = self._session, None
+        session.__exit__(None, None, None)
+        if self.chrome_trace_path:
+            p = profiler.export_chrome_trace(self.chrome_trace_path)
+            if self.verbose:
+                print(f"[profiler] chrome trace written to {p} "
+                      f"(open in chrome://tracing or Perfetto)")
+        if self.prometheus_path:
+            profiler.export_prometheus(self.prometheus_path)
+            if self.verbose:
+                print(f"[profiler] prometheus metrics written to "
+                      f"{self.prometheus_path}")
+        if self.summary and self.verbose:
+            print(profiler.span_summary())
 
 
 class ReduceLROnPlateau(Callback):

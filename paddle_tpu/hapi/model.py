@@ -24,7 +24,9 @@ import numpy as np
 
 from ..framework import random as _random
 from ..framework.io import load as _load, save as _save
+from ..framework.monitor import stat_observe
 from ..framework.tensor import Tensor, no_grad_guard
+from ..profiler import span as _prof
 from ..io import DataLoader, Dataset
 from ..metric import Metric
 from ..nn.layer.layers import (
@@ -273,6 +275,7 @@ class Model:
             return auto_cast(level=self._amp_level, dtype=self._amp_dtype)
         return contextlib.nullcontext()
 
+    @_prof.record("hapi/build_train_step", "hapi")
     def _build_train_step(self):
         self._pallas_gate()
         net, opt = self.network, self._optimizer
@@ -352,40 +355,48 @@ class Model:
         adapter = self._static()
         if adapter is not None:
             return adapter.train_batch(inputs, labels)
-        if self._train_step_fn is None:
-            self.network.train()
-            self._sync_state_from_network()
-            self._build_train_step()
-        ins = _as_arrays(inputs)
-        lbs = _as_arrays(labels) if labels is not None else []
-        self._step_counter += 1
-        key = jax.random.fold_in(jax.random.key(0), self._step_counter)
-        lr = jnp.asarray(self._optimizer.get_lr(), jnp.float32)
-        (self._params, self._opt_state, self._buffers, loss,
-         outs) = self._train_step_fn(
-            self._params, self._opt_state, self._buffers, key, lr,
-            len(ins), *ins, *lbs)
-        metrics = self._update_metrics(outs, lbs)
-        self._dirty = True
-        if return_numpy:
-            loss = float(loss)
+        # hapi/step_time_ms is HOST wall time of the step call: with
+        # return_numpy=False jax dispatches asynchronously, so this
+        # measures dispatch+tracing, not device compute — the span/
+        # histogram pair still localises stalls (compiles, H2D, syncs)
+        t0 = time.perf_counter()
+        with _prof.record("hapi/train_batch", "hapi"):
+            if self._train_step_fn is None:
+                self.network.train()
+                self._sync_state_from_network()
+                self._build_train_step()
+            ins = _as_arrays(inputs)
+            lbs = _as_arrays(labels) if labels is not None else []
+            self._step_counter += 1
+            key = jax.random.fold_in(jax.random.key(0), self._step_counter)
+            lr = jnp.asarray(self._optimizer.get_lr(), jnp.float32)
+            (self._params, self._opt_state, self._buffers, loss,
+             outs) = self._train_step_fn(
+                self._params, self._opt_state, self._buffers, key, lr,
+                len(ins), *ins, *lbs)
+            metrics = self._update_metrics(outs, lbs)
+            self._dirty = True
+            if return_numpy:
+                loss = float(loss)
+        stat_observe("hapi/step_time_ms", (time.perf_counter() - t0) * 1e3)
         return (loss, metrics) if metrics else loss
 
     def eval_batch(self, inputs, labels=None):
         adapter = self._static()
         if adapter is not None:
             return adapter.eval_batch(inputs, labels)
-        if self._eval_step_fn is None:
-            self._build_eval_step()
-        if self._params is None:
-            self._sync_state_from_network()
-        ins = _as_arrays(inputs)
-        lbs = _as_arrays(labels) if labels is not None else []
-        key = jax.random.key(0)
-        loss, outs = self._eval_step_fn(
-            self._params, self._buffers, key, len(ins), *ins, *lbs)
-        metrics = self._update_metrics(outs, lbs)
-        loss = float(loss)
+        with _prof.record("hapi/eval_batch", "hapi"):
+            if self._eval_step_fn is None:
+                self._build_eval_step()
+            if self._params is None:
+                self._sync_state_from_network()
+            ins = _as_arrays(inputs)
+            lbs = _as_arrays(labels) if labels is not None else []
+            key = jax.random.key(0)
+            loss, outs = self._eval_step_fn(
+                self._params, self._buffers, key, len(ins), *ins, *lbs)
+            metrics = self._update_metrics(outs, lbs)
+            loss = float(loss)
         return (loss, metrics) if metrics else loss
 
     def predict_batch(self, inputs):
@@ -442,26 +453,37 @@ class Model:
             if self._train_step_fn is None:
                 self._build_train_step()
         cbks.on_train_begin()
-        for epoch in range(epochs):
-            if self.stop_training:
-                break
-            cbks.on_epoch_begin(epoch)
-            for m in self._metrics:
-                m.reset()
-            logs = {}
-            for step, batch in enumerate(loader):
-                cbks.on_train_batch_begin(step)
-                inputs, labels = self._split_batch(batch)
-                result = self.train_batch(inputs, labels)
-                logs = self._pack_logs(result)
-                cbks.on_train_batch_end(step, logs)
-            cbks.on_epoch_end(epoch, logs)
-            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
-                self.evaluate(eval_loader, batch_size=batch_size,
-                              verbose=verbose, callbacks=cbks,
-                              _inside_fit=True)
-        cbks.on_train_end()
-        self._sync_state_to_network()
+        try:
+            for epoch in range(epochs):
+                if self.stop_training:
+                    break
+                cbks.on_epoch_begin(epoch)
+                for m in self._metrics:
+                    m.reset()
+                logs = {}
+                for step, batch in enumerate(loader):
+                    cbks.on_train_batch_begin(step)
+                    inputs, labels = self._split_batch(batch)
+                    result = self.train_batch(inputs, labels)
+                    logs = self._pack_logs(result)
+                    cbks.on_train_batch_end(step, logs)
+                cbks.on_epoch_end(epoch, logs)
+                if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                    self.evaluate(eval_loader, batch_size=batch_size,
+                                  verbose=verbose, callbacks=cbks,
+                                  _inside_fit=True)
+            cbks.on_train_end()
+        except BaseException:
+            # teardown-only hook: a failed fit must not leak callback-held
+            # process-global state (ProfilerCallback's armed span session),
+            # but on_train_end keeps its success-only semantics (e.g.
+            # ModelCheckpoint's 'final' save). CallbackList.on_train_abort
+            # isolates per-callback errors so none can mask the in-flight
+            # training exception.
+            cbks.on_train_abort()
+            raise
+        finally:
+            self._sync_state_to_network()
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
                  num_workers=0, callbacks=None, _inside_fit=False):

@@ -16,12 +16,15 @@ import math
 import os
 import queue as _queue
 import threading
+import time as _time
 from typing import Any, Iterable, List, Optional, Sequence
 
 import numpy as np
 
 from ..framework import random as _random
+from ..framework.monitor import stat_add, stat_observe
 from ..framework.tensor import Tensor
+from ..profiler import span as _prof
 
 __all__ = ["Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
            "ChainDataset", "Subset", "random_split", "Sampler",
@@ -789,9 +792,17 @@ def device_prefetch(iterable, sharding=None, buffer_size=2):
         return False
 
     def producer():
+        # observability: each H2D enqueue is a span + histogram sample
+        # (prefetch_put_ms), so the trace shows whether transfers really
+        # ride under compute or the producer is the bottleneck
         try:
             for batch in iterable:
-                if not _put(put(batch)):
+                t0 = _time.perf_counter()
+                with _prof.record("io/device_put", "io"):
+                    d = put(batch)
+                stat_observe("prefetch_put_ms",
+                             (_time.perf_counter() - t0) * 1e3)
+                if not _put(d):
                     return
             _put(_END)
         except Exception as e:  # propagate into the consumer
@@ -801,11 +812,20 @@ def device_prefetch(iterable, sharding=None, buffer_size=2):
     t.start()
     try:
         while True:
-            item = q.get()
+            # prefetch_wait_ms ~ 0 means the pipeline keeps the device
+            # fed; a distribution skewed high means the loader starves it
+            t0 = _time.perf_counter()
+            with _prof.record("io/queue_wait", "io"):
+                item = q.get()
             if item is _END:
                 break
             if isinstance(item, Exception):
                 raise item
+            # only REAL batches count — the end sentinel and propagated
+            # producer errors must not skew the starvation signal
+            stat_observe("prefetch_wait_ms",
+                         (_time.perf_counter() - t0) * 1e3)
+            stat_add("prefetch_batches")
             yield item
     finally:
         stop.set()

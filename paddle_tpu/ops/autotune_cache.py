@@ -27,6 +27,9 @@ import threading
 import time
 from typing import Callable, Dict, Optional
 
+from ..framework.monitor import stat_add, stat_observe
+from ..profiler import span as _prof
+
 __all__ = ["shape_class", "choose", "measure", "record", "stats",
            "clear", "cache_path", "set_device_kind"]
 
@@ -115,8 +118,10 @@ def choose(op: str, key: str, default: str) -> str:
         got = _entries.get(f"{op}/{key}")
         if got is None:
             _stats["misses"] += 1
+            stat_add("autotune_cache_miss")
             return default
         _stats["hits"] += 1
+        stat_add("autotune_cache_hit")
         return got
 
 
@@ -135,18 +140,25 @@ def measure(op: str, key: str, candidates: Dict[str, Callable],
     and return the winner. Call with CONCRETE inputs only — the reference's
     warmup-steps measurement, done explicitly rather than inside traces."""
     import jax
+    t_measure = time.perf_counter()
     timings = {}
-    for name, thunk in candidates.items():
-        try:
-            for _ in range(n_warmup):
-                jax.block_until_ready(thunk())
-            t0 = time.perf_counter()
-            for _ in range(n_iters):
-                out = thunk()
-            jax.block_until_ready(out)
-            timings[name] = (time.perf_counter() - t0) / n_iters
-        except Exception:
-            continue  # a candidate that cannot run never wins
+    with _prof.record(f"autotune_measure/{op}", "cache",
+                      args={"key": key}):
+        for name, thunk in candidates.items():
+            try:
+                for _ in range(n_warmup):
+                    jax.block_until_ready(thunk())
+                t0 = time.perf_counter()
+                for _ in range(n_iters):
+                    out = thunk()
+                jax.block_until_ready(out)
+                timings[name] = (time.perf_counter() - t0) / n_iters
+            except Exception:
+                continue  # a candidate that cannot run never wins
+    # the measurement IS the compile+warmup cost the cache amortizes —
+    # surface it so "how long did autotune take" has an answer
+    stat_observe(f"autotune_measure_ms/{op}",
+                 (time.perf_counter() - t_measure) * 1e3)
     if not timings:
         raise RuntimeError(f"no runnable candidate for {op}/{key}")
     winner = min(timings, key=timings.get)

@@ -22,7 +22,18 @@ from .profiler import (  # noqa: F401
 )
 from .xplane import device_op_table, summary_table  # noqa: F401
 
+# structured span profiler (span.py): the substrate the framework's hot
+# paths are instrumented with — record() spans, a profile() session, and
+# chrome-trace / Prometheus / table exporters over spans + monitor stats
+from .span import (  # noqa: F401
+    record, profile, enable, disable, reset, is_active, events, dropped,
+    span_summary, export_chrome_trace, export_prometheus,
+)
+
 __all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
            "make_scheduler", "export_chrome_tracing", "export_protobuf",
            "SortedKeys", "load_profiler_result", "device_op_table",
-           "summary_table"]
+           "summary_table",
+           "record", "profile", "enable", "disable", "reset", "is_active",
+           "events", "dropped", "span_summary", "export_chrome_trace",
+           "export_prometheus"]

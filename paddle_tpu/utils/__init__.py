@@ -10,11 +10,12 @@ from __future__ import annotations
 import functools
 import warnings
 
-from ..framework import monitor  # noqa: F401  (STAT counters)
+from ..framework import monitor  # noqa: F401  (STAT counters + histograms)
+from .. import profiler  # noqa: F401  (span profiler: record/profile/export)
 from . import unique_name  # noqa: F401
 
 __all__ = ["unique_name", "deprecated", "try_import", "monitor",
-           "dlpack", "download", "require_version", "run_check"]
+           "profiler", "dlpack", "download", "require_version", "run_check"]
 from . import dlpack  # noqa: E402,F401
 from . import download  # noqa: E402,F401
 

@@ -1,0 +1,31 @@
+"""CI smoke for the observability surface: ``bench.py --dry-run``.
+
+One tiny CPU train step under profiler.profile() must emit a metrics
+summary (counters non-empty), a chrome trace with >= 3 nested span
+categories, and a Prometheus exposition — the cheap canary that an
+instrumentation regression trips BEFORE it costs a real benchmark round.
+Runs in a subprocess like the real driver invocation; kept inside the
+tier-1 ``-m 'not slow'`` budget (one interpreter + jax-cpu startup).
+"""
+import json
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+BENCH = os.path.join(os.path.dirname(HERE), "bench.py")
+
+
+def test_dry_run_emits_metrics_summary():
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+    res = subprocess.run(
+        [sys.executable, BENCH, "--dry-run"], env=env,
+        capture_output=True, text=True, timeout=300)
+    assert res.returncode == 0, \
+        f"--dry-run failed\nstdout: {res.stdout}\nstderr: {res.stderr[-2000:]}"
+    out = json.loads(res.stdout.strip().splitlines()[-1])
+    assert out["ok"] is True, out
+    assert out["counters"] > 0
+    assert len(out["span_categories"]) >= 3, out
+    # the human-readable stats summary goes to stderr
+    assert "op_count/" in res.stderr

@@ -215,3 +215,393 @@ class TestXPlaneDeviceTable:
     def test_empty_dir_graceful(self, tmp_path):
         from paddle_tpu.profiler.xplane import summary_table
         assert "no xplane trace" in summary_table(str(tmp_path))
+
+
+# ---------------------------------------------------------------------------
+# structured span profiler (profiler/span.py) — the framework-facing
+# substrate: record() spans, profile() sessions, monitor histograms,
+# chrome-trace / Prometheus export, hot-path instrumentation
+# ---------------------------------------------------------------------------
+
+class TestStructuredSpans:
+    def setup_method(self):
+        from paddle_tpu.profiler import span as S
+        from paddle_tpu.framework import monitor
+        S.reset()
+        monitor.stat_reset()
+
+    def test_inactive_profiler_records_nothing(self):
+        import paddle_tpu.profiler as P
+        assert not P.is_active()
+        with P.record("ghost", "user"):
+            pass
+
+        @P.record("ghost_fn", "user")
+        def f():
+            return 7
+
+        assert f() == 7
+        assert P.events() == []
+
+    def test_span_nesting_and_categories(self):
+        import paddle_tpu.profiler as P
+        with P.profile():
+            with P.record("outer", "hapi"):
+                with P.record("mid", "dispatch"):
+                    with P.record("leaf", "cache"):
+                        pass
+        by = {e["name"]: e for e in P.events()}
+        assert by["outer"]["depth"] == 0 and by["outer"]["parent"] is None
+        assert by["mid"]["parent"] == "outer" and by["mid"]["depth"] == 1
+        assert by["leaf"]["parent"] == "mid" and by["leaf"]["depth"] == 2
+        assert {e["cat"] for e in by.values()} == \
+            {"hapi", "dispatch", "cache"}
+
+    def test_span_nesting_across_threads(self):
+        import threading
+        import paddle_tpu.profiler as P
+
+        def worker(tag):
+            with P.record(f"outer_{tag}", "user"):
+                with P.record(f"inner_{tag}", "user"):
+                    pass
+
+        with P.profile():
+            ts = [threading.Thread(target=worker, args=(i,))
+                  for i in range(2)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+        evs = P.events()
+        assert len(evs) == 4
+        by = {e["name"]: e for e in evs}
+        for i in range(2):
+            # each thread keeps its OWN stack: inner nests under the
+            # sibling from the same thread, never the other thread's
+            assert by[f"inner_{i}"]["parent"] == f"outer_{i}"
+            assert by[f"inner_{i}"]["tid"] == by[f"outer_{i}"]["tid"]
+        assert by["outer_0"]["tid"] != by["outer_1"]["tid"]
+
+    def test_chrome_trace_roundtrip(self, tmp_path):
+        import paddle_tpu.profiler as P
+        with P.profile() as sess:
+            with P.record("parent", "hapi", args={"k": 1}):
+                with P.record("child", "dispatch"):
+                    time.sleep(0.001)
+        path = sess.export_chrome_trace(str(tmp_path / "t.json"))
+        with open(path) as f:
+            doc = json.load(f)
+        xs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+        assert len(xs) == 2
+        by = {e["name"]: e for e in xs}
+        for e in xs:
+            assert isinstance(e["ts"], float) and isinstance(e["dur"], float)
+            assert e["dur"] > 0 and "cat" in e and "tid" in e
+        # child interval contained in parent (chrome nests by containment)
+        p, c = by["parent"], by["child"]
+        assert p["ts"] <= c["ts"]
+        assert c["ts"] + c["dur"] <= p["ts"] + p["dur"] + 1e-3
+        assert c["args"]["parent"] == "parent"
+        assert p["args"]["k"] == 1
+
+    def test_decorator_records_when_active(self):
+        import paddle_tpu.profiler as P
+
+        @P.record("decorated", "user")
+        def f(a, b):
+            return a + b
+
+        assert f(1, 2) == 3          # inactive: plain call
+        with P.profile():
+            assert f(3, 4) == 7
+        names = [e["name"] for e in P.events()]
+        assert names == ["decorated"]
+
+    def test_max_events_cap_drops_not_grows(self):
+        import paddle_tpu.profiler as P
+        with P.profile(max_events=5):
+            for i in range(10):
+                with P.record(f"e{i}", "user"):
+                    pass
+        assert len(P.events()) == 5
+        assert P.dropped() == 5
+
+    def test_nested_session_preserves_outer_buffer_and_cap(self):
+        import paddle_tpu.profiler as P
+        from paddle_tpu.profiler import span as S
+        with P.profile(max_events=100):
+            with P.record("before_inner", "user"):
+                pass
+            with P.profile(max_events=5):   # nested window must not wipe
+                with P.record("inside_inner", "user"):
+                    pass
+            assert S._max_events == 100     # cap restored after inner exit
+            with P.profile():               # default nested: INHERITS the
+                assert S._max_events == 100  # outer cap, not the flag
+            assert not S._jax_bridge        # bridge never latched on
+            with P.record("after_inner", "user"):
+                pass
+        names = {e["name"] for e in P.events()}
+        assert names == {"before_inner", "inside_inner", "after_inner"}
+        assert not P.is_active()
+
+    def test_stale_span_from_previous_session_is_dropped(self):
+        """A span begun under session A that ends after session B has
+        reset the buffer must not pollute B's timeline."""
+        import paddle_tpu.profiler as P
+        with P.profile():
+            stale = P.record("stale", "user").begin()
+        with P.profile():            # clear=True resets -> new generation
+            stale.end()
+            with P.record("fresh", "user"):
+                pass
+        assert {e["name"] for e in P.events()} == {"fresh"}
+
+    def test_session_reset_clears_previous_events(self):
+        import paddle_tpu.profiler as P
+        with P.profile():
+            with P.record("first", "user"):
+                pass
+        assert len(P.events()) == 1
+        with P.profile():      # default clear=True starts fresh
+            pass
+        assert P.events() == []
+
+    def test_prometheus_exposition(self):
+        import paddle_tpu.profiler as P
+        from paddle_tpu.framework import monitor
+        monitor.stat_add("demo_counter", 3)
+        for v in (1.0, 2.0, 3.0, 4.0):
+            monitor.stat_observe("demo_ms", v)
+        with P.profile():
+            with P.record("span_a", "user"):
+                pass
+        text = P.export_prometheus()
+        assert '# TYPE paddle_tpu_counter counter' in text
+        assert 'paddle_tpu_counter{name="demo_counter"} 3' in text
+        assert 'paddle_tpu_stat_count{name="demo_ms"} 4' in text
+        assert 'paddle_tpu_stat{name="demo_ms",quantile="0.5"} 2' in text
+        assert 'paddle_tpu_span_ms_count{name="span_a",category="user"} 1' \
+            in text
+
+    def test_train_step_trace_has_nested_categories(self, tmp_path):
+        """Acceptance: profile() around a small train step produces a
+        chrome trace with >= 3 distinct nested span categories."""
+        import numpy as np
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+        import paddle_tpu.profiler as P
+        from paddle_tpu.framework import dispatch
+
+        # force jit-cache misses even late in a long suite run, so the
+        # "cache" span category deterministically appears in the trace
+        dispatch._fn_cache.clear()
+        net = nn.Sequential(nn.Linear(8, 8), nn.ReLU(), nn.Linear(8, 2))
+        model = paddle.Model(net)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=net.parameters())
+        model.prepare(opt, nn.CrossEntropyLoss())
+        x = np.ones((4, 8), np.float32)
+        y = np.zeros((4, 1), np.int64)
+        with P.profile() as sess:
+            model.train_batch([x], [y])
+        path = sess.export_chrome_trace(str(tmp_path / "step.json"))
+        with open(path) as f:
+            doc = json.load(f)
+        xs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+        cats = {e["cat"] for e in xs}
+        assert {"hapi", "dispatch", "cache"} <= cats, cats
+        # nested: op dispatch spans sit below the hapi step span
+        op_spans = [e for e in xs if e["cat"] == "dispatch"]
+        assert op_spans and all(e["args"]["depth"] >= 1 for e in op_spans)
+
+
+class TestMonitorHistograms:
+    def setup_method(self):
+        from paddle_tpu.framework import monitor
+        monitor.stat_reset()
+
+    def test_percentiles_known_distribution(self):
+        from paddle_tpu.framework import monitor
+        for v in range(1, 101):
+            monitor.stat_observe("lat", float(v))
+        h = monitor.stat_histogram("lat")
+        assert h["count"] == 100 and h["sum"] == 5050.0
+        assert h["min"] == 1.0 and h["max"] == 100.0
+        assert (h["p50"], h["p95"], h["p99"]) == (50.0, 95.0, 99.0)
+
+    def test_stat_get_falls_back_to_histogram_sum(self):
+        from paddle_tpu.framework import monitor
+        monitor.stat_observe("only_hist", 2.5)
+        monitor.stat_observe("only_hist", 1.5)
+        assert monitor.stat_get("only_hist") == 4.0
+        assert monitor.stat_get("absent") == 0
+
+    def test_reset_semantics(self):
+        from paddle_tpu.framework import monitor
+        monitor.stat_add("c1", 5)
+        monitor.stat_observe("h1", 1.0)
+        monitor.stat_add("c2", 7)
+        monitor.stat_reset("c1")        # named reset: one counter
+        assert monitor.stat_get("c1") == 0
+        assert monitor.stat_get("c2") == 7
+        monitor.stat_reset("h1")        # named reset: one histogram
+        assert monitor.stat_histogram("h1") is None
+        monitor.stat_observe("h2", 1.0)
+        monitor.stat_reset()            # full reset: counters AND hists
+        assert monitor.all_stats() == {}
+        assert monitor.all_histograms() == {}
+
+    def test_summary_includes_both_families(self):
+        from paddle_tpu.framework import monitor
+        monitor.stat_add("ops", 2)
+        monitor.stat_observe("dur", 3.0)
+        s = monitor.stats_summary()
+        assert "ops" in s and "dur" in s and "p95" in s
+
+    def test_benchmark_flag_routes_to_histogram(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        from paddle_tpu.framework import monitor
+        paddle.set_flags({"FLAGS_benchmark": True})
+        try:
+            x = paddle.to_tensor(np.ones((3, 3), np.float32))
+            for _ in range(3):
+                _ = x + x
+            h = monitor.stat_histogram("op_time_ms/add")
+            assert h is not None and h["count"] >= 3
+            # the old counter-style read still returns the total
+            assert monitor.stat_get("op_time_ms/add") == h["sum"] > 0
+        finally:
+            paddle.set_flags({"FLAGS_benchmark": False})
+
+
+class TestDispatchCacheCounters:
+    def test_jit_cache_hit_miss_counters(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        from paddle_tpu.framework import monitor
+        # a shape this process has certainly not dispatched yet
+        x = paddle.to_tensor(np.ones((3, 5, 7), np.float32))
+        monitor.stat_reset("op_cache_miss/multiply")
+        base_miss = monitor.stat_get("op_cache_miss")
+        _ = x * 31.0                     # miss: new (op, attrs, structure)
+        assert monitor.stat_get("op_cache_miss") >= base_miss + 1
+        assert monitor.stat_get("op_cache_miss/multiply") >= 1
+        base_hit = monitor.stat_get("op_cache_hit")
+        for _ in range(4):
+            _ = x * 31.0                 # identical class: pure hits
+        assert monitor.stat_get("op_cache_hit") >= base_hit + 4
+
+    def test_autotune_cache_counters(self):
+        from paddle_tpu.framework import monitor
+        from paddle_tpu.ops import autotune_cache as ac
+        ac.set_device_kind("testkind_prof")
+        try:
+            ac.clear()
+            base_m = monitor.stat_get("autotune_cache_miss")
+            base_h = monitor.stat_get("autotune_cache_hit")
+            assert ac.choose("attn", "k1", "lax") == "lax"   # miss
+            ac.record("attn", "k1", "pallas", persist=False)
+            assert ac.choose("attn", "k1", "lax") == "pallas"  # hit
+            assert monitor.stat_get("autotune_cache_miss") == base_m + 1
+            assert monitor.stat_get("autotune_cache_hit") == base_h + 1
+        finally:
+            ac.clear()
+            ac.set_device_kind(None)
+
+
+class TestProfilerCallback:
+    def test_callback_nested_in_user_session_keeps_outer_events(self):
+        """A ProfilerCallback window inside a user's own profile() must
+        not clear the user's already-recorded spans."""
+        import numpy as np
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+        import paddle_tpu.profiler as P
+        from paddle_tpu.hapi.callbacks import ProfilerCallback
+
+        net = nn.Linear(5, 2)
+        model = paddle.Model(net)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=net.parameters())
+        model.prepare(opt, nn.CrossEntropyLoss())
+        x = np.ones((8, 5), np.float32)
+        y = np.zeros((8, 1), np.int64)
+        ds = paddle.io.TensorDataset([x, y])
+        with P.profile():
+            with P.record("user_outer", "user"):
+                pass
+            model.fit(ds, batch_size=4, epochs=1, verbose=0,
+                      callbacks=[ProfilerCallback(start_step=0, stop_step=1,
+                                                  summary=False, verbose=0)])
+        assert "user_outer" in {e["name"] for e in P.events()}
+        assert not P.is_active()
+
+    def test_fit_window_exports_trace(self, tmp_path, capsys):
+        import numpy as np
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+        from paddle_tpu.hapi.callbacks import ProfilerCallback
+
+        net = nn.Sequential(nn.Linear(6, 4), nn.ReLU(), nn.Linear(4, 2))
+        model = paddle.Model(net)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=net.parameters())
+        model.prepare(opt, nn.CrossEntropyLoss())
+        x = np.random.RandomState(0).randn(16, 6).astype(np.float32)
+        y = np.random.RandomState(1).randint(0, 2, (16, 1)).astype(np.int64)
+        ds = paddle.io.TensorDataset([x, y])
+        trace = str(tmp_path / "fit_trace.json")
+        prom = str(tmp_path / "metrics.prom")
+        cb = ProfilerCallback(start_step=1, stop_step=3,
+                              chrome_trace_path=trace,
+                              prometheus_path=prom, verbose=0)
+        model.fit(ds, batch_size=4, epochs=1, verbose=0, callbacks=[cb])
+        assert cb._session is None           # window closed mid-train
+        with open(trace) as f:
+            doc = json.load(f)
+        xs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+        steps = [e for e in xs if e["name"] == "hapi/step"]
+        assert len(steps) == 2               # steps 1 and 2 profiled
+        assert {e["args"]["global_step"] for e in steps} == {1, 2}
+        with open(prom) as f:
+            assert "paddle_tpu_span_ms" in f.read()
+        import paddle_tpu.profiler as P
+        assert not P.is_active()
+
+    def test_failed_fit_still_closes_session(self):
+        """A step that raises mid-window must not leak the armed global
+        session (Model.fit dispatches on_train_abort on the error path;
+        on_train_end keeps its success-only semantics)."""
+        import numpy as np
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+        import paddle_tpu.profiler as P
+        from paddle_tpu.hapi.callbacks import Callback, ProfilerCallback
+
+        class Boom(Callback):
+            def on_train_batch_end(self, step, logs=None):
+                if step >= 1:
+                    raise RuntimeError("boom")
+
+        net = nn.Linear(4, 2)
+        model = paddle.Model(net)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=net.parameters())
+        model.prepare(opt, nn.CrossEntropyLoss())
+        ds = paddle.io.TensorDataset(
+            [np.ones((12, 4), np.float32), np.zeros((12, 1), np.int64)])
+        cb = ProfilerCallback(start_step=0, stop_step=None,
+                              summary=False, verbose=0)
+        with pytest.raises(RuntimeError, match="boom"):
+            model.fit(ds, batch_size=4, epochs=1, verbose=0,
+                      callbacks=[cb, Boom()])
+        assert not P.is_active()
+        assert cb._session is None and cb._step_span is None
+
+    def test_bad_window_rejected(self):
+        from paddle_tpu.hapi.callbacks import ProfilerCallback
+        with pytest.raises(ValueError):
+            ProfilerCallback(start_step=3, stop_step=3)
