@@ -613,8 +613,11 @@ def _run_child(name: str, timeout: float, force_cpu: bool = False,
     env["PYTHONPATH"] = HERE + os.pathsep + env.get("PYTHONPATH", "")
     # persistent XLA compilation cache: first compile of a heavy graph
     # through the TPU relay can eat most of a child's budget; later runs
-    # (and the driver's round-end run) hit the serialized executable
-    env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/paddle_tpu_jax_cache")
+    # (and the driver's round-end run) hit the serialized executable.
+    # FLAGS_compile_cache routes it through framework/compile_cache.py —
+    # entries land under ~/.cache/paddle_tpu/xla_cache next to the
+    # autotune cache (JAX_COMPILATION_CACHE_DIR, if set, still wins)
+    env.setdefault("FLAGS_compile_cache", "1")
     if force_cpu:
         env["JAX_PLATFORMS"] = "cpu"
         env["PALLAS_AXON_POOL_IPS"] = ""
@@ -850,15 +853,19 @@ def main():
 
 
 def dry_run():
-    """Offline observability smoke (tier-1 gate: tests/test_bench_dryrun.py).
+    """Offline observability+perf smoke (tier-1 gate:
+    tests/test_bench_dryrun.py).
 
-    Runs ONE tiny train step on the CPU backend under an armed
-    profiler.profile() session and asserts the whole metrics surface
-    works end to end: monitor counters non-empty, a chrome trace with
-    nested span categories, and a Prometheus exposition. Prints the
-    stats summary to stderr and ONE JSON line to stdout; exits nonzero
-    when any assertion fails, so CI catches an instrumentation
-    regression before it costs a real benchmark round."""
+    Runs one tiny train step PLUS a short async fit() on the CPU backend
+    under an armed profiler.profile() session and asserts the whole
+    metrics surface works end to end: monitor counters non-empty, a
+    chrome trace with nested span categories, a Prometheus exposition,
+    the async-fast-path counters (``hapi/host_sync`` bounded at
+    O(steps/log_freq), prefetch put/wait histograms), and the persistent
+    XLA compile cache populating entries. Prints the stats summary to
+    stderr and ONE JSON line to stdout; exits nonzero when any assertion
+    fails, so CI catches an instrumentation or fast-path regression
+    before it costs a real benchmark round."""
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import tempfile
 
@@ -866,7 +873,16 @@ def dry_run():
     import paddle_tpu as paddle
     import paddle_tpu.nn as nn
     from paddle_tpu import profiler
-    from paddle_tpu.framework import monitor
+    from paddle_tpu.framework import compile_cache, monitor
+    from paddle_tpu.io import TensorDataset
+
+    # enable the compile cache into a throwaway dir BEFORE the first jit
+    # so this very run produces entries (clean no-op on a jax without
+    # the knob — then the check is skipped, not failed)
+    cache_dir = tempfile.mkdtemp(prefix="paddle_dryrun_xla_")
+    # floor at 0 so the tiny CPU compiles of this canary produce entries
+    # (production enables keep jax's >1s floor)
+    cache_on = compile_cache.enable(cache_dir, min_compile_time_secs=0)
 
     net = nn.Sequential(nn.Linear(16, 8), nn.ReLU(), nn.Linear(8, 4))
     model = paddle.Model(net)
@@ -876,12 +892,20 @@ def dry_run():
     rng = np.random.RandomState(0)
     x = rng.randn(8, 16).astype(np.float32)
     y = rng.randint(0, 4, (8, 1)).astype(np.int64)
+    n_batches, log_freq = 8, 4
+    xs = rng.randn(8 * n_batches, 16).astype(np.float32)
+    ys = rng.randint(0, 4, (8 * n_batches, 1)).astype(np.int64)
 
     monitor.stat_reset()
     with profiler.profile() as sess:
         loss = model.train_batch([x], [y])
+        # async fast path: donated step + device_prefetch input +
+        # windowed host syncs, all counter-asserted below
+        model.fit(TensorDataset([xs, ys]), batch_size=8, epochs=1,
+                  log_freq=log_freq, shuffle=False, verbose=0)
 
     counters = monitor.all_stats()
+    host_syncs = monitor.stat_get("hapi/host_sync")
     trace_path = os.path.join(tempfile.mkdtemp(prefix="paddle_dryrun_"),
                               "trace.json")
     sess.export_chrome_trace(trace_path)
@@ -890,6 +914,7 @@ def dry_run():
     cats = sorted({e["cat"] for e in doc["traceEvents"]
                    if e.get("ph") == "X"})
     prom = sess.export_prometheus()
+    cache_entries = compile_cache.entries(cache_dir) if cache_on else 0
 
     checks = {
         "counters_nonempty": len(counters) > 0,
@@ -902,12 +927,25 @@ def dry_run():
         "trace_categories": len(cats) >= 3,
         "prometheus_nonempty": "paddle_tpu_counter{name=" in prom,
         "loss_finite": bool(np.isfinite(loss)),
+        # the async-fit sync budget: flushes at step%log_freq==0 plus
+        # the epoch tail, never one stall per batch
+        "host_sync_windowed":
+            0 < host_syncs <= n_batches / log_freq + 2,
+        "prefetch_histograms_present":
+            monitor.stat_histogram("prefetch_put_ms") is not None
+            and monitor.stat_histogram("prefetch_wait_ms") is not None,
+        "prefetch_fed_fit":
+            monitor.stat_get("prefetch_batches") >= n_batches,
+        "compile_cache_populated": (not cache_on) or cache_entries > 0,
     }
     print(monitor.stats_summary(), file=sys.stderr)
     ok = all(checks.values())
     print(json.dumps({"metric": "dry_run", "ok": ok,
                       "counters": len(counters),
                       "span_categories": cats, "trace": trace_path,
+                      "host_syncs": host_syncs,
+                      "compile_cache_enabled": bool(cache_on),
+                      "compile_cache_entries": cache_entries,
                       "loss": round(float(loss), 4), "checks": checks}),
           flush=True)
     sys.exit(0 if ok else 1)

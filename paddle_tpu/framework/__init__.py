@@ -48,3 +48,8 @@ from .random import (default_generator, rng_guard, seed)  # noqa: E402,F401
 from .tensor import (GradNode, Parameter, Tensor,  # noqa: E402,F401
                      is_grad_enabled, no_grad, no_grad_guard, run_backward)
 from .dispatch import call_op  # noqa: E402,F401
+
+# env-seeded persistent XLA compilation cache: FLAGS_compile_cache=1
+# arms it for the whole process at import, mirroring FLAGS_enable_profiler
+from . import compile_cache  # noqa: E402,F401
+compile_cache.maybe_enable()

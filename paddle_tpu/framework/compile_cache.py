@@ -1,0 +1,182 @@
+"""Persistent XLA compilation cache (framework-level).
+
+The first jit of a heavy graph costs seconds to minutes (GPT-2 through a
+busy TPU relay has eaten most of a 300 s bench child on compiles alone —
+bench.py's robustness notes). XLA can serialize compiled executables to
+disk and reload them keyed on (HLO, compile options, jaxlib version), so
+every process after the first skips the compile entirely. This module
+wires jax's knobs for that behind the framework flag surface and parks
+the entries under the same ``~/.cache/paddle_tpu/`` root the autotune
+cache uses (ops/autotune_cache.py), so one directory carries all
+persistent per-machine tuning state.
+
+Usage::
+
+    FLAGS_compile_cache=1 python train.py          # env-seeded, or
+    paddle.set_flags({"FLAGS_compile_cache": True}) # before jits, then
+    compile_cache.enable()                          # explicit form
+
+``enable()`` is called automatically at package import when
+``FLAGS_compile_cache`` is set (framework/__init__.py), and by
+``bench.py`` for every child so repeat benchmark runs skip recompiles.
+Every jax knob is feature-tested with ``hasattr`` — on a jax build
+without the persistent cache this degrades to a clean no-op recorded in
+``status()["reason"]``, never an AttributeError.
+
+Reference analog: the reference caches serialized CUDA autotune/program
+state per machine; jax's compilation cache is the XLA-era equivalent.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+from .flags import flag_value
+
+__all__ = ["cache_root", "default_dir", "enable", "disable", "status",
+           "entries", "maybe_enable"]
+
+_lock = threading.Lock()
+_state = {"enabled": False, "dir": None, "reason": None}
+
+
+def cache_root() -> str:
+    """The per-user persistent cache root shared by every paddle_tpu
+    cache family (autotune entries, XLA executables). Override with
+    ``PADDLE_TPU_CACHE_ROOT``."""
+    return os.environ.get(
+        "PADDLE_TPU_CACHE_ROOT",
+        os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu"))
+
+
+def default_dir() -> str:
+    """Where XLA executables land when no explicit dir is configured:
+    ``FLAGS_compile_cache_dir``, else jax's own ``JAX_COMPILATION_CACHE_DIR``
+    env (native jax deployments keep working), else
+    ``<cache_root()>/xla_cache``."""
+    return flag_value("FLAGS_compile_cache_dir") or \
+        os.environ.get("JAX_COMPILATION_CACHE_DIR") or \
+        os.path.join(cache_root(), "xla_cache")
+
+
+def enable(cache_dir: Optional[str] = None,
+           min_compile_time_secs: Optional[float] = None) -> bool:
+    """Turn the persistent cache on for this process. Returns True when
+    jax accepted the configuration; False (with ``status()["reason"]``
+    set) when the installed jax has no cache support or the directory is
+    unwritable. Safe to call repeatedly; the last dir wins.
+
+    ``min_compile_time_secs``: only compiles at least this long are
+    persisted. None keeps jax's own floor (~1 s) — the right production
+    default: micro-compiles cost more to serialize than to redo and
+    would grow the dir without bound. Pass 0 to persist everything
+    (tests, the dry-run canary, tiny-model runs)."""
+    d = cache_dir or default_dir()
+    try:
+        import jax
+    except Exception as e:  # pragma: no cover - jax is a hard dep
+        with _lock:
+            _state.update(enabled=False, reason=f"jax import failed: {e}")
+        return False
+    if not hasattr(jax.config, "jax_compilation_cache_dir"):
+        with _lock:
+            _state.update(
+                enabled=False,
+                reason="this jax has no jax_compilation_cache_dir knob")
+        return False
+    try:
+        os.makedirs(d, exist_ok=True)
+    except OSError as e:
+        if cache_dir is not None:
+            # an EXPLICITLY requested dir fails honestly
+            with _lock:
+                _state.update(enabled=False,
+                              reason=f"cache dir unwritable: {e}")
+            return False
+        # default root unwritable (read-only HOME in CI containers):
+        # fall back to a PER-UID tmp dir so bench children still skip
+        # recompiles — worse persistence beats silently losing the cache.
+        # The uid suffix + ownership check prevent another local user
+        # pre-creating the path and feeding us poisoned serialized
+        # executables (jax deserializes whatever it finds there).
+        import tempfile
+        uid = getattr(os, "getuid", lambda: "u")()
+        d = os.path.join(tempfile.gettempdir(),
+                         f"paddle_tpu_xla_cache_{uid}")
+        try:
+            os.makedirs(d, exist_ok=True)
+            if hasattr(os, "getuid") and os.stat(d).st_uid != os.getuid():
+                raise OSError(f"{d} is owned by another user")
+        except OSError as e2:
+            with _lock:
+                _state.update(enabled=False,
+                              reason=f"cache dir unwritable: {e2}")
+            return False
+    jax.config.update("jax_compilation_cache_dir", d)
+    # knobs that exist on newer jaxes only — each individually optional
+    knobs = [("jax_enable_compilation_cache", True)]  # master switch
+    #          (default True, but a prior disable() must be reversible)
+    if min_compile_time_secs is not None:
+        knobs += [("jax_persistent_cache_min_compile_time_secs",
+                   float(min_compile_time_secs)),
+                  ("jax_persistent_cache_min_entry_size_bytes", 0)]
+    for knob, val in knobs:
+        if hasattr(jax.config, knob):
+            jax.config.update(knob, val)
+    _reset_jax_cache_module()
+    with _lock:
+        _state.update(enabled=True, dir=d, reason=None)
+    return True
+
+
+def _reset_jax_cache_module() -> None:
+    """jax's compilation_cache initializes AT MOST ONCE per process: if
+    any jit ran before enable() (cache dir unset at the time), the module
+    latched 'disabled' and config updates are silently ignored. Reset it
+    so the next compile re-initializes against the new settings."""
+    try:
+        from jax._src import compilation_cache as _jcc
+        if hasattr(_jcc, "reset_cache"):
+            _jcc.reset_cache()
+    except Exception:  # private-API drift: stay best-effort
+        pass
+
+
+def disable() -> None:
+    """Stop persisting (already-written entries stay on disk)."""
+    try:
+        import jax
+        if hasattr(jax.config, "jax_compilation_cache_dir"):
+            jax.config.update("jax_compilation_cache_dir", None)
+        _reset_jax_cache_module()
+    except Exception:
+        pass
+    with _lock:
+        _state.update(enabled=False, reason="disabled")
+
+
+def status() -> dict:
+    with _lock:
+        return dict(_state)
+
+
+def entries(cache_dir: Optional[str] = None) -> int:
+    """Number of serialized-executable entries on disk (``-cache`` files
+    when jax names them that way, else all regular files)."""
+    d = cache_dir or _state["dir"] or default_dir()
+    try:
+        names = os.listdir(d)
+    except OSError:
+        return 0
+    cache_files = [n for n in names if n.endswith("-cache")]
+    return len(cache_files) if cache_files else \
+        sum(os.path.isfile(os.path.join(d, n)) for n in names)
+
+
+def maybe_enable() -> bool:
+    """Import-time hook: arm the cache iff ``FLAGS_compile_cache`` is set
+    (env-seeded like every flag)."""
+    if flag_value("FLAGS_compile_cache"):
+        return enable()
+    return False

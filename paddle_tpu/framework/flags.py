@@ -95,6 +95,18 @@ define_flag("FLAGS_enable_profiler", False,
 define_flag("FLAGS_profiler_max_events", 1_000_000,
             "Span buffer cap: past it events are dropped (and counted in "
             "profiler.dropped()) instead of growing host memory")
+define_flag("FLAGS_compile_cache", False,
+            "Persist XLA-compiled executables to disk "
+            "(framework/compile_cache.py) so repeat runs skip recompiles; "
+            "armed at import when env-seeded (FLAGS_compile_cache=1)")
+define_flag("FLAGS_compile_cache_dir", "",
+            "Directory for the persistent XLA compilation cache; empty "
+            "means JAX_COMPILATION_CACHE_DIR or "
+            "~/.cache/paddle_tpu/xla_cache (the autotune-cache root)")
+define_flag("FLAGS_hapi_prefetch", True,
+            "Route Model.fit/evaluate input through io.device_prefetch "
+            "(background H2D overlapping compute); the escape hatch for "
+            "iterables that must not be read ahead of consumption")
 define_flag("FLAGS_cudnn_deterministic", False, "Parity flag")
 define_flag("FLAGS_embedding_deterministic", False, "Parity flag")
 define_flag("FLAGS_conv_workspace_size_limit", 512, "Parity flag (MB)")

@@ -8,8 +8,11 @@ memory/stats.cc) and the ``benchmark`` flag that prints per-op timing
 Two stat families:
 
 * **counters** (``stat_add``/``stat_get``) — monotonically accumulated
-  floats, e.g. ``op_count/<name>`` (calls per op, always on, ~free) and
-  ``op_cache_hit``/``op_cache_miss`` (jit executable cache);
+  floats, e.g. ``op_count/<name>`` (calls per op, always on, ~free),
+  ``op_cache_hit``/``op_cache_miss`` (jit executable cache), and
+  ``hapi/host_sync`` (device→host flushes in ``Model.fit`` — the async
+  fast path's sync budget, asserted at O(steps/log_freq) by tests and
+  ``bench.py --dry-run`` rather than assumed);
 * **histograms** (``stat_observe``/``stat_histogram``) — value
   distributions with count/sum/min/max and p50/p95/p99 over a bounded
   reservoir, e.g. ``op_time_ms/<name>`` (per-call wall ms when

@@ -2,6 +2,14 @@
 
 Analog of the reference's ``python/paddle/hapi/callbacks.py`` (ProgBarLogger,
 ModelCheckpoint:534, EarlyStopping:690, LRScheduler:599, History).
+
+Windowed-log contract (async fit path): ``Model.fit`` keeps loss/metrics
+on device and flushes to the host once per ``log_freq`` steps, so the
+``logs`` dict passed to ``on_train_batch_end`` updates at flush steps
+(``step % log_freq == 0``) and holds the last flushed values in between
+— aligned with ProgBarLogger's print cadence, which is why per-step
+consumers see no staleness at default settings. Epoch-end hooks always
+receive freshly flushed values.
 """
 from __future__ import annotations
 
@@ -123,6 +131,17 @@ class ProgBarLogger(Callback):
                 parts.append(f"{k}: {np.asarray(v).ravel()}")
             elif isinstance(v, float):
                 parts.append(f"{k}: {v:.4f}")
+            elif getattr(v, "ndim", None) == 0:
+                # 0-d device scalars (a user forwarding unflushed
+                # values) format like floats instead of printing a
+                # jax.Array repr; note float() on one is a host fetch —
+                # fit's own logs are always pre-flushed floats, so the
+                # fast path never pays this. Plain ints/bools fall
+                # through and keep their native formatting.
+                try:
+                    parts.append(f"{k}: {float(v):.4f}")
+                except (TypeError, ValueError):
+                    parts.append(f"{k}: {v}")
             else:
                 parts.append(f"{k}: {v}")
         return " - ".join(parts)

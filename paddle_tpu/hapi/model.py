@@ -24,14 +24,12 @@ import numpy as np
 
 from ..framework import random as _random
 from ..framework.io import load as _load, save as _save
-from ..framework.monitor import stat_observe
+from ..framework.monitor import stat_add, stat_observe
 from ..framework.tensor import Tensor, no_grad_guard
 from ..profiler import span as _prof
 from ..io import DataLoader, Dataset
 from ..metric import Metric
-from ..nn.layer.layers import (
-    Layer, functional_state, get_buffers_tree, get_params_tree,
-)
+from ..nn.layer.layers import Layer, functional_state
 from .callbacks import config_callbacks
 
 __all__ = ["Model"]
@@ -194,6 +192,12 @@ def _as_arrays(batch):
 
 
 class Model:
+    # with metrics attached, the async-fit window holds each step's
+    # outputs until the flush; this caps how many batches of outputs can
+    # be pinned on device when log_freq is large (sync count stays
+    # O(steps / min(log_freq, cap)) — still windowed, never per-step)
+    _METRIC_WINDOW = 8
+
     def __init__(self, network: Layer, inputs=None, labels=None):
         self.network = network
         self._inputs = _to_list(inputs)
@@ -207,6 +211,9 @@ class Model:
         self._params = None       # dict name -> jnp array (device state)
         self._opt_state = None
         self._buffers = None
+        self._frozen = None       # stop_gradient param names (static
+        #                           split baked into the jitted step)
+        self._dirty = False       # functional state newer than network?
         self._step_counter = 0
         self._amp_level = "O0"
         self._amp_dtype = "bfloat16"
@@ -245,19 +252,151 @@ class Model:
         return self
 
     def _sync_state_from_network(self):
-        self._params = get_params_tree(self.network)
-        self._buffers = get_buffers_tree(self.network)
+        # snapshot the (name, Tensor) bindings once per sync: the
+        # per-step rebind must not pay a recursive module walk
+        self._bind_params = list(self.network.named_parameters())
+        self._bind_buffers = list(self.network.named_buffers())
+        net_params = {n: p._data for n, p in self._bind_params}
+        net_buffers = {n: b._data for n, b in self._bind_buffers}
+        if self._params is not None:
+            # after a donated step the network Tensors hold stale
+            # (deleted) handles until _sync_state_to_network runs; for
+            # those the functional state IS the current value. A valid
+            # network array — user assignment, set_state_dict — still
+            # wins, preserving "the network is the API surface".
+            def _undeleted(tree, current):
+                return {
+                    k: current[k]
+                    if (k in current and hasattr(v, "is_deleted")
+                        and v.is_deleted()) else v
+                    for k, v in tree.items()}
+            net_params = _undeleted(net_params, self._params)
+            net_buffers = _undeleted(net_buffers, self._buffers or {})
+        self._params = net_params
+        self._buffers = net_buffers
+        # frozen set = stop_gradient params. The jitted step bakes it in
+        # (static trainable/frozen split), so a change — progressive
+        # unfreezing between fits — forces a re-trace and reconciles the
+        # optimizer state: surviving moments are kept, newly-trainable
+        # params start from zeroed slots, newly-frozen ones are dropped.
+        frozen = {name for name, p in self._bind_params
+                  if p.stop_gradient}
+        if self._frozen is not None and frozen != self._frozen:
+            self._train_step_fn = None
+            if self._optimizer is not None and self._opt_state is not None:
+                old = self._opt_state
+                trainable = {k: v for k, v in self._params.items()
+                             if k not in frozen}
+                new_state = self._optimizer.init_state(trainable)
+                for name, slots in new_state["slots"].items():
+                    old_slots = old["slots"].get(name)
+                    if old_slots is None:
+                        # newly-trainable param: zeroed moments — record
+                        # its birth step so Adam-style bias correction
+                        # runs from this param's own t=0 (see "_t0" in
+                        # Optimizer.apply_gradients) instead of
+                        # mis-scaling against the global step history.
+                        # `+ 0` forces a DISTINCT buffer: sharing the
+                        # step array across donated slots is a
+                        # donate-the-same-buffer-twice XLA error
+                        slots["_t0"] = old["step"] + 0
+                        continue
+                    for sname, arr in old_slots.items():
+                        if sname in slots and \
+                                arr.shape == slots[sname].shape:
+                            slots[sname] = arr
+                        elif sname == "_t0":
+                            slots[sname] = arr  # keep the birth marker
+                new_state["step"] = old["step"]
+                self._opt_state = new_state
+        self._frozen = frozen
+        if self._optimizer is not None and self._opt_state is not None \
+                and int(getattr(self._optimizer, "_step_count", 0)) > \
+                int(self._opt_state["step"]):
+            # eager opt.step() ran since the last mirror: the
+            # optimizer's slot store is the newer state — rebuild the
+            # functional state from it (the overlay below reads both key
+            # namespaces) instead of resuming the stale snapshot and
+            # silently discarding the eager progress
+            self._opt_state = None
         if self._optimizer is not None and self._opt_state is None:
-            self._opt_state = self._optimizer.init_state(self._params)
+            self._opt_state = self._optimizer.init_state(
+                {k: v for k, v in self._params.items() if k not in frozen})
+            # overlay restored slots (optimizer.set_state_dict via
+            # Model.load, or prior eager opt.step() training) so existing
+            # moments survive the functional re-init. Two key namespaces
+            # exist: hapi checkpoints use structural tree names (stable
+            # across processes/instances), the eager optimizer keys by
+            # Parameter.name (process-global counters) — accept either.
+            restored = getattr(self._optimizer, "_slots", {})
+            eager_name = {n: p.name
+                          for n, p in self.network.named_parameters()}
+            any_restored = False
+            for name, slots in self._opt_state["slots"].items():
+                src = restored.get(name) or \
+                    restored.get(eager_name.get(name), {})
+                for sname in slots:
+                    arr = src.get(sname)
+                    if arr is not None and arr.shape == slots[sname].shape:
+                        slots[sname] = jnp.asarray(arr, slots[sname].dtype)
+                        any_restored = True
+                if "_t0" in src:  # birth-step marker rides along
+                    slots["_t0"] = jnp.asarray(src["_t0"], jnp.int32) + 0
+            # carry the step count only when moments came with it (or the
+            # optimizer keeps none, e.g. SGD) — step>0 over zeroed Adam
+            # moments would silently mis-scale the bias correction
+            step = int(getattr(self._optimizer, "_step_count", 0))
+            if step and (any_restored or not self._optimizer._slot_names):
+                self._opt_state["step"] = jnp.asarray(step, jnp.int32)
 
-    def _sync_state_to_network(self):
+    def _rebind_network_state(self):
+        """Point the network's Tensors at the CURRENT functional state.
+
+        Pure Python reference assignment — no device work, no host sync,
+        no module walk (bindings snapshotted in _sync_state_from_network)
+        — so the donated train step can run it every dispatch: user code
+        reading ``net.some.weight`` between steps sees live post-step
+        arrays instead of the donated (deleted) pre-step buffers."""
         if self._params is None:
             return
-        for name, p in self.network.named_parameters():
-            p._data = self._params[name]
-        for name, b in self.network.named_buffers():
+        binds = getattr(self, "_bind_params", None)
+        if binds is None:
+            binds = list(self.network.named_parameters())
+        for name, p in binds:
+            if name in self._params:
+                p._data = self._params[name]
+        bbinds = getattr(self, "_bind_buffers", None)
+        if bbinds is None:
+            bbinds = list(self.network.named_buffers())
+        for name, b in bbinds:
             if name in self._buffers:
                 b._data = self._buffers[name]
+
+    def _sync_state_to_network(self):
+        # freshness guard: only mirror when the functional state has
+        # advanced since the last sync (_dirty set per dispatch) —
+        # unconditional mirroring would roll back eager training done
+        # AFTER fit() (p._data and optimizer slots reverting to the
+        # fit-era snapshot on a mere model.parameters() call)
+        if not self._dirty:
+            return
+        self._rebind_network_state()
+        # mirror the functional opt state back into the optimizer's eager
+        # slot store so state_dict()/save() reflect training done through
+        # the jitted (donated) step — without this, moments trained in
+        # fit() were silently dropped from the .pdopt checkpoint
+        if self._optimizer is not None and self._opt_state is not None:
+            self._optimizer._slots = {
+                name: dict(slots)
+                for name, slots in self._opt_state["slots"].items()}
+            self._optimizer._step_count = int(self._opt_state["step"])
+            # bridge for a later eager opt.step(): Parameter.name ->
+            # tree name, so _ensure_slots migrates these entries instead
+            # of restarting from zeros (see Optimizer._ensure_slots)
+            binds = getattr(self, "_bind_params", None) or \
+                list(self.network.named_parameters())
+            self._optimizer._slot_aliases = {p.name: n for n, p in binds}
+        self._dirty = False
 
     def _loss_tensors(self, outputs, labels):
         if self._loss is None:
@@ -280,15 +419,24 @@ class Model:
         self._pallas_gate()
         net, opt = self.network, self._optimizer
         clip = getattr(opt, "_grad_clip", None)
+        # static split, baked into the trace: frozen (stop_gradient)
+        # params are threaded through untouched — no gradient computed,
+        # no optimizer slots, output aliases the donated input — which
+        # is both the dygraph freezing contract (the old functional step
+        # silently trained frozen params) and free under donation
+        frozen = frozenset(self._frozen or ())
 
         def train_step(params, opt_state, buffers, key, lr, n_inputs,
                        *arrays):
             inputs = arrays[:n_inputs]
             label_arrays = arrays[n_inputs:]
+            froz_p = {k: v for k, v in params.items() if k in frozen}
+            train_p = {k: v for k, v in params.items() if k not in frozen}
 
             def loss_of(p):
                 with _random.rng_guard(key), self._maybe_amp():
-                    with functional_state(net, p, buffers) as st:
+                    with functional_state(net, {**p, **froz_p},
+                                          buffers) as st:
                         with no_grad_guard():
                             ins = [Tensor(a, stop_gradient=True)
                                    for a in inputs]
@@ -302,16 +450,31 @@ class Model:
                     ([o._data for o in outs], new_buffers)
 
             (loss_val, (outs, new_buffers)), grads = jax.value_and_grad(
-                loss_of, has_aux=True)(params)
+                loss_of, has_aux=True)(train_p)
             if clip is not None:
-                pairs = clip([(params[k], g) for k, g in grads.items()])
+                pairs = clip([(train_p[k], g) for k, g in grads.items()])
                 grads = {k: g for (k, (_, g)) in zip(grads.keys(), pairs)}
-            new_params, new_opt_state = opt.apply_gradients(
-                params, grads, opt_state, lr)
+            new_train, new_opt_state = opt.apply_gradients(
+                train_p, grads, opt_state, lr)
+            new_params = dict(params)
+            new_params.update(new_train)
             return new_params, new_opt_state, new_buffers, loss_val, outs
 
+        # donate params/opt_state/buffers: every output leaf has a
+        # same-shape/dtype donated input, so XLA aliases the update
+        # in-place instead of allocating a second copy of the whole train
+        # state per step — halving train-state HBM residency (the sharded
+        # weight-update argument of arXiv 2004.13336, applied to
+        # single-chip aliasing). The OLD buffers are deleted the moment
+        # the step is dispatched: _dispatch_train_step rebinds
+        # self._params/_opt_state/_buffers AND the network's Tensors to
+        # the results (reference assignment, no sync), so nothing may —
+        # or can accidentally — touch the donated arrays afterwards;
+        # a raw pre-step ._data capture raises jax's "Array has been
+        # deleted", never silent garbage.
         self._train_step_fn = jax.jit(train_step,
-                                      static_argnames=("n_inputs",))
+                                      static_argnames=("n_inputs",),
+                                      donate_argnums=(0, 1, 2))
 
     def _build_eval_step(self):
         net = self.network
@@ -334,6 +497,9 @@ class Model:
                             loss = jnp.zeros((), jnp.float32)
             return loss, [o._data for o in outs]
 
+        # no donation here: eval/predict REUSE params and buffers across
+        # batches (the step returns neither), so donating them would
+        # delete live state after the first batch
         self._eval_step_fn = jax.jit(eval_step,
                                      static_argnames=("n_inputs",))
 
@@ -343,6 +509,45 @@ class Model:
         # cannot lower on this chip must degrade to lax, not crash fit()
         from ..ops import pallas_smoke
         pallas_smoke.ensure()
+
+    def _dispatch_train_step(self, ins, lbs):
+        """Dispatch ONE donated jitted step and rebind the train state.
+
+        Returns (loss, outs) as device values without any host sync —
+        the donation contract lives here: the previous
+        params/opt_state/buffers are consumed by the call, so they are
+        rebound to the step's results in the same statement and the old
+        handles are never touched again."""
+        self._step_counter += 1
+        key = jax.random.fold_in(jax.random.key(0), self._step_counter)
+        lr = jnp.asarray(self._optimizer.get_lr(), jnp.float32)
+        (self._params, self._opt_state, self._buffers, loss,
+         outs) = self._train_step_fn(
+            self._params, self._opt_state, self._buffers, key, lr,
+            len(ins), *ins, *lbs)
+        self._dirty = True
+        # reference-only rebind (no sync): the network must never be
+        # left pointing at the donated pre-step buffers
+        self._rebind_network_state()
+        return loss, outs
+
+    def _ensure_train_built(self):
+        if self._train_step_fn is None or self._params is None:
+            self.network.train()
+            self._sync_state_from_network()
+        elif self._frozen is not None and \
+                getattr(self, "_bind_params", None):
+            # cheap staleness probe (attr reads over the cached binds, no
+            # module walk): stop_gradient flips between raw train_batch
+            # calls must re-trace + reconcile optimizer slots exactly as
+            # they do at fit() start — otherwise the frozen split baked
+            # into the jitted step silently keeps training frozen params
+            frozen_now = {n for n, p in self._bind_params
+                          if p.stop_gradient}
+            if frozen_now != self._frozen:
+                self._sync_state_from_network()
+        if self._train_step_fn is None:  # fresh build or forced re-trace
+            self._build_train_step()
 
     def train_batch(self, inputs, labels=None, update=True,
                     return_numpy=True):
@@ -355,31 +560,29 @@ class Model:
         adapter = self._static()
         if adapter is not None:
             return adapter.train_batch(inputs, labels)
-        # hapi/step_time_ms is HOST wall time of the step call: with
-        # return_numpy=False jax dispatches asynchronously, so this
-        # measures dispatch+tracing, not device compute — the span/
-        # histogram pair still localises stalls (compiles, H2D, syncs)
+        loss, outs, lbs = self._timed_dispatch(inputs, labels)
+        metrics = self._update_metrics(outs, lbs)
+        if return_numpy:
+            loss = float(loss)
+        return (loss, metrics) if metrics else loss
+
+    def _timed_dispatch(self, inputs, labels):
+        """Build-if-needed + span + one async dispatch: the shared body
+        of train_batch and fit's inner loop. Returns device (loss, outs)
+        plus the coerced label arrays (for metric updates).
+
+        hapi/step_time_ms is HOST wall time of the step call: jax
+        dispatches asynchronously, so this measures dispatch+tracing,
+        not device compute — the span/histogram pair still localises
+        stalls (compiles, H2D, syncs)."""
         t0 = time.perf_counter()
         with _prof.record("hapi/train_batch", "hapi"):
-            if self._train_step_fn is None:
-                self.network.train()
-                self._sync_state_from_network()
-                self._build_train_step()
+            self._ensure_train_built()
             ins = _as_arrays(inputs)
             lbs = _as_arrays(labels) if labels is not None else []
-            self._step_counter += 1
-            key = jax.random.fold_in(jax.random.key(0), self._step_counter)
-            lr = jnp.asarray(self._optimizer.get_lr(), jnp.float32)
-            (self._params, self._opt_state, self._buffers, loss,
-             outs) = self._train_step_fn(
-                self._params, self._opt_state, self._buffers, key, lr,
-                len(ins), *ins, *lbs)
-            metrics = self._update_metrics(outs, lbs)
-            self._dirty = True
-            if return_numpy:
-                loss = float(loss)
+            loss, outs = self._dispatch_train_step(ins, lbs)
         stat_observe("hapi/step_time_ms", (time.perf_counter() - t0) * 1e3)
-        return (loss, metrics) if metrics else loss
+        return loss, outs, lbs
 
     def eval_batch(self, inputs, labels=None):
         adapter = self._static()
@@ -431,9 +634,66 @@ class Model:
         return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
                           num_workers=num_workers, drop_last=drop_last)
 
+    def _maybe_prefetch(self, loader, prefetch, buffer_size=2):
+        """Wrap ``loader`` in io.device_prefetch unless switched off by
+        the ``prefetch`` argument (None defers to FLAGS_hapi_prefetch) or
+        static mode. Sharding-aware: set ``model._prefetch_sharding`` to
+        a jax.sharding.Sharding to land batches pre-sharded."""
+        from ..framework.flags import flag_value
+        if loader is None or self._static() is not None:
+            return loader
+        if prefetch is None:
+            prefetch = bool(flag_value("FLAGS_hapi_prefetch"))
+        if not prefetch:
+            return loader
+        from ..io import device_prefetch
+        return device_prefetch(loader,
+                               sharding=getattr(self, "_prefetch_sharding",
+                                                None),
+                               buffer_size=buffer_size)
+
+    def _flush_window(self, window):
+        """ONE host sync for a window of buffered device step results:
+        fetch the last loss (its value bounds every queued step, so this
+        is the only pipeline stall), then run the windowed metric updates
+        — their D2H copies read already-computed arrays. Counted in
+        ``hapi/host_sync`` so the sync budget of fit() is asserted by
+        tests and bench.py --dry-run, not assumed."""
+        if not window:
+            return {}
+        t0 = time.perf_counter()
+        with _prof.record("hapi/host_sync", "hapi",
+                          args={"steps": len(window)}):
+            loss = float(np.asarray(window[-1][0]).ravel()[0])
+            metrics = []
+            for _, outs, lbs in window:
+                if outs is not None:
+                    metrics = self._update_metrics(outs, lbs)
+        window.clear()
+        stat_add("hapi/host_sync")
+        stat_observe("hapi/host_sync_ms",
+                     (time.perf_counter() - t0) * 1e3)
+        return self._pack_logs((loss, metrics) if metrics else loss)
+
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
-            drop_last=False, shuffle=True, num_workers=0, callbacks=None):
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            prefetch=None, prefetch_buffer_size=2):
+        """Train over ``train_data``, asynchronously on the dygraph path:
+        steps are dispatched without blocking (donated jitted step), the
+        next batch's H2D transfer rides under compute via
+        ``io.device_prefetch`` (``prefetch=None`` defers to
+        ``FLAGS_hapi_prefetch``; pass False for iterables that must not
+        be read ahead), and loss/metrics stay device values flushed to
+        the host only every ``log_freq`` steps and at epoch end — O(steps
+        / log_freq) host syncs per epoch (the ``hapi/host_sync`` counter)
+        instead of one stall per batch (with metrics attached the window
+        additionally caps at ``_METRIC_WINDOW`` steps so pinned outputs
+        stay bounded). Between flushes,
+        ``on_train_batch_end`` receives the last flushed logs, so
+        per-step scalar consumers (e.g. VisualDL) see values at
+        ``log_freq`` granularity on this path; the static-graph adapter
+        keeps per-step logs (its executor is host-synchronous anyway)."""
         loader = self._as_loader(train_data, batch_size, shuffle,
                                  num_workers, drop_last)
         eval_loader = self._as_loader(eval_data, batch_size, False,
@@ -448,7 +708,8 @@ class Model:
             save_dir=save_dir, metrics=self._metric_names())
         self.stop_training = False
         self.network.train()
-        if self._static() is None:
+        async_path = self._static() is None
+        if async_path:
             self._sync_state_from_network()
             if self._train_step_fn is None:
                 self._build_train_step()
@@ -461,17 +722,49 @@ class Model:
                 for m in self._metrics:
                     m.reset()
                 logs = {}
-                for step, batch in enumerate(loader):
+                window = []
+                data_iter = self._maybe_prefetch(loader, prefetch,
+                                                 prefetch_buffer_size)
+                for step, batch in enumerate(data_iter):
                     cbks.on_train_batch_begin(step)
                     inputs, labels = self._split_batch(batch)
-                    result = self.train_batch(inputs, labels)
-                    logs = self._pack_logs(result)
+                    if not async_path:
+                        result = self.train_batch(inputs, labels)
+                        logs = self._pack_logs(result)
+                    else:
+                        loss, outs, lbs = self._timed_dispatch(inputs,
+                                                               labels)
+                        # without metrics the outputs are dead weight —
+                        # drop the refs so XLA frees them immediately
+                        # (GPT-size logits held over a window would
+                        # otherwise pin log_freq batches of HBM); WITH
+                        # metrics the window itself must pin outputs, so
+                        # its length is capped: at most _METRIC_WINDOW
+                        # batches of outputs live on device even when
+                        # log_freq is large
+                        entry = (loss, outs if self._metrics else None,
+                                 lbs if self._metrics else None)
+                        if self._metrics or not window:
+                            window.append(entry)
+                        else:
+                            # loss-only window: _flush_window reads just
+                            # the last loss, so keep O(1) device buffers
+                            # alive however large log_freq is
+                            window[0] = entry
+                        # log_freq <= 0 means "epoch-end flushes only"
+                        # (pre-async fit accepted 0 as 'never log')
+                        if (log_freq > 0 and step % log_freq == 0) or (
+                                self._metrics and
+                                len(window) >= self._METRIC_WINDOW):
+                            logs = self._flush_window(window)
                     cbks.on_train_batch_end(step, logs)
+                if window:  # tail of the epoch since the last flush
+                    logs = self._flush_window(window)
                 cbks.on_epoch_end(epoch, logs)
                 if eval_loader is not None and (epoch + 1) % eval_freq == 0:
                     self.evaluate(eval_loader, batch_size=batch_size,
                                   verbose=verbose, callbacks=cbks,
-                                  _inside_fit=True)
+                                  prefetch=prefetch, _inside_fit=True)
             cbks.on_train_end()
         except BaseException:
             # teardown-only hook: a failed fit must not leak callback-held
@@ -486,7 +779,8 @@ class Model:
             self._sync_state_to_network()
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
-                 num_workers=0, callbacks=None, _inside_fit=False):
+                 num_workers=0, callbacks=None, prefetch=None,
+                 _inside_fit=False):
         loader = self._as_loader(eval_data, batch_size, False, num_workers,
                                  False)
         self.network.eval()
@@ -501,7 +795,8 @@ class Model:
             metrics=self._metric_names())
         cbks.on_eval_begin()
         total_loss, n = 0.0, 0
-        for step, batch in enumerate(loader):
+        data_iter = self._maybe_prefetch(loader, prefetch)
+        for step, batch in enumerate(data_iter):
             cbks.on_eval_batch_begin(step)
             inputs, labels = self._split_batch(batch)
             result = self.eval_batch(inputs, labels)
@@ -602,6 +897,12 @@ class Model:
                 os.path.exists(opt_path):
             self._optimizer.set_state_dict(_load(opt_path))
             self._opt_state = None
+            # checkpoints written after fit() carry tree-named slots;
+            # arm the adoption bridge (Optimizer._ensure_slots) so an
+            # eager opt.step() straight after load migrates them instead
+            # of bias-correcting fresh zeros at the carried step count
+            self._optimizer._slot_aliases = {
+                p.name: n for n, p in self.network.named_parameters()}
 
     def parameters(self, *args, **kwargs):
         self._sync_state_to_network()

@@ -128,6 +128,14 @@ class FusedMultiHeadAttention(Layer):
         if attn_mask is not None:
             m = attn_mask._data if isinstance(attn_mask, Tensor) else \
                 jnp.asarray(attn_mask)
+            if m.shape[-1] not in (1, max_len):
+                raise ValueError(
+                    f"attn_mask last dim {m.shape[-1]} must equal the "
+                    f"cache capacity max_len={max_len} (or be 1 for a "
+                    f"per-query broadcast): cached attention scores span "
+                    f"every cache slot, so a prompt-length mask cannot "
+                    f"broadcast against them — pad the mask to max_len "
+                    f"(False / -inf for empty slots)")
             if m.dtype == jnp.bool_:
                 mask = valid & m
             else:  # additive float mask: keep it, kill invalid slots

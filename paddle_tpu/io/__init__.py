@@ -770,6 +770,8 @@ def device_prefetch(iterable, sharding=None, buffer_size=2):
                 a = a._data
             if sharding is not None:
                 return jax.device_put(a, sharding)
+            if isinstance(a, jax.Array):
+                return a  # already on device: a re-put is a wasted dispatch
             return jax.device_put(a)
         if isinstance(batch, (list, tuple)):
             return type(batch)(one(a) for a in batch)

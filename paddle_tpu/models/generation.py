@@ -370,9 +370,31 @@ def _build_beam_fn(model, batch, prompt_len, static_key):
     return jax.jit(fn)
 
 
-def generate(model, input_ids, max_new_tokens=32, do_sample=False,
-             temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
-             pad_token_id=0, seed=None, num_beams=1, length_penalty=0.0,
+class _UnsetType:
+    """Per-kwarg sentinel for generate(): distinguishes 'not passed'
+    from 'explicitly passed its default', so an explicit kwarg always
+    conflicts with config= (value comparison silently let config
+    override e.g. an explicit temperature=1.0)."""
+
+    def __repr__(self):
+        return "<unset>"
+
+
+_UNSET = _UnsetType()
+
+# signature defaults of generate(), applied when neither the kwarg nor a
+# config supplies a value
+_GEN_DEFAULTS = {
+    "max_new_tokens": 32, "do_sample": False, "temperature": 1.0,
+    "top_k": 0, "top_p": 1.0, "eos_token_id": None, "pad_token_id": 0,
+    "seed": None, "num_beams": 1, "length_penalty": 0.0,
+}
+
+
+def generate(model, input_ids, max_new_tokens=_UNSET, do_sample=_UNSET,
+             temperature=_UNSET, top_k=_UNSET, top_p=_UNSET,
+             eos_token_id=_UNSET, pad_token_id=_UNSET, seed=_UNSET,
+             num_beams=_UNSET, length_penalty=_UNSET,
              attention_mask=None, config=None):
     """Generate ``max_new_tokens`` continuations of ``input_ids`` [B, S].
 
@@ -392,31 +414,36 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
 
     from ..nn.layer.layers import get_buffers_tree
 
+    passed = {
+        "max_new_tokens": max_new_tokens, "do_sample": do_sample,
+        "temperature": temperature, "top_k": top_k, "top_p": top_p,
+        "eos_token_id": eos_token_id, "pad_token_id": pad_token_id,
+        "seed": seed, "num_beams": num_beams,
+        "length_penalty": length_penalty,
+    }
+    explicit = sorted(k for k, v in passed.items() if v is not _UNSET)
     if config is not None:
-        explicit = {k: v for k, v in [
-            ("max_new_tokens", max_new_tokens != 32),
-            ("do_sample", do_sample is not False),
-            ("temperature", temperature != 1.0),
-            ("top_k", top_k != 0), ("top_p", top_p != 1.0),
-            ("eos_token_id", eos_token_id is not None),
-            ("pad_token_id", pad_token_id != 0),
-            ("seed", seed is not None),
-            ("num_beams", num_beams != 1),
-            ("length_penalty", length_penalty != 0.0)] if v}
+        # sentinel check, not value comparison: an explicitly passed
+        # default (e.g. temperature=1.0) is a conflict too — silently
+        # letting config win would override what the caller wrote
         if explicit:
             raise ValueError(
                 f"pass either config= or individual kwargs, not both "
-                f"(got config plus {sorted(explicit)})")
-        max_new_tokens = config.max_new_tokens
-        do_sample = config.do_sample
-        temperature = config.temperature
-        top_k = config.top_k
-        top_p = config.top_p
-        eos_token_id = config.eos_token_id
-        pad_token_id = config.pad_token_id
-        seed = config.seed
-        num_beams = config.num_beams
-        length_penalty = config.length_penalty
+                f"(got config plus {explicit})")
+        resolved = {k: getattr(config, k) for k in passed}
+    else:
+        resolved = {k: (_GEN_DEFAULTS[k] if v is _UNSET else v)
+                    for k, v in passed.items()}
+    max_new_tokens = resolved["max_new_tokens"]
+    do_sample = resolved["do_sample"]
+    temperature = resolved["temperature"]
+    top_k = resolved["top_k"]
+    top_p = resolved["top_p"]
+    eos_token_id = resolved["eos_token_id"]
+    pad_token_id = resolved["pad_token_id"]
+    seed = resolved["seed"]
+    num_beams = resolved["num_beams"]
+    length_penalty = resolved["length_penalty"]
 
     if num_beams < 1:
         raise ValueError(f"num_beams must be >= 1, got {num_beams}")

@@ -272,6 +272,24 @@ def _chunked_lm_loss(hidden, labels, table, n_chunks):
 
 
 class GPTForPretraining(nn.Layer):
+    """GPT with the tied-embedding LM head and causal-LM loss.
+
+    Return contract of ``forward``:
+
+    * ``labels is None`` — the logits Tensor ``[B, S, V]``;
+    * ``labels`` given, ``lm_loss_chunks == 1`` — ``(loss, logits)``;
+    * ``labels`` given, ``lm_loss_chunks > 1`` — ``(loss, None)``: the
+      chunked cross-entropy (``_chunked_lm_loss``) exists precisely to
+      never materialize the ``[B, S, V]`` logits tensor (1.6 GB fp32 at
+      GPT-2 124M scale), so there are no logits to return. Callers that
+      need logits must either use ``lm_loss_chunks=1`` or call
+      ``self.gpt.logits(hidden)`` themselves and pay the memory.
+
+    ``S`` must be divisible by ``lm_loss_chunks``; a silent dense
+    fallback would defeat the memory bound, so an indivisible length
+    raises instead.
+    """
+
     def __init__(self, cfg: GPTConfig, lm_loss_chunks: int = 1):
         super().__init__()
         self.gpt = GPTModel(cfg)

@@ -77,9 +77,12 @@ def _kind() -> str:
 
 
 def cache_path() -> str:
-    root = os.environ.get(
-        "PADDLE_AUTOTUNE_CACHE_DIR",
-        os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu"))
+    # same per-user root as the persistent XLA compilation cache
+    # (framework/compile_cache.py): one directory carries all
+    # per-machine tuning state. PADDLE_AUTOTUNE_CACHE_DIR moves only
+    # the autotune entries; PADDLE_TPU_CACHE_ROOT moves everything.
+    from ..framework.compile_cache import cache_root
+    root = os.environ.get("PADDLE_AUTOTUNE_CACHE_DIR", cache_root())
     return os.path.join(root, f"autotune_{_kind()}.json")
 
 

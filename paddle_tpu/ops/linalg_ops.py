@@ -279,7 +279,13 @@ def _matrix_nms(bboxes, scores, score_threshold=0.0, post_threshold=0.0,
                 decay = np.exp((comp[:, None] ** 2 - iou ** 2)
                                * gaussian_sigma)
             else:
-                decay = (1.0 - iou) / (1.0 - comp[:, None])
+                # comp==1.0 (duplicate boxes) makes this x/0=inf or
+                # 0/0=nan; both resolve correctly downstream (inf never
+                # wins min() against a finite decay, nan propagates to a
+                # score that fails the `> post_threshold` keep test) —
+                # silence the RuntimeWarning they'd spray over test runs
+                with np.errstate(divide="ignore", invalid="ignore"):
+                    decay = (1.0 - iou) / (1.0 - comp[:, None])
             new_sc = sc[order] * decay.min(axis=0)
             # unconditional, like the reference kernel: even at
             # post_threshold=0 a fully-decayed (0.0) box is dropped

@@ -77,8 +77,22 @@ class Optimizer:
         return self._lr
 
     # -- state --------------------------------------------------------------
+    def _adopt_alias(self, name: str) -> bool:
+        """Adopt slots mirrored under a hapi tree name: Model.fit keys
+        its functional state structurally ('0.weight') while the eager
+        step keys by Parameter.name — migrating the entry (pop + rekey)
+        carries the trained moments into an eager continuation
+        consistently with _step_count, instead of bias-correcting fresh
+        zeros at an inflated step, and keeps state_dict() to a single
+        key family."""
+        alias = getattr(self, "_slot_aliases", {}).get(name)
+        if alias is not None and alias in self._slots:
+            self._slots[name] = self._slots.pop(alias)
+            return True
+        return False
+
     def _ensure_slots(self, name: str, param_value: jnp.ndarray):
-        if name not in self._slots:
+        if name not in self._slots and not self._adopt_alias(name):
             self._slots[name] = {
                 s: jnp.zeros_like(param_value) for s in self._slot_names}
         return self._slots[name]
@@ -101,7 +115,11 @@ class Optimizer:
         for key, value in state.items():
             if key in ("@step", "LR_Scheduler"):
                 continue
-            for sname in list(self._slot_names) + ["master_weight"]:
+            # "_t0" is the per-param birth-step marker written by
+            # progressive unfreezing (see apply_gradients) — restored
+            # like any slot so the offset survives a checkpoint
+            for sname in list(self._slot_names) + ["master_weight",
+                                                   "_t0"]:
                 suffix = "_" + sname
                 if key.endswith(suffix):
                     pname = key[: -len(suffix)]
@@ -167,8 +185,16 @@ class Optimizer:
                     g = self._decay_grad(p._data, g.astype(p._data.dtype)
                                          if hasattr(g, "astype") else g)
                     slots = self._ensure_slots(p.name, p._data)
+                    # honor the per-param birth step (progressive
+                    # unfreezing / hapi adoption) in eager mode too
+                    t0 = slots.get("_t0")
+                    eff = self._step_count if t0 is None else \
+                        self._step_count - int(t0)
                     new_p, new_slots = self._apply_rule(
-                        p._data, g, slots, lr, self._step_count)
+                        p._data, g, slots, lr, eff)
+                    if t0 is not None:
+                        new_slots = dict(new_slots)
+                        new_slots["_t0"] = t0
                     p._data = new_p
                     self._slots[p.name] = new_slots
         finally:
@@ -234,8 +260,18 @@ class Optimizer:
             from types import SimpleNamespace
             self._current_param = SimpleNamespace(name=name)
             g = self._decay_grad(p, g.astype(p.dtype))
-            new_p, ns = self._apply_rule(p, g, state["slots"][name], lr,
-                                         step)
+            slots_in = state["slots"][name]
+            # "_t0" marks a param whose slots were (re)born mid-run —
+            # progressive unfreezing — so step-dependent rules (Adam
+            # bias correction) see its OWN age, not the global step:
+            # zeroed moments at a large step would otherwise update at
+            # ~3x the intended lr for the first few steps
+            t0 = slots_in.get("_t0")
+            new_p, ns = self._apply_rule(
+                p, g, slots_in, lr, step if t0 is None else step - t0)
+            if t0 is not None:
+                ns = dict(ns)
+                ns["_t0"] = t0
             new_params[name] = new_p
             new_slots[name] = ns
         self._current_param_name = None
@@ -315,7 +351,7 @@ class Adam(Optimizer):
         return new_p, {"moment1": m, "moment2": v}
 
     def _ensure_slots(self, name, value):
-        if name not in self._slots:
+        if name not in self._slots and not self._adopt_alias(name):
             self._slots[name] = self._init_slot_dict(value)
         return self._slots[name]
 
@@ -399,7 +435,7 @@ class Adagrad(Optimizer):
         self._init_acc = initial_accumulator_value
 
     def _ensure_slots(self, name, value):
-        if name not in self._slots:
+        if name not in self._slots and not self._adopt_alias(name):
             self._slots[name] = {"moment": jnp.full(
                 value.shape, self._init_acc, jnp.float32)}
         return self._slots[name]

@@ -29,3 +29,15 @@ def test_dry_run_emits_metrics_summary():
     assert len(out["span_categories"]) >= 3, out
     # the human-readable stats summary goes to stderr
     assert "op_count/" in res.stderr
+    # async fast path: the dry run fits 8 batches at log_freq=4, so the
+    # windowed-sync budget is <= 8/4 + 2 flushes, and the prefetch
+    # pipeline must have fed fit (put/wait histograms in the summary)
+    assert 0 < out["host_syncs"] <= 4, out
+    assert out["checks"]["prefetch_histograms_present"] is True, out
+    assert "prefetch_put_ms" in res.stderr
+    assert "prefetch_wait_ms" in res.stderr
+    assert "hapi/host_sync" in res.stderr
+    # compile cache: entries whenever this jax supports it (0.4.37 does);
+    # on a jax without the knob the dry run records a clean no-op
+    if out["compile_cache_enabled"]:
+        assert out["compile_cache_entries"] > 0, out
