@@ -862,10 +862,15 @@ def dry_run():
     chrome trace with nested span categories, a Prometheus exposition,
     the async-fast-path counters (``hapi/host_sync`` bounded at
     O(steps/log_freq), prefetch put/wait histograms), and the persistent
-    XLA compile cache populating entries. Prints the stats summary to
-    stderr and ONE JSON line to stdout; exits nonzero when any assertion
-    fails, so CI catches an instrumentation or fast-path regression
-    before it costs a real benchmark round."""
+    XLA compile cache populating entries. PR-3 additions: the fit runs
+    with ``analyze='warn'`` (jaxpr linter pre-flight), a GPT-2-class and
+    a ResNet-class donated train step are ``analyze()``d and must report
+    ZERO error-severity findings, the repo self-lint (AST rules over
+    paddle_tpu/) must be clean, and the ``analysis/*`` +
+    ``dispatch/retrace_cause`` counters must be populated. Prints the
+    stats summary to stderr and ONE JSON line to stdout; exits nonzero
+    when any assertion fails, so CI catches an instrumentation or
+    fast-path regression before it costs a real benchmark round."""
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import tempfile
 
@@ -900,9 +905,58 @@ def dry_run():
     with profiler.profile() as sess:
         loss = model.train_batch([x], [y])
         # async fast path: donated step + device_prefetch input +
-        # windowed host syncs, all counter-asserted below
-        model.fit(TensorDataset([xs, ys]), batch_size=8, epochs=1,
-                  log_freq=log_freq, shuffle=False, verbose=0)
+        # windowed host syncs, all counter-asserted below; analyze='warn'
+        # additionally runs the jaxpr linter over the built train step
+        # on the first batch (tracing only, nothing executes twice)
+        import warnings as _warnings
+        with _warnings.catch_warnings():
+            _warnings.simplefilter("ignore", UserWarning)
+            model.fit(TensorDataset([xs, ys]), batch_size=8, epochs=1,
+                      log_freq=log_freq, shuffle=False, verbose=0,
+                      analyze="warn")
+
+        # analyze() pre-flight of the two zoo train steps (tiny smoke
+        # configs, same model classes as the north-star benches): the
+        # donated GPT-2 and ResNet steps must carry ZERO error-severity
+        # findings — this is the standing guard for the PR-2 donation/
+        # frozen-grad bug classes. Tracing the full networks also
+        # populates dispatch/retrace_cause organically (shared op sites
+        # re-trace at each new per-layer shape class).
+        from paddle_tpu import analysis
+
+        def _zoo_reports():
+            from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining
+            from paddle_tpu.vision.models import resnet18
+            import paddle_tpu.nn.functional as F
+
+            paddle.framework.random.seed(0)
+            cfg = GPTConfig.tiny()
+            gpt = GPTForPretraining(cfg)
+            gm = paddle.Model(gpt)
+            gm.prepare(
+                paddle.optimizer.AdamW(learning_rate=1e-4,
+                                       parameters=gpt.parameters()),
+                lambda logits, lbl: F.cross_entropy(
+                    logits.reshape([-1, cfg.vocab_size]),
+                    lbl.reshape([-1])))
+            ids = rng.randint(0, cfg.vocab_size, (2, 16)).astype(np.int32)
+            g_rep = analysis.analyze_model(gm, [ids], [ids.astype(np.int64)],
+                                           name="gpt2_tiny.train_step")
+
+            res = resnet18(num_classes=10)
+            rm = paddle.Model(res)
+            rm.prepare(
+                paddle.optimizer.Momentum(learning_rate=0.1,
+                                          parameters=res.parameters()),
+                nn.CrossEntropyLoss())
+            img = rng.randn(2, 3, 32, 32).astype(np.float32)
+            lbl = rng.randint(0, 10, (2, 1)).astype(np.int64)
+            r_rep = analysis.analyze_model(rm, [img], [lbl],
+                                           name="resnet18.train_step")
+            return g_rep, r_rep
+
+        gpt_report, resnet_report = _zoo_reports()
+        lint_findings = analysis.lint_repo()
 
     counters = monitor.all_stats()
     host_syncs = monitor.stat_get("hapi/host_sync")
@@ -937,8 +991,23 @@ def dry_run():
         "prefetch_fed_fit":
             monitor.stat_get("prefetch_batches") >= n_batches,
         "compile_cache_populated": (not cache_on) or cache_entries > 0,
+        # PR-3 static-analysis surface: the linter ran (fit pre-flight +
+        # two zoo steps), the zoo steps carry no error findings, the
+        # retrace-cause classifier recorded trace churn, and the repo
+        # self-lint is clean
+        "analysis_ran": monitor.stat_get("analysis/runs") >= 3,
+        "analysis_findings_counted": "analysis/findings" in counters,
+        "zoo_steps_clean": gpt_report.ok() and resnet_report.ok(),
+        "retrace_cause_recorded":
+            monitor.stat_get("dispatch/retrace_cause") > 0,
+        "selflint_clean": not lint_findings,
     }
     print(monitor.stats_summary(), file=sys.stderr)
+    for f in lint_findings:
+        print(f"SELFLINT {f}", file=sys.stderr)
+    if not gpt_report.ok() or not resnet_report.ok():
+        print(gpt_report.table(), file=sys.stderr)
+        print(resnet_report.table(), file=sys.stderr)
     ok = all(checks.values())
     print(json.dumps({"metric": "dry_run", "ok": ok,
                       "counters": len(counters),
@@ -946,6 +1015,14 @@ def dry_run():
                       "host_syncs": host_syncs,
                       "compile_cache_enabled": bool(cache_on),
                       "compile_cache_entries": cache_entries,
+                      "analysis_runs": monitor.stat_get("analysis/runs"),
+                      "analysis_findings":
+                          monitor.stat_get("analysis/findings"),
+                      "retrace_causes": {
+                          k.rsplit("/", 1)[-1]: v
+                          for k, v in counters.items()
+                          if k.startswith("dispatch/retrace_cause/")},
+                      "selflint_findings": len(lint_findings),
                       "loss": round(float(loss), 4), "checks": checks}),
           flush=True)
     sys.exit(0 if ok else 1)

@@ -1,0 +1,119 @@
+"""``paddle_tpu.analysis`` — jaxpr-level program linter.
+
+The TPU-native analog of the reference's IR-pass layer
+(paddle/fluid/framework/ir): instead of pattern passes over a
+ProgramDesc graph, :func:`analyze` closed-jaxpr-traces a callable (or
+replays a captured ``paddle.static`` Program) without compiling it and
+runs registered passes over the trace. Five ship built-in:
+
+=================  ========================================================
+host-sync          pure_callback/io_callback eqns, and ``.numpy()``/
+                   ``float()``/``bool()`` concretization inside traced fns
+                   diagnosed with the offending source line
+donation-safety    donated args whose buffers are structurally unsafe
+                   (no rebind target / double alias) — the standing guard
+                   for the PR-2 donated train step
+dead-grad          params with structurally-zero cotangents still in the
+                   trainable set (the optimizer decays them anyway)
+dtype-hygiene      f64 leaks; silent bf16->f32 upcasts in autocast regions
+recompile-churn    why retraces fired (shape/dtype/static-arg/frozen-set),
+                   from the ``dispatch/retrace_cause`` trace probe
+=================  ========================================================
+
+Three integration surfaces: ``Model.fit(..., analyze='warn'|'error')``
+(default from ``FLAGS_static_analysis``), an ``Executor.run`` pre-flight
+over captured Programs, and the CLI
+``python -m paddle_tpu.analysis <module:fn | saved-artifact-prefix>``.
+:mod:`.selflint` additionally lints ``paddle_tpu``'s own source (AST
+rules) and runs as a tier-1 test.
+"""
+from __future__ import annotations
+
+from .core import (AnalysisContext, AnalysisError, Finding, Report,  # noqa
+                   all_passes, analyze, iter_eqns, register_pass)
+from . import passes as _passes  # noqa: F401  (registers the built-ins)
+from .selflint import lint_repo, lint_source  # noqa: F401
+
+__all__ = ["analyze", "analyze_model", "apply_mode", "Finding", "Report",
+           "AnalysisError", "AnalysisContext", "register_pass",
+           "all_passes", "lint_repo", "lint_source"]
+
+
+def flag_mode() -> str:
+    """``FLAGS_static_analysis`` normalized to 'off'|'warn'|'error'.
+    Lenient on boolean-style env values (the convention of the
+    neighboring FLAGS_compile_cache=1 knobs): truthy strings mean
+    'warn', anything unrecognized means 'off' — a misconfigured env var
+    must degrade to un-linted, not crash every fit()."""
+    from ..framework.flags import flag_value
+    s = str(flag_value("FLAGS_static_analysis")).strip().lower()
+    if s in ("warn", "warning", "1", "true", "on", "yes"):
+        return "warn"
+    if s in ("error", "strict"):
+        return "error"
+    return "off"
+
+
+def apply_mode(report, mode, label):
+    """The shared warn/error policy of the integration surfaces
+    (``Model.fit(analyze=...)``, ``Executor.run`` pre-flight): emit the
+    findings table as a UserWarning when anything warning-or-worse was
+    found (info-only reports stay silent — they live in the report and
+    the counters), and raise :class:`AnalysisError` in ``'error'`` mode
+    when error-severity findings exist. Returns ``report``."""
+    if report is None:
+        return None
+    if report.warnings() or report.errors():
+        import warnings
+        warnings.warn(f"static analysis of {label}:\n" + report.table(),
+                      UserWarning)
+    if mode == "error" and not report.ok():
+        raise AnalysisError(report)
+    return report
+
+
+def analyze_model(model, inputs, labels=None, passes=None, name=None):
+    """Analyze a prepared hapi ``Model``'s REAL donated train step.
+
+    Traces ``model._train_step_fn`` (donation contract auto-read from
+    the pjit eqn / declared argnums) on one example batch, builds the
+    grad jaxpr of the trainable-params loss for the dead-grad pass, and
+    runs the full pipeline. Nothing executes on device — tracing only.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..hapi.model import _as_arrays
+
+    if model._optimizer is None or model._loss is None:
+        raise ValueError(
+            "analyze_model needs a prepared Model: call "
+            "model.prepare(optimizer, loss) first")
+    ins = _as_arrays(inputs)
+    lbs = _as_arrays(labels) if labels is not None else []
+    model._ensure_train_built()
+
+    loss_fn, train_p = model._analysis_loss_fn(ins, lbs)
+    grad = None
+    if train_p:
+        from .core import _concretization_errors
+        try:
+            grad_jaxpr = jax.make_jaxpr(jax.grad(loss_fn))(train_p)
+            names = sorted(train_p)  # dict pytree flatten order
+            grad = {"jaxpr": grad_jaxpr, "names": names,
+                    "trainable": set(names)}
+        except _concretization_errors():
+            # the forward itself concretizes a tracer — the step trace
+            # below hits the same line and the host-sync pass reports it
+            # with source provenance; grad analysis is moot until fixed
+            grad = None
+
+    key = jax.random.key(0)
+    lr = jnp.asarray(model._optimizer.get_lr(), jnp.float32)
+    step_args = (model._params, model._opt_state, model._buffers, key, lr,
+                 len(ins), *ins, *lbs)
+    return analyze(model._train_step_fn, *step_args,
+                   donate_argnums=(0, 1, 2), static_argnums=(5,),
+                   passes=passes, grad=grad,
+                   name=name or
+                   f"Model({type(model.network).__name__}).train_step")

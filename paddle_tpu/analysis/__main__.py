@@ -1,0 +1,115 @@
+"""CLI: ``python -m paddle_tpu.analysis <target>``.
+
+Targets:
+
+* ``module:attr`` — import ``module`` and resolve ``attr``. If calling
+  ``attr()`` with no arguments returns ``(fn, example_args)`` (the
+  ``__graft_entry__.entry`` convention) that pair is analyzed; otherwise
+  ``attr`` itself is the target and ``--input`` specs supply the avals.
+* a ``jit.save`` artifact prefix or directory (``m`` for ``m.pdmodel``)
+  — loaded and analyzed from its saved input specs.
+
+Options: ``--input dtype:d0,d1,...`` (repeatable), ``--donate 0,1``,
+``--passes a,b``, ``--selflint`` (lint paddle_tpu's own source instead).
+Exit status: 0 clean / findings below error, 1 error-severity findings
+(or any self-lint finding) — usable as a CI gate.
+"""
+from __future__ import annotations
+
+import argparse
+import importlib
+import os
+import sys
+
+
+_DTYPES = {"f32": "float32", "f64": "float64", "bf16": "bfloat16",
+           "f16": "float16", "i32": "int32", "i64": "int64",
+           "i8": "int8", "u8": "uint8", "bool": "bool"}
+
+
+def _parse_input(spec: str):
+    import jax
+    import numpy as np
+    if ":" in spec:
+        dtype, _, dims = spec.partition(":")
+    else:
+        dtype, dims = "float32", spec
+    dtype = _DTYPES.get(dtype, dtype)
+    shape = tuple(int(d) for d in dims.replace("x", ",").split(",") if d)
+    return jax.ShapeDtypeStruct(shape, np.dtype(dtype))
+
+
+def _resolve(target: str):
+    """-> (fn_or_obj, args or None, display name)."""
+    if ":" in target and not os.path.exists(target.split(":")[0]):
+        mod_name, _, attr = target.rpartition(":")
+        sys.path.insert(0, os.getcwd())
+        obj = getattr(importlib.import_module(mod_name), attr)
+        if callable(obj):
+            try:
+                produced = obj()
+            except TypeError:
+                return obj, None, target
+            if isinstance(produced, tuple) and len(produced) == 2 \
+                    and callable(produced[0]):
+                fn, args = produced
+                return fn, tuple(args), target
+            return obj, None, target
+        return obj, None, target
+    # artifact path: directory containing *.pdmodel, or the prefix itself
+    prefix = target
+    if os.path.isdir(target):
+        models = [f for f in sorted(os.listdir(target))
+                  if f.endswith(".pdmodel")]
+        if not models:
+            raise SystemExit(f"no .pdmodel artifact under {target}")
+        prefix = os.path.join(target, models[0][: -len(".pdmodel")])
+    elif target.endswith(".pdmodel"):
+        prefix = target[: -len(".pdmodel")]
+    from .. import jit
+    return jit.load(prefix), None, prefix
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.analysis",
+        description="jaxpr-level program linter")
+    ap.add_argument("target", nargs="?",
+                    help="module:fn or jit.save artifact prefix/dir")
+    ap.add_argument("--input", action="append", default=[],
+                    metavar="DTYPE:D0,D1",
+                    help="input aval, e.g. f32:8,16 (repeatable)")
+    ap.add_argument("--donate", default="",
+                    help="comma-separated donated argnums")
+    ap.add_argument("--passes", default="",
+                    help="comma-separated pass ids (default: all)")
+    ap.add_argument("--selflint", action="store_true",
+                    help="run the AST self-lint over paddle_tpu/ instead")
+    args = ap.parse_args(argv)
+
+    if args.selflint:
+        from .selflint import lint_repo
+        findings = lint_repo()
+        for f in findings:
+            print(f)
+        print(f"self-lint: {len(findings)} finding(s)")
+        return 1 if findings else 0
+
+    if not args.target:
+        ap.error("a target (or --selflint) is required")
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    from . import analyze
+    fn, fn_args, name = _resolve(args.target)
+    if fn_args is None:
+        fn_args = tuple(_parse_input(s) for s in args.input)
+    donate = tuple(int(x) for x in args.donate.split(",") if x)
+    passes = [p for p in args.passes.split(",") if p] or None
+    report = analyze(fn, *fn_args, donate_argnums=donate, passes=passes,
+                     name=name)
+    print(report.table())
+    return 0 if report.ok() else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,398 @@
+"""The five built-in analysis passes.
+
+Each is a function ``(ctx: AnalysisContext) -> list[Finding]`` registered
+under its pass id (≙ REGISTER_PASS in the reference's
+paddle/fluid/framework/ir). A pass that needs a context facility the
+driver could not produce (no jaxpr because tracing failed, no grad info)
+returns [] — the other passes still run.
+
+Severity policy (what "clean bill" means for the zoo train steps):
+
+* **error** — the program is wrong or will corrupt state: host
+  concretization inside a traced fn, a donated buffer with no matching
+  output (the caller's rebind target does not exist — every later read
+  hits "Array has been deleted"), a trainable parameter with a
+  structurally-zero gradient (the optimizer still applies weight decay /
+  moment updates to it — the PR-2 frozen-param bug class).
+* **warning** — probably costing performance or correctness headroom:
+  host callbacks in the hot loop, f64 leaks, repeated shape/dtype-caused
+  retraces, a flapping frozen set.
+* **info** — worth knowing, expected in some designs: bf16→f32 upcasts
+  inside an autocast region, low-count retrace summaries.
+"""
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from .core import (AnalysisContext, Finding, eqn_source, is_structural_zero,
+                   iter_eqns, register_pass)
+
+__all__ = ["host_sync_pass", "donation_safety_pass", "dead_grad_pass",
+           "dtype_hygiene_pass", "recompile_churn_pass"]
+
+
+# ---------------------------------------------------------------------------
+# 1. host-sync
+# ---------------------------------------------------------------------------
+
+_CALLBACK_PRIMS = {
+    "pure_callback": "jax.pure_callback",
+    "io_callback": "jax.experimental.io_callback",
+    "debug_callback": "jax.debug.callback",
+    "callback": "host callback",
+}
+
+
+@register_pass("host-sync")
+def host_sync_pass(ctx: AnalysisContext) -> List[Finding]:
+    """Host round-trips inside the traced computation.
+
+    Two shapes: (a) the trace itself died on a concretization —
+    ``.numpy()`` / ``float()`` / ``bool()`` / ``np.asarray`` on a traced
+    value — which the driver caught and source-located (the raw
+    ConcretizationTypeError fires deep inside jax where the call site is
+    invisible); (b) callback-shaped eqns (pure_callback / io_callback),
+    which run but serialize device against host every step."""
+    out: List[Finding] = []
+    if ctx.trace_error is not None:
+        kind = type(ctx.trace_error).__name__
+        out.append(Finding(
+            pass_id="host-sync", severity="error",
+            message=(f"host concretization inside the traced function "
+                     f"({kind}): a .numpy()/float()/bool()/np.asarray on "
+                     f"a traced value forces a device sync and breaks "
+                     f"under jit"),
+            source=ctx.trace_error_source,
+            fix_hint=("keep host reads out of the step: return the value "
+                      "and fetch it outside, or use a windowed flush "
+                      "(Model.fit syncs once per log_freq steps)")))
+        return out
+    if ctx.closed_jaxpr is None:
+        return out
+    for eqn in iter_eqns(ctx.closed_jaxpr):
+        prim = eqn.primitive.name
+        if prim in _CALLBACK_PRIMS:
+            out.append(Finding(
+                pass_id="host-sync", severity="warning",
+                message=(f"{_CALLBACK_PRIMS[prim]} inside the traced "
+                         f"computation: one device->host->device round "
+                         f"trip per execution"),
+                source=eqn_source(eqn), primitive=prim,
+                fix_hint=("intended for host-only kernels (e.g. "
+                          "nonsymmetric eig, MIGRATION.md); keep it out "
+                          "of per-step hot loops or precompute on host")))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# 2. donation-safety
+# ---------------------------------------------------------------------------
+
+def _aval_key(v):
+    aval = v.aval
+    return (tuple(aval.shape), str(aval.dtype))
+
+
+@register_pass("donation-safety")
+def donation_safety_pass(ctx: AnalysisContext) -> List[Finding]:
+    """Donated inputs whose buffers are structurally unsafe.
+
+    A donated input's buffer is deleted at dispatch; the caller's only
+    valid move is rebinding to a same-shape/dtype output (the PR-2
+    donated train step contract). Structurally checkable: (a) a donated
+    invar with NO matching output aval — the rebind target does not
+    exist, so the state the caller holds after the call is a deleted
+    handle (error); (b) one donated invar feeding MORE outputs than
+    exist buffers to alias (double-alias, error); (c) a donated invar
+    the computation never reads — donation frees it, but passing it at
+    all is dead weight (warning)."""
+    out: List[Finding] = []
+    closed, mask = ctx.closed_jaxpr, ctx.donated_invars
+    if closed is None or not mask or not any(mask):
+        return out
+    jaxpr = closed.jaxpr
+    donated = [v for v, d in zip(jaxpr.invars, mask) if d]
+
+    # multiset of output avals available for aliasing
+    from collections import Counter
+    out_avals = Counter(_aval_key(v) for v in jaxpr.outvars
+                        if not hasattr(v, "val"))
+    outvar_counts = Counter(id(v) for v in jaxpr.outvars)
+    used = set()
+    for eqn in jaxpr.eqns:
+        for v in eqn.invars:
+            if not hasattr(v, "val"):
+                used.add(id(v))
+
+    for i, v in enumerate(donated):
+        key = _aval_key(v)
+        if outvar_counts.get(id(v), 0) > 1:
+            out.append(Finding(
+                pass_id="donation-safety", severity="error",
+                message=(f"donated input #{i} ({key[1]}{list(key[0])}) is "
+                         f"returned as more than one output — two "
+                         f"outputs cannot alias one donated buffer"),
+                fix_hint="return a copy for one of the aliases"))
+            continue
+        if out_avals.get(key, 0) > 0:
+            out_avals[key] -= 1
+            continue
+        out.append(Finding(
+            pass_id="donation-safety", severity="error",
+            message=(f"donated input #{i} ({key[1]}{list(key[0])}) has no "
+                     f"matching output: its buffer is deleted at "
+                     f"dispatch but nothing replaces it — any state the "
+                     f"caller rebinds is a deleted handle"),
+            fix_hint=("return the updated value for every donated arg "
+                      "(params/opt_state/buffers in a train step) or "
+                      "drop it from donate_argnums")))
+    for i, v in enumerate(donated):
+        if id(v) not in used and outvar_counts.get(id(v), 0) == 0:
+            out.append(Finding(
+                pass_id="donation-safety", severity="warning",
+                message=(f"donated input #{i} is never read by the "
+                         f"computation (dead donation)"),
+                fix_hint="stop passing (and donating) the unused value"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# 3. dead/frozen-grad
+# ---------------------------------------------------------------------------
+
+@register_pass("dead-grad")
+def dead_grad_pass(ctx: AnalysisContext) -> List[Finding]:
+    """Parameters whose cotangent is structurally zero in the grad jaxpr.
+
+    jax AD materializes a symbolic-zero cotangent as
+    ``broadcast_in_dim [0.0]`` — no dependence on any input. A trainable
+    parameter with such a gradient is the exact latent bug PR 2 found by
+    hand: the optimizer still applies weight decay and moment updates to
+    it, silently training (decaying) a parameter the loss never sees.
+    Requires grad info from the driver (``analyze_model`` supplies it);
+    returns [] otherwise."""
+    out: List[Finding] = []
+    info = ctx.grad
+    if not info or info.get("jaxpr") is None:
+        return out
+    closed = info["jaxpr"]
+    names = info.get("names") or []
+    trainable = info.get("trainable")
+    jaxpr = closed.jaxpr if hasattr(closed, "jaxpr") else closed
+    for i, v in enumerate(jaxpr.outvars):
+        if not (hasattr(v, "val") or is_structural_zero(jaxpr, v)):
+            continue
+        if hasattr(v, "val") and np.any(np.asarray(v.val)):
+            continue  # constant but nonzero: not a dead grad
+        pname = names[i] if i < len(names) else f"output[{i}]"
+        in_train = trainable is None or pname in trainable
+        out.append(Finding(
+            pass_id="dead-grad",
+            severity="error" if in_train else "info",
+            message=(f"parameter '{pname}' receives a structurally-zero "
+                     f"gradient" +
+                     (" but is in the trainable set — the optimizer "
+                      "will still weight-decay/update it" if in_train
+                      else " (frozen, as declared)")),
+            fix_hint=("if freezing is intended, set stop_gradient=True "
+                      "so the step bakes it out of the trainable split; "
+                      "if not, the loss never reads this parameter — "
+                      "check the forward wiring")))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# 4. dtype-hygiene
+# ---------------------------------------------------------------------------
+
+_MAX_SITES = 3  # provenance examples per finding class before aggregating
+
+
+@register_pass("dtype-hygiene")
+def dtype_hygiene_pass(ctx: AnalysisContext) -> List[Finding]:
+    """f64 leaks and silent bf16->f32 upcasts.
+
+    f64: TPUs emulate double precision at a large slowdown, and with
+    jax's default x64-off config a float64 numpy input is silently
+    downcast — both directions are a data-pipeline leak
+    (``np.random.randn`` is float64!). bf16 upcasts: inside a program
+    that demonstrably runs a bf16 region (bf16 inputs or f32->bf16
+    downcasts present), every bf16->f32 convert re-doubles the memory
+    the autocast saved — expected for loss accumulation, a bug when it
+    hits activations."""
+    out: List[Finding] = []
+    for a in _np_leaves(ctx.args):
+        if a.dtype in (np.float64, np.complex128):
+            out.append(Finding(
+                pass_id="dtype-hygiene", severity="warning",
+                message=(f"float64 host input (shape "
+                         f"{list(a.shape)}): silently downcast to f32 "
+                         f"under jax's default config, or computed at "
+                         f"~10x cost on TPU with x64 on"),
+                fix_hint="cast the pipeline to float32 at the source "
+                         "(np.float32 / .astype('float32'))"))
+            break  # one finding per run is enough signal
+    closed = ctx.closed_jaxpr
+    if closed is None:
+        return out
+
+    def _dt(v) -> str:
+        aval = getattr(v, "aval", None)
+        return str(getattr(aval, "dtype", ""))
+
+    f64_sites, upcast_sites = [], []
+    has_bf16_region = any(_dt(v) == "bfloat16"
+                          for v in closed.jaxpr.invars)
+    for eqn in iter_eqns(closed):
+        for v in eqn.outvars:
+            if _dt(v) in ("float64", "complex128"):
+                f64_sites.append(eqn_source(eqn))
+                break
+        if eqn.primitive.name == "convert_element_type":
+            src_dt = _dt(eqn.invars[0])
+            dst_dt = str(eqn.params.get("new_dtype", ""))
+            if src_dt == "float32" and dst_dt == "bfloat16":
+                has_bf16_region = True
+            if src_dt == "bfloat16" and dst_dt == "float32":
+                upcast_sites.append(eqn_source(eqn))
+    if f64_sites:
+        sites = ", ".join(s for s in f64_sites[:_MAX_SITES] if s)
+        out.append(Finding(
+            pass_id="dtype-hygiene", severity="warning",
+            message=(f"{len(f64_sites)} eqn(s) produce float64/"
+                     f"complex128 values (first at: {sites or 'n/a'})"),
+            source=f64_sites[0],
+            fix_hint="stay fp32/bf16 on TPU; fp64 is emulated"))
+    if upcast_sites and has_bf16_region:
+        sites = ", ".join(s for s in upcast_sites[:_MAX_SITES] if s)
+        out.append(Finding(
+            pass_id="dtype-hygiene", severity="info",
+            message=(f"{len(upcast_sites)} bf16->f32 upcast(s) inside a "
+                     f"bf16/autocast region (first at: {sites or 'n/a'})"),
+            source=upcast_sites[0],
+            fix_hint=("expected for loss/reduction accumulation; if an "
+                      "activation path upcasts, check the amp "
+                      "allow/deny lists")))
+    return out
+
+
+def _np_leaves(args):
+    import jax
+    for leaf in jax.tree_util.tree_leaves(
+            args, is_leaf=lambda x: isinstance(x, np.ndarray)):
+        if isinstance(leaf, np.ndarray):
+            yield leaf
+
+
+# ---------------------------------------------------------------------------
+# 5. recompile-churn
+# ---------------------------------------------------------------------------
+
+# thresholds. Op-level sites ("op/<name>") legitimately trace once per
+# distinct layer shape class while a deep network builds — breadth, not
+# churn — so they stay info until the count looks like a data-driven
+# shape explosion. Step-level sites (the hapi donated train step, user
+# jits) have ONE expected signature per dataset: any repeated
+# shape/dtype retrace there is the bucket-your-data bug.
+_OP_SHAPE_INFO = 8
+_OP_SHAPE_WARN = 32
+_STEP_CHURN = 2
+_FROZEN_CHURN = 2
+
+# per-cause counts already reported by earlier analyze() runs in this
+# process: each run reports only the DELTA since the previous one, so a
+# report on target X never re-attributes another model's history (a
+# long-lived notebook would otherwise see every old model's churn in
+# every new report). A count that went DOWN means trace_probe.reset()
+# ran — treat the site as fresh.
+_reported: dict = {}
+
+
+def _delta_sites(sites: dict) -> dict:
+    out = {}
+    for name, rec in sites.items():
+        causes = rec.get("causes", {})
+        seen = _reported.get(name, {})
+        delta = {}
+        for c, n in causes.items():
+            prev = seen.get(c, 0)
+            d = n - prev if n >= prev else n
+            if d > 0:
+                delta[c] = d
+        if delta:
+            out[name] = {"traces": rec.get("traces", 0), "causes": delta}
+        _reported[name] = dict(causes)
+    return out
+
+
+@register_pass("recompile-churn")
+def recompile_churn_pass(ctx: AnalysisContext) -> List[Finding]:
+    """Why retraces fired, from the trace_probe site registry
+    (framework/trace_probe.py) — every eager-op jit wrapper and the hapi
+    donated train step record the signature they were traced with, and a
+    re-trace is classified shape / dtype / static_arg / frozen_set /
+    structure at trace time. This pass turns per-site counts into
+    findings — scoped to retraces SINCE THE LAST analyze() run in this
+    process; the raw cumulative ``dispatch/retrace_cause`` counters stay
+    visible in monitor/Prometheus either way."""
+    out: List[Finding] = []
+    sites = _delta_sites(ctx.retrace_sites or {})
+    total = 0
+    cause_totals: dict = {}
+    for name, rec in sites.items():
+        causes = rec.get("causes", {})
+        for c, n in causes.items():
+            cause_totals[c] = cause_totals.get(c, 0) + n
+            total += n
+        is_op_site = name.startswith("op/")
+        shape_n = causes.get("shape", 0)
+        if is_op_site and shape_n >= _OP_SHAPE_INFO:
+            out.append(Finding(
+                pass_id="recompile-churn",
+                severity="warning" if shape_n >= _OP_SHAPE_WARN
+                else "info",
+                message=(f"{name} re-traced {shape_n}x on new shape "
+                         f"classes since the last analysis — each is a "
+                         f"fresh XLA compile (expected once per layer "
+                         f"shape; a count that keeps growing across "
+                         f"steps is data-driven churn)"),
+                fix_hint=("bucket variable-length inputs "
+                          "(io.BucketedBatchSampler) or pad to a fixed "
+                          "shape set; the persistent compile cache only "
+                          "amortizes across runs, not shapes")))
+        if not is_op_site and shape_n >= _STEP_CHURN:
+            out.append(Finding(
+                pass_id="recompile-churn", severity="warning",
+                message=(f"{name} re-traced {shape_n}x on batch shape "
+                         f"changes — the whole step recompiles each "
+                         f"time"),
+                fix_hint=("bucket variable-length inputs "
+                          "(io.BucketedBatchSampler), pad, or pin "
+                          "batch_size with drop_last=True")))
+        if not is_op_site and causes.get("dtype", 0) >= _STEP_CHURN:
+            out.append(Finding(
+                pass_id="recompile-churn", severity="warning",
+                message=(f"{name} re-traced {causes['dtype']}x on dtype "
+                         f"changes (e.g. an f32 batch after bf16 "
+                         f"warmup)"),
+                fix_hint="pin the input dtype at the loader"))
+        if causes.get("frozen_set", 0) >= _FROZEN_CHURN:
+            out.append(Finding(
+                pass_id="recompile-churn", severity="warning",
+                message=(f"{name}: the frozen-parameter set changed "
+                         f"{causes['frozen_set']}x — every flip re-traces "
+                         f"the donated train step and reconciles "
+                         f"optimizer slots"),
+                fix_hint=("batch stop_gradient flips (progressive "
+                          "unfreezing per phase, not per step)")))
+    if total:
+        detail = ", ".join(f"{c}={n}"
+                           for c, n in sorted(cause_totals.items()))
+        out.append(Finding(
+            pass_id="recompile-churn", severity="info",
+            message=(f"{total} retrace(s) across {len(sites)} trace "
+                     f"site(s) since the last analysis: {detail}"),
+            fix_hint=None))
+    return out

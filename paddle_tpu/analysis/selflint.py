@@ -1,0 +1,238 @@
+"""Repo self-lint: AST rules over ``paddle_tpu/`` itself.
+
+The jaxpr passes check programs USERS build; these rules check the
+framework's own source for the contracts the codebase documents but
+Python cannot enforce (≙ the reference's tools/codestyle custom checks
++ cpplint rules for its own invariants):
+
+* ``device-get-hot-path`` — no bare ``jax.device_get`` in hot-path
+  modules (dispatch, tensor, monitor, the hapi step loop): every one is
+  a blocking D2H sync per call. Sync points elsewhere (spmd state
+  mirror, pipeline aggregation) are legitimate and stay unflagged.
+* ``monitor-lock-contract`` — the monitor's writer hot path is lock-free
+  BY CONTRACT (framework/monitor.py docstring): ``stat_add`` must not
+  take ``_lock``, and no module outside monitor.py may import or touch
+  its ``_lock``/``_stats``/``_hists`` internals.
+* ``asarray-on-traced`` — inside a ``@register_op`` impl (which runs
+  under jit unless registered ``jit=False``), ``np.asarray``/``np.array``
+  on an op argument concretizes a tracer: TracerArrayConversionError at
+  best, a silent constant-bake at worst. Nested host-callback bodies
+  (pure_callback closures) shadow the name and are exempt.
+
+Suppress a finding with a trailing ``# lint: ok`` comment on the line
+(used only where a human has argued the exception in an adjacent
+comment). Run: ``python -m paddle_tpu.analysis --selflint`` or the
+tier-1 test (tests/test_selflint.py).
+"""
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass
+from typing import List, Optional, Sequence
+
+__all__ = ["LintFinding", "lint_source", "lint_repo", "HOT_PATH_MODULES"]
+
+# modules where a stray device_get is a per-call sync on the hot path
+HOT_PATH_MODULES = (
+    "framework/dispatch.py", "framework/tensor.py", "framework/monitor.py",
+    "framework/trace_probe.py", "hapi/model.py", "ops/registry.py",
+)
+
+_MONITOR_PRIVATE = {"_lock", "_stats", "_hists"}
+
+
+@dataclass
+class LintFinding:
+    rule: str
+    path: str
+    line: int
+    message: str
+
+    def __str__(self):
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+def _suppressed(source_lines: Sequence[str], lineno: int) -> bool:
+    try:
+        return "# lint: ok" in source_lines[lineno - 1]
+    except IndexError:
+        return False
+
+
+def _is_jax_device_get(node: ast.Call) -> bool:
+    f = node.func
+    return (isinstance(f, ast.Attribute) and f.attr == "device_get"
+            and isinstance(f.value, ast.Name) and f.value.id == "jax")
+
+
+def _decorator_name(d) -> Optional[str]:
+    if isinstance(d, ast.Call):
+        d = d.func
+    if isinstance(d, ast.Attribute):
+        return d.attr
+    if isinstance(d, ast.Name):
+        return d.id
+    return None
+
+
+def _op_decorator(fn: ast.FunctionDef):
+    """The @register_op(...) decorator Call of ``fn``, if any."""
+    for d in fn.decorator_list:
+        if _decorator_name(d) in ("register_op", "register_override") \
+                and isinstance(d, ast.Call):
+            return d
+    return None
+
+
+def _jit_disabled(dec: ast.Call) -> bool:
+    for kw in dec.keywords:
+        if kw.arg == "jit" and isinstance(kw.value, ast.Constant) \
+                and kw.value.value is False:
+            return True
+    return False
+
+
+class _AsarrayVisitor(ast.NodeVisitor):
+    """Flags np.asarray/np.array(<op param>) inside an op impl, honoring
+    nested-function shadowing (host-callback closures redefine the
+    name, which makes the call host-side and fine)."""
+
+    def __init__(self, params, lines, path, findings):
+        self.scopes = [set(params)]
+        self.lines = lines
+        self.path = path
+        self.findings = findings
+
+    def _params_of(self, node):
+        a = node.args
+        names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+        if a.vararg:
+            names.append(a.vararg.arg)
+        if a.kwarg:
+            names.append(a.kwarg.arg)
+        return set(names)
+
+    def visit_FunctionDef(self, node):
+        self.scopes.append(self._params_of(node))
+        self.generic_visit(node)
+        self.scopes.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        self.scopes.append(self._params_of(node))
+        self.generic_visit(node)
+        self.scopes.pop()
+
+    def visit_Call(self, node):
+        f = node.func
+        if (isinstance(f, ast.Attribute) and f.attr in ("asarray", "array")
+                and isinstance(f.value, ast.Name)
+                and f.value.id in ("np", "numpy") and node.args
+                and isinstance(node.args[0], ast.Name)):
+            name = node.args[0].id
+            # flagged only when the name is the OP's own parameter and no
+            # nested scope shadows it
+            if name in self.scopes[0] and not any(
+                    name in s for s in self.scopes[1:]) \
+                    and not _suppressed(self.lines, node.lineno):
+                self.findings.append(LintFinding(
+                    "asarray-on-traced", self.path, node.lineno,
+                    f"np.{f.attr}({name}) on a traced op argument — "
+                    f"concretizes under jit; use jnp, mark the op "
+                    f"jit=False, or route through pure_callback"))
+        self.generic_visit(node)
+
+
+def lint_source(path: str, source: str, relpath: str) -> List[LintFinding]:
+    """Lint one file's source. ``relpath`` is the path relative to the
+    package root (rule applicability is keyed on it)."""
+    findings: List[LintFinding] = []
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return [LintFinding("parse", path, e.lineno or 0, str(e))]
+    lines = source.splitlines()
+    rel = relpath.replace(os.sep, "/")
+    in_monitor = rel.endswith("framework/monitor.py")
+    hot = any(rel.endswith(m) for m in HOT_PATH_MODULES)
+
+    for node in ast.walk(tree):
+        # rule: device-get-hot-path
+        if hot and isinstance(node, ast.Call) and _is_jax_device_get(node) \
+                and not _suppressed(lines, node.lineno):
+            findings.append(LintFinding(
+                "device-get-hot-path", path, node.lineno,
+                "bare jax.device_get in a hot-path module: a blocking "
+                "D2H sync per call — return device values and flush in "
+                "windows (Model._flush_window)"))
+
+        # rule: monitor-lock-contract (outside monitor.py: no touching
+        # its private state)
+        if not in_monitor:
+            bad = None
+            if isinstance(node, ast.ImportFrom) and node.module \
+                    and node.module.rsplit(".", 1)[-1] == "monitor":
+                hit = [a.name for a in node.names
+                       if a.name in _MONITOR_PRIVATE]
+                bad = hit[0] if hit else None
+            elif isinstance(node, ast.Attribute) \
+                    and node.attr in _MONITOR_PRIVATE \
+                    and isinstance(node.value, ast.Name) \
+                    and node.value.id == "monitor":
+                bad = node.attr
+            if bad and not _suppressed(lines, node.lineno):
+                findings.append(LintFinding(
+                    "monitor-lock-contract", path, node.lineno,
+                    f"direct use of monitor.{bad}: the monitor's "
+                    f"internals are private to its threading contract "
+                    f"(framework/monitor.py docstring); use the "
+                    f"stat_*/all_* API"))
+
+        # rule: monitor-lock-contract (inside monitor.py: stat_add stays
+        # lock-free)
+        if in_monitor and isinstance(node, ast.FunctionDef) \
+                and node.name == "stat_add":
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.With) and any(
+                        isinstance(item.context_expr, ast.Name)
+                        and item.context_expr.id == "_lock"
+                        for item in sub.items) \
+                        and not _suppressed(lines, sub.lineno):
+                    findings.append(LintFinding(
+                        "monitor-lock-contract", path, sub.lineno,
+                        "stat_add takes _lock: the writer hot path is "
+                        "lock-free BY CONTRACT (module docstring) — a "
+                        "lock per eager op dispatch serializes the "
+                        "engine"))
+
+        # rule: asarray-on-traced (op impls that run under jit)
+        if isinstance(node, ast.FunctionDef):
+            dec = _op_decorator(node)
+            if dec is not None and not _jit_disabled(dec):
+                params = [p.arg for p in node.args.posonlyargs
+                          + node.args.args]
+                v = _AsarrayVisitor(params, lines, path, findings)
+                for stmt in node.body:  # not node: the op fn's own
+                    v.visit(stmt)       # params are scope 0, not a shadow
+
+    return findings
+
+
+def lint_repo(root: Optional[str] = None) -> List[LintFinding]:
+    """Lint every .py file under the paddle_tpu package (or ``root``)."""
+    if root is None:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    findings: List[LintFinding] = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fname in sorted(filenames):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            rel = os.path.relpath(path, root)
+            with open(path, encoding="utf-8") as f:
+                src = f.read()
+            findings.extend(lint_source(path, src, rel))
+    return findings

@@ -31,6 +31,7 @@ from . import dtypes as _dtypes
 from . import static_capture as _capture
 from .flags import flag_value
 from .monitor import stat_add, stat_observe
+from . import trace_probe as _probe
 from .tensor import GradNode, Tensor, is_grad_enabled
 from ..profiler import span as _prof
 
@@ -175,7 +176,8 @@ def _get_callable(name: str, impl, template, attrs_key, attrs,
         # bug exhausting XLA, 3edc4ce) a visible metric, not a post-mortem.
         stat_add("op_cache_miss")
         stat_add(f"op_cache_miss/{name}")
-        fn = _build_callable(impl, template, attrs, arr_attr_names, jit_ok)
+        fn = _build_callable(impl, template, attrs, arr_attr_names, jit_ok,
+                             probe_name=name, probe_static=attrs_key)
         if _prof._active:
             fn = _first_call_span(name, key, fn)
         _fn_cache[key] = fn
@@ -201,7 +203,8 @@ def _first_call_span(name, key, built):
     return traced
 
 
-def _build_callable(impl, template, attrs, arr_attr_names, jit_ok):
+def _build_callable(impl, template, attrs, arr_attr_names, jit_ok,
+                    probe_name=None, probe_static=None):
     n_attr = len(arr_attr_names)
 
     def raw(*arrays):
@@ -212,8 +215,23 @@ def _build_callable(impl, template, attrs, arr_attr_names, jit_ok):
                           arrays[len(arrays) - n_attr:]))
         return impl(*_rebuild(template, pos), **kw)
 
-    return jax.jit(raw) if (jit_ok and flag_value("FLAGS_eager_jit_ops")) \
-        else raw
+    if jit_ok and flag_value("FLAGS_eager_jit_ops"):
+        if probe_name is not None:
+            # under jit, ``raw`` runs only while TRACING a new signature
+            # — so recording here counts (and classifies) every retrace
+            # of this op at trace time, at zero steady-state cost
+            # (framework/trace_probe.py; the dispatch/retrace_cause
+            # counters feed the recompile-churn analysis pass)
+            site = _probe.site(f"op/{probe_name}")
+            static = {"attrs": probe_static}
+            inner = raw
+
+            def raw(*arrays, _inner=inner, _site=site, _static=static):
+                _site.record(_probe.sig_of(arrays), _static)
+                return _inner(*arrays)
+
+        return jax.jit(raw)
+    return raw
 
 
 def _get_bwd_callable(name: str, impl, template, attrs_key, fwd_fn,
@@ -236,9 +254,19 @@ def _get_bwd_callable(name: str, impl, template, attrs_key, fwd_fn,
             _, vjp = jax.vjp(fwd_fn, *arrays)
             return vjp(ct)
 
-        fn = jax.jit(bwd_raw) if (jit_ok
-                                  and flag_value("FLAGS_eager_jit_ops")) \
-            else bwd_raw
+        if jit_ok and flag_value("FLAGS_eager_jit_ops"):
+            bsite = _probe.site(f"op/{name}.bwd")
+            bstatic = {"attrs": attrs_key}
+            inner_bwd = bwd_raw
+
+            def bwd_raw(ct, *arrays, _inner=inner_bwd, _site=bsite,
+                        _static=bstatic):
+                _site.record(_probe.sig_of((ct,) + arrays), _static)
+                return _inner(ct, *arrays)
+
+            fn = jax.jit(bwd_raw)
+        else:
+            fn = bwd_raw
         if _prof._active:
             # backward compiles (often the larger cost) get the same
             # first-call compile attribution as the forward

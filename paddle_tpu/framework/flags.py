@@ -103,6 +103,14 @@ define_flag("FLAGS_compile_cache_dir", "",
             "Directory for the persistent XLA compilation cache; empty "
             "means JAX_COMPILATION_CACHE_DIR or "
             "~/.cache/paddle_tpu/xla_cache (the autotune-cache root)")
+define_flag("FLAGS_static_analysis", "off",
+            "Default mode for the jaxpr-level program linter "
+            "(paddle_tpu/analysis): 'warn' runs the pass pipeline over "
+            "every newly built hapi train step and captured static "
+            "Program and logs findings; 'error' additionally raises "
+            "AnalysisError on error-severity findings; 'off' disables "
+            "the pre-flight (explicit Model.fit(analyze=...) still "
+            "wins). Env-seeded: FLAGS_static_analysis=warn")
 define_flag("FLAGS_hapi_prefetch", True,
             "Route Model.fit/evaluate input through io.device_prefetch "
             "(background H2D overlapping compute); the escape hatch for "

@@ -244,7 +244,15 @@ class Tensor:
     clear_gradient = clear_grad
 
     def detach(self) -> "Tensor":
-        return Tensor(self._data, stop_gradient=True)
+        # lax.stop_gradient, not just a tape-less rewrap: inside a jax-
+        # traced step (hapi donated train step, static replay) gradients
+        # are jax's, which ignore the eager stop_gradient flag — without
+        # the primitive a detached path trains under fit() while being
+        # frozen under eager backward() (divergence found by the
+        # dead-grad analysis pass, tests/test_analysis.py)
+        import jax
+        return Tensor(jax.lax.stop_gradient(self._data),
+                      stop_gradient=True)
 
     def clone(self) -> "Tensor":
         from .dispatch import call_op

@@ -1,0 +1,155 @@
+"""Retrace-cause tracking: records WHY a compiled function re-traced.
+
+Reference analog: the reference caches compiled programs per
+(op, attrs, var shapes) and a miss is silent — the first visible symptom
+of signature churn is a slow step. Here every trace site (an eager-op
+jit wrapper in framework/dispatch.py, the hapi donated train step)
+registers the signature it was traced with; a SECOND trace at the same
+site diffs the new signature against the last one and classifies the
+cause:
+
+* ``shape``      — same leaf structure/dtypes, at least one shape changed
+                   (the "bucket your variable-length data" class);
+* ``dtype``      — a leaf dtype changed (e.g. f32 batch after bf16 warmup);
+* ``structure``  — leaf count / tree structure changed;
+* ``static_arg`` — a static (non-array) argument changed, keyed by which
+                   component: the hapi step reports its frozen-parameter
+                   set as the ``frozen_set`` cause (progressive unfreezing
+                   re-traces are expected — but a flapping frozen set is a
+                   compile storm).
+
+Counters (framework/monitor.py): ``dispatch/retrace_cause`` (total) and
+``dispatch/retrace_cause/<cause>``, surfaced by ``bench.py --dry-run``
+and consumed by the recompile-churn analysis pass
+(paddle_tpu/analysis/passes.py), which turns per-site churn into
+Findings with thresholds.
+
+Cost model: ``record`` runs only when the wrapped python function body
+executes — for a jitted function that is trace time, never the compiled
+hot path. Site bookkeeping takes a lock; traces are orders of magnitude
+rarer than dispatches (same argument as profiler/span.py).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+from .monitor import stat_add
+
+__all__ = ["site", "snapshot", "reset"]
+
+_lock = threading.Lock()
+_sites: Dict[str, "_Site"] = {}
+# registry bound: hapi allocates one site per Model instance, so a
+# sweep/notebook creating thousands of Models must not grow host memory
+# (and snapshot() cost) without bound. Past the cap site() returns an
+# UNREGISTERED _Site: counting still works for callers that hold the
+# returned site by reference across traces (dispatch closures, the
+# Model._probe_site attribute) — only snapshot() visibility is bounded.
+_MAX_SITES = 512
+
+
+class _Site:
+    """One trace location: last signature + per-cause retrace counts."""
+
+    __slots__ = ("name", "last_sig", "last_static", "traces", "causes")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.last_sig: Optional[Tuple] = None
+        self.last_static: Optional[Any] = None
+        self.traces = 0
+        self.causes: Dict[str, int] = {}
+
+    def record(self, sig: Tuple, static_key: Any = None) -> Optional[str]:
+        """Register one trace of this site. ``sig`` is a tuple of
+        (shape, dtype) leaf descriptors; ``static_key`` is a dict of
+        named static components (the differing NAME becomes the cause
+        when it is a known one). Returns the classified cause, or None
+        for the site's first trace."""
+        with _lock:
+            self.traces += 1
+            if self.traces == 1:
+                self.last_sig, self.last_static = sig, static_key
+                return None
+            cause = _classify(self.last_sig, sig,
+                              self.last_static, static_key)
+            self.last_sig, self.last_static = sig, static_key
+            self.causes[cause] = self.causes.get(cause, 0) + 1
+        stat_add("dispatch/retrace_cause")
+        stat_add(f"dispatch/retrace_cause/{cause}")
+        return cause
+
+
+def _classify(old_sig, new_sig, old_static, new_static) -> str:
+    if old_static != new_static:
+        if isinstance(old_static, dict) and isinstance(new_static, dict):
+            for k in old_static:
+                if new_static.get(k, old_static[k]) != old_static[k]:
+                    # a named static component (e.g. "frozen_set") IS the
+                    # cause label when it diffs
+                    return k if k in _NAMED_CAUSES else "static_arg"
+        return "static_arg"
+    if old_sig == new_sig:
+        # same signature re-traced: the wrapper identity changed (cache
+        # cleared / rebuilt fn) — still a compile, still worth counting
+        return "rebuild"
+    old_leaves, new_leaves = list(old_sig), list(new_sig)
+    if len(old_leaves) != len(new_leaves):
+        return "structure"
+    dtype_diff = any(o[1] != n[1] for o, n in zip(old_leaves, new_leaves))
+    if dtype_diff:
+        return "dtype"
+    return "shape"
+
+
+_NAMED_CAUSES = frozenset({"frozen_set", "n_inputs"})
+
+
+def site(name: str) -> _Site:
+    """Get-or-create the named trace site.
+
+    Site granularity is deliberate: ``op/<name>`` sites are shared
+    across attrs variants and callers — every compile of the logical op
+    beyond its first IS the churn the counters exist to expose (a
+    thousand distinct ``scale`` attrs = a thousand XLA compiles of one
+    op, the jit-cache-exhaustion bug class), classified by WHAT changed.
+    Per-caller baselines (the hapi per-Model sites) are for steps whose
+    signature is expected stable."""
+    with _lock:
+        s = _sites.get(name)
+        if s is None:
+            s = _Site(name)
+            if len(_sites) < _MAX_SITES:
+                _sites[name] = s
+        return s
+
+
+def sig_of(arrays) -> Tuple:
+    """(shape, dtype) leaf descriptors for a flat sequence of arrays or
+    tracers (both expose .shape/.dtype during trace)."""
+    out = []
+    for a in arrays:
+        shape = tuple(getattr(a, "shape", ()))
+        dtype = str(getattr(a, "dtype", type(a).__name__))
+        out.append((shape, dtype))
+    return tuple(out)
+
+
+def snapshot() -> Dict[str, dict]:
+    """Per-site view for the recompile-churn analysis pass."""
+    with _lock:
+        return {name: {"traces": s.traces, "causes": dict(s.causes)}
+                for name, s in _sites.items()}
+
+
+def reset() -> None:
+    """Zero all site counts IN PLACE: built jit wrappers hold their
+    _Site by reference, so dropping the registry entries would orphan
+    them — their later traces would never reach snapshot()."""
+    with _lock:
+        for s in _sites.values():
+            s.last_sig = None
+            s.last_static = None
+            s.traces = 0
+            s.causes = {}

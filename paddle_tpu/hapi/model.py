@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..framework import random as _random
+from ..framework import trace_probe as _probe
 from ..framework.io import load as _load, save as _save
 from ..framework.monitor import stat_add, stat_observe
 from ..framework.tensor import Tensor, no_grad_guard
@@ -282,6 +283,11 @@ class Model:
         frozen = {name for name, p in self._bind_params
                   if p.stop_gradient}
         if self._frozen is not None and frozen != self._frozen:
+            # invalidate the step; when the rebuilt step re-traces, the
+            # hapi/train_step probe site diffs its static frozen_set
+            # component and classifies the retrace cause as frozen_set
+            # (framework/trace_probe.py) — the recompile-churn analysis
+            # pass warns on a flapping set
             self._train_step_fn = None
             if self._optimizer is not None and self._opt_state is not None:
                 old = self._opt_state
@@ -426,8 +432,28 @@ class Model:
         # silently trained frozen params) and free under donation
         frozen = frozenset(self._frozen or ())
 
+        # per-INSTANCE site: another Model (even of the same class) must
+        # not diff this one's signatures into phantom structure/shape
+        # retraces — its first compile is not this model's churn. Held
+        # on the Model so rebuilds keep ONE site (and keep counting)
+        # even past the trace_probe registry cap.
+        probe_site = getattr(self, "_probe_site", None)
+        if probe_site is None:
+            Model._probe_seq = getattr(Model, "_probe_seq", 0) + 1
+            probe_site = self._probe_site = _probe.site(
+                f"hapi/train_step[{type(net).__name__}"
+                f"#{Model._probe_seq}]")
+
         def train_step(params, opt_state, buffers, key, lr, n_inputs,
                        *arrays):
+            # body runs only while jax TRACES a new signature, so this
+            # classifies every donated-step retrace (shape vs dtype vs
+            # frozen-set) into dispatch/retrace_cause at trace time —
+            # zero steady-state cost (framework/trace_probe.py)
+            probe_site.record(
+                _probe.sig_of(list(params.values())
+                              + list(buffers.values()) + list(arrays)),
+                {"n_inputs": n_inputs, "frozen_set": tuple(sorted(frozen))})
             inputs = arrays[:n_inputs]
             label_arrays = arrays[n_inputs:]
             froz_p = {k: v for k, v in params.items() if k in frozen}
@@ -475,6 +501,48 @@ class Model:
         self._train_step_fn = jax.jit(train_step,
                                       static_argnames=("n_inputs",),
                                       donate_argnums=(0, 1, 2))
+
+    def _analysis_loss_fn(self, ins, lbs):
+        """Loss-of-trainable-params closure mirroring _build_train_step's
+        ``loss_of`` — the analysis layer (paddle_tpu/analysis) traces
+        ``jax.grad`` of this for the dead/frozen-grad pass. Kept here so
+        the functional_state/amp/rng plumbing has ONE owner."""
+        import jax
+        net = self.network
+        frozen = frozenset(self._frozen or ())
+        params, buffers = self._params, self._buffers
+        froz_p = {k: v for k, v in params.items() if k in frozen}
+        train_p = {k: v for k, v in params.items() if k not in frozen}
+        key = jax.random.key(0)
+
+        def loss_fn(p):
+            with _random.rng_guard(key), self._maybe_amp():
+                with functional_state(net, {**p, **froz_p}, buffers):
+                    with no_grad_guard():
+                        tins = [Tensor(a, stop_gradient=True)
+                                for a in ins]
+                        outputs = net(*tins)
+                        labels = [Tensor(a) for a in lbs]
+                        loss = self._loss_tensors(outputs, labels)
+            return loss._data.astype(jnp.float32)
+
+        return loss_fn, train_p
+
+    def _run_analysis(self, inputs, labels, mode):
+        """fit()'s pre-flight: lint the built train step on the first
+        batch. 'warn' logs the findings table; 'error' additionally
+        raises AnalysisError on error-severity findings. Analyzer
+        crashes (not findings) never kill training."""
+        from .. import analysis
+        try:
+            report = analysis.analyze_model(self, inputs, labels)
+        except Exception as e:  # pragma: no cover - analyzer robustness
+            import warnings
+            warnings.warn(f"static analysis pre-flight failed "
+                          f"({type(e).__name__}: {e}); continuing fit",
+                          RuntimeWarning)
+            return None
+        return analysis.apply_mode(report, mode, "the train step")
 
     def _build_eval_step(self):
         net = self.network
@@ -678,7 +746,7 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
-            prefetch=None, prefetch_buffer_size=2):
+            prefetch=None, prefetch_buffer_size=2, analyze=None):
         """Train over ``train_data``, asynchronously on the dygraph path:
         steps are dispatched without blocking (donated jitted step), the
         next batch's H2D transfer rides under compute via
@@ -693,7 +761,23 @@ class Model:
         ``on_train_batch_end`` receives the last flushed logs, so
         per-step scalar consumers (e.g. VisualDL) see values at
         ``log_freq`` granularity on this path; the static-graph adapter
-        keeps per-step logs (its executor is host-synchronous anyway)."""
+        keeps per-step logs (its executor is host-synchronous anyway).
+
+        ``analyze`` runs the jaxpr linter (paddle_tpu/analysis) over the
+        built train step on the first batch: ``'warn'`` logs findings,
+        ``'error'`` raises AnalysisError on error-severity ones,
+        ``'off'`` skips. ``None`` defers to ``FLAGS_static_analysis``
+        (env-seeded, default off). Tracing only — nothing executes."""
+        analyze_explicit = analyze is not None
+        if analyze is None:
+            # flag-seeded: lenient normalization (a bad env value means
+            # un-linted, not a crash blaming an argument never passed)
+            from .. import analysis
+            analyze = analysis.flag_mode()
+        elif analyze not in ("off", "warn", "error"):
+            raise ValueError(
+                f"analyze must be 'warn', 'error' or 'off', got "
+                f"{analyze!r}")
         loader = self._as_loader(train_data, batch_size, shuffle,
                                  num_workers, drop_last)
         eval_loader = self._as_loader(eval_data, batch_size, False,
@@ -709,6 +793,21 @@ class Model:
         self.stop_training = False
         self.network.train()
         async_path = self._static() is None
+        if analyze != "off" and not async_path:
+            # the jaxpr linter hooks the DYNAMIC donated train step; on
+            # the static-graph adapter the analog is the Executor.run
+            # pre-flight. Warn only for an EXPLICIT analyze= request
+            # (error mode could never fire) — a flag-seeded mode already
+            # covers static programs through that pre-flight, so there
+            # is nothing to advise
+            if analyze_explicit:
+                import warnings
+                warnings.warn(
+                    "fit(analyze=...) applies to the dynamic-graph path; "
+                    "in static mode the FLAGS_static_analysis pre-flight "
+                    "at Executor.run lints the captured Program",
+                    UserWarning)
+            analyze = "off"
         if async_path:
             self._sync_state_from_network()
             if self._train_step_fn is None:
@@ -728,6 +827,10 @@ class Model:
                 for step, batch in enumerate(data_iter):
                     cbks.on_train_batch_begin(step)
                     inputs, labels = self._split_batch(batch)
+                    if (analyze != "off" and async_path
+                            and epoch == 0 and step == 0):
+                        self._analysis_report = self._run_analysis(
+                            inputs, labels, analyze)
                     if not async_path:
                         result = self.train_batch(inputs, labels)
                         logs = self._pack_logs(result)

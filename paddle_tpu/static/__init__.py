@@ -367,6 +367,38 @@ class Executor:
     def __init__(self, place=None):
         self.place = place
 
+    def _maybe_preflight(self, program) -> None:
+        """Static-analysis pre-flight of a captured Program, once per
+        program (cached on it), gated by ``FLAGS_static_analysis`` —
+        the jaxpr linter replays the node list abstractly (no compile,
+        no execution) and warns/raises on findings, the analog of the
+        reference running its IR passes before the first executor step
+        (framework/ir/pass.h). Analyzer crashes never block run()."""
+        from .. import analysis
+        mode = analysis.flag_mode()
+        if mode == "off":
+            return
+        cached = getattr(program, "_analysis_report", None)
+        if cached is not None:
+            # analysis runs once per program, but error mode must KEEP
+            # gating: a caller that caught the first AnalysisError and
+            # retries run() may not execute the error-flagged program.
+            # (warn mode stays quiet on repeats — the one warning stands)
+            if cached and mode == "error" and not cached.ok():
+                raise analysis.AnalysisError(cached)
+            return
+        try:
+            report = analysis.analyze(program)
+        except Exception as e:  # pragma: no cover - analyzer robustness
+            import warnings
+            warnings.warn(f"static-analysis pre-flight failed "
+                          f"({type(e).__name__}: {e}); running anyway",
+                          RuntimeWarning)
+            program._analysis_report = False
+            return
+        program._analysis_report = report
+        analysis.apply_mode(report, mode, "the captured Program")
+
     def run(self, program=None, feed=None, fetch_list=None,
             return_numpy=True):
         if program is None:
@@ -386,6 +418,7 @@ class Executor:
             prev = _capture.current
             _capture.set_current(None)
             try:
+                self._maybe_preflight(program)
                 fetch_ids = [program._resolve_fetch(f)
                              for f in (fetch_list or [])]
                 return program._execute(feed or {}, fetch_ids)

@@ -67,7 +67,10 @@ def _roi_align(x, boxes, boxes_num, output_size=1, spatial_scale=1.0,
     is a CPU-side perf choice, not a semantics change for large grids)."""
     oh, ow = (output_size, output_size) if isinstance(output_size, int) \
         else tuple(output_size)
-    counts = np.asarray(boxes_num)
+    # boxes_num arrives as a STATIC tuple attr (the API wrapper
+    # concretizes it on host — the per-image box layout shapes the
+    # graph), so this asarray is host-side by contract.  # lint: ok
+    counts = np.asarray(boxes_num)  # lint: ok
     img_of_roi = jnp.asarray(np.repeat(np.arange(len(counts)), counts))
     assert img_of_roi.shape[0] == boxes.shape[0], \
         "boxes_num must sum to len(boxes)"
@@ -105,7 +108,10 @@ def _roi_align(x, boxes, boxes_num, output_size=1, spatial_scale=1.0,
 def _roi_pool(x, boxes, boxes_num, output_size=1, spatial_scale=1.0):
     oh, ow = (output_size, output_size) if isinstance(output_size, int) \
         else tuple(output_size)
-    counts = np.asarray(boxes_num)
+    # boxes_num arrives as a STATIC tuple attr (the API wrapper
+    # concretizes it on host — the per-image box layout shapes the
+    # graph), so this asarray is host-side by contract.  # lint: ok
+    counts = np.asarray(boxes_num)  # lint: ok
     img_of_roi = jnp.asarray(np.repeat(np.arange(len(counts)), counts))
     h, w = x.shape[2], x.shape[3]
     xf = x.astype(jnp.float32)
@@ -146,7 +152,10 @@ def _psroi_pool(x, boxes, boxes_num, output_size=1, spatial_scale=1.0):
         else tuple(output_size)
     c = x.shape[1]
     out_c = c // (oh * ow)
-    counts = np.asarray(boxes_num)
+    # boxes_num arrives as a STATIC tuple attr (the API wrapper
+    # concretizes it on host — the per-image box layout shapes the
+    # graph), so this asarray is host-side by contract.  # lint: ok
+    counts = np.asarray(boxes_num)  # lint: ok
     img_of_roi = jnp.asarray(np.repeat(np.arange(len(counts)), counts))
     h, w = x.shape[2], x.shape[3]
     xf = x.astype(jnp.float32)

@@ -1,0 +1,558 @@
+"""The jaxpr program linter (paddle_tpu/analysis): each of the five
+passes must catch its seeded bug class, the integration surfaces
+(Model.fit analyze=, Executor pre-flight, CLI) must work, and the zoo
+train steps + examples entry points must come back with a clean bill
+(zero error-severity findings)."""
+import os
+import subprocess
+import sys
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu import analysis
+from paddle_tpu.framework import monitor, trace_probe
+from paddle_tpu.io import TensorDataset
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _findings(report, pass_id, severity=None):
+    return [f for f in report.findings if f.pass_id == pass_id
+            and (severity is None or f.severity == severity)]
+
+
+def _small_model(net=None):
+    net = net or nn.Sequential(nn.Linear(8, 8), nn.ReLU(), nn.Linear(8, 4))
+    m = paddle.Model(net)
+    m.prepare(paddle.optimizer.Adam(learning_rate=1e-3,
+                                    parameters=net.parameters()),
+              nn.CrossEntropyLoss())
+    return m
+
+
+def _batch(n=8, d=8, c=4, seed=0):
+    rng = np.random.RandomState(seed)
+    return (rng.randn(n, d).astype("float32"),
+            rng.randint(0, c, (n, 1)).astype("int64"))
+
+
+# ---------------------------------------------------------------------------
+# pass 1: host-sync
+# ---------------------------------------------------------------------------
+
+def test_host_sync_catches_hidden_numpy():
+    import jax.numpy as jnp
+
+    def step_with_hidden_sync(x):
+        h = x * 2.0
+        scale = float(np.asarray(h).mean())  # the seeded bug
+        return h * scale
+
+    r = analysis.analyze(step_with_hidden_sync,
+                         jnp.ones((4,), jnp.float32))
+    errs = _findings(r, "host-sync", "error")
+    assert len(errs) == 1
+    # diagnosed with the offending source line, not a raw
+    # ConcretizationError deep inside jax
+    assert "test_analysis.py" in (errs[0].source or "")
+    assert not r.ok()
+
+
+def test_host_sync_catches_tensor_numpy_inside_layer():
+    class SyncNet(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(8, 4)
+
+        def forward(self, x):
+            h = self.fc(x)
+            return h * float(h.numpy().mean())  # hidden host sync
+
+    m = _small_model(SyncNet())
+    x, y = _batch()
+    r = analysis.analyze_model(m, [x], [y])
+    assert not r.ok()
+    assert _findings(r, "host-sync", "error")
+
+
+def test_host_sync_flags_callbacks():
+    t = paddle.to_tensor(np.eye(4, dtype="float32"))
+    r = analysis.analyze(lambda x: paddle.linalg.eig(x)[0], t)
+    warns = _findings(r, "host-sync", "warning")
+    assert warns and warns[0].primitive == "pure_callback"
+    assert r.ok()  # a callback is a cost warning, not an error
+
+
+# ---------------------------------------------------------------------------
+# pass 2: donation-safety
+# ---------------------------------------------------------------------------
+
+def test_donation_catches_missing_rebind_target():
+    import jax
+    import jax.numpy as jnp
+
+    # the seeded PR-2 bug class: buffers donated but never returned —
+    # the caller's rebind target does not exist after dispatch
+    f = jax.jit(lambda params, x: (params * 0.9 + x).sum(),
+                donate_argnums=(0,))
+    r = analysis.analyze(f, jnp.ones((4, 4), jnp.float32),
+                         jnp.ones((4, 4), jnp.float32))
+    errs = _findings(r, "donation-safety", "error")
+    assert len(errs) == 1 and "no matching output" in errs[0].message
+
+
+def test_donation_clean_when_outputs_cover_donated():
+    import jax.numpy as jnp
+
+    def step(params, x):
+        new_params = {k: v - 0.1 * x.mean() for k, v in params.items()}
+        return new_params, (x * 2).sum()
+
+    params = {"w": jnp.ones((3, 3), jnp.float32)}
+    r = analysis.analyze(step, params, jnp.ones((3,), jnp.float32),
+                         donate_argnums=(0,))
+    assert not _findings(r, "donation-safety")
+
+
+def test_donation_real_train_step_is_clean():
+    m = _small_model()
+    x, y = _batch()
+    r = analysis.analyze_model(m, [x], [y])
+    assert not _findings(r, "donation-safety"), r.table()
+    assert r.ok(), r.table()
+
+
+# ---------------------------------------------------------------------------
+# pass 3: dead/frozen-grad
+# ---------------------------------------------------------------------------
+
+class _PartlyDeadNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.used = nn.Linear(8, 4)
+        self.unused = nn.Linear(8, 4)  # the seeded frozen-param bug
+
+    def forward(self, x):
+        return self.used(x)
+
+
+def test_dead_grad_catches_trainable_param_without_grad():
+    m = _small_model(_PartlyDeadNet())
+    x, y = _batch()
+    r = analysis.analyze_model(m, [x], [y])
+    errs = _findings(r, "dead-grad", "error")
+    names = {e.message.split("'")[1] for e in errs}
+    assert names == {"unused.weight", "unused.bias"}
+    assert not r.ok()
+
+
+def test_dead_grad_silent_when_properly_frozen():
+    net = _PartlyDeadNet()
+    net.unused.weight.stop_gradient = True
+    net.unused.bias.stop_gradient = True
+    m = _small_model(net)
+    x, y = _batch()
+    r = analysis.analyze_model(m, [x], [y])
+    # the frozen split bakes them out of the grad jaxpr entirely
+    assert not _findings(r, "dead-grad"), r.table()
+    assert r.ok(), r.table()
+
+
+def test_dead_grad_catches_detached_path():
+    class DetachNet(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(8, 4)
+            self.gate = nn.Linear(8, 4)
+
+        def forward(self, x):
+            # .detach() severs the grad path while gate stays trainable
+            return self.fc(x) + self.gate(x).detach()
+
+    m = _small_model(DetachNet())
+    x, y = _batch()
+    r = analysis.analyze_model(m, [x], [y])
+    names = {e.message.split("'")[1]
+             for e in _findings(r, "dead-grad", "error")}
+    assert names == {"gate.weight", "gate.bias"}
+
+
+# ---------------------------------------------------------------------------
+# pass 4: dtype-hygiene
+# ---------------------------------------------------------------------------
+
+def test_dtype_catches_f64_input_leak():
+    bad_batch = np.random.RandomState(0).randn(4, 8)  # float64!
+    r = analysis.analyze(lambda a: (a * 2).sum(), bad_batch)
+    warns = _findings(r, "dtype-hygiene", "warning")
+    assert any("float64 host input" in f.message for f in warns)
+
+
+def test_dtype_catches_bf16_upcast():
+    import jax.numpy as jnp
+
+    def fn(x):
+        h = x * 2  # bf16 work
+        return h.astype(jnp.float32).sum()  # silent upcast
+
+    r = analysis.analyze(fn, jnp.ones((4, 4), jnp.bfloat16))
+    infos = _findings(r, "dtype-hygiene", "info")
+    assert any("bf16->f32 upcast" in f.message for f in infos)
+
+
+def test_dtype_clean_on_f32():
+    import jax.numpy as jnp
+    r = analysis.analyze(lambda a: (a @ a).sum(),
+                         jnp.ones((4, 4), jnp.float32))
+    assert not _findings(r, "dtype-hygiene")
+
+
+# ---------------------------------------------------------------------------
+# pass 5: recompile-churn
+# ---------------------------------------------------------------------------
+
+def test_recompile_churn_classifies_shape_retraces():
+    trace_probe.reset()
+    monitor.stat_reset()
+    # the seeded churn: one op dispatched at many distinct shapes
+    for n in range(3, 13):
+        t = paddle.to_tensor(np.ones((n, 2), "float32"))
+        (t * 1.5).numpy()
+    assert monitor.stat_get("dispatch/retrace_cause/shape") >= 8
+    r = analysis.analyze(None)
+    churn = _findings(r, "recompile-churn")
+    assert any("shape classes" in f.message for f in churn)
+
+
+def test_recompile_churn_step_level_warning():
+    trace_probe.reset()
+    m = _small_model()
+    # batch-shape flapping re-traces the whole donated step each time
+    for n in (8, 9, 10):
+        x, y = _batch(n=n)
+        m.train_batch([x], [y])
+    r = analysis.analyze(None)
+    warns = [f for f in _findings(r, "recompile-churn", "warning")
+             if "train_step" in f.message]
+    assert warns, r.table()
+
+
+def test_frozen_set_flip_is_classified():
+    trace_probe.reset()
+    monitor.stat_reset()
+    net = nn.Sequential(nn.Linear(8, 8), nn.ReLU(), nn.Linear(8, 4))
+    m = _small_model(net)
+    x, y = _batch()
+    m.train_batch([x], [y])
+    net[0].weight.stop_gradient = True  # progressive-freezing flip
+    m.train_batch([x], [y])
+    assert monitor.stat_get("dispatch/retrace_cause/frozen_set") >= 1
+
+
+# ---------------------------------------------------------------------------
+# integration: Model.fit(analyze=...), Executor pre-flight, CLI, counters
+# ---------------------------------------------------------------------------
+
+def test_fit_analyze_error_mode_raises():
+    m = _small_model(_PartlyDeadNet())
+    x, y = _batch(n=16)
+    with pytest.raises(analysis.AnalysisError) as ei:
+        m.fit(TensorDataset([x, y]), batch_size=8, epochs=1, verbose=0,
+              analyze="error")
+    assert "dead-grad" in str(ei.value)
+
+
+def test_fit_analyze_warn_mode_trains_and_reports():
+    m = _small_model(_PartlyDeadNet())
+    x, y = _batch(n=16)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        m.fit(TensorDataset([x, y]), batch_size=8, epochs=1, verbose=0,
+              analyze="warn")
+    assert any("dead-grad" in str(x.message) for x in w)
+    assert m._analysis_report is not None
+    assert not m._analysis_report.ok()
+
+
+def test_fit_analyze_off_by_default():
+    monitor.stat_reset()
+    m = _small_model()
+    x, y = _batch(n=16)
+    m.fit(TensorDataset([x, y]), batch_size=8, epochs=1, verbose=0)
+    assert monitor.stat_get("analysis/runs") == 0
+
+
+def test_fit_analyze_flag_seeded():
+    from paddle_tpu.framework.flags import set_flags
+    monitor.stat_reset()
+    m = _small_model()
+    x, y = _batch(n=16)
+    set_flags({"FLAGS_static_analysis": "warn"})
+    try:
+        m.fit(TensorDataset([x, y]), batch_size=8, epochs=1, verbose=0)
+    finally:
+        set_flags({"FLAGS_static_analysis": "off"})
+    assert monitor.stat_get("analysis/runs") == 1
+
+
+def test_fit_analyze_rejects_bad_mode():
+    m = _small_model()
+    with pytest.raises(ValueError):
+        m.fit(TensorDataset(list(_batch())), batch_size=8, verbose=0,
+              analyze="loud")
+
+
+def test_executor_preflight_over_captured_program():
+    from paddle_tpu import static
+    from paddle_tpu.framework.flags import set_flags
+
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [None, 8], "float32")
+            h = static.nn.fc(x, size=4)
+        exe = static.Executor()
+        exe.run(startup)
+        set_flags({"FLAGS_static_analysis": "warn"})
+        try:
+            out = exe.run(main, feed={"x": np.ones((2, 8), "float32")},
+                          fetch_list=[h])
+        finally:
+            set_flags({"FLAGS_static_analysis": "off"})
+        assert out[0].shape == (2, 4)
+        report = main._analysis_report
+        assert report is not None and report.ok()
+        # cached: a second run() does not re-analyze
+        runs = monitor.stat_get("analysis/runs")
+        exe.run(main, feed={"x": np.ones((2, 8), "float32")},
+                fetch_list=[h])
+        assert monitor.stat_get("analysis/runs") == runs
+    finally:
+        paddle.disable_static()
+
+
+def test_counters_and_histograms_populated():
+    import jax.numpy as jnp
+    monitor.stat_reset()
+    analysis.analyze(lambda a: a + 1, jnp.ones((2,), jnp.float32))
+    assert monitor.stat_get("analysis/runs") == 1
+    assert "analysis/findings" in monitor.all_stats()
+    for pid in analysis.all_passes():
+        assert monitor.stat_histogram(f"analysis/pass_ms/{pid}"), pid
+
+
+def test_cli_module_target_and_selflint():
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="",
+               PYTHONPATH=REPO)
+    res = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.analysis",
+         "__graft_entry__:entry"],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert res.returncode == 0, res.stderr[-1500:]
+    assert "clean" in res.stdout or "0 error(s)" in res.stdout
+    res = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.analysis", "--selflint"],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert res.returncode == 0, res.stdout[-1500:]
+
+
+def test_donation_mapping_with_static_argnums():
+    import jax.numpy as jnp
+
+    # a static argnum BEFORE the donated one: the donation mask must
+    # land on `params`, whose missing output is then caught
+    def step(cfg, params, x):
+        return (params * cfg + x).sum()
+
+    r = analysis.analyze(step, 2, jnp.ones((3, 3), jnp.float32),
+                         jnp.ones((3, 3), jnp.float32),
+                         static_argnums=(0,), donate_argnums=(1,))
+    assert _findings(r, "donation-safety", "error")
+
+
+def test_executor_error_mode_keeps_gating_on_rerun():
+    from paddle_tpu import static
+    from paddle_tpu.framework.flags import set_flags
+
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [None, 4], "float32")
+            h = static.nn.fc(x, size=2)
+        exe = static.Executor()
+        exe.run(startup)
+        # simulate a cached error-carrying report: error mode must keep
+        # raising on EVERY run, not just the analyzing one
+        main._analysis_report = analysis.Report(
+            target="seeded", findings=[analysis.Finding(
+                pass_id="host-sync", severity="error", message="seeded")])
+        set_flags({"FLAGS_static_analysis": "error"})
+        try:
+            with pytest.raises(analysis.AnalysisError):
+                exe.run(main, feed={"x": np.ones((2, 4), "float32")},
+                        fetch_list=[h])
+        finally:
+            set_flags({"FLAGS_static_analysis": "off"})
+    finally:
+        paddle.disable_static()
+
+
+def test_flag_mode_is_lenient_on_boolean_style_values():
+    from paddle_tpu.framework.flags import set_flags
+    for raw, want in (("1", "warn"), ("on", "warn"), ("true", "warn"),
+                      ("error", "error"), ("strict", "error"),
+                      ("0", "off"), ("nonsense", "off"), ("off", "off")):
+        set_flags({"FLAGS_static_analysis": raw})
+        try:
+            assert analysis.flag_mode() == want, raw
+        finally:
+            set_flags({"FLAGS_static_analysis": "off"})
+    # a boolean-style env value must not crash fit()
+    set_flags({"FLAGS_static_analysis": "1"})
+    try:
+        monitor.stat_reset()
+        m = _small_model()
+        x, y = _batch(n=16)
+        m.fit(TensorDataset([x, y]), batch_size=8, epochs=1, verbose=0)
+        assert monitor.stat_get("analysis/runs") == 1
+    finally:
+        set_flags({"FLAGS_static_analysis": "off"})
+
+
+def test_tp_decode_capability_classifier():
+    import __graft_entry__ as g
+    assert g._is_capability_error(ImportError("no module"))
+    assert g._is_capability_error(
+        ValueError("compiling computation requires at least 8 devices"))
+    assert g._is_capability_error(
+        RuntimeError("UNIMPLEMENTED: PartitionId instruction is not "
+                     "supported for SPMD partitioning"))
+    # python-level bugs NEVER skip, even when their message contains
+    # marker-like words
+    assert not g._is_capability_error(
+        TypeError("unsupported operand type(s) for +: 'int' and 'None'"))
+    assert not g._is_capability_error(AssertionError("shape mismatch"))
+    assert not g._is_capability_error(ValueError("shapes do not match"))
+
+
+# ---------------------------------------------------------------------------
+# clean bill: zoo train steps + examples entry points
+# ---------------------------------------------------------------------------
+
+def test_gpt2_donated_train_step_clean():
+    from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining
+
+    paddle.framework.random.seed(0)
+    cfg = GPTConfig.tiny()
+    net = GPTForPretraining(cfg)
+    m = paddle.Model(net)
+    m.prepare(paddle.optimizer.AdamW(learning_rate=1e-4,
+                                     parameters=net.parameters()),
+              lambda logits, lbl: F.cross_entropy(
+                  logits.reshape([-1, cfg.vocab_size]),
+                  lbl.reshape([-1])))
+    ids = np.random.RandomState(0).randint(
+        0, cfg.vocab_size, (2, 16)).astype(np.int32)
+    r = analysis.analyze_model(m, [ids], [ids.astype(np.int64)])
+    assert r.ok(), r.table()
+    # the donated contract on the REAL step: every donated leaf rebinds
+    assert not _findings(r, "donation-safety"), r.table()
+    assert not _findings(r, "dead-grad"), r.table()
+
+
+def test_resnet_donated_train_step_clean():
+    from paddle_tpu.vision.models import resnet18
+
+    paddle.framework.random.seed(0)
+    net = resnet18(num_classes=10)
+    m = paddle.Model(net)
+    m.prepare(paddle.optimizer.Momentum(learning_rate=0.1,
+                                        parameters=net.parameters()),
+              nn.CrossEntropyLoss())
+    x = np.random.RandomState(0).randn(2, 3, 32, 32).astype(np.float32)
+    y = np.random.RandomState(1).randint(0, 10, (2, 1)).astype(np.int64)
+    r = analysis.analyze_model(m, [x], [y])
+    assert r.ok(), r.table()
+
+
+def test_examples_entry_points_clean():
+    """The computations the examples/ scripts run, analyzed at their
+    smoke scale: train_vision's hapi vision fit step (LeNet; the resnet
+    variant is covered by test_resnet_donated_train_step_clean and the
+    bench dry-run), generate_text's GPT train step, train_gpt2_sharded's
+    ParallelEngine donated step, and the static_graph Program replay.
+    All must carry zero error-severity findings."""
+    from paddle_tpu.vision.models import LeNet
+
+    # train_vision.py: Model(LeNet).fit
+    paddle.framework.random.seed(0)
+    m = paddle.Model(LeNet())
+    m.prepare(paddle.optimizer.Adam(
+        learning_rate=1e-3, parameters=m.network.parameters()),
+        nn.CrossEntropyLoss())
+    x = np.random.RandomState(0).randn(2, 1, 28, 28).astype(np.float32)
+    y = np.random.RandomState(1).randint(0, 10, (2, 1)).astype(np.int64)
+    r = analysis.analyze_model(m, [x], [y], name="examples/train_vision")
+    assert r.ok(), r.table()
+
+    # generate_text.py: char-GPT train step (tiny config)
+    from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_hidden_layers=2,
+                    num_attention_heads=2, intermediate_size=64,
+                    max_position_embeddings=32)
+    net = GPTForPretraining(cfg)
+    gm = paddle.Model(net)
+    gm.prepare(paddle.optimizer.AdamW(learning_rate=1e-3,
+                                      parameters=net.parameters()),
+               lambda logits, lbl: F.cross_entropy(
+                   logits.reshape([-1, cfg.vocab_size]),
+                   lbl.reshape([-1])))
+    ids = np.random.RandomState(0).randint(0, 64, (2, 16)).astype(np.int32)
+    r = analysis.analyze_model(gm, [ids], [ids.astype(np.int64)],
+                               name="examples/generate_text")
+    assert r.ok(), r.table()
+
+    # train_gpt2_sharded.py: the ParallelEngine donated sharded step
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.distributed import env as denv
+    from paddle_tpu.distributed.spmd import ParallelEngine
+    paddle.framework.random.seed(0)
+    net2 = GPTForPretraining(GPTConfig.tiny())
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=net2.parameters())
+    denv.build_mesh({"data": 1})
+    eng = ParallelEngine(net2, opt, loss_fn=None, mesh=denv.get_mesh())
+    ids2 = np.random.RandomState(0).randint(
+        0, GPTConfig.tiny().vocab_size, (2, 16)).astype(np.int32)
+    eng.train_step_async([ids2], [ids2])  # builds eng._train_step
+    key = jax.random.key(0)
+    lr = jnp.asarray(1e-4, jnp.float32)
+    r = analysis.analyze(eng._train_step, eng.params, eng.opt_state,
+                         eng.buffers, key, lr, ids2, ids2,
+                         name="examples/train_gpt2_sharded")
+    assert r.ok(), r.table()
+    denv.set_mesh(None)
+
+    # static_graph.py: captured Program replay (fc + fc + loss)
+    from paddle_tpu import static
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            xv = static.data("x", [None, 8], "float32")
+            yv = static.data("y", [None, 1], "float32")
+            h = static.nn.fc(xv, size=16)
+            pred = static.nn.fc(h, size=1)
+            paddle.mean(paddle.nn.functional.square_error_cost(pred, yv))
+        r = analysis.analyze(main, name="examples/static_graph")
+        assert r.ok(), r.table()
+    finally:
+        paddle.disable_static()

@@ -41,3 +41,15 @@ def test_dry_run_emits_metrics_summary():
     # on a jax without the knob the dry run records a clean no-op
     if out["compile_cache_enabled"]:
         assert out["compile_cache_entries"] > 0, out
+    # PR-3 static-analysis surface: the fit pre-flight plus the GPT-2/
+    # ResNet zoo steps ran the linter (>=3 analyze() runs), the zoo
+    # steps reported zero error-severity findings, the retrace-cause
+    # classifier populated dispatch/retrace_cause (tracing two networks
+    # guarantees per-op shape variety), and the repo self-lint is clean
+    assert out["analysis_runs"] >= 3, out
+    assert out["checks"]["zoo_steps_clean"] is True, out
+    assert out["checks"]["analysis_findings_counted"] is True, out
+    assert out["retrace_causes"].get("shape", 0) > 0, out
+    assert out["selflint_findings"] == 0, out
+    assert "analysis/findings" in res.stderr
+    assert "dispatch/retrace_cause" in res.stderr

@@ -175,6 +175,122 @@ def test_structured_layers():
     assert len(list(pl)) == 1
 
 
+# ---------------------------------------------------------------------------
+# value-pinned layers: numeric parity vs independent numpy references
+# (OpTest-style, r5 verdict item 6 — construct-and-forward smoke is not
+# enough for layers with nontrivial math)
+# ---------------------------------------------------------------------------
+
+def _np_group_norm(x, groups, eps, weight, bias):
+    n, c = x.shape[:2]
+    g = x.reshape(n, groups, c // groups, *x.shape[2:])
+    axes = tuple(range(2, g.ndim))
+    mean = g.mean(axis=axes, keepdims=True)
+    var = g.var(axis=axes, keepdims=True)
+    out = ((g - mean) / np.sqrt(var + eps)).reshape(x.shape)
+    shape = (1, c) + (1,) * (x.ndim - 2)
+    return out * weight.reshape(shape) + bias.reshape(shape)
+
+
+def test_group_norm_value():
+    paddle.seed(0)
+    x = t((2, 6, 5, 5), seed=3)
+    layer = nn.GroupNorm(num_groups=3, num_channels=6, epsilon=1e-5)
+    w = np.random.RandomState(4).randn(6).astype("float32")
+    b = np.random.RandomState(5).randn(6).astype("float32")
+    layer.set_state_dict({"weight": w, "bias": b})
+    ref = _np_group_norm(x.numpy(), 3, 1e-5, w, b)
+    np.testing.assert_allclose(layer(x).numpy(), ref,
+                               rtol=1e-5, atol=1e-5)
+
+
+def _np_lrn(x, size, alpha, beta, k):
+    """Cross-channel LRN: out = x / (k + alpha * sum_window(x^2))^beta
+    with the window centered per the framework's half = size//2 split."""
+    sq = np.square(x)
+    half = size // 2
+    c = x.shape[1]
+    pad = [(0, 0), (half, size - 1 - half)] + [(0, 0)] * (x.ndim - 2)
+    padded = np.pad(sq, pad)
+    acc = np.zeros_like(x)
+    for i in range(size):
+        acc = acc + padded[:, i:i + c]
+    return x / np.power(k + alpha * acc, beta)
+
+
+def test_local_response_norm_value():
+    x = t((2, 7, 4, 4), seed=6)
+    layer = nn.LocalResponseNorm(size=3, alpha=1e-3, beta=0.6, k=1.2)
+    ref = _np_lrn(x.numpy(), 3, 1e-3, 0.6, 1.2)
+    np.testing.assert_allclose(layer(x).numpy(), ref,
+                               rtol=1e-5, atol=1e-6)
+
+
+def _np_unfold(x, kh, kw, sh, sw):
+    """im2col, channel-major feature ordering (c, i, j), L = oh*ow."""
+    n, c, h, w = x.shape
+    oh = (h - kh) // sh + 1
+    ow = (w - kw) // sw + 1
+    cols = np.zeros((n, c, kh, kw, oh, ow), x.dtype)
+    for i in range(kh):
+        for j in range(kw):
+            cols[:, :, i, j] = x[:, :, i:i + sh * oh:sh, j:j + sw * ow:sw]
+    return cols.reshape(n, c * kh * kw, oh * ow)
+
+
+def test_unfold_value():
+    x = t((2, 3, 6, 5), seed=7)
+    out = nn.Unfold(kernel_sizes=[3, 2], strides=[2, 1])(x)
+    ref = _np_unfold(x.numpy(), 3, 2, 2, 1)
+    assert tuple(out.shape) == ref.shape
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-6, atol=1e-6)
+
+
+def _np_fold(cols, out_h, out_w, kh, kw):
+    """col2im: scatter-add the unfolded columns back (overlaps SUM)."""
+    n, ckk, L = cols.shape
+    c = ckk // (kh * kw)
+    oh = out_h - kh + 1
+    ow = out_w - kw + 1
+    assert L == oh * ow
+    cols = cols.reshape(n, c, kh, kw, oh, ow)
+    out = np.zeros((n, c, out_h, out_w), cols.dtype)
+    for i in range(kh):
+        for j in range(kw):
+            out[:, :, i:i + oh, j:j + ow] += cols[:, :, i, j]
+    return out
+
+
+def test_fold_value():
+    x = t((1, 2, 5, 4), seed=8)
+    cols = nn.Unfold(kernel_sizes=[2, 2])(x)
+    folded = nn.Fold(output_sizes=[5, 4], kernel_sizes=[2, 2])(cols)
+    ref = _np_fold(cols.numpy(), 5, 4, 2, 2)
+    np.testing.assert_allclose(folded.numpy(), ref, rtol=1e-6, atol=1e-6)
+    # interior pixels are covered by overlap-count patches: fold(unfold)
+    # equals x * coverage — pin the corner (coverage 1) exactly
+    np.testing.assert_allclose(folded.numpy()[:, :, 0, 0],
+                               x.numpy()[:, :, 0, 0], rtol=1e-6)
+
+
+def _np_pixel_shuffle(x, r):
+    n, c, h, w = x.shape
+    x = x.reshape(n, c // (r * r), r, r, h, w)
+    x = x.transpose(0, 1, 4, 2, 5, 3)
+    return x.reshape(n, c // (r * r), h * r, w * r)
+
+
+def test_pixel_shuffle_value():
+    x = t((2, 8, 3, 4), seed=9)
+    out = nn.PixelShuffle(upscale_factor=2)(x)
+    ref = _np_pixel_shuffle(x.numpy(), 2)
+    assert tuple(out.shape) == ref.shape
+    np.testing.assert_allclose(out.numpy(), ref, rtol=0, atol=0)
+    # round-trip through PixelUnshuffle restores the input bit-exactly
+    back = nn.PixelUnshuffle(downscale_factor=2)(out)
+    np.testing.assert_allclose(back.numpy(), x.numpy(), rtol=0, atol=0)
+
+
 def test_rnn_wrappers_and_sync_bn():
     paddle.seed(0)
     rnn = nn.SimpleRNN(4, 6)

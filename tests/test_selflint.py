@@ -1,0 +1,56 @@
+"""Repo self-lint (paddle_tpu/analysis/selflint.py) runs green as a
+tier-1 gate, and each AST rule provably catches its seeded violation —
+a lint that cannot fail is not a lint."""
+from paddle_tpu.analysis.selflint import lint_repo, lint_source
+
+
+def test_repo_is_lint_clean():
+    findings = lint_repo()
+    assert not findings, "\n".join(str(f) for f in findings)
+
+
+def test_device_get_rule():
+    src = "import jax\ndef f(x):\n    return jax.device_get(x)\n"
+    hot = lint_source("t.py", src, "framework/dispatch.py")
+    assert [f.rule for f in hot] == ["device-get-hot-path"]
+    assert hot[0].line == 3
+    # the same call OUTSIDE a hot-path module is a legitimate sync point
+    assert lint_source("t.py", src, "distributed/spmd.py") == []
+    # suppression comment with an adjacent justification is honored
+    sup = src.replace("jax.device_get(x)", "jax.device_get(x)  # lint: ok")
+    assert lint_source("t.py", sup, "framework/dispatch.py") == []
+
+
+def test_monitor_lock_rules():
+    out = lint_source(
+        "t.py", "from paddle_tpu.framework.monitor import _lock\n",
+        "hapi/model.py")
+    assert [f.rule for f in out] == ["monitor-lock-contract"]
+    # inside monitor.py: stat_add must stay lock-free
+    src = ("def stat_add(name, value=1):\n"
+           "    with _lock:\n        pass\n")
+    out = lint_source("t.py", src, "framework/monitor.py")
+    assert [f.rule for f in out] == ["monitor-lock-contract"]
+    # ...but other functions there may lock (readers do, by contract)
+    src_ok = ("def stat_get(name):\n"
+              "    with _lock:\n        return 0\n")
+    assert lint_source("t.py", src_ok, "framework/monitor.py") == []
+
+
+def test_asarray_rule():
+    src = (
+        "import numpy as np\n"
+        "from .registry import register_op\n"
+        "@register_op('foo')\n"
+        "def _foo(x):\n"
+        "    return np.asarray(x) + 1\n"          # flagged: jit op
+        "@register_op('bar', jit=False)\n"
+        "def _bar(x):\n"
+        "    return np.asarray(x) + 1\n"          # ok: host-side op
+        "@register_op('baz')\n"
+        "def _baz(x):\n"
+        "    def cb(x):\n"
+        "        return np.asarray(x)\n"          # ok: shadowed (callback)
+        "    return cb\n")
+    out = lint_source("t.py", src, "ops/foo_ops.py")
+    assert [(f.rule, f.line) for f in out] == [("asarray-on-traced", 5)]
