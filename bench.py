@@ -867,7 +867,12 @@ def dry_run():
     a ResNet-class donated train step are ``analyze()``d and must report
     ZERO error-severity findings, the repo self-lint (AST rules over
     paddle_tpu/) must be clean, and the ``analysis/*`` +
-    ``dispatch/retrace_cause`` counters must be populated. Prints the
+    ``dispatch/retrace_cause`` counters must be populated. PR-4
+    addition: a short continuous-batching serve over the tiny GPT
+    (paddle_tpu/serving/) must complete every request with live
+    ``serving/ttft_ms``/``serving/tokens_per_sec`` metrics, a
+    zero-error ``analyze()`` bill on the decode step, and exactly one
+    trace per capacity bucket. Prints the
     stats summary to stderr and ONE JSON line to stdout; exits nonzero
     when any assertion fails, so CI catches an instrumentation or
     fast-path regression before it costs a real benchmark round."""
@@ -958,6 +963,36 @@ def dry_run():
         gpt_report, resnet_report = _zoo_reports()
         lint_findings = analysis.lint_repo()
 
+        # serving canary (PR-4): a short continuous-batching run over a
+        # tiny GPT — every request completes, the serving/* metrics are
+        # live, the decode step carries a ZERO-error analysis bill
+        # (donation-safe, host-sync-free), and each capacity bucket
+        # traced exactly once (no retrace churn in the serve loop).
+        def _serving_canary():
+            from paddle_tpu.framework import trace_probe
+            from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining
+            from paddle_tpu.serving import GenerationEngine
+
+            paddle.framework.random.seed(0)
+            model = GPTForPretraining(GPTConfig.tiny())
+            model.eval()
+            eng = GenerationEngine(model, num_slots=4, max_len=48,
+                                   min_bucket=8)
+            prompts = [np.arange(1, 1 + n, dtype=np.int32)
+                       for n in (3, 9, 5, 12, 7, 4)]
+            handles = [eng.submit(p, max_new_tokens=5) for p in prompts]
+            done = [h.result(timeout=300) for h in handles]
+            report = eng.analyze()
+            eng.close()
+            sites = {k: v for k, v in trace_probe.snapshot().items()
+                     if k.startswith("serving/")}
+            one_trace = bool(sites) and all(
+                s["traces"] == 1 and not s["causes"]
+                for s in sites.values())
+            return len(done), report, one_trace
+
+        served, serving_report, serving_one_trace = _serving_canary()
+
     counters = monitor.all_stats()
     host_syncs = monitor.stat_get("hapi/host_sync")
     trace_path = os.path.join(tempfile.mkdtemp(prefix="paddle_dryrun_"),
@@ -1001,6 +1036,18 @@ def dry_run():
         "retrace_cause_recorded":
             monitor.stat_get("dispatch/retrace_cause") > 0,
         "selflint_clean": not lint_findings,
+        # PR-4 serving surface: the continuous batcher completed every
+        # canary request, its metrics are live, its decode step analyzes
+        # clean and each capacity bucket traced exactly once
+        "serving_completed":
+            served == 6 and monitor.stat_get("serving/completed") == 6,
+        "serving_counters_live":
+            monitor.stat_histogram("serving/ttft_ms") is not None
+            and monitor.stat_histogram("serving/tokens_per_sec")
+            is not None
+            and monitor.stat_get("serving/requests") == 6,
+        "serving_decode_clean": serving_report.ok(),
+        "serving_one_trace_per_bucket": serving_one_trace,
     }
     print(monitor.stats_summary(), file=sys.stderr)
     for f in lint_findings:
@@ -1008,6 +1055,8 @@ def dry_run():
     if not gpt_report.ok() or not resnet_report.ok():
         print(gpt_report.table(), file=sys.stderr)
         print(resnet_report.table(), file=sys.stderr)
+    if not serving_report.ok():
+        print(serving_report.table(), file=sys.stderr)
     ok = all(checks.values())
     print(json.dumps({"metric": "dry_run", "ok": ok,
                       "counters": len(counters),
@@ -1023,6 +1072,8 @@ def dry_run():
                           for k, v in counters.items()
                           if k.startswith("dispatch/retrace_cause/")},
                       "selflint_findings": len(lint_findings),
+                      "serving_requests":
+                          monitor.stat_get("serving/requests"),
                       "loss": round(float(loss), 4), "checks": checks}),
           flush=True)
     sys.exit(0 if ok else 1)

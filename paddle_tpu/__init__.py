@@ -54,7 +54,7 @@ for _m in ("autograd", "optimizer", "amp", "io", "metric", "static", "jit",
            "sparse", "text", "device", "quantization", "linalg", "fft",
            "signal", "regularizer", "sysconfig", "compat", "hub", "reader",
            "dataset", "onnx", "callbacks", "cost_model", "version",
-           "fluid", "analysis"):
+           "fluid", "analysis", "serving"):
     _mod = _import_if_built(_m)
     if _mod is not None:
         globals()[_m] = _mod

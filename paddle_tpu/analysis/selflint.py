@@ -18,6 +18,12 @@ Python cannot enforce (≙ the reference's tools/codestyle custom checks
   on an op argument concretizes a tracer: TracerArrayConversionError at
   best, a silent constant-bake at worst. Nested host-callback bodies
   (pure_callback closures) shadow the name and are exempt.
+* ``serving-host-sync`` — the continuous-batching decode loop
+  (``paddle_tpu/serving/``) must stay sync-free: ``jax.device_get``,
+  ``.block_until_ready()`` and ``.numpy()`` anywhere in the package are
+  a per-step device stall. The single argued exception is the windowed
+  token fetch (``serving/scheduler.py _fetch``), which carries the
+  suppression.
 
 Suppress a finding with a trailing ``# lint: ok`` comment on the line
 (used only where a human has argued the exception in an adjacent
@@ -157,8 +163,26 @@ def lint_source(path: str, source: str, relpath: str) -> List[LintFinding]:
     rel = relpath.replace(os.sep, "/")
     in_monitor = rel.endswith("framework/monitor.py")
     hot = any(rel.endswith(m) for m in HOT_PATH_MODULES)
+    # the serving PACKAGE only — inference/serving.py (the gather-and-run
+    # batcher) blocks its callers by design and is not in scope
+    in_serving = rel.startswith("serving/")
 
     for node in ast.walk(tree):
+        # rule: serving-host-sync (no host sync in the decode loop)
+        if in_serving and isinstance(node, ast.Call):
+            sync = None
+            if _is_jax_device_get(node):
+                sync = "jax.device_get"
+            elif isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in ("block_until_ready", "numpy"):
+                sync = f".{node.func.attr}()"
+            if sync and not _suppressed(lines, node.lineno):
+                findings.append(LintFinding(
+                    "serving-host-sync", path, node.lineno,
+                    f"{sync} in the serving package: the continuous-"
+                    f"batching decode loop must stay async — route "
+                    f"device reads through the single windowed fetch "
+                    f"(serving/scheduler.py _fetch)"))
         # rule: device-get-hot-path
         if hot and isinstance(node, ast.Call) and _is_jax_device_get(node) \
                 and not _suppressed(lines, node.lineno):

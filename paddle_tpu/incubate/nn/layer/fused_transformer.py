@@ -92,10 +92,20 @@ class FusedMultiHeadAttention(Layer):
         layout: cache [2, B, H, max_len, Dh]
         (fused_multi_transformer_op.cu:1). time_step=None is the context
         (prefill) stage — the prompt's K/V land at slots [0, S); an
-        int/Tensor time_step writes the chunk at [t, t+S) (S=1 is the
-        usual decode step). Queries attend causally to slots <= their
-        own, intersected with any caller attn_mask. Functional update:
-        the new cache is RETURNED, not aliased."""
+        int/Tensor SCALAR time_step writes the chunk at [t, t+S) (S=1 is
+        the usual decode step); a VECTOR time_step [B] is the
+        slot-indexed update for pooled decode: example b's chunk lands
+        at [t_b, t_b+S) with a per-row causal horizon, so sequences at
+        DIFFERENT positions decode in one batch. This is the
+        CacheKV-layout counterpart of the continuous-batching serving
+        engine's decode step (models/generation.py
+        ``build_slot_decode_fn``, which applies the same contract over
+        the pooled 6-D ``serving.KVCachePool`` layout) — the engine does
+        NOT call through here; both are pinned to ``generate()``'s
+        semantics by their own parity tests. Queries attend
+        causally to slots <= their own, intersected with any caller
+        attn_mask. Functional update: the new cache is RETURNED, not
+        aliased."""
         import jax.numpy as jnp
         from jax import lax
 
@@ -108,12 +118,16 @@ class FusedMultiHeadAttention(Layer):
                         jnp.swapaxes(v._data, 1, 2)]).astype(ckv.dtype)
         z = jnp.int32(0)
         s = q.shape[1]
+        b = q.shape[0]
         if time_step is None:                         # prefill
             start = 0
         else:
             ts = time_step._data if isinstance(time_step, Tensor) else \
                 time_step
             start = ts
+        if getattr(start, "ndim", 0) == 1:            # slot-indexed [B]
+            return self._slot_indexed_attention(q, kv, ckv, start,
+                                                attn_mask, max_len, s, b)
         if isinstance(start, (int, np.integer)):
             if int(start) + s > max_len:
                 raise ValueError(
@@ -144,6 +158,62 @@ class FusedMultiHeadAttention(Layer):
             mask = valid
         ckv = lax.dynamic_update_slice(ckv, kv, (z, z, z, pos, z))
         k_full = Tensor(jnp.swapaxes(ckv[0], 1, 2))   # [B, L, H, Dh]
+        v_full = Tensor(jnp.swapaxes(ckv[1], 1, 2))
+        out = F.scaled_dot_product_attention(
+            q, k_full, v_full, attn_mask=Tensor(mask))
+        return out, Tensor(ckv, stop_gradient=True)
+
+    def _slot_indexed_attention(self, q, kv, ckv, starts, attn_mask,
+                                max_len, s, b):
+        """Per-example time_step [B]: example b's S-chunk scatters to
+        time indices [starts[b], starts[b]+S) and its queries see slots
+        <= starts[b]+i. One trace serves every position mix (starts is
+        traced), which is what lets a continuous batcher decode
+        sequences of different lengths in one program. (The serving
+        engine itself implements this contract over its pooled layout in
+        ``build_slot_decode_fn``; this is the incubate-API twin.)"""
+        import jax.numpy as jnp
+
+        from ....framework.tensor import Tensor
+        starts = jnp.asarray(starts, jnp.int32).reshape(-1)
+        if starts.shape[0] != b:
+            raise ValueError(
+                f"vector time_step has {starts.shape[0]} entries for "
+                f"batch {b}")
+        tidx = starts[:, None] + jnp.arange(s)[None, :]        # [B, S]
+        # concrete starts get the same loud capacity check as the scalar
+        # path (an out-of-range scatter index silently DROPS the write);
+        # traced starts can't be inspected — their bound is the serving
+        # engine's admission contract
+        try:
+            hi = int(np.max(np.asarray(starts)))
+        except Exception:                               # noqa: BLE001
+            hi = None                                   # traced under jit
+        if hi is not None and hi + s > max_len:
+            raise ValueError(
+                f"time_step max {hi} + chunk {s} exceeds the cache "
+                f"capacity {max_len}")
+        # kv [2, B, H, S, Dh] -> scatter rows at [b, tidx[b, i]]
+        val = jnp.transpose(kv, (1, 3, 0, 2, 4))       # [B, S, 2, H, Dh]
+        ckv = ckv.at[:, jnp.arange(b)[:, None], :, tidx].set(val)
+        # query i of example b attends to slots <= starts[b] + i
+        valid = (jnp.arange(max_len)[None, None, :] <=
+                 tidx[:, :, None])[:, None]            # [B, 1, S, L]
+        if attn_mask is not None:
+            m = attn_mask._data if isinstance(attn_mask, Tensor) else \
+                jnp.asarray(attn_mask)
+            if m.shape[-1] not in (1, max_len):
+                raise ValueError(
+                    f"attn_mask last dim {m.shape[-1]} must equal the "
+                    f"cache capacity max_len={max_len} (or be 1 for a "
+                    f"per-query broadcast)")
+            if m.dtype == jnp.bool_:
+                mask = valid & m
+            else:
+                mask = jnp.where(valid, m.astype(jnp.float32), -1e30)
+        else:
+            mask = valid
+        k_full = Tensor(jnp.swapaxes(ckv[0], 1, 2))    # [B, L, H, Dh]
         v_full = Tensor(jnp.swapaxes(ckv[1], 1, 2))
         out = F.scaled_dot_product_attention(
             q, k_full, v_full, attn_mask=Tensor(mask))

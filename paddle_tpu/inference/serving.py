@@ -74,14 +74,22 @@ class BatchingEngine:
             raise req.error
         return req.result
 
-    def close(self):
+    def close(self, timeout: Optional[float] = None):
+        """Stop accepting work and DRAIN: the shutdown sentinel queues
+        BEHIND everything already submitted, so the worker serves every
+        in-flight request before exiting — close() is a graceful drain,
+        not an abandonment. Pass ``timeout`` (seconds) to bound the
+        wait; requests still pending past it (or left behind by a dead
+        worker) fail with a "closed" error instead of hanging their
+        callers forever."""
         with self._close_lock:
             if self._closed:
                 return
             self._closed = True
             self._queue.put(None)       # wake the worker
-        self._worker.join(timeout=5)
-        # fail anything the worker left behind (it exits at the sentinel)
+        self._worker.join(timeout)
+        # after an untimed join the queue holds nothing; with a timeout
+        # (or a dead worker) fail the leftovers so no caller hangs
         while True:
             try:
                 r = self._queue.get_nowait()
@@ -153,32 +161,47 @@ class BatchingEngine:
             b *= 2
         return b
 
+    def _serve(self, batch: List[_Request]) -> None:
+        """Pad one gathered batch to its pow2 bucket, run the predictor,
+        split the rows back per caller."""
+        n_inputs = len(batch[0].arrays)
+        rows = [r.arrays[0].shape[0] for r in batch]
+        total = sum(rows)
+        padded = self._bucket(total, self._max_batch)
+        feeds = []
+        for j in range(n_inputs):
+            stacked = np.concatenate([r.arrays[j] for r in batch])
+            if padded > total:
+                pad = np.repeat(stacked[-1:], padded - total, axis=0)
+                stacked = np.concatenate([stacked, pad])
+            feeds.append(stacked)
+        outs = self._predictor.run(feeds)
+        start = 0
+        for r, n in zip(batch, rows):
+            r.result = [o[start:start + n] for o in outs]
+            start += n
+            r.event.set()
+
     def _loop(self):
         while True:
             batch = self._gather()
             if batch is None:
                 return
             try:
-                n_inputs = len(batch[0].arrays)
-                rows = [r.arrays[0].shape[0] for r in batch]
-                total = sum(rows)
-                padded = self._bucket(total, self._max_batch)
-                feeds = []
-                for j in range(n_inputs):
-                    stacked = np.concatenate([r.arrays[j] for r in batch])
-                    if padded > total:
-                        pad = np.repeat(stacked[-1:], padded - total,
-                                        axis=0)
-                        stacked = np.concatenate([stacked, pad])
-                    feeds.append(stacked)
-                outs = self._predictor.run(feeds)
-                start = 0
-                for r, n in zip(batch, rows):
-                    r.result = [o[start:start + n] for o in outs]
-                    start += n
-                    r.event.set()
-            except Exception as e:                      # noqa: BLE001
+                self._serve(batch)
+            except Exception as batch_exc:              # noqa: BLE001
+                if len(batch) == 1:
+                    batch[0].error = batch_exc
+                    batch[0].event.set()
+                    continue
+                # one poisoned request must not fail its co-riders:
+                # retry each request as its own batch — the healthy ones
+                # succeed, only the poisoned one propagates its error
                 for r in batch:
-                    if not r.event.is_set():
+                    if r.event.is_set():
+                        continue
+                    try:
+                        self._serve([r])
+                    except Exception as e:              # noqa: BLE001
                         r.error = e
                         r.event.set()

@@ -28,7 +28,8 @@ import numpy as np
 from ..framework.tensor import Tensor, no_grad_guard
 
 __all__ = ["GenerationConfig", "generate", "save_for_serving",
-           "shard_params_megatron"]
+           "shard_params_megatron", "build_slot_prefill_fn",
+           "build_slot_decode_fn"]
 
 
 def shard_params_megatron(model, mesh, mp_axis="mp"):
@@ -368,6 +369,175 @@ def _build_beam_fn(model, batch, prompt_len, static_key):
         return out
 
     return jax.jit(fn)
+
+
+# ---------------------------------------------------------------------------
+# slot-pool step functions (the continuous-batching serving decode path;
+# consumed by paddle_tpu/serving/ — see serving/engine.py)
+# ---------------------------------------------------------------------------
+
+def build_slot_prefill_fn(model, bucket_len, max_len, top_k=0, top_p=1.0,
+                          probe=None):
+    """Build the per-bucket prefill step of the slot-based serving engine.
+
+    Returns ``fn(params, buffers, pool, ids, key_valid, slot, sample,
+    temperature, key) -> (pool, first_token, key)``:
+
+    * ``pool`` — the shared KV pool ``[layers, 2, slots, heads, max_len,
+      head_dim]`` (``serving.KVCachePool.data``); the new prompt's K/V
+      are written into row ``slot`` at time indices ``[0, bucket_len)``
+      with one ``dynamic_update_slice`` per layer (``slot`` is traced, so
+      ONE trace serves every slot);
+    * ``ids`` ``[1, bucket_len]`` int32 — the prompt LEFT-padded to the
+      capacity bucket; ``key_valid`` ``[1, bucket_len]`` bool marks the
+      real tokens (the exact ragged-prompt contract of ``generate``);
+    * ``sample``/``temperature`` are traced scalars: greedy and sampled
+      first-token picks share the single compiled program;
+    * the caller jits with ``donate_argnums`` on ``pool`` so the update
+      is in place.
+
+    ``probe`` is an optional ``framework.trace_probe`` site recorded at
+    trace time (the dispatch/retrace_cause idiom): one trace per
+    capacity bucket is this function's whole point, and the probe makes
+    a violation visible in the counters.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ..framework import trace_probe as _probe
+    from ..nn.layer.layers import functional_state
+
+    gpt = model.gpt if hasattr(model, "gpt") else model
+    Lb = int(bucket_len)
+    if Lb < 1:
+        raise ValueError(f"bucket_len must be >= 1, got {Lb}")
+    if Lb > int(max_len):
+        raise ValueError(f"bucket_len {Lb} exceeds pool max_len {max_len}")
+    if Lb > gpt.cfg.max_position_embeddings:
+        raise ValueError(
+            f"bucket_len {Lb} exceeds max_position_embeddings="
+            f"{gpt.cfg.max_position_embeddings}")
+    top_k = min(int(top_k), gpt.cfg.vocab_size)
+
+    def fn(params, buffers, pool, ids, key_valid, slot, sample,
+           temperature, key):
+        if probe is not None:  # runs at trace time only (jit caches)
+            probe.record(_probe.sig_of([pool, ids, key_valid]),
+                         {"bucket": Lb})
+        with functional_state(model, params, buffers):
+            with no_grad_guard():
+                caches = gpt.init_cache(1, Lb, pool.dtype)
+                hidden, caches = gpt.prefill(
+                    Tensor(ids, stop_gradient=True), caches,
+                    key_valid=key_valid)
+                logits = gpt.logits(hidden)._data[:, 0].astype(jnp.float32)
+                key, sub = jax.random.split(key)
+                greedy = _pick_token(logits, sub, False, top_k, top_p, 1.0)
+                sampled = _pick_token(logits, sub, True, top_k, top_p,
+                                      temperature)
+                first = jnp.where(sample, sampled, greedy)
+                z = jnp.int32(0)
+                s = jnp.asarray(slot, jnp.int32).reshape(())
+                new_pool = pool
+                for li, (ck, cv) in enumerate(caches):
+                    # ck/cv [1, Lb, H, Dh] -> the pool's [H, Lb, Dh] rows
+                    kvb = jnp.stack([jnp.swapaxes(ck[0], 0, 1),
+                                     jnp.swapaxes(cv[0], 0, 1)])
+                    new_pool = lax.dynamic_update_slice(
+                        new_pool, kvb[None, :, None].astype(new_pool.dtype),
+                        (jnp.int32(li), z, s, z, z, z))
+        return new_pool, first, key
+
+    return fn
+
+
+def build_slot_decode_fn(model, num_slots, max_len, top_k=0, top_p=1.0,
+                         probe=None):
+    """Build THE decode step of the slot-based serving engine: one jitted
+    program advancing every pool slot by one token per call.
+
+    Returns ``fn(params, buffers, pool, tokens, pos, lo, sample_mask,
+    temperature, key) -> (pool, next_tokens, key)`` over the shared KV
+    pool ``[layers, 2, slots, heads, max_len, head_dim]``:
+
+    * ``tokens`` ``[slots]`` int32 — each slot's last emitted token; its
+      K/V are written at cache index ``pos[slot]`` with a per-slot
+      scatter (slots at DIFFERENT positions decode together — the
+      continuous-batching core, the Ragged-Paged-Attention shape);
+    * ``lo`` ``[slots]`` int32 — first valid cache index per slot (the
+      left-pad offset of its capacity bucket): attention sees exactly
+      ``[lo, pos]``, and position embeddings count logical tokens
+      ``pos - lo``, matching ``generate``'s ragged-prompt semantics
+      token for token;
+    * ``sample_mask``/``temperature`` ``[slots]`` are traced, so mixed
+      greedy/sampled request batches share the ONE compiled program
+      (sampling reuses :func:`_pick_token`); inactive slots compute
+      garbage that the scheduler ignores and the next prefill
+      overwrites.
+
+    The caller jits with ``donate_argnums`` on ``pool``; the engine's
+    ``analyze()`` must report this program donation-safe and
+    host-sync-free (the PR-3 clean-bill contract).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..framework import trace_probe as _probe
+    from ..nn import functional as F
+    from ..nn.layer.layers import functional_state
+
+    gpt = model.gpt if hasattr(model, "gpt") else model
+    S = int(num_slots)
+    L = int(max_len)
+    if S < 1:
+        raise ValueError(f"num_slots must be >= 1, got {S}")
+    if L > gpt.cfg.max_position_embeddings:
+        raise ValueError(
+            f"max_len {L} exceeds max_position_embeddings="
+            f"{gpt.cfg.max_position_embeddings}")
+    top_k = min(int(top_k), gpt.cfg.vocab_size)
+
+    def fn(params, buffers, pool, tokens, pos, lo, sample_mask,
+           temperature, key):
+        if probe is not None:  # runs at trace time only (jit caches)
+            probe.record(_probe.sig_of([pool, tokens, pos, lo,
+                                        temperature]), {"slots": S})
+        with functional_state(model, params, buffers):
+            with no_grad_guard():
+                logical = (pos - lo)[:, None]
+                x = gpt.wte(Tensor(tokens[:, None], stop_gradient=True)) \
+                    + gpt.wpe(Tensor(logical))
+                r = jnp.arange(L)
+                key_valid = (r[None, :] >= lo[:, None]) \
+                    & (r[None, :] <= pos[:, None])
+                mask = Tensor(key_valid[:, None, None, :])
+                sl = jnp.arange(S)
+                new_pool = pool
+                for li, block in enumerate(gpt.blocks):
+                    q, k, v = block._qkv(x)
+                    kh = k._data[:, 0].astype(new_pool.dtype)  # [S, H, Dh]
+                    vh = v._data[:, 0].astype(new_pool.dtype)
+                    # per-slot scatter: slot i's row at time index pos[i]
+                    new_pool = new_pool.at[li, 0, sl, :, pos, :].set(kh)
+                    new_pool = new_pool.at[li, 1, sl, :, pos, :].set(vh)
+                    k_full = Tensor(jnp.swapaxes(new_pool[li, 0], 1, 2),
+                                    stop_gradient=True)  # [S, L, H, Dh]
+                    v_full = Tensor(jnp.swapaxes(new_pool[li, 1], 1, 2),
+                                    stop_gradient=True)
+                    a = F.scaled_dot_product_attention(
+                        q, k_full, v_full, attn_mask=mask)
+                    x = block._tail(x, a)
+                x = gpt.ln_f(x)
+                logits = gpt.logits(x)._data[:, 0].astype(jnp.float32)
+                key, sub = jax.random.split(key)
+                greedy = _pick_token(logits, sub, False, top_k, top_p, 1.0)
+                sampled = _pick_token(logits, sub, True, top_k, top_p,
+                                      temperature[:, None])
+                nxt = jnp.where(sample_mask, sampled, greedy)
+        return new_pool, nxt, key
+
+    return fn
 
 
 class _UnsetType:

@@ -1,0 +1,43 @@
+"""``paddle_tpu.serving`` — continuous-batching LLM serving (L9+).
+
+The autoregressive counterpart of ``inference.BatchingEngine``: where
+that engine gathers fixed-shape ``predictor.run`` calls, this one serves
+many concurrent ``generate``-style requests through ONE jitted, donated
+decode step over a slot-based KV-cache pool. Requests join and leave
+the in-flight batch EVERY step (continuous batching) instead of waiting
+for a whole generation to drain — a long request never stalls a short
+one, and a retired slot's capacity is reused mid-flight.
+
+Reference analog: the reference serves decoder LMs through
+fused_multi_transformer's fixed-capacity CacheKV
+(paddle/fluid/operators/fused/fused_multi_transformer_op.cu:1) behind
+AnalysisPredictor + paddle-serving request batching; the TPU-native
+collapse is slot-addressed decode over a shared pool (the Ragged Paged
+Attention shape, PAPERS.md) with XLA-donated in-place updates.
+
+::
+
+    from paddle_tpu.serving import GenerationEngine
+
+    engine = GenerationEngine(model, num_slots=8, max_len=256)
+    handle = engine.submit(prompt_ids, max_new_tokens=64,
+                           eos_token_id=eos)
+    for token in handle.stream():   # tokens as they are produced
+        ...
+    engine.close()                  # drains in-flight work
+
+Modules: :mod:`.kv_pool` (the pooled cache + slot allocator +
+capacity buckets), :mod:`.scheduler` (admission queue, backpressure,
+prefill-budget policy, the decode loop), :mod:`.engine` (the
+thread-safe user surface + monitor/profiler/analysis wiring).
+"""
+from __future__ import annotations
+
+from .engine import GenerationEngine  # noqa: F401
+from .kv_pool import KVCachePool  # noqa: F401
+from .scheduler import (DeadlineExceeded, GenerationRequest,  # noqa: F401
+                        QueueFullError, RequestCancelled, Scheduler)
+
+__all__ = ["GenerationEngine", "KVCachePool", "GenerationRequest",
+           "Scheduler", "QueueFullError", "DeadlineExceeded",
+           "RequestCancelled"]

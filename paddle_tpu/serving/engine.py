@@ -1,0 +1,248 @@
+"""``GenerationEngine`` — the user surface of the continuous-batching
+LLM server.
+
+Many concurrent ``submit(prompt_ids, ...)`` calls are served by ONE
+jitted, pool-donated decode step over a slot-based KV-cache pool
+(:mod:`.kv_pool`), driven by the prefill/decode scheduler
+(:mod:`.scheduler`). The serving-side twin of the PR-2 donated training
+loop: buffers are donated and rebound, the hot loop never syncs except
+the one windowed token fetch, and every step program must pass the PR-3
+analyzer clean (``engine.analyze()``).
+
+Compile discipline: the decode step traces ONCE per engine (slot count,
+pool shape and sampling support are static; per-request temperature and
+greedy/sampled choice are traced values), and prefill traces once per
+CAPACITY BUCKET (pow2 prompt lengths) — both watched by
+``framework.trace_probe`` sites (``serving/decode#N``,
+``serving/prefill[B]#N``), so a retrace shows up in the
+``dispatch/retrace_cause`` counters exactly like training-loop churn.
+
+Observability (PR-1 wiring): counters ``serving/requests``,
+``serving/completed``, ``serving/tokens``, ``serving/preempt``,
+``serving/queue_full``, ``serving/cancelled``,
+``serving/deadline_exceeded``; histograms ``serving/queue_depth``,
+``serving/active_slots``, ``serving/ttft_ms``,
+``serving/tokens_per_sec``; spans ``serving/prefill`` and
+``serving/decode_step``.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Iterator, Optional
+
+import numpy as np
+
+from ..framework import trace_probe as _probe
+from ..framework.monitor import stat_add
+from .kv_pool import KVCachePool
+from .scheduler import (GenerationRequest, Scheduler, _fetch)
+
+__all__ = ["GenerationEngine"]
+
+_engine_seq = 0
+_engine_seq_lock = threading.Lock()
+
+
+def _next_engine_id() -> int:
+    global _engine_seq
+    with _engine_seq_lock:
+        _engine_seq += 1
+        return _engine_seq
+
+
+class GenerationEngine:
+    """Continuous-batching autoregressive serving over a GPT-style model.
+
+    ``model`` is a ``models.GPTForPretraining`` / ``GPTModel`` (anything
+    exposing the ``gpt`` prefill/decode surface used by
+    ``models.generate``); its parameters are snapshotted at construction
+    (sharded parameters serve sharded — jit follows the placement).
+
+    * ``num_slots`` — concurrent in-flight requests (the pool's batch);
+    * ``max_len`` — per-slot cache capacity; a request needs
+      ``bucket(prompt) + max_new_tokens <= max_len``;
+    * ``top_k``/``top_p`` — the sampled path's truncation, STATIC per
+      engine (part of the single decode trace); per-request
+      ``do_sample``/``temperature`` are traced values;
+    * ``max_queue``/``prefill_budget`` — backpressure and the
+      anti-starvation admission policy (see :mod:`.scheduler`).
+
+    Greedy engine output is token-identical to ``models.generate`` run
+    per request (the parity contract, tests/test_serving_engine.py).
+    """
+
+    def __init__(self, model, num_slots: int = 8,
+                 max_len: Optional[int] = None, *, top_k: int = 0,
+                 top_p: float = 1.0, pad_token_id: int = 0,
+                 max_queue: int = 128, prefill_budget: Optional[int] = None,
+                 min_bucket: int = 8, seed: int = 0, dtype=None):
+        import jax
+
+        from ..models.generation import build_slot_decode_fn
+        from ..nn.layer.layers import get_buffers_tree, get_params_tree
+
+        gpt = model.gpt if hasattr(model, "gpt") else model
+        cfg = gpt.cfg
+        max_len = int(max_len or cfg.max_position_embeddings)
+        model.eval()                      # serving is inference-only
+        self._model = model
+        self._gpt = gpt
+        self._pad = int(pad_token_id)
+        self._top_k, self._top_p = int(top_k), float(top_p)
+        self._params = get_params_tree(model)
+        self._buffers = get_buffers_tree(model)
+        if dtype is None:
+            dtype = self._params[next(iter(self._params))].dtype
+        self._pool = KVCachePool(
+            cfg.num_hidden_layers, num_slots, cfg.num_attention_heads,
+            max_len, cfg.hidden_size // cfg.num_attention_heads,
+            dtype=dtype, min_bucket=min_bucket)
+        self._key = jax.random.PRNGKey(int(seed))
+        self._eid = _next_engine_id()
+        self._decode_probe = _probe.site(f"serving/decode#{self._eid}")
+        self._decode_jit = jax.jit(
+            build_slot_decode_fn(model, self._pool.num_slots, max_len,
+                                 top_k=self._top_k, top_p=self._top_p,
+                                 probe=self._decode_probe),
+            donate_argnums=(2,))
+        self._prefill_jits = {}           # bucket -> jitted prefill step
+        self._closed = False
+        self._close_lock = threading.Lock()
+        self._sched = Scheduler(self._pool, self._run_prefill,
+                                self._run_decode, max_queue=max_queue,
+                                prefill_budget=prefill_budget)
+
+    # -- client side -------------------------------------------------------
+    def submit(self, prompt_ids, max_new_tokens: int = 32, *,
+               do_sample: bool = False, temperature: float = 1.0,
+               eos_token_id: Optional[int] = None,
+               timeout: Optional[float] = None) -> GenerationRequest:
+        """Enqueue one generation; returns its handle immediately.
+
+        The handle streams tokens as they are produced
+        (``handle.stream()``), blocks for the padded full sequence
+        (``handle.result()``), and cancels mid-flight
+        (``handle.cancel()``). ``timeout`` (seconds) is a hard deadline:
+        a request that has not FINISHED by then fails with
+        ``DeadlineExceeded``. A full admission queue raises
+        ``QueueFullError`` here, synchronously."""
+        if self._closed:
+            raise RuntimeError("GenerationEngine is closed")
+        ids = np.asarray(prompt_ids, np.int32).reshape(-1)
+        if ids.size < 1:
+            raise ValueError("prompt_ids must contain at least one token")
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        bucket = self._pool.bucket_for(ids.size)
+        if bucket + int(max_new_tokens) > self._pool.max_len:
+            raise ValueError(
+                f"prompt bucket {bucket} + max_new_tokens "
+                f"{max_new_tokens} exceeds the pool capacity "
+                f"{self._pool.max_len}; shorten the request or build the "
+                f"engine with a larger max_len")
+        req = GenerationRequest(
+            ids, max_new_tokens, do_sample=do_sample,
+            temperature=temperature, eos_token_id=eos_token_id,
+            pad_token_id=self._pad, timeout=timeout)
+        handle = self._sched.submit(req)   # QueueFullError propagates
+        stat_add("serving/requests")       # counts ACCEPTED requests
+        return handle
+
+    def stream(self, prompt_ids, **kwargs) -> Iterator[int]:
+        """``submit(...).stream()`` in one call: an iterator of token
+        ids, yielded as each is produced."""
+        return self.submit(prompt_ids, **kwargs).stream()
+
+    def close(self, cancel_pending: bool = False) -> None:
+        """Graceful shutdown: stop accepting work, DRAIN everything
+        queued and in flight, then stop the scheduler thread. With
+        ``cancel_pending`` the queue is cancelled instead of served
+        (in-flight slots still finish)."""
+        with self._close_lock:
+            if self._closed:
+                return
+            self._closed = True
+        self._sched.close(cancel_pending=cancel_pending)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def num_slots(self) -> int:
+        return self._pool.num_slots
+
+    @property
+    def queue_depth(self) -> int:
+        return self._sched.queue_depth
+
+    @property
+    def active_requests(self) -> int:
+        return self._sched.active
+
+    def analyze(self, passes=None):
+        """PR-3 pre-flight of THE decode step: trace the jitted program
+        (donation contract auto-read from the pjit eqn) and run the
+        analysis pipeline. The clean-bill contract is zero
+        error-severity findings — donation-safe, no host sync in the
+        hot loop; asserted by ``bench.py --dry-run`` and the tier-1
+        tests. Tracing hits jit's signature cache, so this never
+        retraces (the probe counters stay honest)."""
+        from .. import analysis
+
+        S = self._pool.num_slots
+        return analysis.analyze(
+            self._decode_jit, self._params, self._buffers, self._pool.data,
+            np.zeros(S, np.int32), np.zeros(S, np.int32),
+            np.zeros(S, np.int32), np.zeros(S, bool),
+            np.ones(S, np.float32), self._key,
+            passes=passes, name=f"serving.decode[{S} slots]")
+
+    # -- device side (called from the scheduler thread only) ---------------
+    def _prefill_fn(self, bucket: int):
+        fn = self._prefill_jits.get(bucket)
+        if fn is None:
+            import jax
+
+            from ..models.generation import build_slot_prefill_fn
+            probe = _probe.site(f"serving/prefill[{bucket}]#{self._eid}")
+            fn = jax.jit(
+                build_slot_prefill_fn(self._model, bucket,
+                                      self._pool.max_len,
+                                      top_k=self._top_k,
+                                      top_p=self._top_p, probe=probe),
+                donate_argnums=(2,))
+            self._prefill_jits[bucket] = fn
+        return fn
+
+    def _run_prefill(self, req: GenerationRequest, slot: int,
+                     bucket: int) -> int:
+        ids = np.full((1, bucket), self._pad, np.int32)
+        ids[0, bucket - req.prompt.size:] = req.prompt
+        key_valid = np.zeros((1, bucket), bool)
+        key_valid[0, bucket - req.prompt.size:] = True
+        self._pool.data, first, self._key = self._prefill_fn(bucket)(
+            self._params, self._buffers, self._pool.data, ids, key_valid,
+            np.int32(slot), np.bool_(req.do_sample),
+            np.float32(req.temperature), self._key)
+        return int(_fetch(first)[0])
+
+    def _run_decode(self, slot_requests) -> np.ndarray:
+        S = self._pool.num_slots
+        tokens = np.zeros(S, np.int32)
+        sample_mask = np.zeros(S, bool)
+        temps = np.ones(S, np.float32)
+        for slot, req in slot_requests.items():
+            tokens[slot] = req.last_token
+            sample_mask[slot] = req.do_sample
+            temps[slot] = req.temperature
+        pos, lo = self._pool.position_arrays()
+        self._pool.data, nxt, self._key = self._decode_jit(
+            self._params, self._buffers, self._pool.data, tokens, pos, lo,
+            sample_mask, temps, self._key)
+        return _fetch(nxt)

@@ -1,0 +1,191 @@
+"""Slot-based KV-cache pool: the device-resident memory the continuous
+batcher schedules over.
+
+One array ``[layers, 2, slots, heads, max_len, head_dim]`` holds every
+in-flight request's KV cache — the pooled, slot-addressed form of the
+``FusedMultiHeadAttention._cached_attention`` CacheKV layout
+(``[2, B, H, max_len, Dh]`` per layer,
+incubate/nn/layer/fused_transformer.py), stacked over layers with the
+batch axis reinterpreted as SLOTS. A request owns a slot for exactly the
+steps it is decoding; the moment it finishes (EOS / budget / cancel /
+deadline) the slot returns to the free list and the NEXT admission's
+prefill overwrites it — capacity is reused mid-flight, which is the
+whole reason one long request cannot hold a batch hostage (the
+Ragged-Paged-Attention argument, PAPERS.md).
+
+Host-side bookkeeping lives here too: the free list, per-slot position
+tracking (``pos`` = cache index of the slot's last token, ``lo`` = first
+valid index, i.e. the left-pad offset of its admission bucket), and the
+CAPACITY BUCKETS — prompts are left-padded to power-of-two lengths so
+the prefill step traces once per bucket, never once per prompt length
+(the BatchingEngine pow2 argument, applied to sequence length).
+
+Threading contract: the pool is owned by the scheduler thread; ``alloc``
+/ ``free`` / ``set_slot`` are only called from it. ``data`` is rebound
+by the engine after every donated step (the old array is deleted by XLA
+— donation — so nothing else may hold it).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["KVCachePool"]
+
+
+class _Slot:
+    """Position state of one allocated slot (host ints, scheduler-owned)."""
+
+    __slots__ = ("pos", "lo")
+
+    def __init__(self, pos: int = 0, lo: int = 0):
+        self.pos = pos
+        self.lo = lo
+
+
+class KVCachePool:
+    """Fixed-capacity pooled KV cache + slot allocator.
+
+    ``data`` is the jnp array ``[layers, 2, slots, heads, max_len,
+    head_dim]``; the engine threads it through the donated prefill and
+    decode steps and rebinds it here. Everything else is host
+    bookkeeping: which slots are live, where each slot's sequence starts
+    (``lo``) and currently ends (``pos``).
+    """
+
+    def __init__(self, num_layers: int, num_slots: int, num_heads: int,
+                 max_len: int, head_dim: int, dtype="float32",
+                 min_bucket: int = 8):
+        import jax.numpy as jnp
+
+        if num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+        if min_bucket < 1:
+            raise ValueError(f"min_bucket must be >= 1, got {min_bucket}")
+        if max_len < min_bucket:
+            raise ValueError(
+                f"max_len={max_len} is below min_bucket={min_bucket}: no "
+                f"prompt could ever be admitted")
+        self.num_layers = int(num_layers)
+        self.num_slots = int(num_slots)
+        self.num_heads = int(num_heads)
+        self.max_len = int(max_len)
+        self.head_dim = int(head_dim)
+        self.min_bucket = int(min_bucket)
+        self.shape = (self.num_layers, 2, self.num_slots, self.num_heads,
+                      self.max_len, self.head_dim)
+        self.dtype = jnp.dtype(dtype)
+        self.data = jnp.zeros(self.shape, self.dtype)
+        # lowest-index-first keeps slot assignment deterministic (tests
+        # and trace/debug output stay stable across runs)
+        self._free: List[int] = list(range(self.num_slots))
+        self._slots: Dict[int, _Slot] = {}
+
+    # -- slot allocation ---------------------------------------------------
+    def alloc(self) -> Optional[int]:
+        """Claim the lowest free slot, or None when the pool is full."""
+        if not self._free:
+            return None
+        slot = min(self._free)
+        self._free.remove(slot)
+        self._slots[slot] = _Slot()
+        return slot
+
+    def free(self, slot: int) -> None:
+        """Return ``slot`` to the free list. Its device rows are NOT
+        cleared — the next occupant's prefill overwrites ``[0, bucket)``
+        and its decode mask never looks past ``pos``, so stale K/V are
+        unreachable by construction."""
+        if slot not in self._slots:
+            raise ValueError(f"slot {slot} is not allocated")
+        del self._slots[slot]
+        self._free.append(slot)
+
+    def is_allocated(self, slot: int) -> bool:
+        return slot in self._slots
+
+    def reset_data(self) -> None:
+        """Reallocate the device pool. The steps DONATE ``data``, so a
+        step that fails at XLA runtime may leave it already deleted —
+        serving on with the stale handle would fail every later step
+        with "Array has been deleted". Called by the scheduler's
+        failure path after the in-flight slots are failed and freed;
+        fresh zeros are safe because only live slots carry meaningful
+        cache rows and none survive the failure."""
+        import jax.numpy as jnp
+        self.data = jnp.zeros(self.shape, self.dtype)
+
+    @property
+    def n_active(self) -> int:
+        return len(self._slots)
+
+    @property
+    def n_free(self) -> int:
+        return len(self._free)
+
+    def active_slots(self) -> List[int]:
+        return sorted(self._slots)
+
+    # -- per-slot position tracking ---------------------------------------
+    def set_slot(self, slot: int, *, pos: int, lo: int) -> None:
+        st = self._slots[slot]
+        if not 0 <= lo <= pos < self.max_len:
+            raise ValueError(
+                f"slot {slot}: bad position state lo={lo} pos={pos} "
+                f"(max_len={self.max_len})")
+        st.pos = int(pos)
+        st.lo = int(lo)
+
+    def advance(self, slot: int) -> int:
+        """One decode step happened: the slot's last token now sits one
+        cache index later. Returns the new ``pos``."""
+        st = self._slots[slot]
+        st.pos += 1
+        if st.pos >= self.max_len:
+            raise RuntimeError(
+                f"slot {slot} overran the cache capacity {self.max_len} — "
+                f"the admission check (bucket + max_new <= max_len) is "
+                f"broken")
+        return st.pos
+
+    def slot_pos(self, slot: int) -> int:
+        return self._slots[slot].pos
+
+    def slot_lo(self, slot: int) -> int:
+        return self._slots[slot].lo
+
+    def position_arrays(self):
+        """(tokens-independent) dense ``pos``/``lo`` int32 arrays over ALL
+        slots for the decode step; free slots read 0 — they compute
+        garbage the scheduler ignores and the next prefill overwrites."""
+        pos = np.zeros(self.num_slots, np.int32)
+        lo = np.zeros(self.num_slots, np.int32)
+        for slot, st in self._slots.items():
+            pos[slot] = st.pos
+            lo[slot] = st.lo
+        return pos, lo
+
+    # -- capacity buckets --------------------------------------------------
+    def bucket_for(self, prompt_len: int) -> int:
+        """The capacity bucket of a prompt: next power of two >=
+        ``prompt_len`` (floored at ``min_bucket``) — ONE prefill trace
+        per bucket, O(log max_len) buckets total."""
+        if prompt_len < 1:
+            raise ValueError(f"prompt_len must be >= 1, got {prompt_len}")
+        b = self.min_bucket
+        while b < prompt_len:
+            b *= 2
+        return b
+
+    def buckets(self) -> List[int]:
+        """Every admissible bucket size (pow2 from min_bucket to max_len)."""
+        out, b = [], self.min_bucket
+        while b <= self.max_len:
+            out.append(b)
+            b *= 2
+        return out
+
+    def __repr__(self):
+        return (f"<KVCachePool {self.shape} {self.data.dtype} "
+                f"active={self.n_active}/{self.num_slots}>")

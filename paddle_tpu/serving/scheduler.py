@@ -1,0 +1,419 @@
+"""Continuous-batching scheduler: admit, decode, retire — every step.
+
+The loop at the heart of ``GenerationEngine``. Unlike the gather-and-run
+``inference.BatchingEngine`` (whole batch enters and leaves together),
+membership of the in-flight batch changes EVERY step:
+
+* **admit** — pop FCFS from the bounded admission queue into free pool
+  slots, one prefill per admitted request, under a PREFILL BUDGET
+  (tokens per cycle): a burst of long prompts may not starve the slots
+  already decoding — when the budget is spent the remaining queue waits
+  one decode step (counted as ``serving/preempt``);
+* **decode** — ONE jitted, pool-donated step advances every active slot
+  by one token (inactive slots compute garbage nobody reads); the
+  single host fetch per cycle delivers each new token to its stream;
+* **retire** — finished (EOS / token budget), cancelled and
+  deadline-expired slots are freed IMMEDIATELY, so their capacity is
+  reused by the very next admit — mid-flight, not at batch end.
+
+Backpressure is explicit: a full queue raises :class:`QueueFullError`
+in ``submit`` (the caller sheds load, nothing queues unboundedly), and
+a per-request deadline turns into :class:`DeadlineExceeded` whether the
+request is still queued or already decoding.
+
+Threading contract: ``submit``/``cancel`` may be called from any
+thread; the loop body, the pool, and all slot state belong to the
+scheduler thread alone. The ONLY device→host sync in the loop is
+:func:`_fetch` below — everything else stays async (enforced by the
+``serving-host-sync`` self-lint rule over this package).
+"""
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..framework.monitor import stat_add, stat_observe
+from ..profiler import span as _prof
+
+__all__ = ["QueueFullError", "DeadlineExceeded", "RequestCancelled",
+           "GenerationRequest", "Scheduler"]
+
+
+class QueueFullError(RuntimeError):
+    """The admission queue is at capacity — shed load and retry later."""
+
+
+class DeadlineExceeded(TimeoutError):
+    """The request's deadline passed before it finished (it may have
+    produced some tokens first — they were streamed)."""
+
+
+class RequestCancelled(RuntimeError):
+    """The request was cancelled via ``GenerationRequest.cancel()``."""
+
+
+_DONE = object()          # stream terminator sentinel
+
+
+def _fetch(device_array):
+    """THE one device→host sync of the serving loop: one fetch per decode
+    cycle (a batch of tokens), one per prefill (the first token). Every
+    other transfer in this package is host→device and async. The rule
+    below is the package-wide lint (analysis/selflint.py
+    ``serving-host-sync``); this call site is the argued exception."""
+    import jax
+    return np.asarray(jax.device_get(device_array))  # lint: ok
+
+
+class GenerationRequest:
+    """One submitted generation: the scheduler's work item AND the
+    caller's handle (``stream()`` / ``result()`` / ``cancel()``).
+
+    Caller-side API is thread-safe; the mutable decode state
+    (``emitted``, ``last_token``) belongs to the scheduler thread.
+    """
+
+    _ids = itertools.count()
+
+    def __init__(self, prompt: np.ndarray, max_new_tokens: int, *,
+                 do_sample: bool = False, temperature: float = 1.0,
+                 eos_token_id: Optional[int] = None, pad_token_id: int = 0,
+                 timeout: Optional[float] = None):
+        self.id = next(self._ids)
+        self.prompt = np.asarray(prompt, np.int32).reshape(-1)
+        self.max_new_tokens = int(max_new_tokens)
+        self.do_sample = bool(do_sample)
+        self.temperature = float(temperature)
+        self.eos_token_id = None if eos_token_id is None \
+            else int(eos_token_id)
+        self.pad_token_id = int(pad_token_id)
+        self.submitted_at = time.perf_counter()
+        self.deadline = None if timeout is None \
+            else self.submitted_at + float(timeout)
+        # scheduler-side decode state
+        self.tokens: List[int] = []     # generated so far (incl. EOS)
+        self.emitted = 0
+        self.last_token: Optional[int] = None
+        self.first_token_at: Optional[float] = None
+        # caller-side plumbing
+        self._q: "queue.Queue" = queue.Queue()
+        self._done = threading.Event()
+        self.error: Optional[BaseException] = None
+        self._cancelled = False
+
+    # -- caller side -------------------------------------------------------
+    def cancel(self) -> None:
+        """Ask the scheduler to drop this request; queued requests are
+        rejected at admission, active ones retire at the next decode
+        cycle. Already-finished requests are unaffected."""
+        self._cancelled = True
+
+    @property
+    def cancelled(self) -> bool:
+        return self._cancelled and not self._done.is_set()
+
+    def stream(self):
+        """Iterator of generated token ids, yielded as each is produced
+        (the first right after prefill). Raises the terminal error
+        (:class:`RequestCancelled` / :class:`DeadlineExceeded`) after
+        any tokens produced before it."""
+        while True:
+            item = self._q.get()
+            if item is _DONE:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        """Block until the request finishes; returns the full sequence
+        ``[prompt_len + max_new_tokens]`` int32 with post-EOS positions
+        filled with ``pad_token_id`` — exactly ``models.generate``'s
+        output row for this request."""
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"request {self.id} not finished within {timeout}s")
+        if self.error is not None:
+            raise self.error
+        pad = self.max_new_tokens - len(self.tokens)
+        return np.concatenate([
+            self.prompt, np.asarray(self.tokens, np.int32),
+            np.full(pad, self.pad_token_id, np.int32)])
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    # -- scheduler side ----------------------------------------------------
+    def expired(self, now: Optional[float] = None) -> bool:
+        return self.deadline is not None \
+            and (now or time.perf_counter()) > self.deadline
+
+    def _emit(self, tok: int) -> None:
+        if self.first_token_at is None:
+            self.first_token_at = time.perf_counter()
+            stat_observe("serving/ttft_ms",
+                         (self.first_token_at - self.submitted_at) * 1e3)
+        self.tokens.append(tok)
+        self.emitted += 1
+        self.last_token = tok
+        self._q.put(tok)
+
+    def _finish(self, error: Optional[BaseException] = None) -> None:
+        self.error = error
+        self._done.set()
+        self._q.put(error if error is not None else _DONE)
+
+    def __repr__(self):
+        return (f"<GenerationRequest #{self.id} prompt={len(self.prompt)} "
+                f"max_new={self.max_new_tokens} emitted={self.emitted}>")
+
+
+class Scheduler:
+    """The continuous-batching loop over a :class:`~.kv_pool.KVCachePool`.
+
+    Device work is delegated to two engine-provided callables so the
+    policy here stays host-pure and unit-testable:
+
+    * ``do_prefill(request, slot, bucket) -> first_token`` — run the
+      bucket's prefill step, write the slot, return the first token;
+    * ``do_decode(slot_requests) -> np.ndarray [num_slots]`` — run the
+      shared decode step, return every slot's next token (garbage for
+      inactive slots).
+    """
+
+    def __init__(self, pool, do_prefill: Callable, do_decode: Callable, *,
+                 max_queue: int = 128, prefill_budget: Optional[int] = None):
+        if max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        self._pool = pool
+        self._do_prefill = do_prefill
+        self._do_decode = do_decode
+        self._max_queue = int(max_queue)
+        # tokens of prefill allowed per cycle WHILE slots are decoding
+        # (with an idle pool admission is unthrottled — there is nothing
+        # to starve). A budget below the head's bucket cannot deadlock:
+        # once the active slots drain, the idle-pool path admits it.
+        self._prefill_budget = int(prefill_budget or pool.max_len)
+        if self._prefill_budget < 1:
+            raise ValueError(
+                f"prefill_budget must be >= 1, got {self._prefill_budget}")
+        self._queue: List[GenerationRequest] = []
+        self._slots: Dict[int, GenerationRequest] = {}
+        self._cond = threading.Condition()
+        self._closing = False
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="paddle-serving-scheduler")
+        self._thread.start()
+
+    # -- producer side -----------------------------------------------------
+    def submit(self, req: GenerationRequest) -> GenerationRequest:
+        with self._cond:
+            if self._closing:
+                raise RuntimeError("GenerationEngine is closed")
+            if len(self._queue) >= self._max_queue:
+                stat_add("serving/queue_full")
+                raise QueueFullError(
+                    f"admission queue is full ({self._max_queue} "
+                    f"requests); retry after in-flight work drains")
+            self._queue.append(req)
+            stat_observe("serving/queue_depth", len(self._queue))
+            self._cond.notify_all()
+        return req
+
+    def close(self, cancel_pending: bool = False) -> None:
+        """Stop accepting work and DRAIN: every queued and in-flight
+        request runs to completion before the loop exits (with
+        ``cancel_pending`` queued requests are cancelled instead —
+        in-flight slots still finish)."""
+        with self._cond:
+            if self._closing and not self._thread.is_alive():
+                return
+            self._closing = True
+            if cancel_pending:
+                for r in self._queue:
+                    r.cancel()
+            self._cond.notify_all()
+        self._thread.join()
+
+    @property
+    def queue_depth(self) -> int:
+        with self._cond:
+            return len(self._queue)
+
+    @property
+    def active(self) -> int:
+        return len(self._slots)
+
+    # -- scheduler thread --------------------------------------------------
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                while not self._closing and not self._queue \
+                        and not self._slots:
+                    self._cond.wait()
+                if self._closing and not self._queue and not self._slots:
+                    return
+            try:
+                self._admit()
+                if self._slots:
+                    self._decode_cycle()
+            except Exception as e:                      # noqa: BLE001
+                # a step failure (OOM, bad artifact) poisons the affected
+                # requests, never the loop: fail everything in flight and
+                # keep serving — the BatchingEngine worker-survival rule
+                self._fail_inflight(e)
+
+    def _fail_inflight(self, error: BaseException) -> None:
+        for slot in list(self._slots):
+            req = self._slots.pop(slot)
+            self._pool.free(slot)
+            req._finish(RuntimeError(
+                f"serving step failed for request {req.id}: {error!r}"))
+        # the steps DONATE the pool buffer, so a step that failed at XLA
+        # runtime may have left pool.data already deleted — reallocate
+        # before serving on, or every later step dies on the stale handle
+        self._pool.reset_data()
+
+    def _sweep_queue(self) -> None:
+        """Resolve terminal (cancelled / deadline-expired) entries
+        ANYWHERE in the queue, not just at the head: a dead request
+        behind a slot-starved head must fail its caller NOW, not when
+        its turn finally comes, and must stop holding ``max_queue``
+        capacity. Terminal entries are removed, so live-request FCFS
+        order is untouched."""
+        now = time.perf_counter()
+        with self._cond:
+            live = []
+            for r in self._queue:
+                if r.cancelled:
+                    stat_add("serving/cancelled")
+                    r._finish(RequestCancelled(
+                        f"request {r.id} cancelled while queued"))
+                elif r.expired(now):
+                    stat_add("serving/deadline_exceeded")
+                    r._finish(DeadlineExceeded(
+                        f"request {r.id} exceeded its deadline while "
+                        f"queued"))
+                else:
+                    live.append(r)
+            if len(live) != len(self._queue):
+                self._queue[:] = live
+                stat_observe("serving/queue_depth", len(live))
+
+    # admission: FCFS with a prefill budget
+    def _admit(self) -> None:
+        self._sweep_queue()
+        decode_waiting = bool(self._slots)
+        budget = self._prefill_budget
+        while True:
+            with self._cond:
+                if not self._queue:
+                    return
+                req = self._queue[0]
+                # re-check the head: cancel/expiry may race the sweep
+                if req.cancelled:
+                    self._queue.pop(0)
+                    stat_add("serving/cancelled")
+                    req._finish(RequestCancelled(
+                        f"request {req.id} cancelled while queued"))
+                    continue
+                if req.expired():
+                    self._queue.pop(0)
+                    stat_add("serving/deadline_exceeded")
+                    req._finish(DeadlineExceeded(
+                        f"request {req.id} exceeded its deadline while "
+                        f"queued"))
+                    continue
+                bucket = self._pool.bucket_for(len(req.prompt))
+                if decode_waiting and budget < bucket:
+                    # budget spent: decode the active slots first; the
+                    # queue keeps its place (FCFS) and is retried next
+                    # cycle. This is the anti-starvation preemption.
+                    stat_add("serving/preempt")
+                    return
+                slot = self._pool.alloc()
+                if slot is None:
+                    return              # pool full: decode will retire
+                self._queue.pop(0)
+                stat_observe("serving/queue_depth", len(self._queue))
+            budget -= bucket
+            try:
+                self._prefill(req, slot, bucket)
+            except Exception as exc:                    # noqa: BLE001
+                # at this point the request is in neither queue nor
+                # slots: fail it HERE (or its caller hangs forever) and
+                # reclaim the slot, then let the loop's handler fail the
+                # other in-flight slots and reset the donated pool
+                self._slots.pop(slot, None)
+                if self._pool.is_allocated(slot):
+                    self._pool.free(slot)
+                if not req.done():
+                    req._finish(RuntimeError(
+                        f"serving step failed for request {req.id}: "
+                        f"{exc!r}"))
+                raise
+
+    def _prefill(self, req: GenerationRequest, slot: int,
+                 bucket: int) -> None:
+        with _prof.record("serving/prefill", "serving",
+                          args={"bucket": bucket, "slot": slot}):
+            first = int(self._do_prefill(req, slot, bucket))
+        stat_add("serving/prefill_tokens", bucket)
+        # first generated token sits at cache index `bucket`; the slot's
+        # valid keys start past the bucket's left pad
+        self._pool.set_slot(slot, pos=bucket,
+                            lo=bucket - len(req.prompt))
+        self._slots[slot] = req
+        req._emit(first)
+        stat_add("serving/tokens")
+        if self._finished(req, first):
+            self._retire(slot)
+
+    def _finished(self, req: GenerationRequest, tok: int) -> bool:
+        return (req.eos_token_id is not None and tok == req.eos_token_id) \
+            or req.emitted >= req.max_new_tokens
+
+    def _retire(self, slot: int,
+                error: Optional[BaseException] = None) -> None:
+        req = self._slots.pop(slot)
+        self._pool.free(slot)
+        if error is None:
+            stat_add("serving/completed")
+        req._finish(error)
+
+    def _decode_cycle(self) -> None:
+        active = dict(self._slots)
+        t0 = time.perf_counter()
+        with _prof.record("serving/decode_step", "serving",
+                          args={"active": len(active)}):
+            toks = self._do_decode(active)
+        dt = time.perf_counter() - t0
+        stat_observe("serving/active_slots", len(active))
+        emitted = 0
+        now = time.perf_counter()
+        for slot, req in active.items():
+            self._pool.advance(slot)
+            if req.cancelled:
+                stat_add("serving/cancelled")
+                self._retire(slot, RequestCancelled(
+                    f"request {req.id} cancelled mid-generation"))
+                continue
+            if req.expired(now):
+                stat_add("serving/deadline_exceeded")
+                self._retire(slot, DeadlineExceeded(
+                    f"request {req.id} exceeded its deadline after "
+                    f"{req.emitted} token(s)"))
+                continue
+            tok = int(toks[slot])
+            req._emit(tok)
+            emitted += 1
+            if self._finished(req, tok):
+                self._retire(slot)
+        stat_add("serving/tokens", emitted)
+        if dt > 0:
+            stat_observe("serving/tokens_per_sec", emitted / dt)

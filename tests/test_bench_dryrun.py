@@ -53,3 +53,13 @@ def test_dry_run_emits_metrics_summary():
     assert out["selflint_findings"] == 0, out
     assert "analysis/findings" in res.stderr
     assert "dispatch/retrace_cause" in res.stderr
+    # PR-4 serving surface: the continuous-batching canary completed,
+    # its metrics are live, the decode step analyzed clean and each
+    # capacity bucket traced exactly once
+    assert out["serving_requests"] == 6, out
+    assert out["checks"]["serving_completed"] is True, out
+    assert out["checks"]["serving_counters_live"] is True, out
+    assert out["checks"]["serving_decode_clean"] is True, out
+    assert out["checks"]["serving_one_trace_per_bucket"] is True, out
+    assert "serving/ttft_ms" in res.stderr
+    assert "serving/tokens_per_sec" in res.stderr

@@ -39,6 +39,15 @@ def test_serve_example(tmp_path):
     assert "16 concurrent requests" in out
 
 
+def test_serve_gpt2_example(tmp_path):
+    out = _run([os.path.join(REPO, "examples", "serve_gpt2.py"),
+                "--clients", "10", "--slots", "4", "--train-steps", "20"],
+               tmp_path, timeout=600)
+    assert "served 10 requests" in out
+    assert "aggregate" in out and "tokens/s" in out
+    assert "ttft p50" in out
+
+
 def test_generate_text_example(tmp_path):
     out = _run([os.path.join(REPO, "examples", "generate_text.py")],
                tmp_path, timeout=600)

@@ -138,3 +138,75 @@ class TestEngineRobustness:
             eng.infer(np.ones((n, 2), "float32"))
         eng.close()
         assert pred.batches == [64, 64]   # one compile bucket, not two
+
+    def test_poisoned_request_does_not_fail_its_batch(self):
+        """One request the predictor chokes on must fail ALONE: its
+        co-riders are retried as singles and succeed."""
+
+        class _NaNAllergic:
+            def __init__(self):
+                self.calls = []
+
+            def run(self, feeds):
+                self.calls.append(feeds[0].shape[0])
+                if np.isnan(feeds[0]).any():
+                    raise RuntimeError("poisoned input")
+                return [feeds[0] * 2.0]
+
+        pred = _NaNAllergic()
+        eng = BatchingEngine(pred, max_batch_size=16, max_delay_ms=100)
+        results, errors = {}, {}
+        barrier = threading.Barrier(4)
+
+        def client(i):
+            x = np.full((1, 4), float(i), "float32")
+            if i == 2:
+                x[:] = np.nan            # the poisoned rider
+            barrier.wait()               # force one gathered batch
+            try:
+                (out,) = eng.infer(x)
+                results[i] = out
+            except RuntimeError as e:
+                errors[i] = e
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        eng.close()
+        assert set(errors) == {2}
+        assert "poisoned" in str(errors[2])
+        for i in (0, 1, 3):
+            np.testing.assert_allclose(results[i], 2.0 * i)
+
+    def test_close_drains_in_flight_requests(self):
+        """close() must serve everything already submitted, not abandon
+        it — the sentinel queues behind the work."""
+
+        class _Slow:
+            def run(self, feeds):
+                import time
+                time.sleep(0.15)
+                return [feeds[0] * 2.0]
+
+        eng = BatchingEngine(_Slow(), max_batch_size=1, max_delay_ms=0)
+        results = {}
+
+        def client(i):
+            (out,) = eng.infer(np.full((1, 2), float(i), "float32"))
+            results[i] = out
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(3)]
+        for t in threads:
+            t.start()
+        import time
+        time.sleep(0.05)         # requests are queued, first is running
+        eng.close()              # untimed close = graceful drain
+        for t in threads:
+            t.join()
+        assert len(results) == 3
+        for i in range(3):
+            np.testing.assert_allclose(results[i], 2.0 * i)

@@ -292,6 +292,57 @@ class TestFusedTransformerLayers:
         np.testing.assert_allclose(chunk.numpy()[:, 1], s5.numpy()[:, 0],
                                    rtol=1e-4, atol=1e-5)
 
+    def test_slot_indexed_decode_matches_per_example_scalar(self):
+        """Vector time_step [B] (the serving-pool slot update): a batch
+        of sequences at DIFFERENT positions decoded in one call must
+        equal per-example scalar time_step calls — the contract the
+        continuous batcher (paddle_tpu/serving/) is built on."""
+        import numpy as np
+        from paddle_tpu.incubate.nn import FusedMultiHeadAttention
+        paddle.framework.random.seed(47)
+        B, E, H, L = 3, 16, 4, 12
+        mha = FusedMultiHeadAttention(E, H, dropout_rate=0.0,
+                                      attn_dropout_rate=0.0,
+                                      normalize_before=True)
+        mha.eval()
+        rng = np.random.RandomState(3)
+        starts = np.array([2, 5, 0], np.int32)
+        x = rng.randn(B, 1, E).astype(np.float32)
+        seed = rng.randn(2, B, H, L, E // H).astype(np.float32)
+        outs, caches = [], []
+        for i in range(B):                 # oracle: scalar calls on B=1
+            o, c = mha(paddle.to_tensor(x[i:i + 1]),
+                       cache=paddle.to_tensor(seed[:, i:i + 1].copy()),
+                       time_step=int(starts[i]))
+            outs.append(o.numpy())
+            caches.append(c.numpy())
+        o2, c2 = mha(paddle.to_tensor(x),
+                     cache=paddle.to_tensor(seed.copy()),
+                     time_step=paddle.to_tensor(starts))
+        np.testing.assert_allclose(o2.numpy(), np.concatenate(outs),
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(c2.numpy(),
+                                   np.concatenate(caches, axis=1),
+                                   rtol=1e-6, atol=1e-6)
+        # same loud capacity check as the scalar path on concrete starts
+        import pytest as _pytest
+        with _pytest.raises(ValueError, match="capacity"):
+            mha(paddle.to_tensor(x), cache=paddle.to_tensor(seed.copy()),
+                time_step=paddle.to_tensor(np.array([2, 12, 0], np.int32)))
+        with _pytest.raises(ValueError, match="entries for"):
+            mha(paddle.to_tensor(x), cache=paddle.to_tensor(seed.copy()),
+                time_step=paddle.to_tensor(np.array([2, 5], np.int32)))
+        # traced starts (under jit) compile and match
+        import jax
+        def step(ck, xx, ts):
+            o, c = mha(paddle.to_tensor(xx), cache=paddle.to_tensor(ck),
+                       time_step=paddle.to_tensor(ts))
+            return c._data
+        out = jax.jit(step)(seed.copy(), x, starts)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.concatenate(caches, axis=1),
+                                   rtol=1e-6, atol=1e-6)
+
     def test_fused_mha_shapes_and_train(self):
         from paddle_tpu.incubate.nn import FusedMultiHeadAttention
         paddle.framework.random.seed(40)

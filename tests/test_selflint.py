@@ -37,6 +37,25 @@ def test_monitor_lock_rules():
     assert lint_source("t.py", src_ok, "framework/monitor.py") == []
 
 
+def test_serving_host_sync_rule():
+    src = ("import jax\n"
+           "def loop(x):\n"
+           "    a = jax.device_get(x)\n"          # flagged
+           "    b = x.numpy()\n"                  # flagged
+           "    c = x.block_until_ready()\n"      # flagged
+           "    return a, b, c\n")
+    out = lint_source("t.py", src, "serving/scheduler.py")
+    assert [f.rule for f in out] == ["serving-host-sync"] * 3
+    assert [f.line for f in out] == [3, 4, 5]
+    # the same calls OUTSIDE the serving package are unflagged (the
+    # gather-and-run batcher in inference/serving.py blocks by design)
+    assert lint_source("t.py", src, "inference/serving.py") == []
+    # the windowed-fetch exception is suppressible
+    sup = src.replace("jax.device_get(x)", "jax.device_get(x)  # lint: ok")
+    out = lint_source("t.py", sup, "serving/engine.py")
+    assert [f.line for f in out] == [4, 5]
+
+
 def test_asarray_rule():
     src = (
         "import numpy as np\n"
