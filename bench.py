@@ -872,7 +872,11 @@ def dry_run():
     (paddle_tpu/serving/) must complete every request with live
     ``serving/ttft_ms``/``serving/tokens_per_sec`` metrics, a
     zero-error ``analyze()`` bill on the decode step, and exactly one
-    trace per capacity bucket. Prints the
+    trace per capacity bucket. PR-5 addition: the same contract for the
+    PAGED engine (block pool + page tables + prefix cache) — mixed
+    lengths all complete, a repeated system prompt scores
+    ``serving/prefix_hit`` with prefill tokens saved, and each
+    prefill/table bucket traces once. Prints the
     stats summary to stderr and ONE JSON line to stdout; exits nonzero
     when any assertion fails, so CI catches an instrumentation or
     fast-path regression before it costs a real benchmark round."""
@@ -989,9 +993,55 @@ def dry_run():
             one_trace = bool(sites) and all(
                 s["traces"] == 1 and not s["causes"]
                 for s in sites.values())
-            return len(done), report, one_trace
+            # snapshot the process-global serving counters BEFORE the
+            # paged canary adds its own requests to them
+            return (len(done), report, one_trace,
+                    monitor.stat_get("serving/completed"),
+                    monitor.stat_get("serving/requests"))
 
-        served, serving_report, serving_one_trace = _serving_canary()
+        (served, serving_report, serving_one_trace, served_completed,
+         served_requests) = _serving_canary()
+
+        # paged canary (PR-5): mixed-length requests through a PAGED
+        # engine — all complete, a repeated system prompt scores prefix
+        # hits (prefill skipped, tokens saved), the paged decode step
+        # analyzes clean, and every prefill/table bucket traced exactly
+        # once (sites are per-engine, filtered by its id).
+        def _paged_canary():
+            from paddle_tpu.framework import trace_probe
+            from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining
+            from paddle_tpu.serving import GenerationEngine
+
+            paddle.framework.random.seed(0)
+            model = GPTForPretraining(GPTConfig.tiny())
+            model.eval()
+            eng = GenerationEngine(model, num_slots=4, max_len=48,
+                                   min_bucket=8, kv_layout="paged",
+                                   block_size=8)
+            system = np.arange(2, 18, dtype=np.int32)     # two full blocks
+            # the system prompt's blocks are computed once...
+            eng.submit(np.concatenate([system, [30]]),
+                       max_new_tokens=4).result(timeout=300)
+            # ...then served from the prefix cache under mixed lengths
+            prompts = [np.concatenate([system,
+                                       np.arange(40, 40 + n,
+                                                 dtype=np.int32)])
+                       for n in (1, 5, 9, 2)] \
+                + [np.arange(1, 1 + n, dtype=np.int32) for n in (3, 7)]
+            handles = [eng.submit(p, max_new_tokens=5) for p in prompts]
+            done = [h.result(timeout=300) for h in handles]
+            report = eng.analyze()
+            stats = eng.stats()
+            eng.close()
+            sites = {k: v for k, v in trace_probe.snapshot().items()
+                     if k.startswith("serving/") and f"#{eng._eid}" in k}
+            one_trace = bool(sites) and all(
+                s["traces"] == 1 and not s["causes"]
+                for s in sites.values())
+            return len(done), report, one_trace, stats
+
+        paged_served, paged_report, paged_one_trace, paged_stats = \
+            _paged_canary()
 
     counters = monitor.all_stats()
     host_syncs = monitor.stat_get("hapi/host_sync")
@@ -1039,15 +1089,25 @@ def dry_run():
         # PR-4 serving surface: the continuous batcher completed every
         # canary request, its metrics are live, its decode step analyzes
         # clean and each capacity bucket traced exactly once
-        "serving_completed":
-            served == 6 and monitor.stat_get("serving/completed") == 6,
+        "serving_completed": served == 6 and served_completed == 6,
         "serving_counters_live":
             monitor.stat_histogram("serving/ttft_ms") is not None
             and monitor.stat_histogram("serving/tokens_per_sec")
             is not None
-            and monitor.stat_get("serving/requests") == 6,
+            and served_requests == 6,
         "serving_decode_clean": serving_report.ok(),
         "serving_one_trace_per_bucket": serving_one_trace,
+        # PR-5 paged surface: mixed lengths through the paged engine all
+        # complete, the repeated system prompt hits the prefix cache
+        # (prefill skipped, whole blocks of tokens saved), the paged
+        # decode step analyzes clean and every bucket traced once
+        "paged_completed": paged_served == 6,
+        "paged_prefix_hit":
+            monitor.stat_get("serving/prefix_hit") > 0
+            and paged_stats["prefill_tokens_saved"] > 0
+            and paged_stats["prefix_hit_ratio"] > 0,
+        "paged_decode_clean": paged_report.ok(),
+        "paged_one_trace_per_bucket": paged_one_trace,
     }
     print(monitor.stats_summary(), file=sys.stderr)
     for f in lint_findings:
@@ -1057,6 +1117,8 @@ def dry_run():
         print(resnet_report.table(), file=sys.stderr)
     if not serving_report.ok():
         print(serving_report.table(), file=sys.stderr)
+    if not paged_report.ok():
+        print(paged_report.table(), file=sys.stderr)
     ok = all(checks.values())
     print(json.dumps({"metric": "dry_run", "ok": ok,
                       "counters": len(counters),
@@ -1072,8 +1134,11 @@ def dry_run():
                           for k, v in counters.items()
                           if k.startswith("dispatch/retrace_cause/")},
                       "selflint_findings": len(lint_findings),
-                      "serving_requests":
-                          monitor.stat_get("serving/requests"),
+                      "serving_requests": served_requests,
+                      "paged_prefix_hits":
+                          monitor.stat_get("serving/prefix_hit"),
+                      "paged_tokens_saved":
+                          monitor.stat_get("serving/prefill_tokens_saved"),
                       "loss": round(float(loss), 4), "checks": checks}),
           flush=True)
     sys.exit(0 if ok else 1)

@@ -15,8 +15,18 @@ and leave the in-flight batch EVERY decode step (continuous batching
 over a slot-based KV pool), so a client asking for 4 tokens is never
 held hostage by one asking for 48.
 
+With ``--paged`` the engine swaps the dense per-slot KV stripes for the
+block-granular paged pool: every client shares the same block-aligned
+system preamble, so after the first request prefills it, clients whose
+own prompt fits one prefill bucket are PREFIX-CACHE HITS that skip
+prefill entirely (a longer tail prefills fresh — replay costs a decode
+cycle per token, see serving/engine.py) — watch ``prefix_hit_ratio``
+and ``prefill_tokens_saved`` in the end-of-run ``engine.stats()``
+report.
+
 Usage:
     python examples/serve_gpt2.py [--clients 12] [--slots 8] [--mp 2]
+                                  [--paged]
 """
 import argparse
 import threading
@@ -89,15 +99,35 @@ def main():
     ap.add_argument("--mp", type=int, default=1,
                     help="tensor-parallel ways (<= visible devices)")
     ap.add_argument("--train-steps", type=int, default=40)
+    ap.add_argument("--paged", action="store_true",
+                    help="paged KV blocks + prefix cache instead of "
+                         "dense per-slot stripes")
     args = ap.parse_args()
 
     paddle.seed(0)
     model = build_model(args.train_steps)
     maybe_shard(model, args.mp)
 
-    engine = GenerationEngine(model, num_slots=args.slots, max_len=96,
-                              min_bucket=8)
-    print(f"\nserving with {args.slots} slots, "
+    if args.paged:
+        # min_bucket 16 also bounds the prefix-hit replay: a hit is
+        # taken when a prompt's uncovered tail fits one min_bucket.
+        # max_len 128 keeps the pow2 bucket ladder (16..128) feasible
+        # for every prompt/max_new the clients draw — on the 16/32/64
+        # ladder a worst re-admission feed past 64 tokens would have
+        # no bucket and submit() would reject it
+        engine = GenerationEngine(model, num_slots=args.slots,
+                                  max_len=128, min_bucket=16,
+                                  kv_layout="paged", block_size=8)
+    else:
+        engine = GenerationEngine(model, num_slots=args.slots, max_len=96,
+                                  min_bucket=8)
+    # a shared system preamble every client prepends — exactly three
+    # full 8-token blocks, so on the paged engine it is computed once
+    # and then served whole from the prefix cache
+    system = np.frombuffer(b"the quick brown fox jump", np.uint8) \
+        .astype(np.int32) if args.paged else None
+    print(f"\nserving with {args.slots} slots "
+          f"({'paged' if args.paged else 'dense'} KV), "
           f"{args.clients} concurrent clients (mixed lengths):")
 
     lines, lock = [], threading.Lock()
@@ -106,6 +136,8 @@ def main():
         rng = np.random.RandomState(i)
         text = PROMPTS[i % len(PROMPTS)]
         ids = np.frombuffer(text, np.uint8).astype(np.int32)
+        if system is not None:
+            ids = np.concatenate([system, ids])
         max_new = int(rng.randint(4, 25))
         t0 = time.perf_counter()
         ttft, toks = None, []
@@ -128,6 +160,7 @@ def main():
     for t in threads:
         t.join()
     wall = time.perf_counter() - t0
+    stats = engine.stats()      # snapshot BEFORE close drains the pool
     engine.close()
 
     for ln in sorted(lines):
@@ -139,6 +172,21 @@ def main():
           f"aggregate {total_tokens / wall:.1f} tokens/s, "
           f"ttft p50 {ttft.get('p50', 0):.1f} ms "
           f"p95 {ttft.get('p95', 0):.1f} ms")
+    # the operator snapshot: one call instead of scraping serving/*
+    # monitor counters by prefix
+    print(f"engine.stats(): layout={stats['kv_layout']} "
+          f"queue={stats['queue_depth']} "
+          f"active={stats['active_requests']} "
+          f"slots={stats['slots_in_use']}/{stats['num_slots']} "
+          f"preempts={stats['preempts']}")
+    if args.paged:
+        print(f"  paged: blocks {stats['kv_blocks_in_use']}"
+              f"/{stats['num_blocks']} x{stats['block_size']}, "
+              f"cached {stats['cached_blocks']}, "
+              f"prefix hit ratio {stats['prefix_hit_ratio']:.2f} "
+              f"({stats['prefix_hits']} hit / "
+              f"{stats['prefix_misses']} miss), "
+              f"prefill tokens saved {stats['prefill_tokens_saved']}")
 
 
 if __name__ == "__main__":

@@ -19,11 +19,12 @@ Python cannot enforce (≙ the reference's tools/codestyle custom checks
   best, a silent constant-bake at worst. Nested host-callback bodies
   (pure_callback closures) shadow the name and are exempt.
 * ``serving-host-sync`` — the continuous-batching decode loop
-  (``paddle_tpu/serving/``) must stay sync-free: ``jax.device_get``,
-  ``.block_until_ready()`` and ``.numpy()`` anywhere in the package are
-  a per-step device stall. The single argued exception is the windowed
-  token fetch (``serving/scheduler.py _fetch``), which carries the
-  suppression.
+  (``paddle_tpu/serving/``, the paged memory manager ``serving/paging.py``
+  included) must stay sync-free: ``jax.device_get``,
+  ``.block_until_ready()`` (method or ``jax.block_until_ready`` module
+  form) and ``.numpy()`` anywhere in the package are a per-step device
+  stall. The single argued exception is the windowed token fetch
+  (``serving/scheduler.py _fetch``), which carries the suppression.
 
 Suppress a finding with a trailing ``# lint: ok`` comment on the line
 (used only where a human has argued the exception in an adjacent
@@ -173,6 +174,11 @@ def lint_source(path: str, source: str, relpath: str) -> List[LintFinding]:
             sync = None
             if _is_jax_device_get(node):
                 sync = "jax.device_get"
+            elif isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "block_until_ready" \
+                    and isinstance(node.func.value, ast.Name) \
+                    and node.func.value.id == "jax":
+                sync = "jax.block_until_ready"
             elif isinstance(node.func, ast.Attribute) \
                     and node.func.attr in ("block_until_ready", "numpy"):
                 sync = f".{node.func.attr}()"

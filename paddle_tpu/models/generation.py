@@ -29,7 +29,8 @@ from ..framework.tensor import Tensor, no_grad_guard
 
 __all__ = ["GenerationConfig", "generate", "save_for_serving",
            "shard_params_megatron", "build_slot_prefill_fn",
-           "build_slot_decode_fn"]
+           "build_slot_decode_fn", "build_paged_prefill_fn",
+           "build_paged_decode_fn"]
 
 
 def shard_params_megatron(model, mesh, mp_axis="mp"):
@@ -527,6 +528,197 @@ def build_slot_decode_fn(model, num_slots, max_len, top_k=0, top_p=1.0,
                                     stop_gradient=True)
                     a = F.scaled_dot_product_attention(
                         q, k_full, v_full, attn_mask=mask)
+                    x = block._tail(x, a)
+                x = gpt.ln_f(x)
+                logits = gpt.logits(x)._data[:, 0].astype(jnp.float32)
+                key, sub = jax.random.split(key)
+                greedy = _pick_token(logits, sub, False, top_k, top_p, 1.0)
+                sampled = _pick_token(logits, sub, True, top_k, top_p,
+                                      temperature[:, None])
+                nxt = jnp.where(sample_mask, sampled, greedy)
+        return new_pool, nxt, key
+
+    return fn
+
+
+# ---------------------------------------------------------------------------
+# paged step functions (block-pooled KV with page tables and prefix reuse;
+# consumed by paddle_tpu/serving/paging.py — see serving/engine.py)
+# ---------------------------------------------------------------------------
+
+def build_paged_prefill_fn(model, bucket_len, block_size, top_k=0,
+                           top_p=1.0, probe=None):
+    """Build the per-bucket prefill step of the PAGED serving engine.
+
+    Returns ``fn(params, buffers, pool, ids, key_valid, table, plen,
+    sample, temperature, key) -> (pool, first_token, key)``:
+
+    * ``pool`` — the block pool ``[layers, 2, num_blocks + 1, heads,
+      block_size, head_dim]`` (``serving.PagedKVPool.data``); the
+      prompt's K/V are scattered block-wise through ``table``
+      ``[bucket_len // block_size]`` int32 (physical block per virtual
+      block; 0 = the scratch block for entries past the allocation);
+    * ``ids`` ``[1, bucket_len]`` int32 — the prompt RIGHT-padded to
+      the capacity bucket (paged sequences are aligned at virtual
+      index 0, the property that makes blocks shareable across
+      requests); ``key_valid`` ``[1, bucket_len]`` bool marks real
+      tokens; ``plen`` is the TRACED real length — the first-token
+      logits come from hidden position ``plen - 1``, so one trace
+      serves every prompt length in the bucket;
+    * ``sample``/``temperature`` are traced scalars, exactly the
+      slot-prefill contract; the caller jits with ``donate_argnums``
+      on ``pool``.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ..framework import trace_probe as _probe
+    from ..nn.layer.layers import functional_state
+
+    gpt = model.gpt if hasattr(model, "gpt") else model
+    Lb, bs = int(bucket_len), int(block_size)
+    if Lb < 1:
+        raise ValueError(f"bucket_len must be >= 1, got {Lb}")
+    if bs < 1 or Lb % bs:
+        raise ValueError(
+            f"bucket_len {Lb} must be a positive multiple of "
+            f"block_size {bs}")
+    if Lb > gpt.cfg.max_position_embeddings:
+        raise ValueError(
+            f"bucket_len {Lb} exceeds max_position_embeddings="
+            f"{gpt.cfg.max_position_embeddings}")
+    Tp = Lb // bs
+    H = gpt.cfg.num_attention_heads
+    Dh = gpt.cfg.hidden_size // H
+    top_k = min(int(top_k), gpt.cfg.vocab_size)
+
+    def fn(params, buffers, pool, ids, key_valid, table, plen, sample,
+           temperature, key):
+        if probe is not None:  # runs at trace time only (jit caches)
+            probe.record(_probe.sig_of([pool, ids, key_valid, table]),
+                         {"bucket": Lb, "table": Tp})
+        with functional_state(model, params, buffers):
+            with no_grad_guard():
+                # right-padded: reals count 0,1,2,..., pads repeat the
+                # last real position (their K/V are masked garbage that
+                # lands in the scratch block or gets overwritten by the
+                # decode steps that reach those virtual indices)
+                pos_ids = Tensor(jnp.maximum(
+                    jnp.cumsum(key_valid.astype(jnp.int32), axis=1) - 1,
+                    0))
+                x = gpt.wte(Tensor(ids, stop_gradient=True)) \
+                    + gpt.wpe(pos_ids)
+                new_pool = pool
+                for li, block in enumerate(gpt.blocks):
+                    ck = jnp.zeros((1, Lb, H, Dh), new_pool.dtype)
+                    cv = jnp.zeros((1, Lb, H, Dh), new_pool.dtype)
+                    x, ck, cv = block.prefill(x, ck, cv,
+                                              key_valid=key_valid)
+                    # [1, Lb, H, Dh] -> per-block [Tp, H, bs, Dh] rows
+                    kb = jnp.transpose(ck[0].reshape(Tp, bs, H, Dh),
+                                       (0, 2, 1, 3))
+                    vb = jnp.transpose(cv[0].reshape(Tp, bs, H, Dh),
+                                       (0, 2, 1, 3))
+                    new_pool = new_pool.at[li, 0, table].set(kb)
+                    new_pool = new_pool.at[li, 1, table].set(vb)
+                x = gpt.ln_f(x)
+                z = jnp.int32(0)
+                p = jnp.asarray(plen, jnp.int32).reshape(())
+                last = lax.dynamic_slice(
+                    x._data, (z, p - 1, z), (1, 1, x._data.shape[-1]))
+                logits = gpt.logits(Tensor(last))._data[:, 0].astype(
+                    jnp.float32)
+                key, sub = jax.random.split(key)
+                greedy = _pick_token(logits, sub, False, top_k, top_p, 1.0)
+                sampled = _pick_token(logits, sub, True, top_k, top_p,
+                                      temperature)
+                first = jnp.where(sample, sampled, greedy)
+        return new_pool, first, key
+
+    return fn
+
+
+def build_paged_decode_fn(model, num_slots, table_len, block_size,
+                          top_k=0, top_p=1.0, probe=None):
+    """Build the per-table-bucket decode step of the PAGED serving
+    engine: gather-based paged attention over the block table.
+
+    Returns ``fn(params, buffers, pool, tokens, pos, lo, tables,
+    sample_mask, temperature, key) -> (pool, next_tokens, key)`` over
+    the block pool ``[layers, 2, num_blocks + 1, heads, block_size,
+    head_dim]``:
+
+    * ``tables`` ``[slots, table_len]`` int32 — each slot's page table
+      padded with 0 (the scratch block) to the pow2 table bucket; the
+      new token's K/V are scattered at physical block
+      ``tables[s, pos[s] // block_size]``, offset ``pos[s] %
+      block_size`` (the per-slot scatter of the dense step, routed
+      through the page table);
+    * attention runs over the GATHERED virtual cache
+      ``pool[li, :, tables]`` reshaped to ``[slots, table_len *
+      block_size, heads, head_dim]`` with the ``[lo, pos]`` mask and
+      logical positions ``pos - lo`` unchanged from the dense step —
+      scratch-block garbage is masked, never NaN;
+    * ``sample_mask``/``temperature`` are traced (one program serves
+      mixed greedy/sampled batches via :func:`_pick_token`); the
+      caller jits with ``donate_argnums`` on ``pool``, and the
+      engine's ``analyze()`` must report the program donation-safe and
+      host-sync-free.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..framework import trace_probe as _probe
+    from ..nn import functional as F
+    from ..nn.layer.layers import functional_state
+
+    gpt = model.gpt if hasattr(model, "gpt") else model
+    S, T, bs = int(num_slots), int(table_len), int(block_size)
+    if S < 1:
+        raise ValueError(f"num_slots must be >= 1, got {S}")
+    if T < 1:
+        raise ValueError(f"table_len must be >= 1, got {T}")
+    H = gpt.cfg.num_attention_heads
+    Dh = gpt.cfg.hidden_size // H
+    top_k = min(int(top_k), gpt.cfg.vocab_size)
+
+    def fn(params, buffers, pool, tokens, pos, lo, tables, sample_mask,
+           temperature, key):
+        if probe is not None:  # runs at trace time only (jit caches)
+            probe.record(_probe.sig_of([pool, tokens, pos, lo, tables,
+                                        temperature]),
+                         {"slots": S, "table": T})
+        with functional_state(model, params, buffers):
+            with no_grad_guard():
+                logical = (pos - lo)[:, None]
+                x = gpt.wte(Tensor(tokens[:, None], stop_gradient=True)) \
+                    + gpt.wpe(Tensor(logical))
+                r = jnp.arange(T * bs)
+                key_valid = (r[None, :] >= lo[:, None]) \
+                    & (r[None, :] <= pos[:, None])
+                mask = Tensor(key_valid[:, None, None, :])
+                sl = jnp.arange(S)
+                wb = tables[sl, pos // bs]        # write block per slot
+                off = pos % bs
+                new_pool = pool
+                for li, block in enumerate(gpt.blocks):
+                    q, k, v = block._qkv(x)
+                    kh = k._data[:, 0].astype(new_pool.dtype)  # [S, H, Dh]
+                    vh = v._data[:, 0].astype(new_pool.dtype)
+                    new_pool = new_pool.at[li, 0, wb, :, off, :].set(kh)
+                    new_pool = new_pool.at[li, 1, wb, :, off, :].set(vh)
+                    # gather the virtual cache through the page table:
+                    # [NB+1, H, bs, Dh][tables] -> [S, T, H, bs, Dh]
+                    kf = jnp.transpose(new_pool[li, 0][tables],
+                                       (0, 1, 3, 2, 4)).reshape(
+                                           S, T * bs, H, Dh)
+                    vf = jnp.transpose(new_pool[li, 1][tables],
+                                       (0, 1, 3, 2, 4)).reshape(
+                                           S, T * bs, H, Dh)
+                    a = F.scaled_dot_product_attention(
+                        q, Tensor(kf, stop_gradient=True),
+                        Tensor(vf, stop_gradient=True), attn_mask=mask)
                     x = block._tail(x, a)
                 x = gpt.ln_f(x)
                 logits = gpt.logits(x)._data[:, 0].astype(jnp.float32)

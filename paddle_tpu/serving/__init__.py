@@ -26,18 +26,31 @@ Attention shape, PAPERS.md) with XLA-donated in-place updates.
         ...
     engine.close()                  # drains in-flight work
 
+Two KV layouts share the surface: the dense slot pool above, and
+``GenerationEngine(kv_layout="paged", block_size=...)`` — block-granular
+KV management (:mod:`.paging`) with per-request page tables, ref-counted
+block sharing and a prefix cache, so admission gates on FREE BLOCKS
+instead of worst-case slot stripes and a repeated system prompt skips
+prefill entirely.
+
 Modules: :mod:`.kv_pool` (the pooled cache + slot allocator +
-capacity buckets), :mod:`.scheduler` (admission queue, backpressure,
-prefill-budget policy, the decode loop), :mod:`.engine` (the
-thread-safe user surface + monitor/profiler/analysis wiring).
+capacity buckets), :mod:`.paging` (the paged block pool: free-list
+allocator, page tables, refcounts/copy-on-write, prefix-cache trie +
+LRU eviction), :mod:`.scheduler` (admission queue, backpressure,
+prefill-budget policy, block-pressure preemption, the decode loop),
+:mod:`.engine` (the thread-safe user surface +
+monitor/profiler/analysis wiring).
 """
 from __future__ import annotations
 
 from .engine import GenerationEngine  # noqa: F401
 from .kv_pool import KVCachePool  # noqa: F401
+from .paging import (BlockError, PagedKVPool,  # noqa: F401
+                     PoolCapacityError, PoolExhaustedError)
 from .scheduler import (DeadlineExceeded, GenerationRequest,  # noqa: F401
                         QueueFullError, RequestCancelled, Scheduler)
 
-__all__ = ["GenerationEngine", "KVCachePool", "GenerationRequest",
-           "Scheduler", "QueueFullError", "DeadlineExceeded",
-           "RequestCancelled"]
+__all__ = ["GenerationEngine", "KVCachePool", "PagedKVPool",
+           "GenerationRequest", "Scheduler", "QueueFullError",
+           "DeadlineExceeded", "RequestCancelled", "PoolCapacityError",
+           "PoolExhaustedError", "BlockError"]

@@ -9,21 +9,38 @@ loop: buffers are donated and rebound, the hot loop never syncs except
 the one windowed token fetch, and every step program must pass the PR-3
 analyzer clean (``engine.analyze()``).
 
-Compile discipline: the decode step traces ONCE per engine (slot count,
-pool shape and sampling support are static; per-request temperature and
-greedy/sampled choice are traced values), and prefill traces once per
-CAPACITY BUCKET (pow2 prompt lengths) — both watched by
+Two KV layouts share this surface (``kv_layout=``):
+
+* ``"dense"`` — one ``[heads, max_len, head_dim]`` stripe per slot
+  (:mod:`.kv_pool`): simplest, but concurrency is capped by worst-case
+  sequence length;
+* ``"paged"`` — a block pool addressed through per-request page tables
+  (:mod:`.paging`): a request owns only the blocks covering its tokens
+  so far, admission gates on FREE BLOCKS instead of free slots, memory
+  pressure preempts the youngest request (requeued, replayed) instead
+  of deadlocking, and full prompt blocks are shared across requests
+  through the prefix cache — a repeated system prompt skips prefill
+  entirely. Greedy paged output is token-identical to the dense slot
+  engine (tests/test_serving_paging.py).
+
+Compile discipline: the dense decode step traces ONCE per engine (the
+paged one once per pow2 TABLE bucket), and prefill traces once per
+CAPACITY BUCKET (pow2 prompt lengths) — all watched by
 ``framework.trace_probe`` sites (``serving/decode#N``,
-``serving/prefill[B]#N``), so a retrace shows up in the
-``dispatch/retrace_cause`` counters exactly like training-loop churn.
+``serving/decode[tT]#N``, ``serving/prefill[B]#N``), so a retrace shows
+up in the ``dispatch/retrace_cause`` counters exactly like
+training-loop churn.
 
 Observability (PR-1 wiring): counters ``serving/requests``,
 ``serving/completed``, ``serving/tokens``, ``serving/preempt``,
 ``serving/queue_full``, ``serving/cancelled``,
-``serving/deadline_exceeded``; histograms ``serving/queue_depth``,
-``serving/active_slots``, ``serving/ttft_ms``,
-``serving/tokens_per_sec``; spans ``serving/prefill`` and
-``serving/decode_step``.
+``serving/deadline_exceeded``, ``serving/prefix_hit``/``prefix_miss``/
+``prefill_tokens_saved``/``prefix_evict`` (paged); histograms
+``serving/queue_depth``, ``serving/active_slots``, ``serving/ttft_ms``,
+``serving/tokens_per_sec``, ``serving/kv_blocks_in_use`` (paged);
+spans ``serving/prefill`` and ``serving/decode_step``. The
+:meth:`GenerationEngine.stats` snapshot packages the operator view so
+nobody has to scrape monitor counters by prefix.
 """
 from __future__ import annotations
 
@@ -35,6 +52,7 @@ import numpy as np
 from ..framework import trace_probe as _probe
 from ..framework.monitor import stat_add
 from .kv_pool import KVCachePool
+from .paging import PagedKVPool, PoolCapacityError
 from .scheduler import (GenerationRequest, Scheduler, _fetch)
 
 __all__ = ["GenerationEngine"]
@@ -59,28 +77,41 @@ class GenerationEngine:
     (sharded parameters serve sharded — jit follows the placement).
 
     * ``num_slots`` — concurrent in-flight requests (the pool's batch);
-    * ``max_len`` — per-slot cache capacity; a request needs
-      ``bucket(prompt) + max_new_tokens <= max_len``;
+    * ``max_len`` — per-slot cache capacity; a dense request needs
+      ``bucket(prompt) + max_new_tokens <= max_len``, a paged one only
+      ``prompt + max_new_tokens <= max_len`` (no left-pad tax);
     * ``top_k``/``top_p`` — the sampled path's truncation, STATIC per
       engine (part of the single decode trace); per-request
       ``do_sample``/``temperature`` are traced values;
     * ``max_queue``/``prefill_budget`` — backpressure and the
-      anti-starvation admission policy (see :mod:`.scheduler`).
+      anti-starvation admission policy (see :mod:`.scheduler`);
+    * ``kv_layout``/``block_size``/``num_blocks`` — ``"paged"`` swaps
+      the dense pool for the block-granular :class:`~.paging.PagedKVPool`
+      (``num_blocks`` defaults to the dense-equivalent device budget;
+      shrink it to realise the capacity win — admission then gates on
+      blocks, pressure preempts, and full prompt blocks are shared
+      through the prefix cache).
 
     Greedy engine output is token-identical to ``models.generate`` run
-    per request (the parity contract, tests/test_serving_engine.py).
+    per request (the parity contract, tests/test_serving_engine.py and
+    tests/test_serving_paging.py).
     """
 
     def __init__(self, model, num_slots: int = 8,
                  max_len: Optional[int] = None, *, top_k: int = 0,
                  top_p: float = 1.0, pad_token_id: int = 0,
                  max_queue: int = 128, prefill_budget: Optional[int] = None,
-                 min_bucket: int = 8, seed: int = 0, dtype=None):
+                 min_bucket: int = 8, seed: int = 0, dtype=None,
+                 kv_layout: str = "dense", block_size: int = 16,
+                 num_blocks: Optional[int] = None):
         import jax
 
         from ..models.generation import build_slot_decode_fn
         from ..nn.layer.layers import get_buffers_tree, get_params_tree
 
+        if kv_layout not in ("dense", "paged"):
+            raise ValueError(
+                f"kv_layout must be 'dense' or 'paged', got {kv_layout!r}")
         gpt = model.gpt if hasattr(model, "gpt") else model
         cfg = gpt.cfg
         max_len = int(max_len or cfg.max_position_embeddings)
@@ -93,28 +124,53 @@ class GenerationEngine:
         self._buffers = get_buffers_tree(model)
         if dtype is None:
             dtype = self._params[next(iter(self._params))].dtype
-        self._pool = KVCachePool(
-            cfg.num_hidden_layers, num_slots, cfg.num_attention_heads,
-            max_len, cfg.hidden_size // cfg.num_attention_heads,
-            dtype=dtype, min_bucket=min_bucket)
+        self._paged = kv_layout == "paged"
+        head_dim = cfg.hidden_size // cfg.num_attention_heads
         self._key = jax.random.PRNGKey(int(seed))
         self._eid = _next_engine_id()
-        self._decode_probe = _probe.site(f"serving/decode#{self._eid}")
-        self._decode_jit = jax.jit(
-            build_slot_decode_fn(model, self._pool.num_slots, max_len,
-                                 top_k=self._top_k, top_p=self._top_p,
-                                 probe=self._decode_probe),
-            donate_argnums=(2,))
         self._prefill_jits = {}           # bucket -> jitted prefill step
+        if self._paged:
+            # the dense layout fails this at construction inside
+            # build_slot_decode_fn; every paged jit is deferred, so
+            # without this check an oversized max_len would only
+            # surface as SILENTLY WRONG tokens (XLA clamps the
+            # out-of-range wpe gather at decode positions past mpe)
+            if max_len > cfg.max_position_embeddings:
+                raise ValueError(
+                    f"max_len {max_len} exceeds max_position_embeddings="
+                    f"{cfg.max_position_embeddings}")
+            # prefill scatters WHOLE blocks, so capacity buckets must be
+            # block multiples: round the floor up rather than reject it
+            mb = -(-max(int(min_bucket), int(block_size))
+                   // int(block_size)) * int(block_size)
+            self._pool = PagedKVPool(
+                cfg.num_hidden_layers, num_slots, cfg.num_attention_heads,
+                max_len, head_dim, block_size=block_size,
+                num_blocks=num_blocks, dtype=dtype, min_bucket=mb)
+            self._decode_jit = None       # per-table-bucket instead
+            self._decode_jits = {}        # table bucket -> jitted step
+            self._copy_jit = None         # lazy COW device block copy
+        else:
+            self._pool = KVCachePool(
+                cfg.num_hidden_layers, num_slots, cfg.num_attention_heads,
+                max_len, head_dim, dtype=dtype, min_bucket=min_bucket)
+            self._decode_probe = _probe.site(f"serving/decode#{self._eid}")
+            self._decode_jit = jax.jit(
+                build_slot_decode_fn(model, self._pool.num_slots, max_len,
+                                     top_k=self._top_k, top_p=self._top_p,
+                                     probe=self._decode_probe),
+                donate_argnums=(2,))
         self._closed = False
         self._close_lock = threading.Lock()
-        self._sched = Scheduler(self._pool, self._run_prefill,
-                                self._run_decode, max_queue=max_queue,
-                                prefill_budget=prefill_budget)
+        self._sched = Scheduler(
+            self._pool, self._run_prefill, self._run_decode,
+            max_queue=max_queue, prefill_budget=prefill_budget,
+            do_copy=self._run_copy if self._paged else None)
 
     # -- client side -------------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens: int = 32, *,
                do_sample: bool = False, temperature: float = 1.0,
+               top_k: Optional[int] = None, top_p: Optional[float] = None,
                eos_token_id: Optional[int] = None,
                timeout: Optional[float] = None) -> GenerationRequest:
         """Enqueue one generation; returns its handle immediately.
@@ -125,22 +181,71 @@ class GenerationEngine:
         (``handle.cancel()``). ``timeout`` (seconds) is a hard deadline:
         a request that has not FINISHED by then fails with
         ``DeadlineExceeded``. A full admission queue raises
-        ``QueueFullError`` here, synchronously."""
+        ``QueueFullError`` here, synchronously.
+
+        ``do_sample``/``temperature`` are per-request (traced values of
+        the shared decode program). ``top_k``/``top_p`` are NOT: they
+        are static truncation structure baked into the engine's compile
+        key at construction, so a differing per-request value here is
+        rejected with :class:`ValueError` instead of silently retracing
+        the decode step per sampling mix (the retrace-storm bug class
+        the ``dispatch/retrace_cause`` counters exist to expose)."""
         if self._closed:
             raise RuntimeError("GenerationEngine is closed")
+        if top_k is not None and int(top_k) != self._top_k:
+            raise ValueError(
+                f"per-request top_k={top_k} differs from the engine's "
+                f"static top_k={self._top_k}: top_k is part of the decode "
+                f"step's compile key — build a GenerationEngine("
+                f"top_k={top_k}) instead of risking one retrace per "
+                f"sampling mix")
+        if top_p is not None and float(top_p) != self._top_p:
+            raise ValueError(
+                f"per-request top_p={top_p} differs from the engine's "
+                f"static top_p={self._top_p}: top_p is part of the decode "
+                f"step's compile key — build a GenerationEngine("
+                f"top_p={top_p}) instead of risking one retrace per "
+                f"sampling mix")
         ids = np.asarray(prompt_ids, np.int32).reshape(-1)
         if ids.size < 1:
             raise ValueError("prompt_ids must contain at least one token")
         if max_new_tokens < 1:
             raise ValueError(
                 f"max_new_tokens must be >= 1, got {max_new_tokens}")
-        bucket = self._pool.bucket_for(ids.size)
-        if bucket + int(max_new_tokens) > self._pool.max_len:
-            raise ValueError(
-                f"prompt bucket {bucket} + max_new_tokens "
-                f"{max_new_tokens} exceeds the pool capacity "
-                f"{self._pool.max_len}; shorten the request or build the "
-                f"engine with a larger max_len")
+        if self._paged:
+            # paged sequences are aligned at virtual 0 — no left-pad tax,
+            # only the true footprint counts (this is the capacity win)
+            if ids.size + int(max_new_tokens) > self._pool.max_len:
+                raise PoolCapacityError(
+                    f"prompt {ids.size} + max_new_tokens {max_new_tokens} "
+                    f"exceeds the pool's virtual capacity "
+                    f"{self._pool.max_len}; shorten the request or build "
+                    f"the engine with a larger max_len")
+            # bucket feasibility, incl. the WORST re-admission: a
+            # preempted request re-prefills prompt + generated-so-far
+            # (up to max_new - 1 tokens), and that feed's pow2 bucket
+            # must exist — without this gate a bucket ladder that
+            # overshoots max_len (non-pow2 max_len / large min_bucket)
+            # admits a request whose prefill can never trace, and the
+            # scheduler-thread crash poisons every in-flight request
+            worst = ids.size + int(max_new_tokens) - 1
+            if self._pool.bucket_for(worst) > self._pool.max_len:
+                raise PoolCapacityError(
+                    f"no prefill bucket fits this request: prompt "
+                    f"{ids.size} (+ up to {int(max_new_tokens) - 1} "
+                    f"replayed tokens after a preemption) needs bucket "
+                    f"{self._pool.bucket_for(worst)} > max_len "
+                    f"{self._pool.max_len}; shorten the request or build "
+                    f"the engine with a larger max_len / smaller "
+                    f"min_bucket")
+        else:
+            bucket = self._pool.bucket_for(ids.size)
+            if bucket + int(max_new_tokens) > self._pool.max_len:
+                raise ValueError(
+                    f"prompt bucket {bucket} + max_new_tokens "
+                    f"{max_new_tokens} exceeds the pool capacity "
+                    f"{self._pool.max_len}; shorten the request or build "
+                    f"the engine with a larger max_len")
         req = GenerationRequest(
             ids, max_new_tokens, do_sample=do_sample,
             temperature=temperature, eos_token_id=eos_token_id,
@@ -185,6 +290,38 @@ class GenerationEngine:
     def active_requests(self) -> int:
         return self._sched.active
 
+    def stats(self) -> dict:
+        """One coherent operator snapshot — queue depth, in-flight
+        requests, slot/block utilization and the prefix-cache hit ratio
+        — so nobody has to scrape process-global monitor counters by
+        ``serving/`` prefix (those aggregate across every engine ever
+        constructed; this reads THIS engine's pool and scheduler).
+        Host bookkeeping only: never blocks on the device."""
+        pool = self._pool
+        s = {
+            "kv_layout": "paged" if self._paged else "dense",
+            "queue_depth": self._sched.queue_depth,
+            "active_requests": self._sched.active,
+            "num_slots": pool.num_slots,
+            "slots_in_use": pool.n_active,
+            "slot_utilization": pool.n_active / pool.num_slots,
+            "preempts": self._sched.preempts,
+        }
+        if self._paged:
+            hits, misses = pool.prefix_hits, pool.prefix_misses
+            s.update({
+                "block_size": pool.block_size,
+                "num_blocks": pool.num_blocks,
+                "kv_blocks_in_use": pool.blocks_in_use,
+                "block_utilization": pool.blocks_in_use / pool.num_blocks,
+                "cached_blocks": pool.cached_blocks,
+                "prefix_hits": hits,
+                "prefix_misses": misses,
+                "prefix_hit_ratio": hits / max(1, hits + misses),
+                "prefill_tokens_saved": pool.tokens_saved,
+            })
+        return s
+
     def analyze(self, passes=None):
         """PR-3 pre-flight of THE decode step: trace the jitted program
         (donation contract auto-read from the pjit eqn) and run the
@@ -192,10 +329,22 @@ class GenerationEngine:
         error-severity findings — donation-safe, no host sync in the
         hot loop; asserted by ``bench.py --dry-run`` and the tier-1
         tests. Tracing hits jit's signature cache, so this never
-        retraces (the probe counters stay honest)."""
+        retraces (the probe counters stay honest). A paged engine
+        analyzes its LARGEST built table bucket (the step that actually
+        served), falling back to the one-block bucket on a fresh
+        engine."""
         from .. import analysis
 
         S = self._pool.num_slots
+        if self._paged:
+            T = max(self._decode_jits) if self._decode_jits else 1
+            return analysis.analyze(
+                self._paged_decode_fn(T), self._params, self._buffers,
+                self._pool.data, np.zeros(S, np.int32),
+                np.zeros(S, np.int32), np.zeros(S, np.int32),
+                np.zeros((S, T), np.int32), np.zeros(S, bool),
+                np.ones(S, np.float32), self._key, passes=passes,
+                name=f"serving.paged_decode[{S} slots, {T}-block tables]")
         return analysis.analyze(
             self._decode_jit, self._params, self._buffers, self._pool.data,
             np.zeros(S, np.int32), np.zeros(S, np.int32),
@@ -209,19 +358,41 @@ class GenerationEngine:
         if fn is None:
             import jax
 
-            from ..models.generation import build_slot_prefill_fn
+            from ..models.generation import (build_paged_prefill_fn,
+                                             build_slot_prefill_fn)
             probe = _probe.site(f"serving/prefill[{bucket}]#{self._eid}")
-            fn = jax.jit(
-                build_slot_prefill_fn(self._model, bucket,
-                                      self._pool.max_len,
-                                      top_k=self._top_k,
-                                      top_p=self._top_p, probe=probe),
-                donate_argnums=(2,))
+            if self._paged:
+                built = build_paged_prefill_fn(
+                    self._model, bucket, self._pool.block_size,
+                    top_k=self._top_k, top_p=self._top_p, probe=probe)
+            else:
+                built = build_slot_prefill_fn(
+                    self._model, bucket, self._pool.max_len,
+                    top_k=self._top_k, top_p=self._top_p, probe=probe)
+            fn = jax.jit(built, donate_argnums=(2,))
             self._prefill_jits[bucket] = fn
         return fn
 
+    def _paged_decode_fn(self, table_len: int):
+        fn = self._decode_jits.get(table_len)
+        if fn is None:
+            import jax
+
+            from ..models.generation import build_paged_decode_fn
+            probe = _probe.site(f"serving/decode[t{table_len}]#{self._eid}")
+            fn = jax.jit(
+                build_paged_decode_fn(self._model, self._pool.num_slots,
+                                      table_len, self._pool.block_size,
+                                      top_k=self._top_k, top_p=self._top_p,
+                                      probe=probe),
+                donate_argnums=(2,))
+            self._decode_jits[table_len] = fn
+        return fn
+
     def _run_prefill(self, req: GenerationRequest, slot: int,
-                     bucket: int) -> int:
+                     bucket: int) -> Optional[int]:
+        if self._paged:
+            return self._run_paged_prefill(req, slot, bucket)
         ids = np.full((1, bucket), self._pad, np.int32)
         ids[0, bucket - req.prompt.size:] = req.prompt
         key_valid = np.zeros((1, bucket), bool)
@@ -230,6 +401,52 @@ class GenerationEngine:
             self._params, self._buffers, self._pool.data, ids, key_valid,
             np.int32(slot), np.bool_(req.do_sample),
             np.float32(req.temperature), self._key)
+        return int(_fetch(first)[0])
+
+    def _run_paged_prefill(self, req: GenerationRequest, slot: int,
+                           bucket: int) -> Optional[int]:
+        """Admit one request into the paged pool. On a prefix-cache hit
+        the matched blocks are adopted and prefill is SKIPPED entirely —
+        the uncovered tail (plus, after a preemption, the request's own
+        generated history) replays through the shared decode step, one
+        token per cycle, predictions discarded until the replay drains.
+        Replay costs one decode cycle PER TOKEN, so the hit is only
+        taken when the tail fits one ``min_bucket`` (a smallest
+        prefill's worth); a longer tail prefills the whole feed fresh
+        instead — one prefill call beats a tail-long replay, and the
+        shared blocks are still deduplicated in the cache. On a miss
+        the whole feed prefills into freshly allocated blocks and its
+        full token blocks are published to the prefix cache."""
+        pool = self._pool
+        # a re-admitted (preempted) request replays prompt + everything
+        # it already generated; a fresh request's feed IS its prompt
+        feed = np.concatenate(
+            [req.prompt, np.asarray(req.tokens, np.int32)])
+        cached = pool.match_prefix(feed)
+        if cached and feed.size - len(cached) * pool.block_size \
+                > pool.min_bucket:
+            cached = []                   # tail too long: prefill wins
+        if cached:
+            pool.admit_cached(slot, cached)
+            m = len(cached) * pool.block_size
+            pool.set_slot(slot, pos=m, lo=0)
+            req.last_token = int(feed[m])
+            req.replay = [int(t) for t in feed[m + 1:]]
+            return None
+        blocks = pool.admit_fresh(slot, feed.size)
+        table = np.zeros(bucket // pool.block_size, np.int32)
+        table[:len(blocks)] = blocks      # padding -> the scratch block
+        ids = np.zeros((1, bucket), np.int32)
+        ids[0, :feed.size] = feed         # RIGHT-padded: virtual index 0
+        key_valid = np.zeros((1, bucket), bool)
+        key_valid[0, :feed.size] = True
+        pool.data, first, self._key = self._prefill_fn(bucket)(
+            self._params, self._buffers, pool.data, ids, key_valid, table,
+            np.int32(feed.size), np.bool_(req.do_sample),
+            np.float32(req.temperature), self._key)
+        pool.set_slot(slot, pos=feed.size, lo=0)
+        pool.register_prefix(slot, feed)
+        req.replay = []
         return int(_fetch(first)[0])
 
     def _run_decode(self, slot_requests) -> np.ndarray:
@@ -242,7 +459,33 @@ class GenerationEngine:
             sample_mask[slot] = req.do_sample
             temps[slot] = req.temperature
         pos, lo = self._pool.position_arrays()
+        if self._paged:
+            # the cohort decodes at the largest member's pow2 table
+            # bucket (shorter tables pad with the scratch block) — one
+            # trace per bucket, exactly the prefill-bucket discipline
+            T = max(self._pool.table_bucket(s) for s in slot_requests)
+            tables = self._pool.table_array(T, slot_requests)
+            self._pool.data, nxt, self._key = self._paged_decode_fn(T)(
+                self._params, self._buffers, self._pool.data, tokens, pos,
+                lo, tables, sample_mask, temps, self._key)
+            return _fetch(nxt)
         self._pool.data, nxt, self._key = self._decode_jit(
             self._params, self._buffers, self._pool.data, tokens, pos, lo,
             sample_mask, temps, self._key)
         return _fetch(nxt)
+
+    def _run_copy(self, dst: int, src: int) -> None:
+        """Copy-on-write append support: device-copy block ``src`` over
+        block ``dst`` across every layer/kv plane before the decode step
+        scatters into ``dst``. Block ids are traced scalars — ONE trace
+        serves every copy — and the pool is donated like every other
+        step. Device-to-device only: no host sync."""
+        if self._copy_jit is None:
+            import jax
+
+            def _copy(pool, dst, src):
+                return pool.at[:, :, dst].set(pool[:, :, src])
+
+            self._copy_jit = jax.jit(_copy, donate_argnums=(0,))
+        self._pool.data = self._copy_jit(self._pool.data, np.int32(dst),
+                                         np.int32(src))

@@ -31,7 +31,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-__all__ = ["KVCachePool"]
+__all__ = ["KVCachePool", "SlotPoolBase"]
 
 
 class _Slot:
@@ -44,63 +44,58 @@ class _Slot:
         self.lo = lo
 
 
-class KVCachePool:
-    """Fixed-capacity pooled KV cache + slot allocator.
+class SlotPoolBase:
+    """Slot/position/bucket bookkeeping shared by every KV pool layout.
 
-    ``data`` is the jnp array ``[layers, 2, slots, heads, max_len,
-    head_dim]``; the engine threads it through the donated prefill and
-    decode steps and rebinds it here. Everything else is host
-    bookkeeping: which slots are live, where each slot's sequence starts
-    (``lo``) and currently ends (``pos``).
+    The scheduler talks to pools through this interface only: request
+    slots (the decode batch axis) with deterministic lowest-index
+    allocation, per-slot ``pos``/``lo`` tracking, and the pow2 capacity
+    buckets that keep prefill at ONE trace per bucket. Subclasses bind
+    the device memory (``data``/``shape``/``dtype``) in their
+    constructors, pick the per-slot state record via ``_slot_cls``, and
+    hook ``_slot_freed`` for layout-specific teardown (the paged pool
+    unrefs the slot's blocks there).
     """
 
-    def __init__(self, num_layers: int, num_slots: int, num_heads: int,
-                 max_len: int, head_dim: int, dtype="float32",
-                 min_bucket: int = 8):
-        import jax.numpy as jnp
+    _slot_cls = _Slot
+    # advance()'s overrun diagnostic, per layout (dense bills the pow2
+    # bucket, paged only the true footprint)
+    _capacity_noun = "cache capacity"
+    _admission_law = "bucket + max_new <= max_len"
 
-        if num_slots < 1:
-            raise ValueError(f"num_slots must be >= 1, got {num_slots}")
-        if min_bucket < 1:
-            raise ValueError(f"min_bucket must be >= 1, got {min_bucket}")
-        if max_len < min_bucket:
-            raise ValueError(
-                f"max_len={max_len} is below min_bucket={min_bucket}: no "
-                f"prompt could ever be admitted")
-        self.num_layers = int(num_layers)
-        self.num_slots = int(num_slots)
-        self.num_heads = int(num_heads)
-        self.max_len = int(max_len)
-        self.head_dim = int(head_dim)
-        self.min_bucket = int(min_bucket)
-        self.shape = (self.num_layers, 2, self.num_slots, self.num_heads,
-                      self.max_len, self.head_dim)
-        self.dtype = jnp.dtype(dtype)
-        self.data = jnp.zeros(self.shape, self.dtype)
+    # subclass constructors set: num_slots, max_len, min_bucket,
+    # shape, dtype, data — then call _init_slots()
+    def _init_slots(self) -> None:
         # lowest-index-first keeps slot assignment deterministic (tests
         # and trace/debug output stay stable across runs)
-        self._free: List[int] = list(range(self.num_slots))
+        self._free_slots: List[int] = list(range(self.num_slots))
         self._slots: Dict[int, _Slot] = {}
 
     # -- slot allocation ---------------------------------------------------
     def alloc(self) -> Optional[int]:
         """Claim the lowest free slot, or None when the pool is full."""
-        if not self._free:
+        if not self._free_slots:
             return None
-        slot = min(self._free)
-        self._free.remove(slot)
-        self._slots[slot] = _Slot()
+        slot = min(self._free_slots)
+        self._free_slots.remove(slot)
+        self._slots[slot] = self._slot_cls()
         return slot
 
     def free(self, slot: int) -> None:
-        """Return ``slot`` to the free list. Its device rows are NOT
-        cleared — the next occupant's prefill overwrites ``[0, bucket)``
-        and its decode mask never looks past ``pos``, so stale K/V are
-        unreachable by construction."""
+        """Return ``slot`` to the free list (``_slot_freed`` runs the
+        layout's teardown first). Its device rows are NOT cleared — the
+        next occupant's prefill overwrites them and the decode mask
+        never looks past ``pos``, so stale K/V are unreachable by
+        construction."""
         if slot not in self._slots:
             raise ValueError(f"slot {slot} is not allocated")
-        del self._slots[slot]
-        self._free.append(slot)
+        st = self._slots.pop(slot)
+        self._slot_freed(st)
+        self._free_slots.append(slot)
+
+    def _slot_freed(self, st) -> None:
+        """Layout hook: called by :meth:`free` with the popped slot
+        state, before the slot rejoins the free list."""
 
     def is_allocated(self, slot: int) -> bool:
         return slot in self._slots
@@ -122,7 +117,7 @@ class KVCachePool:
 
     @property
     def n_free(self) -> int:
-        return len(self._free)
+        return len(self._free_slots)
 
     def active_slots(self) -> List[int]:
         return sorted(self._slots)
@@ -144,9 +139,9 @@ class KVCachePool:
         st.pos += 1
         if st.pos >= self.max_len:
             raise RuntimeError(
-                f"slot {slot} overran the cache capacity {self.max_len} — "
-                f"the admission check (bucket + max_new <= max_len) is "
-                f"broken")
+                f"slot {slot} overran the {self._capacity_noun} "
+                f"{self.max_len} — the admission check "
+                f"({self._admission_law}) is broken")
         return st.pos
 
     def slot_pos(self, slot: int) -> int:
@@ -185,6 +180,42 @@ class KVCachePool:
             out.append(b)
             b *= 2
         return out
+
+
+class KVCachePool(SlotPoolBase):
+    """Fixed-capacity pooled KV cache + slot allocator.
+
+    ``data`` is the jnp array ``[layers, 2, slots, heads, max_len,
+    head_dim]``; the engine threads it through the donated prefill and
+    decode steps and rebinds it here. Everything else is host
+    bookkeeping: which slots are live, where each slot's sequence starts
+    (``lo``) and currently ends (``pos``).
+    """
+
+    def __init__(self, num_layers: int, num_slots: int, num_heads: int,
+                 max_len: int, head_dim: int, dtype="float32",
+                 min_bucket: int = 8):
+        import jax.numpy as jnp
+
+        if num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+        if min_bucket < 1:
+            raise ValueError(f"min_bucket must be >= 1, got {min_bucket}")
+        if max_len < min_bucket:
+            raise ValueError(
+                f"max_len={max_len} is below min_bucket={min_bucket}: no "
+                f"prompt could ever be admitted")
+        self.num_layers = int(num_layers)
+        self.num_slots = int(num_slots)
+        self.num_heads = int(num_heads)
+        self.max_len = int(max_len)
+        self.head_dim = int(head_dim)
+        self.min_bucket = int(min_bucket)
+        self.shape = (self.num_layers, 2, self.num_slots, self.num_heads,
+                      self.max_len, self.head_dim)
+        self.dtype = jnp.dtype(dtype)
+        self.data = jnp.zeros(self.shape, self.dtype)
+        self._init_slots()
 
     def __repr__(self):
         return (f"<KVCachePool {self.shape} {self.data.dtype} "

@@ -1,0 +1,460 @@
+"""Paged KV-cache memory manager: block-granular pooling + prefix cache.
+
+The dense :class:`~.kv_pool.KVCachePool` reserves a full
+``[heads, max_len, head_dim]`` stripe per slot, so concurrency is capped
+by WORST-CASE sequence length even when most requests are short — the
+fragmentation problem paged, block-granular KV management solves on TPU
+(the Ragged-Paged-Attention argument, PAPERS.md). Here the device pool
+is ``[layers, 2, num_blocks + 1, heads, block_size, head_dim]``: a
+request owns only the blocks covering its tokens SO FAR, addressed
+through a per-request page table that maps virtual cache index
+``i`` to ``(table[i // block_size], i % block_size)``. Physical block 0
+is a reserved SCRATCH block — page-table padding points at it, prefill
+pad-position garbage lands in it, and nothing ever reads it through an
+unmasked position.
+
+Host-side manager (this module, scheduler-thread-owned):
+
+* **free-list block allocator** — blocks move between the free list,
+  request page tables (refcounted), and the prefix cache's LRU of
+  released-but-reusable blocks;
+* **page tables in pow2 buckets** — the decode step's table width is
+  the next power of two over the blocks a request holds (capped at
+  ``max_table_len``), so there is ONE decode trace per table bucket,
+  never one per table length — the serving twin of the dense engine's
+  pow2 prompt buckets;
+* **refcounts + copy-on-write** — a block reachable from several page
+  tables (prefix sharing) is never written through; the manager's
+  ``ensure_writable`` hands the engine a ``(dst, src)`` copy order and
+  swaps the table entry, so appends always hit a refcount-1 block. By
+  construction shared blocks sit strictly below every sharer's write
+  position (reuse is capped at ``(len - 1) // block_size`` full
+  blocks), so COW is a guard rail, not a hot path;
+* **prefix-cache trie** — full token blocks are registered under their
+  token-prefix key (the dict key IS the exact prefix tuple, so "hash"
+  collisions cannot alias two different prefixes); a later request
+  whose prompt starts with the same full blocks reuses their K/V and
+  skips prefill entirely (the remaining tokens are replayed through the
+  shared decode step, one per cycle — which is why the ENGINE only
+  takes the hit when the uncovered tail fits one ``min_bucket``; a
+  longer tail prefills fresh instead). Released cached blocks wait in
+  an LRU; allocation pressure evicts the oldest refcount-0 entry (and
+  unregisters its now-unreachable descendants) before giving up.
+
+Virtual layout note: unlike the dense pool's left-padded capacity
+buckets, paged sequences are aligned at virtual index 0 (``lo == 0``) —
+block contents then depend only on the token prefix, which is what
+makes them shareable across requests and prompt lengths.
+
+Monitor wiring (PR-1): ``serving/kv_blocks_in_use`` histogram,
+``serving/prefix_hit`` / ``serving/prefix_miss`` /
+``serving/prefill_tokens_saved`` / ``serving/prefix_evict`` counters
+(``serving/preempt`` is counted by the scheduler's preemption path).
+
+Threading contract: exactly the dense pool's — the manager is owned by
+the scheduler thread; ``data`` is rebound by the engine after every
+donated step.
+"""
+from __future__ import annotations
+
+import heapq
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..framework.monitor import stat_add, stat_observe
+from .kv_pool import SlotPoolBase
+
+__all__ = ["PagedKVPool", "PoolCapacityError", "PoolExhaustedError",
+           "BlockError"]
+
+
+class PoolCapacityError(ValueError):
+    """The request can NEVER fit this pool (virtual capacity or total
+    block budget) — raised at ``submit()`` time, fail fast."""
+
+
+class PoolExhaustedError(RuntimeError):
+    """No free and no evictable block right now — a TRANSIENT pressure
+    signal; the scheduler answers it by preempting the youngest active
+    request, never by corrupting the free list."""
+
+
+class BlockError(ValueError):
+    """Block bookkeeping misuse (double free / unref of an unreferenced
+    block) — named so tests can assert the free list was protected."""
+
+
+class _PagedSlot:
+    """Per-request decode state: virtual positions + the page table."""
+
+    __slots__ = ("pos", "lo", "table")
+
+    def __init__(self):
+        self.pos = 0
+        self.lo = 0
+        self.table: List[int] = []      # physical block ids, virtual order
+
+
+class _TrieNode:
+    """One cached full block. Keyed in ``_trie`` by the exact token
+    prefix tuple it encodes (root..this block, inclusive)."""
+
+    __slots__ = ("key", "block", "children")
+
+    def __init__(self, key: Tuple[int, ...], block: int):
+        self.key = key
+        self.block = block
+        self.children: set = set()      # child keys (one block longer)
+
+
+class PagedKVPool(SlotPoolBase):
+    """Block-pooled KV cache + page-table/prefix-cache manager.
+
+    ``data`` is the jnp array ``[layers, 2, num_blocks + 1, heads,
+    block_size, head_dim]`` (index 0 = scratch); the engine threads it
+    through the donated paged prefill/decode steps and rebinds it here.
+    ``num_slots`` bounds concurrent REQUESTS (the decode batch axis),
+    ``num_blocks`` bounds their total KV footprint — with mixed lengths
+    the block budget, not the slot count, is what fills first, and a
+    same-device-budget paged pool admits strictly more concurrent
+    requests than the dense pool (tests/test_serving_paging.py).
+    """
+
+    is_paged = True
+    _slot_cls = _PagedSlot
+    _capacity_noun = "virtual capacity"
+    _admission_law = "prompt + max_new <= max_len"
+
+    def __init__(self, num_layers: int, num_slots: int, num_heads: int,
+                 max_len: int, head_dim: int, *, block_size: int = 16,
+                 num_blocks: Optional[int] = None, dtype="float32",
+                 min_bucket: int = 8):
+        import jax.numpy as jnp
+
+        if num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+        if block_size < 1 or (block_size & (block_size - 1)):
+            raise ValueError(
+                f"block_size must be a power of two, got {block_size}")
+        if min_bucket < block_size or min_bucket % block_size:
+            raise ValueError(
+                f"min_bucket={min_bucket} must be a multiple of "
+                f"block_size={block_size} (prefill buckets scatter whole "
+                f"blocks)")
+        if max_len < min_bucket:
+            raise ValueError(
+                f"max_len={max_len} is below min_bucket={min_bucket}: no "
+                f"prompt could ever be admitted")
+        self.num_layers = int(num_layers)
+        self.num_slots = int(num_slots)
+        self.num_heads = int(num_heads)
+        self.max_len = int(max_len)
+        self.head_dim = int(head_dim)
+        self.block_size = int(block_size)
+        self.min_bucket = int(min_bucket)
+        # blocks a single request can ever hold (covers [0, max_len))
+        self.max_table_len = -(-self.max_len // self.block_size)
+        if num_blocks is None:
+            # dense-equivalent device budget: every slot could still go
+            # the full max_len (callers shrink this to realise the
+            # capacity win; see README "paged vs dense")
+            num_blocks = self.num_slots * self.max_table_len
+        self.num_blocks = int(num_blocks)
+        if self.num_blocks < self.max_table_len:
+            raise ValueError(
+                f"num_blocks={self.num_blocks} cannot hold even one "
+                f"max-length request ({self.max_table_len} blocks)")
+        # +1: physical block 0 is the reserved scratch block
+        self.shape = (self.num_layers, 2, self.num_blocks + 1,
+                      self.num_heads, self.block_size, self.head_dim)
+        self.dtype = jnp.dtype(dtype)
+        self.data = jnp.zeros(self.shape, self.dtype)
+        # min-heap: deterministic lowest-id allocation at O(log n) —
+        # unlike the base slot list (num_slots entries), num_blocks is
+        # production-large and a min()+remove() scan per block would
+        # sit on the per-decode-cycle hot path
+        self._free: List[int] = list(range(1, self.num_blocks + 1))
+        self._ref: Dict[int, int] = {}            # block -> request refs
+        self._init_slots()                        # request slots (base)
+        # prefix cache: exact-prefix-keyed trie + LRU of released blocks
+        self._trie: Dict[Tuple[int, ...], _TrieNode] = {}
+        self._block_key: Dict[int, Tuple[int, ...]] = {}
+        self._lru: "OrderedDict[Tuple[int, ...], _TrieNode]" = OrderedDict()
+        # pool-local prefix stats (engine.stats() reads these without
+        # scraping process-global monitor counters)
+        self.prefix_hits = 0
+        self.prefix_misses = 0
+        self.tokens_saved = 0
+
+    # -- request slots (decode batch axis: SlotPoolBase) -------------------
+    def _slot_freed(self, st: _PagedSlot) -> None:
+        """free() teardown: unref every block in the slot's page table.
+        Refcount-0 cached blocks stay in the prefix cache (LRU,
+        evictable); uncached ones return to the free list."""
+        for b in st.table:
+            self._unref(b)
+        self._observe()
+
+    def reset_data(self) -> None:
+        """Reallocate the (donated, possibly already-deleted) device
+        pool AND drop every cached block: zeroed device rows no longer
+        match any trie key, so serving a prefix hit off them would
+        replay garbage. Called by the scheduler's failure path after
+        every in-flight slot has been failed and freed."""
+        import jax.numpy as jnp
+        if self._slots:
+            raise RuntimeError(
+                "reset_data with live slots: fail and free them first")
+        self.data = jnp.zeros(self.shape, self.dtype)
+        self._trie.clear()
+        self._block_key.clear()
+        self._lru.clear()
+        self._ref.clear()
+        self._free = list(range(1, self.num_blocks + 1))
+        self._observe()
+
+    # (per-slot position tracking and the pow2 capacity buckets are the
+    # SlotPoolBase implementations, shared verbatim with the dense pool)
+
+    # -- block bookkeeping -------------------------------------------------
+    def blocks_for(self, n_tokens: int) -> int:
+        """Blocks covering virtual indices [0, n_tokens)."""
+        return -(-int(n_tokens) // self.block_size)
+
+    @property
+    def blocks_in_use(self) -> int:
+        """Blocks referenced by at least one page table (scratch and
+        cached-but-released blocks excluded)."""
+        return self.num_blocks - len(self._free) - len(self._lru)
+
+    @property
+    def blocks_available(self) -> int:
+        """Free plus evictable (released cached) blocks."""
+        return len(self._free) + len(self._lru)
+
+    @property
+    def cached_blocks(self) -> int:
+        """Blocks currently registered in the prefix cache (referenced
+        or waiting in the LRU)."""
+        return len(self._trie)
+
+    def can_admit(self, n_tokens: int) -> bool:
+        """Admission gate: enough free + evictable blocks to hold the
+        request's first ``n_tokens`` tokens. Growth past that is the
+        preemption policy's problem, so a head request never waits for
+        its WORST case — the whole point of paging."""
+        return self.blocks_available >= self.blocks_for(n_tokens)
+
+    def _observe(self) -> None:
+        stat_observe("serving/kv_blocks_in_use", self.blocks_in_use)
+
+    def _alloc_block(self) -> int:
+        if not self._free:
+            self._evict_one()            # raises PoolExhaustedError
+        b = heapq.heappop(self._free)    # deterministic, like slot alloc
+        self._ref[b] = 1
+        return b
+
+    def _unref(self, b: int) -> None:
+        rc = self._ref.get(b, 0)
+        if rc <= 0:
+            raise BlockError(
+                f"block {b} is not referenced (double free would corrupt "
+                f"the free list)")
+        self._ref[b] = rc - 1
+        if rc == 1:
+            key = self._block_key.get(b)
+            if key is not None and key in self._trie:
+                # released but cached: joins the LRU (most-recent end),
+                # reusable by a later prefix hit until evicted
+                self._lru[key] = self._trie[key]
+            else:
+                heapq.heappush(self._free, b)
+
+    def _evict_one(self) -> None:
+        """Reclaim the least-recently-released cached block (and drop
+        its now-unreachable cached descendants)."""
+        if not self._lru:
+            raise PoolExhaustedError(
+                f"all {self.num_blocks} blocks are referenced and the "
+                f"prefix cache has nothing to evict")
+        key = next(iter(self._lru))
+        self._drop_node(key)
+        stat_add("serving/prefix_evict")
+
+    def _drop_node(self, key: Tuple[int, ...]) -> None:
+        """Unregister the cached block at ``key`` and its subtree. A
+        refcount-0 block returns to the free list; a block still held
+        by a request merely loses its cache membership (its owner frees
+        it normally later)."""
+        node = self._trie.pop(key, None)
+        if node is None:
+            return
+        self._lru.pop(key, None)
+        self._block_key.pop(node.block, None)
+        if self._ref.get(node.block, 0) == 0:
+            heapq.heappush(self._free, node.block)
+        parent = self._trie.get(key[:-self.block_size])
+        if parent is not None:
+            parent.children.discard(key)
+        for child in list(node.children):
+            self._drop_node(child)
+
+    # -- admission: prefix matching + table setup --------------------------
+    def match_prefix(self, tokens) -> List[int]:
+        """Longest chain of cached full blocks covering a PROPER prefix
+        of ``tokens`` — capped at ``(len - 1) // block_size`` blocks so
+        at least one token is always recomputed (its forward pass is
+        what produces the next-token logits, and the cap is also what
+        keeps every write strictly past the shared region, making COW a
+        guard rail instead of a hot path). Returns the physical block
+        ids, longest match first-to-last. Read-only."""
+        toks = tuple(int(t) for t in tokens)
+        bs = self.block_size
+        blocks: List[int] = []
+        for i in range(1, (len(toks) - 1) // bs + 1):
+            node = self._trie.get(toks[:i * bs])
+            if node is None:
+                break
+            blocks.append(node.block)
+        return blocks
+
+    def admit_cached(self, slot: int, blocks: List[int]) -> None:
+        """Seed the slot's page table with matched prefix blocks
+        (refcount++ each; a block leaves the LRU while referenced)."""
+        st = self._require(slot)
+        if st.table:
+            raise BlockError(f"slot {slot} already has a page table")
+        for b in blocks:
+            rc = self._ref.get(b, 0)
+            self._ref[b] = rc + 1
+            if rc == 0:
+                self._lru.pop(self._block_key.get(b), None)
+        st.table = list(blocks)
+        self.prefix_hits += 1
+        self.tokens_saved += len(blocks) * self.block_size
+        stat_add("serving/prefix_hit")
+        stat_add("serving/prefill_tokens_saved",
+                 len(blocks) * self.block_size)
+        self._observe()
+
+    def admit_fresh(self, slot: int, n_tokens: int) -> List[int]:
+        """Allocate the page table covering ``[0, n_tokens)`` for a
+        prefix-miss prefill. All-or-nothing: on exhaustion the partial
+        allocation is rolled back and :class:`PoolExhaustedError`
+        propagates (admission re-tries next cycle)."""
+        st = self._require(slot)
+        if st.table:
+            raise BlockError(f"slot {slot} already has a page table")
+        got: List[int] = []
+        try:
+            for _ in range(self.blocks_for(n_tokens)):
+                got.append(self._alloc_block())
+        except PoolExhaustedError:
+            for b in got:
+                self._unref(b)
+            raise
+        st.table = got
+        self.prefix_misses += 1
+        stat_add("serving/prefix_miss")
+        self._observe()
+        return list(got)
+
+    def register_prefix(self, slot: int, tokens) -> None:
+        """Publish the slot's full token blocks into the prefix cache.
+        Called after a prefill WROTE them; an existing entry for the
+        same prefix stays canonical (this slot's duplicate block simply
+        remains privately owned)."""
+        st = self._require(slot)
+        toks = tuple(int(t) for t in tokens)
+        bs = self.block_size
+        for i in range(len(toks) // bs):
+            key = toks[:(i + 1) * bs]
+            if key in self._trie:
+                continue
+            block = st.table[i]
+            if block in self._block_key:
+                continue                  # already published elsewhere
+            self._trie[key] = _TrieNode(key, block)
+            self._block_key[block] = key
+            parent = self._trie.get(key[:-bs])
+            if parent is not None:
+                parent.children.add(key)
+
+    # -- decode-time growth + copy-on-write --------------------------------
+    def ensure_writable(self, slot: int) -> Optional[Tuple[int, int]]:
+        """Guarantee the block holding virtual index ``pos`` exists and
+        is exclusively owned before the decode step scatters into it.
+        Returns ``(dst, src)`` when the engine must device-copy a
+        shared block first (copy-on-write append), else None. May raise
+        :class:`PoolExhaustedError` — the scheduler's preemption
+        trigger."""
+        st = self._require(slot)
+        vb = st.pos // self.block_size
+        if vb > len(st.table):
+            raise RuntimeError(
+                f"slot {slot}: page table has {len(st.table)} blocks but "
+                f"pos={st.pos} needs block {vb} — positions outran "
+                f"allocation")
+        if vb == len(st.table):
+            st.table.append(self._alloc_block())
+            self._observe()
+            return None
+        b = st.table[vb]
+        if self._ref.get(b, 0) > 1:
+            nb = self._alloc_block()      # may raise: caller preempts
+            st.table[vb] = nb
+            self._unref(b)
+            self._observe()
+            return (nb, b)
+        key = self._block_key.get(b)
+        if key is not None:
+            # about to append into a cached block in place: its content
+            # will no longer match its prefix key, so unregister it
+            # (structurally unreachable — reuse is capped below every
+            # write position — but cheap to keep airtight)
+            self._drop_node(key)
+        return None
+
+    def table_bucket(self, slot: int) -> int:
+        """The slot's decode-trace bucket: next pow2 over its page-table
+        length, capped at ``max_table_len`` — ONE decode trace per
+        bucket, O(log max_table_len) buckets total."""
+        n = max(1, len(self._require(slot).table))
+        t = 1
+        while t < n:
+            t *= 2
+        return min(t, self.max_table_len)
+
+    def table_array(self, bucket: int, slots) -> np.ndarray:
+        """Dense int32 ``[num_slots, bucket]`` page-table operand for
+        the decode step. Rows of slots outside ``slots`` (and padding
+        past a member's table) read 0 — the scratch block, whose
+        gathered garbage the ``[lo, pos]`` mask hides and whose writes
+        nobody reads."""
+        out = np.zeros((self.num_slots, int(bucket)), np.int32)
+        for slot in slots:
+            table = self._require(slot).table
+            if len(table) > bucket:
+                raise RuntimeError(
+                    f"slot {slot}: table length {len(table)} exceeds its "
+                    f"bucket {bucket}")
+            out[slot, :len(table)] = table
+        return out
+
+    def slot_table(self, slot: int) -> List[int]:
+        return list(self._require(slot).table)
+
+    def _require(self, slot: int) -> _PagedSlot:
+        st = self._slots.get(slot)
+        if st is None:
+            raise ValueError(f"slot {slot} is not allocated")
+        return st
+
+    def __repr__(self):
+        return (f"<PagedKVPool blocks={self.blocks_in_use}/"
+                f"{self.num_blocks} x{self.block_size} "
+                f"active={self.n_active}/{self.num_slots} "
+                f"cached={len(self._trie)}>")

@@ -39,6 +39,7 @@ import numpy as np
 
 from ..framework.monitor import stat_add, stat_observe
 from ..profiler import span as _prof
+from .paging import PoolExhaustedError
 
 __all__ = ["QueueFullError", "DeadlineExceeded", "RequestCancelled",
            "GenerationRequest", "Scheduler"]
@@ -99,6 +100,11 @@ class GenerationRequest:
         self.tokens: List[int] = []     # generated so far (incl. EOS)
         self.emitted = 0
         self.last_token: Optional[int] = None
+        # paged engines only: prompt/generated tokens still to be fed
+        # through the decode step WITHOUT emitting (prefix-cache hits
+        # skip prefill; preempted requests replay their own history on
+        # re-admission). Rebuilt at every admission.
+        self.replay: List[int] = []
         self.first_token_at: Optional[float] = None
         # caller-side plumbing
         self._q: "queue.Queue" = queue.Queue()
@@ -187,12 +193,18 @@ class Scheduler:
     """
 
     def __init__(self, pool, do_prefill: Callable, do_decode: Callable, *,
-                 max_queue: int = 128, prefill_budget: Optional[int] = None):
+                 max_queue: int = 128, prefill_budget: Optional[int] = None,
+                 do_copy: Optional[Callable] = None):
         if max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {max_queue}")
         self._pool = pool
         self._do_prefill = do_prefill
         self._do_decode = do_decode
+        # paged pools bring block-granular admission, growth and
+        # preemption into the loop; the dense path is untouched
+        self._paged = bool(getattr(pool, "is_paged", False))
+        self._do_copy = do_copy          # device block copy (COW append)
+        self.preempts = 0                # requests evicted mid-flight
         self._max_queue = int(max_queue)
         # tokens of prefill allowed per cycle WHILE slots are decoding
         # (with an idle pool admission is unthrottled — there is nothing
@@ -329,7 +341,17 @@ class Scheduler:
                         f"request {req.id} exceeded its deadline while "
                         f"queued"))
                     continue
-                bucket = self._pool.bucket_for(len(req.prompt))
+                # paged re-admission (preemption) replays the request's
+                # own generated tokens, so the "prompt" being fed is the
+                # whole sequence so far
+                feed_len = len(req.prompt) + len(req.tokens) \
+                    if self._paged else len(req.prompt)
+                bucket = self._pool.bucket_for(feed_len)
+                if self._paged and not self._pool.can_admit(feed_len):
+                    # block pressure: wait for retirements (the head
+                    # keeps its FCFS place; submit-time capacity checks
+                    # guarantee it fits an idle pool, so no deadlock)
+                    return
                 if decode_waiting and budget < bucket:
                     # budget spent: decode the active slots first; the
                     # queue keeps its place (FCFS) and is retried next
@@ -341,9 +363,8 @@ class Scheduler:
                     return              # pool full: decode will retire
                 self._queue.pop(0)
                 stat_observe("serving/queue_depth", len(self._queue))
-            budget -= bucket
             try:
-                self._prefill(req, slot, bucket)
+                prefilled = self._prefill(req, slot, bucket)
             except Exception as exc:                    # noqa: BLE001
                 # at this point the request is in neither queue nor
                 # slots: fail it HERE (or its caller hangs forever) and
@@ -357,12 +378,36 @@ class Scheduler:
                         f"serving step failed for request {req.id}: "
                         f"{exc!r}"))
                 raise
+            if prefilled:
+                # a prefix-cache hit skipped prefill entirely, so it
+                # costs the cycle's prefill budget nothing — charging
+                # the bucket anyway would throttle exactly the
+                # admissions the cache made cheap
+                budget -= bucket
 
     def _prefill(self, req: GenerationRequest, slot: int,
-                 bucket: int) -> None:
+                 bucket: int) -> bool:
+        """Admit ``req`` into ``slot``. Returns whether a prefill
+        program actually ran (False = paged prefix-cache hit)."""
         with _prof.record("serving/prefill", "serving",
                           args={"bucket": bucket, "slot": slot}):
-            first = int(self._do_prefill(req, slot, bucket))
+            first = self._do_prefill(req, slot, bucket)
+        if self._paged:
+            # the engine set the slot's page table and positions; a
+            # None first token means a prefix-cache hit — prefill was
+            # skipped entirely and the remaining tokens arrive through
+            # the replay path of the decode cycles
+            self._slots[slot] = req
+            if first is None:
+                return False
+            stat_add("serving/prefill_tokens", bucket)
+            first = int(first)
+            req._emit(first)
+            stat_add("serving/tokens")
+            if self._finished(req, first):
+                self._retire(slot)
+            return True
+        first = int(first)
         stat_add("serving/prefill_tokens", bucket)
         # first generated token sits at cache index `bucket`; the slot's
         # valid keys start past the bucket's left pad
@@ -373,6 +418,7 @@ class Scheduler:
         stat_add("serving/tokens")
         if self._finished(req, first):
             self._retire(slot)
+        return True
 
     def _finished(self, req: GenerationRequest, tok: int) -> bool:
         return (req.eos_token_id is not None and tok == req.eos_token_id) \
@@ -386,7 +432,53 @@ class Scheduler:
             stat_add("serving/completed")
         req._finish(error)
 
+    # -- paged memory pressure: growth, copy-on-write, preemption ----------
+    def _preempt_youngest(self) -> bool:
+        """Evict the youngest active request to free its blocks: the
+        request is failed OUT of the pool but not failed to its caller
+        — it re-enters the queue at the head (it predates everything
+        queued) and replays its own history on re-admission. Returns
+        False when nothing is active to evict."""
+        if not self._slots:
+            return False
+        slot = max(self._slots, key=lambda s: self._slots[s].id)
+        req = self._slots.pop(slot)
+        self._pool.free(slot)
+        req.replay = []                  # rebuilt at re-admission
+        self.preempts += 1
+        stat_add("serving/preempt")
+        with self._cond:
+            self._queue.insert(0, req)
+            stat_observe("serving/queue_depth", len(self._queue))
+            self._cond.notify_all()
+        return True
+
+    def _prepare_paged(self) -> bool:
+        """Before a paged decode step: every active slot must own a
+        writable block at its position — grow tables, resolve
+        copy-on-write appends, and answer exhaustion by preempting the
+        youngest request (oldest-first order makes the youngest the
+        victim, never the beneficiary). Returns False when no slots
+        survive."""
+        for slot in sorted(self._slots,
+                           key=lambda s: self._slots[s].id):
+            while slot in self._slots:
+                try:
+                    cow = self._pool.ensure_writable(slot)
+                except PoolExhaustedError:
+                    # slot itself is active, so there is always a
+                    # youngest to evict — possibly slot itself, which
+                    # the while re-check then skips
+                    self._preempt_youngest()
+                    continue
+                if cow is not None and self._do_copy is not None:
+                    self._do_copy(*cow)
+                break
+        return bool(self._slots)
+
     def _decode_cycle(self) -> None:
+        if self._paged and not self._prepare_paged():
+            return
         active = dict(self._slots)
         t0 = time.perf_counter()
         with _prof.record("serving/decode_step", "serving",
@@ -408,6 +500,13 @@ class Scheduler:
                 self._retire(slot, DeadlineExceeded(
                     f"request {req.id} exceeded its deadline after "
                     f"{req.emitted} token(s)"))
+                continue
+            if req.replay:
+                # paged prefix-hit / re-admission: this cycle fed one
+                # known token; the model's prediction is discarded and
+                # the next known token queued — nothing reaches the
+                # caller until the replay drains
+                req.last_token = req.replay.pop(0)
                 continue
             tok = int(toks[slot])
             req._emit(tok)

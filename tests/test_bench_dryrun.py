@@ -63,3 +63,17 @@ def test_dry_run_emits_metrics_summary():
     assert out["checks"]["serving_one_trace_per_bucket"] is True, out
     assert "serving/ttft_ms" in res.stderr
     assert "serving/tokens_per_sec" in res.stderr
+    # PR-5 paged surface: mixed lengths through the paged engine all
+    # complete, the repeated system prompt hit the prefix cache (whole
+    # prefill blocks skipped), the paged decode step analyzed clean and
+    # every prefill/table bucket traced exactly once — plus the
+    # serving-host-sync self-lint staying green covers serving/paging.py
+    # (selflint_findings == 0 above already walks the whole package)
+    assert out["checks"]["paged_completed"] is True, out
+    assert out["checks"]["paged_prefix_hit"] is True, out
+    assert out["checks"]["paged_decode_clean"] is True, out
+    assert out["checks"]["paged_one_trace_per_bucket"] is True, out
+    assert out["paged_prefix_hits"] > 0, out
+    assert out["paged_tokens_saved"] > 0, out
+    assert "serving/kv_blocks_in_use" in res.stderr
+    assert "serving/prefix_hit" in res.stderr

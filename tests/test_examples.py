@@ -46,6 +46,17 @@ def test_serve_gpt2_example(tmp_path):
     assert "served 10 requests" in out
     assert "aggregate" in out and "tokens/s" in out
     assert "ttft p50" in out
+    assert "engine.stats():" in out          # the operator snapshot
+
+
+def test_serve_gpt2_example_paged(tmp_path):
+    out = _run([os.path.join(REPO, "examples", "serve_gpt2.py"),
+                "--clients", "8", "--slots", "4", "--train-steps", "20",
+                "--paged"],
+               tmp_path, timeout=600)
+    assert "served 8 requests" in out
+    assert "paged KV" in out
+    assert "prefix hit ratio" in out         # stats() paged section
 
 
 def test_generate_text_example(tmp_path):

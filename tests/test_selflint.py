@@ -47,6 +47,16 @@ def test_serving_host_sync_rule():
     out = lint_source("t.py", src, "serving/scheduler.py")
     assert [f.rule for f in out] == ["serving-host-sync"] * 3
     assert [f.line for f in out] == [3, 4, 5]
+    # the rule covers the PAGED memory manager too (serving/paging.py is
+    # scheduler-thread host bookkeeping — a sync there stalls every
+    # decode cycle exactly like one in the loop), and the module form
+    # jax.block_until_ready(x) is flagged like the method form
+    paged_src = ("import jax\n"
+                 "def ensure_writable(x):\n"
+                 "    return jax.block_until_ready(x)\n")
+    out = lint_source("t.py", paged_src, "serving/paging.py")
+    assert [f.rule for f in out] == ["serving-host-sync"]
+    assert "jax.block_until_ready" in out[0].message
     # the same calls OUTSIDE the serving package are unflagged (the
     # gather-and-run batcher in inference/serving.py blocks by design)
     assert lint_source("t.py", src, "inference/serving.py") == []
