@@ -604,6 +604,208 @@ BENCHES = {"gpt2": bench_gpt2, "resnet50": bench_resnet50,
 
 
 # ---------------------------------------------------------------------------
+# open-loop serving load harness (--serve-load)
+# ---------------------------------------------------------------------------
+
+def _load_schedule(seed, n, rate, system, vocab):
+    """Seeded OPEN-arrival schedule: Poisson arrivals at ``rate`` req/s
+    (exponential inter-arrival gaps, submitted on the clock regardless
+    of completions — the open-loop discipline that actually exposes
+    queueing collapse) with a mixed prompt/max_new distribution. ~40%
+    of prompts are the block-aligned system prefix plus a SHORT tail
+    (paged prefix-hit candidates), ~20% the prefix plus a long tail
+    (fresh prefill, shared blocks), the rest fully fresh. Lengths are
+    chosen so every request is feasible for BOTH engines at max_len=64:
+    dense needs bucket(prompt) + max_new <= 64 (prompt <= 31 -> bucket
+    32, max_new <= 16), paged needs prompt + max_new <= 64 and a
+    worst-re-admission bucket <= 64."""
+    import numpy as np
+    rng = np.random.RandomState(seed)
+    offsets = np.cumsum(rng.exponential(1.0 / rate, n))
+    schedule = []
+    for i in range(n):
+        kind = rng.rand()
+        if kind < 0.4:
+            tail = rng.randint(1, 8)       # fits one min_bucket: a hit
+        elif kind < 0.6:
+            tail = rng.randint(9, 16)      # too long: fresh prefill
+        else:
+            tail = None
+        if tail is not None:
+            ids = np.concatenate(
+                [system, rng.randint(1, vocab, tail)]).astype(np.int32)
+        else:
+            ids = rng.randint(1, vocab,
+                              rng.randint(3, 29)).astype(np.int32)
+        schedule.append((float(offsets[i]), ids,
+                         int(rng.randint(4, 17))))
+    return schedule
+
+
+def _run_serve_load(engine, schedule, slo_ms):
+    """Drive one engine with the schedule; returns (summary, handles).
+    TTFT/TPOT come from each handle's RequestTrace — per-request,
+    per-engine, no process-global histogram involved. Goodput is the
+    SLO-metric that matters: completed requests whose TTFT met the
+    latency SLO, per second of wall clock."""
+    from paddle_tpu.framework.monitor import _percentile
+    from paddle_tpu.serving import QueueFullError
+
+    t_start = time.perf_counter()
+    handles, shed, failed = [], 0, 0
+    for off, ids, max_new in schedule:
+        delay = t_start + off - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        try:
+            handles.append(engine.submit(ids, max_new_tokens=max_new))
+        except QueueFullError:
+            shed += 1                      # open loop: the caller sheds
+    for h in handles:
+        try:
+            h.result(timeout=600)
+        except Exception:                  # noqa: BLE001
+            failed += 1
+    wall = time.perf_counter() - t_start
+    traces = [h.trace for h in handles]
+    ttft = sorted(t.ttft_ms for t in traces if t.ttft_ms is not None)
+    tpot = sorted(t.tpot_ms for t in traces if t.tpot_ms is not None)
+
+    def pct(vals):
+        return {"p50": round(_percentile(vals, 0.5), 2),
+                "p95": round(_percentile(vals, 0.95), 2),
+                "p99": round(_percentile(vals, 0.99), 2),
+                "count": len(vals)}
+
+    good = sum(1 for t in traces
+               if t.t("finish") is not None and t.ttft_ms is not None
+               and t.ttft_ms <= slo_ms)
+    summary = {
+        "requests": len(schedule), "shed": shed, "failed": failed,
+        "completed": sum(1 for t in traces if t.t("finish") is not None),
+        "wall_sec": round(wall, 3),
+        "tokens": int(sum(len(t.token_times) for t in traces)),
+        "ttft_ms": pct(ttft), "tpot_ms": pct(tpot),
+        "slo_ms": slo_ms,
+        "slo_attainment": round(good / max(1, len(schedule)), 4),
+        "goodput_rps": round(good / wall, 2),
+    }
+    return summary, handles
+
+
+def _serve_load_engine(kind, model, schedule, slo_ms, num_slots=8):
+    """One engine's leg of the load run: drive it, then fold in the
+    per-engine stats()/flight-recorder view and the zero-retrace check
+    (every serving trace-probe site of THIS engine compiled exactly
+    once — a retrace storm under load is the bug class the pow2 bucket
+    discipline exists to prevent)."""
+    from paddle_tpu.framework import trace_probe
+    from paddle_tpu.serving import GenerationEngine
+
+    import numpy as np
+
+    kw = dict(num_slots=num_slots, max_len=64, min_bucket=8)
+    if kind == "paged":
+        kw.update(kv_layout="paged", block_size=8)
+    eng = GenerationEngine(model, **kw)
+    # warm the compile caches BEFORE the clock starts: one request per
+    # prefill bucket the schedule can touch (8/16/32, plus the paged
+    # engine's deeper page-table buckets) — the measured TTFT curve
+    # must reflect serving behavior, not XLA cold compiles
+    warm = [(4, 2), (12, 2), (28, 2)]
+    if kind == "paged":
+        warm.append((40, 14))            # grows the table to bucket 8
+    for plen, mnew in warm:
+        eng.submit(np.full(plen, 1, np.int32),
+                   max_new_tokens=mnew).result(timeout=600)
+    summary, _ = _run_serve_load(eng, schedule, slo_ms)
+    stats = eng.stats()
+    recorder = eng.dump_flight_recorder()
+    eng.close()
+    sites = {k: v for k, v in trace_probe.snapshot().items()
+             if k.startswith("serving/")
+             and k.endswith(f"#{eng._eid}")}   # suffix: #1 isn't #12
+    summary["zero_decode_retraces"] = bool(sites) and all(
+        s["traces"] == 1 and not s["causes"] for s in sites.values())
+    summary["preempts"] = stats["preempts"]
+    summary["preempt_rate"] = round(
+        stats["preempts"] / max(1, summary["requests"]), 4)
+    # NOTE: the summary's ttft_ms/tpot_ms percentiles come from the
+    # MEASURED handles' traces only; engine.stats() latency is not
+    # republished here because its reservoirs also hold the warm-up
+    # requests (whose TTFT contains XLA compile time)
+    summary["flight_recorder_cycles"] = recorder["cycles_recorded"]
+    if kind == "paged":
+        summary["prefix_hits"] = stats["prefix_hits"]
+        summary["prefix_hit_ratio"] = round(stats["prefix_hit_ratio"], 4)
+        summary["prefill_tokens_saved"] = stats["prefill_tokens_saved"]
+        summary["prefix_evictions"] = stats["prefix_evictions"]
+    return summary
+
+
+def serve_load():
+    """``bench.py --serve-load``: the serving SLO load harness
+    (OPEN-loop — arrivals follow the seeded clock, never the responses,
+    so queueing collapse shows instead of self-throttling).
+
+    Drives the SAME seeded open-arrival trace (Poisson arrivals, mixed
+    prompt/max_new lengths, a shared system prefix) against a dense and
+    a paged engine over a tiny GPT and writes the measured curve —
+    TTFT/TPOT p50/p95/p99, goodput at the stated latency SLO,
+    preemption/eviction/prefix-hit rates, zero-retrace check — into
+    ``BENCH_serve_load.json``. This is the measurement every future
+    serving claim ("paged admits more", "spec decode is faster")
+    reports against; ROADMAP "Production front door + load harness"."""
+    import argparse
+
+    import numpy as np
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--serve-load", action="store_true")
+    ap.add_argument("--rate", type=float, default=32.0,
+                    help="mean arrival rate, requests/sec")
+    ap.add_argument("--requests", type=int, default=48)
+    ap.add_argument("--slo-ms", type=float, default=250.0,
+                    help="TTFT SLO the goodput figure is stated at")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--slots", type=int, default=8)
+    ap.add_argument("--out", default=os.path.join(
+        HERE, "BENCH_serve_load.json"))
+    args = ap.parse_args()
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining
+
+    paddle.framework.random.seed(0)
+    cfg = GPTConfig.tiny()
+    model = GPTForPretraining(cfg)
+    model.eval()
+    # two full 8-token blocks: the shareable system preamble
+    system = np.arange(2, 18, dtype=np.int32)
+    schedule = _load_schedule(args.seed, args.requests, args.rate,
+                              system, cfg.vocab_size)
+    out = {"metric": "serve_load_goodput_rps", "unit": "req/s@SLO",
+           "rate_rps": args.rate, "requests": args.requests,
+           "slo_ms": args.slo_ms, "seed": args.seed,
+           "num_slots": args.slots, "engines": {}}
+    try:
+        out["device_kind"] = _device_kind()
+    except Exception:                                  # noqa: BLE001
+        out["device_kind"] = "unknown"
+    for kind in ("dense", "paged"):
+        out["engines"][kind] = _serve_load_engine(
+            kind, model, schedule, args.slo_ms, num_slots=args.slots)
+    out["value"] = out["engines"]["paged"]["goodput_rps"]
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=2)
+    print(json.dumps(out), flush=True)
+    ok = all(e["completed"] + e["shed"] == e["requests"]
+             and e["failed"] == 0 and e["zero_decode_retraces"]
+             for e in out["engines"].values())
+    sys.exit(0 if ok else 1)
+
+
+# ---------------------------------------------------------------------------
 # parent orchestration
 # ---------------------------------------------------------------------------
 
@@ -876,10 +1078,14 @@ def dry_run():
     PAGED engine (block pool + page tables + prefix cache) — mixed
     lengths all complete, a repeated system prompt scores
     ``serving/prefix_hit`` with prefill tokens saved, and each
-    prefill/table bucket traces once. Prints the
-    stats summary to stderr and ONE JSON line to stdout; exits nonzero
-    when any assertion fails, so CI catches an instrumentation or
-    fast-path regression before it costs a real benchmark round."""
+    prefill/table bucket traces once. ISSUE-6 addition: a seeded mini
+    serve-load run through the --serve-load harness helpers — request
+    traces complete in lifecycle order with derived TTFT/TPOT,
+    ``serving/tpot_ms`` live, per-engine stats() latency present, the
+    always-on flight recorder non-empty, zero decode retraces. Prints
+    the stats summary to stderr and ONE JSON line to stdout; exits
+    nonzero when any assertion fails, so CI catches an instrumentation
+    or fast-path regression before it costs a real benchmark round."""
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import tempfile
 
@@ -1034,7 +1240,8 @@ def dry_run():
             stats = eng.stats()
             eng.close()
             sites = {k: v for k, v in trace_probe.snapshot().items()
-                     if k.startswith("serving/") and f"#{eng._eid}" in k}
+                     if k.startswith("serving/")
+                     and k.endswith(f"#{eng._eid}")}
             one_trace = bool(sites) and all(
                 s["traces"] == 1 and not s["causes"]
                 for s in sites.values())
@@ -1042,6 +1249,58 @@ def dry_run():
 
         paged_served, paged_report, paged_one_trace, paged_stats = \
             _paged_canary()
+
+        # serve-load canary (ISSUE 6): a seeded mini open-arrival run
+        # through the SAME harness --serve-load uses — every trace
+        # completes in lifecycle order, TTFT/TPOT derive per request,
+        # the serving/tpot_ms histogram is live, the flight recorder's
+        # rings are non-empty and the engine's decode never retraced.
+        def _serve_load_canary():
+            from paddle_tpu.framework import trace_probe
+            from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining
+            from paddle_tpu.serving import GenerationEngine
+
+            paddle.framework.random.seed(0)
+            cfg = GPTConfig.tiny()
+            m = GPTForPretraining(cfg)
+            m.eval()
+            system = np.arange(2, 18, dtype=np.int32)
+            schedule = _load_schedule(seed=7, n=6, rate=200.0,
+                                      system=system, vocab=cfg.vocab_size)
+            eng = GenerationEngine(m, num_slots=4, max_len=64,
+                                   min_bucket=8)
+            # CPU-scale SLO: the canary asserts the measurement works,
+            # not that an untuned CPU backend meets a production SLO
+            summary, handles = _run_serve_load(eng, schedule,
+                                               slo_ms=60_000.0)
+            recorder = eng.dump_flight_recorder()
+            stats = eng.stats()
+            eng.close()
+            sites = {k: v for k, v in trace_probe.snapshot().items()
+                     if k.startswith("serving/")
+                     and k.endswith(f"#{eng._eid}")}
+            traces_ok = summary["completed"] == len(schedule) and all(
+                h.trace.completed
+                and h.trace.t("submit") <= h.trace.t("admitted")
+                <= h.trace.t("first_token") <= h.trace.finished_at
+                and h.trace.ttft_ms is not None
+                for h in handles)
+            return {
+                "traces_complete": traces_ok,
+                "summary": summary,
+                "engine_latency_present":
+                    stats["ttft_ms"] is not None
+                    and stats["tpot_ms"] is not None
+                    and stats["ttft_ms"]["count"] == len(schedule),
+                "flight_recorder_nonempty":
+                    len(recorder["cycles"]) > 0
+                    and len(recorder["events"]) > 0,
+                "zero_retraces": bool(sites) and all(
+                    s["traces"] == 1 and not s["causes"]
+                    for s in sites.values()),
+            }
+
+        serve_load_canary = _serve_load_canary()
 
     counters = monitor.all_stats()
     host_syncs = monitor.stat_get("hapi/host_sync")
@@ -1108,6 +1367,19 @@ def dry_run():
             and paged_stats["prefix_hit_ratio"] > 0,
         "paged_decode_clean": paged_report.ok(),
         "paged_one_trace_per_bucket": paged_one_trace,
+        # ISSUE-6 serving observability: the mini serve-load run's
+        # traces all completed in lifecycle order, the per-token decode
+        # cadence histogram is live, per-engine stats() latency derives
+        # from the engine's own traces, and the always-on flight
+        # recorder captured cycles + events without the profiler
+        "serve_load_traces_complete":
+            serve_load_canary["traces_complete"],
+        "serve_load_tpot_live":
+            monitor.stat_histogram("serving/tpot_ms") is not None
+            and serve_load_canary["engine_latency_present"],
+        "serve_load_flight_recorder":
+            serve_load_canary["flight_recorder_nonempty"],
+        "serve_load_zero_retraces": serve_load_canary["zero_retraces"],
     }
     print(monitor.stats_summary(), file=sys.stderr)
     for f in lint_findings:
@@ -1139,6 +1411,7 @@ def dry_run():
                           monitor.stat_get("serving/prefix_hit"),
                       "paged_tokens_saved":
                           monitor.stat_get("serving/prefill_tokens_saved"),
+                      "serve_load": serve_load_canary["summary"],
                       "loss": round(float(loss), 4), "checks": checks}),
           flush=True)
     sys.exit(0 if ok else 1)
@@ -1148,6 +1421,8 @@ if __name__ == "__main__":
     if len(sys.argv) >= 3 and sys.argv[1] == "--child":
         result = BENCHES[sys.argv[2]]()
         print("RESULT " + json.dumps(result))
+    elif "--serve-load" in sys.argv[1:]:
+        serve_load()
     elif "--dry-run" in sys.argv[1:]:
         dry_run()
     else:

@@ -165,13 +165,20 @@ def main():
 
     for ln in sorted(lines):
         print(ln)
-    ttft = monitor.stat_histogram("serving/ttft_ms") or {}
+    # per-ENGINE latency percentiles, derived from this engine's own
+    # request traces (stats()["ttft_ms"/"tpot_ms"]) — unlike the
+    # process-global monitor histograms, these cannot be contaminated
+    # by another engine in the same process
+    ttft = stats["ttft_ms"] or {}
+    tpot = stats["tpot_ms"] or {}
     total_tokens = monitor.stat_get("serving/tokens")
     print(f"\nserved {args.clients} requests in {wall:.2f}s: "
           f"{total_tokens:.0f} tokens, "
           f"aggregate {total_tokens / wall:.1f} tokens/s, "
           f"ttft p50 {ttft.get('p50', 0):.1f} ms "
-          f"p95 {ttft.get('p95', 0):.1f} ms")
+          f"p95 {ttft.get('p95', 0):.1f} ms, "
+          f"tpot p50 {tpot.get('p50', 0):.2f} ms "
+          f"p95 {tpot.get('p95', 0):.2f} ms")
     # the operator snapshot: one call instead of scraping serving/*
     # monitor counters by prefix
     print(f"engine.stats(): layout={stats['kv_layout']} "

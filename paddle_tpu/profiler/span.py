@@ -40,7 +40,8 @@ import time
 from typing import Any, Callable, Dict, List, Optional
 
 __all__ = ["record", "profile", "enable", "disable", "reset", "is_active",
-           "events", "dropped", "export_chrome_trace", "export_prometheus",
+           "events", "dropped", "add_event", "set_thread_name",
+           "thread_names", "export_chrome_trace", "export_prometheus",
            "span_summary"]
 
 # hot-path gate: instrumentation sites check this module attribute before
@@ -54,6 +55,12 @@ _dropped = 0
 _max_events = 1_000_000
 _jax_bridge = False
 _tls = threading.local()
+# tid -> human label for the trace viewer (real python threads AND the
+# synthetic per-request lanes the serving tracer emits). Survives
+# reset() — lane identity is stable across sessions — and is bounded so
+# a thread-churning server cannot grow it without limit.
+_thread_names: Dict[int, str] = {}
+_MAX_THREAD_NAMES = 4096
 
 
 def _flag(name: str, default):
@@ -128,6 +135,49 @@ def events() -> List[Dict[str, Any]]:
                     "dur": (t1 - t0) * 1e6, "tid": tid, "depth": depth,
                     "parent": parent, "args": args})
     return out
+
+
+def set_thread_name(name: str, tid: Optional[int] = None) -> None:
+    """Label a trace lane for the chrome-trace viewer: the calling
+    thread's by default, or an explicit ``tid`` (used for the serving
+    tracer's synthetic per-request lanes). The export emits these as
+    ``thread_name`` metadata events so the viewer shows "serving
+    scheduler" instead of a bare thread ident. Cheap enough to call
+    unconditionally; first-writer-wins per tid keeps a thread that
+    plays several roles from flapping."""
+    if tid is None:
+        tid = threading.get_ident()
+    with _lock:
+        if tid not in _thread_names and \
+                len(_thread_names) < _MAX_THREAD_NAMES:
+            _thread_names[tid] = str(name)
+
+
+def thread_names() -> Dict[int, str]:
+    with _lock:
+        return dict(_thread_names)
+
+
+def add_event(name: str, category: str, t0: float, t1: float, *,
+              tid: Optional[int] = None, depth: int = 0,
+              parent: Optional[str] = None,
+              args: Optional[dict] = None) -> None:
+    """Append one already-timed span to the buffer (same gate/cap as a
+    live ``record()`` span). The escape hatch for events whose begin/end
+    do not bracket a code region on the current thread — e.g. a serving
+    request's lifecycle, reconstructed onto a synthetic lane when it
+    finishes. ``t0``/``t1`` are ``time.perf_counter()`` seconds."""
+    if not _active:
+        return
+    global _dropped
+    if tid is None:
+        tid = threading.get_ident()
+    with _lock:
+        if len(_events) < _max_events:
+            _events.append((name, category, float(t0), float(t1),
+                            int(tid), int(depth), parent, args))
+        else:
+            _dropped += 1
 
 
 def _stack() -> list:
@@ -295,6 +345,12 @@ def export_chrome_trace(path: str) -> str:
     pid = os.getpid()
     trace = [{"name": "process_name", "ph": "M", "pid": pid,
               "args": {"name": "paddle_tpu"}}]
+    # thread/lane labels: scheduler, submitter and stream-consumer
+    # threads (and the serving tracer's per-request lanes) show their
+    # registered names in the viewer instead of bare tids
+    for tid, tname in sorted(thread_names().items()):
+        trace.append({"name": "thread_name", "ph": "M", "pid": pid,
+                      "tid": tid, "args": {"name": tname}})
     for ev in events():
         trace.append({
             "name": ev["name"], "cat": ev["cat"], "ph": "X", "pid": pid,

@@ -33,24 +33,39 @@ block sharing and a prefix cache, so admission gates on FREE BLOCKS
 instead of worst-case slot stripes and a repeated system prompt skips
 prefill entirely.
 
+SLO observability (ISSUE 6): every handle carries ``handle.trace`` — a
+:class:`~.tracing.RequestTrace` of timestamped lifecycle events with
+derived per-request TTFT/TPOT — the scheduler keeps an always-on
+bounded :class:`~.flight_recorder.FlightRecorder`
+(``engine.dump_flight_recorder()``, auto-dumped on step failure), and
+``engine.stats()`` reports per-ENGINE TTFT/TPOT percentiles from its
+own retired traces. ``bench.py --serve-load`` drives seeded
+open-arrival traffic against both KV layouts and writes the
+TTFT/TPOT/goodput curve into a BENCH json.
+
 Modules: :mod:`.kv_pool` (the pooled cache + slot allocator +
 capacity buckets), :mod:`.paging` (the paged block pool: free-list
 allocator, page tables, refcounts/copy-on-write, prefix-cache trie +
 LRU eviction), :mod:`.scheduler` (admission queue, backpressure,
 prefill-budget policy, block-pressure preemption, the decode loop),
-:mod:`.engine` (the thread-safe user surface +
+:mod:`.tracing` (per-request lifecycle traces + chrome-trace lanes),
+:mod:`.flight_recorder` (bounded postmortem rings + per-engine latency
+reservoirs), :mod:`.engine` (the thread-safe user surface +
 monitor/profiler/analysis wiring).
 """
 from __future__ import annotations
 
 from .engine import GenerationEngine  # noqa: F401
+from .flight_recorder import FlightRecorder  # noqa: F401
 from .kv_pool import KVCachePool  # noqa: F401
 from .paging import (BlockError, PagedKVPool,  # noqa: F401
                      PoolCapacityError, PoolExhaustedError)
 from .scheduler import (DeadlineExceeded, GenerationRequest,  # noqa: F401
                         QueueFullError, RequestCancelled, Scheduler)
+from .tracing import RequestTrace  # noqa: F401
 
 __all__ = ["GenerationEngine", "KVCachePool", "PagedKVPool",
            "GenerationRequest", "Scheduler", "QueueFullError",
            "DeadlineExceeded", "RequestCancelled", "PoolCapacityError",
-           "PoolExhaustedError", "BlockError"]
+           "PoolExhaustedError", "BlockError", "RequestTrace",
+           "FlightRecorder"]

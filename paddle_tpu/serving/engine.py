@@ -31,16 +31,25 @@ CAPACITY BUCKET (pow2 prompt lengths) — all watched by
 up in the ``dispatch/retrace_cause`` counters exactly like
 training-loop churn.
 
-Observability (PR-1 wiring): counters ``serving/requests``,
-``serving/completed``, ``serving/tokens``, ``serving/preempt``,
-``serving/queue_full``, ``serving/cancelled``,
+Observability (PR-1 wiring + the ISSUE-6 SLO spine): counters
+``serving/requests``, ``serving/completed``, ``serving/tokens``,
+``serving/preempt``, ``serving/queue_full``, ``serving/cancelled``,
 ``serving/deadline_exceeded``, ``serving/prefix_hit``/``prefix_miss``/
 ``prefill_tokens_saved``/``prefix_evict`` (paged); histograms
-``serving/queue_depth``, ``serving/active_slots``, ``serving/ttft_ms``,
-``serving/tokens_per_sec``, ``serving/kv_blocks_in_use`` (paged);
-spans ``serving/prefill`` and ``serving/decode_step``. The
-:meth:`GenerationEngine.stats` snapshot packages the operator view so
-nobody has to scrape monitor counters by prefix.
+``serving/queue_depth``, ``serving/active_slots``,
+``serving/batch_occupancy``, ``serving/cycle_ms``, ``serving/ttft_ms``,
+``serving/tpot_ms``, ``serving/tokens_per_sec``,
+``serving/kv_blocks_in_use`` (paged); spans ``serving/cycle`` with
+nested sweep/admit/prefill/decode_dispatch/host_fetch children, plus a
+chrome-trace LANE per finished request (``serving/tracing.py``). Every
+request handle carries ``handle.trace`` (a
+:class:`~.tracing.RequestTrace` with derived TTFT/TPOT), the scheduler
+keeps an always-on bounded flight recorder
+(:meth:`GenerationEngine.dump_flight_recorder`, auto-dumped when a
+step failure poisons requests), and the :meth:`GenerationEngine.stats`
+snapshot packages the operator view — per-ENGINE TTFT/TPOT percentiles
+included — so nobody has to scrape process-global monitor counters by
+prefix.
 """
 from __future__ import annotations
 
@@ -306,7 +315,14 @@ class GenerationEngine:
             "slots_in_use": pool.n_active,
             "slot_utilization": pool.n_active / pool.num_slots,
             "preempts": self._sched.preempts,
+            "requests_retired": self._sched.recorder.retired,
         }
+        # per-ENGINE latency percentiles, derived from this engine's own
+        # retired request traces — the process-global serving/ttft_ms
+        # histogram aggregates every engine ever constructed in the
+        # process, so two engines (or back-to-back tests) would
+        # contaminate each other's figures there
+        s.update(self._sched.recorder.latency_summary())
         if self._paged:
             hits, misses = pool.prefix_hits, pool.prefix_misses
             s.update({
@@ -319,8 +335,22 @@ class GenerationEngine:
                 "prefix_misses": misses,
                 "prefix_hit_ratio": hits / max(1, hits + misses),
                 "prefill_tokens_saved": pool.tokens_saved,
+                "prefix_evictions": pool.evictions,
             })
         return s
+
+    def dump_flight_recorder(self, path: Optional[str] = None) -> dict:
+        """Postmortem snapshot of the scheduler's always-on flight
+        recorder — the last N cycle records (sweep/admit/prefill/
+        decode-dispatch/host-fetch breakdown, occupancy, queue depth)
+        and the tail of every request's lifecycle events — plus this
+        engine's :meth:`stats` snapshot. Written to ``path`` as JSON
+        when given; also dumped AUTOMATICALLY (to a temp file, path in
+        ``engine._sched.recorder.last_dump_path``) when a step failure
+        poisons the in-flight requests, so a production stall is
+        debuggable without the profiler ever having been armed."""
+        return self._sched.recorder.dump(path, extra={"engine":
+                                                      self.stats()})
 
     def analyze(self, passes=None):
         """PR-3 pre-flight of THE decode step: trace the jitted program
@@ -432,6 +462,8 @@ class GenerationEngine:
             pool.set_slot(slot, pos=m, lo=0)
             req.last_token = int(feed[m])
             req.replay = [int(t) for t in feed[m + 1:]]
+            req.trace.mark("prefix_hit", tokens_saved=m,
+                           replay=len(req.replay))
             return None
         blocks = pool.admit_fresh(slot, feed.size)
         table = np.zeros(bucket // pool.block_size, np.int32)
@@ -449,7 +481,10 @@ class GenerationEngine:
         req.replay = []
         return int(_fetch(first)[0])
 
-    def _run_decode(self, slot_requests) -> np.ndarray:
+    def _run_decode(self, slot_requests):
+        """Dispatch ONE decode step; returns the next-token DEVICE
+        array — the scheduler performs the windowed ``_fetch`` itself so
+        its cycle telemetry can time dispatch and host-fetch apart."""
         S = self._pool.num_slots
         tokens = np.zeros(S, np.int32)
         sample_mask = np.zeros(S, bool)
@@ -468,11 +503,11 @@ class GenerationEngine:
             self._pool.data, nxt, self._key = self._paged_decode_fn(T)(
                 self._params, self._buffers, self._pool.data, tokens, pos,
                 lo, tables, sample_mask, temps, self._key)
-            return _fetch(nxt)
+            return nxt
         self._pool.data, nxt, self._key = self._decode_jit(
             self._params, self._buffers, self._pool.data, tokens, pos, lo,
             sample_mask, temps, self._key)
-        return _fetch(nxt)
+        return nxt
 
     def _run_copy(self, dst: int, src: int) -> None:
         """Copy-on-write append support: device-copy block ``src`` over

@@ -1,0 +1,162 @@
+"""Scheduler flight recorder: bounded postmortem telemetry, always on.
+
+The span profiler answers "where did the time go" — but only while a
+``profile()`` session is armed, which it never is when a production
+scheduler stalls at 3am. The flight recorder is the always-on
+complement: two bounded ring buffers the scheduler writes on every
+cycle (host dicts, no device work, O(1) per cycle), dumpable as JSON
+after the fact:
+
+* **cycle records** — per scheduler cycle: the sweep / admission /
+  prefill / decode-dispatch / host-fetch wall-time breakdown, batch
+  occupancy, queue depth, tokens emitted, and (paged) blocks in use;
+* **request events** — the tail of every request's lifecycle marks
+  (submit, admitted, preempt, first_token, finish/cancel/deadline/
+  error) interleaved in arrival order, so "which request was in flight
+  when cycle N went sideways" is answerable.
+
+It also aggregates per-engine latency samples: every retired
+:class:`~.tracing.RequestTrace` deposits its TTFT/TPOT here, and
+``engine.stats()`` reads the percentiles from THIS recorder — so two
+engines in one process (or back-to-back tests) never contaminate each
+other the way the process-global monitor histograms do.
+
+``engine.dump_flight_recorder()`` snapshots everything on demand; the
+scheduler's step-failure path calls :meth:`auto_dump` so a poisoned
+cycle leaves a postmortem file behind even when nobody was watching.
+
+Threading: written by the scheduler thread, read by any (stats / dump)
+— every method takes the one small lock; writes are per-cycle, not
+per-token, so contention is negligible.
+"""
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from ..framework.monitor import _percentile
+
+__all__ = ["FlightRecorder"]
+
+
+class FlightRecorder:
+    """Bounded ring buffers of scheduler cycles + request events, plus
+    per-engine TTFT/TPOT sample reservoirs."""
+
+    def __init__(self, max_cycles: int = 256, max_events: int = 2048,
+                 max_samples: int = 4096):
+        if max_cycles < 1 or max_events < 1:
+            raise ValueError("flight recorder rings must hold >= 1 entry")
+        self._lock = threading.Lock()
+        self._cycles: deque = deque(maxlen=int(max_cycles))
+        self._events: deque = deque(maxlen=int(max_events))
+        self._ttft: deque = deque(maxlen=int(max_samples))
+        self._tpot: deque = deque(maxlen=int(max_samples))
+        self.cycles_recorded = 0       # monotonic (ring drops, this doesn't)
+        self.events_recorded = 0
+        self.retired = 0
+        self.last_dump_path: Optional[str] = None
+        self.dumps = 0
+
+    # -- writers (scheduler thread) ----------------------------------------
+    def record_cycle(self, rec: Dict[str, Any]) -> None:
+        with self._lock:
+            self._cycles.append(rec)
+            self.cycles_recorded += 1
+
+    def record_event(self, request_id: int, name: str,
+                     t: Optional[float] = None,
+                     meta: Optional[dict] = None) -> None:
+        ev = {"request": int(request_id), "event": name,
+              "t": t if t is not None else time.perf_counter()}
+        if meta:
+            ev["meta"] = meta
+        with self._lock:
+            self._events.append(ev)
+            self.events_recorded += 1
+
+    def retire(self, trace) -> None:
+        """A request finished: bank its derived latencies so stats()
+        percentiles come from this engine's own traffic."""
+        ttft, tpot = trace.ttft_ms, trace.tpot_ms
+        with self._lock:
+            self.retired += 1
+            if ttft is not None:
+                self._ttft.append(ttft)
+            if tpot is not None:
+                self._tpot.append(tpot)
+
+    # -- readers -----------------------------------------------------------
+    def latency_summary(self) -> Dict[str, Optional[dict]]:
+        """Per-engine ``{"ttft_ms": {...}, "tpot_ms": {...}}`` with
+        count/p50/p95/p99 over the retired-trace reservoirs (None while
+        no request has produced the respective samples)."""
+        with self._lock:
+            ttft, tpot = list(self._ttft), list(self._tpot)
+
+        def pct(vals: List[float]) -> Optional[dict]:
+            if not vals:
+                return None
+            s = sorted(vals)
+            return {"count": len(s), "p50": _percentile(s, 0.5),
+                    "p95": _percentile(s, 0.95),
+                    "p99": _percentile(s, 0.99)}
+
+        return {"ttft_ms": pct(ttft), "tpot_ms": pct(tpot)}
+
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON-serializable copy of both rings + the counters."""
+        with self._lock:
+            return {
+                "cycles": [dict(c) for c in self._cycles],
+                "events": [dict(e) for e in self._events],
+                "cycles_recorded": self.cycles_recorded,
+                "events_recorded": self.events_recorded,
+                "requests_retired": self.retired,
+                "ring_capacity": {"cycles": self._cycles.maxlen,
+                                  "events": self._events.maxlen},
+            }
+
+    # -- dumps -------------------------------------------------------------
+    def dump(self, path: Optional[str] = None,
+             extra: Optional[dict] = None) -> Dict[str, Any]:
+        """Snapshot (plus ``extra``, e.g. engine stats); written to
+        ``path`` as JSON when given. Returns the document."""
+        doc = self.snapshot()
+        doc["latency"] = self.latency_summary()
+        if extra:
+            doc.update(extra)
+        if path:
+            d = os.path.dirname(os.path.abspath(path))
+            os.makedirs(d, exist_ok=True)
+            with open(path, "w") as f:
+                json.dump(doc, f, default=repr)
+        return doc
+
+    def auto_dump(self, reason: str) -> Optional[str]:
+        """Failure-path dump: best effort, NEVER raises (it runs inside
+        the scheduler's exception handler — a broken disk must not turn
+        a poisoned step into a dead loop). Returns the file path."""
+        try:
+            path = os.path.join(
+                tempfile.gettempdir(),
+                f"paddle_serving_flight_{os.getpid()}_{id(self):x}.json")
+            self.dump(path, extra={"reason": reason,
+                                   "dumped_at": time.time()})
+            with self._lock:
+                self.last_dump_path = path
+                self.dumps += 1
+            return path
+        except Exception:                                # noqa: BLE001
+            return None
+
+    def __repr__(self):
+        with self._lock:
+            return (f"<FlightRecorder cycles={len(self._cycles)}/"
+                    f"{self.cycles_recorded} events={len(self._events)}/"
+                    f"{self.events_recorded} retired={self.retired}>")

@@ -187,6 +187,7 @@ class PagedKVPool(SlotPoolBase):
         self.prefix_hits = 0
         self.prefix_misses = 0
         self.tokens_saved = 0
+        self.evictions = 0
 
     # -- request slots (decode batch axis: SlotPoolBase) -------------------
     def _slot_freed(self, st: _PagedSlot) -> None:
@@ -282,6 +283,7 @@ class PagedKVPool(SlotPoolBase):
                 f"prefix cache has nothing to evict")
         key = next(iter(self._lru))
         self._drop_node(key)
+        self.evictions += 1
         stat_add("serving/prefix_evict")
 
     def _drop_node(self, key: Tuple[int, ...]) -> None:

@@ -21,11 +21,25 @@ in ``submit`` (the caller sheds load, nothing queues unboundedly), and
 a per-request deadline turns into :class:`DeadlineExceeded` whether the
 request is still queued or already decoding.
 
+Observability (the serving SLO spine, ISSUE 6): every request carries a
+:class:`~.tracing.RequestTrace` of timestamped lifecycle events
+(submit → admitted → prefill → first token → per-token stamps →
+finish/cancel/deadline, plus preemptions and prefix hits), from which
+TTFT and TPOT derive per request; every CYCLE writes a record into the
+always-on bounded :class:`~.flight_recorder.FlightRecorder` (sweep /
+admit / prefill / decode-dispatch / host-fetch breakdown, occupancy,
+queue depth) so a scheduler stall is debuggable postmortem without the
+profiler armed. When a ``profiler.profile()`` session IS armed, the
+same phases additionally emit nested ``serving/cycle`` spans and each
+finished request exports a chrome-trace lane.
+
 Threading contract: ``submit``/``cancel`` may be called from any
 thread; the loop body, the pool, and all slot state belong to the
-scheduler thread alone. The ONLY device→host sync in the loop is
-:func:`_fetch` below — everything else stays async (enforced by the
-``serving-host-sync`` self-lint rule over this package).
+scheduler thread alone (trace marks and cycle records included — all
+host stamps, taken outside every traced fn). The ONLY device→host sync
+in the loop is :func:`_fetch` below — everything else stays async
+(enforced by the ``serving-host-sync`` self-lint rule over this
+package).
 """
 from __future__ import annotations
 
@@ -39,7 +53,9 @@ import numpy as np
 
 from ..framework.monitor import stat_add, stat_observe
 from ..profiler import span as _prof
+from .flight_recorder import FlightRecorder
 from .paging import PoolExhaustedError
+from .tracing import RequestTrace
 
 __all__ = ["QueueFullError", "DeadlineExceeded", "RequestCancelled",
            "GenerationRequest", "Scheduler"]
@@ -106,6 +122,11 @@ class GenerationRequest:
         # re-admission). Rebuilt at every admission.
         self.replay: List[int] = []
         self.first_token_at: Optional[float] = None
+        self._last_token_at: Optional[float] = None
+        # lifecycle trace (host stamps; the scheduler marks events, the
+        # caller reads derived TTFT/TPOT after result() returns)
+        self.trace = RequestTrace(self.id, t_submit=self.submitted_at)
+        self._recorder: Optional[FlightRecorder] = None   # set at submit
         # caller-side plumbing
         self._q: "queue.Queue" = queue.Queue()
         self._done = threading.Event()
@@ -128,6 +149,8 @@ class GenerationRequest:
         (the first right after prefill). Raises the terminal error
         (:class:`RequestCancelled` / :class:`DeadlineExceeded`) after
         any tokens produced before it."""
+        _prof.set_thread_name(
+            f"stream consumer ({threading.current_thread().name})")
         while True:
             item = self._q.get()
             if item is _DONE:
@@ -160,10 +183,21 @@ class GenerationRequest:
             and (now or time.perf_counter()) > self.deadline
 
     def _emit(self, tok: int) -> None:
+        now = time.perf_counter()
         if self.first_token_at is None:
-            self.first_token_at = time.perf_counter()
+            self.first_token_at = now
             stat_observe("serving/ttft_ms",
-                         (self.first_token_at - self.submitted_at) * 1e3)
+                         (now - self.submitted_at) * 1e3)
+            self.trace.mark("first_token", t=now)
+            if self._recorder is not None:
+                self._recorder.record_event(self.id, "first_token", t=now)
+        else:
+            # the streaming cadence: one inter-token sample per decoded
+            # token after the first (replayed tokens never land here)
+            stat_observe("serving/tpot_ms",
+                         (now - self._last_token_at) * 1e3)
+        self._last_token_at = now
+        self.trace.stamp_token(now)
         self.tokens.append(tok)
         self.emitted += 1
         self.last_token = tok
@@ -171,6 +205,22 @@ class GenerationRequest:
 
     def _finish(self, error: Optional[BaseException] = None) -> None:
         self.error = error
+        if error is None:
+            name = "finish"
+        elif isinstance(error, RequestCancelled):
+            name = "cancelled"
+        elif isinstance(error, DeadlineExceeded):
+            name = "deadline"
+        else:
+            name = "error"
+        self.trace.mark(name,
+                        **({} if error is None else {"error": repr(error)}))
+        if self._recorder is not None:
+            self._recorder.record_event(
+                self.id, name,
+                meta=None if error is None else {"error": repr(error)})
+            self._recorder.retire(self.trace)
+        self.trace.export_spans()   # chrome-trace lane; no-op unarmed
         self._done.set()
         self._q.put(error if error is not None else _DONE)
 
@@ -187,19 +237,30 @@ class Scheduler:
 
     * ``do_prefill(request, slot, bucket) -> first_token`` — run the
       bucket's prefill step, write the slot, return the first token;
-    * ``do_decode(slot_requests) -> np.ndarray [num_slots]`` — run the
-      shared decode step, return every slot's next token (garbage for
-      inactive slots).
+    * ``do_decode(slot_requests) -> [num_slots] token array`` — DISPATCH
+      the shared decode step and return its result UN-fetched (a device
+      array; plain numpy passes through): the scheduler performs the
+      windowed ``_fetch`` itself so the cycle telemetry can time
+      dispatch and host-fetch apart — a do_decode that syncs internally
+      would hide the fetch inside ``decode_dispatch_ms``. Every slot
+      gets a token (garbage for inactive slots).
     """
 
     def __init__(self, pool, do_prefill: Callable, do_decode: Callable, *,
                  max_queue: int = 128, prefill_budget: Optional[int] = None,
-                 do_copy: Optional[Callable] = None):
+                 do_copy: Optional[Callable] = None,
+                 recorder: Optional[FlightRecorder] = None):
         if max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {max_queue}")
         self._pool = pool
         self._do_prefill = do_prefill
         self._do_decode = do_decode
+        # always-on postmortem telemetry: bounded cycle/event rings +
+        # the per-engine TTFT/TPOT reservoirs stats() reads
+        self.recorder = recorder if recorder is not None \
+            else FlightRecorder()
+        self._cycle = 0
+        self._rec: Optional[dict] = None   # current cycle's record
         # paged pools bring block-granular admission, growth and
         # preemption into the loop; the dense path is untouched
         self._paged = bool(getattr(pool, "is_paged", False))
@@ -224,6 +285,8 @@ class Scheduler:
 
     # -- producer side -----------------------------------------------------
     def submit(self, req: GenerationRequest) -> GenerationRequest:
+        _prof.set_thread_name(
+            f"submitter ({threading.current_thread().name})")
         with self._cond:
             if self._closing:
                 raise RuntimeError("GenerationEngine is closed")
@@ -232,6 +295,11 @@ class Scheduler:
                 raise QueueFullError(
                     f"admission queue is full ({self._max_queue} "
                     f"requests); retry after in-flight work drains")
+            req._recorder = self.recorder
+            # recorded before notify so the event ring can never show
+            # this request admitted ahead of its own submit
+            self.recorder.record_event(req.id, "submit",
+                                       t=req.submitted_at)
             self._queue.append(req)
             stat_observe("serving/queue_depth", len(self._queue))
             self._cond.notify_all()
@@ -263,6 +331,7 @@ class Scheduler:
 
     # -- scheduler thread --------------------------------------------------
     def _loop(self) -> None:
+        _prof.set_thread_name("serving scheduler")
         while True:
             with self._cond:
                 while not self._closing and not self._queue \
@@ -270,15 +339,55 @@ class Scheduler:
                     self._cond.wait()
                 if self._closing and not self._queue and not self._slots:
                     return
+            self._cycle += 1
+            t0 = time.perf_counter()
+            # the cycle record is ALWAYS captured (bounded ring, host
+            # dicts only) — the spans below additionally land in the
+            # profiler buffer when a profile() session is armed
+            rec = self._rec = {
+                "cycle": self._cycle, "t": t0, "sweep_ms": 0.0,
+                "admit_ms": 0.0, "prefill_ms": 0.0,
+                "decode_dispatch_ms": 0.0, "fetch_ms": 0.0,
+                "admitted": [], "retired": [], "emitted": 0,
+                "preempts": 0, "active": 0, "occupancy": 0.0,
+            }
+            failed = None
             try:
-                self._admit()
-                if self._slots:
-                    self._decode_cycle()
+                with _prof.record("serving/cycle", "serving",
+                                  args={"cycle": self._cycle}):
+                    t = time.perf_counter()
+                    with _prof.record("serving/sweep", "serving"):
+                        self._sweep_queue()
+                    rec["sweep_ms"] = (time.perf_counter() - t) * 1e3
+                    t = time.perf_counter()
+                    with _prof.record("serving/admit", "serving"):
+                        self._admit()
+                    rec["admit_ms"] = (time.perf_counter() - t) * 1e3
+                    if self._slots:
+                        self._decode_cycle()
             except Exception as e:                      # noqa: BLE001
                 # a step failure (OOM, bad artifact) poisons the affected
                 # requests, never the loop: fail everything in flight and
                 # keep serving — the BatchingEngine worker-survival rule
+                failed = e
                 self._fail_inflight(e)
+            finally:
+                with self._cond:
+                    rec["queue_depth"] = len(self._queue)
+                if self._paged:
+                    rec["blocks_in_use"] = self._pool.blocks_in_use
+                if failed is not None:
+                    rec["failed"] = repr(failed)
+                rec["cycle_ms"] = (time.perf_counter() - t0) * 1e3
+                stat_observe("serving/cycle_ms", rec["cycle_ms"])
+                self.recorder.record_cycle(rec)
+                self._rec = None
+                if failed is not None:
+                    # leave the postmortem behind: the profiler is
+                    # almost never armed when a production step dies,
+                    # but the recorder's rings (this poisoned cycle
+                    # included) hold what led here
+                    self.recorder.auto_dump(reason=repr(failed))
 
     def _fail_inflight(self, error: BaseException) -> None:
         for slot in list(self._slots):
@@ -317,9 +426,9 @@ class Scheduler:
                 self._queue[:] = live
                 stat_observe("serving/queue_depth", len(live))
 
-    # admission: FCFS with a prefill budget
+    # admission: FCFS with a prefill budget (the loop sweeps the queue
+    # under its own span/timer right before calling this)
     def _admit(self) -> None:
-        self._sweep_queue()
         decode_waiting = bool(self._slots)
         budget = self._prefill_budget
         while True:
@@ -389,9 +498,22 @@ class Scheduler:
                  bucket: int) -> bool:
         """Admit ``req`` into ``slot``. Returns whether a prefill
         program actually ran (False = paged prefix-cache hit)."""
+        self._event(req, "admitted", slot=slot, bucket=bucket,
+                    feed=len(req.prompt) + len(req.tokens))
+        if self._rec is not None:
+            self._rec["admitted"].append(req.id)
+        req.trace.mark("prefill_start", bucket=bucket)
+        t0 = time.perf_counter()
         with _prof.record("serving/prefill", "serving",
                           args={"bucket": bucket, "slot": slot}):
             first = self._do_prefill(req, slot, bucket)
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        if self._rec is not None:
+            self._rec["prefill_ms"] += dt_ms
+        # ran=False marks a paged prefix-cache hit: the engine skipped
+        # the prefill program and stamped prefix_hit with tokens saved
+        req.trace.mark("prefill_end", bucket=bucket,
+                       ran=not (self._paged and first is None))
         if self._paged:
             # the engine set the slot's page table and positions; a
             # None first token means a prefix-cache hit — prefill was
@@ -420,6 +542,13 @@ class Scheduler:
             self._retire(slot)
         return True
 
+    def _event(self, req: GenerationRequest, name: str, **meta) -> None:
+        """One lifecycle event, stamped once into both the request's
+        trace and the flight recorder's event ring."""
+        t = time.perf_counter()
+        req.trace.mark(name, t=t, **meta)
+        self.recorder.record_event(req.id, name, t=t, meta=meta or None)
+
     def _finished(self, req: GenerationRequest, tok: int) -> bool:
         return (req.eos_token_id is not None and tok == req.eos_token_id) \
             or req.emitted >= req.max_new_tokens
@@ -430,6 +559,8 @@ class Scheduler:
         self._pool.free(slot)
         if error is None:
             stat_add("serving/completed")
+        if self._rec is not None:
+            self._rec["retired"].append(req.id)
         req._finish(error)
 
     # -- paged memory pressure: growth, copy-on-write, preemption ----------
@@ -446,6 +577,9 @@ class Scheduler:
         self._pool.free(slot)
         req.replay = []                  # rebuilt at re-admission
         self.preempts += 1
+        self._event(req, "preempt", emitted=req.emitted)
+        if self._rec is not None:
+            self._rec["preempts"] += 1
         stat_add("serving/preempt")
         with self._cond:
             self._queue.insert(0, req)
@@ -480,12 +614,29 @@ class Scheduler:
         if self._paged and not self._prepare_paged():
             return
         active = dict(self._slots)
-        t0 = time.perf_counter()
-        with _prof.record("serving/decode_step", "serving",
-                          args={"active": len(active)}):
-            toks = self._do_decode(active)
-        dt = time.perf_counter() - t0
+        occupancy = len(active) / self._pool.num_slots
         stat_observe("serving/active_slots", len(active))
+        stat_observe("serving/batch_occupancy", occupancy)
+        rec = self._rec
+        if rec is not None:
+            rec["active"] = len(active)
+            rec["occupancy"] = occupancy
+        # dispatch and the windowed host fetch are timed APART: a slow
+        # cycle with fat fetch_ms is a host-sync problem, one with fat
+        # dispatch_ms is tracing/compile churn — the flight recorder
+        # must distinguish them postmortem
+        t0 = time.perf_counter()
+        with _prof.record("serving/decode_dispatch", "serving",
+                          args={"active": len(active)}):
+            toks_dev = self._do_decode(active)
+        t1 = time.perf_counter()
+        with _prof.record("serving/host_fetch", "serving"):
+            toks = _fetch(toks_dev)
+        t2 = time.perf_counter()
+        if rec is not None:
+            rec["decode_dispatch_ms"] += (t1 - t0) * 1e3
+            rec["fetch_ms"] += (t2 - t1) * 1e3
+        dt = t2 - t0
         emitted = 0
         now = time.perf_counter()
         for slot, req in active.items():
@@ -507,6 +658,8 @@ class Scheduler:
                 # the next known token queued — nothing reaches the
                 # caller until the replay drains
                 req.last_token = req.replay.pop(0)
+                if not req.replay:
+                    req.trace.mark("replay_done", emitted=req.emitted)
                 continue
             tok = int(toks[slot])
             req._emit(tok)
@@ -514,5 +667,7 @@ class Scheduler:
             if self._finished(req, tok):
                 self._retire(slot)
         stat_add("serving/tokens", emitted)
+        if rec is not None:
+            rec["emitted"] += emitted
         if dt > 0:
             stat_observe("serving/tokens_per_sec", emitted / dt)

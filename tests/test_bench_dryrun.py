@@ -77,3 +77,20 @@ def test_dry_run_emits_metrics_summary():
     assert out["paged_tokens_saved"] > 0, out
     assert "serving/kv_blocks_in_use" in res.stderr
     assert "serving/prefix_hit" in res.stderr
+    # ISSUE-6 serving SLO observability: the seeded mini serve-load run
+    # completed every request with lifecycle-ordered traces, derived
+    # TTFT/TPOT percentiles in the summary, a live serving/tpot_ms
+    # histogram, a non-empty always-on flight recorder and zero decode
+    # retraces during the run
+    assert out["checks"]["serve_load_traces_complete"] is True, out
+    assert out["checks"]["serve_load_tpot_live"] is True, out
+    assert out["checks"]["serve_load_flight_recorder"] is True, out
+    assert out["checks"]["serve_load_zero_retraces"] is True, out
+    sl = out["serve_load"]
+    assert sl["completed"] == sl["requests"] and sl["failed"] == 0, sl
+    assert sl["ttft_ms"]["count"] == sl["requests"], sl
+    assert sl["tpot_ms"]["p50"] > 0, sl
+    assert "goodput_rps" in sl and "slo_attainment" in sl, sl
+    assert "serving/tpot_ms" in res.stderr
+    assert "serving/cycle_ms" in res.stderr
+    assert "serving/batch_occupancy" in res.stderr

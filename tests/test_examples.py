@@ -46,6 +46,7 @@ def test_serve_gpt2_example(tmp_path):
     assert "served 10 requests" in out
     assert "aggregate" in out and "tokens/s" in out
     assert "ttft p50" in out
+    assert "tpot p50" in out                 # per-engine decode cadence
     assert "engine.stats():" in out          # the operator snapshot
 
 

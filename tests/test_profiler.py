@@ -305,6 +305,38 @@ class TestStructuredSpans:
         assert c["args"]["parent"] == "parent"
         assert p["args"]["k"] == 1
 
+    def test_add_event_and_thread_name_metadata(self, tmp_path):
+        """add_event injects already-timed spans (synthetic lanes) and
+        set_thread_name labels lanes via thread_name metadata events —
+        the serving tracer's request-lane surface."""
+        from paddle_tpu.profiler import span as S
+        with S.profile() as sess:
+            t0 = time.perf_counter()
+            S.add_event("lane span", "custom", t0, t0 + 0.002,
+                        tid=999_123, args={"k": 7})
+            S.set_thread_name("my lane", tid=999_123)
+        assert [e["name"] for e in S.events()] == ["lane span"]
+        assert S.events()[0]["tid"] == 999_123
+        path = sess.export_chrome_trace(str(tmp_path / "lane.json"))
+        with open(path) as f:
+            doc = json.load(f)
+        metas = [e for e in doc["traceEvents"]
+                 if e.get("ph") == "M" and e["name"] == "thread_name"]
+        assert any(m["tid"] == 999_123
+                   and m["args"]["name"] == "my lane" for m in metas)
+        xs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+        assert xs[0]["args"]["k"] == 7 and xs[0]["tid"] == 999_123
+
+    def test_add_event_inactive_is_noop_and_cap_drops(self):
+        from paddle_tpu.profiler import span as S
+        t = time.perf_counter()
+        S.add_event("ghost", "custom", t, t + 0.001)   # no session
+        with S.profile(max_events=1):
+            S.add_event("a", "custom", t, t + 0.001)
+            S.add_event("b", "custom", t, t + 0.001)   # over the cap
+        assert [e["name"] for e in S.events()] == ["a"]
+        assert S.dropped() == 1
+
     def test_decorator_records_when_active(self):
         import paddle_tpu.profiler as P
 

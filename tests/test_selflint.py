@@ -57,6 +57,16 @@ def test_serving_host_sync_rule():
     out = lint_source("t.py", paged_src, "serving/paging.py")
     assert [f.rule for f in out] == ["serving-host-sync"]
     assert "jax.block_until_ready" in out[0].message
+    # ...and the ISSUE-6 tracing/flight-recorder modules by
+    # construction: host-time stamping lives in serving/, so a stray
+    # sync slipped into the trace path is flagged like one in the loop
+    trace_src = ("import jax\n"
+                 "def stamp(x):\n"
+                 "    return x.numpy()\n")
+    out = lint_source("t.py", trace_src, "serving/tracing.py")
+    assert [f.rule for f in out] == ["serving-host-sync"]
+    out = lint_source("t.py", trace_src, "serving/flight_recorder.py")
+    assert [f.rule for f in out] == ["serving-host-sync"]
     # the same calls OUTSIDE the serving package are unflagged (the
     # gather-and-run batcher in inference/serving.py blocks by design)
     assert lint_source("t.py", src, "inference/serving.py") == []

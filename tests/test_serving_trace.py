@@ -1,0 +1,316 @@
+"""Serving SLO observability: request traces + the flight recorder.
+
+Deterministic mock-device scheduler tests (no real model, tiny pools)
+for the ISSUE-6 measurement layer:
+
+* event ordering — submit <= admitted <= first_token <= terminal, with
+  TTFT/TPOT derived from the per-token stamps;
+* preemption replay shows up in the trace (preempt mark + second
+  admission) and the request still completes with the right length;
+* the flight recorder's rings hold their bounds under sustained load;
+* a step failure auto-dumps the recorder to a JSON postmortem file;
+* per-engine latency isolation — two schedulers' stats come from their
+  OWN retired traces, not a shared process-global histogram;
+* chrome-trace export carries request lanes and thread-name metadata.
+"""
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu import profiler
+from paddle_tpu.framework import monitor
+from paddle_tpu.serving.flight_recorder import FlightRecorder
+from paddle_tpu.serving.kv_pool import KVCachePool
+from paddle_tpu.serving.paging import PagedKVPool
+from paddle_tpu.serving.scheduler import GenerationRequest, Scheduler
+from paddle_tpu.serving.tracing import TERMINAL_EVENTS
+
+
+def _mock_pool(slots=2, max_len=64):
+    return KVCachePool(num_layers=1, num_slots=slots, num_heads=1,
+                       max_len=max_len, head_dim=1, min_bucket=8)
+
+
+class _MockDevice:
+    """Deterministic stand-in for the engine's device steps."""
+
+    def __init__(self, pool, prefill_delay=0.0, decode_delay=0.0):
+        self.pool = pool
+        self.prefill_delay = prefill_delay
+        self.decode_delay = decode_delay
+        self.prefills = []
+        self.decodes = 0
+
+    def do_prefill(self, req, slot, bucket):
+        if self.prefill_delay:
+            time.sleep(self.prefill_delay)
+        self.prefills.append((req.id, slot, bucket))
+        return 1
+
+    def do_decode(self, slot_requests):
+        if self.decode_delay:
+            time.sleep(self.decode_delay)
+        self.decodes += 1
+        return np.full(self.pool.num_slots, 2, np.int32)
+
+
+class _PagedMockDevice:
+    """Mock device steps doing the engine's PAGED pool bookkeeping
+    (fresh-prefill only — no prefix cache — so freed blocks return to
+    the free list and pressure must be answered by preemption)."""
+
+    def __init__(self, pool):
+        self.pool = pool
+
+    def do_prefill(self, req, slot, bucket):
+        feed = np.concatenate([req.prompt,
+                               np.asarray(req.tokens, np.int32)])
+        self.pool.admit_fresh(slot, feed.size)
+        self.pool.set_slot(slot, pos=feed.size, lo=0)
+        req.replay = []
+        return 100 + feed.size
+
+    def do_decode(self, slot_requests):
+        return np.full(self.pool.num_slots, 7, np.int32)
+
+
+def _submit(sched, prompt_len=4, max_new=3, **kw):
+    return sched.submit(GenerationRequest(
+        np.ones(prompt_len, np.int32), max_new, **kw))
+
+
+class TestRequestTrace:
+    def test_event_ordering_and_derived_metrics(self):
+        pool = _mock_pool(slots=2)
+        dev = _MockDevice(pool, decode_delay=0.002)
+        sched = Scheduler(pool, dev.do_prefill, dev.do_decode)
+        handles = [_submit(sched, prompt_len=4 + i, max_new=4)
+                   for i in range(3)]
+        for h in handles:
+            h.result(timeout=60)
+        sched.close()
+        for h in handles:
+            tr = h.trace
+            assert tr.completed
+            assert tr.t("submit") <= tr.t("admitted") \
+                <= tr.t("first_token") <= tr.finished_at
+            assert tr.t("prefill_start") <= tr.t("prefill_end")
+            # 4 tokens emitted -> 4 stamps, TTFT and a real TPOT (the
+            # decode_delay makes the cadence strictly positive)
+            assert len(tr.token_times) == 4
+            assert tr.ttft_ms is not None and tr.ttft_ms >= 0
+            assert tr.tpot_ms is not None and tr.tpot_ms > 0
+            assert len(tr.decode_intervals_ms) == 3
+            assert sum(1 for n, _, _ in tr.events
+                       if n in TERMINAL_EVENTS) == 1
+            # timeline is JSON-friendly and time-ordered
+            tl = tr.timeline()
+            assert [e["t_ms"] for e in tl] == \
+                sorted(e["t_ms"] for e in tl)
+            json.dumps(tl)
+
+    def test_terminal_event_names_cancel_and_deadline(self):
+        pool = _mock_pool(slots=1)
+        dev = _MockDevice(pool, decode_delay=0.01)
+        sched = Scheduler(pool, dev.do_prefill, dev.do_decode)
+        a = _submit(sched, max_new=50)
+        b = _submit(sched, max_new=50, timeout=0.05)
+        time.sleep(0.03)
+        a.cancel()
+        for h in (a, b):
+            with pytest.raises(Exception):
+                h.result(timeout=60)
+        sched.close()
+        assert a.trace.t("cancelled") is not None
+        assert b.trace.t("deadline") is not None
+
+    def test_tpot_none_for_single_token_request(self):
+        pool = _mock_pool()
+        dev = _MockDevice(pool)
+        sched = Scheduler(pool, dev.do_prefill, dev.do_decode)
+        h = _submit(sched, max_new=1)
+        h.result(timeout=60)
+        sched.close()
+        assert len(h.trace.token_times) == 1
+        assert h.trace.ttft_ms is not None
+        assert h.trace.tpot_ms is None
+
+    def test_tpot_histogram_live(self):
+        monitor.stat_reset("serving/tpot_ms")
+        pool = _mock_pool()
+        dev = _MockDevice(pool, decode_delay=0.001)
+        sched = Scheduler(pool, dev.do_prefill, dev.do_decode)
+        _submit(sched, max_new=5).result(timeout=60)
+        sched.close()
+        h = monitor.stat_histogram("serving/tpot_ms")
+        # 5 tokens -> 4 inter-token samples
+        assert h is not None and h["count"] >= 4 and h["p50"] > 0
+
+
+class TestPreemptionReplayTrace:
+    def test_preempt_and_readmission_appear_in_trace(self):
+        # 4 usable blocks of 8, two requests that each want 3 blocks:
+        # growth exhausts the pool mid-decode, the youngest (B) is
+        # preempted, replays through re-admission, and still finishes
+        # with the full token budget
+        pool = PagedKVPool(num_layers=1, num_slots=2, num_heads=1,
+                           max_len=32, head_dim=1, block_size=8,
+                           num_blocks=4, min_bucket=8)
+        dev = _PagedMockDevice(pool)
+        sched = Scheduler(pool, dev.do_prefill, dev.do_decode)
+        a = _submit(sched, prompt_len=8, max_new=12)
+        b = _submit(sched, prompt_len=8, max_new=12)
+        ra = a.result(timeout=60)
+        rb = b.result(timeout=60)
+        sched.close()
+        assert ra.size == 20 and rb.size == 20
+        assert sched.preempts >= 1
+        pre = a if a.trace.count("preempt") else b
+        assert pre.trace.count("preempt") >= 1
+        # the victim was re-admitted AFTER the preemption...
+        admits = [t for n, t, _ in pre.trace.events if n == "admitted"]
+        assert len(admits) == pre.trace.count("preempt") + 1
+        assert admits[-1] > pre.trace.t("preempt")
+        # ...and the preempt made it into the flight recorder's events
+        evs = sched.recorder.snapshot()["events"]
+        assert any(e["event"] == "preempt" for e in evs)
+
+
+class TestFlightRecorder:
+    def test_ring_buffer_bounds_hold(self):
+        rec = FlightRecorder(max_cycles=4, max_events=10)
+        pool = _mock_pool(slots=2)
+        dev = _MockDevice(pool)
+        sched = Scheduler(pool, dev.do_prefill, dev.do_decode,
+                          recorder=rec)
+        for _ in range(8):
+            _submit(sched, max_new=4).result(timeout=60)
+        sched.close()
+        snap = rec.snapshot()
+        assert len(snap["cycles"]) <= 4
+        assert len(snap["events"]) <= 10
+        # the monotonic counters kept counting past the ring bounds
+        assert snap["cycles_recorded"] > 4
+        assert snap["events_recorded"] > 10
+        assert snap["requests_retired"] == 8
+
+    def test_cycle_records_breakdown(self):
+        pool = _mock_pool(slots=2)
+        dev = _MockDevice(pool, prefill_delay=0.002, decode_delay=0.002)
+        sched = Scheduler(pool, dev.do_prefill, dev.do_decode)
+        _submit(sched, max_new=3).result(timeout=60)
+        sched.close()
+        cycles = sched.recorder.snapshot()["cycles"]
+        assert cycles, "no cycle records captured"
+        for c in cycles:
+            for k in ("cycle", "sweep_ms", "admit_ms", "prefill_ms",
+                      "decode_dispatch_ms", "fetch_ms", "cycle_ms",
+                      "occupancy", "queue_depth", "emitted"):
+                assert k in c, f"cycle record missing {k}: {c}"
+        assert any(c["prefill_ms"] > 0 for c in cycles)
+        assert any(c["decode_dispatch_ms"] > 0 for c in cycles)
+        assert sum(c["emitted"] for c in cycles) >= 2  # decode tokens
+        json.dumps(cycles)
+        # occupancy histogram fed by the decode cycles
+        assert monitor.stat_histogram("serving/batch_occupancy") \
+            is not None
+        assert monitor.stat_histogram("serving/cycle_ms") is not None
+
+    def test_step_failure_auto_dumps(self):
+        pool = _mock_pool(slots=2)
+        dev = _MockDevice(pool)
+        boom = {"armed": False}
+
+        def bad_decode(slot_requests):
+            boom["armed"] = True
+            raise RuntimeError("injected device failure")
+
+        sched = Scheduler(pool, dev.do_prefill, bad_decode)
+        h = _submit(sched, max_new=4)
+        with pytest.raises(RuntimeError):
+            h.result(timeout=60)
+        sched.close()
+        assert boom["armed"]
+        path = sched.recorder.last_dump_path
+        assert path is not None and os.path.exists(path)
+        with open(path) as f:
+            doc = json.load(f)
+        assert "injected device failure" in doc["reason"]
+        assert doc["cycles"] and doc["events"]
+        assert h.trace.t("error") is not None
+        os.unlink(path)
+
+    def test_per_engine_latency_isolation(self):
+        # two schedulers in one process: each recorder's percentiles
+        # come from its own retired traces only
+        fast_pool, slow_pool = _mock_pool(), _mock_pool()
+        fast = Scheduler(fast_pool, _MockDevice(fast_pool).do_prefill,
+                         _MockDevice(fast_pool).do_decode)
+        slow_dev = _MockDevice(slow_pool, decode_delay=0.02)
+        slow = Scheduler(slow_pool, slow_dev.do_prefill,
+                         slow_dev.do_decode)
+        for s in (fast, slow):
+            for _ in range(3):
+                _submit(s, max_new=4).result(timeout=60)
+        fast.close(), slow.close()
+        lf = fast.recorder.latency_summary()
+        ls = slow.recorder.latency_summary()
+        # one TTFT and one (mean) TPOT sample banked per retired request
+        assert lf["ttft_ms"]["count"] == ls["ttft_ms"]["count"] == 3
+        assert lf["tpot_ms"]["count"] == ls["tpot_ms"]["count"] == 3
+        # the slow engine's decode cadence (>= 20ms) must not leak into
+        # the fast engine's per-engine percentiles
+        assert ls["tpot_ms"]["p50"] >= 15.0
+        assert lf["tpot_ms"]["p50"] < ls["tpot_ms"]["p50"]
+
+
+class TestChromeTraceExport:
+    def test_request_lanes_and_thread_names(self, tmp_path):
+        pool = _mock_pool(slots=2)
+        dev = _MockDevice(pool, decode_delay=0.001)
+        with profiler.profile() as sess:
+            sched = Scheduler(pool, dev.do_prefill, dev.do_decode)
+            hs = [_submit(sched, max_new=3) for _ in range(2)]
+            # consume on a separate thread so the submitter and the
+            # stream-consumer labels land on distinct lanes
+            toks = [[] for _ in hs]
+
+            def consume(i, h):
+                toks[i] = list(h.stream())
+
+            ts = [threading.Thread(target=consume, args=(i, h))
+                  for i, h in enumerate(hs)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            sched.close()
+        assert all(len(t) == 3 for t in toks)
+        path = sess.export_chrome_trace(str(tmp_path / "serve.json"))
+        with open(path) as f:
+            doc = json.load(f)
+        evs = doc["traceEvents"]
+        names = {e["args"]["name"] for e in evs
+                 if e.get("ph") == "M" and e["name"] == "thread_name"}
+        assert "serving scheduler" in names
+        assert any(n.startswith("submitter") for n in names)
+        assert any(n.startswith("stream consumer") for n in names)
+        assert any(n.startswith("request ") for n in names)
+        # request lanes: one whole-lifetime span per request with its
+        # phase children, on the synthetic per-request tid
+        lanes = [e for e in evs if e.get("ph") == "X"
+                 and e["cat"] == "serving/request"]
+        whole = [e for e in lanes if e["name"].startswith("request ")]
+        assert len(whole) == 2
+        assert {e["name"] for e in lanes} >= {"queued", "prefill",
+                                              "decode"}
+        # cycle spans with the phase breakdown children
+        cats = {e["name"] for e in evs if e.get("ph") == "X"
+                and e["cat"] == "serving"}
+        assert {"serving/cycle", "serving/sweep", "serving/admit",
+                "serving/decode_dispatch",
+                "serving/host_fetch"} <= cats
