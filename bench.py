@@ -49,7 +49,11 @@ import time
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 
-# bf16 peak FLOPs/sec per chip by device kind substring
+# bf16 peak FLOPs/sec per chip: the canonical table lives in
+# framework/program_registry.py (PEAK_FLOPS_TABLE, with the
+# PADDLE_TPU_PEAK_FLOPS override) so fit()/engine.stats() MFU and the
+# bench children agree; this local copy is only the fallback for the
+# parent process, which never imports paddle_tpu (robustness contract)
 _PEAK_FLOPS = [
     ("v5 lite", 197e12), ("v5e", 197e12), ("v5p", 459e12),
     ("v4", 275e12), ("v3", 123e12), ("v6", 918e12),
@@ -57,11 +61,15 @@ _PEAK_FLOPS = [
 
 
 def _peak_flops(device_kind: str):
-    dk = device_kind.lower()
-    for sub, peak in _PEAK_FLOPS:
-        if sub in dk:
-            return peak
-    return None
+    try:
+        from paddle_tpu.framework.program_registry import peak_flops
+        return peak_flops(device_kind)
+    except Exception:
+        dk = device_kind.lower()
+        for sub, peak in _PEAK_FLOPS:
+            if sub in dk:
+                return peak
+        return None
 
 
 def _device_kind():
@@ -730,6 +738,12 @@ def _serve_load_engine(kind, model, schedule, slo_ms, num_slots=8):
     summary["preempts"] = stats["preempts"]
     summary["preempt_rate"] = round(
         stats["preempts"] / max(1, summary["requests"]), 4)
+    # per-engine compute figures (ISSUE-7): decode-step cost analysis
+    # from the program registry, throughput from the engine's own ring
+    for k in ("model_flops_per_token", "decode_bytes_per_token",
+              "decode_tokens_per_sec", "serving_mfu"):
+        if stats.get(k) is not None:
+            summary[k] = round(stats[k], 4)
     # NOTE: the summary's ttft_ms/tpot_ms percentiles come from the
     # MEASURED handles' traces only; engine.stats() latency is not
     # republished here because its reservoirs also hold the warm-up
@@ -803,6 +817,251 @@ def serve_load():
              and e["failed"] == 0 and e["zero_decode_retraces"]
              for e in out["engines"].values())
     sys.exit(0 if ok else 1)
+
+
+# ---------------------------------------------------------------------------
+# regression gate (--compare / --history)
+# ---------------------------------------------------------------------------
+# The bench trajectory only matters if something reads it: --compare
+# diffs the key metrics of two bench artifacts with per-metric
+# tolerances and exits nonzero on regression; --history appends an
+# artifact's flattened metrics to BENCH_history.jsonl, gating against
+# the previous entry — so the BENCH_r*.json series accumulates into a
+# guarded trend instead of a pile of unread files. Reference analog:
+# tools/check_op_benchmark_result.py (perf diff as a CI gate).
+
+DEFAULT_TOLERANCE = 0.05          # 5% relative, either direction
+
+# wider tolerances where run-to-run noise is structural: eager dispatch
+# is host-scheduler bound, serve latency percentiles on shared CI boxes
+# jitter, compile seconds ride the relay's mood
+PER_METRIC_TOLERANCE = {
+    "eager": 0.25,
+    "serve": 0.25,
+    "serve.p95_ms": 0.30,
+}
+
+
+def _tolerance_for(name, tolerances, default):
+    """Exact name first, then the structural-noise classes: latency
+    PERCENTILES (serve-load '{kind}.ttft_ms.p95' etc.) jitter on shared
+    boxes far beyond the throughput default."""
+    if name in tolerances:
+        return tolerances[name]
+    if name.endswith(".p95") or name.endswith(".p95_ms"):
+        return max(default, 0.30)
+    return default
+
+
+def _load_bench_doc(path):
+    """Load a bench artifact: the aggregate JSON line (--dry-run /
+    _emit output saved to a file), a BENCH_serve_load.json document, or
+    a driver wrapper ({"tail": "<stdout>"} — the artifact is the last
+    parseable JSON line of the tail)."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        doc = json.loads(text)
+    except json.JSONDecodeError:
+        doc = None
+        for line in reversed(text.strip().splitlines()):
+            try:
+                doc = json.loads(line)
+                break
+            except json.JSONDecodeError:
+                continue
+        if doc is None:
+            raise ValueError(f"{path}: no parseable JSON document")
+    if isinstance(doc, dict) and "tail" in doc and "extras" not in doc \
+            and "engines" not in doc:
+        for line in reversed(str(doc["tail"]).strip().splitlines()):
+            try:
+                cand = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(cand, dict) and "metric" in cand:
+                return cand
+    if not isinstance(doc, dict):
+        raise ValueError(
+            f"{path}: artifact is not a JSON object (got "
+            f"{type(doc).__name__})")
+    return doc
+
+
+def _flatten_bench_doc(doc):
+    """{name: {"value", "unit", "metric"}} for every gateable number in
+    an artifact. Probe/health entries are excluded — they are
+    environment facts, not performance."""
+    out = {}
+
+    def add(name, rec):
+        if not isinstance(rec, dict) or "error" in rec:
+            return
+        v = rec.get("value")
+        if not isinstance(v, (int, float)) or \
+                rec.get("metric") == "backend_probe":
+            return
+        out[name] = {"value": float(v), "unit": str(rec.get("unit", "")),
+                     "metric": str(rec.get("metric", name))}
+        if isinstance(rec.get("mfu"), (int, float)):
+            out[f"{name}.mfu"] = {"value": float(rec["mfu"]),
+                                  "unit": "mfu", "metric": f"{name}.mfu"}
+        if isinstance(rec.get("p95_ms"), (int, float)):
+            out[f"{name}.p95_ms"] = {"value": float(rec["p95_ms"]),
+                                     "unit": "ms",
+                                     "metric": f"{name}.p95_ms"}
+
+    if isinstance(doc.get("engines"), dict):          # serve-load shape
+        for kind, e in doc["engines"].items():
+            if not isinstance(e, dict):
+                continue
+            for key, unit in (("goodput_rps", "req/s"),
+                              ("slo_attainment", "ratio")):
+                if isinstance(e.get(key), (int, float)):
+                    out[f"{kind}.{key}"] = {
+                        "value": float(e[key]), "unit": unit,
+                        "metric": f"serve_load.{kind}.{key}"}
+            for lat in ("ttft_ms", "tpot_ms"):
+                p95 = (e.get(lat) or {}).get("p95")
+                if isinstance(p95, (int, float)):
+                    out[f"{kind}.{lat}.p95"] = {
+                        "value": float(p95), "unit": "ms",
+                        "metric": f"serve_load.{kind}.{lat}.p95"}
+        return out
+    extras = doc.get("extras")
+    if isinstance(extras, dict):
+        for name, rec in sorted(extras.items()):
+            add(name, rec)
+        return out
+    add(doc.get("metric", "value"), doc)
+    return out
+
+
+def _lower_is_better(entry) -> bool:
+    m = entry["metric"]
+    return entry["unit"] == "ms" or m.endswith("_ms") or \
+        m.endswith(".p95") or "latency" in m
+
+
+def compare_flat(old_m, new_m, tolerance=DEFAULT_TOLERANCE,
+                 tolerances=None):
+    """Diff two flattened metric maps. Returns (rows, regressions,
+    missing): rows are (name, old, new, rel_delta, unit, verdict);
+    a metric beyond its tolerance in the WORSE direction regresses.
+    Metrics present only on one side are reported, never gated — bench
+    rounds legitimately differ in which children survived the budget."""
+    tolerances = {**PER_METRIC_TOLERANCE, **(tolerances or {})}
+    rows, regressions = [], []
+    for name in sorted(set(old_m) & set(new_m)):
+        o, n = old_m[name], new_m[name]
+        tol = _tolerance_for(name, tolerances, tolerance)
+        if o["value"]:
+            delta = (n["value"] - o["value"]) / abs(o["value"])
+        else:
+            delta = 0.0 if n["value"] == o["value"] else \
+                (1.0 if n["value"] > o["value"] else -1.0)
+        worse = delta > tol if _lower_is_better(o) else delta < -tol
+        better = delta < -tol if _lower_is_better(o) else delta > tol
+        verdict = "REGRESSED" if worse else \
+            ("improved" if better else "ok")
+        rows.append((name, o["value"], n["value"], delta, o["unit"],
+                     verdict))
+        if worse:
+            regressions.append(name)
+    # BOTH one-sided sets are reported (never gated): an operator must
+    # be able to tell a metric RENAME (old-only + new-only pair) from a
+    # dropped benchmark (old-only alone)
+    missing = {"old_only": sorted(set(old_m) - set(new_m)),
+               "new_only": sorted(set(new_m) - set(old_m))}
+    return rows, regressions, missing
+
+
+def _print_compare(rows, regressions, missing, label_a, label_b):
+    w = max([len(r[0]) for r in rows] + [10])
+    print(f"{'metric':<{w}}  {'old':>14}  {'new':>14}  {'delta':>8}  "
+          f"verdict   ({label_a} -> {label_b})")
+    for name, old, new, delta, unit, verdict in rows:
+        print(f"{name:<{w}}  {old:>14,.3f}  {new:>14,.3f}  "
+              f"{delta:>+7.1%}  {verdict}  [{unit}]")
+    for name in missing["old_only"]:
+        print(f"{name:<{w}}  (present in {label_a} only — not gated)")
+    for name in missing["new_only"]:
+        print(f"{name:<{w}}  (present in {label_b} only — not gated)")
+    if regressions:
+        print(f"REGRESSION: {', '.join(regressions)}")
+    elif rows:
+        print("no regressions")
+    else:
+        print("WARNING: no common metrics to compare")
+
+
+def run_compare(argv):
+    """``bench.py --compare A.json B.json [--tolerance 0.05]``: exit 1
+    when B regresses any shared metric beyond tolerance vs A."""
+    import argparse
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--compare", nargs=2, metavar=("OLD", "NEW"))
+    ap.add_argument("--tolerance", type=float, default=DEFAULT_TOLERANCE)
+    args = ap.parse_args(argv)
+    old_path, new_path = args.compare
+    rows, regressions, missing = compare_flat(
+        _flatten_bench_doc(_load_bench_doc(old_path)),
+        _flatten_bench_doc(_load_bench_doc(new_path)),
+        tolerance=args.tolerance)
+    _print_compare(rows, regressions, missing,
+                   os.path.basename(old_path), os.path.basename(new_path))
+    sys.exit(1 if regressions or not rows else 0)
+
+
+def run_history(argv):
+    """``bench.py --history ARTIFACT.json [--history-file F.jsonl]``:
+    gate the artifact against the history's last entry (exit 1 on
+    regression), then append it — the trajectory accumulates either
+    way, so one regressed round is visible in the trend, not lost."""
+    import argparse
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--history", metavar="ARTIFACT")
+    ap.add_argument("--history-file",
+                    default=os.path.join(HERE, "BENCH_history.jsonl"))
+    ap.add_argument("--tolerance", type=float, default=DEFAULT_TOLERANCE)
+    args = ap.parse_args(argv)
+    flat = _flatten_bench_doc(_load_bench_doc(args.history))
+    if not flat:
+        # same contract as --compare's empty-intersection case: a
+        # metric-less artifact means the bench output format broke —
+        # appending it would make the NEXT round's compare vacuously
+        # green too, greenlighting two broken rounds in a row
+        print(f"ERROR: {args.history} yields no gateable metrics; "
+              f"not appended")
+        sys.exit(1)
+    prev = None
+    if os.path.exists(args.history_file):
+        with open(args.history_file) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    try:
+                        prev = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+    rc = 0
+    if prev and isinstance(prev.get("metrics"), dict):
+        rows, regressions, missing = compare_flat(
+            prev["metrics"], flat, tolerance=args.tolerance)
+        _print_compare(rows, regressions, missing,
+                       f"history[{prev.get('n', '?')}]",
+                       os.path.basename(args.history))
+        # same contract as run_compare: ZERO shared metrics means the
+        # gate compared nothing (a metric rename, a format break) — that
+        # must fail loudly, not greenlight this round and the next
+        rc = 1 if regressions or not rows else 0
+    n = (prev.get("n", 0) + 1) if prev else 1
+    with open(args.history_file, "a") as f:
+        f.write(json.dumps({"n": n, "ts": time.time(),
+                            "source": os.path.abspath(args.history),
+                            "metrics": flat}) + "\n")
+    print(f"appended entry {n} to {args.history_file}")
+    sys.exit(rc)
 
 
 # ---------------------------------------------------------------------------
@@ -1087,6 +1346,10 @@ def dry_run():
     nonzero when any assertion fails, so CI catches an instrumentation
     or fast-path regression before it costs a real benchmark round."""
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # ISSUE-7: pin a fake per-device peak so the MFU math (hapi/mfu,
+    # serving_mfu) is exercised end to end on the CPU backend — without
+    # the override CPU honestly reports FLOP/s only, never an MFU
+    os.environ.setdefault("PADDLE_TPU_PEAK_FLOPS", "1e12")
     import tempfile
 
     import numpy as np
@@ -1094,7 +1357,9 @@ def dry_run():
     import paddle_tpu.nn as nn
     from paddle_tpu import profiler
     from paddle_tpu.framework import compile_cache, monitor
+    from paddle_tpu.framework import program_registry
     from paddle_tpu.io import TensorDataset
+    from paddle_tpu.profiler import memory as _memory
 
     # enable the compile cache into a throwaway dir BEFORE the first jit
     # so this very run produces entries (clean no-op on a jax without
@@ -1288,6 +1553,11 @@ def dry_run():
             return {
                 "traces_complete": traces_ok,
                 "summary": summary,
+                # ISSUE-7: per-engine compute figures derived from the
+                # decode step's program-registry cost analysis
+                "flops_per_token": stats.get("model_flops_per_token"),
+                "bytes_per_token": stats.get("decode_bytes_per_token"),
+                "serving_mfu": stats.get("serving_mfu"),
                 "engine_latency_present":
                     stats["ttft_ms"] is not None
                     and stats["tpot_ms"] is not None
@@ -1302,8 +1572,45 @@ def dry_run():
 
         serve_load_canary = _serve_load_canary()
 
+    # ISSUE-7: the bench regression gate, exercised the way the driver
+    # would use it — a seeded artifact vs a doctored copy with a 20%
+    # throughput loss and a 40% latency blowup must exit nonzero
+    # through the real --compare CLI, and a self-compare must exit 0.
+    # bench.py's parent entry imports no jax, so these children are
+    # milliseconds, not interpreter+backend startups.
+    import copy
+    import subprocess
+    seeded = {"metric": "gpt2_tps", "value": 100.0, "unit": "tokens/sec",
+              "extras": {
+                  "gpt2": {"metric": "gpt2_tps", "value": 100.0,
+                           "unit": "tokens/sec", "mfu": 0.40},
+                  "serve": {"metric": "serve_lenet_latency_p50_ms",
+                            "value": 10.0, "unit": "ms"}}}
+    doctored = copy.deepcopy(seeded)
+    doctored["extras"]["gpt2"]["value"] = 80.0       # -20% throughput
+    doctored["extras"]["serve"]["value"] = 14.0      # +40% latency
+    cmp_dir = tempfile.mkdtemp(prefix="paddle_dryrun_cmp_")
+    a_path = os.path.join(cmp_dir, "a.json")
+    b_path = os.path.join(cmp_dir, "b.json")
+    with open(a_path, "w") as f:
+        json.dump(seeded, f)
+    with open(b_path, "w") as f:
+        json.dump(doctored, f)
+    me = os.path.abspath(__file__)
+    rc_self = subprocess.run(
+        [sys.executable, me, "--compare", a_path, a_path],
+        capture_output=True).returncode
+    rc_regress = subprocess.run(
+        [sys.executable, me, "--compare", a_path, b_path],
+        capture_output=True).returncode
+    # the pure diff logic agrees with the CLI verdicts
+    _, regs, _ = compare_flat(_flatten_bench_doc(seeded),
+                              _flatten_bench_doc(doctored))
+
     counters = monitor.all_stats()
     host_syncs = monitor.stat_get("hapi/host_sync")
+    mem_ledger = _memory.ledger()
+    mem_timeline_labels = {e.get("label") for e in _memory.timeline()}
     trace_path = os.path.join(tempfile.mkdtemp(prefix="paddle_dryrun_"),
                               "trace.json")
     sess.export_chrome_trace(trace_path)
@@ -1380,6 +1687,32 @@ def dry_run():
         "serve_load_flight_recorder":
             serve_load_canary["flight_recorder_nonempty"],
         "serve_load_zero_retraces": serve_load_canary["zero_retraces"],
+        # ISSUE-7 compute/memory observability: every owned jit site
+        # registered its compile (compile/ms histogram + compile/count
+        # counter live), the train step's cost analysis produced
+        # hapi/flops_per_sec + hapi/mfu (pinned fake peak), the serving
+        # engines derived model-FLOPs-per-token from the decode step's
+        # registry record, the HBM ledger holds the train state + the
+        # timeline carries serving-cycle/pool watermarks, and the
+        # --compare regression gate flags the doctored artifact while
+        # self-compare stays green
+        "registry_compiles_recorded":
+            monitor.stat_get("compile/count") > 0
+            and monitor.stat_histogram("compile/ms") is not None,
+        "hapi_mfu_present":
+            monitor.stat_histogram("hapi/flops_per_sec") is not None
+            and monitor.stat_histogram("hapi/mfu") is not None,
+        "serving_flops_per_token":
+            (serve_load_canary.get("flops_per_token") or 0) > 0
+            and paged_stats.get("model_flops_per_token", 0) > 0,
+        "memory_ledger_live":
+            sum(mem_ledger.values()) > 0
+            and any(k.startswith("hapi/state") and k.endswith("/params")
+                    and v > 0 for k, v in mem_ledger.items())
+            and "serving/cycle" in mem_timeline_labels
+            and "kv/alloc" in mem_timeline_labels,
+        "bench_compare_gate":
+            rc_self == 0 and rc_regress != 0 and bool(regs),
     }
     print(monitor.stats_summary(), file=sys.stderr)
     for f in lint_findings:
@@ -1412,6 +1745,17 @@ def dry_run():
                       "paged_tokens_saved":
                           monitor.stat_get("serving/prefill_tokens_saved"),
                       "serve_load": serve_load_canary["summary"],
+                      "compile_count":
+                          int(monitor.stat_get("compile/count")),
+                      "hapi_mfu": (monitor.stat_histogram("hapi/mfu")
+                                   or {}).get("p50"),
+                      "serving_flops_per_token":
+                          serve_load_canary.get("flops_per_token"),
+                      "paged_flops_per_token":
+                          paged_stats.get("model_flops_per_token"),
+                      "memory_ledger_bytes": sum(mem_ledger.values()),
+                      "compare_gate_rc": {"self": rc_self,
+                                          "regression": rc_regress},
                       "loss": round(float(loss), 4), "checks": checks}),
           flush=True)
     sys.exit(0 if ok else 1)
@@ -1421,6 +1765,10 @@ if __name__ == "__main__":
     if len(sys.argv) >= 3 and sys.argv[1] == "--child":
         result = BENCHES[sys.argv[2]]()
         print("RESULT " + json.dumps(result))
+    elif "--compare" in sys.argv[1:]:
+        run_compare(sys.argv[1:])
+    elif "--history" in sys.argv[1:]:
+        run_history(sys.argv[1:])
     elif "--serve-load" in sys.argv[1:]:
         serve_load()
     elif "--dry-run" in sys.argv[1:]:

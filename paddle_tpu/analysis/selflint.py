@@ -25,6 +25,12 @@ Python cannot enforce (≙ the reference's tools/codestyle custom checks
   form) and ``.numpy()`` anywhere in the package are a per-step device
   stall. The single argued exception is the windowed token fetch
   (``serving/scheduler.py _fetch``), which carries the suppression.
+* ``memory-stats-hot-path`` — ``memory_stats()`` polling (a PjRt query
+  per call) stays OFF the scheduler hot path: inside ``serving/`` the
+  memory timeline is fed by host-only ``profiler.memory.mark()``
+  stamps; device polling belongs to the tracker's background sampler
+  thread (``profiler/memory.py``) and windowed surfaces like fit's
+  flush.
 
 Suppress a finding with a trailing ``# lint: ok`` comment on the line
 (used only where a human has argued the exception in an adjacent
@@ -189,6 +195,22 @@ def lint_source(path: str, source: str, relpath: str) -> List[LintFinding]:
                     f"batching decode loop must stay async — route "
                     f"device reads through the single windowed fetch "
                     f"(serving/scheduler.py _fetch)"))
+        # rule: memory-stats-hot-path (no device memory polling in the
+        # serving package — marks are host-only, the sampler thread
+        # polls)
+        if in_serving and isinstance(node, ast.Call):
+            f = node.func
+            poll = (isinstance(f, ast.Attribute)
+                    and f.attr == "memory_stats") or \
+                   (isinstance(f, ast.Name) and f.id == "memory_stats")
+            if poll and not _suppressed(lines, node.lineno):
+                findings.append(LintFinding(
+                    "memory-stats-hot-path", path, node.lineno,
+                    "memory_stats() polled in the serving package: a "
+                    "PjRt stats query per scheduler cycle — stamp "
+                    "host-only watermarks with profiler.memory.mark() "
+                    "and leave polling to the tracker's sampler thread "
+                    "(profiler/memory.py)"))
         # rule: device-get-hot-path
         if hot and isinstance(node, ast.Call) and _is_jax_device_get(node) \
                 and not _suppressed(lines, node.lineno):

@@ -58,14 +58,18 @@ class CostModel:
 
 
 def estimate_flops(fn, *example_args):
-    """FLOP estimate for a jittable callable via XLA cost analysis."""
-    import jax
-    lowered = jax.jit(fn).lower(*example_args)
-    compiled = lowered.compile()
-    try:
-        analysis = compiled.cost_analysis()
-        if isinstance(analysis, (list, tuple)):
-            analysis = analysis[0]
-        return float(analysis.get("flops", -1.0))
-    except Exception:
-        return -1.0
+    """FLOP estimate for a jittable callable via XLA cost analysis
+    (``framework/program_registry.analyze_callable`` — the one owner of
+    the trace→compile→cost_analysis dance). Returns ``None`` when the
+    backend provides no analysis — a dashboard must see "unknown", not
+    the ``-1.0`` this used to silently return and callers charted."""
+    import logging
+
+    from ..framework.program_registry import analyze_callable
+    res = analyze_callable(fn, *example_args)
+    if res is None or res.get("flops") is None:
+        logging.getLogger(__name__).debug(
+            "estimate_flops: backend provides no cost analysis for %r",
+            fn)
+        return None
+    return float(res["flops"])

@@ -20,7 +20,7 @@ from ..framework import get_device, set_device  # noqa: F401
 __all__ = ["get_device", "set_device", "device_count", "synchronize",
            "get_device_properties", "memory_allocated",
            "max_memory_allocated", "memory_reserved", "memory_stats",
-           "cuda", "is_compiled_with_cuda"]
+           "memory_summary", "cuda", "is_compiled_with_cuda"]
 
 
 def _jax():
@@ -90,6 +90,45 @@ def memory_reserved(device=None) -> int:
     return int(s.get("bytes_reserved", s.get("bytes_in_use", 0)))
 
 
+def _fmt_bytes(n: Optional[float]) -> str:
+    if n is None:
+        return "n/a"
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:,.1f} {unit}" if unit != "B" else f"{int(n):,} B"
+        n /= 1024
+    return f"{n:,.1f} TiB"
+
+
+def memory_summary(device=None) -> str:
+    """Human-readable HBM table (reference:
+    ``paddle.device.cuda.memory_summary``): bytes in use, peak, limit
+    and utilization, straight from the PjRt stats. On backends that
+    report nothing (CPU) the table says so instead of printing zeros
+    that look like measurements."""
+    d = _device(device)
+    s = memory_stats(d)
+    name = getattr(d, "device_kind", str(d))
+    lines = [f"device {d.platform}:{d.id} ({name})"]
+    if not s:
+        lines.append("  (backend reports no memory statistics)")
+        return "\n".join(lines)
+    in_use = s.get("bytes_in_use")
+    peak = s.get("peak_bytes_in_use")
+    limit = s.get("bytes_limit")
+    rows = [("bytes_in_use", _fmt_bytes(in_use)),
+            ("peak_bytes_in_use", _fmt_bytes(peak)),
+            ("bytes_limit", _fmt_bytes(limit))]
+    if in_use is not None and limit:
+        rows.append(("utilization", f"{100.0 * in_use / limit:.1f}%"))
+    if peak is not None and limit:
+        rows.append(("peak_utilization", f"{100.0 * peak / limit:.1f}%"))
+    w = max(len(k) for k, _ in rows)
+    lines += [f"  {k:<{w}}  {v}" for k, v in rows]
+    return "\n".join(lines)
+
+
 class _Properties:
     def __init__(self, d):
         self.name = getattr(d, "device_kind", str(d))
@@ -116,6 +155,7 @@ class _CudaAlias:
     memory_allocated = staticmethod(memory_allocated)
     max_memory_allocated = staticmethod(max_memory_allocated)
     memory_reserved = staticmethod(memory_reserved)
+    memory_summary = staticmethod(memory_summary)
     get_device_properties = staticmethod(get_device_properties)
 
     @staticmethod

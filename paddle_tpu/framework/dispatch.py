@@ -19,6 +19,7 @@ the reference's OpCache/kernel-factory lookups in the eager hot loop.
 from __future__ import annotations
 
 import functools
+import time
 from typing import Any, Dict, List, Tuple
 
 import jax
@@ -28,6 +29,7 @@ import numpy as np
 from ..ops.registry import get_op
 from .infermeta import maybe_check as _infermeta_check
 from . import dtypes as _dtypes
+from . import program_registry as _registry
 from . import static_capture as _capture
 from .flags import flag_value
 from .monitor import stat_add, stat_observe
@@ -178,26 +180,41 @@ def _get_callable(name: str, impl, template, attrs_key, attrs,
         stat_add(f"op_cache_miss/{name}")
         fn = _build_callable(impl, template, attrs, arr_attr_names, jit_ok,
                              probe_name=name, probe_static=attrs_key)
-        if _prof._active:
-            fn = _first_call_span(name, key, fn)
+        fn = _first_call_probe(
+            name, key, fn,
+            jitted=jit_ok and flag_value("FLAGS_eager_jit_ops"))
         _fn_cache[key] = fn
     else:
         stat_add("op_cache_hit")
     return fn
 
 
-def _first_call_span(name, key, built):
-    """Attribute the REAL compile cost to the trace: the jax.jit wrapper
-    is cheap, XLA compiles at the first invocation — so on a miss while
-    profiling, span that first call as jit_compile/<op> ("cache"
-    category; duration = trace+compile+first run) and self-replace the
-    cache entry with the raw callable, leaving zero steady-state
-    overhead."""
+def _first_call_probe(name, key, built, jitted=True):
+    """Attribute the REAL compile cost: the jax.jit wrapper is cheap,
+    XLA compiles at the first invocation — so on a miss, time that
+    first call (trace+compile+first run) into the program registry
+    (``compile/ms/op/<name>`` histogram + ``compile/count``; the
+    registry's dispatch-layer approximation — the op cache must stay
+    jax-owned, so no cost analysis here) and, while a profiler session
+    is armed, additionally span it as jit_compile/<op> ("cache"
+    category). ``jitted=False`` (a jit=False op, or FLAGS_eager_jit_ops
+    off) keeps the span but skips the registry note — an eager first
+    call compiles nothing, and the always-on compile counters must
+    never count one. Self-replaces the cache entry with the raw
+    callable, leaving zero steady-state overhead."""
     def traced(*arrays):
         if _fn_cache.get(key) is not built:
             _fn_cache[key] = built
-            with _prof.record(f"jit_compile/{name}", "cache"):
-                return built(*arrays)
+            t0 = time.perf_counter()
+            if _prof._active:
+                with _prof.record(f"jit_compile/{name}", "cache"):
+                    out = built(*arrays)
+            else:
+                out = built(*arrays)
+            if jitted:
+                _registry.note_compile(f"op/{name}",
+                                       (time.perf_counter() - t0) * 1e3)
+            return out
         return built(*arrays)  # replayed wrapper ref (static capture)
 
     return traced
@@ -265,12 +282,13 @@ def _get_bwd_callable(name: str, impl, template, attrs_key, fwd_fn,
                 return _inner(ct, *arrays)
 
             fn = jax.jit(bwd_raw)
+            bwd_jitted = True
         else:
             fn = bwd_raw
-        if _prof._active:
-            # backward compiles (often the larger cost) get the same
-            # first-call compile attribution as the forward
-            fn = _first_call_span(f"{name}.bwd", key, fn)
+            bwd_jitted = False
+        # backward compiles (often the larger cost) get the same
+        # first-call compile attribution as the forward
+        fn = _first_call_probe(f"{name}.bwd", key, fn, jitted=bwd_jitted)
         _fn_cache[key] = fn
     else:
         stat_add("op_cache_hit")

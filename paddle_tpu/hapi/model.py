@@ -22,11 +22,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..framework import program_registry as _registry
 from ..framework import random as _random
 from ..framework import trace_probe as _probe
 from ..framework.io import load as _load, save as _save
 from ..framework.monitor import stat_add, stat_observe
 from ..framework.tensor import Tensor, no_grad_guard
+from ..profiler import memory as _memory
 from ..profiler import span as _prof
 from ..io import DataLoader, Dataset
 from ..metric import Metric
@@ -40,6 +42,13 @@ def _to_list(x):
     if x is None:
         return []
     return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _drop_ledger_keys(keys):
+    """weakref.finalize target for a Model's HBM-ledger entries — a
+    module function so the finalizer holds no reference to the Model."""
+    for k in keys:
+        _memory.ledger_drop(k)
 
 
 class _StaticGraphAdapter:
@@ -220,6 +229,15 @@ class Model:
         self._amp_dtype = "bfloat16"
         self._static_adapter = None
         self.stop_training = False
+        # achieved-FLOP/s accounting for the async fit window: FLOPs of
+        # the step programs actually DISPATCHED since the last flush +
+        # the window's start stamp (hapi/flops_per_sec, hapi/mfu — see
+        # _observe_compute). Summing per dispatch — not steps × the
+        # record's latest-compile figure — keeps a partial last batch
+        # (its own smaller program) from mis-billing full-batch steps.
+        self._flush_flops = 0.0
+        self._flush_steps = 0
+        self._flush_t0 = None
 
     def _static(self):
         """The StaticGraphAdapter when ``paddle.enable_static()`` is on
@@ -498,9 +516,16 @@ class Model:
         # or can accidentally — touch the donated arrays afterwards;
         # a raw pre-step ._data capture raises jax's "Array has been
         # deleted", never silent garbage.
-        self._train_step_fn = jax.jit(train_step,
-                                      static_argnames=("n_inputs",),
-                                      donate_argnums=(0, 1, 2))
+        #
+        # The step is an AOT program-registry site (same jit semantics —
+        # static n_inputs at position 5, donated train state — but the
+        # executable is compiled explicitly ONCE per signature): compile
+        # wall-ms lands in compile/ms, and the program's XLA cost
+        # analysis (FLOPs/bytes) is what _observe_compute turns into
+        # hapi/flops_per_sec and hapi/mfu at every flush window.
+        self._train_step_fn = _registry.aot_site(
+            probe_site.name, train_step, static_argnums=(5,),
+            donate_argnums=(0, 1, 2))
 
     def _analysis_loss_fn(self, ins, lbs):
         """Loss-of-trainable-params closure mirroring _build_train_step's
@@ -567,9 +592,10 @@ class Model:
 
         # no donation here: eval/predict REUSE params and buffers across
         # batches (the step returns neither), so donating them would
-        # delete live state after the first batch
-        self._eval_step_fn = jax.jit(eval_step,
-                                     static_argnames=("n_inputs",))
+        # delete live state after the first batch. Registry site like
+        # the train step (static n_inputs at position 3).
+        self._eval_step_fn = _registry.aot_site(
+            "hapi/eval_step", eval_step, static_argnums=(3,))
 
     # -- single-batch APIs (reference train_batch/eval_batch/predict_batch) -
     def _pallas_gate(self):
@@ -587,12 +613,17 @@ class Model:
         rebound to the step's results in the same statement and the old
         handles are never touched again."""
         self._step_counter += 1
+        if self._flush_t0 is None:
+            self._flush_t0 = time.perf_counter()
+        self._flush_steps += 1
         key = jax.random.fold_in(jax.random.key(0), self._step_counter)
         lr = jnp.asarray(self._optimizer.get_lr(), jnp.float32)
         (self._params, self._opt_state, self._buffers, loss,
          outs) = self._train_step_fn(
             self._params, self._opt_state, self._buffers, key, lr,
             len(ins), *ins, *lbs)
+        self._flush_flops += getattr(self._train_step_fn,
+                                     "last_dispatch_flops", None) or 0.0
         self._dirty = True
         # reference-only rebind (no sync): the network must never be
         # left pointing at the donated pre-step buffers
@@ -741,7 +772,76 @@ class Model:
         stat_add("hapi/host_sync")
         stat_observe("hapi/host_sync_ms",
                      (time.perf_counter() - t0) * 1e3)
-        return self._pack_logs((loss, metrics) if metrics else loss)
+        logs = self._pack_logs((loss, metrics) if metrics else loss)
+        logs.update(self._observe_compute())
+        # HBM watermark at the step-boundary surface (the flush already
+        # blocks on the host sync; one PjRt stats query rides along)
+        _memory.sample("hapi/flush", steps=self._step_counter)
+        return logs
+
+    def _observe_compute(self):
+        """Achieved FLOP/s (and MFU against the device peak) for the
+        steps dispatched since the last flush, from the train step's
+        program-registry cost analysis: ``hapi/flops_per_sec`` always
+        when the backend reports FLOPs, ``hapi/mfu`` (plus an ``mfu``
+        entry in the flush logs, which the ProgBar prints) only when a
+        peak is known — the per-device table in
+        ``framework/program_registry.py``, overridable with
+        ``PADDLE_TPU_PEAK_FLOPS``; CPU has no honest peak. The FIRST
+        window includes trace+compile wall time, exactly like
+        ``hapi/step_time_ms``."""
+        now = time.perf_counter()
+        flops, self._flush_flops = self._flush_flops, 0.0
+        steps, self._flush_steps = self._flush_steps, 0
+        # re-arm lazily (next dispatch stamps the window start), NOT at
+        # `now`: eval/checkpoint wall time between the epoch-end flush
+        # and the next epoch's first batch must not deflate the next
+        # window's FLOP/s into a fake per-epoch MFU dip
+        t0, self._flush_t0 = self._flush_t0, None
+        out = {}
+        if not flops or not steps or t0 is None:
+            return out
+        wall = now - t0
+        if wall <= 0:
+            return out
+        achieved = flops / wall
+        stat_observe("hapi/flops_per_sec", achieved)
+        peak = _registry.peak_flops()
+        if peak:
+            out["mfu"] = achieved / peak
+            stat_observe("hapi/mfu", out["mfu"])
+        return out
+
+    def _update_memory_ledger(self):
+        """Register the train state's bytes in the HBM ledger
+        (profiler/memory.py) — the 'what WE think is live' side of the
+        ledger-vs-device crosscheck. Host arithmetic over avals only.
+
+        Keys are per-INSTANCE (the train step's probe-site name as the
+        prefix) so two Models in one process never alias each other's
+        entries, and a weakref finalizer drops them when the Model is
+        collected — a discarded model must not haunt the crosscheck or
+        an OOM postmortem with train state that is no longer live."""
+        import weakref
+
+        def tree_bytes(tree):
+            return sum(int(getattr(v, "nbytes", 0))
+                       for v in jax.tree_util.tree_leaves(tree or {}))
+        base = getattr(self, "_ledger_base", None)
+        if base is None:
+            site = getattr(self, "_probe_site", None)
+            name = site.name if site is not None else \
+                f"hapi/train_step[{type(self.network).__name__}" \
+                f"@{id(self):x}]"
+            base = self._ledger_base = name.replace(
+                "hapi/train_step", "hapi/state", 1)
+            keys = [f"{base}/params", f"{base}/opt_state",
+                    f"{base}/buffers"]
+            weakref.finalize(self, _drop_ledger_keys, keys)
+        _memory.ledger_set(f"{base}/params", tree_bytes(self._params))
+        _memory.ledger_set(f"{base}/opt_state",
+                           tree_bytes(self._opt_state))
+        _memory.ledger_set(f"{base}/buffers", tree_bytes(self._buffers))
 
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
@@ -812,6 +912,8 @@ class Model:
             self._sync_state_from_network()
             if self._train_step_fn is None:
                 self._build_train_step()
+            self._update_memory_ledger()
+        self._flush_flops, self._flush_steps, self._flush_t0 = 0.0, 0, None
         cbks.on_train_begin()
         try:
             for epoch in range(epochs):
@@ -869,7 +971,14 @@ class Model:
                                   verbose=verbose, callbacks=cbks,
                                   prefetch=prefetch, _inside_fit=True)
             cbks.on_train_end()
-        except BaseException:
+        except BaseException as e:
+            # an out-of-HBM death leaves the memory picture behind: the
+            # tracker's timeline, the ledger (params/opt_state/buffers +
+            # KV pools), and the largest live arrays, as JSON next to
+            # the serving flight recorder's dumps. Best-effort — the
+            # postmortem can never mask the original error.
+            if _memory.is_resource_exhausted(e):
+                _memory.oom_postmortem(e, extra={"phase": "Model.fit"})
             # teardown-only hook: a failed fit must not leak callback-held
             # process-global state (ProfilerCallback's armed span session),
             # but on_train_end keeps its success-only semantics (e.g.

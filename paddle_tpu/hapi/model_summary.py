@@ -103,12 +103,16 @@ def summary(net, input_size=None, dtypes=None, input=None):
 
 def flops(net, input_size=None, inputs=None, custom_ops=None,
           print_detail: bool = False) -> int:
-    """FLOPs of one forward pass, measured by XLA's cost analysis over the
-    traced program (reference: hapi/dynamic_flops.py counts per-layer by
-    hand; the compiler already knows)."""
-    import jax
-
+    """FLOPs of one forward pass, measured by XLA's cost analysis over
+    the traced program (reference: hapi/dynamic_flops.py counts
+    per-layer by hand; the compiler already knows). Routed through
+    ``framework/program_registry.analyze_callable`` — the same helper
+    behind ``cost_model.estimate_flops`` and every registry site.
+    Returns ``-1`` when the backend provides no analysis (the reference
+    API contract is an int; ``estimate_flops`` returns ``None`` for the
+    same case)."""
     import paddle_tpu as paddle
+    from ..framework.program_registry import analyze_callable
     from ..nn.layer.layers import functional_call, get_params_tree
 
     if inputs is not None:
@@ -126,14 +130,9 @@ def flops(net, input_size=None, inputs=None, custom_ops=None,
         first = out[0] if isinstance(out, (list, tuple)) else out
         return first._data
 
-    try:
-        compiled = jax.jit(fwd).lower(params, *arrs).compile()
-        analysis = compiled.cost_analysis()
-        if isinstance(analysis, (list, tuple)):
-            analysis = analysis[0]
-        total = int(analysis.get("flops", -1))
-    except Exception:
-        total = -1
+    res = analyze_callable(fwd, params, *arrs)
+    total = -1 if res is None or res.get("flops") is None \
+        else int(res["flops"])
     if print_detail:
         print(f"Total Flops: {total}")
     return total

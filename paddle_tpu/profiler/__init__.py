@@ -30,10 +30,14 @@ from .span import (  # noqa: F401
     span_summary, export_chrome_trace, export_prometheus,
 )
 
+# HBM memory tracker (memory.py): bounded device-stats timeline +
+# byte ledger (train state, KV pools) + the OOM postmortem dump
+from . import memory  # noqa: F401
+
 __all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
            "make_scheduler", "export_chrome_tracing", "export_protobuf",
            "SortedKeys", "load_profiler_result", "device_op_table",
            "summary_table",
            "record", "profile", "enable", "disable", "reset", "is_active",
            "events", "dropped", "span_summary", "export_chrome_trace",
-           "export_prometheus"]
+           "export_prometheus", "memory"]

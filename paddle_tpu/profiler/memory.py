@@ -1,0 +1,319 @@
+"""HBM memory tracker: timeline, ledger, and the OOM postmortem.
+
+Reference analog: the profiler's memory tab +
+``paddle.device.cuda.memory_allocated`` over the STAT gpu-mem counters
+(fluid/memory/stats.cc). On TPU, allocation belongs to PjRt — the
+user-visible surface is observability, three layers of it:
+
+* **timeline** — a bounded ring of samples over
+  ``device.memory_stats()`` (``bytes_in_use`` / ``peak`` / ``limit``),
+  fed by a background sampler thread (:func:`start_sampler`) plus
+  labeled watermarks at the moments that matter: fit's flush windows,
+  serving cycles, KV-pool alloc/free. Watermarks from the scheduler hot
+  path use :func:`mark` — a host-only stamp that NEVER polls the device
+  (the ``memory-stats-hot-path`` self-lint rule keeps polling on the
+  sampler thread); :func:`sample` additionally reads the device stats.
+* **ledger** — the bytes WE think are live, by owner: the train state
+  (params / opt_state / buffers, registered by ``Model.fit``) and the
+  serving KV pools (capacity + in-use, registered by the pools).
+  :func:`crosscheck` compares the ledger total against the device's
+  ``bytes_in_use`` — the gap is what nobody is accounting for.
+* **OOM postmortem** — ``RESOURCE_EXHAUSTED`` caught in ``Model.fit``
+  and the serving scheduler dumps the timeline, the ledger, and the
+  largest live arrays (``jax.live_arrays()``) to a JSON file next to
+  the flight recorder's auto-dump, never masking the original error.
+
+Threading: writers (``mark``/``ledger_set``) take the one small lock
+per call — they run per flush window / pool event / scheduler cycle,
+not per op, so contention is negligible (same argument as the flight
+recorder). The module-level default tracker is what the framework
+integrations use; tests build their own :class:`MemoryTracker` with a
+mocked stats function.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import tempfile
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+from ..framework.monitor import stat_add, stat_observe
+
+__all__ = ["MemoryTracker", "tracker", "sample", "mark", "ledger_set",
+           "ledger_drop", "ledger", "ledger_total", "crosscheck",
+           "start_sampler", "stop_sampler", "timeline",
+           "largest_live_arrays", "oom_postmortem",
+           "is_resource_exhausted"]
+
+logger = logging.getLogger(__name__)
+
+# substrings that mark an out-of-HBM failure across the surfaces it
+# arrives on (XlaRuntimeError repr, RuntimeError text, wrapped reprs).
+# Deliberately NO bare "OOM": three characters match inside unrelated
+# identifiers ("BOOM", a path segment) and a spurious postmortem
+# actively misdirects the triage it exists to aid.
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory")
+
+
+def is_resource_exhausted(error: BaseException) -> bool:
+    """Does this exception look like the device ran out of memory?"""
+    text = f"{type(error).__name__}: {error!r}"
+    return any(m in text for m in _OOM_MARKERS)
+
+
+def _device_stats() -> dict:
+    """One ``device.memory_stats()`` poll; {} when the backend doesn't
+    report (CPU) or the query fails."""
+    try:
+        from .. import device as _device
+        return _device.memory_stats() or {}
+    except Exception:                                    # noqa: BLE001
+        return {}
+
+
+class MemoryTracker:
+    """Bounded HBM timeline + byte ledger + postmortem dump."""
+
+    def __init__(self, max_samples: int = 2048,
+                 stats_fn: Optional[Callable[[], dict]] = None):
+        if max_samples < 1:
+            raise ValueError("max_samples must be >= 1")
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=int(max_samples))
+        self._ledger: Dict[str, int] = {}
+        self._stats_fn = stats_fn or _device_stats
+        self.samples_recorded = 0       # monotonic (ring drops, this doesn't)
+        self.last_dump_path: Optional[str] = None
+        self.dumps = 0
+        self._sampler: Optional[threading.Thread] = None
+        self._sampler_stop = threading.Event()
+
+    # -- timeline ----------------------------------------------------------
+    def _append(self, entry: dict) -> None:
+        with self._lock:
+            entry["ledger_bytes"] = sum(self._ledger.values())
+            self._ring.append(entry)
+            self.samples_recorded += 1
+
+    def sample(self, label: Optional[str] = None, **meta) -> dict:
+        """Poll the device stats and append one timeline entry. NOT for
+        the scheduler hot path — that is :meth:`mark`'s job (the
+        ``memory-stats-hot-path`` self-lint rule enforces it)."""
+        stats = self._stats_fn() or {}
+        entry: Dict[str, Any] = {"t": time.perf_counter()}
+        if label is not None:
+            entry["label"] = label
+        for k in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+            if k in stats:
+                entry[k] = int(stats[k])
+        entry.update(meta)
+        self._append(entry)
+        if "bytes_in_use" in entry:
+            stat_observe("memory/bytes_in_use", entry["bytes_in_use"])
+        return entry
+
+    def mark(self, label: str, **meta) -> dict:
+        """Host-only watermark: a labeled timeline stamp carrying the
+        ledger total but NO device poll — safe from the scheduler
+        thread, pool alloc/free, and anywhere else a stats query would
+        stall the hot path. Device numbers around it come from the
+        sampler thread's periodic :meth:`sample` entries."""
+        entry: Dict[str, Any] = {"t": time.perf_counter(), "label": label}
+        entry.update(meta)
+        self._append(entry)
+        return entry
+
+    def timeline(self) -> List[dict]:
+        with self._lock:
+            return [dict(e) for e in self._ring]
+
+    # -- background sampler ------------------------------------------------
+    def start(self, interval: float = 0.2) -> None:
+        """Start the background sampler thread (idempotent): one
+        :meth:`sample` every ``interval`` seconds until :meth:`stop`."""
+        with self._lock:
+            if self._sampler is not None and self._sampler.is_alive():
+                return
+            self._sampler_stop = threading.Event()
+            stop = self._sampler_stop
+
+            def _loop():
+                while not stop.wait(interval):
+                    try:
+                        self.sample(label="sampler")
+                    except Exception:                    # noqa: BLE001
+                        pass        # a flaky stats query must not kill it
+            self._sampler = threading.Thread(
+                target=_loop, daemon=True, name="paddle-memory-sampler")
+            self._sampler.start()
+
+    def stop(self) -> None:
+        with self._lock:
+            t, self._sampler = self._sampler, None
+            self._sampler_stop.set()
+        if t is not None:
+            t.join(timeout=5)
+
+    # -- ledger ------------------------------------------------------------
+    def ledger_set(self, key: str, nbytes: int) -> None:
+        with self._lock:
+            self._ledger[key] = int(nbytes)
+
+    def ledger_drop(self, key: str) -> None:
+        with self._lock:
+            self._ledger.pop(key, None)
+
+    def ledger(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._ledger)
+
+    def ledger_total(self) -> int:
+        with self._lock:
+            return sum(self._ledger.values())
+
+    def crosscheck(self) -> dict:
+        """Ledger vs device: how much of ``bytes_in_use`` do the
+        registered owners explain? ``device_bytes_in_use`` is ``None``
+        where the backend doesn't report (CPU) — then only the ledger
+        side is meaningful."""
+        stats = self._stats_fn() or {}
+        in_use = stats.get("bytes_in_use")
+        led = self.ledger_total()
+        out: Dict[str, Any] = {
+            "ledger_bytes": led,
+            "device_bytes_in_use": None if in_use is None else int(in_use),
+            "unexplained_bytes": None,
+            "explained_ratio": None,
+        }
+        if in_use:
+            out["unexplained_bytes"] = int(in_use) - led
+            out["explained_ratio"] = led / int(in_use)
+        return out
+
+    # -- postmortem --------------------------------------------------------
+    def largest_live_arrays(self, n: int = 20) -> List[dict]:
+        """The ``n`` biggest live device arrays (shape/dtype/bytes),
+        biggest first — the "what is actually holding HBM" list of the
+        OOM postmortem. Host bookkeeping only (sizes come from avals)."""
+        try:
+            import jax
+            arrays = jax.live_arrays()
+        except Exception:                                # noqa: BLE001
+            return []
+        rows = []
+        for a in arrays:
+            try:
+                rows.append({"shape": list(a.shape), "dtype": str(a.dtype),
+                             "nbytes": int(a.nbytes)})
+            except Exception:                            # noqa: BLE001
+                continue        # deleted/donated handles have no size
+        rows.sort(key=lambda r: r["nbytes"], reverse=True)
+        return rows[:n]
+
+    def oom_postmortem(self, error: Optional[BaseException] = None,
+                       path: Optional[str] = None,
+                       extra: Optional[dict] = None) -> Optional[str]:
+        """Dump the memory picture at the moment of death: timeline,
+        ledger, ledger-vs-device crosscheck, and the largest live
+        arrays, as JSON. Best effort and NEVER raises — it runs inside
+        failure handlers, and a broken disk must not mask the original
+        error. Returns the file path (``None`` on failure)."""
+        try:
+            doc: Dict[str, Any] = {
+                "reason": repr(error) if error is not None else "requested",
+                "dumped_at": time.time(),
+                "timeline": self.timeline(),
+                "ledger": self.ledger(),
+                "crosscheck": self.crosscheck(),
+                "largest_live_arrays": self.largest_live_arrays(),
+            }
+            if extra:
+                doc.update(extra)
+            if path is None:
+                path = os.path.join(
+                    tempfile.gettempdir(),
+                    f"paddle_oom_postmortem_{os.getpid()}_{id(self):x}"
+                    f".json")
+            d = os.path.dirname(os.path.abspath(path))
+            os.makedirs(d, exist_ok=True)
+            with open(path, "w") as f:
+                json.dump(doc, f, default=repr)
+            with self._lock:
+                self.last_dump_path = path
+                self.dumps += 1
+            stat_add("memory/oom_postmortem")
+            logger.error("OOM postmortem written to %s", path)
+            return path
+        except Exception:                                # noqa: BLE001
+            return None
+
+    def __repr__(self):
+        with self._lock:
+            return (f"<MemoryTracker samples={len(self._ring)}/"
+                    f"{self.samples_recorded} ledger_keys="
+                    f"{len(self._ledger)}>")
+
+
+# ---------------------------------------------------------------------------
+# module-level default tracker (what the framework integrations use)
+# ---------------------------------------------------------------------------
+
+_tracker = MemoryTracker()
+
+
+def tracker() -> MemoryTracker:
+    return _tracker
+
+
+def sample(label: Optional[str] = None, **meta) -> dict:
+    return _tracker.sample(label, **meta)
+
+
+def mark(label: str, **meta) -> dict:
+    return _tracker.mark(label, **meta)
+
+
+def ledger_set(key: str, nbytes: int) -> None:
+    _tracker.ledger_set(key, nbytes)
+
+
+def ledger_drop(key: str) -> None:
+    _tracker.ledger_drop(key)
+
+
+def ledger() -> Dict[str, int]:
+    return _tracker.ledger()
+
+
+def ledger_total() -> int:
+    return _tracker.ledger_total()
+
+
+def crosscheck() -> dict:
+    return _tracker.crosscheck()
+
+
+def start_sampler(interval: float = 0.2) -> None:
+    _tracker.start(interval)
+
+
+def stop_sampler() -> None:
+    _tracker.stop()
+
+
+def timeline() -> List[dict]:
+    return _tracker.timeline()
+
+
+def largest_live_arrays(n: int = 20) -> List[dict]:
+    return _tracker.largest_live_arrays(n)
+
+
+def oom_postmortem(error: Optional[BaseException] = None,
+                   path: Optional[str] = None,
+                   extra: Optional[dict] = None) -> Optional[str]:
+    return _tracker.oom_postmortem(error, path=path, extra=extra)

@@ -58,8 +58,10 @@ from typing import Iterator, Optional
 
 import numpy as np
 
+from ..framework import program_registry as _registry
 from ..framework import trace_probe as _probe
 from ..framework.monitor import stat_add
+from ..profiler import memory as _memory
 from .kv_pool import KVCachePool
 from .paging import PagedKVPool, PoolCapacityError
 from .scheduler import (GenerationRequest, Scheduler, _fetch)
@@ -164,13 +166,25 @@ class GenerationEngine:
                 cfg.num_hidden_layers, num_slots, cfg.num_attention_heads,
                 max_len, head_dim, dtype=dtype, min_bucket=min_bucket)
             self._decode_probe = _probe.site(f"serving/decode#{self._eid}")
-            self._decode_jit = jax.jit(
+            # program-registry AOT site (same jit semantics, donated
+            # pool): THE decode step's compile ms + XLA cost analysis
+            # land under this name — stats() derives flops-per-token
+            # and serving MFU from its record
+            self._decode_jit = _registry.aot_site(
+                f"serving/decode#{self._eid}",
                 build_slot_decode_fn(model, self._pool.num_slots, max_len,
                                      top_k=self._top_k, top_p=self._top_p,
                                      probe=self._decode_probe),
                 donate_argnums=(2,))
         self._closed = False
         self._close_lock = threading.Lock()
+        # per-engine compute accounting (scheduler-thread writes, host
+        # ints): FLOPs of the decode programs actually DISPATCHED — a
+        # paged engine runs different table-bucket programs with very
+        # different costs, so stats() must average what ran, not bill
+        # the largest bucket to every cycle
+        self._decode_flops_dispatched = 0.0
+        self._decode_dispatches = 0
         self._sched = Scheduler(
             self._pool, self._run_prefill, self._run_decode,
             max_queue=max_queue, prefill_budget=prefill_budget,
@@ -278,6 +292,8 @@ class GenerationEngine:
                 return
             self._closed = True
         self._sched.close(cancel_pending=cancel_pending)
+        # a closed engine's pool is no longer an accounted HBM owner
+        self._pool.drop_ledger()
 
     def __enter__(self):
         return self
@@ -323,6 +339,14 @@ class GenerationEngine:
         # process, so two engines (or back-to-back tests) would
         # contaminate each other's figures there
         s.update(self._sched.recorder.latency_summary())
+        s.update(self._compute_stats())
+        # KV memory, from the HBM ledger (profiler/memory.py — the pool
+        # publishes capacity + in-use bytes there on every alloc/free)
+        led = _memory.ledger()
+        s["kv_pool_capacity_bytes"] = led.get(
+            f"{pool.ledger_key}/capacity", pool.capacity_bytes)
+        s["kv_bytes_in_use"] = led.get(
+            f"{pool.ledger_key}/in_use", pool.bytes_in_use)
         if self._paged:
             hits, misses = pool.prefix_hits, pool.prefix_misses
             s.update({
@@ -338,6 +362,53 @@ class GenerationEngine:
                 "prefix_evictions": pool.evictions,
             })
         return s
+
+    def _compute_stats(self) -> dict:
+        """Model-FLOPs-per-token and serving MFU, from the decode
+        step's program-registry cost analysis (``serving/decode*`` AOT
+        sites). One decode step advances EVERY slot one token, so
+        flops-per-token = step FLOPs / num_slots (the full-batch cost —
+        a partially occupied batch still pays it, which is exactly what
+        an operator sizing capacity wants to see). Throughput comes
+        from THIS engine's flight-recorder cycle ring; MFU needs a
+        known device peak (``program_registry.peak_flops``, env-
+        overridable) — absent one (CPU), raw FLOP/s are reported."""
+        if not self._decode_dispatches:
+            return {}
+        mean_step_flops = \
+            self._decode_flops_dispatched / self._decode_dispatches
+        if not mean_step_flops:
+            return {}
+        out = {}
+        S = self._pool.num_slots
+        out["model_flops_per_token"] = mean_step_flops / S
+        rec = None
+        if self._paged:
+            if self._decode_jits:
+                rec = self._decode_jits[max(self._decode_jits)].record
+        elif self._decode_jit is not None:
+            rec = getattr(self._decode_jit, "record", None)
+        if rec is not None and rec.bytes_accessed and rec.flops:
+            # scale the largest bucket's bytes by the mean-cost ratio so
+            # bytes-per-token tracks what actually ran, like the FLOPs
+            out["decode_bytes_per_token"] = \
+                rec.bytes_accessed * (mean_step_flops / rec.flops) / S
+        thr = self._sched.recorder.cycle_throughput()
+        if thr["cycle_secs"] > 0 and thr["decode_cycles"] > 0:
+            out["decode_tokens_per_sec"] = \
+                thr["emitted"] / thr["cycle_secs"]
+            # FLOPs summed per cycle IN the ring (same window as the
+            # wall-time denominator; sweep-only/drain cycles contribute
+            # wall but zero FLOPs); the lifetime mean is only the
+            # fallback for rings recorded before the engine attached
+            flops_in_ring = thr["decode_flops"] or \
+                mean_step_flops * thr["decode_cycles"]
+            achieved = flops_in_ring / thr["cycle_secs"]
+            out["serving_flops_per_sec"] = achieved
+            peak = _registry.peak_flops()
+            if peak:
+                out["serving_mfu"] = achieved / peak
+        return out
 
     def dump_flight_recorder(self, path: Optional[str] = None) -> dict:
         """Postmortem snapshot of the scheduler's always-on flight
@@ -386,8 +457,6 @@ class GenerationEngine:
     def _prefill_fn(self, bucket: int):
         fn = self._prefill_jits.get(bucket)
         if fn is None:
-            import jax
-
             from ..models.generation import (build_paged_prefill_fn,
                                              build_slot_prefill_fn)
             probe = _probe.site(f"serving/prefill[{bucket}]#{self._eid}")
@@ -399,18 +468,19 @@ class GenerationEngine:
                 built = build_slot_prefill_fn(
                     self._model, bucket, self._pool.max_len,
                     top_k=self._top_k, top_p=self._top_p, probe=probe)
-            fn = jax.jit(built, donate_argnums=(2,))
+            fn = _registry.aot_site(
+                f"serving/prefill[{bucket}]#{self._eid}", built,
+                donate_argnums=(2,))
             self._prefill_jits[bucket] = fn
         return fn
 
     def _paged_decode_fn(self, table_len: int):
         fn = self._decode_jits.get(table_len)
         if fn is None:
-            import jax
-
             from ..models.generation import build_paged_decode_fn
             probe = _probe.site(f"serving/decode[t{table_len}]#{self._eid}")
-            fn = jax.jit(
+            fn = _registry.aot_site(
+                f"serving/decode[t{table_len}]#{self._eid}",
                 build_paged_decode_fn(self._model, self._pool.num_slots,
                                       table_len, self._pool.block_size,
                                       top_k=self._top_k, top_p=self._top_p,
@@ -500,14 +570,33 @@ class GenerationEngine:
             # trace per bucket, exactly the prefill-bucket discipline
             T = max(self._pool.table_bucket(s) for s in slot_requests)
             tables = self._pool.table_array(T, slot_requests)
-            self._pool.data, nxt, self._key = self._paged_decode_fn(T)(
+            step = self._paged_decode_fn(T)
+            self._pool.data, nxt, self._key = step(
                 self._params, self._buffers, self._pool.data, tokens, pos,
                 lo, tables, sample_mask, temps, self._key)
+            self._note_decode_dispatch(step)
             return nxt
         self._pool.data, nxt, self._key = self._decode_jit(
             self._params, self._buffers, self._pool.data, tokens, pos, lo,
             sample_mask, temps, self._key)
+        self._note_decode_dispatch(self._decode_jit)
         return nxt
+
+    def _note_decode_dispatch(self, step) -> None:
+        """Account the FLOPs of the decode program that actually ran
+        this cycle (host arithmetic only): lifetime counters for the
+        mean, plus the live cycle record so cycle_throughput() keeps
+        achieved-FLOP/s on the same ring window as its wall-time
+        denominator (a lifetime mean alone would lag a shifting
+        bucket mix)."""
+        self._decode_dispatches += 1
+        flops = getattr(step, "last_dispatch_flops", None)
+        if flops is None:
+            rec = getattr(step, "record", None)
+            flops = rec.flops if rec is not None else None
+        if flops:
+            self._decode_flops_dispatched += flops
+            self._sched.note_decode_flops(flops)
 
     def _run_copy(self, dst: int, src: int) -> None:
         """Copy-on-write append support: device-copy block ``src`` over
@@ -516,11 +605,10 @@ class GenerationEngine:
         serves every copy — and the pool is donated like every other
         step. Device-to-device only: no host sync."""
         if self._copy_jit is None:
-            import jax
-
             def _copy(pool, dst, src):
                 return pool.at[:, :, dst].set(pool[:, :, src])
 
-            self._copy_jit = jax.jit(_copy, donate_argnums=(0,))
+            self._copy_jit = _registry.aot_site(
+                f"serving/copy#{self._eid}", _copy, donate_argnums=(0,))
         self._pool.data = self._copy_jit(self._pool.data, np.int32(dst),
                                          np.int32(src))

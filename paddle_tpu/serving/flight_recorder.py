@@ -109,6 +109,25 @@ class FlightRecorder:
 
         return {"ttft_ms": pct(ttft), "tpot_ms": pct(tpot)}
 
+    def cycle_throughput(self) -> Dict[str, float]:
+        """Decode throughput over the cycle ring: cycles recorded in the
+        ring, tokens emitted, and summed cycle wall seconds —
+        ``engine.stats()`` derives per-engine tokens/sec and serving MFU
+        from THIS ring (per-engine by construction, like the latency
+        reservoirs)."""
+        with self._lock:
+            cycles = len(self._cycles)
+            emitted = sum(c.get("emitted", 0) for c in self._cycles)
+            secs = sum(c.get("cycle_ms", 0.0) for c in self._cycles) / 1e3
+            decode_cycles = sum(
+                1 for c in self._cycles
+                if c.get("decode_dispatch_ms", 0.0) > 0.0)
+            decode_flops = sum(c.get("decode_flops", 0.0)
+                               for c in self._cycles)
+        return {"cycles": cycles, "emitted": emitted, "cycle_secs": secs,
+                "decode_cycles": decode_cycles,
+                "decode_flops": decode_flops}
+
     def snapshot(self) -> Dict[str, Any]:
         """JSON-serializable copy of both rings + the counters."""
         with self._lock:

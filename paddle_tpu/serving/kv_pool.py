@@ -27,11 +27,25 @@ by the engine after every donated step (the old array is deleted by XLA
 """
 from __future__ import annotations
 
+import itertools
 from typing import Dict, List, Optional
 
 import numpy as np
 
+from ..profiler import memory as _memory
+
 __all__ = ["KVCachePool", "SlotPoolBase"]
+
+# process-wide pool numbering for the HBM ledger keys (two engines in
+# one process must not alias each other's ledger entries)
+_pool_ids = itertools.count(1)
+
+
+def _drop_pool_ledger(ledger_key: str) -> None:
+    """weakref.finalize target for a pool's ledger entries — a module
+    function so the finalizer holds no reference to the pool."""
+    _memory.ledger_drop(f"{ledger_key}/capacity")
+    _memory.ledger_drop(f"{ledger_key}/in_use")
 
 
 class _Slot:
@@ -68,8 +82,46 @@ class SlotPoolBase:
     def _init_slots(self) -> None:
         # lowest-index-first keeps slot assignment deterministic (tests
         # and trace/debug output stay stable across runs)
+        import weakref
         self._free_slots: List[int] = list(range(self.num_slots))
         self._slots: Dict[int, _Slot] = {}
+        self.ledger_key = f"serving/kv_pool#{next(_pool_ids)}"
+        # a pool dropped WITHOUT engine.close() (exception paths, tests
+        # building pools directly) must not haunt crosscheck()/OOM
+        # postmortems with phantom KV bytes — same finalizer discipline
+        # as the hapi train-state ledger keys
+        weakref.finalize(self, _drop_pool_ledger, self.ledger_key)
+        self._update_ledger()
+
+    # -- HBM ledger (profiler/memory.py) -----------------------------------
+    @property
+    def capacity_bytes(self) -> int:
+        """Device bytes of the whole pool array (host arithmetic over
+        shape/dtype — never touches the array)."""
+        return int(np.prod(self.shape)) * self.dtype.itemsize
+
+    @property
+    def bytes_in_use(self) -> int:
+        """Bytes of the capacity actually claimed by live requests —
+        whole slot stripes here; the paged pool overrides with
+        block-granular accounting."""
+        return self.n_active * (self.capacity_bytes // self.num_slots)
+
+    def _update_ledger(self) -> None:
+        """Publish capacity + in-use bytes into the process HBM ledger
+        (the 'what we think is live' side of the ledger-vs-device
+        crosscheck). Host dict stores only — called from alloc/free and
+        the paged block hooks, all scheduler-thread, all sync-free."""
+        _memory.ledger_set(f"{self.ledger_key}/capacity",
+                           self.capacity_bytes)
+        _memory.ledger_set(f"{self.ledger_key}/in_use", self.bytes_in_use)
+
+    def drop_ledger(self) -> None:
+        """Remove this pool's ledger entries (engine close): the pool
+        array may outlive the engine object briefly, but a closed
+        engine's pool is no longer an accounted owner."""
+        _memory.ledger_drop(f"{self.ledger_key}/capacity")
+        _memory.ledger_drop(f"{self.ledger_key}/in_use")
 
     # -- slot allocation ---------------------------------------------------
     def alloc(self) -> Optional[int]:
@@ -79,6 +131,9 @@ class SlotPoolBase:
         slot = min(self._free_slots)
         self._free_slots.remove(slot)
         self._slots[slot] = self._slot_cls()
+        self._update_ledger()
+        _memory.mark("kv/alloc", pool=self.ledger_key, slot=slot,
+                     in_use=self.bytes_in_use)
         return slot
 
     def free(self, slot: int) -> None:
@@ -92,6 +147,9 @@ class SlotPoolBase:
         st = self._slots.pop(slot)
         self._slot_freed(st)
         self._free_slots.append(slot)
+        self._update_ledger()
+        _memory.mark("kv/free", pool=self.ledger_key, slot=slot,
+                     in_use=self.bytes_in_use)
 
     def _slot_freed(self, st) -> None:
         """Layout hook: called by :meth:`free` with the popped slot

@@ -177,11 +177,13 @@ class PagedKVPool(SlotPoolBase):
         # sit on the per-decode-cycle hot path
         self._free: List[int] = list(range(1, self.num_blocks + 1))
         self._ref: Dict[int, int] = {}            # block -> request refs
-        self._init_slots()                        # request slots (base)
         # prefix cache: exact-prefix-keyed trie + LRU of released blocks
+        # (before _init_slots: the base ctor publishes the HBM ledger
+        # entry, whose in-use figure reads blocks_in_use -> _lru)
         self._trie: Dict[Tuple[int, ...], _TrieNode] = {}
         self._block_key: Dict[int, Tuple[int, ...]] = {}
         self._lru: "OrderedDict[Tuple[int, ...], _TrieNode]" = OrderedDict()
+        self._init_slots()                        # request slots (base)
         # pool-local prefix stats (engine.stats() reads these without
         # scraping process-global monitor counters)
         self.prefix_hits = 0
@@ -241,6 +243,18 @@ class PagedKVPool(SlotPoolBase):
         or waiting in the LRU)."""
         return len(self._trie)
 
+    @property
+    def block_bytes(self) -> int:
+        """Device bytes of ONE block across every layer/kv plane (the
+        quantum the HBM ledger accounts paged usage in)."""
+        return self.capacity_bytes // (self.num_blocks + 1)
+
+    @property
+    def bytes_in_use(self) -> int:
+        """Block-granular override of the base's whole-slot accounting:
+        only blocks referenced by live page tables count."""
+        return self.blocks_in_use * self.block_bytes
+
     def can_admit(self, n_tokens: int) -> bool:
         """Admission gate: enough free + evictable blocks to hold the
         request's first ``n_tokens`` tokens. Growth past that is the
@@ -250,6 +264,9 @@ class PagedKVPool(SlotPoolBase):
 
     def _observe(self) -> None:
         stat_observe("serving/kv_blocks_in_use", self.blocks_in_use)
+        # block-granular HBM ledger refresh: _observe already fires at
+        # every block-count change (alloc/unref/evict/free/reset)
+        self._update_ledger()
 
     def _alloc_block(self) -> int:
         if not self._free:

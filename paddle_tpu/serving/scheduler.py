@@ -52,6 +52,7 @@ from typing import Callable, Dict, List, Optional
 import numpy as np
 
 from ..framework.monitor import stat_add, stat_observe
+from ..profiler import memory as _memory
 from ..profiler import span as _prof
 from .flight_recorder import FlightRecorder
 from .paging import PoolExhaustedError
@@ -381,6 +382,12 @@ class Scheduler:
                 rec["cycle_ms"] = (time.perf_counter() - t0) * 1e3
                 stat_observe("serving/cycle_ms", rec["cycle_ms"])
                 self.recorder.record_cycle(rec)
+                # HBM watermark per cycle — a host-only stamp
+                # (profiler/memory.py mark: ledger total, NO device
+                # poll — polling belongs to the sampler thread; the
+                # memory-stats-hot-path self-lint rule enforces it)
+                _memory.mark("serving/cycle", cycle=self._cycle,
+                             active=rec["active"])
                 self._rec = None
                 if failed is not None:
                     # leave the postmortem behind: the profiler is
@@ -388,6 +395,27 @@ class Scheduler:
                     # but the recorder's rings (this poisoned cycle
                     # included) hold what led here
                     self.recorder.auto_dump(reason=repr(failed))
+                    if _memory.is_resource_exhausted(failed):
+                        # out-of-HBM death: the memory picture (ledger,
+                        # timeline, largest live arrays) lands as JSON
+                        # next to the flight recorder's dump — best
+                        # effort, the original error is already on its
+                        # way to every poisoned request
+                        _memory.oom_postmortem(failed, extra={
+                            "phase": "serving.scheduler",
+                            "cycle": self._cycle,
+                            "flight_recorder":
+                                self.recorder.last_dump_path})
+
+    def note_decode_flops(self, flops: float) -> None:
+        """Record the FLOPs of the decode program dispatched THIS cycle
+        into the live cycle record (called by the engine's do_decode,
+        scheduler thread). cycle_throughput sums it alongside emitted,
+        keeping stats() achieved-FLOP/s on the same ring window as its
+        wall-time denominator."""
+        if self._rec is not None:
+            self._rec["decode_flops"] = \
+                self._rec.get("decode_flops", 0.0) + float(flops)
 
     def _fail_inflight(self, error: BaseException) -> None:
         for slot in list(self._slots):

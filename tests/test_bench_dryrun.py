@@ -94,3 +94,25 @@ def test_dry_run_emits_metrics_summary():
     assert "serving/tpot_ms" in res.stderr
     assert "serving/cycle_ms" in res.stderr
     assert "serving/batch_occupancy" in res.stderr
+    # ISSUE-7 compute/memory observability: every owned jit site
+    # registered its compile cost (compile/ms + compile/count live), the
+    # train step's XLA cost analysis produced hapi/flops_per_sec and —
+    # under the dry run's pinned fake peak — hapi/mfu, both serving
+    # engines derived model-FLOPs-per-token from their decode records,
+    # the HBM ledger holds the train state with serving-cycle/pool
+    # watermarks on the timeline, and the --compare regression gate
+    # flagged the doctored artifact while the self-compare exited 0
+    assert out["checks"]["registry_compiles_recorded"] is True, out
+    assert out["checks"]["hapi_mfu_present"] is True, out
+    assert out["checks"]["serving_flops_per_token"] is True, out
+    assert out["checks"]["memory_ledger_live"] is True, out
+    assert out["checks"]["bench_compare_gate"] is True, out
+    assert out["compile_count"] > 0, out
+    assert out["hapi_mfu"] is not None and out["hapi_mfu"] > 0, out
+    assert out["serving_flops_per_token"] > 0, out
+    assert out["paged_flops_per_token"] > 0, out
+    assert out["memory_ledger_bytes"] > 0, out
+    assert out["compare_gate_rc"] == {"self": 0, "regression": 1}, out
+    assert "compile/ms" in res.stderr
+    assert "hapi/mfu" in res.stderr
+    assert "hapi/flops_per_sec" in res.stderr

@@ -1,0 +1,232 @@
+"""Compiled-program registry (framework/program_registry.py): per-site
+compile counters, cost-analysis fields tolerant of CPU backends, and
+the MFU math against a pinned fake peak."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.framework import monitor, program_registry as registry
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    registry.reset()
+    yield
+    registry.reset()
+
+
+class TestAotSite:
+    def test_per_site_compile_counters(self):
+        import jax.numpy as jnp
+
+        monitor.stat_reset()
+
+        def f(a, b):
+            return a @ a + b
+
+        site = registry.aot_site("test/matmul", f)
+        x = jnp.ones((8, 8))
+        site(x, x)
+        site(jnp.zeros((8, 8)), x)       # same signature: no recompile
+        assert site.record.compiles == 1
+        site(jnp.ones((4, 4)), jnp.ones((4, 4)))   # new shape: compile
+        assert site.record.compiles == 2
+        assert monitor.stat_get("compile/count") == 2
+        h = monitor.stat_histogram("compile/ms/test/matmul")
+        assert h is not None and h["count"] == 2
+        assert monitor.stat_histogram("compile/ms") is not None
+        # the registry snapshot carries the same record
+        assert registry.get("test/matmul").compiles == 2
+        assert "test/matmul" in registry.snapshot()
+
+    def test_cost_analysis_fields_tolerant(self):
+        import jax.numpy as jnp
+
+        site = registry.aot_site("test/cost", lambda a: (a @ a).sum())
+        site(jnp.ones((16, 16)))
+        rec = site.record
+        # CPU provides cost analysis on this image; the contract either
+        # way is "a real number or None" — never a fake -1
+        assert rec.flops is None or rec.flops > 0
+        assert rec.bytes_accessed is None or rec.bytes_accessed > 0
+        assert rec.eqns is None or rec.eqns >= 1
+        for field in ("temp_bytes", "argument_bytes", "output_bytes"):
+            v = getattr(rec, field)
+            assert v is None or v >= 0
+
+    def test_static_args_select_programs(self):
+        import jax.numpy as jnp
+
+        def f(a, n):
+            return a * n
+
+        site = registry.aot_site("test/static", f, static_argnums=(1,))
+        a = jnp.ones(4)
+        assert float(site(a, 2)[0]) == 2.0
+        assert float(site(a, 3)[0]) == 3.0   # new static: new program
+        assert site.record.compiles == 2
+        assert float(site(a, 2)[0]) == 2.0   # cached
+        assert site.record.compiles == 2
+
+    def test_donation_honored(self):
+        import jax
+        import jax.numpy as jnp
+
+        site = registry.aot_site("test/donate", lambda a: a + 1,
+                                 donate_argnums=(0,))
+        x = jnp.ones(8)
+        y = site(x)
+        assert float(y[0]) == 2.0
+        assert x.is_deleted()            # donated input consumed
+        # and the site keeps serving fresh buffers
+        z = site(jnp.zeros(8))
+        assert float(z[0]) == 1.0
+        del jax
+
+    def test_transparent_under_tracing(self):
+        import jax
+        import jax.numpy as jnp
+
+        site = registry.aot_site("test/traced", lambda a: a * 2)
+        x = jnp.ones(4)
+        site(x)
+        before = site.record.compiles
+        jaxpr = jax.make_jaxpr(lambda a: site(a) + 1)(x)
+        assert len(jaxpr.jaxpr.eqns) >= 1   # pjit eqn inlined
+        assert site.record.compiles == before   # tracing never compiles
+
+    def test_note_compile_only_sites(self):
+        monitor.stat_reset()
+        rec = registry.note_compile("op/fake", 12.5)
+        assert rec.compiles == 1 and rec.flops is None
+        registry.note_compile("op/fake", 7.5, eqns=3,
+                              analysis={"flops": 100.0})
+        assert rec.compiles == 2 and rec.flops == 100.0 and rec.eqns == 3
+        assert monitor.stat_get("compile/count") == 2
+
+
+class TestAnalyzeCallable:
+    def test_flops_on_cpu(self):
+        import jax.numpy as jnp
+
+        res = registry.analyze_callable(lambda a: a @ a,
+                                        jnp.ones((16, 16)))
+        assert res is not None
+        assert res["flops"] is None or res["flops"] > 0
+        assert res["eqns"] is None or res["eqns"] >= 1
+
+    def test_failure_returns_none(self):
+        def broken(a):
+            raise RuntimeError("cannot trace this")
+
+        assert registry.analyze_callable(broken, np.ones(4)) is None
+
+    def test_analyze_compiled_tolerates_stub(self):
+        class _Stub:
+            def cost_analysis(self):
+                raise NotImplementedError
+
+            def memory_analysis(self):
+                raise NotImplementedError
+
+        res = registry.analyze_compiled(_Stub())
+        assert res["flops"] is None and res["bytes_accessed"] is None
+
+    def test_estimate_flops_none_contract(self, monkeypatch):
+        from paddle_tpu import cost_model
+        import jax.numpy as jnp
+
+        f = cost_model.estimate_flops(lambda a: a @ a, jnp.ones((8, 8)))
+        assert f is None or f > 0
+        # backend without analysis -> None, never -1.0
+        monkeypatch.setattr(registry, "analyze_callable",
+                            lambda *a, **k: {"flops": None, "eqns": 1})
+        assert cost_model.estimate_flops(lambda a: a + 1,
+                                         jnp.ones(4)) is None
+
+
+class TestPeakFlopsAndMfu:
+    def test_env_override_pins_peak(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_PEAK_FLOPS", "1e12")
+        assert registry.peak_flops() == 1e12
+        monkeypatch.setenv("PADDLE_TPU_PEAK_FLOPS", "garbage")
+        assert registry.peak_flops("cpu") is None
+        monkeypatch.delenv("PADDLE_TPU_PEAK_FLOPS")
+        assert registry.peak_flops("TPU v4") == 275e12
+        assert registry.peak_flops("cpu") is None   # no honest CPU peak
+
+    def test_fit_reports_mfu_with_pinned_peak(self, monkeypatch):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.io import TensorDataset
+
+        monkeypatch.setenv("PADDLE_TPU_PEAK_FLOPS", "1e12")
+        monitor.stat_reset()
+        rng = np.random.RandomState(0)
+        net = nn.Sequential(nn.Linear(16, 8), nn.ReLU(), nn.Linear(8, 4))
+        model = paddle.Model(net)
+        model.prepare(paddle.optimizer.Adam(learning_rate=1e-3,
+                                            parameters=net.parameters()),
+                      nn.CrossEntropyLoss())
+        xs = rng.randn(32, 16).astype(np.float32)
+        ys = rng.randint(0, 4, (32, 1)).astype(np.int64)
+        model.fit(TensorDataset([xs, ys]), batch_size=8, epochs=1,
+                  log_freq=2, shuffle=False, verbose=0)
+        # the train step registered its program: compile ms + FLOPs
+        rec = model._train_step_fn.record
+        assert rec.compiles >= 1
+        assert rec.flops is None or rec.flops > 0
+        if rec.flops:
+            fps = monitor.stat_histogram("hapi/flops_per_sec")
+            mfu = monitor.stat_histogram("hapi/mfu")
+            assert fps is not None and fps["count"] >= 1
+            assert mfu is not None and mfu["count"] >= 1
+            # MFU math: achieved / pinned peak, strictly positive and
+            # consistent with the flops_per_sec series
+            assert 0 < mfu["max"] == pytest.approx(fps["max"] / 1e12)
+
+    def test_mfu_absent_without_peak(self, monkeypatch):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.io import TensorDataset
+
+        monkeypatch.delenv("PADDLE_TPU_PEAK_FLOPS", raising=False)
+        monitor.stat_reset()
+        rng = np.random.RandomState(0)
+        net = nn.Linear(8, 4)
+        model = paddle.Model(net)
+        model.prepare(paddle.optimizer.SGD(learning_rate=0.1,
+                                           parameters=net.parameters()),
+                      nn.CrossEntropyLoss())
+        xs = rng.randn(16, 8).astype(np.float32)
+        ys = rng.randint(0, 4, (16, 1)).astype(np.int64)
+        model.fit(TensorDataset([xs, ys]), batch_size=8, epochs=1,
+                  shuffle=False, verbose=0)
+        # CPU has no honest peak: FLOP/s may be present, MFU must not
+        assert monitor.stat_histogram("hapi/mfu") is None
+
+
+class TestServingFlopsPerToken:
+    def test_engine_stats_compute_figures(self, monkeypatch):
+        from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining
+        from paddle_tpu.serving import GenerationEngine
+
+        monkeypatch.setenv("PADDLE_TPU_PEAK_FLOPS", "1e12")
+        paddle.framework.random.seed(0)
+        model = GPTForPretraining(GPTConfig.tiny())
+        model.eval()
+        eng = GenerationEngine(model, num_slots=2, max_len=32,
+                               min_bucket=8)
+        try:
+            h = eng.submit(np.arange(1, 6, dtype=np.int32),
+                           max_new_tokens=4)
+            h.result(timeout=300)
+            stats = eng.stats()
+        finally:
+            eng.close()
+        assert stats.get("model_flops_per_token", 0) > 0
+        assert stats.get("decode_bytes_per_token", 0) > 0
+        assert stats.get("decode_tokens_per_sec", 0) > 0
+        assert stats.get("serving_flops_per_sec", 0) > 0
+        assert stats.get("serving_mfu", 0) > 0
+        # kv bytes ride along from the ledger (satellite contract)
+        assert stats["kv_pool_capacity_bytes"] > 0
+        assert stats["kv_bytes_in_use"] == 0    # request retired

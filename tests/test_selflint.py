@@ -76,6 +76,34 @@ def test_serving_host_sync_rule():
     assert [f.line for f in out] == [4, 5]
 
 
+def test_memory_stats_hot_path_rule():
+    # polling device memory stats inside the serving package is a PjRt
+    # query on the scheduler hot path — both the method and bare-name
+    # call forms are flagged
+    src = ("from paddle_tpu import device\n"
+           "def cycle(d):\n"
+           "    a = device.memory_stats()\n"        # flagged
+           "    b = memory_stats()\n"               # flagged
+           "    return a, b\n")
+    out = lint_source("t.py", src, "serving/scheduler.py")
+    assert [f.rule for f in out] == ["memory-stats-hot-path"] * 2
+    assert [f.line for f in out] == [3, 4]
+    # host-only watermarks (profiler.memory.mark) are the sanctioned
+    # path and are not flagged
+    ok = ("from paddle_tpu.profiler import memory as _memory\n"
+          "def cycle(n):\n"
+          "    _memory.mark('serving/cycle', cycle=n)\n")
+    assert lint_source("t.py", ok, "serving/scheduler.py") == []
+    # the same poll OUTSIDE serving/ (the sampler thread's home, fit's
+    # windowed flush) is legitimate
+    assert lint_source("t.py", src, "profiler/memory.py") == []
+    # suppression with an argued justification is honored
+    sup = src.replace("device.memory_stats()",
+                      "device.memory_stats()  # lint: ok")
+    out = lint_source("t.py", sup, "serving/engine.py")
+    assert [f.line for f in out] == [4]
+
+
 def test_asarray_rule():
     src = (
         "import numpy as np\n"

@@ -146,7 +146,8 @@ class TestCostModel:
         import jax.numpy as jnp
         from paddle_tpu.cost_model import estimate_flops
         f = estimate_flops(lambda a: a @ a, jnp.ones((16, 16)))
-        assert f == -1.0 or f > 0
+        # None = "backend has no cost analysis", never a fake -1.0
+        assert f is None or f > 0
 
 
 class TestCallbacksAlias:
