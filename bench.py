@@ -581,6 +581,106 @@ def bench_gpt2_decode():
             "device_kind": _device_kind(), **pallas_state}
 
 
+def bench_attn():
+    """Gather-vs-fused paged attention microbench (``--bench-attn``):
+    the same decode workload through GenerationEngine(attention=
+    "gather") and ("fused"), reporting per-decode-step ms (flight-
+    recorder cycle ring: dispatch + fetch of decode-only cycles) and
+    bytes-accessed-per-token (PR-7 program-registry XLA cost analysis
+    of the step that actually served). The fused step must be SELECTED
+    and token-parity with the gather oracle must hold — a fused path
+    that silently fell back or drifted is an error, not a number.
+    Lands in the BENCH artifact so ``--history`` gates the speedup."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining
+    from paddle_tpu.serving import GenerationEngine
+
+    pallas_state = _setup_pallas()
+    if _smoke() or jax_backend_is_cpu():
+        cfg, slots, prompt, new, reqs = GPTConfig.tiny(), 4, 12, 16, 8
+    else:
+        cfg = GPTConfig.gpt2_small()
+        cfg.hidden_dropout_prob = 0.0
+        cfg.attention_dropout_prob = 0.0
+        slots, prompt, new, reqs = 8, 64, 64, 16
+    paddle.framework.random.seed(0)
+    model = GPTForPretraining(cfg)
+    model.eval()
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, cfg.vocab_size, prompt).astype(np.int32)
+               for _ in range(reqs)]
+
+    def run(attention):
+        eng = GenerationEngine(
+            model, num_slots=slots, max_len=prompt + new + 8,
+            kv_layout="paged", block_size=16, attention=attention)
+        # warm with a FULL concurrent wave of the same workload: the
+        # fused engine compiles one program per (q-row, table) bucket
+        # and the concurrent-occupancy q buckets only exist at
+        # concurrency — a single-request warm-up would leave the fused
+        # side paying multi-second compiles inside the timed region
+        # while the gather side (whose buckets depend only on context
+        # length) ran fully warm
+        warm = [eng.submit(p, max_new_tokens=new) for p in prompts]
+        [h.result(timeout=600) for h in warm]
+        warm_snap = eng._sched.recorder.snapshot()
+        warm_last = warm_snap["cycles"][-1]["cycle"] \
+            if warm_snap["cycles"] else 0
+        t0 = time.perf_counter()
+        hs = [eng.submit(p, max_new_tokens=new) for p in prompts]
+        outs = [h.result(timeout=600) for h in hs]
+        wall = time.perf_counter() - t0
+        thr = eng._sched.recorder.cycle_throughput()
+        snap = eng._sched.recorder.snapshot()
+        # decode-step samples from TIMED cycles only (warm cycles carry
+        # the compile wall inside decode_dispatch_ms)
+        decode_ms = [c["decode_dispatch_ms"] + c["fetch_ms"]
+                     for c in snap["cycles"]
+                     if c["cycle"] > warm_last
+                     and c.get("decode_dispatch_ms", 0) > 0
+                     and not c.get("chunk_tokens")]
+        stats = eng.stats()
+        # evidence, not the echoed ctor arg: a fused engine that
+        # actually served compiled fused (q, table)-bucket programs
+        selected = (bool(eng._fused_jits) if attention == "fused"
+                    else not eng._fused_jits)
+        eng.close()
+        return {
+            "outs": outs,
+            "selected": selected,
+            "decode_step_ms": (round(float(np.median(decode_ms)), 3)
+                               if decode_ms else None),
+            "bytes_per_token": stats.get("decode_bytes_per_token"),
+            "tokens_per_sec": round(reqs * new / wall, 1),
+            "emitted": thr["emitted"],
+        }
+
+    gather = run("gather")
+    fused = run("fused")
+    parity = all(np.array_equal(a, b)
+                 for a, b in zip(gather.pop("outs"), fused.pop("outs")))
+    if not fused["selected"] or not parity:
+        raise RuntimeError(
+            f"fused attention bench invalid: selected={fused['selected']} "
+            f"parity={parity}")
+    out = {"metric": "attn_fused_decode_step_ms",
+           "value": fused["decode_step_ms"], "unit": "ms",
+           "fused": fused, "gather": gather, "parity": parity,
+           "batch_requests": reqs, "prompt_len": prompt,
+           "new_tokens": new,
+           "device_kind": _device_kind(), **pallas_state}
+    if gather["decode_step_ms"] and fused["decode_step_ms"]:
+        out["speedup_vs_gather"] = round(
+            gather["decode_step_ms"] / fused["decode_step_ms"], 3)
+    return out
+
+
+def jax_backend_is_cpu():
+    import jax
+    return jax.default_backend() == "cpu"
+
+
 def bench_probe():
     """Backend health probe: bare jax (no framework import), one tiny
     matmul on the real backend. Healthy backend: seconds. The parent
@@ -607,7 +707,7 @@ BENCHES = {"gpt2": bench_gpt2, "resnet50": bench_resnet50,
            "gpt2_fp32": lambda: bench_gpt2(amp_o2=False),
            "resnet50_pipeline": bench_resnet50_pipeline,
            "eager": bench_eager, "serve": bench_serve,
-           "gpt2_decode": bench_gpt2_decode,
+           "gpt2_decode": bench_gpt2_decode, "attn": bench_attn,
            "probe": bench_probe}
 
 
@@ -1288,6 +1388,12 @@ def main():
         if "error" not in extra:
             results["gpt2_decode"] = extra
             _emit(results)
+    if remaining() > 90:
+        # gather-vs-fused ragged paged attention (serving decode step)
+        extra = _run_child("attn", timeout=child_timeout())
+        if "error" not in extra:
+            results["attn"] = extra
+            _emit(results)
     if not _smoke():
         for name in ("gpt2", "bert"):
             if remaining() < 90 or not results.get(name, {}).get("pallas"):
@@ -1515,6 +1621,61 @@ def dry_run():
         paged_served, paged_report, paged_one_trace, paged_stats = \
             _paged_canary()
 
+        # fused canary (ISSUE 8): the SAME mixed-length prompts through
+        # GenerationEngine(attention="fused") — the fused ragged-paged-
+        # attention Pallas step (interpret mode on this CPU backend)
+        # must be SELECTED, produce token-identical output to the
+        # gather engine (the correctness oracle), chunk a long prompt
+        # under a tight prefill budget, analyze clean, and trace once
+        # per (q, table) bucket.
+        def _fused_canary():
+            from paddle_tpu.framework import trace_probe
+            from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining
+            from paddle_tpu.serving import GenerationEngine
+
+            paddle.framework.random.seed(0)
+            model = GPTForPretraining(GPTConfig.tiny())
+            model.eval()
+            prompts = [np.arange(1, 1 + n, dtype=np.int32)
+                       for n in (3, 9, 17, 5)] \
+                + [np.arange(2, 42, dtype=np.int32)]   # chunks at budget 8
+            outs = {}
+            for kind in ("gather", "fused"):
+                eng = GenerationEngine(model, num_slots=4, max_len=64,
+                                       min_bucket=8, kv_layout="paged",
+                                       block_size=8, attention=kind,
+                                       prefill_budget=8)
+                handles = [eng.submit(p, max_new_tokens=5)
+                           for p in prompts]
+                outs[kind] = [h.result(timeout=300) for h in handles]
+                if kind == "fused":
+                    report = eng.analyze()
+                    stats = eng.stats()
+                    sites = {k: v
+                             for k, v in trace_probe.snapshot().items()
+                             if k.startswith("serving/fused")
+                             and k.endswith(f"#{eng._eid}")}
+                eng.close()
+            parity = all(np.array_equal(a, b) for a, b in
+                         zip(outs["gather"], outs["fused"]))
+            one_trace = bool(sites) and all(
+                s["traces"] == 1 and not s["causes"]
+                for s in sites.values())
+            return {
+                "parity": parity,
+                # evidence of the fused path actually serving: fused
+                # (q, table)-bucket probe sites recorded traces (the
+                # stats()["attention"] field merely echoes the ctor arg)
+                "selected": bool(sites) and all(
+                    s["traces"] >= 1 for s in sites.values()),
+                "report": report,
+                "one_trace": one_trace,
+                "prefill_chunks": stats["prefill_chunks"],
+                "chunk_tokens": stats["chunked_prefill_tokens"],
+            }
+
+        fused_canary = _fused_canary()
+
         # serve-load canary (ISSUE 6): a seeded mini open-arrival run
         # through the SAME harness --serve-load uses — every trace
         # completes in lifecycle order, TTFT/TPOT derive per request,
@@ -1674,6 +1835,17 @@ def dry_run():
             and paged_stats["prefix_hit_ratio"] > 0,
         "paged_decode_clean": paged_report.ok(),
         "paged_one_trace_per_bucket": paged_one_trace,
+        # ISSUE-8 fused surface: the fused ragged-paged-attention step
+        # was SELECTED (not silently fallen back), its greedy output is
+        # token-identical to the gather oracle, a long prompt chunked
+        # under the 8-token budget (>= 5 launches), the fused step
+        # analyzes clean, and every (q, table) bucket traced once
+        "fused_selected": fused_canary["selected"],
+        "fused_parity": fused_canary["parity"],
+        "fused_chunked_prefill": fused_canary["prefill_chunks"] >= 5
+        and fused_canary["chunk_tokens"] >= 40,
+        "fused_step_clean": fused_canary["report"].ok(),
+        "fused_one_trace_per_bucket": fused_canary["one_trace"],
         # ISSUE-6 serving observability: the mini serve-load run's
         # traces all completed in lifecycle order, the per-token decode
         # cadence histogram is live, per-engine stats() latency derives
@@ -1724,6 +1896,8 @@ def dry_run():
         print(serving_report.table(), file=sys.stderr)
     if not paged_report.ok():
         print(paged_report.table(), file=sys.stderr)
+    if not fused_canary["report"].ok():
+        print(fused_canary["report"].table(), file=sys.stderr)
     ok = all(checks.values())
     print(json.dumps({"metric": "dry_run", "ok": ok,
                       "counters": len(counters),
@@ -1744,6 +1918,9 @@ def dry_run():
                           monitor.stat_get("serving/prefix_hit"),
                       "paged_tokens_saved":
                           monitor.stat_get("serving/prefill_tokens_saved"),
+                      "fused_prefill_chunks":
+                          fused_canary["prefill_chunks"],
+                      "fused_chunk_tokens": fused_canary["chunk_tokens"],
                       "serve_load": serve_load_canary["summary"],
                       "compile_count":
                           int(monitor.stat_get("compile/count")),
@@ -1771,6 +1948,10 @@ if __name__ == "__main__":
         run_history(sys.argv[1:])
     elif "--serve-load" in sys.argv[1:]:
         serve_load()
+    elif "--bench-attn" in sys.argv[1:]:
+        # standalone gather-vs-fused microbench: one JSON line, same
+        # schema as the child result that lands in the round artifact
+        print("RESULT " + json.dumps(bench_attn()))
     elif "--dry-run" in sys.argv[1:]:
         dry_run()
     else:

@@ -102,7 +102,12 @@ def main():
     ap.add_argument("--paged", action="store_true",
                     help="paged KV blocks + prefix cache instead of "
                          "dense per-slot stripes")
+    ap.add_argument("--fused", action="store_true",
+                    help="fused ragged-paged-attention Pallas step + "
+                         "chunked prefill (implies --paged)")
     args = ap.parse_args()
+    if args.fused:
+        args.paged = True
 
     paddle.seed(0)
     model = build_model(args.train_steps)
@@ -115,9 +120,10 @@ def main():
         # for every prompt/max_new the clients draw — on the 16/32/64
         # ladder a worst re-admission feed past 64 tokens would have
         # no bucket and submit() would reject it
-        engine = GenerationEngine(model, num_slots=args.slots,
-                                  max_len=128, min_bucket=16,
-                                  kv_layout="paged", block_size=8)
+        engine = GenerationEngine(
+            model, num_slots=args.slots, max_len=128, min_bucket=16,
+            kv_layout="paged", block_size=8,
+            attention="fused" if args.fused else "gather")
     else:
         engine = GenerationEngine(model, num_slots=args.slots, max_len=96,
                                   min_bucket=8)
@@ -194,6 +200,10 @@ def main():
               f"({stats['prefix_hits']} hit / "
               f"{stats['prefix_misses']} miss), "
               f"prefill tokens saved {stats['prefill_tokens_saved']}")
+    if args.fused:
+        print(f"  fused: attention={stats['attention']}, "
+              f"prefill chunks {stats['prefill_chunks']} "
+              f"({stats['chunked_prefill_tokens']} tokens chunked)")
 
 
 if __name__ == "__main__":

@@ -31,6 +31,20 @@ Python cannot enforce (≙ the reference's tools/codestyle custom checks
   stamps; device polling belongs to the tracker's background sampler
   thread (``profiler/memory.py``) and windowed surfaces like fit's
   flush.
+* ``pallas-block-tiling`` — Mosaic's TPU block-shape rule, statically:
+  inside ``ops/``, a ``pl.BlockSpec`` whose block tuple carries a
+  LITERAL second-to-last dim not divisible by 8, or a literal last dim
+  neither divisible by 128 nor >= 8-aligned... — precisely: the
+  second-to-last block dim must be divisible by 8 (or equal the array
+  dim) and the last must be 128-aligned (or the full array dim). The
+  AST cannot see array shapes, so literal dims that fail the divisible
+  test are flagged and a spec that is legal because the block IS the
+  full array dim carries a ``# lint: ok`` suppression with the argument
+  adjacent. This is the exact ``(1, 128)``-block crash BENCH_r02
+  recorded on hardware (flash-attention LSE output), turned into a
+  standing static check. SMEM specs and shapeless (whole-array) specs
+  are exempt; dynamic dims (names/expressions) are trusted — the
+  kernels derive them from array shapes.
 
 Suppress a finding with a trailing ``# lint: ok`` comment on the line
 (used only where a human has argued the exception in an adjacent
@@ -158,6 +172,34 @@ class _AsarrayVisitor(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+def _blockspec_literal_dims(node: ast.Call):
+    """For a ``BlockSpec(...)`` call (attribute or bare-name form, the
+    block tuple positional or via ``block_shape=``): the shape tuple's
+    last two elements as ints where they are literals (None where
+    dynamic), or None when the spec has no block tuple / is
+    SMEM-space."""
+    f = node.func
+    name = f.attr if isinstance(f, ast.Attribute) else (
+        f.id if isinstance(f, ast.Name) else None)
+    if name != "BlockSpec":
+        return None
+    shape = node.args[0] if node.args else None
+    for kw in node.keywords:
+        if kw.arg == "memory_space" and isinstance(kw.value, ast.Attribute) \
+                and kw.value.attr == "SMEM":
+            return None            # scalar memory: no (8, 128) tiling
+        if kw.arg == "block_shape" and shape is None:
+            shape = kw.value
+    if not isinstance(shape, ast.Tuple) or len(shape.elts) < 2:
+        return None
+
+    def lit(e):
+        return e.value if isinstance(e, ast.Constant) \
+            and isinstance(e.value, int) else None
+
+    return lit(shape.elts[-2]), lit(shape.elts[-1])
+
+
 def lint_source(path: str, source: str, relpath: str) -> List[LintFinding]:
     """Lint one file's source. ``relpath`` is the path relative to the
     package root (rule applicability is keyed on it)."""
@@ -173,8 +215,32 @@ def lint_source(path: str, source: str, relpath: str) -> List[LintFinding]:
     # the serving PACKAGE only — inference/serving.py (the gather-and-run
     # batcher) blocks its callers by design and is not in scope
     in_serving = rel.startswith("serving/")
+    # Pallas kernels live in ops/ — BlockSpec tiling is checked there
+    in_ops = rel.startswith("ops/")
 
     for node in ast.walk(tree):
+        # rule: pallas-block-tiling (Mosaic (8, 128) block-shape law)
+        if in_ops and isinstance(node, ast.Call):
+            dims = _blockspec_literal_dims(node)
+            if dims is not None and not _suppressed(lines, node.lineno):
+                sub, lane = dims
+                if sub is not None and (sub < 1 or sub % 8):
+                    findings.append(LintFinding(
+                        "pallas-block-tiling", path, node.lineno,
+                        f"BlockSpec second-to-last block dim {sub} is "
+                        f"not divisible by 8: Mosaic rejects the layout "
+                        f"on TPU (the BENCH_r02 (1, 128) crash) unless "
+                        f"it equals the array dim — if it provably "
+                        f"does, argue it in an adjacent comment and "
+                        f"suppress with '# lint: ok'"))
+                if lane is not None and (lane < 1 or lane % 128):
+                    findings.append(LintFinding(
+                        "pallas-block-tiling", path, node.lineno,
+                        f"BlockSpec last block dim {lane} is not "
+                        f"128-aligned: Mosaic rejects the layout on TPU "
+                        f"unless it equals the array dim — if it "
+                        f"provably does, argue it in an adjacent "
+                        f"comment and suppress with '# lint: ok'"))
         # rule: serving-host-sync (no host sync in the decode loop)
         if in_serving and isinstance(node, ast.Call):
             sync = None

@@ -30,7 +30,7 @@ from ..framework.tensor import Tensor, no_grad_guard
 __all__ = ["GenerationConfig", "generate", "save_for_serving",
            "shard_params_megatron", "build_slot_prefill_fn",
            "build_slot_decode_fn", "build_paged_prefill_fn",
-           "build_paged_decode_fn"]
+           "build_paged_decode_fn", "build_fused_step_fn"]
 
 
 def shard_params_megatron(model, mesh, mp_axis="mp"):
@@ -722,6 +722,113 @@ def build_paged_decode_fn(model, num_slots, table_len, block_size,
                     x = block._tail(x, a)
                 x = gpt.ln_f(x)
                 logits = gpt.logits(x)._data[:, 0].astype(jnp.float32)
+                key, sub = jax.random.split(key)
+                greedy = _pick_token(logits, sub, False, top_k, top_p, 1.0)
+                sampled = _pick_token(logits, sub, True, top_k, top_p,
+                                      temperature[:, None])
+                nxt = jnp.where(sample_mask, sampled, greedy)
+        return new_pool, nxt, key
+
+    return fn
+
+
+def build_fused_step_fn(model, num_slots, q_rows, table_len, block_size,
+                        top_k=0, top_p=1.0, probe=None):
+    """Build THE fused ragged serving step: one jitted program that
+    advances a RAGGED batch of mixed prefill-chunk and decode rows
+    through every layer with the fused paged-attention Pallas kernel
+    (ops/ragged_paged_attention.py) — no gathered KV window, the kernel
+    walks each sequence's page table directly in HBM. This is the
+    ``GenerationEngine(attention="fused")`` decode/chunk step; the
+    gather-based :func:`build_paged_decode_fn` stays as the correctness
+    oracle.
+
+    Returns ``fn(params, buffers, pool, token_ids, qpos, write_block,
+    write_off, blk_seq, seq_qstart, seq_pos0, tables, lo, kv_len,
+    last_row, sample_mask, temperature, key) -> (pool, next_tokens,
+    key)`` over the block pool ``[layers, 2, num_blocks + 1, heads,
+    block_size, head_dim]``:
+
+    * ``token_ids``/``qpos``/``write_block``/``write_off`` ``[q_rows]``
+      int32 — the flattened padded ragged batch (see
+      ``ops.ragged_paged_attention.ragged_layout``): each row's token,
+      virtual cache position, and page-table-resolved physical write
+      block/offset (pad rows write the scratch block); every row's K/V
+      are scattered into the pool BEFORE the kernel runs, so a chunk
+      row attends causally to its own chunk prefix;
+    * ``blk_seq [q_rows / 8]``, ``seq_qstart``/``seq_pos0``/``lo``/
+      ``kv_len`` ``[num_slots]``, ``tables [num_slots, table_len]`` —
+      the kernel's scalar-prefetch metadata;
+    * ``last_row [num_slots]`` int32 — the flattened row of each slot's
+      LAST real token this launch: its hidden state produces the slot's
+      next-token logits, so a slot whose final feed chunk lands this
+      cycle gets its first generated token from the SAME launch that
+      prefilled the tail (rows of slots mid-chunk or absent produce
+      garbage the scheduler ignores);
+    * ``sample_mask``/``temperature`` ``[num_slots]`` are traced (one
+      program serves mixed greedy/sampled batches); the caller jits
+      with ``donate_argnums`` on ``pool`` and the engine's ``analyze()``
+      must report the program donation-safe and host-sync-free.
+
+    One trace per ``(q_rows bucket, table bucket)`` — the fused twin of
+    the prefill/table pow2 bucket discipline, watched by ``probe``.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..framework import trace_probe as _probe
+    from ..nn.layer.layers import functional_state
+    from ..ops.ragged_paged_attention import BLOCK_Q, ragged_paged_attention
+
+    gpt = model.gpt if hasattr(model, "gpt") else model
+    S, Q, T, bs = (int(num_slots), int(q_rows), int(table_len),
+                   int(block_size))
+    if S < 1:
+        raise ValueError(f"num_slots must be >= 1, got {S}")
+    if Q < BLOCK_Q or Q % BLOCK_Q:
+        raise ValueError(
+            f"q_rows must be a positive multiple of {BLOCK_Q}, got {Q}")
+    if T < 1:
+        raise ValueError(f"table_len must be >= 1, got {T}")
+    top_k = min(int(top_k), gpt.cfg.vocab_size)
+
+    def fn(params, buffers, pool, token_ids, qpos, write_block, write_off,
+           blk_seq, seq_qstart, seq_pos0, tables, lo, kv_len, last_row,
+           sample_mask, temperature, key):
+        if probe is not None:  # runs at trace time only (jit caches)
+            probe.record(_probe.sig_of([pool, token_ids, tables]),
+                         {"q": Q, "table": T})
+        with functional_state(model, params, buffers):
+            with no_grad_guard():
+                # logical positions == virtual positions (paged
+                # sequences are aligned at virtual 0; lo is the mask
+                # floor, not a pad offset)
+                x = gpt.wte(Tensor(token_ids[None, :],
+                                   stop_gradient=True)) \
+                    + gpt.wpe(Tensor(qpos[None, :]))
+                new_pool = pool
+                for li, block in enumerate(gpt.blocks):
+                    q, k, v = block._qkv(x)
+                    kh = k._data[0].astype(new_pool.dtype)  # [Q, H, Dh]
+                    vh = v._data[0].astype(new_pool.dtype)
+                    # per-row scatter through the page table: row i's
+                    # K/V land at (write_block[i], write_off[i]) — pad
+                    # rows hit the scratch block nobody reads
+                    new_pool = new_pool.at[
+                        li, 0, write_block, :, write_off, :].set(kh)
+                    new_pool = new_pool.at[
+                        li, 1, write_block, :, write_off, :].set(vh)
+                    qh = jnp.transpose(q._data, (0, 2, 1, 3))[0]
+                    a = ragged_paged_attention(
+                        qh, new_pool, li, blk_seq, seq_qstart, seq_pos0,
+                        tables, lo, kv_len)
+                    a = jnp.transpose(a[None], (0, 2, 1, 3))
+                    x = block._tail(x, Tensor(a, stop_gradient=True))
+                x = gpt.ln_f(x)
+                last = x._data[0, last_row]             # [S, E]
+                logits = gpt.logits(
+                    Tensor(last[:, None, :]))._data[:, 0].astype(
+                        jnp.float32)
                 key, sub = jax.random.split(key)
                 greedy = _pick_token(logits, sub, False, top_k, top_p, 1.0)
                 sampled = _pick_token(logits, sub, True, top_k, top_p,

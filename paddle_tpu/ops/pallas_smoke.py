@@ -81,10 +81,38 @@ def _smoke_fused_adamw():
         raise FloatingPointError("fused AdamW smoke output not finite")
 
 
+def _smoke_ragged_paged_attention():
+    """Fused serving kernel: a mixed decode + prefill-chunk ragged
+    batch over a tiny block pool, non-interpreted — the lowering gate
+    for the GenerationEngine(attention='fused') path."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from .ragged_paged_attention import ragged_layout, ragged_paged_attention
+
+    rng = np.random.RandomState(0)
+    H, BS, DH, S, T = 2, 16, 64, 2, 2
+    pool = jnp.asarray(rng.randn(1, 2, 6, H, BS, DH), jnp.float32)
+    tables = np.zeros((S, T), np.int32)
+    tables[0, :2] = [1, 3]
+    tables[1, :1] = [4]
+    blk_seq, qstart, pos0, _, _ = ragged_layout([1, 9], [20, 0],
+                                                q_bucket=24)
+    q = jnp.asarray(rng.randn(H, 24, DH), jnp.float32)
+    out = jax.jit(lambda q_, p_: ragged_paged_attention(
+        q_, p_, 0, blk_seq, qstart, pos0, tables,
+        np.zeros(S, np.int32), np.asarray([21, 9], np.int32)))(q, pool)
+    jax.block_until_ready(out)
+    if not bool(jnp.isfinite(out.sum())):
+        raise FloatingPointError(
+            "ragged paged attention smoke output not finite")
+
+
 _KERNEL_SMOKES: Dict[str, Callable[[], None]] = {
     "flash_attention": _smoke_flash_attention,
     "fused_layer_norm": _smoke_fused_layer_norm,
     "fused_adamw": _smoke_fused_adamw,
+    "ragged_paged_attention": _smoke_ragged_paged_attention,
 }
 
 

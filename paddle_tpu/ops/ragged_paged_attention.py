@@ -1,0 +1,299 @@
+"""Ragged paged attention — the fused Pallas TPU serving kernel.
+
+The gather-based paged decode step (models/generation.py
+``build_paged_decode_fn``) materializes ``pool[li, :, tables]`` per
+layer: every request's WHOLE KV window is copied out of the block pool
+on every decode step, and attention then runs over the padded
+``table_bucket * block_size`` columns for every slot. This kernel is
+the TPU-native replacement per "Ragged Paged Attention" (PAPERS.md):
+the block pool stays in HBM (``memory_space=ANY``), the kernel walks
+each sequence's page table directly — one async DMA per (KV block,
+head) into VMEM scratch — and streams online softmax over exactly the
+blocks a sequence owns. Nothing is gathered, nothing is padded to the
+table bucket, and a single launch serves a RAGGED batch of mixed
+prefill-chunk and decode rows (the chunked-prefill unlock).
+
+Layout contract (the serving engine's fused step builds these):
+
+* queries are FLATTENED over the batch: each sequence's ``q_len[s]``
+  rows sit contiguously, padded up to a multiple of ``block_q`` (8, the
+  fp32 sublane) so one grid step never mixes sequences — decode rows
+  cost one padded q block, prefill chunks amortize theirs;
+* scalar-prefetch metadata maps grid steps back to sequences:
+  ``blk_seq`` names the sequence of each q block (−1 = pad block),
+  ``seq_qstart``/``seq_pos0`` recover every row's virtual cache
+  position, ``tables`` is the page table, ``kv_len`` bounds the KV walk
+  and ``lo`` the valid-window floor (always 0 for paged sequences);
+* a row at position ``p`` attends to cache columns ``[lo, p]`` — the
+  history PLUS the causal prefix of its own chunk, whose K/V the fused
+  step scatters into the pool before the kernel runs.
+
+Mosaic legality (the BENCH_r02 bug class, enforced by the
+``pallas-block-tiling`` self-lint): q/o blocks are ``(1, block_q, Dh)``
+with ``block_q = 8`` sublane-aligned and ``Dh`` the full array dim; the
+KV scratch is ``(block_size, Dh)`` with ``block_size >= 8`` required.
+
+Off-TPU the kernel runs in interpret mode — that is how the tier-1
+parity suite (tests/test_ragged_attention.py) executes the kernel body
+on CPU.
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .pallas_kernels import _interpret, _x64_off
+
+__all__ = ["ragged_paged_attention", "ragged_layout", "BLOCK_Q",
+           "MIN_KV_BLOCK"]
+
+_NEG_INF = -1e30
+
+# q rows per grid step: the fp32 sublane count — the smallest
+# Mosaic-legal second-to-last block dim, so a decode row (1 real query)
+# wastes at most 7 pad rows while a prefill chunk fills whole blocks
+BLOCK_Q = 8
+
+# the KV scratch block is (block_size, Dh): block_size below the
+# sublane count has no legal TPU layout
+MIN_KV_BLOCK = 8
+
+
+def _rpa_kernel(blk_seq_ref, qstart_ref, pos0_ref, tables_ref, lo_ref,
+                kvlen_ref, q_ref, pool_ref, o_ref, k_scr, v_scr, k_sem,
+                v_sem, *, layer, block_q, block_size, scale):
+    """One (head, q-block) grid step: walk the owning sequence's page
+    table, DMA each KV block HBM→VMEM, stream online softmax.
+
+    i32-typed constants: bare python ints in kernel index math get
+    materialized as i64 by Mosaic under the framework's global x64 (the
+    pallas_kernels idiom; the call sites also trace under _x64_off)."""
+    h = pl.program_id(0)
+    b = pl.program_id(1)
+    seq = blk_seq_ref[b]
+
+    @pl.when(seq < 0)
+    def _pad_block():
+        o_ref[...] = jnp.zeros_like(o_ref)
+
+    @pl.when(seq >= 0)
+    def _attend():
+        _BS = jnp.int32(block_size)
+        _BQ = jnp.int32(block_q)
+        q = q_ref[0]                                    # [bq, Dh]
+        bq, dh = q.shape
+        # virtual cache position of each row: rows of a sequence are
+        # consecutive tokens starting at seq_pos0 (pad rows past the
+        # real q_len compute masked garbage nobody reads)
+        row0 = b * _BQ - qstart_ref[seq]
+        qpos = pos0_ref[seq] + row0 + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, 1), 0)                 # [bq, 1]
+        lo = lo_ref[seq]
+        n_kv = (kvlen_ref[seq] + _BS - 1) // _BS
+
+        def body(j, carry):
+            # running softmax stats stay 2D [bq, 1] (sublane-oriented);
+            # rank-1 carries would force lane<->sublane relayouts
+            m_prev, l_prev, acc = carry
+            pid = tables_ref[seq, j]
+            # the page-table walk: this sequence's j-th block, this
+            # head, copied HBM -> VMEM — the ONLY KV bytes this grid
+            # step touches (the gather path would have materialized the
+            # whole padded table bucket for every slot)
+            ck = pltpu.make_async_copy(
+                pool_ref.at[layer, 0, pid, h], k_scr, k_sem)
+            cv = pltpu.make_async_copy(
+                pool_ref.at[layer, 1, pid, h], v_scr, v_sem)
+            ck.start()
+            cv.start()
+            ck.wait()
+            cv.wait()
+            k_blk = k_scr[...]                          # [bs, Dh]
+            v_blk = v_scr[...]
+            # operands in storage dtype, f32 accumulation (MXU contract
+            # shared with the flash kernels)
+            s = jax.lax.dot_general(
+                q, k_blk, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale   # [bq, bs]
+            cols = j * _BS + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_size), 1)
+            # f32-typed fill: a bare python float is weak f64 under the
+            # framework's global x64
+            s = jnp.where((cols >= lo) & (cols <= qpos), s,
+                          jnp.float32(_NEG_INF))
+            m_cur = jnp.max(s, axis=-1, keepdims=True)
+            m_new = jnp.maximum(m_prev, m_cur)
+            p = jnp.exp(s - m_new)
+            alpha = jnp.exp(m_prev - m_new)
+            l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+            acc_new = acc * alpha + jax.lax.dot_general(
+                p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            return m_new, l_new, acc_new
+
+        m0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
+        l0 = jnp.zeros((block_q, 1), jnp.float32)
+        acc0 = jnp.zeros((block_q, dh), jnp.float32)
+        # i32 bounds: a bare python 0 becomes an i64 induction variable
+        # under the framework's global x64, and the interpret-mode body
+        # trace happens outside the call site's _x64_off scope
+        _, l, acc = jax.lax.fori_loop(jnp.int32(0), n_kv, body,
+                                      (m0, l0, acc0))
+        o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def ragged_paged_attention(q, pool, layer, blk_seq, seq_qstart, seq_pos0,
+                           tables, lo, kv_len, *, scale=None,
+                           block_q: int = BLOCK_Q):
+    """Fused paged attention over one layer of the serving block pool.
+
+    * ``q`` — ``[H, Qp, Dh]`` flattened padded query rows (``Qp`` a
+      multiple of ``block_q``; per-sequence contiguous, see module doc);
+    * ``pool`` — the FULL block pool ``[L, 2, NB + 1, H, bs, Dh]``; it
+      stays in HBM (``memory_space=ANY``) and ``layer`` is a static int,
+      so no per-layer slice is ever materialized;
+    * ``blk_seq [Qp / block_q]``, ``seq_qstart [S]``, ``seq_pos0 [S]``,
+      ``tables [S, T]``, ``lo [S]``, ``kv_len [S]`` — int32
+      scalar-prefetch metadata (``ragged_layout`` builds the first
+      three);
+    * returns ``[H, Qp, Dh]`` in ``q``'s dtype.
+    """
+    h, qp, dh = q.shape
+    L, two, nb1, hp, bs, dhp = pool.shape
+    if (hp, dhp) != (h, dh):
+        raise ValueError(
+            f"pool heads/head_dim {(hp, dhp)} != q {(h, dh)}")
+    if qp % block_q:
+        raise ValueError(
+            f"padded q rows {qp} must be a multiple of block_q {block_q}")
+    if bs < MIN_KV_BLOCK:
+        raise ValueError(
+            f"block_size {bs} < {MIN_KV_BLOCK}: the KV scratch block has "
+            f"no legal (8, 128) TPU tiling below the sublane count")
+    if not 0 <= int(layer) < L:
+        raise ValueError(f"layer {layer} out of range [0, {L})")
+    scale = float(scale) if scale is not None else 1.0 / math.sqrt(dh)
+    n_qblk = qp // block_q
+    kernel = functools.partial(
+        _rpa_kernel, layer=int(layer), block_q=int(block_q),
+        block_size=int(bs), scale=scale)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=6,
+        grid=(h, n_qblk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, dh), lambda hh, b, *_: (hh, b, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),   # pool stays in HBM
+        ],
+        out_specs=pl.BlockSpec((1, block_q, dh),
+                               lambda hh, b, *_: (hh, b, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((bs, dh), pool.dtype),
+            pltpu.VMEM((bs, dh), pool.dtype),
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+        ],
+    )
+    with _x64_off():
+        return pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((h, qp, dh), q.dtype),
+            interpret=_interpret(),
+        )(jnp.asarray(blk_seq, jnp.int32),
+          jnp.asarray(seq_qstart, jnp.int32),
+          jnp.asarray(seq_pos0, jnp.int32),
+          jnp.asarray(tables, jnp.int32),
+          jnp.asarray(lo, jnp.int32),
+          jnp.asarray(kv_len, jnp.int32),
+          q, pool)
+
+
+def ragged_layout(q_lens: Sequence[int], pos0s: Sequence[int], *,
+                  block_q: int = BLOCK_Q,
+                  q_bucket: int = 0) -> Tuple[np.ndarray, np.ndarray,
+                                              np.ndarray, np.ndarray, int]:
+    """Host-side row layout of a ragged batch (numpy, scheduler thread).
+
+    ``q_lens[s]`` query rows for sequence ``s`` (0 = absent this
+    launch), first token at virtual position ``pos0s[s]``. Each present
+    sequence's rows are laid out contiguously and padded to a multiple
+    of ``block_q`` so no q block straddles sequences.
+
+    Returns ``(blk_seq, seq_qstart, seq_pos0, last_row, total_rows)``:
+    ``blk_seq [q_bucket / block_q]`` int32 (−1 pads), ``seq_qstart`` /
+    ``seq_pos0`` ``[S]`` int32, ``last_row [S]`` int32 (flattened row of
+    each present sequence's LAST real token; 0 for absent sequences —
+    its logits row is garbage the caller ignores), and the unpadded
+    ``total_rows``. ``q_bucket`` (a multiple of ``block_q``) fixes the
+    padded width; 0 sizes it to the content.
+    """
+    S = len(q_lens)
+    if len(pos0s) != S:
+        raise ValueError(f"q_lens/pos0s length mismatch: {S} vs "
+                         f"{len(pos0s)}")
+    rows_padded = sum(-(-int(n) // block_q) * block_q
+                      for n in q_lens if n > 0)
+    if q_bucket:
+        if q_bucket % block_q:
+            raise ValueError(
+                f"q_bucket {q_bucket} must be a multiple of block_q "
+                f"{block_q}")
+        if q_bucket < rows_padded:
+            raise ValueError(
+                f"q_bucket {q_bucket} cannot hold {rows_padded} padded "
+                f"rows")
+    else:
+        q_bucket = max(rows_padded, block_q)
+    blk_seq = np.full(q_bucket // block_q, -1, np.int32)
+    seq_qstart = np.zeros(S, np.int32)
+    seq_pos0 = np.zeros(S, np.int32)
+    last_row = np.zeros(S, np.int32)
+    cursor = 0
+    total = 0
+    for s, n in enumerate(q_lens):
+        n = int(n)
+        if n <= 0:
+            continue
+        nblk = -(-n // block_q)
+        seq_qstart[s] = cursor
+        seq_pos0[s] = int(pos0s[s])
+        last_row[s] = cursor + n - 1
+        blk_seq[cursor // block_q: cursor // block_q + nblk] = s
+        cursor += nblk * block_q
+        total += n
+    return blk_seq, seq_qstart, seq_pos0, last_row, total
+
+
+def reference_ragged_attention(q_rows, pool, layer, row_seq, row_pos,
+                               tables, lo, scale=None):
+    """Numpy oracle for the kernel (tests): per-row full-precision
+    softmax attention over the row's ``[lo, pos]`` window gathered
+    through the page table. ``q_rows [N, H, Dh]``, ``row_seq/row_pos
+    [N]``."""
+    pool = np.asarray(pool, np.float32)
+    q_rows = np.asarray(q_rows, np.float32)
+    n, h, dh = q_rows.shape
+    bs = pool.shape[4]
+    scale = float(scale) if scale is not None else 1.0 / math.sqrt(dh)
+    out = np.zeros_like(q_rows)
+    for i in range(n):
+        s = int(row_seq[i])
+        p = int(row_pos[i])
+        cols = np.arange(int(lo[s]), p + 1)
+        k = np.stack([pool[layer, 0, tables[s][c // bs], :, c % bs, :]
+                      for c in cols])                    # [ctx, H, Dh]
+        v = np.stack([pool[layer, 1, tables[s][c // bs], :, c % bs, :]
+                      for c in cols])
+        for hh in range(h):
+            logits = (k[:, hh] @ q_rows[i, hh]) * scale
+            w = np.exp(logits - logits.max())
+            w /= w.sum()
+            out[i, hh] = w @ v[:, hh]
+    return out

@@ -60,6 +60,13 @@ _tls = threading.local()
 # reset() — lane identity is stable across sessions — and is bounded so
 # a thread-churning server cannot grow it without limit.
 _thread_names: Dict[int, str] = {}
+# tid -> the Thread object that registered it (weakref; absent for
+# synthetic lanes registered with an explicit tid). The OS REUSES thread
+# idents: without owner tracking, a label registered by a long-dead
+# thread would stick to its recycled ident forever and first-writer-wins
+# would silently mislabel every later thread that inherits the ident
+# (the order-dependent serving-trace flake).
+_thread_owners: Dict[int, Any] = {}
 _MAX_THREAD_NAMES = 4096
 
 
@@ -144,13 +151,28 @@ def set_thread_name(name: str, tid: Optional[int] = None) -> None:
     ``thread_name`` metadata events so the viewer shows "serving
     scheduler" instead of a bare thread ident. Cheap enough to call
     unconditionally; first-writer-wins per tid keeps a thread that
-    plays several roles from flapping."""
+    plays several roles from flapping — but a label whose registering
+    thread has DIED is stale (the OS recycles idents), so the current
+    thread reclaims its own ident instead of inheriting a dead
+    thread's role."""
+    import weakref
+    cur = None
     if tid is None:
         tid = threading.get_ident()
+        cur = threading.current_thread()
     with _lock:
-        if tid not in _thread_names and \
-                len(_thread_names) < _MAX_THREAD_NAMES:
-            _thread_names[tid] = str(name)
+        if tid in _thread_names:
+            owner = _thread_owners.get(tid)
+            # single deref: GC may collect the Thread between checks
+            owner_thread = owner() if owner is not None else None
+            alive = owner_thread is not None and owner_thread.is_alive()
+            if cur is None or alive:
+                return          # same-thread role flap / synthetic lane
+        elif len(_thread_names) >= _MAX_THREAD_NAMES:
+            return
+        _thread_names[tid] = str(name)
+        if cur is not None:
+            _thread_owners[tid] = weakref.ref(cur)
 
 
 def thread_names() -> Dict[int, str]:

@@ -101,7 +101,17 @@ class GenerationEngine:
       (``num_blocks`` defaults to the dense-equivalent device budget;
       shrink it to realise the capacity win — admission then gates on
       blocks, pressure preempts, and full prompt blocks are shared
-      through the prefix cache).
+      through the prefix cache);
+    * ``attention`` — ``"gather"`` (default) keeps the gather-based
+      paged decode step (the correctness oracle); ``"fused"`` (paged
+      only, ``block_size >= 8``) serves every cycle with ONE fused
+      ragged-paged-attention Pallas launch
+      (``ops/ragged_paged_attention.py``): no materialized KV gather,
+      and CHUNKED PREFILL — prompts feed in ``prefill_budget``-token
+      chunks mixed into decode launches, so a prompt burst can no
+      longer monopolize a cycle, and the first generated token comes
+      out of the same launch that fed the final chunk. One trace per
+      (pow2 q-row bucket, pow2 table bucket).
 
     Greedy engine output is token-identical to ``models.generate`` run
     per request (the parity contract, tests/test_serving_engine.py and
@@ -114,7 +124,8 @@ class GenerationEngine:
                  max_queue: int = 128, prefill_budget: Optional[int] = None,
                  min_bucket: int = 8, seed: int = 0, dtype=None,
                  kv_layout: str = "dense", block_size: int = 16,
-                 num_blocks: Optional[int] = None):
+                 num_blocks: Optional[int] = None,
+                 attention: str = "gather"):
         import jax
 
         from ..models.generation import build_slot_decode_fn
@@ -123,6 +134,23 @@ class GenerationEngine:
         if kv_layout not in ("dense", "paged"):
             raise ValueError(
                 f"kv_layout must be 'dense' or 'paged', got {kv_layout!r}")
+        if attention not in ("gather", "fused"):
+            raise ValueError(
+                f"attention must be 'gather' or 'fused', got {attention!r}")
+        if attention == "fused":
+            from ..ops.ragged_paged_attention import MIN_KV_BLOCK
+            if kv_layout != "paged":
+                raise ValueError(
+                    "attention='fused' is the fused RAGGED PAGED "
+                    "attention path — it requires kv_layout='paged' "
+                    "(the dense slot pool has no page tables to walk)")
+            if int(block_size) < MIN_KV_BLOCK:
+                raise ValueError(
+                    f"attention='fused' requires block_size >= "
+                    f"{MIN_KV_BLOCK}: the kernel's (block_size, head_dim)"
+                    f" KV scratch has no legal (8, 128) TPU tiling below "
+                    f"the sublane count")
+        self._fused = attention == "fused"
         gpt = model.gpt if hasattr(model, "gpt") else model
         cfg = gpt.cfg
         max_len = int(max_len or cfg.max_position_embeddings)
@@ -160,6 +188,7 @@ class GenerationEngine:
                 num_blocks=num_blocks, dtype=dtype, min_bucket=mb)
             self._decode_jit = None       # per-table-bucket instead
             self._decode_jits = {}        # table bucket -> jitted step
+            self._fused_jits = {}         # (q bucket, table bucket) -> step
             self._copy_jit = None         # lazy COW device block copy
         else:
             self._pool = KVCachePool(
@@ -188,7 +217,8 @@ class GenerationEngine:
         self._sched = Scheduler(
             self._pool, self._run_prefill, self._run_decode,
             max_queue=max_queue, prefill_budget=prefill_budget,
-            do_copy=self._run_copy if self._paged else None)
+            do_copy=self._run_copy if self._paged else None,
+            do_chunked_step=self._run_fused_step if self._fused else None)
 
     # -- client side -------------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens: int = 32, *,
@@ -250,9 +280,13 @@ class GenerationEngine:
             # must exist — without this gate a bucket ladder that
             # overshoots max_len (non-pow2 max_len / large min_bucket)
             # admits a request whose prefill can never trace, and the
-            # scheduler-thread crash poisons every in-flight request
+            # scheduler-thread crash poisons every in-flight request.
+            # FUSED engines have no prefill buckets at all — any feed
+            # up to max_len chunks through the ragged step, so the
+            # ladder constraint simply does not exist there.
             worst = ids.size + int(max_new_tokens) - 1
-            if self._pool.bucket_for(worst) > self._pool.max_len:
+            if not self._fused \
+                    and self._pool.bucket_for(worst) > self._pool.max_len:
                 raise PoolCapacityError(
                     f"no prefill bucket fits this request: prompt "
                     f"{ids.size} (+ up to {int(max_new_tokens) - 1} "
@@ -325,6 +359,7 @@ class GenerationEngine:
         pool = self._pool
         s = {
             "kv_layout": "paged" if self._paged else "dense",
+            "attention": "fused" if self._fused else "gather",
             "queue_depth": self._sched.queue_depth,
             "active_requests": self._sched.active,
             "num_slots": pool.num_slots,
@@ -361,6 +396,16 @@ class GenerationEngine:
                 "prefill_tokens_saved": pool.tokens_saved,
                 "prefix_evictions": pool.evictions,
             })
+        if self._fused:
+            # chunked-prefill observability: lifetime chunk counters
+            # plus ring-window chunk token throughput, so the "long
+            # prompts no longer monopolize a cycle" win is measurable
+            s["prefill_chunks"] = self._sched.prefill_chunks
+            s["chunked_prefill_tokens"] = self._sched.chunk_tokens
+            thr = self._sched.recorder.cycle_throughput()
+            if thr["cycle_secs"] > 0 and thr["chunk_tokens"] > 0:
+                s["chunked_prefill_tokens_per_sec"] = \
+                    thr["chunk_tokens"] / thr["cycle_secs"]
         return s
 
     def _compute_stats(self) -> dict:
@@ -383,7 +428,10 @@ class GenerationEngine:
         S = self._pool.num_slots
         out["model_flops_per_token"] = mean_step_flops / S
         rec = None
-        if self._paged:
+        if self._fused:
+            if self._fused_jits:
+                rec = self._fused_jits[max(self._fused_jits)].record
+        elif self._paged:
             if self._decode_jits:
                 rec = self._decode_jits[max(self._decode_jits)].record
         elif self._decode_jit is not None:
@@ -437,6 +485,26 @@ class GenerationEngine:
         from .. import analysis
 
         S = self._pool.num_slots
+        if self._fused:
+            # largest built fused bucket (the step that actually
+            # served), falling back to the smallest on a fresh engine.
+            # Zeroed metadata is a legal no-op launch: blk_seq 0 maps
+            # every q block to slot 0 with kv_len 0, so the KV walk
+            # runs zero iterations.
+            from ..ops.ragged_paged_attention import BLOCK_Q
+            Q, T = max(self._fused_jits) if self._fused_jits \
+                else (BLOCK_Q, 1)
+            return analysis.analyze(
+                self._fused_step_fn(Q, T), self._params, self._buffers,
+                self._pool.data, np.zeros(Q, np.int32),
+                np.zeros(Q, np.int32), np.zeros(Q, np.int32),
+                np.zeros(Q, np.int32), np.zeros(Q // BLOCK_Q, np.int32),
+                np.zeros(S, np.int32), np.zeros(S, np.int32),
+                np.zeros((S, T), np.int32), np.zeros(S, np.int32),
+                np.zeros(S, np.int32), np.zeros(S, np.int32),
+                np.zeros(S, bool), np.ones(S, np.float32), self._key,
+                passes=passes,
+                name=f"serving.fused_step[{S} slots, q{Q}, t{T}]")
         if self._paged:
             T = max(self._decode_jits) if self._decode_jits else 1
             return analysis.analyze(
@@ -491,6 +559,8 @@ class GenerationEngine:
 
     def _run_prefill(self, req: GenerationRequest, slot: int,
                      bucket: int) -> Optional[int]:
+        if self._fused:
+            return self._run_fused_admit(req, slot)
         if self._paged:
             return self._run_paged_prefill(req, slot, bucket)
         ids = np.full((1, bucket), self._pad, np.int32)
@@ -550,6 +620,119 @@ class GenerationEngine:
         pool.register_prefix(slot, feed)
         req.replay = []
         return int(_fetch(first)[0])
+
+    def _run_fused_admit(self, req: GenerationRequest,
+                         slot: int) -> None:
+        """Admit one request into the FUSED engine: pure host
+        bookkeeping, no prefill program. Blocks covering the whole feed
+        are reserved, a prefix-cache match adopts its blocks (ANY tail
+        length — chunks drain a long tail in budgeted launches, so the
+        gather path's one-``min_bucket`` decline heuristic is obsolete
+        here), and the remaining tokens arm ``req.pending_feed`` for
+        the per-cycle chunk plan."""
+        pool = self._pool
+        feed = np.concatenate(
+            [req.prompt, np.asarray(req.tokens, np.int32)])
+        cached = pool.match_prefix(feed)
+        if cached:
+            pool.admit_cached(slot, cached)
+            m = len(cached) * pool.block_size
+            pool.set_slot(slot, pos=m, lo=0)
+            req.pending_feed = [int(t) for t in feed[m:]]
+            req.trace.mark("prefix_hit", tokens_saved=m,
+                           pending=len(req.pending_feed))
+        else:
+            pool.admit_fresh(slot, feed.size)
+            # position 0 is where the first pending token's K/V land
+            pool.set_slot(slot, pos=0, lo=0)
+            req.pending_feed = [int(t) for t in feed]
+        req.replay = []
+        return None
+
+    def _run_fused_step(self, slot_requests, plan):
+        """Dispatch ONE fused ragged launch (the chunked-mode
+        do_chunked_step): budgeted prompt chunks + decode rows,
+        flattened into the padded row layout of
+        ``ops.ragged_paged_attention`` and served by the
+        ``build_fused_step_fn`` program for this (q bucket, table
+        bucket). Returns the next-token DEVICE array un-fetched."""
+        from ..ops.ragged_paged_attention import BLOCK_Q, ragged_layout
+
+        pool = self._pool
+        S = pool.num_slots
+        bs = pool.block_size
+        q_lens = [0] * S
+        pos0s = [0] * S
+        row_tokens = {}
+        kv_len = np.zeros(S, np.int32)
+        sample_mask = np.zeros(S, bool)
+        temps = np.ones(S, np.float32)
+        for slot, req in slot_requests.items():
+            n = int(plan.get(slot, 0))
+            if n < 1:
+                continue
+            p = pool.slot_pos(slot)
+            q_lens[slot] = n
+            pos0s[slot] = p
+            row_tokens[slot] = (req.pending_feed[:n] if req.pending_feed
+                                else [req.last_token])
+            kv_len[slot] = p + n
+            sample_mask[slot] = req.do_sample
+            temps[slot] = req.temperature
+        padded = sum(-(-n // BLOCK_Q) * BLOCK_Q for n in q_lens if n)
+        Q = self._q_bucket(padded)
+        blk_seq, qstart, pos0, last_row, _ = ragged_layout(
+            q_lens, pos0s, q_bucket=Q)
+        token_ids = np.zeros(Q, np.int32)
+        qpos = np.zeros(Q, np.int32)
+        write_block = np.zeros(Q, np.int32)   # pad rows -> scratch block
+        write_off = np.zeros(Q, np.int32)
+        for slot, toks in row_tokens.items():
+            r0, p0 = int(qstart[slot]), int(pos0[slot])
+            table = pool.slot_table(slot)
+            for i, t in enumerate(toks):
+                token_ids[r0 + i] = t
+                qpos[r0 + i] = p0 + i
+                write_block[r0 + i] = table[(p0 + i) // bs]
+                write_off[r0 + i] = (p0 + i) % bs
+        T = max(pool.table_bucket(s) for s in row_tokens)
+        tables = pool.table_array(T, row_tokens)
+        lo = np.zeros(S, np.int32)            # paged virtual floor
+        step = self._fused_step_fn(Q, T)
+        pool.data, nxt, self._key = step(
+            self._params, self._buffers, pool.data, token_ids, qpos,
+            write_block, write_off, blk_seq, qstart, pos0, tables, lo,
+            kv_len, last_row, sample_mask, temps, self._key)
+        self._note_decode_dispatch(step)
+        return nxt
+
+    def _q_bucket(self, rows: int) -> int:
+        """pow2 bucket over the launch's padded q rows — one fused
+        trace per (q bucket, table bucket), the ragged twin of the
+        prefill-bucket discipline."""
+        from ..ops.ragged_paged_attention import BLOCK_Q
+        b = BLOCK_Q
+        while b < rows:
+            b *= 2
+        return b
+
+    def _fused_step_fn(self, q_rows: int, table_len: int):
+        key = (q_rows, table_len)
+        fn = self._fused_jits.get(key)
+        if fn is None:
+            from ..models.generation import build_fused_step_fn
+            probe = _probe.site(
+                f"serving/fused[q{q_rows},t{table_len}]#{self._eid}")
+            fn = _registry.aot_site(
+                f"serving/fused[q{q_rows},t{table_len}]#{self._eid}",
+                build_fused_step_fn(self._model, self._pool.num_slots,
+                                    q_rows, table_len,
+                                    self._pool.block_size,
+                                    top_k=self._top_k, top_p=self._top_p,
+                                    probe=probe),
+                donate_argnums=(2,))
+            self._fused_jits[key] = fn
+        return fn
 
     def _run_decode(self, slot_requests):
         """Dispatch ONE decode step; returns the next-token DEVICE

@@ -124,9 +124,15 @@ class FlightRecorder:
                 if c.get("decode_dispatch_ms", 0.0) > 0.0)
             decode_flops = sum(c.get("decode_flops", 0.0)
                                for c in self._cycles)
+            chunk_tokens = sum(c.get("chunk_tokens", 0)
+                               for c in self._cycles)
+            prefill_chunks = sum(c.get("prefill_chunks", 0)
+                                 for c in self._cycles)
         return {"cycles": cycles, "emitted": emitted, "cycle_secs": secs,
                 "decode_cycles": decode_cycles,
-                "decode_flops": decode_flops}
+                "decode_flops": decode_flops,
+                "chunk_tokens": chunk_tokens,
+                "prefill_chunks": prefill_chunks}
 
     def snapshot(self) -> Dict[str, Any]:
         """JSON-serializable copy of both rings + the counters."""

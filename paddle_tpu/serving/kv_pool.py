@@ -190,11 +190,14 @@ class SlotPoolBase:
         st.pos = int(pos)
         st.lo = int(lo)
 
-    def advance(self, slot: int) -> int:
-        """One decode step happened: the slot's last token now sits one
-        cache index later. Returns the new ``pos``."""
+    def advance(self, slot: int, n: int = 1) -> int:
+        """``n`` tokens landed (one decode step, or one prefill chunk
+        of the fused ragged step): the slot's write position moves
+        ``n`` cache indices later. Returns the new ``pos``."""
+        if n < 1:
+            raise ValueError(f"advance needs n >= 1, got {n}")
         st = self._slots[slot]
-        st.pos += 1
+        st.pos += int(n)
         if st.pos >= self.max_len:
             raise RuntimeError(
                 f"slot {slot} overran the {self._capacity_noun} "

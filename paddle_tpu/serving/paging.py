@@ -411,11 +411,44 @@ class PagedKVPool(SlotPoolBase):
         :class:`PoolExhaustedError` — the scheduler's preemption
         trigger."""
         st = self._require(slot)
-        vb = st.pos // self.block_size
+        return self._ensure_block(slot, st, st.pos // self.block_size)
+
+    def ensure_writable_range(self, slot: int,
+                              last_pos: int) -> List[Tuple[int, int]]:
+        """Chunked-prefill variant: guarantee EVERY block covering
+        virtual indices ``[pos, last_pos]`` exists and is exclusively
+        owned (a chunk scatters a run of positions in one fused
+        launch). Returns the copy-on-write ``(dst, src)`` orders, in
+        virtual-block order. May raise :class:`PoolExhaustedError`
+        mid-growth — already-granted blocks stay on the table (they are
+        freed with the slot if the scheduler preempts it), and any COW
+        orders collected BEFORE the failure ride on the exception as
+        ``partial_cows``: the table swap already happened, so the
+        caller must still perform those device copies — a retry after
+        preemption sees the swapped (refcount-1) block and would never
+        re-order the copy."""
+        st = self._require(slot)
+        if last_pos < st.pos:
+            raise ValueError(
+                f"slot {slot}: range end {last_pos} precedes pos {st.pos}")
+        cows: List[Tuple[int, int]] = []
+        for vb in range(st.pos // self.block_size,
+                        last_pos // self.block_size + 1):
+            try:
+                cow = self._ensure_block(slot, st, vb)
+            except PoolExhaustedError as e:
+                e.partial_cows = list(cows)
+                raise
+            if cow is not None:
+                cows.append(cow)
+        return cows
+
+    def _ensure_block(self, slot: int, st: _PagedSlot,
+                      vb: int) -> Optional[Tuple[int, int]]:
         if vb > len(st.table):
             raise RuntimeError(
                 f"slot {slot}: page table has {len(st.table)} blocks but "
-                f"pos={st.pos} needs block {vb} — positions outran "
+                f"virtual block {vb} is needed — positions outran "
                 f"allocation")
         if vb == len(st.table):
             st.table.append(self._alloc_block())

@@ -16,6 +16,15 @@ membership of the in-flight batch changes EVERY step:
   deadline-expired slots are freed IMMEDIATELY, so their capacity is
   reused by the very next admit — mid-flight, not at batch end.
 
+CHUNKED mode (the fused ragged engine, ``do_chunked_step``): admission
+becomes pure host bookkeeping (blocks reserved, ``req.pending_feed``
+armed) and each cycle runs ONE fused ragged launch mixing
+``prefill_budget`` tokens of prompt chunks with every decode row —
+decode is never budget-charged, so a prompt burst cannot monopolize a
+cycle, and the first generated token emits from the launch that feeds
+the final chunk (``serving/prefill_chunks``/``serving/chunk_tokens``,
+per-cycle ``chunk_tokens`` in the flight recorder).
+
 Backpressure is explicit: a full queue raises :class:`QueueFullError`
 in ``submit`` (the caller sheds load, nothing queues unboundedly), and
 a per-request deadline turns into :class:`DeadlineExceeded` whether the
@@ -122,6 +131,12 @@ class GenerationRequest:
         # skip prefill; preempted requests replay their own history on
         # re-admission). Rebuilt at every admission.
         self.replay: List[int] = []
+        # fused (chunked-prefill) engines only: the not-yet-fed feed
+        # tokens — drained in token-budget chunks through the fused
+        # ragged step, mixed into decode launches. Rebuilt at every
+        # admission; the first generated token emits from the launch
+        # that feeds the final chunk.
+        self.pending_feed: List[int] = []
         self.first_token_at: Optional[float] = None
         self._last_token_at: Optional[float] = None
         # lifecycle trace (host stamps; the scheduler marks events, the
@@ -250,12 +265,25 @@ class Scheduler:
     def __init__(self, pool, do_prefill: Callable, do_decode: Callable, *,
                  max_queue: int = 128, prefill_budget: Optional[int] = None,
                  do_copy: Optional[Callable] = None,
+                 do_chunked_step: Optional[Callable] = None,
                  recorder: Optional[FlightRecorder] = None):
         if max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {max_queue}")
         self._pool = pool
         self._do_prefill = do_prefill
         self._do_decode = do_decode
+        # chunked-prefill mode (the fused ragged engine): prefill is no
+        # longer a per-bucket program at admission — admission only
+        # allocates blocks and arms ``req.pending_feed``, and
+        # ``do_chunked_step(slot_requests, plan) -> token array`` runs
+        # ONE ragged launch per cycle mixing budgeted prompt chunks
+        # with the decode rows. The prefill budget becomes the per-
+        # cycle CHUNK token budget: decode rows are never charged, so a
+        # prompt burst can no longer monopolize a cycle.
+        self._do_chunked = do_chunked_step
+        self._chunked = do_chunked_step is not None
+        self.prefill_chunks = 0          # chunk launches fed (slot-cycles)
+        self.chunk_tokens = 0            # prompt tokens fed via chunks
         # always-on postmortem telemetry: bounded cycle/event rings +
         # the per-engine TTFT/TPOT reservoirs stats() reads
         self.recorder = recorder if recorder is not None \
@@ -489,10 +517,13 @@ class Scheduler:
                     # keeps its FCFS place; submit-time capacity checks
                     # guarantee it fits an idle pool, so no deadlock)
                     return
-                if decode_waiting and budget < bucket:
+                if not self._chunked and decode_waiting and budget < bucket:
                     # budget spent: decode the active slots first; the
                     # queue keeps its place (FCFS) and is retried next
                     # cycle. This is the anti-starvation preemption.
+                    # (Chunked mode has no per-admission prefill program
+                    # to budget — admission is host bookkeeping, and the
+                    # budget throttles the per-cycle chunk feed instead.)
                     stat_add("serving/preempt")
                     return
                 slot = self._pool.alloc()
@@ -604,6 +635,7 @@ class Scheduler:
         req = self._slots.pop(slot)
         self._pool.free(slot)
         req.replay = []                  # rebuilt at re-admission
+        req.pending_feed = []            # ditto (fused chunked feed)
         self.preempts += 1
         self._event(req, "preempt", emitted=req.emitted)
         if self._rec is not None:
@@ -638,7 +670,60 @@ class Scheduler:
                 break
         return bool(self._slots)
 
+    # -- chunked prefill (the fused ragged engine) -------------------------
+    def _chunk_plan(self) -> Dict[int, int]:
+        """Per-cycle row plan: how many query rows each active slot
+        contributes to the fused ragged launch. Decode slots (feed
+        drained) always get their 1 row — decode is NEVER budget-
+        charged, which is the anti-starvation guarantee. Feeding slots
+        split the prefill TOKEN budget FCFS by request age; a slot
+        whose share hits 0 simply waits a cycle (its blocks are already
+        reserved)."""
+        budget = self._prefill_budget
+        plan: Dict[int, int] = {}
+        for slot in sorted(self._slots,
+                           key=lambda s: self._slots[s].id):
+            req = self._slots[slot]
+            if req.pending_feed:
+                n = min(len(req.pending_feed), budget)
+                budget -= n
+                if n > 0:
+                    plan[slot] = n
+            else:
+                plan[slot] = 1
+        return plan
+
+    def _prepare_chunked(self, plan: Dict[int, int]) -> Dict[int, int]:
+        """Chunked-mode twin of :meth:`_prepare_paged`: every planned
+        slot must own writable blocks for its WHOLE row range this
+        cycle (a chunk scatters ``[pos, pos + n)``). Exhaustion preempts
+        the youngest request; evicted slots drop out of the plan."""
+        for slot in sorted(plan, key=lambda s: self._slots[s].id
+                           if s in self._slots else -1):
+            while slot in self._slots and slot in plan:
+                try:
+                    cows = self._pool.ensure_writable_range(
+                        slot, self._pool.slot_pos(slot) + plan[slot] - 1)
+                except PoolExhaustedError as e:
+                    # COW table swaps before the failure are already in
+                    # place — their device copies must happen NOW (the
+                    # retry sees a refcount-1 block and would never
+                    # re-order them)
+                    if self._do_copy is not None:
+                        for cow in getattr(e, "partial_cows", ()):
+                            self._do_copy(*cow)
+                    self._preempt_youngest()
+                    continue
+                if self._do_copy is not None:
+                    for cow in cows:
+                        self._do_copy(*cow)
+                break
+        return {s: n for s, n in plan.items() if s in self._slots}
+
     def _decode_cycle(self) -> None:
+        if self._chunked:
+            self._chunked_cycle()
+            return
         if self._paged and not self._prepare_paged():
             return
         active = dict(self._slots)
@@ -698,4 +783,92 @@ class Scheduler:
         if rec is not None:
             rec["emitted"] += emitted
         if dt > 0:
+            stat_observe("serving/tokens_per_sec", emitted / dt)
+
+    def _chunked_cycle(self) -> None:
+        """One fused ragged launch: budgeted prompt chunks mixed with
+        every decode row. The launch's next-token array is real for
+        decode slots AND for slots whose final feed chunk landed this
+        cycle (their first generated token comes out of the same
+        launch); mid-feed slots' rows are ignored."""
+        plan = self._prepare_chunked(self._chunk_plan())
+        if not plan:
+            return
+        active = {s: self._slots[s] for s in plan}
+        occupancy = len(self._slots) / self._pool.num_slots
+        stat_observe("serving/active_slots", len(self._slots))
+        stat_observe("serving/batch_occupancy", occupancy)
+        rec = self._rec
+        if rec is not None:
+            rec["active"] = len(self._slots)
+            rec["occupancy"] = occupancy
+        t0 = time.perf_counter()
+        with _prof.record("serving/decode_dispatch", "serving",
+                          args={"active": len(active),
+                                "chunk_rows": sum(
+                                    n for s, n in plan.items()
+                                    if active[s].pending_feed)}):
+            toks_dev = self._do_chunked(active, plan)
+        t1 = time.perf_counter()
+        with _prof.record("serving/host_fetch", "serving"):
+            toks = _fetch(toks_dev)
+        t2 = time.perf_counter()
+        if rec is not None:
+            rec["decode_dispatch_ms"] += (t1 - t0) * 1e3
+            rec["fetch_ms"] += (t2 - t1) * 1e3
+        dt = t2 - t0
+        emitted = 0
+        chunks = 0
+        chunk_tokens = 0
+        now = time.perf_counter()
+        for slot, req in active.items():
+            n = plan[slot]
+            feeding = bool(req.pending_feed)
+            self._pool.advance(slot, n)
+            if feeding:
+                # the feed tokens' K/V are in the pool now: account the
+                # chunk BEFORE the terminal checks so a cancel mid-feed
+                # still leaves honest chunk telemetry behind
+                del req.pending_feed[:n]
+                chunks += 1
+                chunk_tokens += n
+                self.prefill_chunks += 1
+                self.chunk_tokens += n
+                stat_add("serving/prefill_chunks")
+                stat_add("serving/chunk_tokens", n)
+                req.trace.mark("prefill_chunk", tokens=n,
+                               remaining=len(req.pending_feed))
+            if req.cancelled:
+                stat_add("serving/cancelled")
+                self._retire(slot, RequestCancelled(
+                    f"request {req.id} cancelled mid-generation"))
+                continue
+            if req.expired(now):
+                stat_add("serving/deadline_exceeded")
+                self._retire(slot, DeadlineExceeded(
+                    f"request {req.id} exceeded its deadline after "
+                    f"{req.emitted} token(s)"))
+                continue
+            if feeding:
+                if req.pending_feed:
+                    continue             # mid-feed: row output ignored
+                # final chunk landed: publish the fully-written feed
+                # blocks to the prefix cache, then emit the first
+                # generated token — produced by this same launch
+                self._pool.register_prefix(slot, np.concatenate(
+                    [req.prompt, np.asarray(req.tokens, np.int32)]))
+                req.trace.mark("chunked_prefill_done",
+                               emitted=req.emitted)
+            tok = int(toks[slot])
+            req._emit(tok)
+            emitted += 1
+            if self._finished(req, tok):
+                self._retire(slot)
+        stat_add("serving/tokens", emitted)
+        if rec is not None:
+            rec["emitted"] += emitted
+            rec["prefill_chunks"] = rec.get("prefill_chunks", 0) + chunks
+            rec["chunk_tokens"] = rec.get("chunk_tokens", 0) \
+                + chunk_tokens
+        if dt > 0 and emitted:
             stat_observe("serving/tokens_per_sec", emitted / dt)

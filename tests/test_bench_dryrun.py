@@ -77,6 +77,21 @@ def test_dry_run_emits_metrics_summary():
     assert out["paged_tokens_saved"] > 0, out
     assert "serving/kv_blocks_in_use" in res.stderr
     assert "serving/prefix_hit" in res.stderr
+    # ISSUE-8 fused ragged-paged-attention surface: the fused Pallas
+    # step was selected (no silent fallback), token-parity with the
+    # gather oracle held, a 40-token prompt chunked under the 8-token
+    # prefill budget, the fused step analyzed clean (donation-safe,
+    # host-sync-free — the Pallas call included) and every (q, table)
+    # bucket traced exactly once
+    assert out["checks"]["fused_selected"] is True, out
+    assert out["checks"]["fused_parity"] is True, out
+    assert out["checks"]["fused_chunked_prefill"] is True, out
+    assert out["checks"]["fused_step_clean"] is True, out
+    assert out["checks"]["fused_one_trace_per_bucket"] is True, out
+    assert out["fused_prefill_chunks"] >= 5, out
+    assert out["fused_chunk_tokens"] >= 40, out
+    assert "serving/prefill_chunks" in res.stderr
+    assert "serving/chunk_tokens" in res.stderr
     # ISSUE-6 serving SLO observability: the seeded mini serve-load run
     # completed every request with lifecycle-ordered traces, derived
     # TTFT/TPOT percentiles in the summary, a live serving/tpot_ms

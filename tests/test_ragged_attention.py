@@ -1,0 +1,517 @@
+"""Fused ragged paged attention (ops/ragged_paged_attention.py) and the
+chunked-prefill serving path (GenerationEngine(attention="fused")).
+
+Four layers of guarantees:
+
+* **kernel parity** — the Pallas kernel (interpret mode on CPU, so the
+  kernel BODY executes under tier-1) matches a full-precision numpy
+  oracle on ragged mixed prefill+decode batches over randomized page
+  tables, including multi-block chunks and bf16 storage;
+* **engine parity** — greedy FUSED engine output is token-identical to
+  the gather-based paged engine AND to per-request ``models.generate``
+  under mixed concurrent churn, prefix-cache adoption, COW and
+  block-pressure preemption — with ZERO retraces during the storm and a
+  clean ``analyze()`` bill on the fused step (donation-safe,
+  host-sync-free);
+* **chunked prefill** — long prompts feed in ``prefill_budget``-token
+  chunks mixed into decode launches: output stays exact, the chunk
+  counters are observable in ``stats()``/the flight recorder, and the
+  policy test shows decode rows advancing in the SAME cycles that chunk
+  a long prompt (no cycle spends its whole budget on one prompt);
+* **validation** — fused requires the paged layout and a
+  Mosaic-tileable block size, fail-fast at construction.
+"""
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.framework import monitor, trace_probe
+from paddle_tpu.models import GPTConfig, GPTForPretraining, generate
+from paddle_tpu.ops.ragged_paged_attention import (
+    ragged_layout, ragged_paged_attention, reference_ragged_attention)
+from paddle_tpu.serving import GenerationEngine
+from paddle_tpu.serving.paging import PagedKVPool
+from paddle_tpu.serving.scheduler import GenerationRequest, Scheduler
+
+VOCAB = 96
+
+
+@pytest.fixture(scope="module")
+def served_model():
+    """A tiny char GPT trained for a few steps: trained logits have
+    clear argmax margins, so greedy parity between the fused (ragged
+    Pallas kernel) and gather (materialized window) attention programs
+    cannot flake on numeric noise."""
+    paddle.seed(11)
+    cfg = GPTConfig(vocab_size=VOCAB, hidden_size=64, num_hidden_layers=2,
+                    num_attention_heads=4, intermediate_size=128,
+                    max_position_embeddings=64, hidden_dropout_prob=0.0,
+                    attention_dropout_prob=0.0)
+    model = GPTForPretraining(cfg)
+    opt = paddle.optimizer.Adam(learning_rate=3e-3,
+                                parameters=model.parameters())
+    corpus = ("the quick brown fox jumps over the lazy dog. "
+              "pack my box with five dozen liquor jugs. ") * 6
+    data = np.frombuffer(corpus.encode(), np.uint8).astype(np.int32) % VOCAB
+    rng = np.random.RandomState(0)
+    seq, batch = 24, 8
+    for _ in range(30):
+        starts = rng.randint(0, len(data) - seq - 1, batch)
+        chunk = np.stack([data[s:s + seq + 1] for s in starts])
+        loss, _ = model(paddle.to_tensor(chunk[:, :-1]),
+                        paddle.to_tensor(chunk[:, 1:].astype(np.int64)))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    model.eval()
+    return model
+
+
+def _prompt(rng, n):
+    return rng.randint(1, VOCAB, n).astype(np.int32)
+
+
+# ---------------------------------------------------------------------------
+# kernel-level parity (interpret mode: the kernel body runs on CPU)
+# ---------------------------------------------------------------------------
+
+def _random_ragged_case(rng, *, dtype="float32"):
+    """A randomized ragged batch over a randomized page table: returns
+    everything the kernel needs plus the flat oracle rows."""
+    import jax.numpy as jnp
+
+    L, H, BS, DH, S, T = 2, 3, 8, 16, 4, 4
+    NB = 24
+    pool = rng.randn(L, 2, NB + 1, H, BS, DH).astype(np.float32)
+    # per-seq: present?, kv_len, q_len (decode=1 or a chunk tail)
+    tables = np.zeros((S, T), np.int32)
+    q_lens, pos0s, kv_lens = [], [], []
+    free = list(range(1, NB + 1))
+    rng.shuffle(free)
+    for s in range(S):
+        if s == 3:                      # one absent sequence
+            q_lens.append(0), pos0s.append(0), kv_lens.append(0)
+            continue
+        kv = int(rng.randint(1, T * BS + 1))
+        q = 1 if s == 0 else int(rng.randint(1, kv + 1))  # s0 = decode
+        nblk = -(-kv // BS)
+        blocks = [free.pop() for _ in range(nblk)]
+        tables[s, :nblk] = blocks
+        q_lens.append(q)
+        pos0s.append(kv - q)            # the q rows are the kv tail
+        kv_lens.append(kv)
+    layer = int(rng.randint(0, L))
+    blk_seq, qstart, pos0, last_row, total = ragged_layout(q_lens, pos0s)
+    Qp = len(blk_seq) * 8
+    q = rng.randn(H, Qp, DH).astype(np.float32)
+    lo = np.zeros(S, np.int32)
+    out = ragged_paged_attention(
+        jnp.asarray(q, dtype), jnp.asarray(pool, dtype), layer,
+        blk_seq, qstart, pos0, tables, lo, np.asarray(kv_lens, np.int32))
+    rows, row_seq, row_pos = [], [], []
+    for s in range(S):
+        for i in range(q_lens[s]):
+            rows.append(q[:, qstart[s] + i, :])        # [H, Dh]
+            row_seq.append(s)
+            row_pos.append(pos0s[s] + i)
+    q_rows = np.stack(rows)                            # [N, H, Dh]
+    ref = reference_ragged_attention(
+        q_rows, pool, layer, row_seq, row_pos,
+        [list(t) for t in tables], lo)
+    got = np.stack([np.asarray(out, np.float32)[:, qstart[s] + i, :]
+                    for s in range(S) for i in range(q_lens[s])])
+    return got, ref
+
+
+class TestKernelParity:
+    def test_ragged_mixed_batches_match_oracle(self):
+        rng = np.random.RandomState(3)
+        for _ in range(4):
+            got, ref = _random_ragged_case(rng)
+            np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-5)
+
+    def test_bf16_storage_stays_close(self):
+        got, ref = _random_ragged_case(np.random.RandomState(5),
+                                       dtype="bfloat16")
+        np.testing.assert_allclose(got, ref, rtol=0.08, atol=0.08)
+
+    def test_multi_block_chunk_is_causal(self):
+        """A 20-row chunk spans 3 q blocks; every row must see exactly
+        its own prefix — the causal-within-chunk contract chunked
+        prefill relies on."""
+        import jax.numpy as jnp
+        rng = np.random.RandomState(7)
+        H, BS, DH = 2, 8, 16
+        pool = rng.randn(1, 2, 5, H, BS, DH).astype(np.float32)
+        tables = np.array([[1, 2, 3, 4]], np.int32)
+        blk_seq, qstart, pos0, last_row, total = ragged_layout([20], [0])
+        q = rng.randn(H, len(blk_seq) * 8, DH).astype(np.float32)
+        out = ragged_paged_attention(
+            jnp.asarray(q), jnp.asarray(pool), 0, blk_seq, qstart, pos0,
+            tables, np.zeros(1, np.int32), np.asarray([20], np.int32))
+        q_rows = q[:, :20, :].transpose(1, 0, 2)
+        ref = reference_ragged_attention(
+            q_rows, pool, 0, [0] * 20, list(range(20)),
+            [list(tables[0])], np.zeros(1, np.int32))
+        np.testing.assert_allclose(np.asarray(out)[:, :20, :],
+                                   ref.transpose(1, 0, 2),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_layout_and_validation(self):
+        blk_seq, qstart, pos0, last_row, total = ragged_layout(
+            [1, 0, 9], [4, 0, 2], q_bucket=32)
+        np.testing.assert_array_equal(blk_seq, [0, 2, 2, -1])
+        assert (qstart[0], qstart[2]) == (0, 8)
+        assert (last_row[0], last_row[2]) == (0, 16)
+        assert total == 10
+        with pytest.raises(ValueError, match="multiple of block_q"):
+            ragged_layout([1], [0], q_bucket=12)
+        with pytest.raises(ValueError, match="cannot hold"):
+            ragged_layout([9, 9], [0, 0], q_bucket=16)
+        import jax.numpy as jnp
+        pool = jnp.zeros((1, 2, 3, 2, 4, 16))   # block_size 4 < 8
+        with pytest.raises(ValueError, match="legal"):
+            ragged_paged_attention(
+                jnp.zeros((2, 8, 16)), pool, 0, np.zeros(1, np.int32),
+                np.zeros(1, np.int32), np.zeros(1, np.int32),
+                np.zeros((1, 1), np.int32), np.zeros(1, np.int32),
+                np.zeros(1, np.int32))
+
+
+# ---------------------------------------------------------------------------
+# fused engine parity: fused == gather == generate, zero retraces, clean
+# analysis — the acceptance criterion
+# ---------------------------------------------------------------------------
+
+class TestFusedEngineParity:
+    def test_single_request_matches_generate(self, served_model):
+        eng = GenerationEngine(served_model, num_slots=2, max_len=48,
+                               kv_layout="paged", block_size=8,
+                               attention="fused")
+        p = _prompt(np.random.RandomState(1), 7)
+        out = eng.submit(p, max_new_tokens=8).result(timeout=300)
+        ref = generate(served_model, p[None, :], max_new_tokens=8)
+        np.testing.assert_array_equal(out, ref.numpy()[0])
+        assert eng.stats()["attention"] == "fused"
+        eng.close()
+
+    def test_32_mixed_requests_fused_equals_gather_equals_generate(
+            self, served_model):
+        """The fused acceptance criterion: the same 32 mixed-length
+        concurrent greedy requests through the GATHER paged engine (the
+        correctness oracle) and the FUSED engine produce token-identical
+        output, each matching per-request ``generate``; the storm causes
+        ZERO retraces on the fused engine (one trace per (q, table)
+        bucket) and the fused step analyzes clean."""
+        rng = np.random.RandomState(2)
+        specs = [(_prompt(rng, int(rng.randint(2, 21))),
+                  int(rng.randint(1, 9))) for _ in range(32)]
+
+        def storm(eng):
+            outs = [None] * len(specs)
+
+            def client(i):
+                p, n = specs[i]
+                outs[i] = eng.submit(p, max_new_tokens=n)
+
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(len(specs))]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            return [h.result(timeout=600) for h in outs]
+
+        gather = GenerationEngine(served_model, num_slots=8, max_len=48,
+                                  min_bucket=8, kv_layout="paged",
+                                  block_size=8)
+        gather_outs = storm(gather)
+        gather.close()
+
+        eng = GenerationEngine(served_model, num_slots=8, max_len=48,
+                               min_bucket=8, kv_layout="paged",
+                               block_size=8, attention="fused")
+        # no warmup: the storm compiles its own (q, table) buckets, and
+        # the discipline assertion below is per-site trace counts (a
+        # deterministic zero-retrace check lives in
+        # test_warm_buckets_serve_with_zero_retraces)
+        fused_outs = storm(eng)
+        sites = {k: v for k, v in trace_probe.snapshot().items()
+                 if k.startswith("serving/fused") and f"#{eng._eid}" in k}
+        report = eng.analyze()
+        stats = eng.stats()
+        eng.close()
+
+        # fused == gather for ALL 32 (the oracle contract; gather ==
+        # generate over this same spec distribution is already pinned
+        # by tests/test_serving_paging.py), plus generate() spot checks
+        # so a correlated fused+gather drift cannot hide
+        for (p, n), gout, fout in zip(specs, gather_outs, fused_outs):
+            np.testing.assert_array_equal(fout, gout)
+        for i in (0, 9, 17, 31):
+            p, n = specs[i]
+            ref = generate(served_model, p[None, :], max_new_tokens=n)
+            np.testing.assert_array_equal(fused_outs[i], ref.numpy()[0])
+        # compile discipline: which (q, table) buckets a storm reaches
+        # depends on scheduling, but every bucket traces EXACTLY ONCE
+        # (traces > 1 would be the retrace-storm bug class) and the
+        # ladder is bounded by the pow2 products — q in {8..128} x
+        # table in {1, 2, 4, max_table_len=6} here
+        assert sites, "fused probe sites missing"
+        for name, rec in sites.items():
+            assert rec["traces"] == 1, (name, rec)
+            assert not rec["causes"], (name, rec)
+        assert len(sites) <= 20, sorted(sites)
+        # the clean bill: donation-safe, host-sync-free fused step
+        assert report.ok(), report.table()
+        assert "donation-safety" in report.passes_run
+        assert "host-sync" in report.passes_run
+        assert stats["active_requests"] == 0
+        assert stats["kv_blocks_in_use"] == 0
+
+    def test_eos_early_stop_matches_generate(self, served_model):
+        p = _prompt(np.random.RandomState(3), 6)
+        ref8 = generate(served_model, p[None, :], max_new_tokens=8)
+        eos = int(ref8.numpy()[0, 6 + 2])
+        ref = generate(served_model, p[None, :], max_new_tokens=8,
+                       eos_token_id=eos, pad_token_id=0)
+        eng = GenerationEngine(served_model, num_slots=2, max_len=48,
+                               kv_layout="paged", block_size=8,
+                               attention="fused")
+        out = eng.submit(p, max_new_tokens=8, eos_token_id=eos) \
+                 .result(timeout=300)
+        eng.close()
+        np.testing.assert_array_equal(out, ref.numpy()[0])
+
+    def test_prefix_hit_cow_and_preemption_interleavings(
+            self, served_model):
+        """Shared system prompt + block pressure: later requests adopt
+        the cached prefix blocks (fused takes the hit at ANY tail
+        length — chunks drain long tails, no replay cliff), growth under
+        a halved block budget preempts the youngest, and every output
+        stays token-exact."""
+        eng = GenerationEngine(served_model, num_slots=4, max_len=32,
+                               kv_layout="paged", block_size=8,
+                               num_blocks=8, attention="fused")
+        rng = np.random.RandomState(5)
+        system = _prompt(rng, 16)        # two full cacheable blocks
+        tails = [_prompt(rng, n) for n in (3, 1, 6, 10)]
+        prompts = [np.concatenate([system, t]) for t in tails]
+        first = eng.submit(prompts[0], max_new_tokens=6).result(timeout=300)
+        assert eng._pool.prefix_hits == 0
+        handles = [eng.submit(p, max_new_tokens=6) for p in prompts[1:]]
+        outs = [h.result(timeout=600) for h in handles]
+        stats = eng.stats()
+        eng.close()
+        # the 10-token tail would have been DECLINED by the gather
+        # engine (> min_bucket); fused adopts every hit
+        assert eng._pool.prefix_hits >= 3
+        assert stats["prefill_tokens_saved"] >= 3 * 16
+        for p, out in zip(prompts, [first] + outs):
+            ref = generate(served_model, p[None, :], max_new_tokens=6)
+            np.testing.assert_array_equal(out, ref.numpy()[0])
+
+    def test_block_pressure_preempts_and_stays_exact(self, served_model):
+        eng = GenerationEngine(served_model, num_slots=2, max_len=32,
+                               kv_layout="paged", block_size=8,
+                               num_blocks=4, attention="fused")
+        pa = _prompt(np.random.RandomState(6), 4)
+        pb = _prompt(np.random.RandomState(7), 4)
+        ha = eng.submit(pa, max_new_tokens=24)
+        hb = eng.submit(pb, max_new_tokens=24)
+        oa, ob = ha.result(timeout=600), hb.result(timeout=600)
+        stats = eng.stats()
+        eng.close()
+        assert stats["preempts"] >= 1
+        np.testing.assert_array_equal(
+            oa, generate(served_model, pa[None, :],
+                         max_new_tokens=24).numpy()[0])
+        np.testing.assert_array_equal(
+            ob, generate(served_model, pb[None, :],
+                         max_new_tokens=24).numpy()[0])
+        assert eng._pool.blocks_in_use == 0
+
+    def test_warm_buckets_serve_with_zero_retraces(self, served_model):
+        """The deterministic zero-retrace assertion: a request identical
+        in shape class to one already served reuses every fused (q,
+        table) bucket program — no new trace anywhere, and the
+        dispatch/retrace_cause counters stay untouched."""
+        eng = GenerationEngine(served_model, num_slots=2, max_len=48,
+                               kv_layout="paged", block_size=8,
+                               attention="fused")
+        rng = np.random.RandomState(4)
+        eng.submit(_prompt(rng, 7), max_new_tokens=8).result(timeout=300)
+        retrace0 = monitor.stat_get("dispatch/retrace_cause")
+        sites0 = {k: v["traces"]
+                  for k, v in trace_probe.snapshot().items()
+                  if k.startswith("serving/fused") and f"#{eng._eid}" in k}
+        assert sites0
+        out = eng.submit(_prompt(rng, 7), max_new_tokens=8) \
+                 .result(timeout=300)
+        eng.close()
+        assert out.shape == (15,)
+        assert monitor.stat_get("dispatch/retrace_cause") == retrace0
+        sites1 = {k: v["traces"]
+                  for k, v in trace_probe.snapshot().items()
+                  if k.startswith("serving/fused") and f"#{eng._eid}" in k}
+        assert sites1 == sites0
+
+    def test_sampled_and_greedy_share_one_bucket_trace(self, served_model):
+        eng = GenerationEngine(served_model, num_slots=4, max_len=48,
+                               kv_layout="paged", block_size=8,
+                               attention="fused")
+        rng = np.random.RandomState(8)
+        g = eng.submit(_prompt(rng, 6), max_new_tokens=5)
+        s = eng.submit(_prompt(rng, 6), max_new_tokens=5, do_sample=True,
+                       temperature=0.7)
+        o1, o2 = g.result(timeout=300), s.result(timeout=300)
+        eng.close()
+        assert o1.shape == o2.shape == (11,)
+        assert ((0 <= o2) & (o2 < VOCAB)).all()
+        sites = {k: v for k, v in trace_probe.snapshot().items()
+                 if k.startswith("serving/fused") and f"#{eng._eid}" in k}
+        assert sites
+        for name, rec in sites.items():
+            assert rec["traces"] == 1, (name, rec)
+
+
+# ---------------------------------------------------------------------------
+# chunked prefill: budget-bounded feeding, observable, non-starving
+# ---------------------------------------------------------------------------
+
+class TestChunkedPrefill:
+    def test_long_prompt_chunks_within_budget_and_stays_exact(
+            self, served_model):
+        eng = GenerationEngine(served_model, num_slots=4, max_len=64,
+                               kv_layout="paged", block_size=8,
+                               attention="fused", prefill_budget=8)
+        p = _prompt(np.random.RandomState(9), 40)
+        h = eng.submit(p, max_new_tokens=4)
+        out = h.result(timeout=600)
+        stats = eng.stats()
+        rec = eng.dump_flight_recorder()
+        eng.close()
+        ref = generate(served_model, p[None, :], max_new_tokens=4)
+        np.testing.assert_array_equal(out, ref.numpy()[0])
+        # 40 feed tokens at an 8-token budget: >= 5 chunk launches,
+        # visible in stats() and in the flight recorder's cycle ring
+        assert stats["prefill_chunks"] >= 5
+        assert stats["chunked_prefill_tokens"] == 40
+        assert stats.get("chunked_prefill_tokens_per_sec", 0) > 0
+        chunk_cycles = [c for c in rec["cycles"]
+                        if c.get("chunk_tokens", 0) > 0]
+        assert chunk_cycles
+        assert max(c["chunk_tokens"] for c in chunk_cycles) <= 8
+        # the request trace carries the per-chunk marks and the
+        # completion mark that separates feeding from decoding
+        assert h.trace.count("prefill_chunk") >= 5
+        assert h.trace.t("chunked_prefill_done") is not None
+
+    def test_long_prompt_does_not_starve_decode(self, served_model):
+        """The anti-starvation policy: while a 40-token prompt is being
+        chunk-fed at an 8-token budget, the already-decoding request
+        keeps emitting IN THE SAME cycles — no cycle spends its whole
+        budget on the prompt alone (the prompt-burst monopoly the
+        gather engine's whole-bucket prefill could not avoid)."""
+        eng = GenerationEngine(served_model, num_slots=4, max_len=64,
+                               kv_layout="paged", block_size=8,
+                               attention="fused", prefill_budget=8)
+        short = eng.submit(_prompt(np.random.RandomState(10), 4),
+                           max_new_tokens=40)
+        it = short.stream()
+        next(it)                        # short is decoding now
+        long_h = eng.submit(_prompt(np.random.RandomState(11), 40),
+                            max_new_tokens=2)
+        long_h.result(timeout=600)
+        short.cancel()
+        with pytest.raises(Exception):
+            for _ in it:
+                pass
+        rec = eng.dump_flight_recorder()
+        eng.close()
+        chunk_cycles = [c for c in rec["cycles"]
+                        if c.get("chunk_tokens", 0) > 0]
+        assert len(chunk_cycles) >= 5
+        # every chunk cycle also advanced decode: emitted >= 1
+        assert all(c["emitted"] >= 1 for c in chunk_cycles), chunk_cycles
+        assert max(c["chunk_tokens"] for c in chunk_cycles) <= 8
+
+    def test_chunk_plan_policy_mock_scheduler(self):
+        """Deterministic mock-device policy check (no model): the chunk
+        plan gives every decode slot its row unconditionally and splits
+        the token budget FCFS among feeding slots."""
+        pool = PagedKVPool(num_layers=1, num_slots=4, num_heads=1,
+                           max_len=64, head_dim=1, block_size=8,
+                           min_bucket=8)
+        launches = []
+
+        def do_prefill(req, slot, bucket):
+            feed = np.concatenate([req.prompt,
+                                   np.asarray(req.tokens, np.int32)])
+            pool.admit_fresh(slot, feed.size)
+            pool.set_slot(slot, pos=0, lo=0)
+            req.pending_feed = [int(t) for t in feed]
+            return None
+
+        def do_chunked(slot_requests, plan):
+            launches.append(dict(plan))
+            return np.full(pool.num_slots, 7, np.int32)
+
+        sched = Scheduler(pool, do_prefill, lambda *_: None,
+                          do_chunked_step=do_chunked, prefill_budget=6)
+        a = sched.submit(GenerationRequest(np.ones(4, np.int32), 8))
+        a.result(timeout=60)
+        b = sched.submit(GenerationRequest(np.ones(20, np.int32), 1))
+        c = sched.submit(GenerationRequest(np.ones(20, np.int32), 1))
+        b.result(timeout=60)
+        c.result(timeout=60)
+        sched.close()
+        assert sched.prefill_chunks >= 7     # 4 + 20 + 20 tokens / 6
+        assert sched.chunk_tokens == 44
+        # no launch ever fed more than the budget, and whenever a
+        # decode row existed it was in the launch too
+        for plan in launches:
+            fed = sum(n for n in plan.values() if n > 1)
+            assert fed <= 6
+        # FCFS: b (older) finished its feed no later than c
+        tb = b.trace.t("chunked_prefill_done")
+        tc = c.trace.t("chunked_prefill_done")
+        assert tb is not None and tc is not None and tb <= tc
+
+
+# ---------------------------------------------------------------------------
+# validation
+# ---------------------------------------------------------------------------
+
+class TestFusedValidation:
+    def test_fused_requires_paged_layout(self, served_model):
+        with pytest.raises(ValueError, match="paged"):
+            GenerationEngine(served_model, num_slots=2, max_len=32,
+                             attention="fused")
+
+    def test_fused_requires_tileable_block_size(self, served_model):
+        with pytest.raises(ValueError, match="block_size"):
+            GenerationEngine(served_model, num_slots=2, max_len=32,
+                             kv_layout="paged", block_size=4,
+                             attention="fused")
+
+    def test_unknown_attention_rejected(self, served_model):
+        with pytest.raises(ValueError, match="attention"):
+            GenerationEngine(served_model, num_slots=2, max_len=32,
+                             kv_layout="paged", block_size=8,
+                             attention="flash")
+
+    def test_fused_admits_prompts_the_bucket_ladder_rejects(
+            self, served_model):
+        """No prefill buckets in fused mode: a feed whose pow2 bucket
+        would overshoot a non-pow2 max_len (rejected by the gather
+        engine at submit) chunks through the ragged step instead."""
+        eng = GenerationEngine(served_model, num_slots=2, max_len=48,
+                               kv_layout="paged", block_size=8,
+                               attention="fused")
+        out = eng.submit(np.ones(33, np.int32), max_new_tokens=1) \
+                 .result(timeout=300)
+        assert out.shape == (34,)
+        eng.close()

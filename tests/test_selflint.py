@@ -104,6 +104,58 @@ def test_memory_stats_hot_path_rule():
     assert [f.line for f in out] == [4]
 
 
+def test_pallas_block_tiling_rule():
+    """The BENCH_r02 bug class as a standing static check: a literal
+    BlockSpec dim that violates the Mosaic (8, 128) rule is flagged in
+    ops/; legal shapes, SMEM specs, shapeless specs, dynamic dims and
+    argued suppressions are not."""
+    # the exact r02 crash: (1, 128) block over a [BH, S] array — the
+    # second-to-last literal 1 is neither 8-divisible nor the array dim
+    src = ("import jax.experimental.pallas as pl\n"
+           "spec = pl.BlockSpec((1, 128), lambda i: (i, 0))\n")
+    out = lint_source("t.py", src, "ops/pallas_kernels.py")
+    assert [f.rule for f in out] == ["pallas-block-tiling"]
+    assert out[0].line == 2
+    # a misaligned literal LAST dim is the other half of the rule
+    out = lint_source(
+        "t.py",
+        "import jax.experimental.pallas as pl\n"
+        "spec = pl.BlockSpec((8, 64), lambda i: (i, 0))\n",
+        "ops/pallas_kernels.py")
+    assert [f.rule for f in out] == ["pallas-block-tiling"]
+    # both legal jax spellings are covered: the bare-name import form
+    # and the block_shape= keyword form
+    out = lint_source(
+        "t.py",
+        "from jax.experimental.pallas import BlockSpec\n"
+        "a = BlockSpec((1, 128), lambda i: (i, 0))\n"
+        "b = BlockSpec(block_shape=(1, 128), index_map=lambda i: (i, 0))\n",
+        "ops/pallas_kernels.py")
+    assert [f.rule for f in out] == ["pallas-block-tiling"] * 2
+    assert [f.line for f in out] == [2, 3]
+    # legal literals (8-divisible sublane, 128-aligned lane) pass, as
+    # do leading dims of >2D blocks (only the last two are tiled)
+    ok = ("import jax.experimental.pallas as pl\n"
+          "a = pl.BlockSpec((8, 128), lambda i: (i, 0))\n"
+          "b = pl.BlockSpec((1, 128, 256), lambda i: (i, 0, 0))\n")
+    assert lint_source("t.py", ok, "ops/pallas_kernels.py") == []
+    # dynamic dims are trusted (derived from array shapes at runtime),
+    # SMEM specs and shapeless whole-array specs are out of scope
+    ok2 = ("import jax.experimental.pallas as pl\n"
+           "from jax.experimental.pallas import tpu as pltpu\n"
+           "a = pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0))\n"
+           "b = pl.BlockSpec((1, 1), memory_space=pltpu.SMEM)\n"
+           "c = pl.BlockSpec(memory_space=pltpu.ANY)\n")
+    assert lint_source("t.py", ok2, "ops/pallas_kernels.py") == []
+    # outside ops/ the rule does not apply...
+    assert lint_source("t.py", src, "serving/engine.py") == []
+    # ...and a block-equals-array-dim case is suppressible with an
+    # argued '# lint: ok' (the fused-LN [1, D] param specs)
+    sup = src.replace("lambda i: (i, 0))",
+                      "lambda i: (i, 0))  # lint: ok")
+    assert lint_source("t.py", sup, "ops/pallas_kernels.py") == []
+
+
 def test_asarray_rule():
     src = (
         "import numpy as np\n"
