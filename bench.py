@@ -1447,10 +1447,18 @@ def dry_run():
     serve-load run through the --serve-load harness helpers — request
     traces complete in lifecycle order with derived TTFT/TPOT,
     ``serving/tpot_ms`` live, per-engine stats() latency present, the
-    always-on flight recorder non-empty, zero decode retraces. Prints
-    the stats summary to stderr and ONE JSON line to stdout; exits
-    nonzero when any assertion fails, so CI catches an instrumentation
-    or fast-path regression before it costs a real benchmark round."""
+    always-on flight recorder non-empty, zero decode retraces. ISSUE-10
+    addition: the training numerics canary — a clean
+    ``fit(numerics='record')`` leaves ``hapi/grad_norm``/
+    ``hapi/grad_clip_ratio`` live with ZERO extra compiled programs on
+    a warm re-fit (the audit is fused into the donated step), and an
+    injected-inf fit in ``warn`` mode trips the NaN/Inf sentinel at the
+    exact step within one flush window, dumps a round-tripping anomaly
+    postmortem JSON, and keeps ``hapi/host_sync`` at the PR-2 windowed
+    budget. Prints the stats summary to stderr and ONE JSON line to
+    stdout; exits nonzero when any assertion fails, so CI catches an
+    instrumentation or fast-path regression before it costs a real
+    benchmark round."""
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     # ISSUE-7: pin a fake per-device peak so the MFU math (hapi/mfu,
     # serving_mfu) is exercised end to end on the CPU backend — without
@@ -1733,6 +1741,84 @@ def dry_run():
 
         serve_load_canary = _serve_load_canary()
 
+        # numerics canary (ISSUE 10): the training numerics health layer
+        # end to end — a clean fit with numerics='record' leaves
+        # hapi/grad_norm + hapi/grad_clip_ratio live and a warm re-fit
+        # compiles ZERO additional programs (the audit is fused into the
+        # existing donated step); an injected-inf fit in 'warn' mode
+        # trips the sentinel within one flush window at the exact step,
+        # dumps an anomaly postmortem JSON that round-trips, and leaves
+        # hapi/host_sync at the PR-2 windowed budget.
+        def _numerics_canary():
+            net2 = nn.Sequential(nn.Linear(16, 8), nn.ReLU(),
+                                 nn.Linear(8, 4))
+            m2 = paddle.Model(net2)
+            m2.prepare(
+                paddle.optimizer.Adam(
+                    learning_rate=1e-3, parameters=net2.parameters(),
+                    grad_clip=nn.ClipGradByGlobalNorm(1.0)),
+                nn.CrossEntropyLoss())
+            data = TensorDataset([xs, ys])
+            budget = n_batches / log_freq + 2
+            s0 = monitor.stat_get("hapi/host_sync")
+            m2.fit(data, batch_size=8, epochs=1, log_freq=log_freq,
+                   shuffle=False, verbose=0, numerics="record")
+            clean_syncs = monitor.stat_get("hapi/host_sync") - s0
+            c0 = monitor.stat_get("compile/count")
+            # warm re-fit, same signatures: the audit must not have
+            # grown a second program per signature
+            m2.fit(data, batch_size=8, epochs=1, log_freq=log_freq,
+                   shuffle=False, verbose=0, numerics="record")
+            extra_programs = monitor.stat_get("compile/count") - c0
+            inject_at = m2._step_counter + 3
+            m2._numerics_inject_inf_at = inject_at
+            s1 = monitor.stat_get("hapi/host_sync")
+            import warnings as _w
+            with _w.catch_warnings():
+                _w.simplefilter("ignore")
+                m2.fit(data, batch_size=8, epochs=1, log_freq=log_freq,
+                       shuffle=False, verbose=0, numerics="warn")
+            m2._numerics_inject_inf_at = None
+            warn_syncs = monitor.stat_get("hapi/host_sync") - s1
+            rec = m2._numerics_recorder
+            nonfin = [a for a in rec.anomaly_list()
+                      if a["kind"] == "nonfinite"]
+            pm_ok = False
+            pm_path = rec.last_dump_path
+            if pm_path and os.path.exists(pm_path):
+                with open(pm_path) as f:
+                    pm = json.load(f)
+                pm_ok = (bool(pm.get("ring"))
+                         and pm.get("anomaly", {}).get("kind")
+                         == "nonfinite"
+                         and "blamed_groups" in pm
+                         and "memory_postmortem" in pm
+                         and "monitor" in pm)
+            return {
+                "sentinel_tripped":
+                    bool(nonfin) and nonfin[0]["step"] == inject_at
+                    and bool(nonfin[0]["blamed_groups"]),
+                "postmortem_ok": pm_ok,
+                "postmortem": pm_path,
+                "sync_budget_kept":
+                    0 < clean_syncs <= budget
+                    and 0 < warn_syncs <= budget,
+                "zero_extra_programs": extra_programs == 0,
+                "grad_norm_live":
+                    monitor.stat_histogram("hapi/grad_norm") is not None
+                    and monitor.stat_histogram("hapi/grad_clip_ratio")
+                    is not None,
+                "inject_step": inject_at,
+                "anomaly_step": nonfin[0]["step"] if nonfin else None,
+                "host_syncs": {"clean": clean_syncs, "warn": warn_syncs},
+            }
+
+        # snapshot the host-sync counter BEFORE the numerics canary's
+        # own fits add their windowed flushes: host_sync_windowed below
+        # asserts the budget of the FIRST fit alone
+        host_syncs = monitor.stat_get("hapi/host_sync")
+        numerics_canary = _numerics_canary()
+
     # ISSUE-7: the bench regression gate, exercised the way the driver
     # would use it — a seeded artifact vs a doctored copy with a 20%
     # throughput loss and a 40% latency blowup must exit nonzero
@@ -1769,7 +1855,6 @@ def dry_run():
                               _flatten_bench_doc(doctored))
 
     counters = monitor.all_stats()
-    host_syncs = monitor.stat_get("hapi/host_sync")
     mem_ledger = _memory.ledger()
     mem_timeline_labels = {e.get("label") for e in _memory.timeline()}
     trace_path = os.path.join(tempfile.mkdtemp(prefix="paddle_dryrun_"),
@@ -1885,6 +1970,17 @@ def dry_run():
             and "kv/alloc" in mem_timeline_labels,
         "bench_compare_gate":
             rc_self == 0 and rc_regress != 0 and bool(regs),
+        # ISSUE-10 training numerics health: a clean numerics='record'
+        # fit leaves the gradient telemetry live at zero extra programs
+        # and the windowed sync budget, and the injected-inf warn run
+        # trips the sentinel at the exact step with a round-tripping
+        # anomaly postmortem
+        "numerics_sentinel": numerics_canary["sentinel_tripped"],
+        "numerics_postmortem": numerics_canary["postmortem_ok"],
+        "numerics_sync_budget": numerics_canary["sync_budget_kept"],
+        "numerics_zero_extra_programs":
+            numerics_canary["zero_extra_programs"],
+        "numerics_grad_norm_live": numerics_canary["grad_norm_live"],
     }
     print(monitor.stats_summary(), file=sys.stderr)
     for f in lint_findings:
@@ -1922,6 +2018,15 @@ def dry_run():
                           fused_canary["prefill_chunks"],
                       "fused_chunk_tokens": fused_canary["chunk_tokens"],
                       "serve_load": serve_load_canary["summary"],
+                      "numerics": {
+                          "inject_step": numerics_canary["inject_step"],
+                          "anomaly_step":
+                              numerics_canary["anomaly_step"],
+                          "postmortem": numerics_canary["postmortem"],
+                          "host_syncs": numerics_canary["host_syncs"],
+                          "nonfinite_steps":
+                              monitor.stat_get("hapi/nonfinite_steps"),
+                      },
                       "compile_count":
                           int(monitor.stat_get("compile/count")),
                       "hapi_mfu": (monitor.stat_histogram("hapi/mfu")

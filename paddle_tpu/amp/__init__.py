@@ -14,16 +14,18 @@ from __future__ import annotations
 
 import contextlib
 import threading
+import weakref
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 
+from ..framework.monitor import stat_add, stat_observe
 from ..framework.tensor import Tensor
 
 __all__ = ["auto_cast", "amp_guard", "GradScaler", "decorate",
            "white_list", "black_list", "is_auto_cast_enabled",
-           "get_amp_dtype"]
+           "get_amp_dtype", "active_scaler"]
 
 # O1 lists (reference amp/auto_cast.py WHITE_LIST/BLACK_LIST): matmul-class
 # ops run in low precision; numerically-sensitive ops stay fp32.
@@ -128,6 +130,21 @@ def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
     return (models if single else model_list), optimizers
 
 
+# the most recently constructed ENABLED scaler (weakref): the numerics
+# flight recorder (profiler/numerics.py) stamps its state — scale,
+# good/bad-step streaks, found_inf — into every per-step record so a
+# postmortem shows what the loss-scaling state machine was doing when
+# training went nonfinite
+_active_scaler: Optional["weakref.ref"] = None
+
+
+def active_scaler() -> Optional["GradScaler"]:
+    """The live, enabled :class:`GradScaler` most recently constructed
+    in this process, or ``None`` (bf16 runs have no scaler)."""
+    s = _active_scaler() if _active_scaler is not None else None
+    return s if s is not None and s._enable else None
+
+
 class GradScaler:
     """Dynamic loss scaling (reference amp/grad_scaler.py:26).
 
@@ -135,6 +152,11 @@ class GradScaler:
     steps; scale *= decr_ratio after decr_every_n_nan_or_inf non-finite
     steps, which are skipped. For bf16 (enable=False or use_loss_scaling
     False) this is a transparent pass-through — the TPU-native default.
+
+    Observable: every ``update()`` lands the post-update scale in the
+    ``amp/loss_scale`` histogram and counts nonfinite updates in
+    ``amp/found_inf``; :meth:`state` is the snapshot the training
+    numerics flight recorder rides along per step.
     """
 
     def __init__(self, enable=True, init_loss_scaling=2. ** 15,
@@ -153,6 +175,9 @@ class GradScaler:
         # INIT -> UNSCALED -> STEPPED cycle, reset by update() (reference
         # grad_scaler.py OptimizerState tracking).
         self._stage = "INIT"
+        if enable:
+            global _active_scaler
+            _active_scaler = weakref.ref(self)
 
     def is_enable(self):
         return self._enable
@@ -216,6 +241,7 @@ class GradScaler:
         if not (self._enable and self._dynamic):
             return
         if self._found_inf:
+            stat_add("amp/found_inf")
             self._bad_steps += 1
             self._good_steps = 0
             if self._bad_steps >= self._decr_every:
@@ -228,6 +254,17 @@ class GradScaler:
                 self._scale *= self._incr_ratio
                 self._good_steps = 0
         self._found_inf = False
+        # post-update scale: the histogram's trajectory IS the loss-
+        # scaling state machine's history (halvings on inf bursts,
+        # doublings on good streaks)
+        stat_observe("amp/loss_scale", self._scale)
+
+    def state(self) -> dict:
+        """Host snapshot for the numerics flight recorder: the scale,
+        the good/bad-step streaks, and the pending found_inf verdict."""
+        return {"scale": self._scale, "good_steps": self._good_steps,
+                "bad_steps": self._bad_steps,
+                "found_inf": self._found_inf, "enabled": self._enable}
 
     def state_dict(self):
         return {"scale": self._scale, "incr_ratio": self._incr_ratio,

@@ -110,10 +110,20 @@ def analyze_model(model, inputs, labels=None, passes=None, name=None):
 
     key = jax.random.key(0)
     lr = jnp.asarray(model._optimizer.get_lr(), jnp.float32)
-    step_args = (model._params, model._opt_state, model._buffers, key, lr,
-                 len(ins), *ins, *lbs)
+    # with the numerics audit fused into the step (fit(numerics=...)),
+    # the signature grows a traced inject scalar before the static
+    # n_inputs — mirror the dispatch path so the trace matches the
+    # program that actually runs
+    if getattr(model, "_audit_enabled", False):
+        step_args = (model._params, model._opt_state, model._buffers,
+                     key, lr, jnp.float32(1.0), len(ins), *ins, *lbs)
+        static_argnums = (6,)
+    else:
+        step_args = (model._params, model._opt_state, model._buffers,
+                     key, lr, len(ins), *ins, *lbs)
+        static_argnums = (5,)
     return analyze(model._train_step_fn, *step_args,
-                   donate_argnums=(0, 1, 2), static_argnums=(5,),
+                   donate_argnums=(0, 1, 2), static_argnums=static_argnums,
                    passes=passes, grad=grad,
                    name=name or
                    f"Model({type(model.network).__name__}).train_step")

@@ -31,6 +31,13 @@ Python cannot enforce (≙ the reference's tools/codestyle custom checks
   stamps; device polling belongs to the tracker's background sampler
   thread (``profiler/memory.py``) and windowed surfaces like fit's
   flush.
+* ``numerics-host-sync`` — the training numerics layer
+  (``profiler/numerics.py``) exists to REPLACE the reference's per-op
+  host sweep with audits fetched only at fit's flush windows, so the
+  module itself must never sync: ``jax.device_get``, ``.item()``,
+  ``.numpy()`` and ``.block_until_ready()`` are banned there — the
+  fetch lives in ``hapi/model.py _flush_window`` (behind the window's
+  existing blocking loss fetch), and the recorder receives numpy.
 * ``pallas-block-tiling`` — Mosaic's TPU block-shape rule, statically:
   inside ``ops/``, a ``pl.BlockSpec`` whose block tuple carries a
   LITERAL second-to-last dim not divisible by 8, or a literal last dim
@@ -217,6 +224,8 @@ def lint_source(path: str, source: str, relpath: str) -> List[LintFinding]:
     in_serving = rel.startswith("serving/")
     # Pallas kernels live in ops/ — BlockSpec tiling is checked there
     in_ops = rel.startswith("ops/")
+    # the numerics audit module: host-pure over numpy BY CONTRACT
+    in_numerics = rel.endswith("profiler/numerics.py")
 
     for node in ast.walk(tree):
         # rule: pallas-block-tiling (Mosaic (8, 128) block-shape law)
@@ -261,6 +270,30 @@ def lint_source(path: str, source: str, relpath: str) -> List[LintFinding]:
                     f"batching decode loop must stay async — route "
                     f"device reads through the single windowed fetch "
                     f"(serving/scheduler.py _fetch)"))
+        # rule: numerics-host-sync (the numerics audit module never
+        # syncs — fetches belong to fit's flush window)
+        if in_numerics and isinstance(node, ast.Call):
+            sync = None
+            if _is_jax_device_get(node):
+                sync = "jax.device_get"
+            elif isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "block_until_ready" \
+                    and isinstance(node.func.value, ast.Name) \
+                    and node.func.value.id == "jax":
+                sync = "jax.block_until_ready"
+            elif isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in ("block_until_ready", "numpy",
+                                           "item"):
+                sync = f".{node.func.attr}()"
+            if sync and not _suppressed(lines, node.lineno):
+                findings.append(LintFinding(
+                    "numerics-host-sync", path, node.lineno,
+                    f"{sync} in the numerics audit module: the audit "
+                    f"replaces the reference's per-op host sweep "
+                    f"precisely by never syncing — device vectors are "
+                    f"fetched ONLY at Model._flush_window (behind the "
+                    f"window's existing loss fetch) and arrive here as "
+                    f"numpy"))
         # rule: memory-stats-hot-path (no device memory polling in the
         # serving package — marks are host-only, the sampler thread
         # polls)

@@ -77,7 +77,10 @@ def all_flags() -> Iterable[str]:
 # Core flags (subset of the reference's 56, the ones with TPU meaning).
 define_flag("FLAGS_check_nan_inf", False,
             "Sweep op outputs for NaN/Inf after each eager op "
-            "(reference: framework/details/nan_inf_utils_detail.cc)")
+            "(reference: framework/details/nan_inf_utils_detail.cc). "
+            "Also seeds Model.fit(numerics=None) to 'halt' — the "
+            "windowed, zero-sync analog of the reference's "
+            "abort-on-first-NaN (profiler/numerics.py)")
 define_flag("FLAGS_benchmark", False, "Print per-op timing in eager mode")
 define_flag("FLAGS_check_shapes", True,
             "InferMeta-style pre-dispatch shape validation with call-site "
@@ -111,6 +114,13 @@ define_flag("FLAGS_static_analysis", "off",
             "AnalysisError on error-severity findings; 'off' disables "
             "the pre-flight (explicit Model.fit(analyze=...) still "
             "wins). Env-seeded: FLAGS_static_analysis=warn")
+define_flag("FLAGS_numerics", "",
+            "Default numerics-health mode for Model.fit "
+            "(off|record|warn|halt): the device-side NaN/Inf audit "
+            "fused into the donated train step, gradient telemetry "
+            "histograms, the training flight recorder and the anomaly "
+            "postmortem (profiler/numerics.py). Empty defers to "
+            "FLAGS_check_nan_inf (set -> 'halt'), else 'off'")
 define_flag("FLAGS_hapi_prefetch", True,
             "Route Model.fit/evaluate input through io.device_prefetch "
             "(background H2D overlapping compute); the escape hatch for "

@@ -40,13 +40,19 @@ __all__ = ["site", "snapshot", "reset"]
 
 _lock = threading.Lock()
 _sites: Dict[str, "_Site"] = {}
-# registry bound: hapi allocates one site per Model instance, so a
-# sweep/notebook creating thousands of Models must not grow host memory
-# (and snapshot() cost) without bound. Past the cap site() returns an
-# UNREGISTERED _Site: counting still works for callers that hold the
-# returned site by reference across traces (dispatch closures, the
-# Model._probe_site attribute) — only snapshot() visibility is bounded.
-_MAX_SITES = 512
+# registry bound: hapi allocates one site per Model instance (and a
+# serving engine several per bucket), so a sweep/notebook creating
+# thousands of Models must not grow host memory (and snapshot() cost)
+# without bound. Past the cap site() returns an UNREGISTERED _Site:
+# counting still works for callers that hold the returned site by
+# reference across traces (dispatch closures, the Model._probe_site
+# attribute) — only snapshot() visibility is bounded. The cap is sized
+# well above what a test-suite-scale process accumulates (~500 sites at
+# ISSUE 10): a run that crosses it silently drops NEW sites from
+# snapshot(), which reads as "this engine never traced" to the
+# one-trace-per-bucket assertions — a cliff that must stay far from
+# normal use. ~100 bytes per site: 4096 is still nothing.
+_MAX_SITES = 4096
 
 
 class _Site:

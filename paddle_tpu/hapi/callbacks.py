@@ -112,6 +112,14 @@ class CallbackList:
 
 
 class ProgBarLogger(Callback):
+    """Prints every key in the flush-window ``logs`` dict: loss and
+    metrics always; ``mfu:`` when a device peak is known (PR 7); with
+    ``fit(numerics=...)`` armed additionally ``grad_norm:`` (and
+    ``loss_scale:`` when a GradScaler is active) from the numerics
+    audit — all 0-d-scalar-coerced by :meth:`_fmt` exactly like loss,
+    so a user forwarding unflushed device values still gets numbers,
+    not array reprs."""
+
     def __init__(self, log_freq=1, verbose=2):
         super().__init__()
         self.log_freq = log_freq

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import os
 import time
+from collections import deque
 from typing import Dict, List, Optional, Sequence
 
 import jax
@@ -26,9 +27,10 @@ from ..framework import program_registry as _registry
 from ..framework import random as _random
 from ..framework import trace_probe as _probe
 from ..framework.io import load as _load, save as _save
-from ..framework.monitor import stat_add, stat_observe
+from ..framework.monitor import stat_add, stat_get, stat_observe
 from ..framework.tensor import Tensor, no_grad_guard
 from ..profiler import memory as _memory
+from ..profiler import numerics as _numerics
 from ..profiler import span as _prof
 from ..io import DataLoader, Dataset
 from ..metric import Metric
@@ -207,6 +209,17 @@ class Model:
     # be pinned on device when log_freq is large (sync count stays
     # O(steps / min(log_freq, cap)) — still windowed, never per-step)
     _METRIC_WINDOW = 8
+    # numerics audit vectors buffered between flushes are tiny ((6 +
+    # groups) f32 each) but one per STEP: with log_freq<=0 (epoch-tail
+    # flushes only) an unbounded buffer would pin O(steps-per-epoch)
+    # device handles — the one invariant the loss window's O(1)
+    # overwrite exists to protect. Ring semantics instead: the NEWEST
+    # cap's worth survive to the flush (a NaN propagates, so the tail
+    # still trips the sentinel even when the origin step was dropped),
+    # drops counted in hapi/audit_window_dropped. Forcing a flush would
+    # add host syncs vs numerics-off, breaking the identical-sync-budget
+    # contract — dropping is the honest bounded choice.
+    _AUDIT_WINDOW = 4096
 
     def __init__(self, network: Layer, inputs=None, labels=None):
         self.network = network
@@ -238,6 +251,24 @@ class Model:
         self._flush_flops = 0.0
         self._flush_steps = 0
         self._flush_t0 = None
+        # numerics health (profiler/numerics.py): when fit(numerics=)
+        # is not 'off', the device-side audit is COMPILED INTO the
+        # donated train step (one extra small output + a traced inject
+        # scalar, zero extra programs) and its vectors ride the flush
+        # window — fetched only behind the window's one blocking loss
+        # fetch, so hapi/host_sync is IDENTICAL with numerics on or off
+        self._numerics_mode = "off"   # policy applied host-side at flush
+        self._audit_enabled = False   # audit baked into the built step?
+        self._audit_layout = None     # layer-group schema of the vector
+        # [(global step, device vector, layout)] ring — see _AUDIT_WINDOW
+        self._audit_window = deque(maxlen=self._AUDIT_WINDOW)
+        self._audit_collect = False   # only fit() windows collect
+        self._numerics_recorder = None
+        self._retrace_mark = 0.0      # dispatch/retrace_cause watermark
+        # test hook: scale the loss by +inf when _step_counter hits this
+        # value (traced scalar — same compiled program) so the sentinel
+        # path is testable without NaN-crafted data
+        self._numerics_inject_inf_at = None
 
     def _static(self):
         """The StaticGraphAdapter when ``paddle.enable_static()`` is on
@@ -449,6 +480,22 @@ class Model:
         # is both the dygraph freezing contract (the old functional step
         # silently trained frozen params) and free under donation
         frozen = frozenset(self._frozen or ())
+        # numerics audit (profiler/numerics.py): fused into THIS step's
+        # trace when armed — per-step finite bitmask, grad/param/update
+        # norms and per-layer-group nonfinite counts as one small f32
+        # output next to the loss. 'record'/'warn'/'halt' share the
+        # program (policy is host-side at the flush window); only
+        # off<->on changes the trace.
+        audit_on = self._numerics_mode != "off"
+        self._audit_enabled = audit_on
+        layout = None
+        if audit_on:
+            layout = _numerics.AuditLayout.build(
+                [k for k in (self._params or {}) if k not in frozen])
+        self._audit_layout = layout
+        from ..nn.clip import ClipGradByGlobalNorm
+        reuse_clip_norm = audit_on and isinstance(clip,
+                                                  ClipGradByGlobalNorm)
 
         # per-INSTANCE site: another Model (even of the same class) must
         # not diff this one's signatures into phantom structure/shape
@@ -462,8 +509,8 @@ class Model:
                 f"hapi/train_step[{type(net).__name__}"
                 f"#{Model._probe_seq}]")
 
-        def train_step(params, opt_state, buffers, key, lr, n_inputs,
-                       *arrays):
+        def _step(params, opt_state, buffers, key, lr, inject, n_inputs,
+                  arrays):
             # body runs only while jax TRACES a new signature, so this
             # classifies every donated-step retrace (shape vs dtype vs
             # frozen-set) into dispatch/retrace_cause at trace time —
@@ -490,19 +537,65 @@ class Model:
                     new_buffers = st["updated_buffers"]
                 outs = outputs if isinstance(outputs, (list, tuple)) \
                     else [outputs]
-                return loss._data.astype(jnp.float32), \
-                    ([o._data for o in outs], new_buffers)
+                loss_data = loss._data.astype(jnp.float32)
+                if audit_on:
+                    # traced inject scalar (1.0 in production): the
+                    # numerics test hook scales the loss to +inf at a
+                    # chosen step through the SAME compiled program
+                    loss_data = loss_data * inject
+                return loss_data, ([o._data for o in outs], new_buffers)
 
             (loss_val, (outs, new_buffers)), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(train_p)
+            raw_grads = grads
+            pre_norm = post_norm = None
             if clip is not None:
-                pairs = clip([(train_p[k], g) for k, g in grads.items()])
+                pairs_in = [(train_p[k], g) for k, g in grads.items()]
+                if reuse_clip_norm:
+                    # the clip already reduces the whole gradient tree
+                    # to its global norm — the audit reads that value
+                    # instead of paying the reduction twice. min(norm,
+                    # clip) IS the exact clipped norm here: the leaves
+                    # are plain jnp arrays, so clip_with_norm's eager
+                    # Parameter.need_clip exemption never fires and
+                    # every grad scales by clip/max(norm, clip)
+                    pairs, pre_norm = clip.clip_with_norm(pairs_in)
+                    post_norm = jnp.minimum(
+                        pre_norm, jnp.float32(clip.clip_norm))
+                else:
+                    pairs = clip(pairs_in)
                 grads = {k: g for (k, (_, g)) in zip(grads.keys(), pairs)}
+                if audit_on and post_norm is None:
+                    # per-tensor/value clips have no global-norm to
+                    # reuse: reduce the CLIPPED grads so the audit's
+                    # clip ratio stays honest (reporting 1.0 while a
+                    # value clip was biting would hide exactly the
+                    # saturation the telemetry exists to expose)
+                    post_norm = _numerics.global_grad_norm(grads)
             new_train, new_opt_state = opt.apply_gradients(
                 train_p, grads, opt_state, lr)
             new_params = dict(params)
             new_params.update(new_train)
+            if audit_on:
+                audit = _numerics.build_audit(
+                    loss_val, raw_grads, train_p, new_train, layout,
+                    grad_norm=pre_norm, clipped_norm=post_norm)
+                return (new_params, new_opt_state, new_buffers, loss_val,
+                        outs, audit)
             return new_params, new_opt_state, new_buffers, loss_val, outs
+
+        if audit_on:
+            def train_step(params, opt_state, buffers, key, lr, inject,
+                           n_inputs, *arrays):
+                return _step(params, opt_state, buffers, key, lr, inject,
+                             n_inputs, arrays)
+            static_argnums = (6,)
+        else:
+            def train_step(params, opt_state, buffers, key, lr, n_inputs,
+                           *arrays):
+                return _step(params, opt_state, buffers, key, lr, None,
+                             n_inputs, arrays)
+            static_argnums = (5,)
 
         # donate params/opt_state/buffers: every output leaf has a
         # same-shape/dtype donated input, so XLA aliases the update
@@ -518,13 +611,16 @@ class Model:
         # deleted", never silent garbage.
         #
         # The step is an AOT program-registry site (same jit semantics —
-        # static n_inputs at position 5, donated train state — but the
-        # executable is compiled explicitly ONCE per signature): compile
-        # wall-ms lands in compile/ms, and the program's XLA cost
-        # analysis (FLOPs/bytes) is what _observe_compute turns into
-        # hapi/flops_per_sec and hapi/mfu at every flush window.
+        # static n_inputs, donated train state — but the executable is
+        # compiled explicitly ONCE per signature): compile wall-ms lands
+        # in compile/ms, and the program's XLA cost analysis
+        # (FLOPs/bytes) is what _observe_compute turns into
+        # hapi/flops_per_sec and hapi/mfu at every flush window. With
+        # numerics armed the audit is part of THIS program — never a
+        # second compile per signature (bench.py --dry-run asserts the
+        # registry compile/count stays flat across a warm re-fit).
         self._train_step_fn = _registry.aot_site(
-            probe_site.name, train_step, static_argnums=(5,),
+            probe_site.name, train_step, static_argnums=static_argnums,
             donate_argnums=(0, 1, 2))
 
     def _analysis_loss_fn(self, ins, lbs):
@@ -618,10 +714,30 @@ class Model:
         self._flush_steps += 1
         key = jax.random.fold_in(jax.random.key(0), self._step_counter)
         lr = jnp.asarray(self._optimizer.get_lr(), jnp.float32)
-        (self._params, self._opt_state, self._buffers, loss,
-         outs) = self._train_step_fn(
-            self._params, self._opt_state, self._buffers, key, lr,
-            len(ins), *ins, *lbs)
+        if self._audit_enabled:
+            inj = self._numerics_inject_inf_at
+            inject = np.float32(np.inf) if (
+                inj is not None and self._step_counter == inj) \
+                else np.float32(1.0)
+            (self._params, self._opt_state, self._buffers, loss, outs,
+             audit) = self._train_step_fn(
+                self._params, self._opt_state, self._buffers, key, lr,
+                inject, len(ins), *ins, *lbs)
+            if self._audit_collect:
+                # tiny device vector per step ((6 + groups) f32), held
+                # until the window flush fetches it behind the loss;
+                # the layout rides along so a mid-epoch step rebuild
+                # (frozen-set flip) can never decode old vectors
+                # against a new group schema
+                w = self._audit_window
+                if w.maxlen is not None and len(w) == w.maxlen:
+                    stat_add("hapi/audit_window_dropped")
+                w.append((self._step_counter, audit, self._audit_layout))
+        else:
+            (self._params, self._opt_state, self._buffers, loss,
+             outs) = self._train_step_fn(
+                self._params, self._opt_state, self._buffers, key, lr,
+                len(ins), *ins, *lbs)
         self._flush_flops += getattr(self._train_step_fn,
                                      "last_dispatch_flops", None) or 0.0
         self._dirty = True
@@ -777,7 +893,76 @@ class Model:
         # HBM watermark at the step-boundary surface (the flush already
         # blocks on the host sync; one PjRt stats query rides along)
         _memory.sample("hapi/flush", steps=self._step_counter)
+        # numerics: decode the window's audit vectors (already-computed
+        # device arrays behind the loss fetch above — no extra sync, the
+        # hapi/host_sync counter is untouched), feed the telemetry
+        # histograms + the training flight recorder, and apply the
+        # policy — 'halt' raises NumericsError here, AFTER its anomaly
+        # postmortem dump, and propagates through fit's on_train_abort
+        # teardown like any other training failure
+        logs.update(self._flush_numerics())
         return logs
+
+    def _flush_numerics(self):
+        """Drain the window's audit vectors into the numerics recorder
+        (profiler/numerics.py). Returns the flush-log update
+        (``grad_norm`` + ``loss_scale``); raises only
+        :class:`~paddle_tpu.profiler.numerics.NumericsError` (halt
+        mode) — recorder bugs degrade to a warning, never kill a run
+        the audit exists to protect."""
+        if not self._audit_window:
+            return {}
+        entries = list(self._audit_window)
+        self._audit_window.clear()
+        rec = self._numerics_recorder
+        if rec is None:
+            return {}
+        retrace_now = stat_get("dispatch/retrace_cause")
+        delta = retrace_now - self._retrace_mark
+        self._retrace_mark = retrace_now
+        from ..amp import active_scaler
+        # the process's newest ENABLED scaler: hapi's bf16-native step
+        # drives no GradScaler itself, so the recorded state is ambient
+        # context (which custom-AMP-loop scaler was live during this
+        # fit), not a claim that fit consumed it
+        scaler = active_scaler()
+        kwargs = dict(
+            mode=self._numerics_mode,
+            lr=float(self._optimizer.get_lr()),
+            scaler=scaler.state() if scaler is not None else None,
+            retrace_delta=int(delta),
+            ledger_bytes=_memory.ledger_total(),
+            context={"site": getattr(getattr(self, "_probe_site", None),
+                                     "name", None)})
+        try:
+            # decode each vector against the layout IT was produced
+            # under: a mid-window step rebuild (frozen-set flip via the
+            # staleness probe) changes the group schema, and zipping an
+            # old vector against the new groups would silently blame
+            # the wrong layers. Consecutive same-layout runs share one
+            # record_window call.
+            logs = {}
+            i, n = 0, len(entries)
+            while i < n:
+                layout = entries[i][2]
+                j = i
+                while j < n and entries[j][2] is layout:
+                    j += 1
+                if layout is not None:
+                    logs = rec.record_window(
+                        [(step, np.asarray(a))
+                         for step, a, _ in entries[i:j]],
+                        layout, **kwargs)
+                i = j
+            return logs
+        except _numerics.NumericsError:
+            raise
+        except Exception as e:  # pragma: no cover - recorder robustness
+            import warnings
+            warnings.warn(f"numerics flush failed "
+                          f"({type(e).__name__}: {e}); continuing fit",
+                          RuntimeWarning)
+            return {}
 
     def _observe_compute(self):
         """Achieved FLOP/s (and MFU against the device peak) for the
@@ -846,7 +1031,8 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
-            prefetch=None, prefetch_buffer_size=2, analyze=None):
+            prefetch=None, prefetch_buffer_size=2, analyze=None,
+            numerics=None):
         """Train over ``train_data``, asynchronously on the dygraph path:
         steps are dispatched without blocking (donated jitted step), the
         next batch's H2D transfer rides under compute via
@@ -867,7 +1053,23 @@ class Model:
         built train step on the first batch: ``'warn'`` logs findings,
         ``'error'`` raises AnalysisError on error-severity ones,
         ``'off'`` skips. ``None`` defers to ``FLAGS_static_analysis``
-        (env-seeded, default off). Tracing only — nothing executes."""
+        (env-seeded, default off). Tracing only — nothing executes.
+
+        ``numerics`` arms the training numerics health layer
+        (profiler/numerics.py): a device-side audit (finite bitmask,
+        grad/param/update norms, per-layer-group nonfinite counts)
+        FUSED into the donated train step and fetched only at the flush
+        windows — zero extra host syncs (``hapi/host_sync`` is
+        identical on/off) and zero extra compiled programs. ``'record'``
+        feeds the ``hapi/grad_norm``/``update_ratio``/
+        ``grad_clip_ratio`` histograms and the bounded training flight
+        recorder; ``'warn'`` additionally dumps an anomaly postmortem
+        JSON and warns on nonfinite steps or robust-z loss spikes;
+        ``'halt'`` raises :class:`NumericsError` on a nonfinite step
+        AFTER the postmortem lands (``on_train_abort`` teardown runs).
+        ``None`` defers to ``FLAGS_numerics`` /
+        ``FLAGS_check_nan_inf`` (the reference flag's abort-on-NaN
+        semantics map to ``'halt'``), default ``'off'``."""
         analyze_explicit = analyze is not None
         if analyze is None:
             # flag-seeded: lenient normalization (a bad env value means
@@ -878,6 +1080,13 @@ class Model:
             raise ValueError(
                 f"analyze must be 'warn', 'error' or 'off', got "
                 f"{analyze!r}")
+        numerics_explicit = numerics is not None
+        if numerics is None:
+            numerics = _numerics.flag_mode()
+        elif numerics not in _numerics.MODES:
+            raise ValueError(
+                f"numerics must be one of {_numerics.MODES}, got "
+                f"{numerics!r}")
         loader = self._as_loader(train_data, batch_size, shuffle,
                                  num_workers, drop_last)
         eval_loader = self._as_loader(eval_data, batch_size, False,
@@ -908,11 +1117,45 @@ class Model:
                     "at Executor.run lints the captured Program",
                     UserWarning)
             analyze = "off"
+        if numerics != "off" and not async_path:
+            # the audit is fused into the DYNAMIC donated train step;
+            # the static-graph Executor is host-synchronous per batch —
+            # its loss is already on the host every step
+            if numerics_explicit:
+                import warnings
+                warnings.warn(
+                    "fit(numerics=...) applies to the dynamic-graph "
+                    "path; the static-graph executor fetches the loss "
+                    "every batch already", UserWarning)
+            numerics = "off"
         if async_path:
+            # off<->on changes the step's trace (the audit output and
+            # inject scalar are part of the program); record/warn/halt
+            # share it — the policy is host-side, switching is free
+            if (numerics != "off") != self._audit_enabled \
+                    and self._train_step_fn is not None:
+                self._train_step_fn = None
+            self._numerics_mode = numerics
             self._sync_state_from_network()
             if self._train_step_fn is None:
                 self._build_train_step()
             self._update_memory_ledger()
+            if numerics != "off":
+                if self._numerics_recorder is None:
+                    self._numerics_recorder = _numerics.NumericsRecorder()
+                # ring continuity is kept across fits; the loss-spike
+                # baseline is not (a new task's healthy starting loss
+                # must not z-score against the last run's converged one)
+                self._numerics_recorder.new_run()
+                self._audit_window = deque(maxlen=self._AUDIT_WINDOW)
+                self._audit_collect = True
+                self._retrace_mark = stat_get("dispatch/retrace_cause")
+            else:
+                # an ABORTED numerics fit can leave un-flushed vectors
+                # behind (collect stops in the finally, the window does
+                # not drain) — a later numerics-off fit must not decode
+                # the previous run's leftovers into the recorder
+                self._audit_window.clear()
         self._flush_flops, self._flush_steps, self._flush_t0 = 0.0, 0, None
         cbks.on_train_begin()
         try:
@@ -988,6 +1231,7 @@ class Model:
             cbks.on_train_abort()
             raise
         finally:
+            self._audit_collect = False
             self._sync_state_to_network()
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
@@ -1084,6 +1328,20 @@ class Model:
             n = m.name()
             names.extend(n if isinstance(n, list) else [n])
         return names
+
+    def dump_numerics(self, path=None):
+        """On-demand snapshot of the training numerics flight recorder
+        (ring tail, anomalies, scaler state, monitor snapshot, memory
+        postmortem path) as JSON — the operator surface mirroring
+        ``GenerationEngine.dump_flight_recorder``. Returns the file
+        path, or ``None`` when numerics was never armed on this
+        Model."""
+        rec = self._numerics_recorder
+        if rec is None:
+            return None
+        return rec.postmortem(None, path=path, context={
+            "site": getattr(getattr(self, "_probe_site", None), "name",
+                            None)})
 
     # -- persistence ---------------------------------------------------------
     def save(self, path, training=True):

@@ -460,7 +460,9 @@ def build_slot_decode_fn(model, num_slots, max_len, top_k=0, top_p=1.0,
 
     Returns ``fn(params, buffers, pool, tokens, pos, lo, sample_mask,
     temperature, key) -> (pool, next_tokens, key)`` over the shared KV
-    pool ``[layers, 2, slots, heads, max_len, head_dim]``:
+    pool ``[layers, 2, slots, heads, max_len, head_dim]``
+    (``next_tokens`` is ``[slots + 1]``: the per-slot tokens plus the
+    logits-finite sentinel of :func:`_append_nonfinite_flag`):
 
     * ``tokens`` ``[slots]`` int32 — each slot's last emitted token; its
       K/V are written at cache index ``pos[slot]`` with a per-slot
@@ -536,9 +538,23 @@ def build_slot_decode_fn(model, num_slots, max_len, top_k=0, top_p=1.0,
                 sampled = _pick_token(logits, sub, True, top_k, top_p,
                                       temperature[:, None])
                 nxt = jnp.where(sample_mask, sampled, greedy)
+                nxt = _append_nonfinite_flag(nxt, logits)
         return new_pool, nxt, key
 
     return fn
+
+
+def _append_nonfinite_flag(nxt, logits):
+    """Append the per-cycle logits-finite sentinel to the decode step's
+    token row: element ``[num_slots]`` is 1 when ANY logit this cycle is
+    NaN/Inf, else 0. It rides the scheduler's existing one-per-cycle
+    ``_fetch`` (the token indexing ``toks[slot]`` never reaches it), so
+    the serving twin of the training numerics audit costs zero extra
+    host syncs — the scheduler counts it into
+    ``serving/nonfinite_cycles`` and the flight-recorder cycle record."""
+    import jax.numpy as jnp
+    bad = jnp.any(~jnp.isfinite(logits)).astype(jnp.int32)
+    return jnp.concatenate([nxt, bad[None]])
 
 
 # ---------------------------------------------------------------------------
@@ -647,7 +663,8 @@ def build_paged_decode_fn(model, num_slots, table_len, block_size,
     Returns ``fn(params, buffers, pool, tokens, pos, lo, tables,
     sample_mask, temperature, key) -> (pool, next_tokens, key)`` over
     the block pool ``[layers, 2, num_blocks + 1, heads, block_size,
-    head_dim]``:
+    head_dim]`` (``next_tokens`` ``[slots + 1]`` — the last element is
+    the logits-finite sentinel, see :func:`_append_nonfinite_flag`):
 
     * ``tables`` ``[slots, table_len]`` int32 — each slot's page table
       padded with 0 (the scratch block) to the pow2 table bucket; the
@@ -727,6 +744,7 @@ def build_paged_decode_fn(model, num_slots, table_len, block_size,
                 sampled = _pick_token(logits, sub, True, top_k, top_p,
                                       temperature[:, None])
                 nxt = jnp.where(sample_mask, sampled, greedy)
+                nxt = _append_nonfinite_flag(nxt, logits)
         return new_pool, nxt, key
 
     return fn
@@ -747,7 +765,9 @@ def build_fused_step_fn(model, num_slots, q_rows, table_len, block_size,
     write_off, blk_seq, seq_qstart, seq_pos0, tables, lo, kv_len,
     last_row, sample_mask, temperature, key) -> (pool, next_tokens,
     key)`` over the block pool ``[layers, 2, num_blocks + 1, heads,
-    block_size, head_dim]``:
+    block_size, head_dim]`` (``next_tokens`` ``[num_slots + 1]`` — the
+    last element is the logits-finite sentinel of
+    :func:`_append_nonfinite_flag`):
 
     * ``token_ids``/``qpos``/``write_block``/``write_off`` ``[q_rows]``
       int32 — the flattened padded ragged batch (see
@@ -834,6 +854,7 @@ def build_fused_step_fn(model, num_slots, q_rows, table_len, block_size,
                 sampled = _pick_token(logits, sub, True, top_k, top_p,
                                       temperature[:, None])
                 nxt = jnp.where(sample_mask, sampled, greedy)
+                nxt = _append_nonfinite_flag(nxt, logits)
         return new_pool, nxt, key
 
     return fn

@@ -53,8 +53,16 @@ class ClipGradByGlobalNorm(ClipGradBase):
         self.clip_norm = float(clip_norm)
 
     def __call__(self, params_grads):
+        return self.clip_with_norm(params_grads)[0]
+
+    def clip_with_norm(self, params_grads):
+        """Clip AND return the pre-clip global norm: ``(out_pairs,
+        global_norm)``. The numerics audit of the donated train step
+        (profiler/numerics.py) reads the norm from here instead of
+        reducing the gradient tree a second time — the clip path
+        already paid for it."""
         if not params_grads:
-            return params_grads
+            return params_grads, jnp.zeros((), jnp.float32)
         sq = [jnp.sum(jnp.square(g.astype(jnp.float32)))
               for p, g in params_grads
               if not getattr(p, "need_clip", True) is False]
@@ -66,7 +74,7 @@ class ClipGradByGlobalNorm(ClipGradBase):
                 out.append((p, g))
             else:
                 out.append((p, (g * scale).astype(g.dtype)))
-        return out
+        return out, global_norm
 
 
 def clip_by_norm(x, max_norm):

@@ -34,10 +34,16 @@ from .span import (  # noqa: F401
 # byte ledger (train state, KV pools) + the OOM postmortem dump
 from . import memory  # noqa: F401
 
+# training numerics health (numerics.py): device-side NaN/Inf sentinels
+# fused into the donated train step, gradient telemetry histograms, the
+# train-loop flight recorder and the anomaly postmortem
+from . import numerics  # noqa: F401
+from .numerics import NumericsError  # noqa: F401
+
 __all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
            "make_scheduler", "export_chrome_tracing", "export_protobuf",
            "SortedKeys", "load_profiler_result", "device_op_table",
            "summary_table",
            "record", "profile", "enable", "disable", "reset", "is_active",
            "events", "dropped", "span_summary", "export_chrome_trace",
-           "export_prometheus", "memory"]
+           "export_prometheus", "memory", "numerics", "NumericsError"]

@@ -245,8 +245,15 @@ class MemoryTracker:
             with self._lock:
                 self.last_dump_path = path
                 self.dumps += 1
-            stat_add("memory/oom_postmortem")
-            logger.error("OOM postmortem written to %s", path)
+            if error is not None:
+                stat_add("memory/oom_postmortem")
+                logger.error("OOM postmortem written to %s", path)
+            else:
+                # requested dump (e.g. riding a numerics postmortem):
+                # same artifact, but nobody ran out of memory — an
+                # alert gating on memory/oom_postmortem must not fire
+                stat_add("memory/postmortem_requested")
+                logger.info("memory postmortem written to %s", path)
             return path
         except Exception:                                # noqa: BLE001
             return None

@@ -1,0 +1,521 @@
+"""Training numerics health: device-side sentinels, gradient telemetry,
+and a train-loop flight recorder with anomaly postmortem.
+
+The reference framework's numerics debugging story is
+``FLAGS_check_nan_inf`` — a synchronous host sweep of EVERY op output
+after EVERY kernel launch (framework/details/nan_inf_utils_detail.cc) —
+which is exactly the per-step host sync the donated async train step
+(PR 2) exists to eliminate. This module is the TPU-native replacement,
+built on one rule: **the audit is computed ON DEVICE inside the already-
+compiled train step and fetched only at the existing flush windows**, so
+a ``fit()`` with numerics armed costs zero extra host syncs and zero
+extra compiled programs (the ``hapi/host_sync`` counter and the PR-7
+program-registry ``compile/count`` are both asserted unchanged by
+tests and ``bench.py --dry-run``).
+
+Three layers:
+
+* **device audit** (:func:`build_audit`, traced into the train step by
+  ``hapi/model.py _build_train_step`` when ``Model.fit(numerics=...)``
+  is not ``'off'``) — one small f32 vector per step: a packed finite
+  bitmask (loss / grads / post-update params), the global grad norm
+  (REUSED from the ``ClipGradByGlobalNorm`` clip path when present —
+  never computed twice), the clipped norm, the global param norm, the
+  update norm ``‖Δw‖``, and per-layer-group nonfinite gradient element
+  counts for blame. The vector rides the fit window next to the loss
+  and is converted to numpy at ``_flush_window`` — already-computed
+  arrays behind the window's one blocking fetch.
+* **telemetry + flight recorder** (:class:`NumericsRecorder`) — on
+  every flush the decoded records feed the monitor histograms
+  (``hapi/grad_norm``, ``hapi/update_ratio``, ``hapi/grad_clip_ratio``)
+  and counters (``hapi/nonfinite_steps``, ``hapi/loss_spikes``), and
+  land in a bounded per-Model ring of per-step records mirroring the
+  serving flight recorder (loss, grad norm, update ratio, lr, finite
+  bitmask, GradScaler state, retrace-cause delta, HBM-ledger bytes) —
+  always on while numerics is armed, dumpable after the fact.
+* **policy + postmortem** — ``Model.fit(numerics='record'|'warn'|
+  'halt')`` reacts at the window: nonfinite steps in ``halt`` mode
+  raise a named :class:`NumericsError` AFTER the anomaly postmortem
+  JSON lands (ring tail + blamed layer groups + scaler state + monitor
+  snapshot + the PR-7 memory-postmortem path) and fit's existing
+  ``on_train_abort`` teardown runs; ``warn`` dumps the same postmortem
+  and warns without killing the run. A loss-spike detector (robust
+  z-score over the ring: ``|loss - median| / (1.4826 * MAD)``) fires
+  the postmortem in ``warn``/``halt`` mode but NEVER raises — a spike
+  is a lead, not a verdict.
+
+Threading / sync contract: everything in this module is host-pure over
+NUMPY inputs (``hapi/model.py`` converts the device vectors inside its
+flush window) except :func:`build_audit`, which is jnp code traced into
+the step. The ``numerics-host-sync`` self-lint rule
+(analysis/selflint.py) enforces that no ``.item()``/``jax.device_get``/
+``.numpy()`` sync ever creeps in here — audit fetches belong to the
+flush window, nowhere else.
+"""
+from __future__ import annotations
+
+import json
+import math
+import os
+import statistics
+import tempfile
+import threading
+import time
+import warnings
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..framework.monitor import (all_stats, stat_add, stat_histogram,
+                                 stat_observe)
+from . import memory as _memory
+
+__all__ = ["NumericsError", "AuditLayout", "NumericsRecorder",
+           "build_audit", "group_params", "decode_audit", "flag_mode",
+           "MODES", "N_FIXED", "FINITE_ALL"]
+
+MODES = ("off", "record", "warn", "halt")
+
+# audit vector layout: fixed scalar slots, then one per-group count
+IDX_BITS = 0          # packed finite bitmask (see bit constants below)
+IDX_LOSS = 1          # the step's loss value (f32)
+IDX_GRAD_NORM = 2     # global UNCLIPPED grad norm
+IDX_CLIPPED_NORM = 3  # global grad norm after clipping (== raw w/o clip)
+IDX_PARAM_NORM = 4    # global trainable-param norm (pre-update)
+IDX_UPDATE_NORM = 5   # global update norm ‖Δw‖ (post - pre)
+N_FIXED = 6
+
+BIT_LOSS = 1          # loss is finite
+BIT_GRADS = 2         # every gradient element is finite
+BIT_UPDATE = 4        # every post-update param element is finite
+FINITE_ALL = BIT_LOSS | BIT_GRADS | BIT_UPDATE
+
+
+class NumericsError(RuntimeError):
+    """Training numerics went nonfinite under ``fit(numerics='halt')``.
+
+    Raised at the flush window that detected the anomaly, AFTER the
+    anomaly postmortem JSON was dumped (its path is in the message) —
+    fit's ``on_train_abort`` teardown runs on the way out exactly as for
+    any other training failure."""
+
+
+def group_params(names: Sequence[str],
+                 max_groups: int = 32) -> Dict[str, Tuple[str, ...]]:
+    """Deterministic layer-group partition of parameter tree names, for
+    nonfinite blame. Prefers the parent-module path (``"0.weight"`` →
+    ``"0"``, ``"gpt.blocks.3.attn.q.weight"`` → the attn layer), then
+    coarsens (first two components, then the first) until the group
+    count fits ``max_groups`` — the audit vector carries one count per
+    group, so blame granularity trades off against vector size."""
+    names = sorted(names)
+
+    def parent(n: str) -> str:
+        head, _, _ = n.rpartition(".")
+        return head or n
+
+    keyfns = [parent,
+              lambda n: ".".join(n.split(".")[:2]),
+              lambda n: n.split(".", 1)[0]]
+    groups: Dict[str, List[str]] = {}
+    for keyfn in keyfns:
+        groups = {}
+        for n in names:
+            groups.setdefault(keyfn(n), []).append(n)
+        if len(groups) <= max_groups:
+            break
+    if len(groups) > max_groups:
+        # a flat net (40+ sibling layers) defeats every prefix keyfn —
+        # the cap is a hard bound on the device vector's size, so merge
+        # lexicographic RANGES of groups until it holds, labeled by
+        # their span ("0..17.weight") so blame still localizes
+        keys = list(groups)
+        per = -(-len(keys) // max_groups)
+        merged: Dict[str, List[str]] = {}
+        for i in range(0, len(keys), per):
+            chunk = keys[i:i + per]
+            label = chunk[0] if len(chunk) == 1 \
+                else f"{chunk[0]}..{chunk[-1]}"
+            merged[label] = [n for k in chunk for n in groups[k]]
+        groups = merged
+    return {g: tuple(ms) for g, ms in groups.items()}
+
+
+@dataclass(frozen=True)
+class AuditLayout:
+    """Host-side schema of the device audit vector: the ordered layer
+    groups and their member parameter names. Static per train-step
+    trace (the frozen set is baked in, so the trainable name set is
+    too); held on the Model next to the step it describes."""
+
+    groups: Tuple[str, ...]
+    members: Dict[str, Tuple[str, ...]] = field(hash=False)
+
+    @staticmethod
+    def build(trainable_names: Sequence[str],
+              max_groups: int = 32) -> "AuditLayout":
+        members = group_params(trainable_names, max_groups)
+        return AuditLayout(groups=tuple(members), members=members)
+
+    @property
+    def size(self) -> int:
+        return N_FIXED + len(self.groups)
+
+
+def global_grad_norm(grads):
+    """True global L2 norm over a gradient tree, f32-accumulated — THE
+    reduction the audit reports when the clip path has none to reuse.
+    One owner (here) so the audit's fallback in ``build_audit`` and the
+    per-tensor-clip fallback in ``hapi/model.py`` can never diverge.
+    (``ClipGradByGlobalNorm.clip_with_norm`` keeps its own reduction:
+    the eager path filters ``Parameter.need_clip`` there, a semantic
+    this tree-of-arrays helper deliberately does not have — in the
+    functional train step the leaves are plain jnp arrays, so the
+    filter never fires and the two reductions agree.)"""
+    import jax.numpy as jnp
+    sq = sum((jnp.sum(jnp.square(g.astype(jnp.float32)))
+              for g in grads.values()), jnp.zeros((), jnp.float32))
+    return jnp.sqrt(sq)
+
+
+def build_audit(loss, grads, params, new_params, layout: AuditLayout,
+                grad_norm=None, clipped_norm=None):
+    """The device-side audit: jnp code TRACED INTO the donated train
+    step (no program of its own — the zero-extra-programs contract).
+
+    ``grads``/``params``/``new_params`` are the RAW trainable-param
+    trees (grads pre-clip, so blame points at the true origin: a
+    global-norm clip smears one NaN over every gradient). ``grad_norm``
+    / ``clipped_norm`` reuse the clip path's reduction when the
+    optimizer clips by global norm — the norm is never computed twice.
+    Returns one f32 vector of ``layout.size`` elements (see the
+    ``IDX_*`` layout constants)."""
+    import jax.numpy as jnp
+
+    loss_s = jnp.reshape(jnp.asarray(loss, jnp.float32), (-1,))[0]
+    counts = []
+    for g in layout.groups:
+        c = jnp.zeros((), jnp.int32)
+        for name in layout.members[g]:
+            c = c + jnp.sum(~jnp.isfinite(grads[name])).astype(jnp.int32)
+        counts.append(c)
+    total_nonfinite = sum(counts, jnp.zeros((), jnp.int32))
+    if grad_norm is None:
+        grad_norm = global_grad_norm(grads)
+    grad_norm = jnp.asarray(grad_norm, jnp.float32)
+    clipped_norm = grad_norm if clipped_norm is None \
+        else jnp.asarray(clipped_norm, jnp.float32)
+    p_sq = sum((jnp.sum(jnp.square(p.astype(jnp.float32)))
+                for p in params.values()), jnp.zeros((), jnp.float32))
+    u_sq = sum((jnp.sum(jnp.square(new_params[k].astype(jnp.float32)
+                                   - params[k].astype(jnp.float32)))
+                for k in params), jnp.zeros((), jnp.float32))
+    update_ok = jnp.ones((), bool)
+    for v in new_params.values():
+        update_ok = update_ok & jnp.all(jnp.isfinite(v))
+    bits = (jnp.isfinite(loss_s).astype(jnp.float32) * BIT_LOSS
+            + (total_nonfinite == 0).astype(jnp.float32) * BIT_GRADS
+            + update_ok.astype(jnp.float32) * BIT_UPDATE)
+    vec = jnp.stack([bits, loss_s, grad_norm, clipped_norm,
+                     jnp.sqrt(p_sq), jnp.sqrt(u_sq)])
+    if counts:
+        vec = jnp.concatenate(
+            [vec, jnp.stack(counts).astype(jnp.float32)])
+    return vec
+
+
+def decode_audit(vec: np.ndarray, layout: AuditLayout) -> Dict[str, Any]:
+    """Host-side decode of one fetched audit vector (numpy in, plain
+    Python out) into the per-step record the recorder rings."""
+    v = np.asarray(vec, np.float64).ravel()
+    bits = int(v[IDX_BITS])
+    grad_norm = float(v[IDX_GRAD_NORM])
+    clipped = float(v[IDX_CLIPPED_NORM])
+    p_norm = float(v[IDX_PARAM_NORM])
+    u_norm = float(v[IDX_UPDATE_NORM])
+    rec: Dict[str, Any] = {
+        "finite_bits": bits,
+        "finite": bits == FINITE_ALL,
+        "loss_finite": bool(bits & BIT_LOSS),
+        "grads_finite": bool(bits & BIT_GRADS),
+        "update_finite": bool(bits & BIT_UPDATE),
+        "loss": float(v[IDX_LOSS]),
+        "grad_norm": grad_norm,
+        "clipped_grad_norm": clipped,
+        "param_norm": p_norm,
+        "update_norm": u_norm,
+    }
+    rec["update_ratio"] = (u_norm / p_norm) if p_norm > 0 else 0.0
+    rec["clip_ratio"] = (clipped / grad_norm) \
+        if (grad_norm > 0 and math.isfinite(grad_norm)) else 1.0
+    rec["nonfinite_groups"] = {
+        g: int(c) for g, c in zip(layout.groups, v[N_FIXED:]) if c > 0}
+    return rec
+
+
+def flag_mode() -> str:
+    """Env-seeded default mode for ``Model.fit(numerics=None)``:
+    ``FLAGS_numerics`` when set to a known mode (lenient normalization
+    — a bad env value means un-audited, never a crash blaming an
+    argument that was never passed); otherwise ``FLAGS_check_nan_inf``
+    seeds ``'halt'`` — the reference flag ABORTS on the first NaN/Inf,
+    and this is its windowed, zero-sync analog — else ``'off'``."""
+    from ..framework.flags import flag_value
+    v = str(flag_value("FLAGS_numerics") or "").strip().lower()
+    if v in MODES:
+        return v
+    if v in ("1", "on", "true", "yes"):
+        return "warn"
+    if flag_value("FLAGS_check_nan_inf"):
+        return "halt"
+    return "off"
+
+
+class NumericsRecorder:
+    """The TRAINING flight recorder: a bounded ring of per-step numerics
+    records plus the anomaly policy, mirroring the serving
+    :class:`~..serving.flight_recorder.FlightRecorder` (host dicts,
+    bounded, always on while numerics is armed, dumpable postmortem).
+
+    Written by ``Model._flush_window`` (one ``record_window`` call per
+    flush, decoded numpy in); read by anyone (``snapshot()`` / the
+    postmortem dump) — the one small lock covers both, and writes are
+    per-window, not per-step-dispatch, so contention is negligible."""
+
+    def __init__(self, max_steps: int = 1024, max_anomalies: int = 64,
+                 spike_zscore: float = 8.0, spike_min_history: int = 8,
+                 spike_window: int = 64):
+        if max_steps < 1:
+            raise ValueError("max_steps must be >= 1")
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=int(max_steps))
+        self._anomalies: deque = deque(maxlen=int(max_anomalies))
+        self.steps_recorded = 0       # monotonic (ring drops, this doesn't)
+        self.anomalies_recorded = 0
+        self.last_dump_path: Optional[str] = None
+        self.dumps = 0
+        self._spike_z = float(spike_zscore)
+        self._spike_min = int(spike_min_history)
+        self._spike_window = int(spike_window)
+        self._run = 0        # fit generation (see new_run)
+
+    def new_run(self) -> None:
+        """Mark a fit boundary. The ring deliberately persists across
+        fits (the flight-recorder continuity that makes postmortems
+        useful), but the loss-SPIKE baseline must not: a new task/
+        dataset whose healthy initial loss sits far from the previous
+        run's converged median would otherwise z-score as a spike on
+        its very first windows. Records are stamped with the run
+        generation and the spike history reads only the current one."""
+        with self._lock:
+            self._run += 1
+
+    # -- spike detection ---------------------------------------------------
+    def _run_losses(self) -> List[float]:
+        """The current run's finite losses in ring order — the spike
+        baseline. Built ONCE per flush (record_window extends it
+        incrementally as the window's records ring), so a big epoch-tail
+        window costs O(window + ring), not O(window × ring)."""
+        with self._lock:
+            return [r["loss"] for r in self._ring
+                    if r.get("run") == self._run
+                    and math.isfinite(r.get("loss", math.nan))]
+
+    def _spike_z_of(self, loss: float,
+                    hist: List[float]) -> Optional[float]:
+        """Robust z-score of ``loss`` against the recent FINITE losses
+        in ``hist``: ``|x - median| / max(1.4826 * MAD, floor)``. The
+        floor (1e-3 of the median's magnitude) keeps a perfectly flat
+        loss history from turning any wiggle into an infinite score
+        while still letting a genuine jump off a plateau register."""
+        if not math.isfinite(loss):
+            return None
+        hist = hist[-self._spike_window:]
+        if len(hist) < self._spike_min:
+            return None
+        med = statistics.median(hist)
+        mad = statistics.median([abs(x - med) for x in hist])
+        scale = max(1.4826 * mad, 1e-3 * max(1.0, abs(med)))
+        return abs(loss - med) / scale
+
+    # -- the per-flush entry point -----------------------------------------
+    def record_window(self, entries: Sequence[Tuple[int, np.ndarray]],
+                      layout: AuditLayout, *, mode: str = "record",
+                      lr: Optional[float] = None,
+                      scaler: Optional[dict] = None,
+                      retrace_delta: int = 0,
+                      ledger_bytes: Optional[int] = None,
+                      context: Optional[dict] = None) -> Dict[str, Any]:
+        """Ingest one flush window's decoded audits: feed the monitor,
+        ring the per-step records, detect anomalies, and apply the
+        policy. ``entries`` is ``[(global_step, numpy audit vector)]``
+        in step order — ALREADY fetched by the caller (this module
+        never syncs; the ``numerics-host-sync`` lint rule holds it to
+        that).
+
+        Returns the flush-log update (``grad_norm``, plus
+        ``loss_scale`` when a scaler is active) for the ProgBar.
+        Raises :class:`NumericsError` only in ``halt`` mode on a
+        nonfinite step, AFTER the postmortem dump; a loss spike warns
+        and dumps but never raises, and every other internal failure is
+        the caller's to absorb."""
+        anomalies: List[dict] = []
+        last: Optional[dict] = None
+        hist = self._run_losses()
+        for step, vec in entries:
+            rec = decode_audit(vec, layout)
+            rec["step"] = int(step)
+            rec["run"] = self._run
+            if lr is not None:
+                rec["lr"] = float(lr)
+            if scaler is not None:
+                rec["scaler"] = dict(scaler)
+            # window-level context rides on every record of the window:
+            # retraces since the last flush and the HBM-ledger watermark
+            rec["retrace_delta"] = int(retrace_delta)
+            if ledger_bytes is not None:
+                rec["ledger_bytes"] = int(ledger_bytes)
+            if math.isfinite(rec["grad_norm"]):
+                stat_observe("hapi/grad_norm", rec["grad_norm"])
+            if math.isfinite(rec["update_ratio"]):
+                stat_observe("hapi/update_ratio", rec["update_ratio"])
+            if math.isfinite(rec["clip_ratio"]):
+                stat_observe("hapi/grad_clip_ratio", rec["clip_ratio"])
+            if not rec["finite"]:
+                stat_add("hapi/nonfinite_steps")
+                anomalies.append({
+                    "kind": "nonfinite", "step": rec["step"],
+                    "loss_finite": rec["loss_finite"],
+                    "grads_finite": rec["grads_finite"],
+                    "update_finite": rec["update_finite"],
+                    "blamed_groups": sorted(rec["nonfinite_groups"]),
+                    "nonfinite_counts": rec["nonfinite_groups"],
+                })
+            else:
+                z = self._spike_z_of(rec["loss"], hist)
+                if z is not None and z >= self._spike_z:
+                    stat_add("hapi/loss_spikes")
+                    anomalies.append({
+                        "kind": "loss_spike", "step": rec["step"],
+                        "loss": rec["loss"], "zscore": round(z, 2),
+                    })
+            with self._lock:
+                self._ring.append(rec)
+                self.steps_recorded += 1
+            if math.isfinite(rec["loss"]):
+                hist.append(rec["loss"])
+            last = rec
+        logs: Dict[str, Any] = {}
+        if last is not None:
+            logs["grad_norm"] = last["grad_norm"]
+            if scaler is not None:
+                logs["loss_scale"] = float(scaler.get("scale", 0.0))
+        if not anomalies:
+            return logs
+        with self._lock:
+            for a in anomalies:
+                self._anomalies.append(a)
+                self.anomalies_recorded += 1
+        if mode in ("warn", "halt"):
+            hard = [a for a in anomalies if a["kind"] == "nonfinite"]
+            lead = hard[0] if hard else anomalies[0]
+            path = self.postmortem(lead, context=context)
+            if mode == "halt" and hard:
+                blamed = hard[0]["blamed_groups"] or "loss/update only"
+                raise NumericsError(
+                    f"nonfinite training numerics at step "
+                    f"{hard[0]['step']} (blamed layer groups: {blamed}); "
+                    f"anomaly postmortem: {path}")
+            warnings.warn(
+                f"training numerics anomaly: {lead} "
+                f"(postmortem: {path})", RuntimeWarning, stacklevel=3)
+        return logs
+
+    # -- readers -----------------------------------------------------------
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "records": [dict(r) for r in self._ring],
+                "anomalies": [dict(a) for a in self._anomalies],
+                "steps_recorded": self.steps_recorded,
+                "anomalies_recorded": self.anomalies_recorded,
+                "ring_capacity": self._ring.maxlen,
+            }
+
+    def anomaly_list(self) -> List[dict]:
+        with self._lock:
+            return [dict(a) for a in self._anomalies]
+
+    # -- postmortem --------------------------------------------------------
+    def postmortem(self, anomaly: Optional[dict] = None,
+                   path: Optional[str] = None,
+                   context: Optional[dict] = None) -> Optional[str]:
+        """Dump the numerics picture: the ring tail, the anomaly and its
+        blamed layer groups, the active GradScaler state, a monitor
+        snapshot (``hapi/``/``amp/``/``dispatch/`` counters plus the
+        numerics histograms), and the path of a PR-7 MEMORY postmortem
+        dumped alongside (profiler/memory.py — ledger, timeline,
+        largest live arrays). Best effort and NEVER raises — it runs
+        inside the flush's failure handling, and a broken disk must not
+        replace the numerics error with an IO one. Returns the file
+        path (``None`` on failure)."""
+        try:
+            mem_path = _memory.oom_postmortem(
+                None, extra={"phase": "numerics",
+                             "anomaly_step":
+                                 (anomaly or {}).get("step")})
+            hist_names = ("hapi/grad_norm", "hapi/update_ratio",
+                          "hapi/grad_clip_ratio", "hapi/step_time_ms",
+                          "hapi/host_sync_ms", "amp/loss_scale")
+            with self._lock:
+                ring = [dict(r) for r in self._ring]
+                anoms = [dict(a) for a in self._anomalies]
+            scaler = ring[-1].get("scaler") if ring else None
+            doc: Dict[str, Any] = {
+                "reason": "numerics anomaly" if anomaly is not None
+                          else "requested",
+                "anomaly": anomaly,
+                # the full anomaly ring: once NaN propagates, every
+                # later window re-dumps with ITS anomaly — the ORIGIN
+                # (the first nonfinite step) must stay in the artifact
+                "anomalies": anoms,
+                "blamed_groups": (anomaly or {}).get("blamed_groups"),
+                "dumped_at": time.time(),
+                "ring": ring,
+                "scaler": scaler,
+                "monitor": {
+                    "counters": {k: v for k, v in all_stats().items()
+                                 if k.startswith(("hapi/", "amp/",
+                                                  "dispatch/"))},
+                    "histograms": {n: stat_histogram(n)
+                                   for n in hist_names
+                                   if stat_histogram(n) is not None},
+                },
+                "memory_postmortem": mem_path,
+            }
+            if context:
+                doc["context"] = context
+            if path is None:
+                path = os.path.join(
+                    tempfile.gettempdir(),
+                    f"paddle_numerics_postmortem_{os.getpid()}_"
+                    f"{id(self):x}.json")
+            d = os.path.dirname(os.path.abspath(path))
+            os.makedirs(d, exist_ok=True)
+            with open(path, "w") as f:
+                json.dump(doc, f, default=repr)
+            with self._lock:
+                self.last_dump_path = path
+                self.dumps += 1
+            stat_add("hapi/numerics_postmortem")
+            return path
+        except Exception:                                # noqa: BLE001
+            return None
+
+    def __repr__(self):
+        with self._lock:
+            return (f"<NumericsRecorder steps={len(self._ring)}/"
+                    f"{self.steps_recorded} anomalies="
+                    f"{self.anomalies_recorded} dumps={self.dumps}>")

@@ -367,6 +367,10 @@ class GenerationEngine:
             "slot_utilization": pool.n_active / pool.num_slots,
             "preempts": self._sched.preempts,
             "requests_retired": self._sched.recorder.retired,
+            # serving numerics sentinel (scheduler._note_nonfinite):
+            # decode cycles whose logits carried a NaN/Inf — the flag
+            # rides the existing per-cycle token fetch, zero extra syncs
+            "nonfinite_cycles": self._sched.nonfinite_cycles,
         }
         # per-ENGINE latency percentiles, derived from this engine's own
         # retired request traces — the process-global serving/ttft_ms

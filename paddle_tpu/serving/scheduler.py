@@ -284,6 +284,11 @@ class Scheduler:
         self._chunked = do_chunked_step is not None
         self.prefill_chunks = 0          # chunk launches fed (slot-cycles)
         self.chunk_tokens = 0            # prompt tokens fed via chunks
+        # serving numerics sentinel: decode steps append a logits-finite
+        # flag past the token row (models/generation.py), riding the one
+        # windowed _fetch — cycles whose logits went NaN/Inf are counted
+        # here and flagged in the flight-recorder cycle record
+        self.nonfinite_cycles = 0
         # always-on postmortem telemetry: bounded cycle/event rings +
         # the per-engine TTFT/TPOT reservoirs stats() reads
         self.recorder = recorder if recorder is not None \
@@ -434,6 +439,22 @@ class Scheduler:
                             "cycle": self._cycle,
                             "flight_recorder":
                                 self.recorder.last_dump_path})
+
+    def _note_nonfinite(self, toks, rec) -> None:
+        """Read the decode step's logits-finite sentinel off the fetched
+        token row (element ``[num_slots]``; absent from mock/legacy
+        decodes that return exactly ``num_slots`` tokens). A tripped
+        flag marks the cycle record and counts
+        ``serving/nonfinite_cycles`` — the tokens themselves still flow
+        (an argmax over NaN logits is garbage, not a crash), so the
+        loop survives and the operator sees WHY the output went bad."""
+        S = self._pool.num_slots
+        shape = getattr(toks, "shape", None)
+        if shape and shape[0] > S and bool(toks[S]):
+            self.nonfinite_cycles += 1
+            stat_add("serving/nonfinite_cycles")
+            if rec is not None:
+                rec["nonfinite"] = True
 
     def note_decode_flops(self, flops: float) -> None:
         """Record the FLOPs of the decode program dispatched THIS cycle
@@ -749,6 +770,7 @@ class Scheduler:
         if rec is not None:
             rec["decode_dispatch_ms"] += (t1 - t0) * 1e3
             rec["fetch_ms"] += (t2 - t1) * 1e3
+        self._note_nonfinite(toks, rec)
         dt = t2 - t0
         emitted = 0
         now = time.perf_counter()
@@ -816,6 +838,7 @@ class Scheduler:
         if rec is not None:
             rec["decode_dispatch_ms"] += (t1 - t0) * 1e3
             rec["fetch_ms"] += (t2 - t1) * 1e3
+        self._note_nonfinite(toks, rec)
         dt = t2 - t0
         emitted = 0
         chunks = 0

@@ -131,3 +131,21 @@ def test_dry_run_emits_metrics_summary():
     assert "compile/ms" in res.stderr
     assert "hapi/mfu" in res.stderr
     assert "hapi/flops_per_sec" in res.stderr
+    # ISSUE-10 training numerics health: the clean numerics='record'
+    # fit left the gradient telemetry live (hapi/grad_norm +
+    # hapi/grad_clip_ratio) with ZERO additional compiled programs on a
+    # warm re-fit (the audit is fused into the donated step, asserted
+    # via the PR-7 registry compile/count), the injected-inf warn run
+    # tripped the NaN/Inf sentinel at the exact step within one flush
+    # window with a round-tripping anomaly postmortem JSON, and
+    # hapi/host_sync stayed at the PR-2 windowed budget throughout
+    assert out["checks"]["numerics_sentinel"] is True, out
+    assert out["checks"]["numerics_postmortem"] is True, out
+    assert out["checks"]["numerics_sync_budget"] is True, out
+    assert out["checks"]["numerics_zero_extra_programs"] is True, out
+    assert out["checks"]["numerics_grad_norm_live"] is True, out
+    num = out["numerics"]
+    assert num["anomaly_step"] == num["inject_step"], num
+    assert num["nonfinite_steps"] > 0, num
+    assert "hapi/grad_norm" in res.stderr
+    assert "hapi/nonfinite_steps" in res.stderr

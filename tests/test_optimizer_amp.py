@@ -258,6 +258,49 @@ class TestAmp:
         assert scaler.get_loss_scaling() == 8.0  # 16 halved on inf
         np.testing.assert_allclose(model.weight.numpy(), w_before)
 
+    def test_grad_scaler_observable_and_state_roundtrip(self):
+        # ISSUE-10 satellite: update() observes amp/loss_scale +
+        # amp/found_inf, state() snapshots the machine for the numerics
+        # flight recorder, and a state_dict round-trip PINS the good/
+        # bad-step counters (a restored scaler must resume its streaks,
+        # not restart them)
+        from paddle_tpu.framework import monitor
+        inf_before = monitor.stat_get("amp/found_inf")
+        scaler = paddle.amp.GradScaler(
+            enable=True, init_loss_scaling=32.0, incr_every_n_steps=3,
+            decr_every_n_nan_or_inf=2)
+        assert paddle.amp.active_scaler() is scaler
+        scaler._found_inf = True
+        scaler.update()                      # 1st inf: streak, no halve
+        assert monitor.stat_get("amp/found_inf") - inf_before == 1
+        scaler._found_inf = False
+        scaler.update()                      # finite: good streak = 1
+        hist = monitor.stat_histogram("amp/loss_scale")
+        assert hist is not None and hist["max"] >= 32.0
+        st = scaler.state()
+        assert st["scale"] == 32.0 and st["good_steps"] == 1 \
+            and st["bad_steps"] == 0 and st["enabled"]
+        # round-trip: counters survive (incr_count/decr_count pinned)
+        scaler._found_inf = True
+        scaler.update()                      # bad streak = 1 again
+        saved = scaler.state_dict()
+        restored = paddle.amp.GradScaler(
+            enable=True, init_loss_scaling=2.0, incr_every_n_steps=3,
+            decr_every_n_nan_or_inf=2)
+        restored.load_state_dict(saved)
+        assert restored.get_loss_scaling() == 32.0
+        assert restored._good_steps == saved["incr_count"] == 0
+        assert restored._bad_steps == saved["decr_count"] == 1
+        # one more inf on the RESTORED scaler completes the streak of 2
+        restored._found_inf = True
+        restored.update()
+        assert restored.get_loss_scaling() == 16.0
+        # construction registers the newest ENABLED scaler as active; a
+        # disabled (bf16 pass-through) one never takes the slot
+        assert paddle.amp.active_scaler() is restored
+        paddle.amp.GradScaler(enable=False)
+        assert paddle.amp.active_scaler() is restored
+
 
 class TestAutograd:
     def test_paddle_grad(self):

@@ -104,6 +104,32 @@ def test_memory_stats_hot_path_rule():
     assert [f.line for f in out] == [4]
 
 
+def test_numerics_host_sync_rule():
+    # the numerics audit module must never sync: its whole point is
+    # replacing the reference's per-op host sweep with audits fetched
+    # only at fit's flush windows — device_get/.item()/.numpy()/
+    # .block_until_ready anywhere in profiler/numerics.py is the bug
+    # class the rule exists to catch
+    src = ("import jax\n"
+           "def flush(x):\n"
+           "    a = jax.device_get(x)\n"          # flagged
+           "    b = x.item()\n"                   # flagged
+           "    c = x.numpy()\n"                  # flagged
+           "    d = jax.block_until_ready(x)\n"   # flagged
+           "    return a, b, c, d\n")
+    out = lint_source("t.py", src, "profiler/numerics.py")
+    assert [f.rule for f in out] == ["numerics-host-sync"] * 4
+    assert [f.line for f in out] == [3, 4, 5, 6]
+    # the fetch site itself (hapi/model.py np.asarray at the flush) and
+    # the rest of the profiler package are out of the rule's scope
+    assert lint_source("t.py", src, "profiler/span.py") == []
+    assert lint_source("t.py", src, "profiler/memory.py") == []
+    # an argued suppression is honored, like every other rule
+    sup = src.replace("x.item()", "x.item()  # lint: ok")
+    out = lint_source("t.py", sup, "profiler/numerics.py")
+    assert [f.line for f in out] == [3, 5, 6]
+
+
 def test_pallas_block_tiling_rule():
     """The BENCH_r02 bug class as a standing static check: a literal
     BlockSpec dim that violates the Mosaic (8, 128) rule is flagged in
