@@ -676,6 +676,108 @@ def bench_attn():
     return out
 
 
+def bench_zero():
+    """Replicated vs ZeRO-sharded donated train step (``--bench-zero``):
+    the same Adam fit through ``fit(zero=0)`` and ``fit(zero=1)`` (plus
+    ``grad_comm='int8'``) on a dp=4 mesh, reporting per-step wall ms
+    and — from the PR-7 HBM ledger — per-replica train-state bytes.
+    The memory claim IS the gate: the sharded run must report
+    opt-state bytes at ~1/dp of the replicated run (stripe padding
+    allowed), and the trained params must stay allclose-identical, or
+    this bench raises instead of publishing a number. Runs at
+    ``--xla_force_host_platform_device_count=4`` on CPU (the child env
+    forces it) so the mechanism is measurable every round; on real
+    multi-chip backends the same code paths ride ICI."""
+    import numpy as np
+    import jax
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.distributed import env as denv
+    from paddle_tpu.io import TensorDataset
+    from paddle_tpu.profiler import memory as _memory
+
+    pallas_state = _setup_pallas()
+    if len(jax.devices()) < 4:
+        raise RuntimeError(
+            f"bench_zero needs >= 4 devices (have {len(jax.devices())}); "
+            f"set XLA_FLAGS=--xla_force_host_platform_device_count=4")
+    dp = 4
+    denv.build_mesh({"dp": dp})
+    batch, d, hidden, classes = 256, 256, 512, 16
+    rng = np.random.RandomState(0)
+    xs = rng.randn(batch, d).astype(np.float32)
+    ys = rng.randint(0, classes, (batch, 1)).astype(np.int64)
+    data = TensorDataset([xs, ys])
+
+    def make():
+        paddle.framework.random.seed(0)
+        net = nn.Sequential(nn.Linear(d, hidden), nn.ReLU(),
+                            nn.Linear(hidden, hidden), nn.ReLU(),
+                            nn.Linear(hidden, classes))
+        m = paddle.Model(net)
+        m.prepare(paddle.optimizer.Adam(learning_rate=1e-3,
+                                        parameters=net.parameters()),
+                  nn.CrossEntropyLoss())
+        return m
+
+    n_warm, n_steps = (1, 3) if _smoke() else (4, 30)
+
+    def run(zero, grad_comm="fp32"):
+        m = make()
+        # one short fit arms the mode (shards the opt state, compiles
+        # the donated step); the timed region then measures warm steps
+        m.fit(data, batch_size=batch, epochs=1, log_freq=1,
+              shuffle=False, verbose=0, zero=zero, grad_comm=grad_comm)
+        dt, last = _timeit_async(
+            lambda: m.train_batch([xs], [ys], return_numpy=False),
+            n_warm, n_steps)
+        m._update_memory_ledger()
+        led = _memory.ledger()
+        base = m._ledger_base
+        return m, {"step_ms": round(dt / n_steps * 1e3, 3),
+                   "opt_state_bytes_per_replica":
+                       led.get(f"{base}/opt_state"),
+                   "params_bytes": led.get(f"{base}/params"),
+                   "loss": round(last, 4)}
+
+    m_rep, rep = run(0)
+    m_zero, z = run(1)
+    m_int8, z8 = run(1, "int8")
+    # tolerance sized to Adam's eps-sensitivity: near-zero gradients
+    # amplify the exchange's summation-order noise (~1e-7 relative on
+    # the grad) into ~1e-5 absolute on the first update — bounded
+    # noise, not divergence; real layout corruption is orders beyond
+    parity = all(np.allclose(np.asarray(m_rep._params[k]),
+                             np.asarray(m_zero._params[k]),
+                             rtol=1e-3, atol=1e-4)
+                 for k in m_rep._params)
+    shrink = rep["opt_state_bytes_per_replica"] / max(
+        1, z["opt_state_bytes_per_replica"])
+    # the int8 leg is gated too: quantized but still the same training
+    # run — finite loss and bounded drift vs the replicated params (a
+    # broken scale alignment must not publish a plausible step_ms)
+    int8_drift = max(
+        float(np.max(np.abs(np.asarray(m_rep._params[k])
+                            - np.asarray(m_int8._params[k]))))
+        for k in m_rep._params)
+    z8["drift_vs_replicated"] = round(int8_drift, 5)
+    int8_ok = np.isfinite(z8["loss"]) and int8_drift < 0.05
+    # the win must be real: ~1/dp per-replica opt state (half counts as
+    # failed — padding can only cost one stripe) and identical training
+    if not parity or shrink < dp / 2 or not int8_ok:
+        raise RuntimeError(
+            f"zero bench invalid: parity={parity} "
+            f"opt_state_shrink={shrink:.2f} (expected ~{dp}x) "
+            f"int8_drift={int8_drift:.4f} int8_loss={z8['loss']}")
+    return {"metric": "zero_sharded_step_ms", "value": z["step_ms"],
+            "unit": "ms", "dp": dp, "parity": parity,
+            "replicated": rep, "zero": z, "zero_int8": z8,
+            "opt_state_shrink": round(shrink, 2),
+            "step_ms_vs_replicated": round(
+                z["step_ms"] / max(1e-9, rep["step_ms"]), 3),
+            "device_kind": _device_kind(), **pallas_state}
+
+
 def jax_backend_is_cpu():
     import jax
     return jax.default_backend() == "cpu"
@@ -708,7 +810,7 @@ BENCHES = {"gpt2": bench_gpt2, "resnet50": bench_resnet50,
            "resnet50_pipeline": bench_resnet50_pipeline,
            "eager": bench_eager, "serve": bench_serve,
            "gpt2_decode": bench_gpt2_decode, "attn": bench_attn,
-           "probe": bench_probe}
+           "zero": bench_zero, "probe": bench_probe}
 
 
 # ---------------------------------------------------------------------------
@@ -1185,6 +1287,15 @@ def _run_child(name: str, timeout: float, force_cpu: bool = False,
         env["PADDLE_BENCH_SMOKE"] = "1"
     if no_pallas:
         env["PADDLE_BENCH_NO_PALLAS"] = "1"
+    if name == "zero":
+        # the ZeRO microbench needs a dp=4 mesh; on CPU that means
+        # forcing host platform devices BEFORE jax initializes (no-op
+        # for real multi-chip backends, which ignore the CPU knob)
+        flags = env.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            env["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=4"
+            ).strip()
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--child", name],
@@ -1393,6 +1504,14 @@ def main():
         extra = _run_child("attn", timeout=child_timeout())
         if "error" not in extra:
             results["attn"] = extra
+            _emit(results)
+    if remaining() > 90:
+        # replicated-vs-ZeRO donated train step + per-replica
+        # train-state bytes (dp=4 CPU mesh — mechanism + memory gate,
+        # reproducible every round regardless of the TPU pool)
+        extra = _run_child("zero", timeout=child_timeout())
+        if "error" not in extra:
+            results["zero"] = extra
             _emit(results)
     if not _smoke():
         for name in ("gpt2", "bert"):
@@ -1819,6 +1938,62 @@ def dry_run():
         host_syncs = monitor.stat_get("hapi/host_sync")
         numerics_canary = _numerics_canary()
 
+        # ZeRO canary (ISSUE-11): on a dp=4 mesh, fit(zero=1) must
+        # train allclose-identical params to the replicated donated
+        # step AND the PR-7 ledger must bill per-replica opt-state
+        # bytes at ~1/dp (one stripe of padding allowed). Skipped —
+        # reported, not failed — when fewer than 4 devices are visible
+        # (the tier-1 conftest forces 8 host devices, so CI always
+        # exercises it).
+        def _zero_canary():
+            import jax
+            if len(jax.devices()) < 4:
+                return {"skipped": True, "parity": True,
+                        "ledger_ok": True, "opt_bytes": None,
+                        "replicated_opt_bytes": None}
+            from paddle_tpu.distributed import env as denv
+            from paddle_tpu.hapi import zero as zmod
+            mesh_before = denv.get_mesh()
+            denv.build_mesh({"dp": 4})
+            try:
+                def mk():
+                    paddle.framework.random.seed(0)
+                    netz = nn.Sequential(nn.Linear(16, 64), nn.ReLU(),
+                                         nn.Linear(64, 4))
+                    mm = paddle.Model(netz)
+                    mm.prepare(
+                        paddle.optimizer.Adam(
+                            learning_rate=1e-3,
+                            parameters=netz.parameters()),
+                        nn.CrossEntropyLoss())
+                    return mm
+                dset = TensorDataset([xs, ys])
+                m_rep = mk()
+                m_rep.fit(dset, batch_size=8, epochs=1,
+                          log_freq=log_freq, shuffle=False, verbose=0)
+                m_z = mk()
+                m_z.fit(dset, batch_size=8, epochs=1,
+                        log_freq=log_freq, shuffle=False, verbose=0,
+                        zero=1)
+                parity = all(
+                    np.allclose(np.asarray(m_rep._params[k]),
+                                np.asarray(m_z._params[k]),
+                                rtol=1e-5, atol=1e-6)
+                    for k in m_rep._params)
+                led = _memory.ledger()
+                rep_b = led.get(f"{m_rep._ledger_base}/opt_state", 0)
+                z_b = led.get(f"{m_z._ledger_base}/opt_state", 0)
+                n_slots = len(m_z._optimizer._slot_names)
+                bound = rep_b // 4 + n_slots * zmod.QUANT_CHUNK * 4 + 64
+                return {"skipped": False, "parity": parity,
+                        "ledger_ok": 0 < z_b <= bound,
+                        "opt_bytes": z_b,
+                        "replicated_opt_bytes": rep_b}
+            finally:
+                denv.set_mesh(mesh_before)
+
+        zero_canary = _zero_canary()
+
     # ISSUE-7: the bench regression gate, exercised the way the driver
     # would use it — a seeded artifact vs a doctored copy with a 20%
     # throughput loss and a 40% latency blowup must exit nonzero
@@ -1981,6 +2156,10 @@ def dry_run():
         "numerics_zero_extra_programs":
             numerics_canary["zero_extra_programs"],
         "numerics_grad_norm_live": numerics_canary["grad_norm_live"],
+        # fit(zero=1): dp=4 parity with the replicated step + the
+        # ledger's ~1/dp per-replica opt-state bytes
+        "zero_parity": zero_canary["parity"],
+        "zero_opt_state_sharded": zero_canary["ledger_ok"],
     }
     print(monitor.stats_summary(), file=sys.stderr)
     for f in lint_findings:
@@ -2027,6 +2206,7 @@ def dry_run():
                           "nonfinite_steps":
                               monitor.stat_get("hapi/nonfinite_steps"),
                       },
+                      "zero": zero_canary,
                       "compile_count":
                           int(monitor.stat_get("compile/count")),
                       "hapi_mfu": (monitor.stat_histogram("hapi/mfu")
@@ -2057,6 +2237,11 @@ if __name__ == "__main__":
         # standalone gather-vs-fused microbench: one JSON line, same
         # schema as the child result that lands in the round artifact
         print("RESULT " + json.dumps(bench_attn()))
+    elif "--bench-zero" in sys.argv[1:]:
+        # standalone replicated-vs-ZeRO microbench (same child schema);
+        # needs >= 4 devices — on CPU run under
+        # XLA_FLAGS=--xla_force_host_platform_device_count=4
+        print("RESULT " + json.dumps(bench_zero()))
     elif "--dry-run" in sys.argv[1:]:
         dry_run()
     else:

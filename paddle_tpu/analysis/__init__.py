@@ -4,7 +4,7 @@ The TPU-native analog of the reference's IR-pass layer
 (paddle/fluid/framework/ir): instead of pattern passes over a
 ProgramDesc graph, :func:`analyze` closed-jaxpr-traces a callable (or
 replays a captured ``paddle.static`` Program) without compiling it and
-runs registered passes over the trace. Five ship built-in:
+runs registered passes over the trace. Six ship built-in:
 
 =================  ========================================================
 host-sync          pure_callback/io_callback eqns, and ``.numpy()``/
@@ -16,6 +16,9 @@ donation-safety    donated args whose buffers are structurally unsafe
 dead-grad          params with structurally-zero cotangents still in the
                    trainable set (the optimizer decays them anyway)
 dtype-hygiene      f64 leaks; silent bf16->f32 upcasts in autocast regions
+collective-pairing a reduce-scatter whose axis/dimension/tiling has no
+                   matching closing all-gather (the ZeRO sharded-update
+                   loop left open or permuted)
 recompile-churn    why retraces fired (shape/dtype/static-arg/frozen-set),
                    from the ``dispatch/retrace_cause`` trace probe
 =================  ========================================================

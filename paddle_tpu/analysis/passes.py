@@ -30,7 +30,8 @@ from .core import (AnalysisContext, Finding, eqn_source, is_structural_zero,
                    iter_eqns, register_pass)
 
 __all__ = ["host_sync_pass", "donation_safety_pass", "dead_grad_pass",
-           "dtype_hygiene_pass", "recompile_churn_pass"]
+           "dtype_hygiene_pass", "recompile_churn_pass",
+           "collective_pairing_pass"]
 
 
 # ---------------------------------------------------------------------------
@@ -287,7 +288,91 @@ def _np_leaves(args):
 
 
 # ---------------------------------------------------------------------------
-# 5. recompile-churn
+# 5. collective-pairing
+# ---------------------------------------------------------------------------
+
+def _axis_key(v):
+    return tuple(v) if isinstance(v, (tuple, list)) else (v,)
+
+
+@register_pass("collective-pairing")
+def collective_pairing_pass(ctx: AnalysisContext) -> List[Finding]:
+    """Reduce-scatter / all-gather pairing over the traced program.
+
+    The ZeRO-sharded weight update's contract is a closed loop:
+    gradients reduce-scatter over a mesh axis into 1/dp stripes, and
+    the updated stripes all-gather back over the SAME axis and
+    dimension with the SAME tiling. A reduce-scatter whose (axis,
+    dimension, tiled) triple has no matching all-gather leaves the
+    caller holding a shard it will treat as the full value — the
+    sharded analog of a donated invar with no rebind target — and a
+    gather on a DIFFERENT axis/dimension re-assembles the stripes in
+    the wrong order (silently permuted parameters). psum-only programs
+    (plain data-parallel grad sync) never trip this: the pass only
+    speaks when reduce_scatter eqns exist."""
+    out: List[Finding] = []
+    if ctx.closed_jaxpr is None:
+        return out
+    # program order matters: an all-gather can only CLOSE a
+    # reduce-scatter that precedes it (iter_eqns yields eqns in
+    # program order) — an unrelated gather at the top of the step must
+    # not be consumed as the match for a later unclosed scatter
+    rs, ag = [], []
+    for pos, eqn in enumerate(iter_eqns(ctx.closed_jaxpr)):
+        name = eqn.primitive.name
+        if name == "reduce_scatter":
+            rs.append((pos, eqn))
+        elif name == "all_gather":
+            ag.append((pos, eqn))
+    if not rs:
+        return out
+
+    def _ag_key(e):
+        return (_axis_key(e.params.get("axis_name")),
+                int(e.params.get("all_gather_dimension", 0)),
+                bool(e.params.get("tiled", False)))
+
+    unconsumed = list(ag)  # (pos, eqn), program order
+    for rs_pos, e in rs:
+        key = (_axis_key(e.params.get("axis_name")),
+               int(e.params.get("scatter_dimension", 0)),
+               bool(e.params.get("tiled", False)))
+        match = next((i for i, (p, g) in enumerate(unconsumed)
+                      if p > rs_pos and _ag_key(g) == key), None)
+        if match is not None:
+            unconsumed.pop(match)
+            continue
+        axis, dim, tiled = key
+        same_axis = [
+            _ag_key(g) for p, g in unconsumed
+            if p > rs_pos and _ag_key(g)[0] == axis]
+        if same_axis:
+            have = ", ".join(f"dim={k[1]} tiled={k[2]}"
+                             for k in same_axis)
+            msg = (f"reduce-scatter over axis {axis} (dim={dim}, "
+                   f"tiled={tiled}) does not match its closing "
+                   f"all-gather ({have}): the stripes re-assemble "
+                   f"permuted")
+        else:
+            msg = (f"reduce-scatter over axis {axis} (dim={dim}, "
+                   f"tiled={tiled}) has no closing all-gather on that "
+                   f"axis: downstream code holds a 1/N shard where it "
+                   f"expects the full value")
+        out.append(Finding(
+            pass_id="collective-pairing", severity="error",
+            message=msg, source=eqn_source(e),
+            primitive="reduce_scatter",
+            fix_hint=("close the sharded region with all_gather_in_axis "
+                      "over the same axis/dimension/tiling, or keep the "
+                      "value sharded on purpose via an explicit "
+                      "out_spec (then psum_scatter is not the right "
+                      "primitive to lint — wrap it outside the "
+                      "analyzed step)")))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# 6. recompile-churn
 # ---------------------------------------------------------------------------
 
 # thresholds. Op-level sites ("op/<name>") legitimately trace once per

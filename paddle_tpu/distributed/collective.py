@@ -161,6 +161,11 @@ def _traced(kind: str, *tensors):
     stat_add(f"collective_count/{kind}")
     if n:
         stat_add("collective_bytes", n)
+        # per-kind bytes: lets a caller prove a SPECIFIC exchange got
+        # cheaper (the ZeRO int8 gradient path moves the reduce-scatter
+        # payload onto all_to_all at 1/4 the bytes while the param
+        # all-gather stays f32 — only per-kind counters can show that)
+        stat_add(f"collective_bytes/{kind}", n)
 
 
 # ---------------------------------------------------------------------------

@@ -121,6 +121,20 @@ define_flag("FLAGS_numerics", "",
             "histograms, the training flight recorder and the anomaly "
             "postmortem (profiler/numerics.py). Empty defers to "
             "FLAGS_check_nan_inf (set -> 'halt'), else 'off'")
+define_flag("FLAGS_zero_stage", 0,
+            "Default Model.fit(zero=) stage: 1 shards the optimizer "
+            "state and the weight update across the data-parallel mesh "
+            "axis inside the donated train step (reduce-scatter grads "
+            "-> shard-local update -> all-gather params, hapi/zero.py; "
+            "arXiv 2004.13336), cutting per-replica train-state HBM "
+            "~dp-fold; 0 keeps the replicated step. Env-seeded: "
+            "FLAGS_zero_stage=1")
+define_flag("FLAGS_grad_comm", "fp32",
+            "Default Model.fit(grad_comm=) gradient-exchange precision "
+            "for the ZeRO-sharded step: 'int8' runs an EQuARX-style "
+            "quantized reduce-scatter (per-chunk max-abs scales "
+            "computed in-step, ~4x fewer wire bytes), 'fp32' the exact "
+            "exchange. Ignored unless zero sharding is armed")
 define_flag("FLAGS_hapi_prefetch", True,
             "Route Model.fit/evaluate input through io.device_prefetch "
             "(background H2D overlapping compute); the escape hatch for "

@@ -35,6 +35,7 @@ from ..profiler import span as _prof
 from ..io import DataLoader, Dataset
 from ..metric import Metric
 from ..nn.layer.layers import Layer, functional_state
+from . import zero as _zero
 from .callbacks import config_callbacks
 
 __all__ = ["Model"]
@@ -269,6 +270,20 @@ class Model:
         # value (traced scalar — same compiled program) so the sentinel
         # path is testable without NaN-crafted data
         self._numerics_inject_inf_at = None
+        # ZeRO-sharded weight update (hapi/zero.py, fit(zero=1)): the
+        # optimizer state lives dp-sharded as flat f32 stripes and the
+        # donated step runs reduce-scatter -> shard-local update ->
+        # all-gather inside a shard_map over _zero_mesh. _zero_layout
+        # is the padding map; _zero_t0 keeps per-param birth steps
+        # host-side (the flat analog of the "_t0" slot marker, baked
+        # into the step as a constant — a change always rides a
+        # frozen-set re-trace). _grad_comm picks the gradient-exchange
+        # precision ('fp32' exact | 'int8' EQuARX-style quantized).
+        self._zero_stage = 0
+        self._grad_comm = "fp32"
+        self._zero_mesh = None
+        self._zero_layout = None
+        self._zero_t0 = {}
 
     def _static(self):
         """The StaticGraphAdapter when ``paddle.enable_static()`` is on
@@ -331,6 +346,19 @@ class Model:
         # params start from zeroed slots, newly-frozen ones are dropped.
         frozen = {name for name, p in self._bind_params
                   if p.stop_gradient}
+        # sharded opt state (fit(zero=1)) converts back to the named
+        # layout whenever the code below must reconcile it per param —
+        # a frozen-set flip, a zero->replicated switch, or a layout no
+        # longer matching the trainable tree; otherwise the stripes
+        # stay on device untouched (re-fits never round-trip state)
+        if self._opt_state is not None and \
+                _zero.is_sharded_state(self._opt_state):
+            stale = (self._zero_layout is None
+                     or not self._zero_layout.compatible_with(
+                         {k: v for k, v in self._params.items()
+                          if k not in frozen}))
+            if not self._zero_stage or frozen != self._frozen or stale:
+                self._opt_state = self._zero_gather_named()
         if self._frozen is not None and frozen != self._frozen:
             # invalidate the step; when the rebuilt step re-traces, the
             # hapi/train_step probe site diffs its static frozen_set
@@ -403,6 +431,79 @@ class Model:
             step = int(getattr(self._optimizer, "_step_count", 0))
             if step and (any_restored or not self._optimizer._slot_names):
                 self._opt_state["step"] = jnp.asarray(step, jnp.int32)
+        if self._zero_stage and self._optimizer is not None and \
+                self._opt_state is not None and \
+                not _zero.is_sharded_state(self._opt_state):
+            self._arm_zero()
+
+    def _zero_validate(self):
+        """fit(zero=1) compatibility gate — reject configurations the
+        flat stripe update cannot express, with the fix in the
+        message, instead of training silently-wrong."""
+        opt = self._optimizer
+        if not getattr(opt, "_flat_rule_supported", True):
+            raise ValueError(
+                f"fit(zero=1) cannot shard {type(opt).__name__}: its "
+                f"update rule has per-parameter semantics a flat stripe "
+                f"cannot express (e.g. Lamb's trust ratio); use the "
+                f"replicated step (zero=0) or an elementwise optimizer")
+        if getattr(opt, "_multi_precision", False):
+            raise ValueError(
+                "fit(zero=1) does not keep fp32 master-weight slots "
+                "(the flat update already runs in f32 over the cast-up "
+                "params); disable multi_precision or use zero=0")
+        clip = getattr(opt, "_grad_clip", None)
+        if clip is not None:
+            from ..nn.clip import ClipGradByGlobalNorm, ClipGradByValue
+            if not isinstance(clip, (ClipGradByGlobalNorm,
+                                     ClipGradByValue)):
+                raise ValueError(
+                    f"fit(zero=1) supports ClipGradByGlobalNorm (cross-"
+                    f"shard psum norm) and ClipGradByValue (elementwise) "
+                    f"— {type(clip).__name__} clips per TENSOR, which a "
+                    f"flat stripe cannot see; use zero=0")
+
+    def _arm_zero(self):
+        """Adopt the ZeRO shard layout: resolve the dp mesh, build the
+        padding map over the trainable tree, stripe the NAMED opt state
+        onto the mesh, and land params/buffers replicated so the
+        compiled step's input shardings are stable from the first
+        dispatch. The per-param ``_t0`` birth markers move into a host
+        dict (``_zero_t0``) — they only change on frozen-set flips,
+        which re-trace anyway, so the step bakes them as a constant."""
+        self._zero_validate()
+        mesh = _zero.resolve_mesh()
+        frozen = frozenset(self._frozen or ())
+        trainable = {k: v for k, v in self._params.items()
+                     if k not in frozen}
+        layout = _zero.FlatLayout.build(
+            trainable, int(np.prod(mesh.devices.shape)))
+        named = self._opt_state
+        self._zero_t0 = {
+            name: int(np.asarray(slots["_t0"]))
+            for name, slots in named.get("slots", {}).items()
+            if "_t0" in slots}
+        self._opt_state = _zero.shard_opt_state(
+            named, layout, mesh, self._optimizer._slot_names)
+        self._zero_mesh, self._zero_layout = mesh, layout
+        rep = _zero.replicated_sharding(mesh)
+        self._params = {k: jax.device_put(v, rep)
+                        for k, v in self._params.items()}
+        self._buffers = {k: jax.device_put(v, rep)
+                         for k, v in (self._buffers or {}).items()}
+        self._rebind_network_state()
+
+    def _zero_gather_named(self):
+        """Sharded opt state -> the named {"step", "slots"} layout
+        (host gather; fit boundaries only), with the ``_t0`` markers
+        re-attached from the host map."""
+        named = _zero.gather_opt_state(
+            self._opt_state, self._zero_layout,
+            self._optimizer._slot_names)
+        for name, t0 in self._zero_t0.items():
+            if name in named["slots"]:
+                named["slots"][name]["_t0"] = jnp.asarray(t0, jnp.int32)
+        return named
 
     def _rebind_network_state(self):
         """Point the network's Tensors at the CURRENT functional state.
@@ -441,10 +542,17 @@ class Model:
         # the jitted (donated) step — without this, moments trained in
         # fit() were silently dropped from the .pdopt checkpoint
         if self._optimizer is not None and self._opt_state is not None:
+            # a dp-sharded opt state (fit(zero=1)) gathers ON DEMAND
+            # here — state_dict()/save() and the eager bridge always
+            # see the named layout, so a zero=1 checkpoint is byte-for-
+            # byte the replicated format (and restores into either)
+            state = self._zero_gather_named() \
+                if _zero.is_sharded_state(self._opt_state) \
+                else self._opt_state
             self._optimizer._slots = {
                 name: dict(slots)
-                for name, slots in self._opt_state["slots"].items()}
-            self._optimizer._step_count = int(self._opt_state["step"])
+                for name, slots in state["slots"].items()}
+            self._optimizer._step_count = int(state["step"])
             # bridge for a later eager opt.step(): Parameter.name ->
             # tree name, so _ensure_slots migrates these entries instead
             # of restarting from zeros (see Optimizer._ensure_slots)
@@ -471,6 +579,8 @@ class Model:
 
     @_prof.record("hapi/build_train_step", "hapi")
     def _build_train_step(self):
+        if self._zero_stage:
+            return self._build_zero_train_step()
         self._pallas_gate()
         net, opt = self.network, self._optimizer
         clip = getattr(opt, "_grad_clip", None)
@@ -619,6 +729,216 @@ class Model:
         # numerics armed the audit is part of THIS program — never a
         # second compile per signature (bench.py --dry-run asserts the
         # registry compile/count stays flat across a warm re-fit).
+        self._train_step_fn = _registry.aot_site(
+            probe_site.name, train_step, static_argnums=static_argnums,
+            donate_argnums=(0, 1, 2))
+
+    def _build_zero_train_step(self):
+        """The ZeRO-sharded twin of ``_build_train_step`` (fit(zero=1),
+        hapi/zero.py; arXiv 2004.13336): ONE donated compiled program
+        per signature — same argument/static/donation discipline as the
+        replicated step — whose body runs inside a ``shard_map`` over
+        the dp mesh axis. Per replica: forward+backward on the LOCAL
+        batch slice against replicated params, reduce-scatter the flat
+        gradient (f32 exact, or the EQuARX-style int8 exchange under
+        ``grad_comm='int8'``), shard-local optimizer rule over this
+        replica's 1/dp stripe of params and opt state, all-gather the
+        updated stripes back into the named tree. Losses/outs leave the
+        map as the full-batch mean / the batch-concatenated outputs, so
+        everything downstream (flush window, metrics, callbacks) is
+        layout-blind. The numerics audit, when armed, is the
+        cross-shard variant (build_audit_flat) over the POST-exchange
+        dequantized gradient — quantization corruption trips the
+        sentinel at the exact step with per-layer-group blame."""
+        self._pallas_gate()
+        self._zero_validate()
+        net, opt = self.network, self._optimizer
+        clip = getattr(opt, "_grad_clip", None)
+        frozen = frozenset(self._frozen or ())
+        mesh, layout = self._zero_mesh, self._zero_layout
+        if mesh is None or layout is None:
+            raise RuntimeError(
+                "zero train step built before the shard layout was "
+                "armed — _sync_state_from_network must run first")
+        AXIS = _zero.AXIS
+        dp, stripe = layout.dp, layout.stripe
+        grad_comm = self._grad_comm
+        from jax.sharding import PartitionSpec as P
+        from ..distributed import collective as _collective
+        from ..nn.clip import ClipGradByGlobalNorm, ClipGradByValue
+        is_global_clip = isinstance(clip, ClipGradByGlobalNorm)
+
+        audit_on = self._numerics_mode != "off"
+        self._audit_enabled = audit_on
+        alayout = None
+        group_ids = None
+        if audit_on:
+            alayout = _numerics.AuditLayout.build(
+                [k for k in (self._params or {}) if k not in frozen])
+            group_ids = layout.group_ids(alayout)
+        self._audit_layout = alayout
+        # per-param predicates baked as flat constants (they can only
+        # change alongside a re-trace): AdamW's decoupled-decay
+        # exclusion mask and the _t0 birth-step vector
+        decay_mask = None
+        if getattr(opt, "_apply_decay_param_fun", None) is not None:
+            decay_mask = layout.mask_from(
+                [n for n in layout.names if opt._wd_enabled(n)])
+        t0_vec = layout.t0_vector(self._zero_t0) if self._zero_t0 \
+            else None
+
+        probe_site = getattr(self, "_probe_site", None)
+        if probe_site is None:
+            Model._probe_seq = getattr(Model, "_probe_seq", 0) + 1
+            probe_site = self._probe_site = _probe.site(
+                f"hapi/train_step[{type(net).__name__}"
+                f"#{Model._probe_seq}]")
+
+        def _stripe_of(full, idx):
+            return jax.lax.dynamic_slice(jnp.asarray(full),
+                                         (idx * stripe,), (stripe,))
+
+        def _step(params, opt_state, buffers, key, lr, inject, n_inputs,
+                  arrays):
+            # BODY RUNS INSIDE shard_map: params/buffers/key/lr are
+            # replicated per-device views, opt_state["flat"] arrays are
+            # this replica's [stripe] slices, arrays are the local
+            # batch shard (axis 0 split dp ways)
+            idx = jax.lax.axis_index(AXIS)
+            rkey = jax.random.fold_in(key, idx)  # per-replica dropout
+            inputs = arrays[:n_inputs]
+            label_arrays = arrays[n_inputs:]
+            froz_p = {k: v for k, v in params.items() if k in frozen}
+            train_p = {k: v for k, v in params.items()
+                       if k not in frozen}
+
+            def loss_of(p):
+                with _random.rng_guard(rkey), self._maybe_amp():
+                    with functional_state(net, {**p, **froz_p},
+                                          buffers) as st:
+                        with no_grad_guard():
+                            ins = [Tensor(a, stop_gradient=True)
+                                   for a in inputs]
+                            outputs = net(*ins)
+                            labels = [Tensor(a) for a in label_arrays]
+                            loss = self._loss_tensors(outputs, labels)
+                    new_buffers = st["updated_buffers"]
+                outs = outputs if isinstance(outputs, (list, tuple)) \
+                    else [outputs]
+                loss_data = loss._data.astype(jnp.float32)
+                if audit_on:
+                    loss_data = loss_data * inject
+                return loss_data, ([o._data for o in outs], new_buffers)
+
+            (loss_val, (outs, new_buffers)), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(train_p)
+            # gradient exchange: each replica ends holding the summed
+            # 1/dp stripe it owns; /dp turns per-slice-mean grads into
+            # the exact full-batch mean (equal slices)
+            flat_g = layout.flatten(grads)
+            if grad_comm == "int8":
+                g_sum = _zero.quantized_reduce_scatter(
+                    flat_g, AXIS, dp, stripe, layout.chunk)
+            else:
+                g_sum = _collective.reduce_scatter_in_axis(flat_g, AXIS)
+            g_stripe = g_sum / jnp.float32(dp)
+            raw_stripe = g_stripe  # post-exchange, dequantized, pre-clip
+            pre_norm = post_norm = None
+            if clip is not None:
+                if is_global_clip:
+                    # the global norm needs the cross-shard psum term —
+                    # a local-stripe norm under-clips by ~sqrt(dp)
+                    pre_norm = jnp.sqrt(jax.lax.psum(
+                        jnp.sum(jnp.square(g_stripe)), AXIS))
+                    cn = jnp.float32(clip.clip_norm)
+                    g_stripe = g_stripe * (cn / jnp.maximum(pre_norm,
+                                                            cn))
+                    post_norm = jnp.minimum(pre_norm, cn)
+                else:  # ClipGradByValue: elementwise, stripe-local
+                    g_stripe = jnp.clip(g_stripe, clip.min, clip.max)
+                    if audit_on:
+                        post_norm = jnp.sqrt(jax.lax.psum(
+                            jnp.sum(jnp.square(g_stripe)), AXIS))
+            flat_p = layout.flatten(train_p)
+            p_stripe = jax.lax.dynamic_slice(flat_p, (idx * stripe,),
+                                             (stripe,))
+            step_no = opt_state["step"] + 1
+            eff = step_no if t0_vec is None \
+                else step_no - _stripe_of(t0_vec, idx)
+            mstripe = None if decay_mask is None \
+                else _stripe_of(decay_mask, idx)
+            new_stripe, new_slots = opt.flat_rule(
+                p_stripe, g_stripe, dict(opt_state["flat"]), lr, eff,
+                decay_mask=mstripe)
+            new_flat = _collective.all_gather_in_axis(
+                new_stripe.astype(jnp.float32), AXIS, tiled=True,
+                axis=0)
+            new_train = layout.unflatten(new_flat, train_p)
+            new_params = dict(params)
+            new_params.update(new_train)
+            new_buffers = _zero.replicate_buffers(new_buffers, AXIS, dp)
+            loss_full = jax.lax.pmean(loss_val, AXIS)
+            new_state = {"step": step_no, "flat": new_slots}
+            if audit_on:
+                audit = _numerics.build_audit_flat(
+                    loss_full, raw_stripe, p_stripe, new_stripe,
+                    _stripe_of(group_ids, idx), alayout, AXIS,
+                    grad_norm=pre_norm, clipped_norm=post_norm)
+                return (new_params, new_state, new_buffers, loss_full,
+                        outs, audit)
+            return new_params, new_state, new_buffers, loss_full, outs
+
+        opt_spec = {"step": P(), "flat": P(AXIS)}
+        base_in = (P(), opt_spec, P(), P(), P())
+        base_out = (P(), opt_spec, P(), P(), P(AXIS))
+
+        # check_vma=False (the shim's name for check_rep): the rep
+        # checker cannot statically prove the all-gathered params /
+        # pmean'd loss replicated, and the out_specs above ARE the
+        # contract (every P() output is produced by an explicit
+        # psum/pmean/all_gather)
+        if audit_on:
+            def train_step(params, opt_state, buffers, key, lr, inject,
+                           n_inputs, *arrays):
+                probe_site.record(
+                    _probe.sig_of(list(params.values())
+                                  + list(buffers.values())
+                                  + list(arrays)),
+                    {"n_inputs": n_inputs,
+                     "frozen_set": tuple(sorted(frozen)),
+                     "zero": (1, dp, grad_comm)})
+                sm = jax.shard_map(
+                    lambda p, o, b, k, l, i, arrs: _step(
+                        p, o, b, k, l, i, n_inputs, arrs),
+                    mesh=mesh, in_specs=base_in + (P(), P(AXIS)),
+                    out_specs=base_out + (P(),), check_vma=False)
+                return sm(params, opt_state, buffers, key, lr, inject,
+                          tuple(arrays))
+            static_argnums = (6,)
+        else:
+            def train_step(params, opt_state, buffers, key, lr,
+                           n_inputs, *arrays):
+                probe_site.record(
+                    _probe.sig_of(list(params.values())
+                                  + list(buffers.values())
+                                  + list(arrays)),
+                    {"n_inputs": n_inputs,
+                     "frozen_set": tuple(sorted(frozen)),
+                     "zero": (1, dp, grad_comm)})
+                sm = jax.shard_map(
+                    lambda p, o, b, k, l, arrs: _step(
+                        p, o, b, k, l, None, n_inputs, arrs),
+                    mesh=mesh, in_specs=base_in + (P(AXIS),),
+                    out_specs=base_out, check_vma=False)
+                return sm(params, opt_state, buffers, key, lr,
+                          tuple(arrays))
+            static_argnums = (5,)
+
+        # same donation contract as the replicated step: every donated
+        # leaf (params replicated, opt stripes dp-sharded, buffers) has
+        # a same-aval same-sharding output to alias — the
+        # donation-safety pass stays the standing guard, now through
+        # the shard_map eqn
         self._train_step_fn = _registry.aot_site(
             probe_site.name, train_step, static_argnums=static_argnums,
             donate_argnums=(0, 1, 2))
@@ -795,6 +1115,8 @@ class Model:
             self._ensure_train_built()
             ins = _as_arrays(inputs)
             lbs = _as_arrays(labels) if labels is not None else []
+            if self._zero_stage and self._zero_layout is not None:
+                self._zero_batch_guard(ins + lbs)
             loss, outs = self._dispatch_train_step(ins, lbs)
         stat_observe("hapi/step_time_ms", (time.perf_counter() - t0) * 1e3)
         return loss, outs, lbs
@@ -849,11 +1171,36 @@ class Model:
         return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
                           num_workers=num_workers, drop_last=drop_last)
 
-    def _maybe_prefetch(self, loader, prefetch, buffer_size=2):
+    def _zero_batch_guard(self, arrays):
+        """The helpful face of the zero=1 batch contract: every array's
+        axis 0 must split evenly across dp. Raised from BOTH entries —
+        the dispatch path and the prefetch producer (which would
+        otherwise surface jax's opaque 'global size of its dimension 0
+        should be divisible' from the dp-sharded device_put on a tail
+        batch)."""
+        dp = self._zero_layout.dp if self._zero_layout is not None \
+            else None
+        if not dp:
+            return
+        for a in arrays:
+            shape = getattr(a, "shape", ())
+            if shape and shape[0] % dp:
+                raise ValueError(
+                    f"fit(zero=1) splits the batch across dp={dp} "
+                    f"replicas but got axis-0 size {shape[0]}; use a "
+                    f"batch size divisible by dp (drop_last=True for "
+                    f"the tail)")
+
+    def _maybe_prefetch(self, loader, prefetch, buffer_size=2,
+                        train=False):
         """Wrap ``loader`` in io.device_prefetch unless switched off by
         the ``prefetch`` argument (None defers to FLAGS_hapi_prefetch) or
         static mode. Sharding-aware: set ``model._prefetch_sharding`` to
-        a jax.sharding.Sharding to land batches pre-sharded."""
+        a jax.sharding.Sharding to land batches pre-sharded. With the
+        ZeRO-sharded step armed (fit(zero=1)) and no explicit override,
+        TRAIN batches derive the step's own dp batch sharding — they
+        land pre-split across the mesh instead of replicated-then-
+        resharded (a gather the sharded train state never needs)."""
         from ..framework.flags import flag_value
         if loader is None or self._static() is not None:
             return loader
@@ -862,9 +1209,25 @@ class Model:
         if not prefetch:
             return loader
         from ..io import device_prefetch
-        return device_prefetch(loader,
-                               sharding=getattr(self, "_prefetch_sharding",
-                                                None),
+        sharding = getattr(self, "_prefetch_sharding", None)
+        if sharding is None and train and self._zero_stage \
+                and self._zero_mesh is not None:
+            sharding = _zero.dp_sharding(self._zero_mesh)
+
+            def _guarded(it):
+                # validate BEFORE the dp-sharded device_put: a
+                # non-divisible tail batch must fail with the
+                # drop_last=True hint, not jax's sharding error from
+                # the prefetch producer thread
+                for batch in it:
+                    arrays = batch if isinstance(batch, (list, tuple)) \
+                        else [batch]
+                    self._zero_batch_guard(
+                        [getattr(a, "_data", a) for a in arrays])
+                    yield batch
+
+            loader = _guarded(loader)
+        return device_prefetch(loader, sharding=sharding,
                                buffer_size=buffer_size)
 
     def _flush_window(self, window):
@@ -1024,15 +1387,26 @@ class Model:
                     f"{base}/buffers"]
             weakref.finalize(self, _drop_ledger_keys, keys)
         _memory.ledger_set(f"{base}/params", tree_bytes(self._params))
-        _memory.ledger_set(f"{base}/opt_state",
-                           tree_bytes(self._opt_state))
+        # the ledger records PER-REPLICA residency (what one chip
+        # holds): a dp-sharded opt state (fit(zero=1)) bills its flat
+        # stripes at 1/dp of the logical bytes — the HBM win the ZeRO
+        # rewrite exists for, proven by the same ledger that would
+        # catch it regressing
+        opt_bytes = tree_bytes(self._opt_state)
+        if self._opt_state is not None and \
+                _zero.is_sharded_state(self._opt_state) and \
+                self._zero_layout is not None:
+            flat_bytes = tree_bytes(self._opt_state.get("flat"))
+            opt_bytes = (opt_bytes - flat_bytes
+                         + flat_bytes // self._zero_layout.dp)
+        _memory.ledger_set(f"{base}/opt_state", opt_bytes)
         _memory.ledger_set(f"{base}/buffers", tree_bytes(self._buffers))
 
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
             prefetch=None, prefetch_buffer_size=2, analyze=None,
-            numerics=None):
+            numerics=None, zero=None, grad_comm=None):
         """Train over ``train_data``, asynchronously on the dygraph path:
         steps are dispatched without blocking (donated jitted step), the
         next batch's H2D transfer rides under compute via
@@ -1069,7 +1443,33 @@ class Model:
         AFTER the postmortem lands (``on_train_abort`` teardown runs).
         ``None`` defers to ``FLAGS_numerics`` /
         ``FLAGS_check_nan_inf`` (the reference flag's abort-on-NaN
-        semantics map to ``'halt'``), default ``'off'``."""
+        semantics map to ``'halt'``), default ``'off'``.
+
+        ``zero=1`` arms the ZeRO-sharded weight update (hapi/zero.py,
+        arXiv 2004.13336): the donated train step runs inside a
+        ``shard_map`` over the dp mesh axis — reduce-scatter grads,
+        shard-local optimizer over a 1/dp stripe of the (flat,
+        dp-sharded) optimizer state, all-gather updated params — one
+        compiled donated program, bit-identical training math, and
+        per-replica opt-state HBM cut ~dp-fold (the PR-7 ledger bills
+        the stripes). Optimizer state lives SHARDED between steps;
+        ``state_dict``/``save``/the eager bridge gather on demand and
+        ``load`` re-shards, so checkpoints are mode-portable. ``None``
+        defers to ``FLAGS_zero_stage`` (default 0). Batch axis 0 must
+        divide by dp, and the loss must be an equal-weight MEAN over
+        the batch (every built-in loss's default reduction): the
+        gradient exchange averages per-slice gradients, the standard
+        data-parallel contract (``paddle.DataParallel``/DDP) — a
+        ``reduction='sum'`` loss, or one whose per-sample weights
+        concentrate unevenly in a slice (``ignore_index``), follows
+        the dp-averaged semantics, not the single-process ones.
+        ``grad_comm='int8'`` additionally runs the
+        gradient exchange quantized (EQuARX-style per-chunk max-abs
+        scales computed in-step, ~4x fewer wire bytes — the
+        ``collective_bytes/*`` counters prove it), with the numerics
+        audit reading the DEQUANTIZED gradient so corruption is blamed
+        at the exact step; default ``'fp32'`` (exact), ``None`` defers
+        to ``FLAGS_grad_comm``."""
         analyze_explicit = analyze is not None
         if analyze is None:
             # flag-seeded: lenient normalization (a bad env value means
@@ -1087,6 +1487,31 @@ class Model:
             raise ValueError(
                 f"numerics must be one of {_numerics.MODES}, got "
                 f"{numerics!r}")
+        zero_explicit = zero is not None
+        if zero is None:
+            # env-seeded, leniently normalized like the sibling flags:
+            # a bad FLAGS_zero_stage value means replicated, not a
+            # crash blaming an argument that was never passed
+            from ..framework.flags import flag_value
+            try:
+                zero = 1 if int(flag_value("FLAGS_zero_stage") or 0) \
+                    >= 1 else 0
+            except (TypeError, ValueError):
+                zero = 0
+        elif zero in (0, 1, False, True):
+            zero = int(zero)
+        else:
+            raise ValueError(
+                f"zero must be 0 or 1 (ZeRO stage-1 optimizer-state "
+                f"sharding), got {zero!r}")
+        if grad_comm is None:
+            from ..framework.flags import flag_value
+            gc = str(flag_value("FLAGS_grad_comm") or "fp32").strip() \
+                .lower()
+            grad_comm = gc if gc in ("fp32", "int8") else "fp32"
+        elif grad_comm not in ("fp32", "int8"):
+            raise ValueError(
+                f"grad_comm must be 'fp32' or 'int8', got {grad_comm!r}")
         loader = self._as_loader(train_data, batch_size, shuffle,
                                  num_workers, drop_last)
         eval_loader = self._as_loader(eval_data, batch_size, False,
@@ -1128,6 +1553,17 @@ class Model:
                     "path; the static-graph executor fetches the loss "
                     "every batch already", UserWarning)
             numerics = "off"
+        if zero and not async_path:
+            # the sharded weight update lives in the DYNAMIC donated
+            # step; the static-graph executor replays a captured
+            # Program per batch
+            if zero_explicit:
+                import warnings
+                warnings.warn(
+                    "fit(zero=...) applies to the dynamic-graph path; "
+                    "the static-graph executor runs the captured "
+                    "Program unsharded", UserWarning)
+            zero = 0
         if async_path:
             # off<->on changes the step's trace (the audit output and
             # inject scalar are part of the program); record/warn/halt
@@ -1135,6 +1571,14 @@ class Model:
             if (numerics != "off") != self._audit_enabled \
                     and self._train_step_fn is not None:
                 self._train_step_fn = None
+            # a zero-stage or grad-comm flip is a different program:
+            # invalidate the step (the opt-state layout transition —
+            # shard or gather — happens in _sync_state_from_network)
+            if (zero != self._zero_stage
+                    or (zero and grad_comm != self._grad_comm)) \
+                    and self._train_step_fn is not None:
+                self._train_step_fn = None
+            self._zero_stage, self._grad_comm = zero, grad_comm
             self._numerics_mode = numerics
             self._sync_state_from_network()
             if self._train_step_fn is None:
@@ -1168,7 +1612,8 @@ class Model:
                 logs = {}
                 window = []
                 data_iter = self._maybe_prefetch(loader, prefetch,
-                                                 prefetch_buffer_size)
+                                                 prefetch_buffer_size,
+                                                 train=True)
                 for step, batch in enumerate(data_iter):
                     cbks.on_train_batch_begin(step)
                     inputs, labels = self._split_batch(batch)

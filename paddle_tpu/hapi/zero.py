@@ -1,0 +1,363 @@
+"""ZeRO-1 cross-replica sharding of the weight update + quantized
+gradient collectives for the donated train step.
+
+Per "Automatic Cross-Replica Sharding of Weight Update in Data-Parallel
+Training" (arXiv 2004.13336): pure data parallelism keeps the FULL
+optimizer state and runs the FULL weight update on every replica — at
+dp-way replication that is dp identical copies of the Adam moments and
+dp identical update sweeps. Sharding both across the dp axis changes no
+math: reduce-scatter the gradients (each replica receives the summed
+1/dp stripe it owns), run the optimizer over that stripe against its
+stripe of the optimizer state, and all-gather the updated parameter
+stripes. Train-state HBM for the optimizer drops by ~dp and the wire
+cost is the same as an all-reduce (reduce-scatter + all-gather IS the
+two-phase all-reduce decomposition).
+
+Loss contract — the standard data-parallel one: gradients are AVERAGED
+across replicas, exact when the loss is an equal-weight mean over the
+batch axis (every built-in loss's default reduction). A
+``reduction='sum'`` loss, or a mean whose per-sample weights land
+unevenly across slices (``ignore_index`` clustered in one slice),
+trains under the dp-averaged semantics — identical to
+``paddle.DataParallel``/DDP, but not to the single-process run.
+
+The flat layout: the trainable parameter tree is flattened (f32,
+deterministic name order) into one vector, padded so it splits into dp
+equal stripes whose length is also a multiple of the quantization chunk
+— the "padding map" that makes uneven trees shard evenly. Optimizer
+slots live as flat [padded] f32 arrays device-sharded
+``NamedSharding(mesh, P("dp"))`` end-to-end; ``gather_opt_state`` /
+``shard_opt_state`` convert to/from the named {"step", "slots"} layout
+at the fit boundary (state_dict/save/load and the eager bridge).
+
+Quantized gradient exchange (``grad_comm='int8'``, EQuARX-style,
+arXiv 2506.17615): instead of a f32 reduce-scatter, each replica
+quantizes its flat gradient per chunk (max-abs scale / 127, computed
+in-step), all-to-alls the int8 payload + f32 scales over the dp axis,
+and dequantizes-then-sums locally — ~4x fewer wire bytes on the
+gradient exchange (int8 payload + 1/chunk scale overhead vs f32). A
+nonfinite gradient POISONS its chunk's scale (max-abs propagates
+inf/NaN), so dequantization re-materializes the nonfiniteness and the
+PR-9 numerics sentinel still blames the exact step.
+
+Everything here is either host-side layout bookkeeping (numpy) or jnp
+code traced into the donated train step by
+``hapi/model.py _build_zero_train_step``; nothing syncs.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["FlatLayout", "resolve_mesh", "shard_opt_state",
+           "gather_opt_state", "is_sharded_state",
+           "quantized_reduce_scatter", "replicate_buffers",
+           "QUANT_CHUNK", "AXIS"]
+
+# per-chunk scale granularity of the int8 exchange: 256 elements per
+# f32 scale = 1/64 relative overhead on the quantized payload
+QUANT_CHUNK = 256
+
+# the mesh axis name the sharded train step communicates over
+AXIS = "dp"
+
+
+class FlatLayout:
+    """The padding map: a named (trainable) parameter tree flattened to
+    one f32 vector split into dp equal stripes.
+
+    * ``names`` — sorted parameter names (the deterministic flatten
+      order; matches the dict-pytree order jax uses).
+    * ``offsets[name] = (start, end)`` — the param's slice of the flat
+      vector.
+    * ``total``/``padded``/``stripe`` — logical element count, padded
+      count (a multiple of ``dp * chunk`` so stripes split evenly AND
+      each stripe chunks evenly for quantization), per-replica stripe
+      length.
+
+    Padding elements carry zero gradients and zero parameters forever
+    (every built-in rule maps (p=0, g=0) → 0 up to weight decay of 0),
+    so the pad never leaks into real values.
+    """
+
+    __slots__ = ("names", "shapes", "dtypes", "sizes", "offsets",
+                 "total", "padded", "stripe", "dp", "chunk")
+
+    def __init__(self, names, shapes, dtypes, sizes, offsets, total,
+                 padded, stripe, dp, chunk):
+        self.names = names
+        self.shapes = shapes
+        self.dtypes = dtypes
+        self.sizes = sizes
+        self.offsets = offsets
+        self.total = total
+        self.padded = padded
+        self.stripe = stripe
+        self.dp = dp
+        self.chunk = chunk
+
+    @staticmethod
+    def build(params: Dict[str, object], dp: int,
+              chunk: int = QUANT_CHUNK) -> "FlatLayout":
+        if dp < 1:
+            raise ValueError(f"dp must be >= 1, got {dp}")
+        names = sorted(params)
+        shapes = {n: tuple(params[n].shape) for n in names}
+        dtypes = {n: np.dtype(str(params[n].dtype)) for n in names}
+        sizes = {n: int(np.prod(shapes[n])) if shapes[n] else 1
+                 for n in names}
+        offsets, pos = {}, 0
+        for n in names:
+            offsets[n] = (pos, pos + sizes[n])
+            pos += sizes[n]
+        total = pos
+        align = dp * max(1, int(chunk))
+        padded = max(align, ((total + align - 1) // align) * align)
+        return FlatLayout(names, shapes, dtypes, sizes, offsets, total,
+                          padded, padded // dp, int(dp), int(chunk))
+
+    def compatible_with(self, params: Dict[str, object]) -> bool:
+        """True when ``params`` flattens to exactly this layout — the
+        staleness probe for a cached sharded opt state."""
+        if sorted(params) != self.names:
+            return False
+        return all(tuple(params[n].shape) == self.shapes[n]
+                   for n in self.names)
+
+    # -- traced (jnp) helpers ---------------------------------------------
+    def flatten(self, tree):
+        """Concat the tree's leaves (f32, name order) + the pad tail.
+        jnp code — traced into the step."""
+        import jax.numpy as jnp
+        parts = [jnp.reshape(tree[n], (-1,)).astype(jnp.float32)
+                 for n in self.names]
+        flat = jnp.concatenate(parts) if parts \
+            else jnp.zeros((0,), jnp.float32)
+        return jnp.pad(flat, (0, self.padded - self.total))
+
+    def unflatten(self, flat, like: Dict[str, object]):
+        """Split a flat f32 vector back into the named tree, cast to
+        each param's dtype. jnp code — traced into the step."""
+        import jax.numpy as jnp
+        out = {}
+        for n in self.names:
+            lo, hi = self.offsets[n]
+            out[n] = jnp.reshape(flat[lo:hi], self.shapes[n]).astype(
+                like[n].dtype)
+        return out
+
+    # -- host-side helpers -------------------------------------------------
+    def flatten_host(self, tree: Dict[str, object],
+                     default: float = 0.0) -> np.ndarray:
+        """numpy flatten (missing names fall back to ``default``) — the
+        shard/gather boundary runs on host, never inside a trace."""
+        flat = np.full((self.padded,), default, np.float32)
+        for n in self.names:
+            v = tree.get(n)
+            if v is None:
+                continue
+            lo, hi = self.offsets[n]
+            flat[lo:hi] = np.asarray(v, np.float32).reshape(-1)
+        return flat
+
+    def split_host(self, flat: np.ndarray) -> Dict[str, np.ndarray]:
+        flat = np.asarray(flat, np.float32).reshape(-1)
+        out = {}
+        for n in self.names:
+            lo, hi = self.offsets[n]
+            out[n] = flat[lo:hi].reshape(self.shapes[n])
+        return out
+
+    def group_ids(self, audit_layout) -> np.ndarray:
+        """Per-element layer-group index for the sharded numerics audit
+        (profiler/numerics.build_audit_flat): element → the index of
+        its param's group in ``audit_layout.groups``; padding gets the
+        extra ``n_groups`` bucket, which the audit drops."""
+        n_groups = len(audit_layout.groups)
+        ids = np.full((self.padded,), n_groups, np.int32)
+        by_name = {}
+        for gi, g in enumerate(audit_layout.groups):
+            for member in audit_layout.members[g]:
+                by_name[member] = gi
+        for n in self.names:
+            gi = by_name.get(n)
+            if gi is None:
+                continue
+            lo, hi = self.offsets[n]
+            ids[lo:hi] = gi
+        return ids
+
+    def mask_from(self, names: Sequence[str]) -> np.ndarray:
+        """0/1 f32 per-element mask selecting the given params — the
+        flat carrier of per-param predicates (AdamW's decoupled-decay
+        exclusion) into the stripe-local update rule."""
+        mask = np.zeros((self.padded,), np.float32)
+        for n in names:
+            if n in self.offsets:
+                lo, hi = self.offsets[n]
+                mask[lo:hi] = 1.0
+        return mask
+
+    def t0_vector(self, t0_map: Dict[str, int]) -> np.ndarray:
+        """Per-element birth-step vector (flat analog of the ``_t0``
+        slot marker): step-dependent rules see ``step - t0`` per
+        element, so a param unfrozen mid-run bias-corrects from its own
+        t=0 inside the flat stripe exactly as it does in the named
+        path."""
+        t0 = np.zeros((self.padded,), np.int32)
+        for n, v in t0_map.items():
+            if n in self.offsets:
+                lo, hi = self.offsets[n]
+                t0[lo:hi] = int(v)
+        return t0
+
+    def __repr__(self):
+        return (f"<FlatLayout params={len(self.names)} total={self.total} "
+                f"padded={self.padded} dp={self.dp} stripe={self.stripe}>")
+
+
+def resolve_mesh(min_dp: int = 2):
+    """The dp mesh the sharded step runs over: the globally registered
+    mesh (``distributed.env.build_mesh``) when its single axis is
+    ``'dp'`` — the way tests and launchers pick dp < device_count —
+    else a fresh 1-D mesh over every local device. Raises when fewer
+    than ``min_dp`` devices are available: a 1-device "sharded" step
+    would silently measure nothing."""
+    import jax
+    from jax.sharding import Mesh
+
+    from ..distributed import env
+    mesh = env.get_mesh()
+    if mesh is not None and tuple(mesh.axis_names) == (AXIS,):
+        if int(np.prod(mesh.devices.shape)) >= min_dp:
+            return mesh
+    devices = jax.devices()
+    if len(devices) < min_dp:
+        raise ValueError(
+            f"fit(zero=1) needs a data-parallel mesh of >= {min_dp} "
+            f"devices but only {len(devices)} are visible; on CPU set "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count=N or "
+            f"register a mesh with distributed.env.build_mesh("
+            f"{{'dp': N}})")
+    return Mesh(np.array(devices), (AXIS,))
+
+
+def dp_sharding(mesh):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    return NamedSharding(mesh, P(AXIS))
+
+
+def replicated_sharding(mesh):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    return NamedSharding(mesh, P())
+
+
+def is_sharded_state(state) -> bool:
+    """True for the sharded opt-state layout ({"step", "flat": {...}})
+    vs the named layout ({"step", "slots": {...}})."""
+    return isinstance(state, dict) and "flat" in state
+
+
+def shard_opt_state(named_state: dict, layout: FlatLayout, mesh,
+                    slot_names: Sequence[str]) -> dict:
+    """Named {"step", "slots": {name: {slot: arr}}} → sharded {"step",
+    "flat": {slot: [padded] f32 P('dp')}}. Missing per-param slots
+    (e.g. a param adopted without moments) stripe in as zeros. The
+    ``_t0`` birth markers are NOT carried here — they are per-param
+    host ints the Model keeps beside the layout (``Model._zero_t0``)
+    and bakes into the step as a flat constant."""
+    import jax
+    import jax.numpy as jnp
+
+    slots_in = named_state.get("slots", {})
+    shard = dp_sharding(mesh)
+    flat = {}
+    for s in slot_names:
+        host = layout.flatten_host(
+            {n: slots_in.get(n, {}).get(s) for n in layout.names})
+        flat[s] = jax.device_put(jnp.asarray(host), shard)
+    return {"step": jnp.asarray(np.asarray(named_state["step"]),
+                                jnp.int32),
+            "flat": flat}
+
+
+def gather_opt_state(sharded_state: dict, layout: FlatLayout,
+                     slot_names: Sequence[str]) -> dict:
+    """Sharded → named (one host fetch per slot; runs at fit
+    boundaries — state_dict/save/the eager bridge — never per step)."""
+    import jax.numpy as jnp
+
+    slots: Dict[str, Dict[str, object]] = {n: {} for n in layout.names}
+    for s in slot_names:
+        arr = sharded_state["flat"].get(s)
+        if arr is None:
+            continue
+        split = layout.split_host(np.asarray(arr))
+        for n in layout.names:
+            slots[n][s] = jnp.asarray(split[n])
+    return {"step": jnp.asarray(int(np.asarray(sharded_state["step"])),
+                                jnp.int32),
+            "slots": slots}
+
+
+# ---------------------------------------------------------------------------
+# traced collectives of the sharded step
+# ---------------------------------------------------------------------------
+
+def quantized_reduce_scatter(flat_g, axis_name: str, dp: int,
+                             stripe: int, chunk: int):
+    """EQuARX-style int8 gradient exchange: returns this replica's SUM
+    stripe (caller divides by dp for the mean), numerically
+    ``psum_scatter`` with per-chunk max-abs quantization on the wire.
+
+    Each replica chunks its [dp, stripe/chunk, chunk] view, computes
+    f32 scales (max-abs/127, floored so all-zero chunks stay exactly
+    zero), quantizes to int8, and exchanges shards with one all_to_all
+    for the payload and one for the scales — both through the
+    byte-counted ``collective`` wrappers, so the wire savings show up
+    in the ``collective_bytes/*`` counters and profiler spans. A
+    nonfinite element drives its chunk's scale nonfinite, and
+    ``int8 * nonfinite-scale`` dequantizes nonfinite — corruption is
+    never silently rounded away (the PR-9 sentinel fires at the exact
+    step)."""
+    import jax.numpy as jnp
+
+    from ..distributed import collective
+
+    n_chunks = stripe // chunk
+    g3 = flat_g.reshape(dp, n_chunks, chunk)
+    scales = jnp.max(jnp.abs(g3), axis=-1) / jnp.float32(127.0)
+    scales = jnp.maximum(scales, jnp.float32(1e-30))
+    q = jnp.clip(jnp.round(g3 / scales[..., None]),
+                 -127.0, 127.0).astype(jnp.int8)
+    # shard j of every replica lands on replica j (tiled all_to_all on
+    # the leading dp axis); received row r = replica r's contribution
+    # to MY stripe
+    q_recv = collective.all_to_all_in_axis(q, axis_name,
+                                           split_axis=0, concat_axis=0)
+    s_recv = collective.all_to_all_in_axis(scales, axis_name,
+                                           split_axis=0, concat_axis=0)
+    deq = q_recv.astype(jnp.float32) * s_recv[..., None]
+    return jnp.sum(deq, axis=0).reshape(stripe)
+
+
+def replicate_buffers(buffers, axis_name: str, dp: int):
+    """Make per-replica buffer updates (BN running stats computed from
+    the LOCAL batch slice) consistent across the dp axis: floats are
+    cross-replica means (equal-sized slices → the full-batch mean for
+    mean-style stats), integers (step counters) are identical on every
+    replica so psum/dp is exact."""
+    import jax
+    import jax.numpy as jnp
+
+    def one(b):
+        if jnp.issubdtype(b.dtype, jnp.inexact):
+            return jax.lax.pmean(b, axis_name)
+        # psum promotes (bool -> int32); cast back so fit(zero=1)
+        # never rewrites a buffer dtype the replicated step preserves
+        # (dtype drift = a spurious signature retrace + a checkpoint
+        # that stops being byte-identical to the replicated format)
+        return (jax.lax.psum(b, axis_name) // dp).astype(b.dtype)
+
+    return {k: one(v) for k, v in buffers.items()}

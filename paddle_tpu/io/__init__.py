@@ -769,6 +769,14 @@ def device_prefetch(iterable, sharding=None, buffer_size=2):
             if isinstance(a, Tensor):
                 a = a._data
             if sharding is not None:
+                # honor an already-matching layout: a batch that landed
+                # with the requested sharding (e.g. dp-split for the
+                # ZeRO train step) must not be forced through a
+                # gather-and-redistribute round trip
+                if isinstance(a, jax.Array) and \
+                        getattr(a, "sharding", None) is not None and \
+                        a.sharding.is_equivalent_to(sharding, a.ndim):
+                    return a
                 return jax.device_put(a, sharding)
             if isinstance(a, jax.Array):
                 return a  # already on device: a re-put is a wasted dispatch

@@ -37,6 +37,11 @@ class L1Decay:
 
 class Optimizer:
     _slot_names: List[str] = []
+    # elementwise rules shard onto flat parameter stripes (the ZeRO
+    # weight update, hapi/zero.py); optimizers whose rule has per-PARAM
+    # semantics a flat view cannot express (Lamb's per-layer trust
+    # ratio) opt out and fit(zero=1) rejects them with a clear error
+    _flat_rule_supported = True
 
     def __init__(self, learning_rate=0.001, parameters=None,
                  weight_decay=None, grad_clip=None, name=None,
@@ -241,6 +246,31 @@ class Optimizer:
                       for name, v in params.items()},
         }
 
+    def flat_rule(self, p, g, slots, lr, step, decay_mask=None):
+        """Shard-local weight update over one flat f32 STRIPE of the
+        parameter vector — the ZeRO-sharded train step's per-replica
+        rule (hapi/zero.py). ``p``/``g`` are 1-D f32 stripes; ``slots``
+        holds this stripe's slice of each flat slot; ``step`` may be a
+        per-ELEMENT vector (params (re)born mid-run carry their own age
+        — the flat analog of the ``_t0`` marker, broadcast through the
+        elementwise bias-correction math). ``decay_mask`` is a 0/1
+        per-element mask when only some params take weight decay.
+
+        Default implementation folds L2/L1 decay into the gradient
+        (masked) and runs the elementwise ``_rule`` — exact for every
+        built-in optimizer whose update touches elements independently;
+        per-param-semantics optimizers set ``_flat_rule_supported =
+        False`` instead of shipping a silently-wrong flat rule."""
+        if isinstance(self._weight_decay, L2Decay) and \
+                self._weight_decay.coeff:
+            d = self._weight_decay.coeff * p
+            g = g + (d if decay_mask is None else d * decay_mask)
+        elif isinstance(self._weight_decay, L1Decay) and \
+                self._weight_decay.coeff:
+            d = self._weight_decay.coeff * jnp.sign(p)
+            g = g + (d if decay_mask is None else d * decay_mask)
+        return self._rule(p, g, slots, lr, step)
+
     def apply_gradients(self, params, grads, state, lr=None):
         """Pure update: (params, grads, state) -> (new_params, new_state).
 
@@ -381,6 +411,24 @@ class AdamW(Adam):
     def _decay_grad(self, p, g):
         return g  # decoupled — handled in _rule
 
+    def flat_rule(self, p, g, slots, lr, step, decay_mask=None):
+        """Flat-stripe AdamW: the Adam moments elementwise plus the
+        DECOUPLED decay term, masked per element — the flat carrier of
+        ``apply_decay_param_fun`` (the ZeRO step bakes the per-param
+        predicate into a 0/1 vector; see FlatLayout.mask_from)."""
+        gf = g.astype(jnp.float32)
+        m = self._beta1 * slots["moment1"] + (1 - self._beta1) * gf
+        v = self._beta2 * slots["moment2"] + (1 - self._beta2) * gf * gf
+        stepf = jnp.asarray(step, jnp.float32)
+        mhat = m / (1 - self._beta1 ** stepf)
+        vhat = v / (1 - self._beta2 ** stepf)
+        pf = p.astype(jnp.float32)
+        decay = self._wd_coeff if decay_mask is None \
+            else self._wd_coeff * decay_mask
+        new_p = pf - lr * (mhat / (jnp.sqrt(vhat) + self._eps)
+                           + decay * pf)
+        return new_p.astype(p.dtype), {"moment1": m, "moment2": v}
+
     def _wd_enabled(self, name):
         return self._apply_decay_param_fun is None or \
             self._apply_decay_param_fun(name)
@@ -502,6 +550,9 @@ class Lamb(Optimizer):
     optimizer/lamb.py)."""
 
     _slot_names = ["moment1", "moment2"]
+    # the trust ratio is a per-PARAM norm ratio — a flat stripe spans
+    # many params, so no elementwise rule can express it
+    _flat_rule_supported = False
 
     def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
                  beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None,

@@ -73,8 +73,8 @@ from ..framework.monitor import (all_stats, stat_add, stat_histogram,
 from . import memory as _memory
 
 __all__ = ["NumericsError", "AuditLayout", "NumericsRecorder",
-           "build_audit", "group_params", "decode_audit", "flag_mode",
-           "MODES", "N_FIXED", "FINITE_ALL"]
+           "build_audit", "build_audit_flat", "group_params",
+           "decode_audit", "flag_mode", "MODES", "N_FIXED", "FINITE_ALL"]
 
 MODES = ("off", "record", "warn", "halt")
 
@@ -223,6 +223,57 @@ def build_audit(loss, grads, params, new_params, layout: AuditLayout,
     if counts:
         vec = jnp.concatenate(
             [vec, jnp.stack(counts).astype(jnp.float32)])
+    return vec
+
+
+def build_audit_flat(loss, flat_grads, flat_params, flat_new_params,
+                     group_ids, layout: AuditLayout, axis_name: str,
+                     grad_norm=None, clipped_norm=None):
+    """Sharded-stripe variant of :func:`build_audit` for the ZeRO train
+    step (hapi/zero.py): each replica holds a 1/dp STRIPE of the flat
+    gradient/param vectors, so every reduction carries a cross-shard
+    ``psum`` term — the reported norms and finite bits cover the FULL
+    (post-exchange, dequantized) gradient and update, never the local
+    shard. ``flat_grads`` must be the post-reduce-scatter pre-clip
+    stripe: under quantized comms that is the dequantized gradient, so
+    quantization corruption is blamed at the exact step like any other
+    nonfinite. ``group_ids`` maps each stripe element to its layer
+    group (the extra ``len(groups)`` bucket is padding and is
+    dropped). Same output layout as build_audit; decode_audit reads
+    both. The vector is REPLICATED across the axis (every term is a
+    psum/pmean), so the step returns it with a replicated out_spec."""
+    import jax
+    import jax.numpy as jnp
+
+    n_groups = len(layout.groups)
+    loss_s = jnp.reshape(jnp.asarray(loss, jnp.float32), (-1,))[0]
+    nf = (~jnp.isfinite(flat_grads)).astype(jnp.int32)
+    counts = jax.ops.segment_sum(nf, group_ids,
+                                 num_segments=n_groups + 1)[:n_groups]
+    counts = jax.lax.psum(counts, axis_name)
+    total_nonfinite = jnp.sum(counts) if n_groups \
+        else jax.lax.psum(jnp.sum(nf), axis_name)
+    if grad_norm is None:
+        grad_norm = jnp.sqrt(jax.lax.psum(
+            jnp.sum(jnp.square(flat_grads.astype(jnp.float32))),
+            axis_name))
+    grad_norm = jnp.asarray(grad_norm, jnp.float32)
+    clipped_norm = grad_norm if clipped_norm is None \
+        else jnp.asarray(clipped_norm, jnp.float32)
+    pf = flat_params.astype(jnp.float32)
+    nf32 = flat_new_params.astype(jnp.float32)
+    p_sq = jax.lax.psum(jnp.sum(jnp.square(pf)), axis_name)
+    u_sq = jax.lax.psum(jnp.sum(jnp.square(nf32 - pf)), axis_name)
+    bad_new = jax.lax.psum(
+        jnp.sum((~jnp.isfinite(flat_new_params)).astype(jnp.int32)),
+        axis_name)
+    bits = (jnp.isfinite(loss_s).astype(jnp.float32) * BIT_LOSS
+            + (total_nonfinite == 0).astype(jnp.float32) * BIT_GRADS
+            + (bad_new == 0).astype(jnp.float32) * BIT_UPDATE)
+    vec = jnp.stack([bits, loss_s, grad_norm, clipped_norm,
+                     jnp.sqrt(p_sq), jnp.sqrt(u_sq)])
+    if n_groups:
+        vec = jnp.concatenate([vec, counts.astype(jnp.float32)])
     return vec
 
 

@@ -127,6 +127,149 @@ def test_donation_real_train_step_is_clean():
     assert r.ok(), r.table()
 
 
+def _dp_mesh(n=4):
+    import jax
+    from jax.sharding import Mesh
+    return Mesh(np.array(jax.devices()[:n]), ("dp",))
+
+
+def test_donation_threads_through_shard_map():
+    """The ZeRO-shaped contract: a donated dp-sharded state whose
+    updated value comes back through the shard_map eqn must be
+    recognized as covered — and one that is dropped must still be the
+    no-rebind-target error."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = _dp_mesh()
+    state = jax.device_put(jnp.zeros(8, jnp.float32),
+                           NamedSharding(mesh, P("dp")))
+    x = jnp.ones(8, jnp.float32)
+
+    def good(s, v):
+        g = jax.lax.psum_scatter(v, "dp", scatter_dimension=0,
+                                 tiled=True)
+        s2 = s + g
+        return s2, jax.lax.all_gather(s2, "dp", axis=0, tiled=True)
+
+    fn = jax.shard_map(good, mesh=mesh, in_specs=(P("dp"), P()),
+                       out_specs=(P("dp"), P()), check_vma=False)
+    r = analysis.analyze(fn, state, x, donate_argnums=(0,))
+    assert not _findings(r, "donation-safety"), r.table()
+
+    def bad(s, v):
+        # donated state read but never returned: the caller's rebind
+        # target does not exist (output is a scalar, not s's aval)
+        return jax.lax.psum(jnp.sum(s) + jnp.sum(v), "dp")
+
+    fn2 = jax.shard_map(bad, mesh=mesh, in_specs=(P("dp"), P()),
+                        out_specs=P(), check_vma=False)
+    r2 = analysis.analyze(fn2, state, x, donate_argnums=(0,))
+    errs = _findings(r2, "donation-safety", "error")
+    assert errs and "no matching output" in errs[0].message
+
+
+# ---------------------------------------------------------------------------
+# pass: collective-pairing (seeded both directions)
+# ---------------------------------------------------------------------------
+
+def test_collective_pairing_clean_when_paired():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    mesh = _dp_mesh()
+
+    def body(x):
+        s = jax.lax.psum_scatter(x, "dp", scatter_dimension=0,
+                                 tiled=True)
+        return jax.lax.all_gather(s * 2.0, "dp", axis=0, tiled=True)
+
+    fn = jax.shard_map(body, mesh=mesh, in_specs=P(), out_specs=P(),
+                       check_vma=False)
+    r = analysis.analyze(fn, jnp.ones(8, jnp.float32))
+    assert not _findings(r, "collective-pairing"), r.table()
+
+
+def test_collective_pairing_catches_unpaired_reduce_scatter():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    mesh = _dp_mesh()
+
+    def body(x):
+        return jax.lax.psum_scatter(x, "dp", scatter_dimension=0,
+                                    tiled=True)
+
+    fn = jax.shard_map(body, mesh=mesh, in_specs=P(),
+                       out_specs=P("dp"), check_vma=False)
+    r = analysis.analyze(fn, jnp.ones(8, jnp.float32))
+    errs = _findings(r, "collective-pairing", "error")
+    assert errs and "no closing all-gather" in errs[0].message
+
+
+def test_collective_pairing_catches_mismatched_dimension():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    mesh = _dp_mesh()
+
+    def body(x):
+        s = jax.lax.psum_scatter(x, "dp", scatter_dimension=0,
+                                 tiled=True)
+        # closes on the WRONG dimension: stripes re-assemble permuted
+        return jax.lax.all_gather(s, "dp", axis=1, tiled=True)
+
+    fn = jax.shard_map(body, mesh=mesh, in_specs=P(), out_specs=P(),
+                       check_vma=False)
+    r = analysis.analyze(fn, jnp.ones((8, 2), jnp.float32))
+    errs = _findings(r, "collective-pairing", "error")
+    assert errs and "does not match its closing" in errs[0].message
+
+
+def test_collective_pairing_respects_program_order():
+    """An all-gather BEFORE the reduce-scatter (e.g. gathering some
+    other value at the top of the step) cannot be its closing gather —
+    the scatter below it is still unpaired."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    mesh = _dp_mesh()
+
+    def body(a, x):
+        g = jax.lax.all_gather(a, "dp", axis=0, tiled=True)  # unrelated
+        s = jax.lax.psum_scatter(x * jnp.sum(g), "dp",
+                                 scatter_dimension=0, tiled=True)
+        return s  # never gathered back
+
+    fn = jax.shard_map(body, mesh=mesh, in_specs=(P("dp"), P()),
+                       out_specs=P("dp"), check_vma=False)
+    r = analysis.analyze(fn, jnp.ones(8, jnp.float32),
+                         jnp.ones(8, jnp.float32))
+    errs = _findings(r, "collective-pairing", "error")
+    assert errs and "no closing all-gather" in errs[0].message
+
+
+def test_collective_pairing_silent_on_psum_only_programs():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    mesh = _dp_mesh()
+
+    def body(x):
+        return jax.lax.psum(x, "dp")  # plain DP grad sync: fine
+
+    fn = jax.shard_map(body, mesh=mesh, in_specs=P("dp"), out_specs=P(),
+                       check_vma=False)
+    r = analysis.analyze(fn, jnp.ones(8, jnp.float32))
+    assert not _findings(r, "collective-pairing")
+
+
 # ---------------------------------------------------------------------------
 # pass 3: dead/frozen-grad
 # ---------------------------------------------------------------------------

@@ -149,3 +149,14 @@ def test_dry_run_emits_metrics_summary():
     assert num["nonfinite_steps"] > 0, num
     assert "hapi/grad_norm" in res.stderr
     assert "hapi/nonfinite_steps" in res.stderr
+
+    # ISSUE-11 ZeRO canary: on the dp=4 mesh (the conftest forces 8
+    # host devices, so the canary never skips here) fit(zero=1) trained
+    # allclose-identical params to the replicated donated step, and the
+    # PR-7 ledger billed per-replica opt-state bytes at ~1/dp of the
+    # replicated run (one quantization-chunk stripe of padding allowed)
+    assert out["checks"]["zero_parity"] is True, out
+    assert out["checks"]["zero_opt_state_sharded"] is True, out
+    zc = out["zero"]
+    assert zc["skipped"] is False, zc
+    assert zc["opt_bytes"] < zc["replicated_opt_bytes"] / 2, zc

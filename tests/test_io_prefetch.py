@@ -45,6 +45,24 @@ class TestDevicePrefetch:
         for x, y in device_prefetch(loader, sharding=sharding):
             assert x.sharding.is_equivalent_to(sharding, x.ndim)
 
+    def test_already_matching_sharding_is_not_reput(self):
+        """A batch that already carries the requested sharding (e.g.
+        dp-split for the ZeRO train step) must pass through untouched —
+        re-putting it would force a gather-and-redistribute round
+        trip."""
+        import jax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        mesh = Mesh(np.array(jax.devices()).reshape(8), ("data",))
+        sharding = NamedSharding(mesh, P("data"))
+        _, xs, ys = _dataset(n=16)
+        pre = [(jax.device_put(xs[i:i + 8], sharding),
+                jax.device_put(ys[i:i + 8], sharding))
+               for i in (0, 8)]
+        out = list(device_prefetch(pre, sharding=sharding))
+        for (x_in, y_in), (x_out, y_out) in zip(pre, out):
+            assert x_out is x_in  # identity: no re-put happened
+            assert y_out is y_in
+
     def test_transfer_overlaps_consumption(self):
         """The producer must run ahead: while the consumer sleeps on batch
         i, batch i+1 must already have been produced (double buffer)."""

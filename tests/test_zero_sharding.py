@@ -1,0 +1,425 @@
+"""ZeRO-sharded weight update + quantized gradient collectives
+(ISSUE 11, hapi/zero.py + Model.fit(zero=1, grad_comm=)).
+
+Five legs, each asserted rather than assumed:
+
+* **exact parity** — on a dp=4 mesh the sharded donated step trains
+  allclose-identical params to the replicated step for SGD/Adam/AdamW,
+  through a frozen-set flip mid-run (the PR-2 re-trace +
+  slot-reconciliation path) and through save()/load() round trips that
+  cross modes in both directions;
+* **memory** — the PR-7 HBM ledger bills per-replica opt-state bytes at
+  ~1/dp (one quantization-chunk stripe of padding allowed);
+* **wire** — ``grad_comm='int8'`` moves the gradient exchange onto an
+  int8 all_to_all at well under half the reduce-scatter's f32 bytes
+  (per-kind ``collective_bytes/*`` counters), with bounded training
+  drift;
+* **numerics** — the PR-9 audit reads the FULL (post-allreduce,
+  dequantized) gradient: its grad norm equals the replicated path's,
+  clip saturation stays visible, and an injected inf under quantized
+  comms still trips ``fit(numerics='warn')`` at the exact step;
+* **analysis** — the shard_map'd step gets a clean donation-safety /
+  dead-grad / collective-pairing bill, and a warm re-fit adds zero
+  retraces.
+"""
+import os
+import tempfile
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import analysis
+from paddle_tpu.distributed import env as denv
+from paddle_tpu.framework import monitor, trace_probe
+from paddle_tpu.hapi import zero as zmod
+from paddle_tpu.io import DataLoader, TensorDataset
+from paddle_tpu.profiler import memory as _memory
+
+DP = 4
+rng = np.random.RandomState(0)
+XS = rng.randn(80, 16).astype(np.float32)
+YS = rng.randint(0, 4, (80, 1)).astype(np.int64)
+
+
+@pytest.fixture(autouse=True)
+def dp_mesh():
+    prev = denv.get_mesh()
+    denv.build_mesh({"dp": DP})
+    yield
+    denv.set_mesh(prev)
+
+
+def _data():
+    return TensorDataset([XS, YS])
+
+
+def _model(opt="adam", clip=None, lr=1e-2, wd_fn=None):
+    paddle.framework.random.seed(0)
+    net = nn.Sequential(nn.Linear(16, 8), nn.ReLU(), nn.Linear(8, 4))
+    model = paddle.Model(net)
+    params = net.parameters()
+    if opt == "sgd":
+        o = paddle.optimizer.SGD(learning_rate=lr, parameters=params,
+                                 grad_clip=clip)
+    elif opt == "adamw":
+        o = paddle.optimizer.AdamW(learning_rate=lr, weight_decay=0.01,
+                                   parameters=params, grad_clip=clip,
+                                   apply_decay_param_fun=wd_fn)
+    else:
+        o = paddle.optimizer.Adam(learning_rate=lr, parameters=params,
+                                  grad_clip=clip)
+    model.prepare(o, nn.CrossEntropyLoss())
+    return model
+
+
+def _fit(model, zero=0, steps="all", **kw):
+    # 80 samples / batch 8 = 10 steps per epoch — the acceptance
+    # criterion's horizon
+    model.fit(_data(), batch_size=8, epochs=1, log_freq=4,
+              shuffle=False, verbose=0, zero=zero, **kw)
+    return model
+
+
+def _params_close(a, b, rtol=1e-5, atol=1e-6):
+    return all(np.allclose(np.asarray(a._params[k]),
+                           np.asarray(b._params[k]), rtol=rtol,
+                           atol=atol) for k in a._params)
+
+
+class TestZeroParity:
+    @pytest.mark.parametrize("opt", ["sgd", "adam", "adamw"])
+    def test_ten_step_parity(self, opt):
+        rep = _fit(_model(opt), zero=0)
+        shd = _fit(_model(opt), zero=1)
+        assert _params_close(rep, shd), opt
+        # and the sharded layout actually armed (not a silent fallback)
+        assert zmod.is_sharded_state(shd._opt_state)
+        assert shd._zero_layout.dp == DP
+
+    def test_adamw_decay_exclusion_mask(self):
+        wd_fn = lambda name: "bias" not in name  # noqa: E731
+        rep = _fit(_model("adamw", wd_fn=wd_fn), zero=0)
+        shd = _fit(_model("adamw", wd_fn=wd_fn), zero=1)
+        assert _params_close(rep, shd)
+
+    def test_global_norm_clip_parity(self):
+        clip = nn.ClipGradByGlobalNorm(0.5)
+        rep = _fit(_model("adam", clip=nn.ClipGradByGlobalNorm(0.5)),
+                   zero=0)
+        shd = _fit(_model("adam", clip=clip), zero=1)
+        assert _params_close(rep, shd)
+
+    def test_value_clip_parity(self):
+        rep = _fit(_model("adam", clip=nn.ClipGradByValue(0.01)), zero=0)
+        shd = _fit(_model("adam", clip=nn.ClipGradByValue(0.01)), zero=1)
+        assert _params_close(rep, shd)
+
+    def test_frozen_flip_mid_run_parity(self):
+        def run(zero):
+            m = _model("adam")
+            _fit(m, zero=zero)
+            for n, p in m.network.named_parameters():
+                if n.startswith("0."):
+                    p.stop_gradient = True
+            _fit(m, zero=zero)
+            for n, p in m.network.named_parameters():
+                p.stop_gradient = False
+            _fit(m, zero=zero)
+            return m
+
+        rep, shd = run(0), run(1)
+        assert _params_close(rep, shd)
+
+    def test_batch_not_divisible_raises(self):
+        m = _model("adam")
+        _fit(m, zero=1)
+        with pytest.raises(ValueError, match="divisible"):
+            m.train_batch([XS[:6]], [YS[:6]])
+
+    def test_tail_batch_error_is_helpful_on_prefetch_path(self):
+        # 41 samples / batch 8 leaves a 1-row tail; with prefetch ON
+        # (fit's default) the guard must still raise the drop_last=True
+        # hint — not jax's opaque dimension-divisibility error from the
+        # dp-sharded device_put in the producer thread
+        m = _model("adam")
+        data = TensorDataset([XS[:41], YS[:41]])
+        with pytest.raises(ValueError, match="drop_last"):
+            m.fit(data, batch_size=8, epochs=1, log_freq=4,
+                  shuffle=False, verbose=0, zero=1, prefetch=True)
+
+    def test_lamb_rejected_with_clear_error(self):
+        paddle.framework.random.seed(0)
+        net = nn.Sequential(nn.Linear(16, 4))
+        m = paddle.Model(net)
+        m.prepare(paddle.optimizer.Lamb(learning_rate=1e-3,
+                                        parameters=net.parameters()),
+                  nn.CrossEntropyLoss())
+        with pytest.raises(ValueError, match="trust ratio"):
+            _fit(m, zero=1)
+
+    def test_per_tensor_clip_rejected(self):
+        m = _model("adam", clip=nn.ClipGradByNorm(1.0))
+        with pytest.raises(ValueError, match="per TENSOR"):
+            _fit(m, zero=1)
+
+    def test_bad_zero_and_grad_comm_values_rejected(self):
+        m = _model("adam")
+        with pytest.raises(ValueError, match="zero must be"):
+            _fit(m, zero=2)
+        with pytest.raises(ValueError, match="grad_comm"):
+            _fit(m, zero=1, grad_comm="fp8")
+
+
+class TestZeroState:
+    def test_save_load_zero_into_replicated(self, tmp_path):
+        path = str(tmp_path / "ckpt")
+        mz = _fit(_model("adam"), zero=1)
+        mz.save(path)
+        cont = _model("adam")
+        cont.load(path)
+        _fit(cont, zero=0)
+        ref = _fit(_fit(_model("adam"), zero=0), zero=0)
+        assert _params_close(cont, ref)
+
+    def test_save_load_replicated_into_zero(self, tmp_path):
+        path = str(tmp_path / "ckpt")
+        mr = _fit(_model("adam"), zero=0)
+        mr.save(path)
+        cont = _model("adam")
+        cont.load(path)
+        _fit(cont, zero=1)
+        ref = _fit(_fit(_model("adam"), zero=0), zero=0)
+        assert _params_close(cont, ref)
+
+    def test_state_dict_gathers_named_moments(self):
+        shd = _fit(_model("adam"), zero=1)
+        rep = _fit(_model("adam"), zero=0)
+        sd_s = shd._optimizer.state_dict()
+        sd_r = rep._optimizer.state_dict()
+        assert sd_s["@step"] == sd_r["@step"] == 10
+        key = "0.weight_moment1"
+        assert key in sd_s and sd_s[key].shape == sd_r[key].shape
+        assert np.allclose(np.asarray(sd_s[key]._data),
+                           np.asarray(sd_r[key]._data),
+                           rtol=1e-5, atol=1e-7)
+
+    def test_warm_refit_adds_no_retrace(self):
+        m = _fit(_model("adam"), zero=1)
+        site = m._probe_site.name
+        before = trace_probe.snapshot()[site]["traces"]
+        _fit(m, zero=1)
+        assert trace_probe.snapshot()[site]["traces"] == before
+
+    def test_mode_flip_rebuilds_and_stays_correct(self):
+        # zero -> replicated -> zero across fits on ONE model: each
+        # flip re-lays the opt state (gather / shard) and the training
+        # trajectory matches a never-sharded model's
+        m = _model("adam")
+        _fit(m, zero=1)
+        _fit(m, zero=0)
+        assert not zmod.is_sharded_state(m._opt_state)
+        _fit(m, zero=1)
+        assert zmod.is_sharded_state(m._opt_state)
+        ref = _model("adam")
+        for _ in range(3):
+            _fit(ref, zero=0)
+        assert _params_close(m, ref)
+
+    def test_ledger_bills_per_replica_opt_bytes(self):
+        rep = _fit(_model("adam"), zero=0)
+        shd = _fit(_model("adam"), zero=1)
+        led = _memory.ledger()
+        rep_b = led[f"{rep._ledger_base}/opt_state"]
+        z_b = led[f"{shd._ledger_base}/opt_state"]
+        n_slots = len(shd._optimizer._slot_names)
+        # acceptance: <= replicated/dp + one stripe of padding (per
+        # slot, one QUANT_CHUNK of f32 per replica) + the step scalar
+        bound = rep_b // DP + n_slots * zmod.QUANT_CHUNK * 4 + 64
+        assert 0 < z_b <= bound, (z_b, rep_b, bound)
+
+    def test_eager_step_after_zero_fit_continues(self):
+        # the eager<->functional bridge adopts the shard layout: after
+        # a zero fit, an eager opt.step() must see the gathered moments
+        # (not bias-correct fresh zeros at an inflated step count)
+        m = _fit(_model("adam"), zero=1)
+        loss = m.network(paddle.to_tensor(XS[:8]))
+        loss = nn.CrossEntropyLoss()(loss, paddle.to_tensor(YS[:8]))
+        loss.backward()
+        m._optimizer.step()
+        name = m.network.parameters()[0].name
+        slots = m._optimizer._slots
+        # adopted under the Parameter.name namespace with real moments
+        assert name in slots or "0.weight" in slots
+        src = slots.get(name) or slots.get("0.weight")
+        assert np.any(np.asarray(src["moment1"]))
+
+
+class TestGradCommInt8:
+    def test_wire_bytes_well_under_half(self):
+        def kind_bytes(k):
+            return monitor.stat_get(f"collective_bytes/{k}")
+
+        b0 = kind_bytes("reduce_scatter_in_axis")
+        _fit(_model("adam"), zero=1)                   # fp32 exchange
+        fp32_bytes = kind_bytes("reduce_scatter_in_axis") - b0
+        a0 = kind_bytes("all_to_all_in_axis")
+        _fit(_model("adam"), zero=1, grad_comm="int8")  # quantized
+        int8_bytes = kind_bytes("all_to_all_in_axis") - a0
+        assert fp32_bytes > 0 and int8_bytes > 0
+        # int8 payload + f32 scales vs f32 payload: ~3.9x, gate at 2x
+        assert int8_bytes * 2 < fp32_bytes, (int8_bytes, fp32_bytes)
+
+    def test_training_drift_bounded(self):
+        rep = _fit(_model("adam"), zero=0)
+        q = _fit(_model("adam"), zero=1, grad_comm="int8")
+        drift = max(
+            float(np.max(np.abs(np.asarray(rep._params[k])
+                                - np.asarray(q._params[k]))))
+            for k in rep._params)
+        assert 0 < drift < 0.05, drift  # quantized but still learning
+        # and the loss trajectory stayed close
+        assert np.isfinite(drift)
+
+    def test_injected_inf_trips_warn_at_exact_step(self):
+        m = _fit(_model("adam"), zero=1, grad_comm="int8",
+                 numerics="record")
+        inject_at = m._step_counter + 3
+        m._numerics_inject_inf_at = inject_at
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            _fit(m, zero=1, grad_comm="int8", numerics="warn")
+        m._numerics_inject_inf_at = None
+        nonfin = [a for a in m._numerics_recorder.anomaly_list()
+                  if a["kind"] == "nonfinite"]
+        assert nonfin and nonfin[0]["step"] == inject_at
+        assert nonfin[0]["blamed_groups"]
+
+
+class TestZeroAudit:
+    def test_grad_norm_equals_replicated(self):
+        def norms(zero):
+            m = _fit(_model("adam"), zero=zero, numerics="record")
+            return [r["grad_norm"]
+                    for r in m._numerics_recorder.snapshot()["records"]]
+
+        r, z = norms(0), norms(1)
+        assert len(r) == len(z) == 10
+        assert np.allclose(r, z, rtol=1e-4), (r, z)
+
+    def test_clip_ratio_equals_replicated_and_saturates(self):
+        def run(zero):
+            m = _fit(_model("adam", clip=nn.ClipGradByGlobalNorm(1e-3)),
+                     zero=zero, numerics="record")
+            recs = m._numerics_recorder.snapshot()["records"]
+            return ([r["grad_norm"] for r in recs],
+                    [r["clip_ratio"] for r in recs])
+
+        (rn, rc), (zn, zc) = run(0), run(1)
+        assert np.allclose(rn, zn, rtol=1e-4)
+        assert np.allclose(rc, zc, rtol=1e-4)
+        assert max(zc) < 1.0  # the 1e-3 clip visibly bites
+
+    def test_value_clip_ratio_stays_honest(self):
+        m = _fit(_model("adam", clip=nn.ClipGradByValue(1e-4)),
+                 zero=1, numerics="record")
+        recs = m._numerics_recorder.snapshot()["records"]
+        assert max(r["clip_ratio"] for r in recs) < 1.0
+
+
+class TestZeroAnalysis:
+    def test_sharded_step_clean_bill(self):
+        m = _fit(_model("adam"), zero=1)
+        report = analysis.analyze_model(m, [XS[:8]], [YS[:8]])
+        assert report.ok(), report.table()
+        assert "donation-safety" in report.passes_run
+        assert "collective-pairing" in report.passes_run
+        bad = [f for f in report.findings
+               if f.pass_id in ("donation-safety", "dead-grad",
+                                "collective-pairing")]
+        assert not bad, [f.message for f in bad]
+
+    def test_sharded_step_dead_grad_still_fires_on_frozen(self):
+        # the dead-grad guard keeps working through the sharded build:
+        # a frozen param is reported as info, a trainable-but-dead one
+        # would be an error (seeded the replicated way in
+        # test_analysis.py; here we prove the pass still runs with
+        # grad info against the zero-armed model)
+        m = _model("adam")
+        for n, p in m.network.named_parameters():
+            if n == "0.bias":
+                p.stop_gradient = True
+        _fit(m, zero=1)
+        report = analysis.analyze_model(m, [XS[:8]], [YS[:8]])
+        assert report.ok(), report.table()
+
+    def test_audit_variant_keeps_clean_bill(self):
+        m = _fit(_model("adam"), zero=1, numerics="record")
+        report = analysis.analyze_model(m, [XS[:8]], [YS[:8]])
+        assert report.ok(), report.table()
+
+
+class TestZeroPrefetch:
+    def test_train_prefetch_derives_dp_sharding(self):
+        m = _fit(_model("adam"), zero=1)
+        loader = DataLoader(_data(), batch_size=8)
+        want = zmod.dp_sharding(m._zero_mesh)
+        for x, y in m._maybe_prefetch(loader, True, train=True):
+            assert x.sharding.is_equivalent_to(want, x.ndim)
+            assert y.sharding.is_equivalent_to(want, y.ndim)
+
+    def test_explicit_prefetch_sharding_still_wins(self):
+        m = _fit(_model("adam"), zero=1)
+        rep = zmod.replicated_sharding(m._zero_mesh)
+        m._prefetch_sharding = rep
+        loader = DataLoader(_data(), batch_size=8)
+        for x, _ in m._maybe_prefetch(loader, True, train=True):
+            assert x.sharding.is_equivalent_to(rep, x.ndim)
+
+    def test_presharded_batches_train_end_to_end(self):
+        # the whole loop: prefetched dp-sharded batches feed the
+        # sharded donated step and the result matches the replicated
+        # trajectory (prefetch on is fit's default)
+        rep = _fit(_model("adam"), zero=0, prefetch=True)
+        shd = _fit(_model("adam"), zero=1, prefetch=True)
+        assert _params_close(rep, shd)
+
+
+class TestFlatLayout:
+    def test_padding_map_round_trip(self):
+        import jax.numpy as jnp
+        params = {"a": np.arange(10, dtype=np.float32).reshape(2, 5),
+                  "b": np.ones(7, np.float32)}
+        lay = zmod.FlatLayout.build(params, dp=4, chunk=8)
+        assert lay.padded % (4 * 8) == 0
+        flat = lay.flatten({k: jnp.asarray(v) for k, v in params.items()})
+        back = lay.unflatten(flat, {k: jnp.asarray(v)
+                                    for k, v in params.items()})
+        for k in params:
+            np.testing.assert_allclose(np.asarray(back[k]), params[k])
+
+    def test_group_ids_cover_members_and_pad(self):
+        from paddle_tpu.profiler import numerics as _num
+        params = {"0.weight": np.ones((3, 3), np.float32),
+                  "0.bias": np.ones(3, np.float32),
+                  "2.weight": np.ones((3, 2), np.float32)}
+        lay = zmod.FlatLayout.build(params, dp=2, chunk=4)
+        alay = _num.AuditLayout.build(sorted(params))
+        ids = lay.group_ids(alay)
+        assert ids.shape == (lay.padded,)
+        assert set(ids[:lay.total]) <= set(range(len(alay.groups)))
+        assert (ids[lay.total:] == len(alay.groups)).all()
+
+    def test_flag_seeded_zero_stage(self):
+        from paddle_tpu.framework import set_flags, get_flags
+        old = get_flags(["FLAGS_zero_stage"])["FLAGS_zero_stage"]
+        set_flags({"FLAGS_zero_stage": 1})
+        try:
+            m = _model("adam")
+            m.fit(_data(), batch_size=8, epochs=1, log_freq=4,
+                  shuffle=False, verbose=0)  # zero=None defers to flag
+            assert zmod.is_sharded_state(m._opt_state)
+        finally:
+            set_flags({"FLAGS_zero_stage": old})
