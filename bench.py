@@ -778,6 +778,155 @@ def bench_zero():
             "device_kind": _device_kind(), **pallas_state}
 
 
+def bench_spec():
+    """Speculative-vs-plain fused decode + int8-vs-fp32 paged pool
+    (``--bench-spec``): the two ISSUE-12 multipliers, measured.
+
+    Leg 1 — spec: the same greedy workload through the fused engine
+    WITH and WITHOUT a draft (draft = the target itself, the agreeing
+    ceiling; ``accept_rate`` and ``tokens_per_step`` are the published
+    evidence). Token parity between the two engines is a HARD FAIL —
+    a speculative path that changes greedy output is a bug, not a
+    number. Leg 2 — int8 blocks: a same-byte-budget capacity ratio
+    (``blocks_within_budget``) plus an int8-vs-fp32 token-agreement
+    drift check through the gather engine. Lands in the BENCH artifact
+    so ``--history`` gates accept rate, tokens/step and capacity from
+    round 1."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining
+    from paddle_tpu.serving import GenerationEngine, PagedKVPool
+
+    pallas_state = _setup_pallas()
+    if _smoke() or jax_backend_is_cpu():
+        cfg, slots, prompt, new, reqs, spec_k = \
+            GPTConfig.tiny(), 4, 12, 16, 8, 4
+    else:
+        cfg = GPTConfig.gpt2_small()
+        cfg.hidden_dropout_prob = 0.0
+        cfg.attention_dropout_prob = 0.0
+        slots, prompt, new, reqs, spec_k = 8, 64, 64, 16, 4
+    paddle.framework.random.seed(0)
+    model = GPTForPretraining(cfg)
+    model.eval()
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, cfg.vocab_size, prompt).astype(np.int32)
+               for _ in range(reqs)]
+    max_len = prompt + new + 8
+
+    def run(spec_draft, kv_dtype=None, block_size=16):
+        eng = GenerationEngine(
+            model, num_slots=slots, max_len=max_len, kv_layout="paged",
+            block_size=block_size, attention="fused",
+            kv_dtype=kv_dtype, spec_draft=spec_draft, spec_k=spec_k)
+        warm = [eng.submit(p, max_new_tokens=new) for p in prompts]
+        [h.result(timeout=600) for h in warm]
+        warm_snap = eng._sched.recorder.snapshot()
+        warm_last = warm_snap["cycles"][-1]["cycle"] \
+            if warm_snap["cycles"] else 0
+        t0 = time.perf_counter()
+        hs = [eng.submit(p, max_new_tokens=new) for p in prompts]
+        outs = [h.result(timeout=600) for h in hs]
+        wall = time.perf_counter() - t0
+        snap = eng._sched.recorder.snapshot()
+        timed = [c for c in snap["cycles"]
+                 if c["cycle"] > warm_last
+                 and c.get("decode_dispatch_ms", 0) > 0]
+        decode_ms = [c["decode_dispatch_ms"] + c["fetch_ms"]
+                     for c in timed]
+        decode_cycles = [c for c in timed if not c.get("chunk_tokens")]
+        stats = eng.stats()
+        eng.close()
+        r = {
+            "outs": outs,
+            "decode_step_ms": (round(float(np.median(decode_ms)), 3)
+                               if decode_ms else None),
+            "tokens_per_sec": round(reqs * new / wall, 1),
+            "wall_ms": round(wall * 1e3, 1),
+        }
+        if decode_cycles:
+            r["tokens_per_step"] = round(
+                sum(c.get("emitted", 0) for c in decode_cycles)
+                / max(1, sum(c.get("spec_slots") or c.get("active", 0)
+                             for c in decode_cycles)), 3)
+        if spec_draft is not None:
+            r["accept_rate"] = round(stats.get("spec_accept_rate", 0), 4)
+            r["spec_tokens_per_cycle"] = round(
+                stats.get("spec_tokens_per_cycle", 0), 3)
+        return r
+
+    plain = run(None)
+    spec = run(model)                    # agreeing draft: the ceiling
+    spec_parity = all(np.array_equal(a, b) for a, b in
+                      zip(plain.pop("outs"), spec.pop("outs")))
+    if not spec_parity:
+        raise RuntimeError(
+            "speculative decoding bench invalid: greedy spec output "
+            "diverged from the plain fused engine")
+    if not spec.get("spec_tokens_per_cycle", 0) > 1.0:
+        raise RuntimeError(
+            f"speculative decoding bench invalid: agreeing draft netted "
+            f"{spec.get('spec_tokens_per_cycle')} tokens/cycle (<= 1)")
+
+    # --- int8 leg: capacity ratio + token-agreement drift ------------
+    fp_pool_kw = dict(num_layers=cfg.num_hidden_layers,
+                      num_heads=cfg.num_attention_heads, block_size=16,
+                      head_dim=cfg.hidden_size // cfg.num_attention_heads)
+    fp_blocks = slots * (-(-max_len // 16))
+    # pure arithmetic — allocating a real fp32 pool just to read its
+    # capacity_bytes would zero-fill ~100 MB of device memory for a
+    # shape*itemsize multiply
+    fp_block_bytes = (cfg.num_hidden_layers * 2
+                      * cfg.num_attention_heads * 16
+                      * (cfg.hidden_size // cfg.num_attention_heads) * 4)
+    budget = (fp_blocks + 1) * fp_block_bytes     # +1: scratch block
+    q_blocks = PagedKVPool.blocks_within_budget(budget, dtype="int8",
+                                                **fp_pool_kw)
+    capacity_ratio = round(q_blocks / fp_blocks, 3)
+
+    def run_gather(kv_dtype):
+        eng = GenerationEngine(
+            model, num_slots=slots, max_len=max_len, kv_layout="paged",
+            block_size=16, kv_dtype=kv_dtype)
+        hs = [eng.submit(p, max_new_tokens=new) for p in prompts]
+        outs = [h.result(timeout=600) for h in hs]
+        eng.close()
+        return outs
+
+    fp_outs = run_gather(None)
+    q_outs = run_gather("int8")
+    gen = np.concatenate([o[prompt:] for o in fp_outs])
+    qgen = np.concatenate([o[prompt:] for o in q_outs])
+    token_agreement = round(float((gen == qgen).mean()), 4)
+    if token_agreement < 0.5:
+        raise RuntimeError(
+            f"int8 KV bench invalid: only {token_agreement:.0%} of "
+            f"greedy tokens agree with fp32 — drift is not 'bounded'")
+
+    out = {"metric": "spec_tokens_per_cycle",
+           "value": spec.get("spec_tokens_per_cycle"),
+           "unit": "tokens/cycle",
+           "spec": spec, "plain": plain, "spec_parity": spec_parity,
+           "spec_k": spec_k,
+           "int8": {"capacity_ratio_vs_fp32": capacity_ratio,
+                    "blocks_fp32": fp_blocks, "blocks_int8": q_blocks,
+                    "budget_bytes": budget,
+                    "token_agreement_vs_fp32": token_agreement},
+           "batch_requests": reqs, "prompt_len": prompt,
+           "new_tokens": new, "device_kind": _device_kind(),
+           **pallas_state}
+    if plain["decode_step_ms"] and spec["decode_step_ms"]:
+        # wall multiplier per decode step: how much one verify launch
+        # costs vs a plain decode launch (the accept rate buys it back)
+        out["spec_step_cost_ratio"] = round(
+            spec["decode_step_ms"] / plain["decode_step_ms"], 3)
+    if capacity_ratio < 2.0:
+        raise RuntimeError(
+            f"int8 KV bench invalid: same-budget capacity ratio "
+            f"{capacity_ratio} < 2.0")
+    return out
+
+
 def jax_backend_is_cpu():
     import jax
     return jax.default_backend() == "cpu"
@@ -810,7 +959,7 @@ BENCHES = {"gpt2": bench_gpt2, "resnet50": bench_resnet50,
            "resnet50_pipeline": bench_resnet50_pipeline,
            "eager": bench_eager, "serve": bench_serve,
            "gpt2_decode": bench_gpt2_decode, "attn": bench_attn,
-           "zero": bench_zero, "probe": bench_probe}
+           "zero": bench_zero, "spec": bench_spec, "probe": bench_probe}
 
 
 # ---------------------------------------------------------------------------
@@ -1513,6 +1662,13 @@ def main():
         if "error" not in extra:
             results["zero"] = extra
             _emit(results)
+    if remaining() > 90:
+        # speculative-vs-plain fused decode + int8-vs-fp32 pool
+        # capacity/drift (ISSUE 12; greedy parity HARD-FAILs inside)
+        extra = _run_child("spec", timeout=child_timeout())
+        if "error" not in extra:
+            results["spec"] = extra
+            _emit(results)
     if not _smoke():
         for name in ("gpt2", "bert"):
             if remaining() < 90 or not results.get(name, {}).get("pallas"):
@@ -1802,6 +1958,88 @@ def dry_run():
             }
 
         fused_canary = _fused_canary()
+
+        # ISSUE-12 speculative-decoding canary: the same greedy
+        # workload through the plain fused engine and a speculating one
+        # (agreeing draft) must be token-identical, the accept
+        # telemetry must be live, and every spec (q, table) bucket must
+        # trace exactly ONCE — verify rows must not cause a retrace
+        # storm. An int8-block engine rides the same prompts to prove
+        # the quantized path end to end.
+        def _spec_canary():
+            from paddle_tpu.framework import trace_probe
+            from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining
+            from paddle_tpu.serving import GenerationEngine
+
+            paddle.framework.random.seed(0)
+            model = GPTForPretraining(GPTConfig.tiny())
+            model.eval()
+            prompts = [np.arange(1, 1 + n, dtype=np.int32)
+                       for n in (3, 9, 17, 5)]
+            outs = {}
+            accept_before = monitor.stat_get("serving/spec_accept")
+            for kind in ("plain", "spec"):
+                eng = GenerationEngine(
+                    model, num_slots=4, max_len=64, kv_layout="paged",
+                    block_size=8, attention="fused", prefill_budget=16,
+                    spec_draft=model if kind == "spec" else None,
+                    spec_k=3)
+                handles = [eng.submit(p, max_new_tokens=6)
+                           for p in prompts]
+                outs[kind] = [h.result(timeout=300) for h in handles]
+                if kind == "spec":
+                    # warm second wave: zero retraces on warm buckets
+                    # (a bucket first-compiling in wave 2 would show
+                    # traces == 1 too; traces > 1 or a recorded cause
+                    # is the storm signal)
+                    handles = [eng.submit(p, max_new_tokens=6)
+                               for p in prompts]
+                    outs["spec_warm"] = [h.result(timeout=300)
+                                         for h in handles]
+                    sites = {k: v
+                             for k, v in trace_probe.snapshot().items()
+                             if k.endswith(f"#{eng._eid}")}
+                    stats = eng.stats()
+                    spec_sites = {
+                        k: v for k, v in sites.items()
+                        if k.startswith("serving/spec[")}
+                eng.close()
+            # int8 blocks over the same prompts (gather path: no
+            # block-size floor), vs the plain outputs
+            eng = GenerationEngine(model, num_slots=4, max_len=64,
+                                   kv_layout="paged", block_size=8,
+                                   kv_dtype="int8")
+            handles = [eng.submit(p, max_new_tokens=6) for p in prompts]
+            int8_outs = [h.result(timeout=300) for h in handles]
+            int8_stats = eng.stats()
+            eng.close()
+            gen = np.concatenate([o[len(p):]
+                                  for o, p in zip(outs["plain"], prompts)])
+            qgen = np.concatenate([o[len(p):]
+                                   for o, p in zip(int8_outs, prompts)])
+            return {
+                "parity": all(np.array_equal(a, b) for a, b in
+                              zip(outs["plain"], outs["spec"])),
+                "warm_parity": all(np.array_equal(a, b) for a, b in
+                                   zip(outs["plain"], outs["spec_warm"])),
+                "accept_live":
+                    monitor.stat_get("serving/spec_accept")
+                    - accept_before > 0
+                    and stats["spec_proposed"] > 0,
+                "accept_rate": stats["spec_accept_rate"],
+                "tokens_per_cycle": stats.get("spec_tokens_per_cycle"),
+                "one_trace": bool(spec_sites) and all(
+                    s["traces"] == 1 and not s["causes"]
+                    for s in spec_sites.values()),
+                "zero_warm_retraces": all(
+                    s["traces"] == 1 and not s["causes"]
+                    for s in sites.values()),
+                "int8_dtype": int8_stats["kv_dtype"],
+                "int8_token_agreement":
+                    float((gen == qgen).mean()),
+            }
+
+        spec_canary = _spec_canary()
 
         # serve-load canary (ISSUE 6): a seeded mini open-arrival run
         # through the SAME harness --serve-load uses — every trace
@@ -2106,6 +2344,25 @@ def dry_run():
         and fused_canary["chunk_tokens"] >= 40,
         "fused_step_clean": fused_canary["report"].ok(),
         "fused_one_trace_per_bucket": fused_canary["one_trace"],
+        # ISSUE-12 speculative decoding + int8 KV blocks: greedy spec
+        # output token-identical to the plain fused engine (cold AND
+        # warm waves), serving/spec_accept live with tokens/cycle > 1
+        # on the agreeing draft, one trace per spec (q, table) bucket
+        # with zero retraces on the warm wave (no retrace storm from
+        # verify rows), and the int8-block engine's greedy tokens agree
+        # with fp32 on this workload
+        "spec_parity": spec_canary["parity"]
+        and spec_canary["warm_parity"],
+        "spec_accept_live": spec_canary["accept_live"]
+        and (spec_canary["tokens_per_cycle"] or 0) > 1.0,
+        "spec_one_trace_per_bucket": spec_canary["one_trace"]
+        and spec_canary["zero_warm_retraces"],
+        # the canary model is UNTRAINED (near-tie argmaxes), so int8
+        # noise may flip a couple of tokens — bounded drift here means
+        # "mostly agrees"; exact trained-margin parity is asserted by
+        # tests/test_serving_paging.py::TestQuantizedBlocks
+        "spec_int8_agrees": spec_canary["int8_dtype"] == "int8"
+        and spec_canary["int8_token_agreement"] >= 0.75,
         # ISSUE-6 serving observability: the mini serve-load run's
         # traces all completed in lifecycle order, the per-token decode
         # cadence histogram is live, per-engine stats() latency derives
@@ -2196,6 +2453,9 @@ def dry_run():
                       "fused_prefill_chunks":
                           fused_canary["prefill_chunks"],
                       "fused_chunk_tokens": fused_canary["chunk_tokens"],
+                      "spec": {k: spec_canary[k] for k in
+                               ("accept_rate", "tokens_per_cycle",
+                                "int8_token_agreement")},
                       "serve_load": serve_load_canary["summary"],
                       "numerics": {
                           "inject_step": numerics_canary["inject_step"],
@@ -2242,6 +2502,11 @@ if __name__ == "__main__":
         # needs >= 4 devices — on CPU run under
         # XLA_FLAGS=--xla_force_host_platform_device_count=4
         print("RESULT " + json.dumps(bench_zero()))
+    elif "--bench-spec" in sys.argv[1:]:
+        # standalone speculative-decoding + int8-KV microbench (same
+        # child schema): spec-vs-plain decode ms, accept rate,
+        # tokens/step, int8 capacity + drift; parity hard-fails
+        print("RESULT " + json.dumps(bench_spec()))
     elif "--dry-run" in sys.argv[1:]:
         dry_run()
     else:

@@ -24,9 +24,21 @@ cycle per token, see serving/engine.py) — watch ``prefix_hit_ratio``
 and ``prefill_tokens_saved`` in the end-of-run ``engine.stats()``
 report.
 
+With ``--spec`` (implies ``--fused``) a 2-layer draft sharing the
+target's embeddings proposes ``--spec-k`` tokens per slot per cycle and
+the target verifies them all in ONE fused ragged launch — watch the
+``spec accept rate`` and ``tokens/cycle`` lines: an agreeing draft
+multiplies decode throughput without changing a single output token
+(greedy speculative output is token-identical by construction). With
+``--kv-dtype int8`` the paged pool stores quantized blocks with
+per-block max-abs scales, so the same device byte budget admits ~4x
+the blocks — the ``block capacity`` line shows the same-budget
+comparison against fp32.
+
 Usage:
     python examples/serve_gpt2.py [--clients 12] [--slots 8] [--mp 2]
-                                  [--paged]
+                                  [--paged] [--fused] [--spec]
+                                  [--kv-dtype int8]
 """
 import argparse
 import threading
@@ -105,9 +117,25 @@ def main():
     ap.add_argument("--fused", action="store_true",
                     help="fused ragged-paged-attention Pallas step + "
                          "chunked prefill (implies --paged)")
+    ap.add_argument("--spec", action="store_true",
+                    help="speculative decoding: a 2-layer draft sharing "
+                         "the target's embeddings proposes --spec-k "
+                         "tokens per cycle, verified in one fused "
+                         "ragged launch (implies --fused)")
+    ap.add_argument("--spec-k", type=int, default=4)
+    ap.add_argument("--kv-dtype", default=None,
+                    choices=["float32", "int8"],
+                    help="paged KV block storage dtype; int8 stores "
+                         "quantized blocks with per-block max-abs "
+                         "scales (~4x blocks per byte budget)")
     args = ap.parse_args()
+    if args.spec:
+        args.fused = True
     if args.fused:
         args.paged = True
+    if args.kv_dtype and not args.paged:
+        ap.error("--kv-dtype requires --paged/--fused/--spec (quantized "
+                 "blocks live in the paged pool)")
 
     paddle.seed(0)
     model = build_model(args.train_steps)
@@ -119,11 +147,20 @@ def main():
         # max_len 128 keeps the pow2 bucket ladder (16..128) feasible
         # for every prompt/max_new the clients draw — on the 16/32/64
         # ladder a worst re-admission feed past 64 tokens would have
-        # no bucket and submit() would reject it
+        # no bucket and submit() would reject it.
+        # int8 on the FUSED path needs block_size >= 32 (the Mosaic
+        # int8 sublane count of the kernel's KV scratch); the gather
+        # path has no such floor
+        block_size = 32 if (args.kv_dtype == "int8" and args.fused) \
+            else 8
         engine = GenerationEngine(
-            model, num_slots=args.slots, max_len=128, min_bucket=16,
-            kv_layout="paged", block_size=8,
-            attention="fused" if args.fused else "gather")
+            model, num_slots=args.slots, max_len=128,
+            min_bucket=max(16, block_size),
+            kv_layout="paged", block_size=block_size,
+            attention="fused" if args.fused else "gather",
+            kv_dtype=args.kv_dtype,
+            spec_draft="auto" if args.spec else None,
+            spec_k=args.spec_k)
     else:
         engine = GenerationEngine(model, num_slots=args.slots, max_len=96,
                                   min_bucket=8)
@@ -204,6 +241,28 @@ def main():
         print(f"  fused: attention={stats['attention']}, "
               f"prefill chunks {stats['prefill_chunks']} "
               f"({stats['chunked_prefill_tokens']} tokens chunked)")
+    if args.spec:
+        print(f"  spec: accept rate {stats['spec_accept_rate']:.2f} "
+              f"({stats['spec_accepted']}/{stats['spec_proposed']} "
+              f"draft tokens), "
+              f"tokens/cycle {stats.get('spec_tokens_per_cycle', 1.0):.2f} "
+              f"(k={stats['spec_k']}, draft {stats['draft_layers']}L)")
+    if args.paged:
+        # same-byte-budget capacity: how many blocks THIS pool's budget
+        # would buy at fp32 vs its actual dtype — the quantized-KV
+        # "more requests per pool" line
+        from paddle_tpu.serving import PagedKVPool
+        budget = stats["kv_pool_capacity_bytes"]
+        pool = engine._pool
+        fp32_blocks = PagedKVPool.blocks_within_budget(
+            budget, num_layers=pool.num_layers,
+            num_heads=pool.num_heads, block_size=pool.block_size,
+            head_dim=pool.head_dim, dtype="float32")
+        print(f"  block capacity: {stats['num_blocks']} x "
+              f"{stats['block_size']}-token {stats['kv_dtype']} blocks "
+              f"in {budget // 1024} KiB "
+              f"(same budget at fp32: {fp32_blocks} blocks, "
+              f"{stats['num_blocks'] / max(1, fp32_blocks):.1f}x)")
 
 
 if __name__ == "__main__":

@@ -14,6 +14,7 @@ import numpy as np
 # Canonical dtype objects (np.dtype instances) -------------------------------
 bool_ = np.dtype("bool")
 uint8 = np.dtype("uint8")
+uint32 = np.dtype("uint32")   # raw PRNG key words (runtime-keyed export)
 int8 = np.dtype("int8")
 int16 = np.dtype("int16")
 int32 = np.dtype("int32")
@@ -28,6 +29,7 @@ complex128 = np.dtype("complex128")
 _STR_ALIASES = {
     "bool": bool_,
     "uint8": uint8,
+    "uint32": uint32,
     "int8": int8,
     "int16": int16,
     "int32": int32,

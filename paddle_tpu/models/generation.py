@@ -30,7 +30,9 @@ from ..framework.tensor import Tensor, no_grad_guard
 __all__ = ["GenerationConfig", "generate", "save_for_serving",
            "shard_params_megatron", "build_slot_prefill_fn",
            "build_slot_decode_fn", "build_paged_prefill_fn",
-           "build_paged_decode_fn", "build_fused_step_fn"]
+           "build_paged_decode_fn", "build_fused_step_fn",
+           "build_draft_prefill_fn", "build_draft_propose_fn",
+           "build_spec_verify_fn", "make_draft_model"]
 
 
 def shard_params_megatron(model, mesh, mp_axis="mp"):
@@ -58,7 +60,8 @@ def shard_params_megatron(model, mesh, mp_axis="mp"):
         p._data = jax.device_put(p._data, sh)
 
 
-def save_for_serving(model, path, batch, prompt_len, **generate_kwargs):
+def save_for_serving(model, path, batch, prompt_len, runtime_key=False,
+                     **generate_kwargs):
     """Export the COMPILED generate loop as an inference artifact: one
     StableHLO program (prefill + while_loop decode + sampling, weights
     baked in) serving ``ids [batch, prompt_len] -> tokens``. Loadable by
@@ -70,22 +73,83 @@ def save_for_serving(model, path, batch, prompt_len, **generate_kwargs):
     fused_multi_transformer inference programs for analysis_predictor
     (paddle/fluid/inference/api/analysis_predictor.cc:1).
 
-    Sampling caveat: the PRNG key is a trace CONSTANT in the artifact,
-    so a sampled export returns the same tokens for a given prompt on
-    every call — sampling picks a fixed draw per artifact, it does not
-    re-randomize per request. That is only sane when the caller chose
-    the draw, so an unseeded ``do_sample=True`` export is rejected."""
+    Sampling: with ``runtime_key=True`` the PRNG key is a RUNTIME INPUT
+    of the artifact — it serves ``(ids [batch, prompt_len] int32,
+    key [2] uint32) -> tokens``, so the caller draws per request and
+    two calls on the same prompt can differ (the reference's serving
+    loop draws per request; this was the standing per-request-sampling
+    gap). Requires ``do_sample=True`` and no ``seed`` (the seed IS the
+    runtime key now).
+
+    Without ``runtime_key`` the key is a trace CONSTANT in the
+    artifact, so a sampled export returns the same tokens for a given
+    prompt on every call — sampling picks a fixed draw per artifact,
+    it does not re-randomize per request. That is only sane when the
+    caller chose the draw, so an unseeded ``do_sample=True`` export is
+    rejected (pass ``runtime_key=True`` for per-request draws)."""
+    import jax.numpy as jnp
+
     from .. import jit
+    from ..nn.layer.layers import get_buffers_tree
     from ..static import InputSpec
+
+    if runtime_key:
+        unknown = sorted(set(generate_kwargs) - set(_GEN_DEFAULTS))
+        if unknown:
+            raise ValueError(
+                f"runtime_key export got unsupported kwargs: {unknown}")
+        resolved = dict(_GEN_DEFAULTS)
+        resolved.update(generate_kwargs)
+        if not resolved["do_sample"]:
+            raise ValueError(
+                "runtime_key=True requires do_sample=True: a greedy "
+                "export never consumes the key, so a key input would "
+                "be dead weight in the artifact's signature")
+        if resolved["seed"] is not None:
+            raise ValueError(
+                "runtime_key=True replaces seed=: the key arrives per "
+                "call at serve time (jax.random.PRNGKey(seed) makes "
+                "one)")
+        if resolved["num_beams"] != 1:
+            raise ValueError("runtime_key=True requires num_beams=1 "
+                             "(beam search is deterministic)")
+        static_key = (
+            int(resolved["max_new_tokens"]), True,
+            int(resolved["top_k"]), float(resolved["top_p"]),
+            None if resolved["eos_token_id"] is None
+            else int(resolved["eos_token_id"]),
+            int(resolved["pad_token_id"]), False)
+        fn = _build_generate_fn(model, int(batch), int(prompt_len),
+                                static_key)
+        was_training = model.training
+        model.eval()
+        try:
+            params = {k: p._data for k, p in model.named_parameters()}
+            buffers = get_buffers_tree(model)
+            temp = float(resolved["temperature"])
+
+            def _serve_keyed(ids, key):
+                return fn(params, buffers, ids, key, jnp.float32(temp),
+                          jnp.int32(0))
+
+            return jit.save(
+                _serve_keyed, path,
+                input_spec=[InputSpec([int(batch), int(prompt_len)],
+                                      "int32"),
+                            InputSpec([2], "uint32")])
+        finally:
+            if was_training:
+                model.train()
 
     if generate_kwargs.get("do_sample") and \
             generate_kwargs.get("seed") is None:
         raise ValueError(
-            "save_for_serving(do_sample=True) requires an explicit seed: "
-            "the key is baked into the artifact as a constant, so the "
-            "export freezes ONE draw per prompt — make that choice "
-            "explicit (and avoid silently advancing the global RNG at "
-            "export time)")
+            "save_for_serving(do_sample=True) requires an explicit seed "
+            "(or runtime_key=True for per-request draws): the key is "
+            "baked into the artifact as a constant, so the export "
+            "freezes ONE draw per prompt — make that choice explicit "
+            "(and avoid silently advancing the global RNG at export "
+            "time)")
 
     def _serve(ids):
         return generate(model, ids, **generate_kwargs)
@@ -109,16 +173,16 @@ class GenerationConfig:
     length_penalty: float = 0.0   # GNMT ((5+len)/6)^alpha; 0 = off
 
 
-def _pick_token(logits, key, do_sample, top_k, top_p, temperature):
-    """logits: jnp [B, V] f32 -> jnp [B] int32. top_k/top_p are static
-    (part of the compile key); temperature is traced."""
+def _filter_logits(logits, top_k, top_p, temperature):
+    """The sampling truncation shared by :func:`_pick_token` and
+    :func:`_sample_probs`: temperature scaling, then static top-k /
+    top-p masking to ``-inf``. Works over any leading batch shape
+    (``[..., V]``)."""
     import jax
     import jax.numpy as jnp
-    if not do_sample:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = logits / jnp.maximum(temperature, 1e-6)
     if top_k and top_k > 0:
-        kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
     if top_p < 1.0:
         sort_idx = jnp.argsort(-logits, axis=-1)
@@ -129,7 +193,103 @@ def _pick_token(logits, key, do_sample, top_k, top_p, temperature):
         inv = jnp.argsort(sort_idx, axis=-1)
         keep = jnp.take_along_axis(keep_sorted, inv, axis=-1)
         logits = jnp.where(keep, logits, -jnp.inf)
-    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+    return logits
+
+
+def _pick_token(logits, key, do_sample, top_k, top_p, temperature):
+    """logits: jnp [B, V] f32 -> jnp [B] int32. top_k/top_p are static
+    (part of the compile key); temperature is traced."""
+    import jax
+    import jax.numpy as jnp
+    if not do_sample:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return jax.random.categorical(
+        key, _filter_logits(logits, top_k, top_p, temperature),
+        axis=-1).astype(jnp.int32)
+
+
+def _sample_probs(logits, sample_mask, top_k, top_p, temperature):
+    """The per-row SAMPLING DISTRIBUTION as explicit probabilities
+    ``[N, V]`` f32 — what speculative decoding's rejection sampling
+    needs on both sides of the accept ratio. Sampled rows get the
+    softmax of the ``_filter_logits`` truncation (the distribution
+    ``categorical(filtered_logits)`` draws from — categorical is
+    shift-invariant, so the two agree exactly); greedy rows get the
+    DEGENERATE one-hot at the argmax, which makes greedy speculative
+    acceptance collapse to token equality with exact parity.
+
+    ``sample_mask [N]`` bool and ``temperature [N]`` are traced."""
+    import jax
+    import jax.numpy as jnp
+    v = logits.shape[-1]
+    onehot = jax.nn.one_hot(jnp.argmax(logits, axis=-1), v,
+                            dtype=jnp.float32)
+    soft = jax.nn.softmax(
+        _filter_logits(logits, top_k, top_p, temperature[..., None]),
+        axis=-1)
+    return jnp.where(sample_mask[..., None], soft, onehot)
+
+
+def _categorical_probs(key, probs):
+    """Draw per-row tokens from explicit probabilities ``[..., V]``
+    (zero-probability entries are exactly ``-inf`` in log space, so a
+    one-hot distribution picks its token DETERMINISTICALLY — the greedy
+    degenerate case of the speculative sampler)."""
+    import jax
+    import jax.numpy as jnp
+    logp = jnp.where(probs > 0, jnp.log(jnp.maximum(probs, 1e-38)),
+                     -jnp.inf)
+    return jax.random.categorical(key, logp, axis=-1).astype(jnp.int32)
+
+
+def _spec_accept(p_probs, q_probs, drafts, n_spec, base_probs, key):
+    """Device-side speculative rejection sampling (one decode cycle).
+
+    Per slot ``s``, the draft proposed ``drafts[s, :n_spec[s]]`` and
+    the verify launch produced the target's sampling distribution
+    ``p_probs[s, j]`` at each candidate row ``j`` (the row that FED
+    candidate ``j``'s predecessor); ``q_probs[s, j]`` is the draft's
+    proposal distribution for that candidate. Standard rejection
+    sampling: candidate ``d`` is accepted while ``u * q(d) < p(d)``
+    (strict, with ``u ~ U[0, 1)``); the first rejected position emits a
+    token from the residual ``max(p - q, 0)`` renormalized. Greedy rows
+    carry one-hot distributions, collapsing all of this to exact
+    argmax-equality acceptance and argmax correction — the degenerate
+    case with EXACT parity to the non-speculative engine.
+
+    ``base_probs [S, V]`` is each slot's last-row distribution, drawn
+    for slots that verified nothing this launch (``n_spec == 0``: a
+    prefill chunk finishing its feed emits its first token from it).
+
+    Returns ``(accepted [S] int32, token [S] int32)`` — ``token`` is
+    the corrected/residual draw when ``accepted < n_spec``, the base
+    draw when ``n_spec == 0``, and unused garbage when every candidate
+    was accepted (the scheduler emits the accepted drafts instead).
+    """
+    import jax
+    import jax.numpy as jnp
+    s_, k_, _v = p_probs.shape
+    ku, kr, kb = jax.random.split(key, 3)
+    u = jax.random.uniform(ku, (s_, k_))
+    pd = jnp.take_along_axis(p_probs, drafts[..., None], axis=-1)[..., 0]
+    qd = jnp.take_along_axis(q_probs, drafts[..., None], axis=-1)[..., 0]
+    valid = jnp.arange(k_)[None, :] < n_spec[:, None]
+    acc = valid & (u * qd < pd)
+    accepted = jnp.sum(jnp.cumprod(acc.astype(jnp.int32), axis=1),
+                       axis=1)                  # leading-accept count
+    ridx = jnp.minimum(accepted, k_ - 1)
+    pr = jnp.take_along_axis(p_probs, ridx[:, None, None], axis=1)[:, 0]
+    qr = jnp.take_along_axis(q_probs, ridx[:, None, None], axis=1)[:, 0]
+    res = jnp.maximum(pr - qr, 0.0)
+    rsum = jnp.sum(res, axis=-1, keepdims=True)
+    # a rejection implies p != q somewhere, so the residual has mass;
+    # the p fallback only guards numerically-identical distributions
+    res = jnp.where(rsum > 0, res / jnp.maximum(rsum, 1e-38), pr)
+    rejected = accepted < n_spec
+    token = jnp.where(rejected & (n_spec > 0),
+                      _categorical_probs(kr, res),
+                      _categorical_probs(kb, base_probs))
+    return accepted, token
 
 
 def _mask_preamble(attn_mask, batch, max_new):
@@ -558,16 +718,88 @@ def _append_nonfinite_flag(nxt, logits):
 
 
 # ---------------------------------------------------------------------------
+# quantized KV blocks (PagedKVPool(dtype="int8"): per-block max-abs
+# scales in a parallel [L, 2, num_blocks + 1, H] f32 array — the EQuARX
+# per-chunk scheme of the PR-10 gradient wire, applied to KV storage)
+# ---------------------------------------------------------------------------
+
+def _quant_append(pool, scales, li, kv, wb, off, rows, qmax):
+    """Scatter per-row K/V values into a QUANTIZED block pool.
+
+    ``rows [N, H, Dh]`` f32 land at ``(block wb[n], offset off[n])`` of
+    plane ``(li, kv)``. Per-block max-abs scales grow monotonically: a
+    row whose magnitude exceeds its block's current scale bumps the
+    scale (scatter-max) and the touched blocks are REQUANTIZED to the
+    new scale in the same step — when the scale is unchanged the
+    requantize ratio is exactly 1.0, so steady-state appends never
+    erode earlier rows. Duplicate ``wb`` entries (a prefill chunk
+    writing several offsets of one block, or pad rows aimed at the
+    scratch block) are safe: the scatter-max makes every duplicate see
+    the same old/new scales, so their requantized block bytes are
+    identical, and the row offsets are distinct by construction.
+    Returns ``(pool, scales)``."""
+    import jax.numpy as jnp
+    rows = rows.astype(jnp.float32)
+    rmax = jnp.max(jnp.abs(rows), axis=-1) / qmax             # [N, H]
+    old = scales[li, kv]                                      # [NB+1, H]
+    new = old.at[wb].max(rmax)
+    nb = jnp.maximum(new[wb], 1e-30)                          # [N, H]
+    ratio = jnp.where(new[wb] > 0, old[wb] / nb, 1.0)
+    blk = pool[li, kv, wb].astype(jnp.float32)                # [N,H,bs,Dh]
+    requant = jnp.clip(jnp.round(blk * ratio[..., None, None]),
+                       -qmax, qmax).astype(pool.dtype)
+    pool = pool.at[li, kv, wb].set(requant)
+    qrow = jnp.clip(jnp.round(jnp.where(new[wb][..., None] > 0,
+                                        rows / nb[..., None], 0.0)),
+                    -qmax, qmax).astype(pool.dtype)
+    pool = pool.at[li, kv, wb, :, off, :].set(qrow)
+    return pool, scales.at[li, kv].set(new)
+
+
+def _quant_write_blocks(pool, scales, li, kv, table, vals, qmax):
+    """Whole-block quantized write (the paged prefill path): ``vals
+    [Tp, H, bs, Dh]`` f32 replace the blocks named by ``table [Tp]``,
+    each with a fresh per-(block, head) max-abs scale — freshly
+    allocated blocks have no prior content worth rescaling. Returns
+    ``(pool, scales)``."""
+    import jax.numpy as jnp
+    vals = vals.astype(jnp.float32)
+    sc = jnp.max(jnp.abs(vals), axis=(-2, -1)) / qmax         # [Tp, H]
+    denom = jnp.maximum(sc, 1e-30)[..., None, None]
+    q = jnp.clip(jnp.round(jnp.where(sc[..., None, None] > 0,
+                                     vals / denom, 0.0)),
+                 -qmax, qmax).astype(pool.dtype)
+    pool = pool.at[li, kv, table].set(q)
+    return pool, scales.at[li, kv, table].set(sc)
+
+
+def _dequant_gather(pool, scales, li, kv, tables):
+    """Gather-path read of a quantized pool: materialize the virtual
+    cache through the page table and multiply the per-block scales
+    back in AFTER the pool read ("the gather path multiplies after the
+    pool read"). ``tables [S, T]`` -> f32 ``[S, T, H, bs, Dh]``."""
+    import jax.numpy as jnp
+    return pool[li, kv][tables].astype(jnp.float32) \
+        * scales[li, kv][tables][..., None, None]
+
+
+# ---------------------------------------------------------------------------
 # paged step functions (block-pooled KV with page tables and prefix reuse;
 # consumed by paddle_tpu/serving/paging.py — see serving/engine.py)
 # ---------------------------------------------------------------------------
 
 def build_paged_prefill_fn(model, bucket_len, block_size, top_k=0,
-                           top_p=1.0, probe=None):
+                           top_p=1.0, probe=None, quantized=False,
+                           qmax=127.0):
     """Build the per-bucket prefill step of the PAGED serving engine.
 
     Returns ``fn(params, buffers, pool, ids, key_valid, table, plen,
-    sample, temperature, key) -> (pool, first_token, key)``:
+    sample, temperature, key) -> (pool, first_token, key)`` — with
+    ``quantized=True`` (``PagedKVPool(dtype="int8")``) the per-block
+    scale array is threaded alongside the pool: ``fn(params, buffers,
+    pool, scales, ids, ...) -> (pool, scales, first_token, key)``, the
+    K/V computed in the model dtype and written through
+    :func:`_quant_write_blocks`:
 
     * ``pool`` — the block pool ``[layers, 2, num_blocks + 1, heads,
       block_size, head_dim]`` (``serving.PagedKVPool.data``); the
@@ -609,8 +841,9 @@ def build_paged_prefill_fn(model, bucket_len, block_size, top_k=0,
     Dh = gpt.cfg.hidden_size // H
     top_k = min(int(top_k), gpt.cfg.vocab_size)
 
-    def fn(params, buffers, pool, ids, key_valid, table, plen, sample,
-           temperature, key):
+    def fn(params, buffers, pool, *rest):
+        (scales, ids, key_valid, table, plen, sample, temperature,
+         key) = rest if quantized else (None,) + rest
         if probe is not None:  # runs at trace time only (jit caches)
             probe.record(_probe.sig_of([pool, ids, key_valid, table]),
                          {"bucket": Lb, "table": Tp})
@@ -625,10 +858,13 @@ def build_paged_prefill_fn(model, bucket_len, block_size, top_k=0,
                     0))
                 x = gpt.wte(Tensor(ids, stop_gradient=True)) \
                     + gpt.wpe(pos_ids)
-                new_pool = pool
+                # quantized pools keep the layer-local K/V in the model
+                # dtype; quantization happens at block-write time
+                cdt = x._data.dtype if quantized else pool.dtype
+                new_pool, new_scales = pool, scales
                 for li, block in enumerate(gpt.blocks):
-                    ck = jnp.zeros((1, Lb, H, Dh), new_pool.dtype)
-                    cv = jnp.zeros((1, Lb, H, Dh), new_pool.dtype)
+                    ck = jnp.zeros((1, Lb, H, Dh), cdt)
+                    cv = jnp.zeros((1, Lb, H, Dh), cdt)
                     x, ck, cv = block.prefill(x, ck, cv,
                                               key_valid=key_valid)
                     # [1, Lb, H, Dh] -> per-block [Tp, H, bs, Dh] rows
@@ -636,8 +872,14 @@ def build_paged_prefill_fn(model, bucket_len, block_size, top_k=0,
                                        (0, 2, 1, 3))
                     vb = jnp.transpose(cv[0].reshape(Tp, bs, H, Dh),
                                        (0, 2, 1, 3))
-                    new_pool = new_pool.at[li, 0, table].set(kb)
-                    new_pool = new_pool.at[li, 1, table].set(vb)
+                    if quantized:
+                        new_pool, new_scales = _quant_write_blocks(
+                            new_pool, new_scales, li, 0, table, kb, qmax)
+                        new_pool, new_scales = _quant_write_blocks(
+                            new_pool, new_scales, li, 1, table, vb, qmax)
+                    else:
+                        new_pool = new_pool.at[li, 0, table].set(kb)
+                        new_pool = new_pool.at[li, 1, table].set(vb)
                 x = gpt.ln_f(x)
                 z = jnp.int32(0)
                 p = jnp.asarray(plen, jnp.int32).reshape(())
@@ -650,13 +892,17 @@ def build_paged_prefill_fn(model, bucket_len, block_size, top_k=0,
                 sampled = _pick_token(logits, sub, True, top_k, top_p,
                                       temperature)
                 first = jnp.where(sample, sampled, greedy)
+        if quantized:
+            return new_pool, new_scales, first, key
         return new_pool, first, key
 
     return fn
 
 
 def build_paged_decode_fn(model, num_slots, table_len, block_size,
-                          top_k=0, top_p=1.0, probe=None):
+                          top_k=0, top_p=1.0, probe=None,
+                          quantized=False, qmax=127.0,
+                          debug_logits=False):
     """Build the per-table-bucket decode step of the PAGED serving
     engine: gather-based paged attention over the block table.
 
@@ -681,7 +927,13 @@ def build_paged_decode_fn(model, num_slots, table_len, block_size,
       mixed greedy/sampled batches via :func:`_pick_token`); the
       caller jits with ``donate_argnums`` on ``pool``, and the
       engine's ``analyze()`` must report the program donation-safe and
-      host-sync-free.
+      host-sync-free;
+    * ``quantized=True`` (``PagedKVPool(dtype="int8")``) threads the
+      per-block scale array beside the pool (``fn(params, buffers,
+      pool, scales, tokens, ...) -> (pool, scales, next_tokens,
+      key)``): appends go through :func:`_quant_append` and the
+      gathered virtual cache is dequantized by :func:`_dequant_gather`
+      — the ``[lo, pos]`` mask, sentinel and sampling are unchanged.
     """
     import jax
     import jax.numpy as jnp
@@ -700,8 +952,9 @@ def build_paged_decode_fn(model, num_slots, table_len, block_size,
     Dh = gpt.cfg.hidden_size // H
     top_k = min(int(top_k), gpt.cfg.vocab_size)
 
-    def fn(params, buffers, pool, tokens, pos, lo, tables, sample_mask,
-           temperature, key):
+    def fn(params, buffers, pool, *rest):
+        (scales, tokens, pos, lo, tables, sample_mask, temperature,
+         key) = rest if quantized else (None,) + rest
         if probe is not None:  # runs at trace time only (jit caches)
             probe.record(_probe.sig_of([pool, tokens, pos, lo, tables,
                                         temperature]),
@@ -718,21 +971,35 @@ def build_paged_decode_fn(model, num_slots, table_len, block_size,
                 sl = jnp.arange(S)
                 wb = tables[sl, pos // bs]        # write block per slot
                 off = pos % bs
-                new_pool = pool
+                new_pool, new_scales = pool, scales
                 for li, block in enumerate(gpt.blocks):
                     q, k, v = block._qkv(x)
-                    kh = k._data[:, 0].astype(new_pool.dtype)  # [S, H, Dh]
-                    vh = v._data[:, 0].astype(new_pool.dtype)
-                    new_pool = new_pool.at[li, 0, wb, :, off, :].set(kh)
-                    new_pool = new_pool.at[li, 1, wb, :, off, :].set(vh)
+                    if quantized:
+                        new_pool, new_scales = _quant_append(
+                            new_pool, new_scales, li, 0, wb, off,
+                            k._data[:, 0], qmax)
+                        new_pool, new_scales = _quant_append(
+                            new_pool, new_scales, li, 1, wb, off,
+                            v._data[:, 0], qmax)
+                        kg = _dequant_gather(new_pool, new_scales, li, 0,
+                                             tables).astype(k._data.dtype)
+                        vg = _dequant_gather(new_pool, new_scales, li, 1,
+                                             tables).astype(v._data.dtype)
+                    else:
+                        kh = k._data[:, 0].astype(new_pool.dtype)
+                        vh = v._data[:, 0].astype(new_pool.dtype)
+                        new_pool = new_pool.at[
+                            li, 0, wb, :, off, :].set(kh)
+                        new_pool = new_pool.at[
+                            li, 1, wb, :, off, :].set(vh)
+                        kg = new_pool[li, 0][tables]
+                        vg = new_pool[li, 1][tables]
                     # gather the virtual cache through the page table:
                     # [NB+1, H, bs, Dh][tables] -> [S, T, H, bs, Dh]
-                    kf = jnp.transpose(new_pool[li, 0][tables],
-                                       (0, 1, 3, 2, 4)).reshape(
-                                           S, T * bs, H, Dh)
-                    vf = jnp.transpose(new_pool[li, 1][tables],
-                                       (0, 1, 3, 2, 4)).reshape(
-                                           S, T * bs, H, Dh)
+                    kf = jnp.transpose(kg, (0, 1, 3, 2, 4)).reshape(
+                        S, T * bs, H, Dh)
+                    vf = jnp.transpose(vg, (0, 1, 3, 2, 4)).reshape(
+                        S, T * bs, H, Dh)
                     a = F.scaled_dot_product_attention(
                         q, Tensor(kf, stop_gradient=True),
                         Tensor(vf, stop_gradient=True), attn_mask=mask)
@@ -745,13 +1012,56 @@ def build_paged_decode_fn(model, num_slots, table_len, block_size,
                                       temperature[:, None])
                 nxt = jnp.where(sample_mask, sampled, greedy)
                 nxt = _append_nonfinite_flag(nxt, logits)
-        return new_pool, nxt, key
+        extra = (logits,) if debug_logits else ()
+        if quantized:
+            return (new_pool, new_scales, nxt) + extra + (key,)
+        return (new_pool, nxt) + extra + (key,)
 
     return fn
 
 
+def _fused_tower(gpt, x, pool, scales, write_block, write_off, blk_seq,
+                 seq_qstart, seq_pos0, tables, lo, kv_len, quantized,
+                 qmax):
+    """The fused ragged transformer tower shared by
+    :func:`build_fused_step_fn` and :func:`build_spec_verify_fn`: per
+    layer, scatter every flattened row's K/V through the page table
+    (quantized pools go through :func:`_quant_append`), run the fused
+    ragged-paged-attention Pallas kernel over the block pool, and apply
+    the block tail. Returns ``(ln_f(x), pool, scales)``."""
+    import jax.numpy as jnp
+
+    from ..ops.ragged_paged_attention import ragged_paged_attention
+
+    for li, block in enumerate(gpt.blocks):
+        q, k, v = block._qkv(x)
+        # per-row scatter through the page table: row i's K/V land at
+        # (write_block[i], write_off[i]) — pad rows hit the scratch
+        # block nobody reads
+        if quantized:
+            pool, scales = _quant_append(
+                pool, scales, li, 0, write_block, write_off,
+                k._data[0], qmax)
+            pool, scales = _quant_append(
+                pool, scales, li, 1, write_block, write_off,
+                v._data[0], qmax)
+        else:
+            pool = pool.at[li, 0, write_block, :, write_off, :].set(
+                k._data[0].astype(pool.dtype))
+            pool = pool.at[li, 1, write_block, :, write_off, :].set(
+                v._data[0].astype(pool.dtype))
+        qh = jnp.transpose(q._data, (0, 2, 1, 3))[0]
+        a = ragged_paged_attention(
+            qh, pool, li, blk_seq, seq_qstart, seq_pos0, tables, lo,
+            kv_len, scales=scales)
+        a = jnp.transpose(a[None], (0, 2, 1, 3))
+        x = block._tail(x, Tensor(a, stop_gradient=True))
+    return gpt.ln_f(x), pool, scales
+
+
 def build_fused_step_fn(model, num_slots, q_rows, table_len, block_size,
-                        top_k=0, top_p=1.0, probe=None):
+                        top_k=0, top_p=1.0, probe=None, quantized=False,
+                        qmax=127.0):
     """Build THE fused ragged serving step: one jitted program that
     advances a RAGGED batch of mixed prefill-chunk and decode rows
     through every layer with the fused paged-attention Pallas kernel
@@ -792,13 +1102,18 @@ def build_fused_step_fn(model, num_slots, q_rows, table_len, block_size,
 
     One trace per ``(q_rows bucket, table bucket)`` — the fused twin of
     the prefill/table pow2 bucket discipline, watched by ``probe``.
+    ``quantized=True`` threads the per-block scale array beside the
+    pool (``fn(params, buffers, pool, scales, token_ids, ...) ->
+    (pool, scales, next_tokens, key)``): rows scatter through
+    :func:`_quant_append` and the kernel dequantizes in-register off
+    the scale array riding its scalar-prefetch metadata.
     """
     import jax
     import jax.numpy as jnp
 
     from ..framework import trace_probe as _probe
     from ..nn.layer.layers import functional_state
-    from ..ops.ragged_paged_attention import BLOCK_Q, ragged_paged_attention
+    from ..ops.ragged_paged_attention import BLOCK_Q
 
     gpt = model.gpt if hasattr(model, "gpt") else model
     S, Q, T, bs = (int(num_slots), int(q_rows), int(table_len),
@@ -812,9 +1127,11 @@ def build_fused_step_fn(model, num_slots, q_rows, table_len, block_size,
         raise ValueError(f"table_len must be >= 1, got {T}")
     top_k = min(int(top_k), gpt.cfg.vocab_size)
 
-    def fn(params, buffers, pool, token_ids, qpos, write_block, write_off,
-           blk_seq, seq_qstart, seq_pos0, tables, lo, kv_len, last_row,
-           sample_mask, temperature, key):
+    def fn(params, buffers, pool, *rest):
+        (scales, token_ids, qpos, write_block, write_off, blk_seq,
+         seq_qstart, seq_pos0, tables, lo, kv_len, last_row,
+         sample_mask, temperature, key) = \
+            rest if quantized else (None,) + rest
         if probe is not None:  # runs at trace time only (jit caches)
             probe.record(_probe.sig_of([pool, token_ids, tables]),
                          {"q": Q, "table": T})
@@ -826,25 +1143,10 @@ def build_fused_step_fn(model, num_slots, q_rows, table_len, block_size,
                 x = gpt.wte(Tensor(token_ids[None, :],
                                    stop_gradient=True)) \
                     + gpt.wpe(Tensor(qpos[None, :]))
-                new_pool = pool
-                for li, block in enumerate(gpt.blocks):
-                    q, k, v = block._qkv(x)
-                    kh = k._data[0].astype(new_pool.dtype)  # [Q, H, Dh]
-                    vh = v._data[0].astype(new_pool.dtype)
-                    # per-row scatter through the page table: row i's
-                    # K/V land at (write_block[i], write_off[i]) — pad
-                    # rows hit the scratch block nobody reads
-                    new_pool = new_pool.at[
-                        li, 0, write_block, :, write_off, :].set(kh)
-                    new_pool = new_pool.at[
-                        li, 1, write_block, :, write_off, :].set(vh)
-                    qh = jnp.transpose(q._data, (0, 2, 1, 3))[0]
-                    a = ragged_paged_attention(
-                        qh, new_pool, li, blk_seq, seq_qstart, seq_pos0,
-                        tables, lo, kv_len)
-                    a = jnp.transpose(a[None], (0, 2, 1, 3))
-                    x = block._tail(x, Tensor(a, stop_gradient=True))
-                x = gpt.ln_f(x)
+                x, new_pool, new_scales = _fused_tower(
+                    gpt, x, pool, scales, write_block, write_off,
+                    blk_seq, seq_qstart, seq_pos0, tables, lo, kv_len,
+                    quantized, qmax)
                 last = x._data[0, last_row]             # [S, E]
                 logits = gpt.logits(
                     Tensor(last[:, None, :]))._data[:, 0].astype(
@@ -855,9 +1157,304 @@ def build_fused_step_fn(model, num_slots, q_rows, table_len, block_size,
                                       temperature[:, None])
                 nxt = jnp.where(sample_mask, sampled, greedy)
                 nxt = _append_nonfinite_flag(nxt, logits)
+        if quantized:
+            return new_pool, new_scales, nxt, key
         return new_pool, nxt, key
 
     return fn
+
+
+# ---------------------------------------------------------------------------
+# speculative decoding (the draft-propose / fused-verify pair consumed by
+# GenerationEngine(spec_draft=..., spec_k=...) — see serving/engine.py)
+# ---------------------------------------------------------------------------
+
+def build_spec_verify_fn(model, num_slots, q_rows, spec_k, table_len,
+                         block_size, top_k=0, top_p=1.0, probe=None,
+                         quantized=False, qmax=127.0):
+    """The multi-row-per-slot VERIFY variant of
+    :func:`build_fused_step_fn`: one fused ragged launch where each
+    speculating slot contributes its candidate rows (``[last_token,
+    d_1, ..., d_{n-1}]`` — draft candidates are just extra ragged rows,
+    exactly like a prefill chunk) and the per-row logits drive
+    :func:`_spec_accept`'s standard rejection sampling, with exact
+    greedy parity as the degenerate case. Slots mid-prefill keep
+    chunking through the same launch (``n_spec == 0`` rows are plain
+    feed rows whose last-row pick is the non-speculative path).
+
+    Returns ``fn(params, buffers, pool, [scales,] token_ids, qpos,
+    write_block, write_off, blk_seq, seq_qstart, seq_pos0, tables, lo,
+    kv_len, last_row, n_spec, draft_toks, draft_probs, sample_mask,
+    temperature, key) -> (pool, [scales,] out, key)`` where
+
+    * ``n_spec [S]`` int32 — candidates verified per slot this launch
+      (0 = plain feed/decode rows);
+    * ``draft_toks [S, spec_k]`` int32 / ``draft_probs [S, spec_k, V]``
+      f32 — the DEVICE-side proposals of the draft loop (the host never
+      fetched them); rows ``seq_qstart + 1 + j`` of ``token_ids`` are
+      overlaid with ``draft_toks[:, j]`` in-trace, because those token
+      values only exist on the device;
+    * ``out [2S + S*spec_k + 1]`` int32 — ``[accepted (S) | corrected
+      token (S) | echoed draft tokens (S*spec_k) | logits-finite
+      sentinel]``: everything the scheduler needs from its ONE fetch
+      per cycle (accepted drafts are emitted host-side from the echo).
+
+    One trace per (q bucket, table bucket), same as the fused step.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..framework import trace_probe as _probe
+    from ..nn.layer.layers import functional_state
+    from ..ops.ragged_paged_attention import BLOCK_Q
+
+    gpt = model.gpt if hasattr(model, "gpt") else model
+    S, Q, K, T = (int(num_slots), int(q_rows), int(spec_k),
+                  int(table_len))
+    if S < 1:
+        raise ValueError(f"num_slots must be >= 1, got {S}")
+    if K < 1:
+        raise ValueError(f"spec_k must be >= 1, got {K}")
+    if Q < BLOCK_Q or Q % BLOCK_Q:
+        raise ValueError(
+            f"q_rows must be a positive multiple of {BLOCK_Q}, got {Q}")
+    if T < 1:
+        raise ValueError(f"table_len must be >= 1, got {T}")
+    top_k = min(int(top_k), gpt.cfg.vocab_size)
+
+    def fn(params, buffers, pool, *rest):
+        (scales, token_ids, qpos, write_block, write_off, blk_seq,
+         seq_qstart, seq_pos0, tables, lo, kv_len, last_row, n_spec,
+         draft_toks, draft_probs, sample_mask, temperature, key) = \
+            rest if quantized else (None,) + rest
+        if probe is not None:  # runs at trace time only (jit caches)
+            probe.record(_probe.sig_of([pool, token_ids, tables,
+                                        draft_toks]),
+                         {"q": Q, "table": T, "k": K})
+        with functional_state(model, params, buffers):
+            with no_grad_guard():
+                # overlay the device-side draft tokens into their
+                # verify rows: row qstart + 1 + j carries candidate
+                # d_{j+1}'s PREDECESSOR d_j... i.e. the fed token at
+                # verify position j+1 is draft_toks[:, j]; invalid
+                # (j >= n_spec - 1) overlays are dropped out of bounds
+                rows = seq_qstart[:, None] + 1 + jnp.arange(K)[None, :]
+                ok = jnp.arange(K)[None, :] < (n_spec[:, None] - 1)
+                safe = jnp.where(ok, rows, Q)         # Q = out of range
+                token_ids = token_ids.at[safe.reshape(-1)].set(
+                    draft_toks.reshape(-1), mode="drop")
+                x = gpt.wte(Tensor(token_ids[None, :],
+                                   stop_gradient=True)) \
+                    + gpt.wpe(Tensor(qpos[None, :]))
+                x, new_pool, new_scales = _fused_tower(
+                    gpt, x, pool, scales, write_block, write_off,
+                    blk_seq, seq_qstart, seq_pos0, tables, lo, kv_len,
+                    quantized, qmax)
+                # gather the rows whose logits are actually read —
+                # the S*K verify rows plus each slot's last row —
+                # BEFORE the LM head: running the [vocab] matmul over
+                # all Q padded ragged rows would cost Q/(S*(K+1))x
+                # more for nothing (a chunk-heavy cycle reads none of
+                # its chunk rows' logits)
+                vrows = jnp.clip(
+                    seq_qstart[:, None] + jnp.arange(K)[None, :],
+                    0, Q - 1)                          # [S, K]
+                sel = x._data[0][jnp.concatenate(
+                    [vrows.reshape(-1), last_row])]    # [S*K+S, E]
+                logits = gpt.logits(
+                    Tensor(sel[:, None, :]))._data[:, 0].astype(
+                        jnp.float32)                   # [S*K+S, V]
+                p = _sample_probs(
+                    logits[:S * K],
+                    jnp.repeat(sample_mask, K),
+                    top_k, top_p,
+                    jnp.repeat(temperature, K)).reshape(S, K, -1)
+                base = _sample_probs(logits[S * K:], sample_mask,
+                                     top_k, top_p, temperature)
+                key, sub = jax.random.split(key)
+                accepted, token = _spec_accept(
+                    p, draft_probs, draft_toks, n_spec, base, sub)
+                bad = jnp.any(~jnp.isfinite(logits)).astype(jnp.int32)
+                out = jnp.concatenate([
+                    accepted.astype(jnp.int32), token,
+                    draft_toks.astype(jnp.int32).reshape(-1),
+                    bad[None]])
+        if quantized:
+            return new_pool, new_scales, out, key
+        return new_pool, out, key
+
+    return fn
+
+
+def build_draft_prefill_fn(model, bucket_len, max_len, probe=None):
+    """Context prefill into the DRAFT model's dense slot pool
+    (speculative decoding): when a slot starts decoding, the draft's
+    KV cache must cover the target's context ``[0, pos)`` before it
+    can propose. Prompts are RIGHT-padded to the bucket (virtual index
+    0 — the draft mirrors the paged pool's alignment, so ``lo == 0``
+    and draft positions equal target positions token for token).
+
+    Returns ``fn(params, buffers, pool, ids, key_valid, slot) ->
+    pool`` over the draft pool ``[draft_layers, 2, slots, draft_heads,
+    max_len, draft_head_dim]``; no token is sampled — proposals come
+    from the :func:`build_draft_propose_fn` loop that follows. The
+    caller jits with ``donate_argnums`` on ``pool``.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ..framework import trace_probe as _probe
+    from ..nn.layer.layers import functional_state
+
+    gpt = model.gpt if hasattr(model, "gpt") else model
+    Lb = int(bucket_len)
+    if Lb < 1:
+        raise ValueError(f"bucket_len must be >= 1, got {Lb}")
+    if Lb > int(max_len):
+        raise ValueError(f"bucket_len {Lb} exceeds pool max_len {max_len}")
+    if Lb > gpt.cfg.max_position_embeddings:
+        raise ValueError(
+            f"bucket_len {Lb} exceeds max_position_embeddings="
+            f"{gpt.cfg.max_position_embeddings}")
+
+    def fn(params, buffers, pool, ids, key_valid, slot):
+        if probe is not None:  # runs at trace time only (jit caches)
+            probe.record(_probe.sig_of([pool, ids, key_valid]),
+                         {"bucket": Lb})
+        with functional_state(model, params, buffers):
+            with no_grad_guard():
+                caches = gpt.init_cache(1, Lb, pool.dtype)
+                _, caches = gpt.prefill(
+                    Tensor(ids, stop_gradient=True), caches,
+                    key_valid=key_valid)
+                z = jnp.int32(0)
+                s = jnp.asarray(slot, jnp.int32).reshape(())
+                new_pool = pool
+                for li, (ck, cv) in enumerate(caches):
+                    kvb = jnp.stack([jnp.swapaxes(ck[0], 0, 1),
+                                     jnp.swapaxes(cv[0], 0, 1)])
+                    new_pool = lax.dynamic_update_slice(
+                        new_pool, kvb[None, :, None].astype(new_pool.dtype),
+                        (jnp.int32(li), z, s, z, z, z))
+        return new_pool
+
+    return fn
+
+
+def build_draft_propose_fn(model, num_slots, max_len, top_k=0, top_p=1.0,
+                           probe=None):
+    """One autoregressive DRAFT proposal step (speculative decoding):
+    the engine runs ``spec_k`` of these back to back, feeding each
+    step's proposal into the next, all device-side — the host never
+    fetches a draft token (they echo back through the verify launch's
+    one fetch).
+
+    Returns ``fn(params, buffers, pool, feed_tok, pos, lo, sample_mask,
+    temperature, key) -> (pool, proposal, probs, key)``:
+
+    * ``feed_tok [S]`` int32 — the token each slot feeds this step (the
+      slot's last accepted token on step 0 — a host array — or the
+      previous step's device-side ``proposal``);
+    * ``proposal [S]`` int32 — drawn from the draft's own sampling
+      distribution (greedy slots: the argmax, deterministically);
+    * ``probs [S, V]`` f32 — THE proposal distribution ``q`` (one-hot
+      for greedy slots), consumed by the verify launch's rejection
+      sampling;
+    * the caller jits with ``donate_argnums`` on ``pool``.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..framework import trace_probe as _probe
+    from ..nn import functional as F
+    from ..nn.layer.layers import functional_state
+
+    gpt = model.gpt if hasattr(model, "gpt") else model
+    S = int(num_slots)
+    L = int(max_len)
+    if S < 1:
+        raise ValueError(f"num_slots must be >= 1, got {S}")
+    if L > gpt.cfg.max_position_embeddings:
+        raise ValueError(
+            f"max_len {L} exceeds max_position_embeddings="
+            f"{gpt.cfg.max_position_embeddings}")
+    top_k = min(int(top_k), gpt.cfg.vocab_size)
+
+    def fn(params, buffers, pool, feed_tok, pos, lo, sample_mask,
+           temperature, key):
+        if probe is not None:  # runs at trace time only (jit caches)
+            probe.record(_probe.sig_of([pool, feed_tok, pos, lo,
+                                        temperature]), {"slots": S})
+        with functional_state(model, params, buffers):
+            with no_grad_guard():
+                logical = (pos - lo)[:, None]
+                x = gpt.wte(Tensor(feed_tok[:, None],
+                                   stop_gradient=True)) \
+                    + gpt.wpe(Tensor(logical))
+                r = jnp.arange(L)
+                key_valid = (r[None, :] >= lo[:, None]) \
+                    & (r[None, :] <= pos[:, None])
+                mask = Tensor(key_valid[:, None, None, :])
+                sl = jnp.arange(S)
+                new_pool = pool
+                for li, block in enumerate(gpt.blocks):
+                    q, k, v = block._qkv(x)
+                    kh = k._data[:, 0].astype(new_pool.dtype)
+                    vh = v._data[:, 0].astype(new_pool.dtype)
+                    new_pool = new_pool.at[li, 0, sl, :, pos, :].set(kh)
+                    new_pool = new_pool.at[li, 1, sl, :, pos, :].set(vh)
+                    k_full = Tensor(jnp.swapaxes(new_pool[li, 0], 1, 2),
+                                    stop_gradient=True)
+                    v_full = Tensor(jnp.swapaxes(new_pool[li, 1], 1, 2),
+                                    stop_gradient=True)
+                    a = F.scaled_dot_product_attention(
+                        q, k_full, v_full, attn_mask=mask)
+                    x = block._tail(x, a)
+                x = gpt.ln_f(x)
+                logits = gpt.logits(x)._data[:, 0].astype(jnp.float32)
+                probs = _sample_probs(logits, sample_mask, top_k, top_p,
+                                      temperature)
+                key, sub = jax.random.split(key)
+                prop = _categorical_probs(sub, probs)
+        return new_pool, prop, probs, key
+
+    return fn
+
+
+def make_draft_model(model, num_layers=2):
+    """Build the default speculative-decoding draft: a GPT with the
+    target's config truncated to ``num_layers`` blocks, SHARING the
+    target's token/position embeddings (the same ``Parameter`` objects
+    — zero extra embedding memory, and the tied LM head stays aligned
+    with the target's vocabulary) and initializing its blocks and
+    final LayerNorm from the target's first ``num_layers`` blocks —
+    the cheapest draft that agrees with the target more often than
+    chance. Any user model exposing the same GPT surface (and vocab)
+    can be passed to ``GenerationEngine(spec_draft=...)`` instead.
+    """
+    from dataclasses import replace
+
+    from .gpt import GPTModel
+
+    gpt = model.gpt if hasattr(model, "gpt") else model
+    n = int(num_layers)
+    if not 1 <= n <= gpt.cfg.num_hidden_layers:
+        raise ValueError(
+            f"num_layers must be in [1, {gpt.cfg.num_hidden_layers}], "
+            f"got {num_layers}")
+    draft = GPTModel(replace(gpt.cfg, num_hidden_layers=n))
+    draft.wte = gpt.wte            # SHARED parameters, not copies
+    draft.wpe = gpt.wpe
+    for i in range(n):
+        src = dict(gpt.blocks[i].named_parameters())
+        for name, p in draft.blocks[i].named_parameters():
+            p._data = src[name]._data
+    src = dict(gpt.ln_f.named_parameters())
+    for name, p in draft.ln_f.named_parameters():
+        p._data = src[name]._data
+    draft.eval()
+    return draft
 
 
 class _UnsetType:

@@ -52,7 +52,7 @@ from jax.experimental.pallas import tpu as pltpu
 from .pallas_kernels import _interpret, _x64_off
 
 __all__ = ["ragged_paged_attention", "ragged_layout", "BLOCK_Q",
-           "MIN_KV_BLOCK"]
+           "MIN_KV_BLOCK", "min_kv_block_for"]
 
 _NEG_INF = -1e30
 
@@ -65,16 +65,42 @@ BLOCK_Q = 8
 # sublane count has no legal TPU layout
 MIN_KV_BLOCK = 8
 
+# QUANTIZED storage needs a taller minimum tile (the Mosaic
+# (sublane, 128) law: int8/fp8 sublane count is 32) — float pools keep
+# the historical MIN_KV_BLOCK floor (sub-sublane float blocks already
+# ran on the padded-layout path)
+_MIN_KV_BLOCK_BY_DTYPE = {"int8": 32, "float8_e4m3fn": 32}
+
+
+def min_kv_block_for(dtype) -> int:
+    """Smallest Mosaic-legal KV ``block_size`` for a pool storage
+    dtype (the scratch block's sublane count)."""
+    return _MIN_KV_BLOCK_BY_DTYPE.get(jnp.dtype(dtype).name,
+                                      MIN_KV_BLOCK)
+
 
 def _rpa_kernel(blk_seq_ref, qstart_ref, pos0_ref, tables_ref, lo_ref,
-                kvlen_ref, q_ref, pool_ref, o_ref, k_scr, v_scr, k_sem,
-                v_sem, *, layer, block_q, block_size, scale):
+                kvlen_ref, *rest, layer, block_q, block_size, scale,
+                quantized=False):
     """One (head, q-block) grid step: walk the owning sequence's page
     table, DMA each KV block HBM→VMEM, stream online softmax.
+
+    Quantized pools (int8 blocks) ride a 7th scalar-prefetch operand:
+    THIS layer's per-block max-abs scale slice ``[2, NB + 1, H]`` f32 —
+    each DMA'd block is dequantized IN-REGISTER (one scalar multiply
+    per (block, head) after the VMEM read), so the HBM traffic stays at
+    the narrow storage width and nothing quantized ever reaches the
+    MXU.
 
     i32-typed constants: bare python ints in kernel index math get
     materialized as i64 by Mosaic under the framework's global x64 (the
     pallas_kernels idiom; the call sites also trace under _x64_off)."""
+    if quantized:
+        (scales_ref, q_ref, pool_ref, o_ref, k_scr, v_scr, k_sem,
+         v_sem) = rest
+    else:
+        scales_ref = None
+        q_ref, pool_ref, o_ref, k_scr, v_scr, k_sem, v_sem = rest
     h = pl.program_id(0)
     b = pl.program_id(1)
     seq = blk_seq_ref[b]
@@ -117,6 +143,17 @@ def _rpa_kernel(blk_seq_ref, qstart_ref, pos0_ref, tables_ref, lo_ref,
             cv.wait()
             k_blk = k_scr[...]                          # [bs, Dh]
             v_blk = v_scr[...]
+            if quantized:
+                # in-register dequant: the per-(block, head) max-abs
+                # scale rides the scalar-prefetch metadata; HBM moved
+                # int8, compute sees floats. scales_ref is THIS
+                # layer's [2, NB+1, H] slice — prefetching all L
+                # layers' scales into SMEM would waste an L-fold
+                # bigger scalar-memory footprint per launch
+                k_blk = (k_blk.astype(jnp.float32)
+                         * scales_ref[0, pid, h]).astype(q.dtype)
+                v_blk = (v_blk.astype(jnp.float32)
+                         * scales_ref[1, pid, h]).astype(q.dtype)
             # operands in storage dtype, f32 accumulation (MXU contract
             # shared with the flash kernels)
             s = jax.lax.dot_general(
@@ -150,7 +187,7 @@ def _rpa_kernel(blk_seq_ref, qstart_ref, pos0_ref, tables_ref, lo_ref,
 
 
 def ragged_paged_attention(q, pool, layer, blk_seq, seq_qstart, seq_pos0,
-                           tables, lo, kv_len, *, scale=None,
+                           tables, lo, kv_len, *, scales=None, scale=None,
                            block_q: int = BLOCK_Q):
     """Fused paged attention over one layer of the serving block pool.
 
@@ -163,29 +200,45 @@ def ragged_paged_attention(q, pool, layer, blk_seq, seq_qstart, seq_pos0,
       ``tables [S, T]``, ``lo [S]``, ``kv_len [S]`` — int32
       scalar-prefetch metadata (``ragged_layout`` builds the first
       three);
+    * ``scales`` — REQUIRED for quantized pools (int8/fp8 storage):
+      the per-block max-abs scale array ``[L, 2, NB + 1, H]`` f32,
+      riding the scalar-prefetch path into SMEM so each DMA'd block
+      dequantizes in-register;
     * returns ``[H, Qp, Dh]`` in ``q``'s dtype.
     """
     h, qp, dh = q.shape
     L, two, nb1, hp, bs, dhp = pool.shape
+    quantized = pool.dtype.name in ("int8", "float8_e4m3fn")
     if (hp, dhp) != (h, dh):
         raise ValueError(
             f"pool heads/head_dim {(hp, dhp)} != q {(h, dh)}")
     if qp % block_q:
         raise ValueError(
             f"padded q rows {qp} must be a multiple of block_q {block_q}")
-    if bs < MIN_KV_BLOCK:
+    min_bs = min_kv_block_for(pool.dtype)
+    if bs < min_bs:
         raise ValueError(
-            f"block_size {bs} < {MIN_KV_BLOCK}: the KV scratch block has "
-            f"no legal (8, 128) TPU tiling below the sublane count")
+            f"block_size {bs} < {min_bs}: the {pool.dtype.name} KV "
+            f"scratch block has no legal (sublane, 128) TPU tiling "
+            f"below the dtype's sublane count")
+    if quantized and scales is None:
+        raise ValueError(
+            f"a {pool.dtype.name} pool is quantized storage: pass the "
+            f"per-block scale array (PagedKVPool.scales)")
+    if scales is not None and tuple(scales.shape) != (L, 2, nb1, h):
+        raise ValueError(
+            f"scales shape {tuple(scales.shape)} != per-block layout "
+            f"{(L, 2, nb1, h)}")
     if not 0 <= int(layer) < L:
         raise ValueError(f"layer {layer} out of range [0, {L})")
     scale = float(scale) if scale is not None else 1.0 / math.sqrt(dh)
     n_qblk = qp // block_q
+    quant = scales is not None
     kernel = functools.partial(
         _rpa_kernel, layer=int(layer), block_q=int(block_q),
-        block_size=int(bs), scale=scale)
+        block_size=int(bs), scale=scale, quantized=quant)
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=6,
+        num_scalar_prefetch=7 if quant else 6,
         grid=(h, n_qblk),
         in_specs=[
             pl.BlockSpec((1, block_q, dh), lambda hh, b, *_: (hh, b, 0)),
@@ -200,19 +253,22 @@ def ragged_paged_attention(q, pool, layer, blk_seq, seq_qstart, seq_pos0,
             pltpu.SemaphoreType.DMA,
         ],
     )
+    prefetch = [jnp.asarray(blk_seq, jnp.int32),
+                jnp.asarray(seq_qstart, jnp.int32),
+                jnp.asarray(seq_pos0, jnp.int32),
+                jnp.asarray(tables, jnp.int32),
+                jnp.asarray(lo, jnp.int32),
+                jnp.asarray(kv_len, jnp.int32)]
+    if quant:
+        # only THIS layer's [2, NB+1, H] scale slice goes to SMEM
+        prefetch.append(jnp.asarray(scales, jnp.float32)[int(layer)])
     with _x64_off():
         return pl.pallas_call(
             kernel,
             grid_spec=grid_spec,
             out_shape=jax.ShapeDtypeStruct((h, qp, dh), q.dtype),
             interpret=_interpret(),
-        )(jnp.asarray(blk_seq, jnp.int32),
-          jnp.asarray(seq_qstart, jnp.int32),
-          jnp.asarray(seq_pos0, jnp.int32),
-          jnp.asarray(tables, jnp.int32),
-          jnp.asarray(lo, jnp.int32),
-          jnp.asarray(kv_len, jnp.int32),
-          q, pool)
+        )(*prefetch, q, pool)
 
 
 def ragged_layout(q_lens: Sequence[int], pos0s: Sequence[int], *,
@@ -272,12 +328,15 @@ def ragged_layout(q_lens: Sequence[int], pos0s: Sequence[int], *,
 
 
 def reference_ragged_attention(q_rows, pool, layer, row_seq, row_pos,
-                               tables, lo, scale=None):
+                               tables, lo, scale=None, scales=None):
     """Numpy oracle for the kernel (tests): per-row full-precision
     softmax attention over the row's ``[lo, pos]`` window gathered
     through the page table. ``q_rows [N, H, Dh]``, ``row_seq/row_pos
-    [N]``."""
+    [N]``; ``scales`` dequantizes an int8 pool (per-block max-abs,
+    the kernel's in-register multiply done up front)."""
     pool = np.asarray(pool, np.float32)
+    if scales is not None:
+        pool = pool * np.asarray(scales, np.float32)[..., None, None]
     q_rows = np.asarray(q_rows, np.float32)
     n, h, dh = q_rows.shape
     bs = pool.shape[4]

@@ -31,7 +31,12 @@ Two KV layouts share the surface: the dense slot pool above, and
 KV management (:mod:`.paging`) with per-request page tables, ref-counted
 block sharing and a prefix cache, so admission gates on FREE BLOCKS
 instead of worst-case slot stripes and a repeated system prompt skips
-prefill entirely.
+prefill entirely. ``kv_dtype="int8"`` stores the blocks QUANTIZED with
+per-block max-abs scales (~4x blocks per byte budget, ~2x+ concurrent
+requests), and ``spec_draft=`` + ``spec_k=`` (fused engines) adds
+draft-model SPECULATIVE DECODING — k candidate tokens verified per
+slot per cycle in one fused ragged launch, exact greedy parity,
+``stats()['spec_tokens_per_cycle']`` > 1 on agreeing workloads.
 
 SLO observability (ISSUE 6): every handle carries ``handle.trace`` — a
 :class:`~.tracing.RequestTrace` of timestamped lifecycle events with

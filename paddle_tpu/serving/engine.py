@@ -125,7 +125,8 @@ class GenerationEngine:
                  min_bucket: int = 8, seed: int = 0, dtype=None,
                  kv_layout: str = "dense", block_size: int = 16,
                  num_blocks: Optional[int] = None,
-                 attention: str = "gather"):
+                 attention: str = "gather", kv_dtype=None,
+                 spec_draft=None, spec_k: int = 4):
         import jax
 
         from ..models.generation import build_slot_decode_fn
@@ -137,19 +138,34 @@ class GenerationEngine:
         if attention not in ("gather", "fused"):
             raise ValueError(
                 f"attention must be 'gather' or 'fused', got {attention!r}")
+        if kv_dtype is not None and kv_layout != "paged":
+            raise ValueError(
+                "kv_dtype (quantized KV blocks) requires "
+                "kv_layout='paged': the per-block max-abs scales live "
+                "beside the block pool (PagedKVPool.scales); the dense "
+                "slot pool has no block granularity to scale")
         if attention == "fused":
-            from ..ops.ragged_paged_attention import MIN_KV_BLOCK
+            from ..ops.ragged_paged_attention import (MIN_KV_BLOCK,
+                                                      min_kv_block_for)
             if kv_layout != "paged":
                 raise ValueError(
                     "attention='fused' is the fused RAGGED PAGED "
                     "attention path — it requires kv_layout='paged' "
                     "(the dense slot pool has no page tables to walk)")
-            if int(block_size) < MIN_KV_BLOCK:
+            need = min_kv_block_for(kv_dtype) if kv_dtype is not None \
+                else MIN_KV_BLOCK
+            if int(block_size) < need:
                 raise ValueError(
-                    f"attention='fused' requires block_size >= "
-                    f"{MIN_KV_BLOCK}: the kernel's (block_size, head_dim)"
-                    f" KV scratch has no legal (8, 128) TPU tiling below "
-                    f"the sublane count")
+                    f"attention='fused' requires block_size >= {need} "
+                    f"for kv_dtype={kv_dtype or 'float'}: the kernel's "
+                    f"(block_size, head_dim) KV scratch has no legal "
+                    f"TPU tiling below the dtype's sublane count")
+        if spec_draft is not None and attention != "fused":
+            raise ValueError(
+                "spec_draft (speculative decoding) requires "
+                "attention='fused': the k-token verify IS one fused "
+                "ragged launch — each slot's candidate tokens are extra "
+                "ragged rows, exactly like a prefill chunk")
         self._fused = attention == "fused"
         gpt = model.gpt if hasattr(model, "gpt") else model
         cfg = gpt.cfg
@@ -185,10 +201,12 @@ class GenerationEngine:
             self._pool = PagedKVPool(
                 cfg.num_hidden_layers, num_slots, cfg.num_attention_heads,
                 max_len, head_dim, block_size=block_size,
-                num_blocks=num_blocks, dtype=dtype, min_bucket=mb)
+                num_blocks=num_blocks, dtype=kv_dtype or dtype,
+                min_bucket=mb)
             self._decode_jit = None       # per-table-bucket instead
             self._decode_jits = {}        # table bucket -> jitted step
             self._fused_jits = {}         # (q bucket, table bucket) -> step
+            self._spec_jits = {}          # (q, table) -> spec verify step
             self._copy_jit = None         # lazy COW device block copy
         else:
             self._pool = KVCachePool(
@@ -207,6 +225,15 @@ class GenerationEngine:
                 donate_argnums=(2,))
         self._closed = False
         self._close_lock = threading.Lock()
+        # speculative decoding (fused engines only): a small draft
+        # model proposes spec_k tokens per decode slot per cycle; the
+        # target verifies all of them in ONE fused ragged launch
+        self._spec = spec_draft is not None
+        self._spec_k = int(spec_k)
+        if self._spec:
+            if self._spec_k < 1:
+                raise ValueError(f"spec_k must be >= 1, got {spec_k}")
+            self._init_draft(spec_draft, max_len)
         # per-engine compute accounting (scheduler-thread writes, host
         # ints): FLOPs of the decode programs actually DISPATCHED — a
         # paged engine runs different table-bucket programs with very
@@ -218,7 +245,9 @@ class GenerationEngine:
             self._pool, self._run_prefill, self._run_decode,
             max_queue=max_queue, prefill_budget=prefill_budget,
             do_copy=self._run_copy if self._paged else None,
-            do_chunked_step=self._run_fused_step if self._fused else None)
+            do_chunked_step=self._run_fused_step if self._fused else None,
+            do_spec_step=self._run_spec_step if self._spec else None,
+            spec_k=self._spec_k)
 
     # -- client side -------------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens: int = 32, *,
@@ -399,17 +428,46 @@ class GenerationEngine:
                 "prefix_hit_ratio": hits / max(1, hits + misses),
                 "prefill_tokens_saved": pool.tokens_saved,
                 "prefix_evictions": pool.evictions,
+                # tiered KV bytes: block storage vs the scale side-array
+                # (zero for float pools) — int8 blocks are the whole
+                # point of the ~2x-requests-per-budget win, so the
+                # operator view must show where the bytes went
+                "kv_dtype": pool.dtype.name,
+                "kv_bytes": {
+                    "blocks": pool.block_storage_bytes,
+                    "scales": pool.scales_bytes,
+                },
             })
         if self._fused:
             # chunked-prefill observability: lifetime chunk counters
             # plus ring-window chunk token throughput, so the "long
             # prompts no longer monopolize a cycle" win is measurable
+            # (ONE ring pass serves the spec figures below too)
             s["prefill_chunks"] = self._sched.prefill_chunks
             s["chunked_prefill_tokens"] = self._sched.chunk_tokens
             thr = self._sched.recorder.cycle_throughput()
             if thr["cycle_secs"] > 0 and thr["chunk_tokens"] > 0:
                 s["chunked_prefill_tokens_per_sec"] = \
                     thr["chunk_tokens"] / thr["cycle_secs"]
+        if self._spec:
+            # the two numbers that prove (or disprove) the multiplier:
+            # how often the draft agrees, and how many tokens a decode
+            # slot actually nets per cycle (1.0 = plain decode)
+            s["spec_k"] = self._spec_k
+            s["spec_cycles"] = self._sched.spec_cycles
+            s["spec_proposed"] = self._sched.spec_proposed
+            s["spec_accepted"] = self._sched.spec_accepted
+            s["spec_accept_rate"] = self._sched.spec_accepted \
+                / max(1, self._sched.spec_proposed)
+            if thr["spec_slots"] > 0:
+                s["spec_tokens_per_cycle"] = \
+                    thr["spec_emitted"] / thr["spec_slots"]
+            s["draft_layers"] = \
+                self._draft_gpt.cfg.num_hidden_layers
+            if self._paged:
+                s["kv_bytes"]["draft"] = \
+                    int(np.prod(self._draft_shape)) \
+                    * np.dtype(self._draft_dtype).itemsize
         return s
 
     def _compute_stats(self) -> dict:
@@ -489,6 +547,27 @@ class GenerationEngine:
         from .. import analysis
 
         S = self._pool.num_slots
+        if self._spec and self._spec_jits:
+            # the speculative verify program (largest built bucket):
+            # zeroed metadata is a legal no-op launch, and n_spec = 0
+            # everywhere keeps the rejection sampler on its base path
+            from ..ops.ragged_paged_attention import BLOCK_Q
+            Q, T = max(self._spec_jits)
+            K = self._spec_k
+            V = self._gpt.cfg.vocab_size
+            scales = (self._pool.scales,) if self._pool.quantized else ()
+            return analysis.analyze(
+                self._spec_step_fn(Q, T), self._params, self._buffers,
+                self._pool.data, *scales, np.zeros(Q, np.int32),
+                np.zeros(Q, np.int32), np.zeros(Q, np.int32),
+                np.zeros(Q, np.int32), np.zeros(Q // BLOCK_Q, np.int32),
+                np.zeros(S, np.int32), np.zeros(S, np.int32),
+                np.zeros((S, T), np.int32), np.zeros(S, np.int32),
+                np.zeros(S, np.int32), np.zeros(S, np.int32),
+                np.zeros(S, np.int32), np.zeros((S, K), np.int32),
+                np.zeros((S, K, V), np.float32), np.zeros(S, bool),
+                np.ones(S, np.float32), self._key, passes=passes,
+                name=f"serving.spec_verify[{S} slots, k{K}, q{Q}, t{T}]")
         if self._fused:
             # largest built fused bucket (the step that actually
             # served), falling back to the smallest on a fresh engine.
@@ -498,9 +577,10 @@ class GenerationEngine:
             from ..ops.ragged_paged_attention import BLOCK_Q
             Q, T = max(self._fused_jits) if self._fused_jits \
                 else (BLOCK_Q, 1)
+            scales = (self._pool.scales,) if self._pool.quantized else ()
             return analysis.analyze(
                 self._fused_step_fn(Q, T), self._params, self._buffers,
-                self._pool.data, np.zeros(Q, np.int32),
+                self._pool.data, *scales, np.zeros(Q, np.int32),
                 np.zeros(Q, np.int32), np.zeros(Q, np.int32),
                 np.zeros(Q, np.int32), np.zeros(Q // BLOCK_Q, np.int32),
                 np.zeros(S, np.int32), np.zeros(S, np.int32),
@@ -511,9 +591,10 @@ class GenerationEngine:
                 name=f"serving.fused_step[{S} slots, q{Q}, t{T}]")
         if self._paged:
             T = max(self._decode_jits) if self._decode_jits else 1
+            scales = (self._pool.scales,) if self._pool.quantized else ()
             return analysis.analyze(
                 self._paged_decode_fn(T), self._params, self._buffers,
-                self._pool.data, np.zeros(S, np.int32),
+                self._pool.data, *scales, np.zeros(S, np.int32),
                 np.zeros(S, np.int32), np.zeros(S, np.int32),
                 np.zeros((S, T), np.int32), np.zeros(S, bool),
                 np.ones(S, np.float32), self._key, passes=passes,
@@ -532,17 +613,22 @@ class GenerationEngine:
             from ..models.generation import (build_paged_prefill_fn,
                                              build_slot_prefill_fn)
             probe = _probe.site(f"serving/prefill[{bucket}]#{self._eid}")
+            donate = (2,)
             if self._paged:
                 built = build_paged_prefill_fn(
                     self._model, bucket, self._pool.block_size,
-                    top_k=self._top_k, top_p=self._top_p, probe=probe)
+                    top_k=self._top_k, top_p=self._top_p, probe=probe,
+                    quantized=self._pool.quantized,
+                    qmax=self._pool.qmax or 127.0)
+                if self._pool.quantized:
+                    donate = (2, 3)       # pool AND its scale array
             else:
                 built = build_slot_prefill_fn(
                     self._model, bucket, self._pool.max_len,
                     top_k=self._top_k, top_p=self._top_p, probe=probe)
             fn = _registry.aot_site(
                 f"serving/prefill[{bucket}]#{self._eid}", built,
-                donate_argnums=(2,))
+                donate_argnums=donate)
             self._prefill_jits[bucket] = fn
         return fn
 
@@ -556,8 +642,10 @@ class GenerationEngine:
                 build_paged_decode_fn(self._model, self._pool.num_slots,
                                       table_len, self._pool.block_size,
                                       top_k=self._top_k, top_p=self._top_p,
-                                      probe=probe),
-                donate_argnums=(2,))
+                                      probe=probe,
+                                      quantized=self._pool.quantized,
+                                      qmax=self._pool.qmax or 127.0),
+                donate_argnums=(2, 3) if self._pool.quantized else (2,))
             self._decode_jits[table_len] = fn
         return fn
 
@@ -616,10 +704,16 @@ class GenerationEngine:
         ids[0, :feed.size] = feed         # RIGHT-padded: virtual index 0
         key_valid = np.zeros((1, bucket), bool)
         key_valid[0, :feed.size] = True
-        pool.data, first, self._key = self._prefill_fn(bucket)(
-            self._params, self._buffers, pool.data, ids, key_valid, table,
-            np.int32(feed.size), np.bool_(req.do_sample),
-            np.float32(req.temperature), self._key)
+        args = (ids, key_valid, table, np.int32(feed.size),
+                np.bool_(req.do_sample), np.float32(req.temperature),
+                self._key)
+        if pool.quantized:
+            pool.data, pool.scales, first, self._key = \
+                self._prefill_fn(bucket)(self._params, self._buffers,
+                                         pool.data, pool.scales, *args)
+        else:
+            pool.data, first, self._key = self._prefill_fn(bucket)(
+                self._params, self._buffers, pool.data, *args)
         pool.set_slot(slot, pos=feed.size, lo=0)
         pool.register_prefix(slot, feed)
         req.replay = []
@@ -635,6 +729,10 @@ class GenerationEngine:
         here), and the remaining tokens arm ``req.pending_feed`` for
         the per-cycle chunk plan."""
         pool = self._pool
+        if self._spec:
+            # this slot's previous occupant's draft cache is stale: the
+            # next speculative cycle re-syncs via a draft prefill
+            self._draft_synced[slot] = False
         feed = np.concatenate(
             [req.prompt, np.asarray(req.tokens, np.int32)])
         cached = pool.match_prefix(feed)
@@ -653,13 +751,14 @@ class GenerationEngine:
         req.replay = []
         return None
 
-    def _run_fused_step(self, slot_requests, plan):
-        """Dispatch ONE fused ragged launch (the chunked-mode
-        do_chunked_step): budgeted prompt chunks + decode rows,
-        flattened into the padded row layout of
-        ``ops.ragged_paged_attention`` and served by the
-        ``build_fused_step_fn`` program for this (q bucket, table
-        bucket). Returns the next-token DEVICE array un-fetched."""
+    def _ragged_operands(self, slot_requests, plan, spec=None):
+        """Host-side flattened ragged-row operands shared by the fused
+        step and the speculative verify launch: per-slot contiguous
+        padded rows, page-table-resolved write targets, and the
+        scalar-prefetch metadata. Speculating slots (``spec``)
+        contribute their candidate rows with only ``last_token``
+        host-known — the draft tokens overlay on the device inside the
+        verify program."""
         from ..ops.ragged_paged_attention import BLOCK_Q, ragged_layout
 
         pool = self._pool
@@ -671,6 +770,7 @@ class GenerationEngine:
         kv_len = np.zeros(S, np.int32)
         sample_mask = np.zeros(S, bool)
         temps = np.ones(S, np.float32)
+        n_spec = np.zeros(S, np.int32)
         for slot, req in slot_requests.items():
             n = int(plan.get(slot, 0))
             if n < 1:
@@ -678,11 +778,16 @@ class GenerationEngine:
             p = pool.slot_pos(slot)
             q_lens[slot] = n
             pos0s[slot] = p
-            row_tokens[slot] = (req.pending_feed[:n] if req.pending_feed
-                                else [req.last_token])
             kv_len[slot] = p + n
             sample_mask[slot] = req.do_sample
             temps[slot] = req.temperature
+            if spec and slot in spec:
+                n_spec[slot] = n
+                row_tokens[slot] = [req.last_token]
+            else:
+                row_tokens[slot] = (req.pending_feed[:n]
+                                    if req.pending_feed
+                                    else [req.last_token])
         padded = sum(-(-n // BLOCK_Q) * BLOCK_Q for n in q_lens if n)
         Q = self._q_bucket(padded)
         blk_seq, qstart, pos0, last_row, _ = ragged_layout(
@@ -694,19 +799,38 @@ class GenerationEngine:
         for slot, toks in row_tokens.items():
             r0, p0 = int(qstart[slot]), int(pos0[slot])
             table = pool.slot_table(slot)
-            for i, t in enumerate(toks):
-                token_ids[r0 + i] = t
+            for i in range(q_lens[slot]):
+                if i < len(toks):
+                    token_ids[r0 + i] = toks[i]
                 qpos[r0 + i] = p0 + i
                 write_block[r0 + i] = table[(p0 + i) // bs]
                 write_off[r0 + i] = (p0 + i) % bs
         T = max(pool.table_bucket(s) for s in row_tokens)
         tables = pool.table_array(T, row_tokens)
         lo = np.zeros(S, np.int32)            # paged virtual floor
+        return (Q, T, (token_ids, qpos, write_block, write_off, blk_seq,
+                       qstart, pos0, tables, lo, kv_len, last_row),
+                n_spec, sample_mask, temps)
+
+    def _run_fused_step(self, slot_requests, plan):
+        """Dispatch ONE fused ragged launch (the chunked-mode
+        do_chunked_step): budgeted prompt chunks + decode rows,
+        flattened into the padded row layout of
+        ``ops.ragged_paged_attention`` and served by the
+        ``build_fused_step_fn`` program for this (q bucket, table
+        bucket). Returns the next-token DEVICE array un-fetched."""
+        pool = self._pool
+        Q, T, ops, _, sample_mask, temps = self._ragged_operands(
+            slot_requests, plan)
         step = self._fused_step_fn(Q, T)
-        pool.data, nxt, self._key = step(
-            self._params, self._buffers, pool.data, token_ids, qpos,
-            write_block, write_off, blk_seq, qstart, pos0, tables, lo,
-            kv_len, last_row, sample_mask, temps, self._key)
+        args = ops + (sample_mask, temps, self._key)
+        if pool.quantized:
+            pool.data, pool.scales, nxt, self._key = step(
+                self._params, self._buffers, pool.data, pool.scales,
+                *args)
+        else:
+            pool.data, nxt, self._key = step(
+                self._params, self._buffers, pool.data, *args)
         self._note_decode_dispatch(step)
         return nxt
 
@@ -733,10 +857,228 @@ class GenerationEngine:
                                     q_rows, table_len,
                                     self._pool.block_size,
                                     top_k=self._top_k, top_p=self._top_p,
-                                    probe=probe),
-                donate_argnums=(2,))
+                                    probe=probe,
+                                    quantized=self._pool.quantized,
+                                    qmax=self._pool.qmax or 127.0),
+                donate_argnums=(2, 3) if self._pool.quantized else (2,))
             self._fused_jits[key] = fn
         return fn
+
+    # -- speculative decoding (draft propose + fused verify) ---------------
+    def _init_draft(self, spec_draft, max_len) -> None:
+        """Set up the draft side of speculative decoding: resolve the
+        draft model (``"auto"`` builds a 2-layer GPT sharing the
+        target's embeddings via ``models.generation.make_draft_model``),
+        snapshot its params, and allocate its DENSE per-slot KV pool —
+        the draft is small, so worst-case stripes cost little, and the
+        dense layout needs no page-table bookkeeping. Draft positions
+        mirror the target pool's ``slot_pos`` exactly (both write a
+        row's K/V when the row is fed), so the only per-slot draft
+        state is a 'synced' flag."""
+        import jax.numpy as jnp
+
+        from ..models.generation import make_draft_model
+        from ..nn.layer.layers import get_buffers_tree, get_params_tree
+
+        if spec_draft == "auto":
+            spec_draft = make_draft_model(self._model)
+        dgpt = spec_draft.gpt if hasattr(spec_draft, "gpt") \
+            else spec_draft
+        if dgpt.cfg.vocab_size != self._gpt.cfg.vocab_size:
+            raise ValueError(
+                f"draft vocab {dgpt.cfg.vocab_size} != target vocab "
+                f"{self._gpt.cfg.vocab_size}: rejection sampling "
+                f"compares distributions over the SAME vocabulary")
+        if max_len > dgpt.cfg.max_position_embeddings:
+            raise ValueError(
+                f"max_len {max_len} exceeds the draft's "
+                f"max_position_embeddings="
+                f"{dgpt.cfg.max_position_embeddings}")
+        spec_draft.eval()
+        self._draft_model = spec_draft
+        self._draft_gpt = dgpt
+        self._draft_params = get_params_tree(spec_draft)
+        self._draft_buffers = get_buffers_tree(spec_draft)
+        dh = dgpt.cfg.hidden_size // dgpt.cfg.num_attention_heads
+        pdt = self._draft_params[next(iter(self._draft_params))].dtype
+        self._draft_max_len = int(max_len)
+        self._draft_shape = (dgpt.cfg.num_hidden_layers, 2,
+                             self._pool.num_slots,
+                             dgpt.cfg.num_attention_heads,
+                             self._draft_max_len, dh)
+        self._draft_dtype = pdt
+        self._draft_pool = jnp.zeros(self._draft_shape, pdt)
+        self._draft_synced = np.zeros(self._pool.num_slots, bool)
+        self._draft_prefill_jits = {}
+        self._draft_step_jit = None
+
+    def _reset_draft(self) -> None:
+        """Failure-path twin of ``pool.reset_data()``: the draft pool
+        is donated through its steps, so a failed cycle may have left
+        it deleted — reallocate and drop every sync flag."""
+        import jax.numpy as jnp
+        self._draft_pool = jnp.zeros(self._draft_shape, self._draft_dtype)
+        self._draft_synced[:] = False
+
+    def _draft_bucket(self, n: int) -> int:
+        """pow2 context bucket for the draft prefill, capped at the
+        draft pool's max_len (the cap is reachable because a slot's
+        context is always < max_len)."""
+        b = 8
+        while b < n:
+            b *= 2
+        return min(b, self._draft_max_len)
+
+    def _draft_prefill_fn(self, bucket: int):
+        fn = self._draft_prefill_jits.get(bucket)
+        if fn is None:
+            from ..models.generation import build_draft_prefill_fn
+            probe = _probe.site(
+                f"serving/spec_prefill[{bucket}]#{self._eid}")
+            fn = _registry.aot_site(
+                f"serving/spec_prefill[{bucket}]#{self._eid}",
+                build_draft_prefill_fn(self._draft_model, bucket,
+                                       self._draft_max_len, probe=probe),
+                donate_argnums=(2,))
+            self._draft_prefill_jits[bucket] = fn
+        return fn
+
+    def _draft_step_fn(self):
+        if self._draft_step_jit is None:
+            from ..models.generation import build_draft_propose_fn
+            probe = _probe.site(f"serving/spec_draft#{self._eid}")
+            self._draft_step_jit = _registry.aot_site(
+                f"serving/spec_draft#{self._eid}",
+                build_draft_propose_fn(self._draft_model,
+                                       self._pool.num_slots,
+                                       self._draft_max_len,
+                                       top_k=self._top_k,
+                                       top_p=self._top_p, probe=probe),
+                donate_argnums=(2,))
+        return self._draft_step_jit
+
+    def _spec_step_fn(self, q_rows: int, table_len: int):
+        key = (q_rows, table_len)
+        fn = self._spec_jits.get(key)
+        if fn is None:
+            from ..models.generation import build_spec_verify_fn
+            probe = _probe.site(
+                f"serving/spec[q{q_rows},t{table_len}]#{self._eid}")
+            fn = _registry.aot_site(
+                f"serving/spec[q{q_rows},t{table_len}]#{self._eid}",
+                build_spec_verify_fn(self._model, self._pool.num_slots,
+                                     q_rows, self._spec_k, table_len,
+                                     self._pool.block_size,
+                                     top_k=self._top_k,
+                                     top_p=self._top_p, probe=probe,
+                                     quantized=self._pool.quantized,
+                                     qmax=self._pool.qmax or 127.0),
+                donate_argnums=(2, 3) if self._pool.quantized else (2,))
+            self._spec_jits[key] = fn
+        return fn
+
+    def _sync_draft(self, slot: int, req: GenerationRequest) -> None:
+        """Bring the draft's KV cache for ``slot`` up to the target's
+        context ``[0, pos)``: one right-padded draft prefill of
+        ``prompt + generated`` minus the last (not-yet-written) token.
+        Runs when a slot starts (or resumes, after preemption/reuse)
+        speculative decoding."""
+        feed = np.concatenate(
+            [req.prompt, np.asarray(req.tokens, np.int32)])
+        ctx = feed[:-1]
+        if ctx.size:
+            b = self._draft_bucket(ctx.size)
+            ids = np.zeros((1, b), np.int32)
+            ids[0, :ctx.size] = ctx
+            key_valid = np.zeros((1, b), bool)
+            key_valid[0, :ctx.size] = True
+            self._draft_pool = self._draft_prefill_fn(b)(
+                self._draft_params, self._draft_buffers,
+                self._draft_pool, ids, key_valid, np.int32(slot))
+        self._draft_synced[slot] = True
+
+    def _run_spec_step(self, slot_requests, plan, spec):
+        """Dispatch ONE speculative serving cycle without any host
+        sync: (1) newly-decoding slots' draft caches sync via a
+        right-padded draft prefill; (2) ``spec_k`` draft launches
+        propose candidates autoregressively (each step feeds the
+        previous step's device-side proposal — the host never fetches
+        a draft token); (3) ONE fused ragged verify launch scores
+        every candidate row next to the cycle's prefill-chunk rows,
+        runs device-side rejection sampling, and returns ``[accepted |
+        corrected | draft echo | sentinel]`` for the scheduler's
+        single fetch. Returns that DEVICE array un-fetched."""
+        try:
+            return self._run_spec_inner(slot_requests, plan, spec)
+        except Exception:
+            # the draft pool is donated through its steps: a failure
+            # may leave it deleted — rebuild so the engine serves on
+            # after the scheduler resets the target pool
+            self._reset_draft()
+            raise
+
+    def _run_spec_inner(self, slot_requests, plan, spec):
+        import jax.numpy as jnp
+
+        pool = self._pool
+        S = pool.num_slots
+        K = self._spec_k
+        for slot in spec:
+            if not self._draft_synced[slot]:
+                self._sync_draft(slot, slot_requests[slot])
+        # --- draft proposal loop: K launches, device-chained ---------
+        sample_mask = np.zeros(S, bool)
+        temps = np.ones(S, np.float32)
+        feed0 = np.zeros(S, np.int32)
+        pos_d = np.zeros(S, np.int32)
+        for slot, req in slot_requests.items():
+            sample_mask[slot] = req.do_sample
+            temps[slot] = req.temperature
+        for slot in spec:
+            feed0[slot] = slot_requests[slot].last_token
+            pos_d[slot] = pool.slot_pos(slot)
+        lo_d = np.zeros(S, np.int32)
+        step_d = self._draft_step_fn()
+        prop = feed0
+        props, probs = [], []
+        # only as many draft launches as the cycle's LARGEST candidate
+        # count needs (every slot's n_spec = min(spec_k, remaining) —
+        # a batch tail one token from its budget would otherwise pay
+        # spec_k full draft passes for one verified candidate); the
+        # verify signature stays [S, K], zero-padded past kmax
+        kmax = max(spec.values())
+        for j in range(kmax):
+            # clamp keeps junk steps of non-speculating slots (and the
+            # n_spec < kmax tail) inside the dense pool's row bounds; a
+            # clamped write only touches a row that a real feed will
+            # rewrite before any mask can reach it
+            pj = np.minimum(pos_d + j, self._draft_max_len - 1)
+            self._draft_pool, prop, pr, self._key = step_d(
+                self._draft_params, self._draft_buffers,
+                self._draft_pool, prop, pj, lo_d, sample_mask, temps,
+                self._key)
+            props.append(prop)
+            probs.append(pr)
+        d_dev = jnp.stack(props, axis=1)        # [S, kmax] device-side
+        q_dev = jnp.stack(probs, axis=1)        # [S, kmax, V]
+        if kmax < K:
+            d_dev = jnp.pad(d_dev, ((0, 0), (0, K - kmax)))
+            q_dev = jnp.pad(q_dev, ((0, 0), (0, K - kmax), (0, 0)))
+        # --- the fused verify launch ---------------------------------
+        Q, T, ops, n_spec, sample_mask, temps = self._ragged_operands(
+            slot_requests, plan, spec=spec)
+        step = self._spec_step_fn(Q, T)
+        args = ops + (n_spec, d_dev, q_dev, sample_mask, temps,
+                      self._key)
+        if pool.quantized:
+            pool.data, pool.scales, out, self._key = step(
+                self._params, self._buffers, pool.data, pool.scales,
+                *args)
+        else:
+            pool.data, out, self._key = step(
+                self._params, self._buffers, pool.data, *args)
+        self._note_decode_dispatch(step)
+        return out
 
     def _run_decode(self, slot_requests):
         """Dispatch ONE decode step; returns the next-token DEVICE
@@ -758,9 +1100,16 @@ class GenerationEngine:
             T = max(self._pool.table_bucket(s) for s in slot_requests)
             tables = self._pool.table_array(T, slot_requests)
             step = self._paged_decode_fn(T)
-            self._pool.data, nxt, self._key = step(
-                self._params, self._buffers, self._pool.data, tokens, pos,
-                lo, tables, sample_mask, temps, self._key)
+            if self._pool.quantized:
+                (self._pool.data, self._pool.scales, nxt,
+                 self._key) = step(
+                    self._params, self._buffers, self._pool.data,
+                    self._pool.scales, tokens, pos, lo, tables,
+                    sample_mask, temps, self._key)
+            else:
+                self._pool.data, nxt, self._key = step(
+                    self._params, self._buffers, self._pool.data, tokens,
+                    pos, lo, tables, sample_mask, temps, self._key)
             self._note_decode_dispatch(step)
             return nxt
         self._pool.data, nxt, self._key = self._decode_jit(
@@ -788,14 +1137,32 @@ class GenerationEngine:
     def _run_copy(self, dst: int, src: int) -> None:
         """Copy-on-write append support: device-copy block ``src`` over
         block ``dst`` across every layer/kv plane before the decode step
-        scatters into ``dst``. Block ids are traced scalars — ONE trace
-        serves every copy — and the pool is donated like every other
-        step. Device-to-device only: no host sync."""
+        scatters into ``dst`` — a quantized pool copies the block's
+        per-(layer, kv, head) scales in the same program, so the clone
+        dequantizes identically. Block ids are traced scalars — ONE
+        trace serves every copy — and the pool (and scale array) is
+        donated like every other step. Device-to-device only: no host
+        sync."""
         if self._copy_jit is None:
-            def _copy(pool, dst, src):
-                return pool.at[:, :, dst].set(pool[:, :, src])
+            if self._pool.quantized:
+                def _copy(pool, scales, dst, src):
+                    return (pool.at[:, :, dst].set(pool[:, :, src]),
+                            scales.at[:, :, dst].set(scales[:, :, src]))
 
-            self._copy_jit = _registry.aot_site(
-                f"serving/copy#{self._eid}", _copy, donate_argnums=(0,))
-        self._pool.data = self._copy_jit(self._pool.data, np.int32(dst),
-                                         np.int32(src))
+                self._copy_jit = _registry.aot_site(
+                    f"serving/copy#{self._eid}", _copy,
+                    donate_argnums=(0, 1))
+            else:
+                def _copy(pool, dst, src):
+                    return pool.at[:, :, dst].set(pool[:, :, src])
+
+                self._copy_jit = _registry.aot_site(
+                    f"serving/copy#{self._eid}", _copy,
+                    donate_argnums=(0,))
+        if self._pool.quantized:
+            self._pool.data, self._pool.scales = self._copy_jit(
+                self._pool.data, self._pool.scales, np.int32(dst),
+                np.int32(src))
+        else:
+            self._pool.data = self._copy_jit(
+                self._pool.data, np.int32(dst), np.int32(src))

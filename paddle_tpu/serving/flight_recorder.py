@@ -128,11 +128,22 @@ class FlightRecorder:
                                for c in self._cycles)
             prefill_chunks = sum(c.get("prefill_chunks", 0)
                                  for c in self._cycles)
+            spec_emitted = sum(c.get("spec_emitted", 0)
+                               for c in self._cycles)
+            spec_slots = sum(c.get("spec_slots", 0)
+                             for c in self._cycles)
+            spec_accepted = sum(c.get("spec_accepted", 0)
+                                for c in self._cycles)
+            spec_proposed = sum(c.get("spec_proposed", 0)
+                                for c in self._cycles)
         return {"cycles": cycles, "emitted": emitted, "cycle_secs": secs,
                 "decode_cycles": decode_cycles,
                 "decode_flops": decode_flops,
                 "chunk_tokens": chunk_tokens,
-                "prefill_chunks": prefill_chunks}
+                "prefill_chunks": prefill_chunks,
+                "spec_emitted": spec_emitted, "spec_slots": spec_slots,
+                "spec_accepted": spec_accepted,
+                "spec_proposed": spec_proposed}
 
     def snapshot(self) -> Dict[str, Any]:
         """JSON-serializable copy of both rings + the counters."""

@@ -76,6 +76,11 @@ class SlotPoolBase:
     # bucket, paged only the true footprint)
     _capacity_noun = "cache capacity"
     _admission_law = "bucket + max_new <= max_len"
+    # quantized block storage is a paged-pool feature (per-block
+    # scales); the dense pool is always a plain float layout
+    quantized = False
+    qmax = None
+    scales = None
 
     # subclass constructors set: num_slots, max_len, min_bucket,
     # shape, dtype, data — then call _init_slots()
@@ -193,16 +198,27 @@ class SlotPoolBase:
     def advance(self, slot: int, n: int = 1) -> int:
         """``n`` tokens landed (one decode step, or one prefill chunk
         of the fused ragged step): the slot's write position moves
-        ``n`` cache indices later. Returns the new ``pos``."""
-        if n < 1:
-            raise ValueError(f"advance needs n >= 1, got {n}")
+        ``n`` cache indices later. ``n`` is a SIGNED delta — the
+        speculative-decoding scheduler rolls back the rows a rejected
+        draft wrote with a negative ``n`` (paged tables address by
+        ``pos``, so rollback is pure bookkeeping: the stale K/V beyond
+        the new ``pos`` are masked out of attention and overwritten by
+        the next append). Returns the new ``pos``."""
+        if n == 0:
+            raise ValueError("advance needs n != 0")
         st = self._slots[slot]
-        st.pos += int(n)
-        if st.pos >= self.max_len:
-            raise RuntimeError(
+        new_pos = st.pos + int(n)        # validate BEFORE mutating: a
+        if new_pos >= self.max_len:      # rejected advance must leave
+            raise RuntimeError(          # the slot state untouched
                 f"slot {slot} overran the {self._capacity_noun} "
                 f"{self.max_len} — the admission check "
                 f"({self._admission_law}) is broken")
+        if new_pos < st.lo:
+            raise RuntimeError(
+                f"slot {slot}: rollback below the slot's floor "
+                f"(pos={new_pos} < lo={st.lo}) — a speculative rollback "
+                f"may only unwind rows written this cycle")
+        st.pos = new_pos
         return st.pos
 
     def slot_pos(self, slot: int) -> int:

@@ -127,6 +127,11 @@ class PagedKVPool(SlotPoolBase):
     _capacity_noun = "virtual capacity"
     _admission_law = "prompt + max_new <= max_len"
 
+    #: storage dtypes quantized with per-block max-abs scales (the
+    #: EQuARX per-chunk scheme of the PR-10 gradient wire, applied to
+    #: KV blocks): int8 now, fp8 slots in when the backend has it
+    _QUANT_QMAX = {"int8": 127.0, "float8_e4m3fn": 448.0}
+
     def __init__(self, num_layers: int, num_slots: int, num_heads: int,
                  max_len: int, head_dim: int, *, block_size: int = 16,
                  num_blocks: Optional[int] = None, dtype="float32",
@@ -170,6 +175,18 @@ class PagedKVPool(SlotPoolBase):
         self.shape = (self.num_layers, 2, self.num_blocks + 1,
                       self.num_heads, self.block_size, self.head_dim)
         self.dtype = jnp.dtype(dtype)
+        # quantized block storage: per-block max-abs scales live in a
+        # parallel [L, 2, num_blocks + 1, H] f32 array riding every
+        # donated step beside the pool (gather steps multiply after the
+        # pool read; the fused kernel dequantizes in-register off the
+        # scalar-prefetch metadata). Scale 0 = untouched block, whose
+        # dequantized content is the same zeros a fresh float pool holds.
+        self.quantized = self.dtype.name in self._QUANT_QMAX
+        self.qmax = self._QUANT_QMAX.get(self.dtype.name)
+        self.scales_shape = (self.num_layers, 2, self.num_blocks + 1,
+                             self.num_heads)
+        self.scales = (jnp.zeros(self.scales_shape, jnp.float32)
+                       if self.quantized else None)
         self.data = jnp.zeros(self.shape, self.dtype)
         # min-heap: deterministic lowest-id allocation at O(log n) —
         # unlike the base slot list (num_slots entries), num_blocks is
@@ -211,6 +228,8 @@ class PagedKVPool(SlotPoolBase):
             raise RuntimeError(
                 "reset_data with live slots: fail and free them first")
         self.data = jnp.zeros(self.shape, self.dtype)
+        if self.quantized:
+            self.scales = jnp.zeros(self.scales_shape, jnp.float32)
         self._trie.clear()
         self._block_key.clear()
         self._lru.clear()
@@ -244,10 +263,51 @@ class PagedKVPool(SlotPoolBase):
         return len(self._trie)
 
     @property
+    def block_storage_bytes(self) -> int:
+        """Device bytes of the quantized-or-not block array alone."""
+        return int(np.prod(self.shape)) * self.dtype.itemsize
+
+    @property
+    def scales_bytes(self) -> int:
+        """Device bytes of the per-block scale array (0 for float
+        pools)."""
+        if not self.quantized:
+            return 0
+        return int(np.prod(self.scales_shape)) * 4
+
+    @property
+    def capacity_bytes(self) -> int:
+        """Device bytes of the whole pool — block storage PLUS the
+        per-block scale array of a quantized pool, so the same-byte-
+        budget capacity comparison against a float pool stays honest."""
+        return self.block_storage_bytes + self.scales_bytes
+
+    @property
     def block_bytes(self) -> int:
-        """Device bytes of ONE block across every layer/kv plane (the
-        quantum the HBM ledger accounts paged usage in)."""
+        """Device bytes of ONE block across every layer/kv plane —
+        scale bytes included for quantized pools (the quantum the HBM
+        ledger accounts paged usage in)."""
         return self.capacity_bytes // (self.num_blocks + 1)
+
+    @classmethod
+    def blocks_within_budget(cls, budget_bytes: int, *, num_layers: int,
+                             num_heads: int, block_size: int,
+                             head_dim: int, dtype="float32") -> int:
+        """Largest ``num_blocks`` whose pool (scratch block and, for
+        quantized dtypes, the per-block scale array included) fits
+        ``budget_bytes`` — the same-byte-budget sizing rule the
+        capacity tests and ``--kv-dtype`` comparisons use. An int8 pool
+        packs ~4x the blocks of an fp32 pool into the same budget
+        (minus the f32 scale overhead of ``1 / (block_size *
+        head_dim)``)."""
+        import jax.numpy as jnp
+        itemsize = jnp.dtype(dtype).itemsize
+        per_block = num_layers * 2 * num_heads * block_size * head_dim \
+            * itemsize
+        if jnp.dtype(dtype).name in cls._QUANT_QMAX:
+            per_block += num_layers * 2 * num_heads * 4
+        # num_blocks + 1 physical blocks (scratch) must fit
+        return max(0, int(budget_bytes) // per_block - 1)
 
     @property
     def bytes_in_use(self) -> int:
@@ -273,6 +333,15 @@ class PagedKVPool(SlotPoolBase):
             self._evict_one()            # raises PoolExhaustedError
         b = heapq.heappop(self._free)    # deterministic, like slot alloc
         self._ref[b] = 1
+        if self.quantized:
+            # a recycled block carries its previous tenant's per-block
+            # max-abs scale, and _quant_append only GROWS scales
+            # (scatter-max) — growth appends into this block would
+            # quantize fresh K/V at an arbitrarily coarse stale scale.
+            # Zero it at allocation (prefill rewrites it anyway;
+            # LRU-adopted cached blocks never pass through here, so
+            # their valid scales survive). Lazy device op, no sync.
+            self.scales = self.scales.at[:, :, b].set(0.0)
         return b
 
     def _unref(self, b: int) -> None:
@@ -401,6 +470,21 @@ class PagedKVPool(SlotPoolBase):
             parent = self._trie.get(key[:-bs])
             if parent is not None:
                 parent.children.add(key)
+
+    def unpublish_from(self, slot: int, pos: int) -> None:
+        """Drop any prefix-cache registration of the slot's blocks
+        covering virtual index ``pos`` onward — the speculative-decode
+        rollback guard: rows a rejected draft wrote must not leave a
+        published block whose device content no longer matches its
+        token-prefix key. Structurally the write path already unshares
+        (COW) and unregisters (``_ensure_block``) before any write, so
+        this is the same airtight-cheap insurance, called by the
+        scheduler after a rollback."""
+        st = self._require(slot)
+        for vb in range(int(pos) // self.block_size, len(st.table)):
+            key = self._block_key.get(st.table[vb])
+            if key is not None:
+                self._drop_node(key)
 
     # -- decode-time growth + copy-on-write --------------------------------
     def ensure_writable(self, slot: int) -> Optional[Tuple[int, int]]:

@@ -266,6 +266,8 @@ class Scheduler:
                  max_queue: int = 128, prefill_budget: Optional[int] = None,
                  do_copy: Optional[Callable] = None,
                  do_chunked_step: Optional[Callable] = None,
+                 do_spec_step: Optional[Callable] = None,
+                 spec_k: int = 0,
                  recorder: Optional[FlightRecorder] = None):
         if max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {max_queue}")
@@ -284,6 +286,25 @@ class Scheduler:
         self._chunked = do_chunked_step is not None
         self.prefill_chunks = 0          # chunk launches fed (slot-cycles)
         self.chunk_tokens = 0            # prompt tokens fed via chunks
+        # speculative decoding (fused engines): ``do_spec_step(active,
+        # plan, spec) -> [2S + S*spec_k + 1] device array`` — per slot
+        # the accepted-prefix length, the corrected/sampled token, the
+        # echoed draft tokens (the host never saw the device-side
+        # proposals) and the logits-finite sentinel, all in ONE fetch.
+        # Decode slots contribute min(spec_k, remaining) candidate rows
+        # to the fused launch instead of 1; feed slots chunk as before.
+        self._do_spec = do_spec_step
+        self._spec = do_spec_step is not None
+        self._spec_k = int(spec_k)
+        if self._spec and not self._chunked:
+            raise ValueError(
+                "do_spec_step requires do_chunked_step: speculative "
+                "verify rows ride the fused ragged launch")
+        if self._spec and self._spec_k < 1:
+            raise ValueError(f"spec_k must be >= 1, got {spec_k}")
+        self.spec_cycles = 0             # cycles that verified >= 1 slot
+        self.spec_proposed = 0           # draft tokens verified
+        self.spec_accepted = 0           # draft tokens accepted
         # serving numerics sentinel: decode steps append a logits-finite
         # flag past the token row (models/generation.py), riding the one
         # windowed _fetch — cycles whose logits went NaN/Inf are counted
@@ -440,17 +461,20 @@ class Scheduler:
                             "flight_recorder":
                                 self.recorder.last_dump_path})
 
-    def _note_nonfinite(self, toks, rec) -> None:
+    def _note_nonfinite(self, toks, rec, idx: Optional[int] = None) \
+            -> None:
         """Read the decode step's logits-finite sentinel off the fetched
-        token row (element ``[num_slots]``; absent from mock/legacy
-        decodes that return exactly ``num_slots`` tokens). A tripped
-        flag marks the cycle record and counts
-        ``serving/nonfinite_cycles`` — the tokens themselves still flow
-        (an argmax over NaN logits is garbage, not a crash), so the
-        loop survives and the operator sees WHY the output went bad."""
-        S = self._pool.num_slots
+        token row (element ``[num_slots]`` — or ``idx`` for layouts
+        like the speculative verify output whose sentinel sits past the
+        draft echo; absent from mock/legacy decodes that return exactly
+        ``num_slots`` tokens). A tripped flag marks the cycle record
+        and counts ``serving/nonfinite_cycles`` — the tokens themselves
+        still flow (an argmax over NaN logits is garbage, not a crash),
+        so the loop survives and the operator sees WHY the output went
+        bad."""
+        idx = self._pool.num_slots if idx is None else int(idx)
         shape = getattr(toks, "shape", None)
-        if shape and shape[0] > S and bool(toks[S]):
+        if shape and shape[0] > idx and bool(toks[idx]):
             self.nonfinite_cycles += 1
             stat_add("serving/nonfinite_cycles")
             if rec is not None:
@@ -807,13 +831,39 @@ class Scheduler:
         if dt > 0:
             stat_observe("serving/tokens_per_sec", emitted / dt)
 
+    def _spec_plan(self, plan: Dict[int, int]) -> Dict[int, int]:
+        """Speculative row plan: every DECODE slot (feed drained)
+        contributes ``min(spec_k, remaining budget)`` candidate rows to
+        the verify launch instead of 1 — the rows are the draft's
+        proposals, and the slot emits up to that many tokens this
+        cycle. Feed slots keep their chunk rows. Mutates ``plan`` (so
+        ``_prepare_chunked`` reserves writable blocks for the whole
+        candidate range) and returns ``{slot: n_candidates}``."""
+        spec: Dict[int, int] = {}
+        for slot, n in list(plan.items()):
+            req = self._slots[slot]
+            if req.pending_feed:
+                continue
+            k = min(self._spec_k, req.max_new_tokens - req.emitted)
+            plan[slot] = spec[slot] = max(1, k)
+        return spec
+
     def _chunked_cycle(self) -> None:
         """One fused ragged launch: budgeted prompt chunks mixed with
         every decode row. The launch's next-token array is real for
         decode slots AND for slots whose final feed chunk landed this
         cycle (their first generated token comes out of the same
-        launch); mid-feed slots' rows are ignored."""
-        plan = self._prepare_chunked(self._chunk_plan())
+        launch); mid-feed slots' rows are ignored. In SPECULATIVE mode
+        decode slots contribute their draft-candidate rows instead and
+        the launch returns ``[accepted | corrected | draft echo |
+        sentinel]`` — accepted candidates emit host-side, the slot's
+        pool position rolls back over the rejected rows (signed
+        ``advance``), and any cache registration the dead rows touched
+        is dropped."""
+        plan = self._chunk_plan()
+        spec = self._spec_plan(plan) if self._spec else {}
+        plan = self._prepare_chunked(plan)
+        spec = {s: n for s, n in spec.items() if s in plan}
         if not plan:
             return
         active = {s: self._slots[s] for s in plan}
@@ -827,10 +877,14 @@ class Scheduler:
         t0 = time.perf_counter()
         with _prof.record("serving/decode_dispatch", "serving",
                           args={"active": len(active),
+                                "spec_slots": len(spec),
                                 "chunk_rows": sum(
                                     n for s, n in plan.items()
                                     if active[s].pending_feed)}):
-            toks_dev = self._do_chunked(active, plan)
+            if spec:
+                toks_dev = self._do_spec(active, plan, spec)
+            else:
+                toks_dev = self._do_chunked(active, plan)
         t1 = time.perf_counter()
         with _prof.record("serving/host_fetch", "serving"):
             toks = _fetch(toks_dev)
@@ -838,11 +892,25 @@ class Scheduler:
         if rec is not None:
             rec["decode_dispatch_ms"] += (t1 - t0) * 1e3
             rec["fetch_ms"] += (t2 - t1) * 1e3
-        self._note_nonfinite(toks, rec)
+        S = self._pool.num_slots
+        K = self._spec_k
+        if spec:
+            # spec layout: [accepted (S) | corrected (S) | draft echo
+            # (S*K) | sentinel] — the default S-indexed sentinel parse
+            # would read a corrected token instead
+            acc_row = toks[:S]
+            corr_row = toks[S:2 * S]
+            draft_rows = toks[2 * S:2 * S + S * K].reshape(S, K)
+            self._note_nonfinite(toks, rec, idx=2 * S + S * K)
+        else:
+            self._note_nonfinite(toks, rec)
         dt = t2 - t0
         emitted = 0
         chunks = 0
         chunk_tokens = 0
+        spec_accepted = 0
+        spec_proposed = 0
+        spec_emitted = 0
         now = time.perf_counter()
         for slot, req in active.items():
             n = plan[slot]
@@ -861,6 +929,26 @@ class Scheduler:
                 stat_add("serving/chunk_tokens", n)
                 req.trace.mark("prefill_chunk", tokens=n,
                                remaining=len(req.pending_feed))
+            elif slot in spec:
+                # verify outcome: the longest agreeing candidate prefix
+                # is kept plus (on a rejection) one corrected token;
+                # the pool position rolls back over the dead rows
+                # (signed advance) and any cache registration they
+                # touched is dropped — paged tables address by pos, so
+                # the rollback is pure bookkeeping
+                a = min(int(acc_row[slot]), n)
+                cov = a + 1 if a < n else n
+                if cov < n:
+                    self._pool.advance(slot, cov - n)
+                    self._pool.unpublish_from(
+                        slot, self._pool.slot_pos(slot))
+                spec_proposed += n
+                spec_accepted += a
+                self.spec_proposed += n
+                self.spec_accepted += a
+                stat_add("serving/spec_proposed", n)
+                stat_add("serving/spec_accept", a)
+                req.trace.mark("spec_verify", proposed=n, accepted=a)
             if req.cancelled:
                 stat_add("serving/cancelled")
                 self._retire(slot, RequestCancelled(
@@ -882,16 +970,41 @@ class Scheduler:
                     [req.prompt, np.asarray(req.tokens, np.int32)]))
                 req.trace.mark("chunked_prefill_done",
                                emitted=req.emitted)
-            tok = int(toks[slot])
+            if slot in spec and not feeding:
+                a = min(int(acc_row[slot]), n)
+                emit = [int(t) for t in draft_rows[slot, :a]]
+                if a < n:
+                    emit.append(int(corr_row[slot]))
+                slot_emitted = 0
+                for tok in emit:
+                    req._emit(tok)
+                    emitted += 1
+                    slot_emitted += 1
+                    if self._finished(req, tok):
+                        self._retire(slot)
+                        break
+                spec_emitted += slot_emitted
+                stat_observe("serving/spec_tokens_per_cycle",
+                             slot_emitted)
+                continue
+            tok = int(toks[S + slot] if spec else toks[slot])
             req._emit(tok)
             emitted += 1
             if self._finished(req, tok):
                 self._retire(slot)
+        if spec:
+            self.spec_cycles += 1
+            stat_add("serving/spec_cycles")
         stat_add("serving/tokens", emitted)
         if rec is not None:
             rec["emitted"] += emitted
             rec["prefill_chunks"] = rec.get("prefill_chunks", 0) + chunks
             rec["chunk_tokens"] = rec.get("chunk_tokens", 0) \
                 + chunk_tokens
+            if spec:
+                rec["spec_proposed"] = spec_proposed
+                rec["spec_accepted"] = spec_accepted
+                rec["spec_emitted"] = spec_emitted
+                rec["spec_slots"] = len(spec)
         if dt > 0 and emitted:
             stat_observe("serving/tokens_per_sec", emitted / dt)

@@ -92,6 +92,24 @@ def test_dry_run_emits_metrics_summary():
     assert out["fused_chunk_tokens"] >= 40, out
     assert "serving/prefill_chunks" in res.stderr
     assert "serving/chunk_tokens" in res.stderr
+    # ISSUE-12 speculative decoding + int8 KV blocks: greedy spec
+    # output token-identical to the plain fused engine (cold and warm
+    # waves), serving/spec_accept live with > 1 token per decode cycle
+    # on the agreeing draft, exactly one trace per spec (q, table)
+    # bucket with zero warm retraces (no storm from verify rows), and
+    # the int8-block engine agreeing token-for-token with fp32
+    assert out["checks"]["spec_parity"] is True, out
+    assert out["checks"]["spec_accept_live"] is True, out
+    assert out["checks"]["spec_one_trace_per_bucket"] is True, out
+    assert out["checks"]["spec_int8_agrees"] is True, out
+    assert out["spec"]["accept_rate"] == 1.0, out
+    assert out["spec"]["tokens_per_cycle"] > 1.0, out
+    # untrained canary model: near-tie argmaxes may flip a couple of
+    # tokens under int8 noise; trained-margin exactness is pinned in
+    # test_serving_paging.py::TestQuantizedBlocks
+    assert out["spec"]["int8_token_agreement"] >= 0.75, out
+    assert "serving/spec_accept" in res.stderr
+    assert "serving/spec_tokens_per_cycle" in res.stderr
     # ISSUE-6 serving SLO observability: the seeded mini serve-load run
     # completed every request with lifecycle-ordered traces, derived
     # TTFT/TPOT percentiles in the summary, a live serving/tpot_ms

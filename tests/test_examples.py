@@ -60,6 +60,22 @@ def test_serve_gpt2_example_paged(tmp_path):
     assert "prefix hit ratio" in out         # stats() paged section
 
 
+def test_serve_gpt2_example_spec_int8(tmp_path):
+    """--spec + --kv-dtype int8: speculative decoding over quantized
+    KV blocks, with the accept-rate / tokens-per-cycle / block-capacity
+    lines in the end-of-run report."""
+    out = _run([os.path.join(REPO, "examples", "serve_gpt2.py"),
+                "--clients", "6", "--slots", "4", "--train-steps", "20",
+                "--spec", "--kv-dtype", "int8"],
+               tmp_path, timeout=600)
+    assert "served 6 requests" in out
+    assert "spec: accept rate" in out
+    assert "tokens/cycle" in out
+    assert "block capacity" in out
+    assert "int8 blocks" in out
+    assert "same budget at fp32" in out
+
+
 def test_generate_text_example(tmp_path):
     out = _run([os.path.join(REPO, "examples", "generate_text.py")],
                tmp_path, timeout=600)

@@ -101,6 +101,79 @@ class TestBatchingEngine:
         eng.close()
 
 
+class TestRuntimeKeyedSamplingExport:
+    """save_for_serving(runtime_key=True): the PRNG key is a RUNTIME
+    input of the exported decode artifact, so served sampling
+    re-randomizes per request — two calls on the same prompt can
+    differ (the standing per-request-sampling VERDICT item; also the
+    property spec-decode rejection sampling relies on)."""
+
+    def _model(self):
+        from paddle_tpu.models import GPTConfig, GPTForPretraining
+        paddle.framework.random.seed(0)
+        m = GPTForPretraining(GPTConfig.tiny())
+        m.eval()
+        return m
+
+    def test_validation_is_independent_of_export_backend(self, tmp_path):
+        from paddle_tpu.models import save_for_serving
+        m = self._model()
+        with pytest.raises(ValueError, match="do_sample"):
+            save_for_serving(m, str(tmp_path / "a"), batch=1,
+                             prompt_len=4, runtime_key=True)
+        with pytest.raises(ValueError, match="seed"):
+            save_for_serving(m, str(tmp_path / "b"), batch=1,
+                             prompt_len=4, runtime_key=True,
+                             do_sample=True, seed=3)
+        with pytest.raises(ValueError, match="num_beams"):
+            save_for_serving(m, str(tmp_path / "c"), batch=1,
+                             prompt_len=4, runtime_key=True,
+                             do_sample=True, num_beams=2)
+        with pytest.raises(ValueError, match="unsupported"):
+            save_for_serving(m, str(tmp_path / "d"), batch=1,
+                             prompt_len=4, runtime_key=True,
+                             do_sample=True, bogus_kwarg=1)
+        # the baked-constant path still demands an explicit choice,
+        # and now names the runtime_key alternative
+        with pytest.raises(ValueError, match="runtime_key"):
+            save_for_serving(m, str(tmp_path / "e"), batch=1,
+                             prompt_len=4, do_sample=True)
+
+    def test_two_calls_same_prompt_differ(self, tmp_path):
+        import jax
+        if not hasattr(jax, "export"):
+            pytest.skip("jit.save needs jax.export (known jax-version "
+                        "drift on this image)")
+        from paddle_tpu import jit
+        from paddle_tpu.models import generate, save_for_serving
+        m = self._model()
+        path = str(tmp_path / "keyed")
+        save_for_serving(m, path, batch=2, prompt_len=8,
+                         max_new_tokens=5, do_sample=True,
+                         temperature=0.8, runtime_key=True)
+        loaded = jit.load(path)
+        ids = np.random.RandomState(0).randint(
+            1, 256, (2, 8)).astype(np.int32)
+        k1 = np.asarray(jax.random.PRNGKey(1))
+        k2 = np.asarray(jax.random.PRNGKey(2))
+        o1 = loaded(paddle.to_tensor(ids), paddle.to_tensor(k1)).numpy()
+        o1b = loaded(paddle.to_tensor(ids), paddle.to_tensor(k1)).numpy()
+        o2 = loaded(paddle.to_tensor(ids), paddle.to_tensor(k2)).numpy()
+        # same key reproduces; different keys re-randomize
+        np.testing.assert_array_equal(o1, o1b)
+        assert not np.array_equal(o1, o2)
+        # the runtime key is the live path's seed: key=PRNGKey(s)
+        # matches generate(seed=s) token for token
+        ref = generate(m, ids, max_new_tokens=5, do_sample=True,
+                       temperature=0.8, seed=1).numpy()
+        np.testing.assert_array_equal(o1, ref)
+        # the C-API-compatible Predictor serves the two-input artifact
+        pred = inference.create_predictor(
+            Config(path + ".pdmodel"))
+        np.testing.assert_array_equal(
+            np.asarray(pred.run([ids, k1])[0]), o1)
+
+
 class TestInertKnobsWarn:
     def test_trt_and_gpu_knobs_warn(self):
         cfg = Config()

@@ -229,6 +229,160 @@ class TestCapacityWin:
 
 
 # ---------------------------------------------------------------------------
+# quantized KV blocks: int8 storage + per-block max-abs scales
+# ---------------------------------------------------------------------------
+
+class TestQuantizedBlocks:
+    def test_same_budget_int8_admits_2x_vs_fp32(self):
+        """The tentpole capacity clause: at the SAME device byte budget
+        (block storage + scale overhead included) an int8 pool admits
+        at least 2x the concurrent requests of the fp32 paged pool —
+        int8 blocks are 4x smaller, minus the f32 per-block-per-head
+        scale array."""
+        fp = _paged_pool(num_slots=64, num_blocks=16)
+        budget = fp.capacity_bytes
+        q_blocks = PagedKVPool.blocks_within_budget(
+            budget, num_layers=fp.num_layers, num_heads=fp.num_heads,
+            block_size=fp.block_size, head_dim=fp.head_dim,
+            dtype="int8")
+        q = _paged_pool(num_slots=64, num_blocks=q_blocks, dtype="int8")
+        assert q.capacity_bytes <= budget       # honest accounting
+        need = 8                                # one block per request
+
+        def admitted(pool):
+            n = 0
+            while pool.can_admit(need):
+                slot = pool.alloc()
+                if slot is None:
+                    break
+                pool.admit_fresh(slot, need)
+                n += 1
+            return n
+
+        n_fp, n_q = admitted(fp), admitted(q)
+        assert n_q >= 2 * n_fp, (n_fp, n_q)
+        _check_free_list(q)
+
+    def test_quant_roundtrip_error_is_bounded(self):
+        """The per-block max-abs scheme's unit bound: |dequant(quant(x))
+        - x| <= scale/2 per element, scale = blockwise max|x|/127."""
+        import jax.numpy as jnp
+
+        from paddle_tpu.models.generation import (_dequant_gather,
+                                                  _quant_write_blocks)
+        rng = np.random.RandomState(0)
+        vals = rng.randn(3, 2, 8, 4).astype(np.float32) * 2.0  # [Tp,H,bs,Dh]
+        pool = jnp.zeros((1, 2, 5, 2, 8, 4), jnp.int8)
+        scales = jnp.zeros((1, 2, 5, 2), jnp.float32)
+        table = np.array([1, 2, 3], np.int32)
+        pool, scales = _quant_write_blocks(pool, scales, 0, 0, table,
+                                           jnp.asarray(vals), 127.0)
+        deq = np.asarray(_dequant_gather(pool, scales, 0, 0,
+                                         table[None, :]))[0]
+        bound = np.abs(vals).max(axis=(2, 3), keepdims=True) / 127.0
+        assert (np.abs(deq - vals) <= bound * 0.5001 + 1e-7).all()
+
+    def test_recycled_block_scale_is_reset(self):
+        """A freed block returning through the allocator must NOT keep
+        its previous tenant's max-abs scale: ``_quant_append`` only
+        GROWS scales (scatter-max), so a stale coarse scale would
+        quantize the next tenant's growth appends to near-zero ints —
+        the 'bounded drift' contract silently broken by block churn."""
+        import jax.numpy as jnp
+
+        from paddle_tpu.models.generation import _quant_write_blocks
+        pool = _paged_pool(num_slots=2, num_blocks=2, max_len=16,
+                           min_bucket=8, dtype="int8")
+        a = pool.alloc()
+        blocks = pool.admit_fresh(a, 16)          # takes both blocks
+        pool.data, pool.scales = _quant_write_blocks(
+            pool.data, pool.scales, 0, 0, np.asarray(blocks, np.int32),
+            jnp.full((2, 1, 8, 1), 100.0), 127.0)
+        assert np.asarray(pool.scales)[0, 0, blocks[1]] > 0.5
+        pool.free(a)                              # blocks recycled
+        b = pool.alloc()
+        pool.admit_fresh(b, 8)
+        pool.set_slot(b, pos=8, lo=0)
+        pool.ensure_writable(b)                   # growth re-allocates
+        grown = pool.slot_table(b)[1]
+        assert float(np.asarray(pool.scales)[0, 0, grown]) == 0.0
+
+    def test_int8_logit_drift_bounded_vs_fp32(self, served_model):
+        """Identical prompt, identical decode step, fp32 vs int8 pool:
+        the per-step LOGIT drift stays small relative to the logit
+        scale — the bounded-drift half of the capacity win (token
+        parity on trained margins is the other half, asserted by the
+        parametrized engine tests)."""
+        import jax
+
+        from paddle_tpu.models.generation import (build_paged_decode_fn,
+                                                  build_paged_prefill_fn)
+        from paddle_tpu.nn.layer.layers import (get_buffers_tree,
+                                                get_params_tree)
+        model = served_model
+        params = get_params_tree(model)
+        buffers = get_buffers_tree(model)
+        rng = np.random.RandomState(3)
+        prompt = _prompt(rng, 13)
+        bucket, bs, T = 16, 8, 2
+        ids = np.zeros((1, bucket), np.int32)
+        ids[0, :prompt.size] = prompt
+        kv = np.zeros((1, bucket), bool)
+        kv[0, :prompt.size] = True
+        table = np.array([1, 2], np.int32)
+        key = jax.random.PRNGKey(0)
+        logits = {}
+        for dtype in ("float32", "int8"):
+            pool = _paged_pool(num_slots=1, num_blocks=8, num_heads=4,
+                               head_dim=16, num_layers=2, dtype=dtype)
+            quant = pool.quantized
+            pre = build_paged_prefill_fn(model, bucket, bs,
+                                         quantized=quant)
+            dec = build_paged_decode_fn(model, 1, T, bs, quantized=quant,
+                                        debug_logits=True)
+            sc = (pool.scales,) if quant else ()
+            out = pre(params, buffers, pool.data, *sc, ids, kv, table,
+                      np.int32(prompt.size), np.bool_(False),
+                      np.float32(1.0), key)
+            data, scales = out[0], (out[1] if quant else None)
+            first = int(np.asarray(out[-2])[0])
+            sc = (scales,) if quant else ()
+            out = dec(params, buffers, data, *sc,
+                      np.asarray([first], np.int32),
+                      np.asarray([prompt.size], np.int32),
+                      np.zeros(1, np.int32), table[None, :],
+                      np.zeros(1, bool), np.ones(1, np.float32), key)
+            logits[dtype] = np.asarray(out[-2])[0]
+        scale = np.abs(logits["float32"]).max()
+        drift = np.abs(logits["int8"] - logits["float32"]).max()
+        assert drift < 0.05 * max(scale, 1.0), (drift, scale)
+        # and the drift is small enough that the trained argmax holds
+        assert logits["int8"].argmax() == logits["float32"].argmax()
+
+    def test_nonfinite_sentinel_trips_through_quantized_pool(self):
+        """The PR-9 serving logits-finite sentinel must survive int8
+        storage: a NaN row drives its block's SCALE nonfinite (the
+        EQuARX rule — int8 * NaN re-materializes the corruption instead
+        of silently rounding it away), the logits go nonfinite, the
+        sentinel rides the one-per-cycle fetch, and the loop SURVIVES."""
+        import jax.numpy as jnp
+        paddle.seed(0)
+        poisoned = GPTForPretraining(GPTConfig.tiny())
+        poisoned.eval()
+        p = poisoned.parameters()[0]
+        p._data = jnp.full(p.shape, jnp.nan, p._data.dtype)
+        eng = GenerationEngine(poisoned, num_slots=2, max_len=32,
+                               kv_layout="paged", block_size=8,
+                               kv_dtype="int8")
+        out = eng.submit(np.arange(1, 6, dtype=np.int32),
+                         max_new_tokens=4).result(timeout=300)
+        stats = eng.stats()
+        eng.close()
+        assert out.shape == (9,)        # the loop served, not crashed
+        assert stats["nonfinite_cycles"] > 0
+
+
+# ---------------------------------------------------------------------------
 # the memory manager: free list, refcounts, COW, misuse fail-fast
 # ---------------------------------------------------------------------------
 
@@ -372,14 +526,19 @@ class TestPrefixCache:
         pool.free(b)
         _check_free_list(pool)
 
+    @pytest.mark.parametrize("kv_dtype", [None, "int8"])
     def test_engine_prefix_hit_skips_prefill_and_stays_exact(
-            self, served_model):
+            self, served_model, kv_dtype):
         """Requests sharing a two-block system prompt: the first
         computes it, the rest adopt its cached blocks — prefill is
         skipped entirely (the tail replays through the decode step),
-        tokens are saved, and the output still matches generate."""
+        tokens are saved, and the output still matches generate.
+        Parametrized over int8 blocks: prefix caching rides on
+        quantized storage unchanged (scales travel with the block
+        ids)."""
         eng = GenerationEngine(served_model, num_slots=4, max_len=64,
-                               kv_layout="paged", block_size=8)
+                               kv_layout="paged", block_size=8,
+                               kv_dtype=kv_dtype)
         rng = np.random.RandomState(5)
         system = _prompt(rng, 16)     # exactly two full blocks
         tails = [_prompt(rng, n) for n in (3, 1, 6)]
@@ -433,16 +592,19 @@ class TestPrefixCache:
 # ---------------------------------------------------------------------------
 
 class TestPreemption:
+    @pytest.mark.parametrize("kv_dtype", [None, "int8"])
     def test_block_pressure_preempts_youngest_and_both_finish_exact(
-            self, served_model):
+            self, served_model, kv_dtype):
         """Two long requests whose combined growth exceeds the block
         budget: the YOUNGEST is preempted (blocks freed, request
         requeued, history replayed on re-admission) instead of
         deadlocking — and both still produce the exact generate()
-        sequence."""
+        sequence. Parametrized over int8 blocks: preemption/replay
+        rides on quantized storage unchanged."""
         eng = GenerationEngine(served_model, num_slots=2, max_len=32,
                                kv_layout="paged", block_size=8,
-                               num_blocks=4)    # half the dense budget
+                               num_blocks=4,    # half the dense budget
+                               kv_dtype=kv_dtype)
         pa = _prompt(np.random.RandomState(6), 4)
         pb = _prompt(np.random.RandomState(7), 4)
         ha = eng.submit(pa, max_new_tokens=24)
