@@ -762,19 +762,41 @@ def bench_zero():
         for k in m_rep._params)
     z8["drift_vs_replicated"] = round(int8_drift, 5)
     int8_ok = np.isfinite(z8["loss"]) and int8_drift < 0.05
+    # ISSUE-13 collective device timing: the zero fits above ran the
+    # sampled same-shape probe (first step always), so the per-kind
+    # timing histograms and the exposed-vs-overlapped report must be
+    # live — this is the instrument the ZeRO overlap follow-on will be
+    # judged by, so its absence is a failed bench, not a missing row
+    from paddle_tpu.distributed import collective as _coll
+    comm = _coll.communication_report()
+    coll_ms = {
+        kind: round(row["time_ms"]["p50"], 4)
+        for kind, row in comm["per_kind"].items()
+        if row["time_ms"] and kind in ("reduce_scatter", "all_gather",
+                                       "all_to_all")}
+    timing_ok = "reduce_scatter" in coll_ms and "all_gather" in coll_ms \
+        and "all_to_all" in coll_ms \
+        and comm["exposed_ms_per_step"] is not None
     # the win must be real: ~1/dp per-replica opt state (half counts as
     # failed — padding can only cost one stripe) and identical training
-    if not parity or shrink < dp / 2 or not int8_ok:
+    if not parity or shrink < dp / 2 or not int8_ok or not timing_ok:
         raise RuntimeError(
             f"zero bench invalid: parity={parity} "
             f"opt_state_shrink={shrink:.2f} (expected ~{dp}x) "
-            f"int8_drift={int8_drift:.4f} int8_loss={z8['loss']}")
+            f"int8_drift={int8_drift:.4f} int8_loss={z8['loss']} "
+            f"collective_timing={coll_ms}")
     return {"metric": "zero_sharded_step_ms", "value": z["step_ms"],
             "unit": "ms", "dp": dp, "parity": parity,
             "replicated": rep, "zero": z, "zero_int8": z8,
             "opt_state_shrink": round(shrink, 2),
             "step_ms_vs_replicated": round(
                 z["step_ms"] / max(1e-9, rep["step_ms"]), 3),
+            "collective_time_ms": coll_ms,
+            "comm_exposed_ms_per_step": round(
+                comm["exposed_ms_per_step"], 4),
+            "comm_overlap_headroom_pct":
+                None if comm["overlap_headroom_pct"] is None
+                else round(comm["overlap_headroom_pct"], 2),
             "device_kind": _device_kind(), **pallas_state}
 
 
@@ -2232,6 +2254,100 @@ def dry_run():
 
         zero_canary = _zero_canary()
 
+        # ISSUE-13 telemetry spine: the labeled metrics registry is the
+        # surface every scale-out PR reports through, so the dry run
+        # proves it end to end — (1) an explicit dp=2 CPU-mesh probe of
+        # the ZeRO exchange populates collective_time_ms/{reduce_
+        # scatter,all_gather} and the exposed-vs-overlapped report;
+        # (2) statusz() renders with NO live engine (every canary
+        # engine above is closed) and WITH a live 2-replica EngineFleet
+        # whose aggregated stats sum the replicas' work with pooled
+        # latency percentiles; (3) the registry's Prometheus exposition
+        # is non-empty and round-trips through parse_prometheus with
+        # the collective-timing family on board; (4) one sampler-ring
+        # entry records the live gauges.
+        def _telemetry_canary():
+            import jax
+            from jax.sharding import Mesh
+
+            from paddle_tpu.distributed import collective as _coll
+            from paddle_tpu.framework import metrics as _reg
+            from paddle_tpu.hapi import zero as zmod
+            from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining
+            from paddle_tpu.serving import EngineFleet, GenerationEngine
+
+            timing_skipped = len(jax.devices()) < 2
+            probed = []
+            if not timing_skipped:
+                mesh = Mesh(np.array(jax.devices()[:2]), (zmod.AXIS,))
+                layout = zmod.FlatLayout.build(
+                    {"w": np.zeros((4096,), np.float32)}, dp=2)
+                probed = sorted(
+                    zmod.time_step_collectives(mesh, layout, "int8"))
+            comm = _coll.communication_report()
+            timing_live = timing_skipped or (
+                monitor.stat_histogram(
+                    "collective_time_ms/reduce_scatter") is not None
+                and monitor.stat_histogram(
+                    "collective_time_ms/all_gather") is not None
+                and comm["exposed_ms_per_step"] is not None)
+
+            console_idle = _reg.statusz()
+            idle_ok = ("(no live engines)" in console_idle
+                       and "--- collectives ---" in console_idle
+                       and "--- memory ---" in console_idle
+                       and "--- training ---" in console_idle
+                       and "(section error" not in console_idle)
+
+            def mk():
+                paddle.framework.random.seed(0)
+                m = GPTForPretraining(GPTConfig.tiny())
+                m.eval()
+                return GenerationEngine(m, num_slots=2, max_len=32,
+                                        min_bucket=8)
+            fleet = EngineFleet([mk(), mk()], name="dryrun")
+            handles = [fleet.submit(np.arange(1, 1 + n, dtype=np.int32),
+                                    max_new_tokens=3)
+                       for n in (3, 5, 4, 6)]
+            for h in handles:
+                h.result(timeout=300)
+            fstats = fleet.stats()
+            fleet_ok = (fstats["replicas_healthy"] == 2
+                        and fstats["requests_retired"] == 4
+                        and fstats["ttft_ms"] is not None
+                        and fstats["ttft_ms"]["count"] == 4
+                        and len(fstats["replicas"]) == 2)
+            console_live = _reg.statusz()
+            live_ok = ("engine #" in console_live
+                       and "fleet dryrun: 2/2 healthy" in console_live
+                       and "(section error" not in console_live)
+            prom_text = _reg.to_prometheus()
+            parsed = _reg.parse_prometheus(prom_text)
+            prom_ok = (
+                len(parsed["samples"]) > 0
+                and parsed["types"].get("collective_time_ms") == "summary"
+                and any(n == "serving_requests_retired"
+                        for n, _ in parsed["samples"]))
+            ring_entry = _reg.registry().sample_now(label="dryrun")
+            ring_ok = (len(ring_entry["values"]) > 0
+                       and len(_reg.registry().timeseries()) > 0)
+            fleet.close()
+            return {"timing_skipped": timing_skipped,
+                    "probed_kinds": probed,
+                    "timing_live": timing_live,
+                    "exposed_ms_per_step": comm["exposed_ms_per_step"],
+                    "statusz_idle_ok": idle_ok,
+                    "statusz_live_ok": live_ok,
+                    "fleet_ok": fleet_ok,
+                    "fleet_requests_retired":
+                        fstats.get("requests_retired"),
+                    "fleet_ttft_p50": (fstats["ttft_ms"] or {}).get("p50"),
+                    "prometheus_ok": prom_ok,
+                    "prometheus_samples": len(parsed["samples"]),
+                    "ring_ok": ring_ok}
+
+        telemetry_canary = _telemetry_canary()
+
     # ISSUE-7: the bench regression gate, exercised the way the driver
     # would use it — a seeded artifact vs a doctored copy with a 20%
     # throughput loss and a 40% latency blowup must exit nonzero
@@ -2417,6 +2533,18 @@ def dry_run():
         # ledger's ~1/dp per-replica opt-state bytes
         "zero_parity": zero_canary["parity"],
         "zero_opt_state_sharded": zero_canary["ledger_ok"],
+        # ISSUE-13 telemetry spine: dp=2 collective timing + the
+        # exposed-vs-overlapped report live, statusz renders with and
+        # without a live engine, the fleet aggregation sums replicas'
+        # work with pooled percentiles, the Prometheus exposition
+        # round-trips non-empty, the sampler ring records
+        "telemetry_collective_timing": telemetry_canary["timing_live"],
+        "telemetry_statusz_idle": telemetry_canary["statusz_idle_ok"],
+        "telemetry_statusz_live": telemetry_canary["statusz_live_ok"],
+        "telemetry_fleet_agg": telemetry_canary["fleet_ok"],
+        "telemetry_prometheus_roundtrip":
+            telemetry_canary["prometheus_ok"],
+        "telemetry_sampler_ring": telemetry_canary["ring_ok"],
     }
     print(monitor.stats_summary(), file=sys.stderr)
     for f in lint_findings:
@@ -2467,6 +2595,12 @@ def dry_run():
                               monitor.stat_get("hapi/nonfinite_steps"),
                       },
                       "zero": zero_canary,
+                      "telemetry": {k: telemetry_canary[k] for k in
+                                    ("probed_kinds",
+                                     "exposed_ms_per_step",
+                                     "fleet_requests_retired",
+                                     "fleet_ttft_p50",
+                                     "prometheus_samples")},
                       "compile_count":
                           int(monitor.stat_get("compile/count")),
                       "hapi_mfu": (monitor.stat_histogram("hapi/mfu")

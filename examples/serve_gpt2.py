@@ -35,10 +35,16 @@ per-block max-abs scales, so the same device byte budget admits ~4x
 the blocks — the ``block capacity`` line shows the same-budget
 comparison against fp32.
 
+``--statusz`` prints the one-call ops console
+(``framework.metrics.statusz()``) while the engine is live, and
+``--prom FILE`` writes the Prometheus exposition of the whole metrics
+surface — the operational view every flag above feeds.
+
 Usage:
     python examples/serve_gpt2.py [--clients 12] [--slots 8] [--mp 2]
                                   [--paged] [--fused] [--spec]
                                   [--kv-dtype int8]
+                                  [--statusz] [--prom metrics.prom]
 """
 import argparse
 import threading
@@ -128,6 +134,15 @@ def main():
                     help="paged KV block storage dtype; int8 stores "
                          "quantized blocks with per-block max-abs "
                          "scales (~4x blocks per byte budget)")
+    ap.add_argument("--statusz", action="store_true",
+                    help="print the one-call ops console "
+                         "(framework.metrics.statusz()) while the "
+                         "engine is still live: pool occupancy, prefix "
+                         "cache, latency, HBM headroom in one report")
+    ap.add_argument("--prom", default=None, metavar="FILE",
+                    help="write the Prometheus text exposition of the "
+                         "whole metrics surface (registry + monitor "
+                         "bridge) to FILE after the run")
     args = ap.parse_args()
     if args.spec:
         args.fused = True
@@ -204,6 +219,15 @@ def main():
         t.join()
     wall = time.perf_counter() - t0
     stats = engine.stats()      # snapshot BEFORE close drains the pool
+    if args.statusz:
+        # the ops console, rendered while the engine is still LIVE so
+        # its serving section shows this engine's row
+        from paddle_tpu.framework import metrics
+        print("\n" + metrics.statusz())
+    if args.prom:
+        from paddle_tpu.framework import metrics
+        metrics.to_prometheus(args.prom)
+        print(f"prometheus exposition -> {args.prom}")
     engine.close()
 
     for ln in sorted(lines):

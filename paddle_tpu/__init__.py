@@ -48,6 +48,12 @@ def _import_if_built(name):
     return None
 
 
+# the one-call ops console + labeled metrics registry (ISSUE 13):
+# paddle.statusz() prints pool occupancy, cache hit ratios, MFU, HBM
+# headroom and recent anomalies; paddle.metrics is the registry surface
+from .framework import metrics  # noqa: E402,F401
+from .framework.metrics import statusz  # noqa: E402,F401
+
 for _m in ("autograd", "optimizer", "amp", "io", "metric", "static", "jit",
            "vision", "distributed", "hapi", "parallel", "profiler",
            "incubate", "models", "utils", "inference", "distribution",

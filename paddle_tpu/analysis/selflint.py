@@ -53,6 +53,15 @@ Python cannot enforce (≙ the reference's tools/codestyle custom checks
   are exempt; dynamic dims (names/expressions) are trusted — the
   kernels derive them from array shapes.
 
+* ``metric-naming`` — literal metric names at monitor
+  (``stat_add``/``stat_observe``) and metrics-registry
+  (``metrics.inc``/``observe``/``set_gauge``) write sites are lowercase
+  snake_case path segments, and a name that says it carries time or
+  size says the unit: ``_ms``/``_bytes``, never ``_time``/``_secs``/
+  ``_mb``. One process's metrics feed one Grafana; a ``*_secs`` sample
+  landing in a ``*_ms`` panel misreads by 1000x and a CamelCase name
+  breaks every PromQL regex written against the snake_case rest.
+
 Suppress a finding with a trailing ``# lint: ok`` comment on the line
 (used only where a human has argued the exception in an adjacent
 comment). Run: ``python -m paddle_tpu.analysis --selflint`` or the
@@ -207,6 +216,81 @@ def _blockspec_literal_dims(node: ast.Call):
     return lit(shape.elts[-2]), lit(shape.elts[-1])
 
 
+# metric-emitting call sites the metric-naming rule inspects: the
+# monitor writers anywhere, and the metrics-registry writers when
+# called through a module alias that names the registry
+_MONITOR_WRITERS = ("stat_add", "stat_observe")
+_REGISTRY_WRITERS = ("inc", "set_gauge", "observe")
+# a name part ending in one of these carries a time/size quantity with
+# NO unit: the naming contract wants _ms / _bytes so dashboards never
+# have to guess (and never mix seconds into a *_ms panel)
+_UNITLESS_TIME_SUFFIXES = ("_time", "_latency", "_duration", "_secs",
+                           "_seconds")
+_NON_BYTE_SIZE_SUFFIXES = ("_kb", "_mb", "_gb", "_kib", "_mib", "_gib")
+_METRIC_CHARSET = frozenset("abcdefghijklmnopqrstuvwxyz0123456789_/")
+
+
+def _metric_leading_literal(arg) -> "Optional[tuple]":
+    """(leading_literal, is_full_literal) of a metric-name argument, or
+    None when nothing literal leads it (a fully dynamic name is the
+    caller's problem — the registry validates at write time)."""
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value, True
+    if isinstance(arg, ast.JoinedStr) and arg.values:
+        head = arg.values[0]
+        if isinstance(head, ast.Constant) and isinstance(head.value, str):
+            return head.value, False
+    return None
+
+
+def _metric_name_finding(node: ast.Call) -> Optional[str]:
+    """The metric-naming rule body: literal metric names at monitor /
+    registry write sites must be lowercase snake_case path segments
+    (``[a-z0-9_/]``; dimensions belong in labels or the per-key path
+    tail, units in a ``_ms``/``_bytes`` suffix), and a name that SAYS
+    it carries time or size must say the unit (``op_time`` -> error,
+    ``op_time_ms`` -> fine; ``_gb`` -> ``_bytes``)."""
+    f = node.func
+    fname = f.attr if isinstance(f, ast.Attribute) else \
+        (f.id if isinstance(f, ast.Name) else None)
+    if fname in _MONITOR_WRITERS:
+        pass
+    elif fname in _REGISTRY_WRITERS:
+        # only when addressed through a metrics-registry alias —
+        # .observe()/.inc() are common method names elsewhere
+        v = getattr(f, "value", None)
+        if not (isinstance(v, ast.Name) and "metric" in v.id.lower()):
+            return None
+    else:
+        return None
+    if not node.args:
+        return None
+    lit = _metric_leading_literal(node.args[0])
+    if lit is None:
+        return None
+    text, full = lit
+    bad = sorted({c for c in text if c not in _METRIC_CHARSET})
+    if bad:
+        return (f"metric name {text!r} violates the naming contract "
+                f"(snake_case [a-z0-9_] path segments; offending "
+                f"chars: {''.join(bad)!r}) — dimensions go in labels "
+                f"or the per-key path tail, never CamelCase/-/spaces")
+    if full:
+        tail = text.rsplit("/", 1)[-1]
+        for suf in _UNITLESS_TIME_SUFFIXES:
+            if tail.endswith(suf):
+                return (f"metric name {text!r} carries a time quantity "
+                        f"without its unit: suffix it _ms (the naming "
+                        f"contract — a *_secs sample in a *_ms panel "
+                        f"is a 1000x lie)")
+        for suf in _NON_BYTE_SIZE_SUFFIXES:
+            if tail.endswith(suf):
+                return (f"metric name {text!r} bakes a scaled size unit "
+                        f"into the name: record raw _bytes and let the "
+                        f"dashboard scale")
+    return None
+
+
 def lint_source(path: str, source: str, relpath: str) -> List[LintFinding]:
     """Lint one file's source. ``relpath`` is the path relative to the
     package root (rule applicability is keyed on it)."""
@@ -357,6 +441,13 @@ def lint_source(path: str, source: str, relpath: str) -> List[LintFinding]:
                         "lock-free BY CONTRACT (module docstring) — a "
                         "lock per eager op dispatch serializes the "
                         "engine"))
+
+        # rule: metric-naming (snake_case paths, unit-suffixed units)
+        if isinstance(node, ast.Call):
+            mfind = _metric_name_finding(node)
+            if mfind and not _suppressed(lines, node.lineno):
+                findings.append(LintFinding(
+                    "metric-naming", path, node.lineno, mfind))
 
         # rule: asarray-on-traced (op impls that run under jit)
         if isinstance(node, ast.FunctionDef):

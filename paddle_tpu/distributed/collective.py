@@ -35,7 +35,9 @@ __all__ = ["ReduceOp", "Group", "new_group", "get_group", "all_reduce",
            "wait", "barrier", "get_backend", "is_available",
            "destroy_process_group", "all_gather_object", "psum_in_axis",
            "all_gather_in_axis", "ppermute_in_axis", "all_to_all_in_axis",
-           "reduce_scatter_in_axis"]
+           "reduce_scatter_in_axis", "observe_collective_time",
+           "timing_sampled", "note_step_exchange",
+           "communication_report", "communication_report_table"]
 
 
 class ReduceOp:
@@ -169,6 +171,195 @@ def _traced(kind: str, *tensors):
 
 
 # ---------------------------------------------------------------------------
+# device timing (ISSUE 13): the byte counters above price what we SEND;
+# collective_time_ms/<kind> prices what it COSTS. Two mechanics:
+#
+# * eager collectives — a sampled block-until-ready bracket around the
+#   call (``_timed_eager``): the first call per kind is always timed,
+#   then every FLAGS_collective_timing_every-th, because a per-call
+#   device barrier would serialize exactly the pipeline the eager API
+#   exists to feed;
+# * in-step collectives (the ZeRO exchange) — XLA fuses them inside one
+#   donated program where no host timer can see them, so
+#   ``hapi/zero.time_step_collectives`` runs each kind ISOLATED in a
+#   tiny jitted shard_map over the same mesh axis and payload shape,
+#   warmed once (compile excluded) and bracketed here via
+#   :func:`observe_collective_time`. What that yields is the EXPOSED
+#   (un-overlapped) cost of the exchange — which is the honest number:
+#   the current zero step brackets the exchange serially, and the
+#   overlap follow-on (ROADMAP) is claimable exactly to the extent this
+#   figure shrinks out of the step wall time.
+#
+# ``collective_bw_gbps/<kind>`` joins the two: payload bytes / measured
+# ms, the achieved-bandwidth figure a hardware round compares against
+# ICI peak. ``communication_report()`` assembles the whole picture.
+# ---------------------------------------------------------------------------
+
+import threading as _threading  # noqa: E402
+import time as _time  # noqa: E402
+
+_timing_lock = _threading.Lock()
+_timing_counts: dict = {}
+
+
+def _timing_flag(name: str, default):
+    try:
+        from ..framework.flags import flag_value
+        return flag_value(name)
+    except Exception:                                    # noqa: BLE001
+        return default
+
+
+def timing_sampled(kind: str) -> bool:
+    """Should THIS call of ``kind`` be device-timed? First call per
+    kind: yes; then every FLAGS_collective_timing_every-th. False
+    everywhere when FLAGS_collective_timing is off."""
+    if not _timing_flag("FLAGS_collective_timing", True):
+        return False
+    every = max(1, int(_timing_flag("FLAGS_collective_timing_every", 16)))
+    with _timing_lock:
+        n = _timing_counts.get(kind, 0)
+        _timing_counts[kind] = n + 1
+    return n % every == 0
+
+
+# the kinds that make up the CURRENT training step's exchange, noted by
+# the ZeRO probe (fp32: reduce_scatter+all_gather; int8: the all_to_all
+# pair + all_gather). exposed_ms_per_step sums ONLY these — a one-shot
+# broadcast at init, an eager metric all_reduce, or the probe's
+# comparison kinds would otherwise be billed as per-step cost and
+# overstate the overlap headroom.
+_step_exchange_kinds: Optional[tuple] = None
+
+
+def note_step_exchange(kinds) -> None:
+    """Record which collective kinds constitute the live train step's
+    exchange (see :func:`communication_report`)."""
+    global _step_exchange_kinds
+    _step_exchange_kinds = tuple(kinds) if kinds else None
+
+
+def observe_collective_time(kind: str, ms: float, nbytes: int = 0) -> None:
+    """Record one device-timing sample for a collective kind:
+    ``collective_time_ms/<kind>`` and, when the payload is known,
+    ``collective_bw_gbps/<kind>`` (payload bytes / measured wall)."""
+    from ..framework.monitor import stat_observe
+    stat_observe(f"collective_time_ms/{kind}", float(ms))
+    if nbytes and ms > 0:
+        # bytes / (ms * 1e-3 s) / 1e9 B/GB == nbytes / (ms * 1e6)
+        stat_observe(f"collective_bw_gbps/{kind}", nbytes / (ms * 1e6))
+
+
+class _TimingBox:
+    """Carries the eager collective's result out of the ``with`` body so
+    the sampled bracket can block on the actual device value."""
+    __slots__ = ("result",)
+
+    def __init__(self):
+        self.result = None
+
+
+@_contextlib.contextmanager
+def _timed_eager(kind: str, *tensors):
+    """_traced plus the sampled block-until-ready bracket. The body
+    stores its device result in the yielded box; an unsampled call pays
+    one lock-free counter read and nothing else."""
+    n = _payload_bytes(*tensors)
+    sampled = timing_sampled(kind)
+    t0 = _time.perf_counter() if sampled else 0.0
+    box = _TimingBox()
+    with _traced(kind, *tensors):
+        yield box
+    if sampled and box.result is not None:
+        try:
+            import jax
+            jax.block_until_ready(box.result)
+        except Exception:                                # noqa: BLE001
+            pass        # a host-only degenerate result has nothing to wait on
+        observe_collective_time(
+            kind, (_time.perf_counter() - t0) * 1e3, n)
+
+
+def communication_report() -> dict:
+    """The exposed-vs-overlapped communication picture, joined from the
+    three per-kind surfaces: byte counters (PR 10), device-timing
+    histograms and achieved bandwidth (this PR). Per kind:
+    ``{count, bytes_total, time_ms: {p50,...}, achieved_gbps}``; and
+    when a training step is live, ``exposed_ms_per_step`` (sum of
+    per-kind p50 isolated times) against ``step_p50_ms``
+    (``hapi/step_time_ms``) — the fraction of the step the exchange
+    would stop costing if fully overlapped (the claim the ZeRO overlap
+    follow-on must cash; "Automatic Cross-Replica Sharding", PAPERS.md).
+    The collective-pairing analysis pass proves the program CONTAINS a
+    matched reduce-scatter/all-gather pair; this report prices it."""
+    from ..framework import monitor
+    stats = monitor.all_stats()
+    hists = monitor.all_histograms()
+    kinds = set()
+    for k in list(stats) + list(hists):
+        for fam in ("collective_bytes/", "collective_count/",
+                    "collective_time_ms/", "collective_bw_gbps/"):
+            if k.startswith(fam):
+                kinds.add(k[len(fam):])
+    per_kind = {}
+    for kind in sorted(kinds):
+        bw = hists.get(f"collective_bw_gbps/{kind}")
+        per_kind[kind] = {
+            "count": stats.get(f"collective_count/{kind}", 0.0),
+            "bytes_total": stats.get(f"collective_bytes/{kind}"),
+            "time_ms": hists.get(f"collective_time_ms/{kind}"),
+            "achieved_gbps": bw["p50"] if bw else None,
+        }
+    step = hists.get("hapi/step_time_ms")
+    # exposed cost = the step's own exchange (note_step_exchange), so a
+    # one-shot broadcast or the probe's comparison kinds never inflate
+    # it; with nothing noted (eager-only programs) every timed kind
+    # counts — the pre-probe behavior, documented imprecision included
+    timed = []
+    if _step_exchange_kinds:
+        timed = [per_kind[k]["time_ms"]["p50"]
+                 for k in _step_exchange_kinds
+                 if k in per_kind and per_kind[k]["time_ms"]]
+    if not timed:
+        timed = [r["time_ms"]["p50"] for r in per_kind.values()
+                 if r["time_ms"]]
+    exposed = float(sum(timed)) if timed else None
+    out = {"per_kind": per_kind,
+           "step_p50_ms": step["p50"] if step else None,
+           "exposed_ms_per_step": exposed,
+           "exposed_fraction": None,
+           "overlap_headroom_pct": None}
+    if exposed is not None and step and step["p50"] > 0:
+        frac = min(1.0, exposed / step["p50"])
+        out["exposed_fraction"] = frac
+        out["overlap_headroom_pct"] = 100.0 * frac
+    return out
+
+
+def communication_report_table() -> str:
+    """Human-readable :func:`communication_report` (statusz section)."""
+    rep = communication_report()
+    if not rep["per_kind"]:
+        return "(no collectives recorded)"
+    lines = [f"{'kind':<24} {'count':>8} {'bytes':>14} "
+             f"{'p50 ms':>9} {'GB/s':>7}"]
+    for kind, row in sorted(rep["per_kind"].items()):
+        t = row.get("time_ms") or {}
+        lines.append(
+            f"{kind:<24} {row.get('count', 0):>8.0f} "
+            f"{row.get('bytes_total') or 0:>14.0f} "
+            f"{t.get('p50', 0.0):>9.3f} "
+            f"{row.get('achieved_gbps') or 0:>7.2f}")
+    if rep["exposed_ms_per_step"] is not None:
+        lines.append(
+            f"exposed comm/step {rep['exposed_ms_per_step']:.3f} ms"
+            + (f" of step p50 {rep['step_p50_ms']:.3f} ms "
+               f"({rep['overlap_headroom_pct']:.1f}% overlap headroom)"
+               if rep["step_p50_ms"] else ""))
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
 # eager process-level API (reference parity)
 # ---------------------------------------------------------------------------
 
@@ -192,8 +383,9 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     Under SPMD the data-parallel grad sync happens inside the jitted step;
     this eager entry point exists for reference API parity (e.g. manual
     metric reduction)."""
-    with _traced("all_reduce", tensor):
+    with _timed_eager("all_reduce", tensor) as _t:
         if _degenerate():
+            _t.result = tensor._data   # identity, but the bracket works
             return tensor
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -214,6 +406,7 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
             jax.shard_map(f, mesh=mesh, in_specs=spec, out_specs=spec))(
             _sharded_like(tensor._data, mesh, spec))
         tensor._data = out
+        _t.result = out
         return tensor
 
 
@@ -237,8 +430,9 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True):
 
 
 def broadcast(tensor, src=0, group=None, sync_op=True):
-    with _traced("broadcast", tensor):
+    with _timed_eager("broadcast", tensor) as _t:
         if _degenerate():
+            _t.result = tensor._data
             return tensor
         # replicated arrays are already consistent; broadcast is the act
         # of resharding to full replication
@@ -246,6 +440,7 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
         from jax.sharding import NamedSharding, PartitionSpec as P
         tensor._data = jax.device_put(
             tensor._data, NamedSharding(env.get_mesh(), P()))
+        _t.result = tensor._data
         return tensor
 
 

@@ -135,6 +135,19 @@ define_flag("FLAGS_grad_comm", "fp32",
             "quantized reduce-scatter (per-chunk max-abs scales "
             "computed in-step, ~4x fewer wire bytes), 'fp32' the exact "
             "exchange. Ignored unless zero sharding is armed")
+define_flag("FLAGS_collective_timing", True,
+            "Sampled device-side collective timing "
+            "(distributed/collective.py): eager collectives get a "
+            "block-until-ready bracket and the ZeRO step runs an "
+            "isolated same-shape probe of its reduce-scatter/all-gather "
+            "pair, feeding collective_time_ms/<kind> + "
+            "collective_bw_gbps/<kind> histograms and the "
+            "exposed-vs-overlapped communication report")
+define_flag("FLAGS_collective_timing_every", 16,
+            "Sampling stride for collective timing: the first call per "
+            "kind is always timed, then every Nth — a block-until-ready "
+            "per call would serialize the device, so timing stays a "
+            "sample, not a census")
 define_flag("FLAGS_hapi_prefetch", True,
             "Route Model.fit/evaluate input through io.device_prefetch "
             "(background H2D overlapping compute); the escape hatch for "

@@ -43,7 +43,8 @@ from collections import deque
 from typing import Dict, Optional
 
 __all__ = ["stat_add", "stat_get", "stat_reset", "stats_summary",
-           "all_stats", "stat_observe", "stat_histogram", "all_histograms"]
+           "all_stats", "stat_observe", "stat_histogram", "all_histograms",
+           "histogram_samples"]
 
 _lock = threading.Lock()
 _stats: Dict[str, float] = {}
@@ -138,6 +139,17 @@ def stat_histogram(name: str) -> Optional[dict]:
                 "max": h.vmax, "p50": _percentile(vals, 0.5),
                 "p95": _percentile(vals, 0.95),
                 "p99": _percentile(vals, 0.99)}
+
+
+def histogram_samples(name: str) -> list:
+    """Copy of a distribution's bounded reservoir (most recent samples,
+    oldest first). The sanctioned way for read-side layers — the
+    metrics registry bucketizing a monitor distribution, a fleet
+    pooling latency reservoirs — to reach raw samples without touching
+    ``_hists`` (the monitor-lock-contract self-lint bans that)."""
+    with _lock:
+        h = _hists.get(name)
+        return list(h.ring) if h is not None else []
 
 
 def all_histograms() -> Dict[str, dict]:

@@ -283,6 +283,13 @@ def _static_value_key(v):
     return (type(v).__name__, v)
 
 
+# process-wide trace serialization (see AotSite._compile): tracing a
+# step body that reads live Layer state (functional_state) is not
+# thread-safe across sites sharing one network; RLock because a traced
+# body may legitimately re-enter another AotSite under a tracer
+_TRACE_LOCK = threading.RLock()
+
+
 class AotSite:
     """A jit site that owns its executables: per input signature it
     traces, lowers and compiles EXPLICITLY (timing the compile and
@@ -383,9 +390,19 @@ class AotSite:
     def _compile(self, key, args):
         t0 = time.perf_counter()
         try:
-            traced = self.jitted.trace(*args)
-            eqns = len(traced.jaxpr.jaxpr.eqns)
-            compiled = traced.lower().compile()
+            # ONE trace at a time, process-wide: the serving/hapi step
+            # bodies trace through functional_state(net, ...), which
+            # temporarily rebinds the network's layer state — two
+            # engine scheduler threads tracing over a SHARED model
+            # concurrently corrupt each other's captures ("compiled for
+            # 79 inputs but called with 43", then a backend abort).
+            # Compiles are rare and the executable DISPATCH below stays
+            # outside the lock, so fleets serialize only their cold
+            # start.
+            with _TRACE_LOCK:
+                traced = self.jitted.trace(*args)
+                eqns = len(traced.jaxpr.jaxpr.eqns)
+                compiled = traced.lower().compile()
         except Exception as e:                           # noqa: BLE001
             logger.debug("AotSite %s: explicit compile failed (%r); "
                          "falling back to plain jit", self.site, e)
@@ -408,7 +425,13 @@ class AotSite:
         approximation) so ``compile/ms``/``compile/count`` stay live."""
         first = key is not None and key not in self._seen_fallback_keys
         t0 = time.perf_counter()
-        out = self.jitted(*args)
+        if first:
+            # same shared-model trace race as _compile: the first call
+            # per signature is the one that traces
+            with _TRACE_LOCK:
+                out = self.jitted(*args)
+        else:
+            out = self.jitted(*args)
         if first:
             self._seen_fallback_keys.add(key)
             note_compile(self.site, (time.perf_counter() - t0) * 1e3)

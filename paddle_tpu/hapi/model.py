@@ -1064,6 +1064,26 @@ class Model:
         # reference-only rebind (no sync): the network must never be
         # left pointing at the donated pre-step buffers
         self._rebind_network_state()
+        # sampled collective device timing (ISSUE 13): the zero step's
+        # exchange is fused inside the donated program, so its cost is
+        # priced by an isolated same-shape probe — first step always
+        # (the dry-run/bench canaries see it), then at the
+        # FLAGS_collective_timing_every stride. Host-side, outside the
+        # step: the probe blocks on ITS OWN tiny program, never on the
+        # in-flight train step.
+        if self._zero_stage and self._zero_mesh is not None \
+                and self._zero_layout is not None:
+            from ..distributed import collective as _collective
+            # stride keyed per comm mode: flipping fp32 -> int8 changes
+            # the probed wire shape, and its FIRST step must sample too
+            if _collective.timing_sampled(
+                    f"zero_step_probe_{self._grad_comm}"):
+                try:
+                    _zero.time_step_collectives(
+                        self._zero_mesh, self._zero_layout,
+                        self._grad_comm)
+                except Exception:                        # noqa: BLE001
+                    pass    # a failed probe must never fail a train step
         return loss, outs
 
     def _ensure_train_built(self):

@@ -53,7 +53,7 @@ import numpy as np
 __all__ = ["FlatLayout", "resolve_mesh", "shard_opt_state",
            "gather_opt_state", "is_sharded_state",
            "quantized_reduce_scatter", "replicate_buffers",
-           "QUANT_CHUNK", "AXIS"]
+           "time_step_collectives", "QUANT_CHUNK", "AXIS"]
 
 # per-chunk scale granularity of the int8 exchange: 256 elements per
 # f32 scale = 1/64 relative overhead on the quantized payload
@@ -361,3 +361,104 @@ def replicate_buffers(buffers, axis_name: str, dp: int):
         return (jax.lax.psum(b, axis_name) // dp).astype(b.dtype)
 
     return {k: one(v) for k, v in buffers.items()}
+
+
+# ---------------------------------------------------------------------------
+# collective device timing (ISSUE 13): price the exchange, not just its
+# bytes
+# ---------------------------------------------------------------------------
+
+# (mesh shape, axis names, padded length, grad_comm) -> list of warmed
+# probe entries (kind, payload_bytes, compiled_fn, operands)
+_PROBE_CACHE: Dict[tuple, list] = {}
+
+
+def time_step_collectives(mesh, layout: "FlatLayout",
+                          grad_comm: str = "fp32") -> Dict[str, float]:
+    """Sampled device timing of the ZeRO step's collective pair.
+
+    The in-step reduce-scatter and all-gather are fused inside ONE
+    donated XLA program — no host timer can bracket them there, and a
+    device trace needs an armed profiler session. So this probe runs
+    each kind ISOLATED, in a tiny jitted ``shard_map`` over the SAME
+    mesh axis and the SAME flat payload shape as the real exchange
+    (``layout.padded`` f32 in, one ``layout.stripe`` per replica out,
+    and the int8 all_to_all pair under ``grad_comm='int8'``), warmed
+    once per shape so compile never pollutes a sample, then bracketed
+    with ``block_until_ready``. The result feeds
+    ``collective_time_ms/<kind>`` + ``collective_bw_gbps/<kind>``
+    (distributed/collective.py) and is the EXPOSED cost of the
+    exchange: the zero step currently brackets it serially, so this is
+    what full overlap (the ROADMAP follow-on) would reclaim —
+    ``communication_report()`` joins it against ``hapi/step_time_ms``.
+
+    Called by ``Model.fit``'s zero dispatch path under the
+    FLAGS_collective_timing sampling stride (first step always); cheap
+    enough that the stride, not the probe, is the budget knob. Returns
+    ``{kind: ms}`` for the kinds probed."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ..distributed import collective as _coll
+
+    key = (tuple(int(s) for s in mesh.devices.shape),
+           tuple(mesh.axis_names), int(layout.padded), str(grad_comm))
+    probes = _PROBE_CACHE.get(key)
+    if probes is None:
+        dp, stripe, padded = layout.dp, layout.stripe, layout.padded
+        f32 = jnp.float32
+        probes = []
+
+        def sm(fn, in_specs, out_specs):
+            return jax.jit(jax.shard_map(
+                fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                check_vma=False))
+
+        rs = sm(lambda x: jax.lax.psum_scatter(
+            x, AXIS, scatter_dimension=0, tiled=True), P(), P(AXIS))
+        probes.append(("reduce_scatter", padded * 4, rs,
+                       (jnp.zeros((padded,), f32),)))
+        ag = sm(lambda x: jax.lax.all_gather(x, AXIS, axis=0, tiled=True),
+                P(AXIS), P())
+        probes.append(("all_gather", padded * 4, ag,
+                       (jnp.zeros((padded,), f32),)))
+        if grad_comm == "int8":
+            # the int8 path replaces psum_scatter with an all_to_all of
+            # int8 payload + f32 per-chunk scales; probe that wire shape
+            n_scales = padded // layout.chunk
+
+            def a2a(q, s):
+                qr = jax.lax.all_to_all(
+                    q.reshape(dp, stripe), AXIS, split_axis=0,
+                    concat_axis=0, tiled=True)
+                sr = jax.lax.all_to_all(
+                    s.reshape(dp, n_scales // dp), AXIS, split_axis=0,
+                    concat_axis=0, tiled=True)
+                return qr, sr
+            probes.append((
+                "all_to_all", padded + n_scales * 4,
+                sm(a2a, (P(), P()), (P(AXIS), P(AXIS))),
+                (jnp.zeros((padded,), jnp.int8),
+                 jnp.zeros((n_scales,), f32))))
+        # warm every probe once: the sample must price the collective,
+        # never its compile
+        for _, _, fn, operands in probes:
+            jax.block_until_ready(fn(*operands))
+        _PROBE_CACHE[key] = probes
+
+    import time
+    out: Dict[str, float] = {}
+    for kind, nbytes, fn, operands in probes:
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*operands))
+        ms = (time.perf_counter() - t0) * 1e3
+        _coll.observe_collective_time(kind, ms, nbytes)
+        out[kind] = ms
+    # tell the report which kinds the LIVE step actually pays per step:
+    # int8 replaces the fp32 reduce-scatter with the all_to_all pair,
+    # so the probed reduce_scatter is a comparison figure, not a cost
+    _coll.note_step_exchange(
+        ("all_to_all", "all_gather") if grad_comm == "int8"
+        else ("reduce_scatter", "all_gather"))
+    return out

@@ -34,6 +34,10 @@ from .span import (  # noqa: F401
 # byte ledger (train state, KV pools) + the OOM postmortem dump
 from . import memory  # noqa: F401
 
+# unified chrome-trace merger (timeline.py): host spans + request
+# lanes + memory timeline + XPlane device ops on one clock in one file
+from .timeline import export_unified_trace  # noqa: F401
+
 # training numerics health (numerics.py): device-side NaN/Inf sentinels
 # fused into the donated train step, gradient telemetry histograms, the
 # train-loop flight recorder and the anomaly postmortem
@@ -46,4 +50,5 @@ __all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
            "summary_table",
            "record", "profile", "enable", "disable", "reset", "is_active",
            "events", "dropped", "span_summary", "export_chrome_trace",
-           "export_prometheus", "memory", "numerics", "NumericsError"]
+           "export_prometheus", "export_unified_trace", "memory",
+           "numerics", "NumericsError"]

@@ -82,7 +82,13 @@ class MemoryTracker:
                  stats_fn: Optional[Callable[[], dict]] = None):
         if max_samples < 1:
             raise ValueError("max_samples must be >= 1")
-        self._lock = threading.Lock()
+        # RLock, not Lock: ledger owners drop their keys from weakref
+        # FINALIZERS (hapi Model._drop_ledger_keys), and a finalizer can
+        # fire on whatever thread happens to allocate — including THIS
+        # thread while it holds the lock inside timeline()/ledger()'s
+        # copy (the copy allocates, allocation can trigger GC). With a
+        # plain Lock that is a same-thread deadlock.
+        self._lock = threading.RLock()
         self._ring: deque = deque(maxlen=int(max_samples))
         self._ledger: Dict[str, int] = {}
         self._stats_fn = stats_fn or _device_stats
@@ -324,3 +330,34 @@ def oom_postmortem(error: Optional[BaseException] = None,
                    path: Optional[str] = None,
                    extra: Optional[dict] = None) -> Optional[str]:
     return _tracker.oom_postmortem(error, path=path, extra=extra)
+
+
+def _metrics_collector():
+    """Registry collector (ISSUE 13): the HBM ledger as per-owner
+    gauges plus one device poll for in-use/limit. Scrape-time only —
+    the collector is PULLED by snapshot/export, so the device query
+    rides the operator's scrape cadence, never a hot path."""
+    led = _tracker.ledger()
+    out = [("gauge", "hbm_ledger_bytes", {"owner": k}, float(v))
+           for k, v in led.items()]
+    out.append(("gauge", "hbm_ledger_total_bytes", {},
+                float(sum(led.values()))))
+    stats = _tracker._stats_fn() or {}
+    if "bytes_in_use" in stats:
+        out.append(("gauge", "hbm_bytes_in_use", {},
+                    float(stats["bytes_in_use"])))
+    if "bytes_limit" in stats:
+        out.append(("gauge", "hbm_bytes_limit", {},
+                    float(stats["bytes_limit"])))
+    return out
+
+
+def _register_memory_collector() -> None:
+    try:
+        from ..framework import metrics as _metrics
+        _metrics.register_collector("memory", _metrics_collector)
+    except Exception:                                    # noqa: BLE001
+        pass
+
+
+_register_memory_collector()

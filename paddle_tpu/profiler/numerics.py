@@ -74,9 +74,50 @@ from . import memory as _memory
 
 __all__ = ["NumericsError", "AuditLayout", "NumericsRecorder",
            "build_audit", "build_audit_flat", "group_params",
-           "decode_audit", "flag_mode", "MODES", "N_FIXED", "FINITE_ALL"]
+           "decode_audit", "flag_mode", "live_recorders", "MODES",
+           "N_FIXED", "FINITE_ALL"]
 
 MODES = ("off", "record", "warn", "halt")
+
+# live recorders, for the statusz training section and the metrics
+# registry collector (weakly held: recorders die with their Models)
+import weakref  # noqa: E402
+
+_LIVE_RECORDERS: "weakref.WeakSet" = weakref.WeakSet()
+_recorder_seq = 0
+
+
+def live_recorders() -> List["NumericsRecorder"]:
+    """The process's live training recorders, recorder_id order."""
+    return sorted(_LIVE_RECORDERS,
+                  key=lambda r: getattr(r, "recorder_id", 0))
+
+
+def _metrics_collector():
+    """Registry collector: per-recorder anomaly counters, labeled
+    ``{recorder=<id>}`` — the numerics island on the fleet scrape."""
+    out = []
+    for rec in list(_LIVE_RECORDERS):
+        labels = {"recorder": str(getattr(rec, "recorder_id", 0))}
+        out.append(("counter", "training_steps_recorded", labels,
+                    rec.steps_recorded))
+        out.append(("counter", "training_anomalies_recorded", labels,
+                    rec.anomalies_recorded))
+        out.append(("counter", "training_postmortem_dumps", labels,
+                    rec.dumps))
+    return out
+
+
+def _register_numerics_collector() -> None:
+    try:
+        from ..framework import metrics as _metrics
+        _metrics.register_collector("training_numerics",
+                                    _metrics_collector)
+    except Exception:                                    # noqa: BLE001
+        pass
+
+
+_register_numerics_collector()
 
 # audit vector layout: fixed scalar slots, then one per-group count
 IDX_BITS = 0          # packed finite bitmask (see bit constants below)
@@ -351,6 +392,13 @@ class NumericsRecorder:
         self._spike_min = int(spike_min_history)
         self._spike_window = int(spike_window)
         self._run = 0        # fit generation (see new_run)
+        # telemetry spine (ISSUE 13): live recorders are a statusz
+        # section and a registry-collector source; weak, so a dropped
+        # Model's recorder leaves the console with it
+        global _recorder_seq
+        _recorder_seq += 1
+        self.recorder_id = _recorder_seq
+        _LIVE_RECORDERS.add(self)
 
     def new_run(self) -> None:
         """Mark a fit boundary. The ring deliberately persists across

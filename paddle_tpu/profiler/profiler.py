@@ -230,11 +230,13 @@ class Profiler:
         self.current_state = self._scheduler(_current_step[0])
         self._transition(ProfilerState.CLOSED, self.current_state)
         self._step_t0 = time.perf_counter()
+        self._session_t0_us = self._step_t0 * 1e6
         return self
 
     def stop(self):
         self._transition(self.current_state, ProfilerState.CLOSED)
         self.current_state = ProfilerState.CLOSED
+        self._session_t1_us = time.perf_counter() * 1e6
         if self._native_session:
             _native().disable()
         if self._on_trace_ready is not None and _recorder.events:
@@ -276,6 +278,11 @@ class Profiler:
                     import jax
                     jax.profiler.start_trace(self.trace_dir)
                     self._device_active = True
+                    # host anchor for the unified-timeline merger: the
+                    # xplane's device clock is aligned by pinning its
+                    # first event to this perf_counter stamp
+                    import time as _t
+                    self._trace_anchor_us = _t.perf_counter() * 1e6
                 except Exception:
                     self._device_active = False
         elif was and not now:
@@ -356,6 +363,21 @@ class Profiler:
         [{name, plane, calls, total_us, avg_us}] sorted by total."""
         from .xplane import device_op_table
         return device_op_table(self.trace_dir, device_only=device_only)
+
+    def export_unified(self, path: str) -> str:
+        """ONE chrome-trace file with everything on one clock: the span
+        profiler's host timeline (serving request lanes included), the
+        HBM memory timeline as counter/instant events, and this
+        profiler's XPlane device ops aligned via the start_trace host
+        anchor (:mod:`.timeline`)."""
+        from .timeline import export_unified_trace
+        t0 = getattr(self, "_session_t0_us", None)
+        t1 = getattr(self, "_session_t1_us", None)
+        window = (t0, t1) if t0 is not None and t1 is not None else None
+        return export_unified_trace(
+            path, trace_dir=self.trace_dir,
+            anchor_us=getattr(self, "_trace_anchor_us", None),
+            window_us=window)
 
     @property
     def events(self):

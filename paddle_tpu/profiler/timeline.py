@@ -1,0 +1,142 @@
+"""Unified chrome-trace merger: every timeline, one clock, one file.
+
+Three observability layers record time-stamped events against DIFFERENT
+buffers today: the span profiler (PR 1 — host spans + the PR-6 serving
+request lanes, ``perf_counter`` seconds), the HBM memory tracker (PR 7
+— timeline ring, also ``perf_counter``), and the XPlane device trace
+(jax's own, producer-clock nanoseconds). Debugging a serving stall or a
+step-time regression means eyeballing all three — which is exactly the
+correlation job a trace viewer does, IF the events share a clock and a
+file. This module merges them:
+
+* **host spans** — re-emitted as-is (they already share the
+  ``perf_counter`` axis), thread/lane labels included, under the main
+  process;
+* **memory timeline** — ``ph:"C"`` counter events (``bytes_in_use``,
+  ``ledger_bytes``) that the viewer draws as a stacked area under the
+  trace, plus ``ph:"i"`` instant marks for the labeled watermarks
+  (``kv/alloc``, ``serving/cycle``, fit flushes);
+* **device ops** — decoded from the newest ``*.xplane.pb``
+  (:func:`..xplane.device_events`, the version-tolerant parser) on a
+  separate "device" pid. Their clock is the producer's: alignment pins
+  the FIRST device event to the host ``perf_counter`` stamp taken when
+  ``start_trace`` returned (``Profiler._trace_anchor_us``), falling
+  back to the earliest host span. That is an alignment HEURISTIC — good
+  to roughly the trace-start latency (sub-ms in practice), and the
+  honest best available without a cross-clock sync protocol; the
+  ``clock`` arg of every device event records the applied shift so a
+  skeptical reader can un-shift.
+
+Open the result in Perfetto / chrome://tracing: request lanes above,
+scheduler + op spans below, device ops beneath them, HBM level along
+the bottom — the whole story of a cycle in one scroll.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+__all__ = ["export_unified_trace", "unified_trace_doc"]
+
+
+def unified_trace_doc(trace_dir: Optional[str] = None,
+                      include_memory: bool = True,
+                      anchor_us: Optional[float] = None,
+                      window_us: Optional[tuple] = None) -> Dict[str, Any]:
+    """Build the merged chrome-trace document (see module docstring).
+    ``trace_dir`` adds the XPlane device lane when it holds a trace;
+    ``anchor_us`` is the host ``perf_counter``-microseconds stamp of
+    ``start_trace`` (device-lane alignment). ``window_us`` (t0, t1)
+    clips the MEMORY lane to a profiling session's window — the memory
+    timeline ring is process-global and outlives any one session, so
+    without the clip a long-lived process drags hours-old HBM samples
+    into every trace. Host spans are already session-scoped (the span
+    recorder is cleared per session) and are never clipped."""
+    from . import span as _span
+
+    pid = os.getpid()
+    trace: List[dict] = [{"name": "process_name", "ph": "M", "pid": pid,
+                          "args": {"name": "paddle_tpu host"}}]
+    for tid, tname in sorted(_span.thread_names().items()):
+        trace.append({"name": "thread_name", "ph": "M", "pid": pid,
+                      "tid": tid, "args": {"name": tname}})
+    span_events = _span.events()
+    first_host_us = min((ev["ts"] for ev in span_events), default=None)
+    for ev in span_events:
+        trace.append({
+            "name": ev["name"], "cat": ev["cat"], "ph": "X", "pid": pid,
+            "tid": ev["tid"], "ts": ev["ts"], "dur": ev["dur"],
+            "args": {"depth": ev["depth"], "parent": ev["parent"],
+                     **(ev["args"] or {})},
+        })
+
+    if include_memory:
+        from . import memory as _memory
+        mem_pid = pid + 1
+        trace.append({"name": "process_name", "ph": "M", "pid": mem_pid,
+                      "args": {"name": "paddle_tpu memory"}})
+        # 0.25 s slack: sampler ticks straddling the window edges stay
+        w0, w1 = (window_us if window_us else (None, None))
+        slack = 0.25e6
+        for entry in _memory.timeline():
+            ts = entry["t"] * 1e6            # perf_counter s -> us
+            if w0 is not None and not (w0 - slack <= ts <= w1 + slack):
+                continue
+            counters = {k: entry[k] for k in
+                        ("bytes_in_use", "ledger_bytes") if k in entry}
+            if counters:
+                trace.append({"name": "hbm", "ph": "C", "pid": mem_pid,
+                              "ts": ts, "args": counters})
+            label = entry.get("label")
+            if label and label != "sampler":
+                trace.append({"name": label, "cat": "memory", "ph": "i",
+                              "pid": mem_pid, "tid": 0, "ts": ts,
+                              "s": "p"})
+
+    if trace_dir:
+        from .xplane import device_events
+        devs = device_events(trace_dir)
+        if devs:
+            dev_pid = pid + 2
+            trace.append({"name": "process_name", "ph": "M",
+                          "pid": dev_pid,
+                          "args": {"name": "paddle_tpu device (XPlane)"}})
+            first_dev_us = min(d["t_us"] for d in devs)
+            anchor = anchor_us if anchor_us is not None else first_host_us
+            shift_us = (anchor - first_dev_us) if anchor is not None \
+                else 0.0
+            lanes: Dict[str, int] = {}
+            for d in devs:
+                key = f"{d['plane']}:{d['line']}"
+                if key not in lanes:
+                    lanes[key] = len(lanes)
+                    trace.append({"name": "thread_name", "ph": "M",
+                                  "pid": dev_pid, "tid": lanes[key],
+                                  "args": {"name": d["line"] or
+                                           d["plane"]}})
+                lane = lanes[key]
+                trace.append({
+                    "name": d["name"], "cat": "device", "ph": "X",
+                    "pid": dev_pid, "tid": lane,
+                    "ts": d["t_us"] + shift_us, "dur": d["dur_us"],
+                    "args": {"clock": "xplane",
+                             "shift_us": round(shift_us, 3)},
+                })
+    return {"traceEvents": trace, "displayTimeUnit": "ms"}
+
+
+def export_unified_trace(path: str, trace_dir: Optional[str] = None,
+                         include_memory: bool = True,
+                         anchor_us: Optional[float] = None,
+                         window_us: Optional[tuple] = None) -> str:
+    """Write :func:`unified_trace_doc` to ``path``; returns the path."""
+    doc = unified_trace_doc(trace_dir=trace_dir,
+                            include_memory=include_memory,
+                            anchor_us=anchor_us,
+                            window_us=window_us)
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return path

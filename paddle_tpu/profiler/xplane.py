@@ -18,8 +18,8 @@ import glob
 import os
 from typing import Dict, Iterator, List, Optional, Tuple
 
-__all__ = ["parse_xspace", "device_op_table", "latest_xplane_file",
-           "summary_table"]
+__all__ = ["parse_xspace", "device_op_table", "device_events",
+           "latest_xplane_file", "summary_table"]
 
 
 # ---------------------------------------------------------------------------
@@ -62,27 +62,35 @@ def _fields(buf: bytes) -> Iterator[Tuple[int, int, object]]:
         yield field, wire, val
 
 
-def _parse_event(buf: bytes) -> Tuple[int, int]:
-    """XEvent -> (metadata_id, duration_ps)."""
-    meta, dur = 0, 0
+def _parse_event(buf: bytes) -> Tuple[int, int, int]:
+    """XEvent -> (metadata_id, offset_ps, duration_ps)."""
+    meta, off, dur = 0, 0, 0
     for field, _, val in _fields(buf):
         if field == 1:
             meta = val
+        elif field == 2:
+            off = val
         elif field == 3:
             dur = val
-    return meta, dur
+    return meta, off, dur
 
 
-def _parse_line(buf: bytes) -> Tuple[str, List[Tuple[int, int]]]:
-    """XLine -> (name, [(metadata_id, duration_ps)])."""
+def _parse_line(buf: bytes) -> Tuple[str, int, List[Tuple[int, int, int]]]:
+    """XLine -> (name, timestamp_ns, [(metadata_id, offset_ps,
+    duration_ps)]). ``timestamp_ns`` is the line's epoch on the
+    producer's clock; event offsets are relative to it — the unified
+    timeline merger needs both to place device ops on the host axis."""
     name = ""
+    ts_ns = 0
     events = []
     for field, _, val in _fields(buf):
         if field == 2:
             name = val.decode("utf-8", "replace")
+        elif field == 3:
+            ts_ns = val
         elif field == 4:
             events.append(_parse_event(val))
-    return name, events
+    return name, ts_ns, events
 
 
 def _parse_event_metadata(buf: bytes) -> Tuple[int, str]:
@@ -116,10 +124,26 @@ def _parse_plane(buf: bytes) -> dict:
 
 
 def parse_xspace(data: bytes) -> List[dict]:
-    """XSpace bytes -> [{name, lines: [(line_name, [(meta_id, dur_ps)])],
-    event_metadata: {id: name}}]."""
+    """XSpace bytes -> [{name, lines: [(line_name, timestamp_ns,
+    [(meta_id, offset_ps, dur_ps)])], event_metadata: {id: name}}]."""
     return [_parse_plane(val) for field, _, val in _fields(data)
             if field == 1]
+
+
+def _is_device_line(plane_name: str, line_name: str) -> bool:
+    """Version-tolerant "is this a device/executable timeline" test.
+
+    On TPU the device ops live in ``/device:TPU:*`` planes. On the CPU
+    backend they live in the host plane, in the XLA client's line —
+    whose NAME drifts with the jax/xla version: ``XLAPjRt...`` on
+    older stacks, ``tf_XLATfrtCpuClient/<id>`` on the 0.4.37 image
+    (the drift that emptied ``device_op_table`` here). Match the
+    stable substring — an XLA-client marker — rather than any one
+    release's spelling."""
+    if ("/device:" in plane_name or "TPU" in plane_name
+            or "GPU" in plane_name):
+        return True
+    return "XLA" in line_name
 
 
 # ---------------------------------------------------------------------------
@@ -145,17 +169,11 @@ def device_op_table(trace_dir: str, device_only: bool = True
     agg: Dict[Tuple[str, str], List[float]] = {}
     for plane in planes:
         pname = plane["name"]
-        plane_is_device = ("/device:" in pname or "TPU" in pname
-                           or "GPU" in pname)
         meta = plane["event_metadata"]
-        for line_name, events in plane["lines"]:
-            # on TPU the device ops live in /device:TPU:* planes; on the
-            # CPU backend they live in the host plane's XLAPjRt client
-            # line — treat both as "device" timelines
-            if device_only and not (plane_is_device
-                                    or "XLAPjRt" in line_name):
+        for line_name, _ts_ns, events in plane["lines"]:
+            if device_only and not _is_device_line(pname, line_name):
                 continue
-            for mid, dur_ps in events:
+            for mid, _off_ps, dur_ps in events:
                 key = (meta.get(mid, f"#{mid}"), pname)
                 cell = agg.setdefault(key, [0.0, 0])
                 cell[0] += dur_ps / 1e6  # ps -> us
@@ -164,6 +182,38 @@ def device_op_table(trace_dir: str, device_only: bool = True
              "total_us": tot, "avg_us": tot / cnt}
             for (name, plane), (tot, cnt) in agg.items()]
     rows.sort(key=lambda r: -r["total_us"])
+    return rows
+
+
+def device_events(trace_dir: str, device_only: bool = True) -> List[dict]:
+    """Individual timed device events from the newest xplane.pb:
+    ``{name, plane, line, t_us, dur_us}`` with ``t_us`` on the
+    PRODUCER's clock (``line.timestamp_ns + event.offset_ps``) — the
+    unified-timeline merger (:mod:`.timeline`) shifts them onto the
+    host ``perf_counter`` axis. Zero-duration bookkeeping events are
+    dropped."""
+    path = latest_xplane_file(trace_dir)
+    if path is None:
+        return []
+    with open(path, "rb") as f:
+        planes = parse_xspace(f.read())
+    rows = []
+    for plane in planes:
+        pname = plane["name"]
+        meta = plane["event_metadata"]
+        for line_name, ts_ns, events in plane["lines"]:
+            if device_only and not _is_device_line(pname, line_name):
+                continue
+            for mid, off_ps, dur_ps in events:
+                if dur_ps <= 0:
+                    continue
+                rows.append({
+                    "name": meta.get(mid, f"#{mid}"),
+                    "plane": pname, "line": line_name,
+                    "t_us": ts_ns / 1e3 + off_ps / 1e6,
+                    "dur_us": dur_ps / 1e6,
+                })
+    rows.sort(key=lambda r: r["t_us"])
     return rows
 
 

@@ -61,6 +61,7 @@ monitor/profiler/analysis wiring).
 from __future__ import annotations
 
 from .engine import GenerationEngine  # noqa: F401
+from .fleet import EngineFleet  # noqa: F401
 from .flight_recorder import FlightRecorder  # noqa: F401
 from .kv_pool import KVCachePool  # noqa: F401
 from .paging import (BlockError, PagedKVPool,  # noqa: F401
@@ -69,8 +70,8 @@ from .scheduler import (DeadlineExceeded, GenerationRequest,  # noqa: F401
                         QueueFullError, RequestCancelled, Scheduler)
 from .tracing import RequestTrace  # noqa: F401
 
-__all__ = ["GenerationEngine", "KVCachePool", "PagedKVPool",
-           "GenerationRequest", "Scheduler", "QueueFullError",
-           "DeadlineExceeded", "RequestCancelled", "PoolCapacityError",
-           "PoolExhaustedError", "BlockError", "RequestTrace",
-           "FlightRecorder"]
+__all__ = ["GenerationEngine", "EngineFleet", "KVCachePool",
+           "PagedKVPool", "GenerationRequest", "Scheduler",
+           "QueueFullError", "DeadlineExceeded", "RequestCancelled",
+           "PoolCapacityError", "PoolExhaustedError", "BlockError",
+           "RequestTrace", "FlightRecorder"]

@@ -54,10 +54,12 @@ prefix.
 from __future__ import annotations
 
 import threading
+import weakref
 from typing import Iterator, Optional
 
 import numpy as np
 
+from ..framework import metrics as _metrics
 from ..framework import program_registry as _registry
 from ..framework import trace_probe as _probe
 from ..framework.monitor import stat_add
@@ -77,6 +79,105 @@ def _next_engine_id() -> int:
     with _engine_seq_lock:
         _engine_seq += 1
         return _engine_seq
+
+
+# live engines for the statusz console (weak: a GC'd or closed engine
+# drops out of the section on its own); the section is registered with
+# the metrics registry once, at the first engine construction, so a
+# process that never serves never shows an empty serving section twice
+_LIVE_ENGINES: "weakref.WeakSet" = weakref.WeakSet()
+_statusz_registered = False
+_statusz_lock = threading.Lock()
+
+
+def _engine_section() -> str:
+    """statusz section: one line of load + cache + health per live
+    engine, plus recent flight-recorder trouble (failed cycles, the
+    last auto-dump path) — the serving half of the ops console."""
+    engines = [e for e in list(_LIVE_ENGINES) if not e._closed]
+    if not engines:
+        return "(no live engines)"
+    lines = []
+    for e in sorted(engines, key=lambda e: e._eid):
+        try:
+            s = e.stats()
+            head = (f"engine #{e._eid} [{s['kv_layout']}/"
+                    f"{s['attention']}] queue={s['queue_depth']} "
+                    f"active={s['active_requests']} "
+                    f"slots={s['slots_in_use']}/{s['num_slots']}")
+            if "kv_blocks_in_use" in s:
+                head += (f" blocks={s['kv_blocks_in_use']}/"
+                         f"{s['num_blocks']}")
+            if "prefix_hit_ratio" in s:
+                head += f" prefix_hit={s['prefix_hit_ratio']:.2f}"
+            if "spec_accept_rate" in s:
+                head += f" spec_accept={s['spec_accept_rate']:.2f}"
+            if s.get("serving_mfu") is not None:
+                head += f" mfu={s['serving_mfu']:.3f}"
+            if s.get("decode_tokens_per_sec") is not None:
+                head += f" tok/s={s['decode_tokens_per_sec']:.1f}"
+            lines.append(head)
+            ttft = s.get("ttft_ms")
+            if ttft:
+                lines.append(f"  ttft p50 {ttft['p50']:.1f} ms  "
+                             f"p95 {ttft['p95']:.1f} ms  "
+                             f"(n={ttft['count']})")
+            if s.get("nonfinite_cycles"):
+                lines.append(f"  !! nonfinite decode cycles: "
+                             f"{s['nonfinite_cycles']}")
+            rec = e.flight_recorder
+            failed = [c for c in rec.snapshot()["cycles"]
+                      if c.get("failed")]
+            if failed:
+                lines.append(f"  !! {len(failed)} failed cycles in the "
+                             f"ring; last: {failed[-1].get('failed')}")
+            if rec.last_dump_path:
+                lines.append(f"  last auto-dump: {rec.last_dump_path}")
+        except Exception as err:                         # noqa: BLE001
+            lines.append(f"engine #{e._eid}: (stats error: {err!r})")
+    return "\n".join(lines)
+
+
+def _register_engine_telemetry(engine: "GenerationEngine") -> None:
+    global _statusz_registered
+    with _statusz_lock:
+        if not _statusz_registered:
+            _metrics.register_statusz_section("serving engines",
+                                              _engine_section)
+            _statusz_registered = True
+    _LIVE_ENGINES.add(engine)
+    # per-engine scrape-time collector: the stats() island re-published
+    # as labeled registry metrics ({engine=<id>}), pulled only when a
+    # snapshot/export/sampler asks — zero cost on the serving hot path
+    ref = weakref.ref(engine)
+
+    def _collect():
+        e = ref()
+        if e is None or e._closed:
+            return ()
+        s = e.stats()
+        labels = {"engine": str(e._eid)}
+        out = [("gauge", "serving_queue_depth", labels,
+                s["queue_depth"]),
+               ("gauge", "serving_slots_in_use", labels,
+                s["slots_in_use"]),
+               ("gauge", "serving_kv_bytes_in_use", labels,
+                s["kv_bytes_in_use"]),
+               ("counter", "serving_requests_retired", labels,
+                s["requests_retired"]),
+               ("counter", "serving_preempts", labels, s["preempts"]),
+               ("counter", "serving_nonfinite_cycles", labels,
+                s["nonfinite_cycles"])]
+        if "kv_blocks_in_use" in s:
+            out.append(("gauge", "serving_kv_blocks_in_use", labels,
+                        s["kv_blocks_in_use"]))
+            out.append(("gauge", "serving_prefix_hit_ratio", labels,
+                        s["prefix_hit_ratio"]))
+        if s.get("decode_tokens_per_sec") is not None:
+            out.append(("gauge", "serving_decode_tokens_per_sec",
+                        labels, s["decode_tokens_per_sec"]))
+        return out
+    _metrics.register_collector(f"serving_engine/{engine._eid}", _collect)
 
 
 class GenerationEngine:
@@ -248,6 +349,10 @@ class GenerationEngine:
             do_chunked_step=self._run_fused_step if self._fused else None,
             do_spec_step=self._run_spec_step if self._spec else None,
             spec_k=self._spec_k)
+        # telemetry spine wiring (ISSUE 13): the engine joins the
+        # statusz console and publishes its stats() island through the
+        # labeled metrics registry ({engine=<id>} gauges/counters)
+        _register_engine_telemetry(self)
 
     # -- client side -------------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens: int = 32, *,
@@ -357,6 +462,9 @@ class GenerationEngine:
         self._sched.close(cancel_pending=cancel_pending)
         # a closed engine's pool is no longer an accounted HBM owner
         self._pool.drop_ledger()
+        # ...nor a scraped metrics source or statusz row
+        _metrics.unregister_collector(f"serving_engine/{self._eid}")
+        _LIVE_ENGINES.discard(self)
 
     def __enter__(self):
         return self
@@ -366,6 +474,13 @@ class GenerationEngine:
         return False
 
     # -- introspection -----------------------------------------------------
+    @property
+    def flight_recorder(self):
+        """This engine's always-on :class:`~.flight_recorder.
+        FlightRecorder` — the per-engine latency reservoirs and cycle
+        ring the fleet aggregator pools."""
+        return self._sched.recorder
+
     @property
     def num_slots(self) -> int:
         return self._pool.num_slots

@@ -92,6 +92,16 @@ class FlightRecorder:
                 self._tpot.append(tpot)
 
     # -- readers -----------------------------------------------------------
+    def latency_samples(self) -> Dict[str, List[float]]:
+        """Raw copies of the TTFT/TPOT reservoirs (bounded, newest
+        window). The fleet aggregator pools THESE into mergeable
+        histograms — fleet percentiles must come from pooled samples or
+        summed bucket counts, never from averaging per-replica
+        percentiles."""
+        with self._lock:
+            return {"ttft_ms": list(self._ttft),
+                    "tpot_ms": list(self._tpot)}
+
     def latency_summary(self) -> Dict[str, Optional[dict]]:
         """Per-engine ``{"ttft_ms": {...}, "tpot_ms": {...}}`` with
         count/p50/p95/p99 over the retired-trace reservoirs (None while
@@ -177,11 +187,20 @@ class FlightRecorder:
     def auto_dump(self, reason: str) -> Optional[str]:
         """Failure-path dump: best effort, NEVER raises (it runs inside
         the scheduler's exception handler — a broken disk must not turn
-        a poisoned step into a dead loop). Returns the file path."""
+        a poisoned step into a dead loop). Returns the file path.
+
+        The filename carries a monotonic per-recorder sequence number:
+        two poisoned cycles in quick succession are exactly the case a
+        postmortem exists for, and without the suffix the second dump
+        OVERWRITES the first — the origin cycle's evidence — at the
+        pid+recorder path."""
         try:
+            with self._lock:
+                seq = self.dumps
             path = os.path.join(
                 tempfile.gettempdir(),
-                f"paddle_serving_flight_{os.getpid()}_{id(self):x}.json")
+                f"paddle_serving_flight_{os.getpid()}_{id(self):x}"
+                f"_{seq:04d}.json")
             self.dump(path, extra={"reason": reason,
                                    "dumped_at": time.time()})
             with self._lock:

@@ -1,0 +1,467 @@
+"""The ISSUE-13 telemetry spine: labeled MetricsRegistry (counters /
+gauges / mergeable histograms), Prometheus round-trip, the monitor
+bridge, collectors, the statusz ops console, collective device timing
+and the communication report, and the monitor prefix-filter contract.
+"""
+import math
+import threading
+
+import numpy as np
+import pytest
+
+from paddle_tpu.framework import metrics as M
+from paddle_tpu.framework import monitor
+from paddle_tpu.framework.metrics import HistValue, MetricsRegistry
+
+
+def _reg(**kw):
+    kw.setdefault("include_monitor", False)
+    return MetricsRegistry(**kw)
+
+
+# ---------------------------------------------------------------------------
+# mergeable histograms: the math the fleet stands on
+# ---------------------------------------------------------------------------
+
+class TestHistValue:
+    def _raw_percentile(self, vals, q):
+        s = sorted(vals)
+        return s[min(len(s) - 1, max(0, math.ceil(q * len(s)) - 1))]
+
+    def _bin_bounds(self, h, value):
+        """The bucket [lo, hi] a value falls in — the tolerance unit."""
+        lo = 0.0
+        for le in h.buckets:
+            if value <= le:
+                return lo, le
+            lo = le
+        return lo, math.inf
+
+    def test_merge_percentiles_match_pooled_raw_within_bin(self):
+        rng = np.random.RandomState(7)
+        # two deliberately DIFFERENT distributions (a fast and a slow
+        # replica) — the case where averaging per-replica percentiles
+        # goes wrong and bucket merging stays right
+        a = rng.lognormal(2.0, 0.6, 400).tolist()
+        b = rng.lognormal(3.5, 0.4, 100).tolist()
+        ha, hb = HistValue.from_samples(a), HistValue.from_samples(b)
+        merged = ha.merge(hb)
+        pooled = a + b
+        assert merged.count == 500
+        assert merged.total == pytest.approx(sum(pooled))
+        for q in (0.5, 0.95, 0.99):
+            est = merged.percentile(q)
+            raw = self._raw_percentile(pooled, q)
+            lo, hi = self._bin_bounds(merged, est)
+            assert lo <= raw <= hi or abs(est - raw) <= (hi - lo), \
+                f"q={q}: est {est} (bin [{lo},{hi}]) vs raw {raw}"
+
+    def test_merge_requires_same_buckets(self):
+        with pytest.raises(ValueError):
+            HistValue((1.0, 2.0)).merge(HistValue((1.0, 3.0)))
+
+    def test_bucket_pairs_cumulative_to_inf(self):
+        h = HistValue.from_samples([0.5, 1.5, 2.5, 1e12])
+        pairs = h.bucket_pairs()
+        assert pairs[-1][0] == math.inf and pairs[-1][1] == 4
+        counts = [c for _, c in pairs]
+        assert counts == sorted(counts)          # cumulative, monotone
+
+    def test_empty_summary(self):
+        s = HistValue().summary()
+        assert s["count"] == 0 and s["p50"] is None
+
+
+# ---------------------------------------------------------------------------
+# the registry
+# ---------------------------------------------------------------------------
+
+class TestRegistry:
+    def test_labeled_counters_and_gauges(self):
+        r = _reg()
+        r.inc("serving_requests_total", 3, engine="0")
+        r.inc("serving_requests_total", 2, engine="0")
+        r.inc("serving_requests_total", 7, engine="1")
+        r.set_gauge("serving_queue_depth", 4, engine="0")
+        assert r.get_value("serving_requests_total", engine="0") == 5
+        assert r.get_value("serving_requests_total", engine="1") == 7
+        assert r.get_value("serving_queue_depth", engine="0") == 4
+        assert r.get_value("serving_queue_depth", engine="9") is None
+
+    def test_naming_contract_enforced(self):
+        r = _reg()
+        for bad in ("CamelCase", "has-dash", "has space", "9leading",
+                    "slash/path"):
+            with pytest.raises(ValueError):
+                r.inc(bad)
+        with pytest.raises(ValueError):
+            r.set_gauge("ok_name", 1.0, **{"bad-label": "x"})
+
+    def test_type_conflict_raises(self):
+        r = _reg()
+        r.inc("a_metric")
+        with pytest.raises(ValueError):
+            r.set_gauge("a_metric", 1.0)
+        with pytest.raises(ValueError):
+            r.observe("a_metric", 1.0)
+
+    def test_histogram_summary_and_fleet_merge(self):
+        r = _reg()
+        for v in (1.0, 2.0, 3.0):
+            r.observe("ttft_ms", v, engine="0")
+        for v in (100.0, 200.0):
+            r.observe("ttft_ms", v, engine="1")
+        s0 = r.histogram_summary("ttft_ms", engine="0")
+        assert s0["count"] == 3
+        merged = r.merged_histogram("ttft_ms")
+        assert merged.count == 5
+        assert merged.total == pytest.approx(306.0)
+
+    def test_series_cap_drops_not_grows(self):
+        r = _reg(max_series=4)
+        for i in range(10):
+            r.inc("bounded_total", 1, key=str(i))
+        snap = r.snapshot()
+        assert len(snap["counters"]["bounded_total"]) <= 4
+        assert snap["series_dropped"] >= 6
+
+    def test_sampler_ring_bounded(self):
+        r = _reg(ring=8)
+        r.set_gauge("g_value", 1.0)
+        for i in range(20):
+            r.sample_now()
+        ts = r.timeseries()
+        assert len(ts) == 8
+        assert all("g_value" in e["values"] for e in ts)
+
+    def test_background_sampler_start_stop(self):
+        r = _reg(ring=64)
+        r.set_gauge("g_value", 2.0)
+        r.start_sampler(interval=0.01)
+        import time
+        time.sleep(0.15)
+        r.stop_sampler()
+        assert len(r.timeseries()) >= 2
+
+
+# ---------------------------------------------------------------------------
+# Prometheus export round-trip (the acceptance criterion)
+# ---------------------------------------------------------------------------
+
+class TestPrometheusRoundTrip:
+    def test_export_parses_back_to_registry_state(self):
+        r = _reg()
+        r.inc("requests_total", 5, engine="0", kind="decode")
+        r.inc("requests_total", 9, engine="1", kind="decode")
+        r.set_gauge("queue_depth", 3, engine="0")
+        rng = np.random.RandomState(0)
+        vals = rng.lognormal(2, 1, 200)
+        for v in vals:
+            r.observe("ttft_ms", float(v), engine="0")
+        text = r.to_prometheus()
+        parsed = M.parse_prometheus(text)
+        # types declared
+        assert parsed["types"]["requests_total"] == "counter"
+        assert parsed["types"]["queue_depth"] == "gauge"
+        assert parsed["types"]["ttft_ms"] == "histogram"
+        sam = parsed["samples"]
+        assert sam[("requests_total",
+                    (("engine", "0"), ("kind", "decode")))] == 5
+        assert sam[("requests_total",
+                    (("engine", "1"), ("kind", "decode")))] == 9
+        assert sam[("queue_depth", (("engine", "0"),))] == 3
+        # histogram: _count/_sum and every cumulative bucket round-trip
+        assert sam[("ttft_ms_count", (("engine", "0"),))] == 200
+        assert sam[("ttft_ms_sum", (("engine", "0"),))] == \
+            pytest.approx(float(vals.sum()), rel=1e-9)
+        h = r.histogram("ttft_ms", engine="0")
+        for le, c in h.bucket_pairs():
+            le_s = "+Inf" if math.isinf(le) else (
+                str(int(le)) if float(le).is_integer() else repr(le))
+            key = ("ttft_ms_bucket", (("engine", "0"), ("le", le_s)))
+            # the exporter's %g-style float formatting must agree with
+            # the parser: look the label up by value instead
+            match = [v for (n, labels), v in sam.items()
+                     if n == "ttft_ms_bucket"
+                     and ("engine", "0") in labels
+                     and any(k == "le" and
+                             (float(val) == le if val != "+Inf"
+                              else math.isinf(le))
+                             for k, val in labels)]
+            assert c in match
+        # +Inf bucket == count
+        inf_vals = [v for (n, labels), v in sam.items()
+                    if n == "ttft_ms_bucket"
+                    and ("le", "+Inf") in labels]
+        assert inf_vals == [200]
+
+    def test_label_escaping_round_trips(self):
+        r = _reg()
+        r.set_gauge("g_value", 1.5, path='a"b\\c', note="two\nlines")
+        parsed = M.parse_prometheus(r.to_prometheus())
+        keys = [labels for (n, labels) in parsed["samples"]
+                if n == "g_value"]
+        assert keys and dict(keys[0])["path"] == 'a"b\\c'
+        assert dict(keys[0])["note"] == "two\nlines"
+
+    def test_collector_samples_in_export(self):
+        r = _reg()
+        r.register_collector("island", lambda: [
+            ("gauge", "island_gauge", {"engine": "7"}, 42.0),
+            ("counter", "island_total", {}, 3.0)])
+        parsed = M.parse_prometheus(r.to_prometheus())
+        assert parsed["samples"][("island_gauge",
+                                  (("engine", "7"),))] == 42.0
+        assert parsed["samples"][("island_total", ())] == 3.0
+        # a broken collector is skipped, never kills the scrape
+        r.register_collector("broken", lambda: 1 / 0)
+        assert "island_gauge" in r.to_prometheus()
+        r.unregister_collector("island")
+        assert "island_gauge" not in r.to_prometheus()
+
+
+# ---------------------------------------------------------------------------
+# monitor bridge
+# ---------------------------------------------------------------------------
+
+class TestMonitorBridge:
+    def test_name_mapping(self):
+        # per-key families keep the family, tail becomes the key label
+        assert M.monitor_metric_name("op_time_ms/add") == \
+            ("op_time_ms", {"key": "add"})
+        assert M.monitor_metric_name("collective_bytes/reduce_scatter") \
+            == ("collective_bytes", {"key": "reduce_scatter"})
+        assert M.monitor_metric_name("compile/ms/serving/decode#1") == \
+            ("compile_ms", {"key": "serving/decode#1"})
+        # path names flatten to snake_case
+        assert M.monitor_metric_name("serving/ttft_ms") == \
+            ("serving_ttft_ms", {})
+        assert M.monitor_metric_name("hapi/host_sync") == \
+            ("hapi_host_sync", {})
+
+    def test_bridge_in_export(self):
+        monitor.stat_reset()
+        monitor.stat_add("collective_bytes/all_gather", 4096)
+        monitor.stat_observe("serving/ttft_ms", 12.5)
+        r = MetricsRegistry(include_monitor=True)
+        text = r.to_prometheus()
+        parsed = M.parse_prometheus(text)
+        assert parsed["samples"][("collective_bytes",
+                                  (("key", "all_gather"),))] == 4096
+        assert parsed["types"]["serving_ttft_ms"] == "summary"
+        assert parsed["samples"][("serving_ttft_ms_count", ())] == 1
+        monitor.stat_reset()
+
+    def test_bridge_name_collision_emits_one_family(self):
+        """A live engine's collector gauge (serving_queue_depth{engine=})
+        and the scheduler's stat_observe("serving/queue_depth") map to
+        the SAME family name with different types. The exposition must
+        carry the family exactly once (native/collected wins) — a
+        duplicate family is invalid and a real scrape rejects the whole
+        document."""
+        monitor.stat_reset()
+        monitor.stat_observe("serving/queue_depth", 3.0)
+        r = MetricsRegistry(include_monitor=True)
+        r.register_collector(
+            "eng", lambda: [("gauge", "serving_queue_depth",
+                             {"engine": "1"}, 2.0)])
+        text = r.to_prometheus()
+        type_lines = [ln for ln in text.splitlines()
+                      if ln.startswith("# TYPE serving_queue_depth ")]
+        assert type_lines == ["# TYPE serving_queue_depth gauge"]
+        parsed = M.parse_prometheus(text)
+        assert parsed["samples"][("serving_queue_depth",
+                                  (("engine", "1"),))] == 2.0
+        monitor.stat_reset()
+
+
+# ---------------------------------------------------------------------------
+# statusz
+# ---------------------------------------------------------------------------
+
+class TestStatusz:
+    def test_renders_with_no_engines(self):
+        txt = M.statusz()
+        assert "paddle_tpu statusz" in txt
+        assert "memory" in txt
+        assert "collectives" in txt
+        assert "training" in txt
+
+    def test_broken_section_renders_error_not_raise(self):
+        r = _reg()
+        r.register_statusz_section("fine", lambda: "all good")
+        r.register_statusz_section("broken", lambda: 1 / 0)
+        txt = r.statusz()
+        assert "all good" in txt
+        assert "section error" in txt and "ZeroDivisionError" in txt
+
+    def test_section_replaced_by_name(self):
+        r = _reg()
+        r.register_statusz_section("s", lambda: "v1")
+        r.register_statusz_section("s", lambda: "v2")
+        txt = r.statusz()
+        assert "v2" in txt and "v1" not in txt
+
+
+# ---------------------------------------------------------------------------
+# collective device timing + the communication report
+# ---------------------------------------------------------------------------
+
+class TestCollectiveTiming:
+    def test_eager_collective_timed_first_call(self):
+        from paddle_tpu.distributed import collective as coll
+        from paddle_tpu.framework.tensor import Tensor
+        monitor.stat_reset()
+        with coll._timing_lock:
+            coll._timing_counts.clear()
+        t = Tensor(np.ones((64,), np.float32))
+        coll.all_reduce(t)          # first call per kind: always sampled
+        h = monitor.stat_histogram("collective_time_ms/all_reduce")
+        assert h is not None and h["count"] == 1
+        # the stride keeps later calls unsampled until it comes around
+        for _ in range(5):
+            coll.all_reduce(t)
+        h = monitor.stat_histogram("collective_time_ms/all_reduce")
+        assert h["count"] == 1
+        monitor.stat_reset()
+
+    def test_zero_step_probe_populates_histograms(self):
+        import jax
+        if len(jax.devices()) < 2:
+            pytest.skip("needs >= 2 devices")
+        from paddle_tpu.distributed import env as denv
+        from paddle_tpu.hapi import zero as zmod
+        monitor.stat_reset()
+        mesh_before = denv.get_mesh()
+        denv.build_mesh({"dp": 2})
+        try:
+            params = {"w": np.zeros((300,), np.float32),
+                      "b": np.zeros((7,), np.float32)}
+            layout = zmod.FlatLayout.build(params, dp=2)
+            out = zmod.time_step_collectives(denv.get_mesh(), layout)
+            assert set(out) == {"reduce_scatter", "all_gather"}
+            for kind in ("reduce_scatter", "all_gather"):
+                h = monitor.stat_histogram(f"collective_time_ms/{kind}")
+                assert h is not None and h["count"] == 1
+                bw = monitor.stat_histogram(f"collective_bw_gbps/{kind}")
+                assert bw is not None
+            # int8 comm probes the all_to_all wire shape too
+            zmod.time_step_collectives(denv.get_mesh(), layout,
+                                       grad_comm="int8")
+            assert monitor.stat_histogram(
+                "collective_time_ms/all_to_all") is not None
+        finally:
+            denv.set_mesh(mesh_before)
+            monitor.stat_reset()
+
+    def test_communication_report_joins_time_bytes_and_step(self):
+        from paddle_tpu.distributed import collective as coll
+        monitor.stat_reset()
+        monitor.stat_add("collective_bytes/reduce_scatter", 1 << 20)
+        monitor.stat_add("collective_count/reduce_scatter", 4)
+        coll.observe_collective_time("reduce_scatter", 2.0, 1 << 20)
+        monitor.stat_observe("hapi/step_time_ms", 10.0)
+        rep = coll.communication_report()
+        row = rep["per_kind"]["reduce_scatter"]
+        assert row["bytes_total"] == 1 << 20
+        assert row["time_ms"]["p50"] == pytest.approx(2.0)
+        # bw: 1 MiB / 2 ms = 0.524 GB/s
+        assert row["achieved_gbps"] == pytest.approx(
+            (1 << 20) / (2.0 * 1e6), rel=1e-6)
+        assert rep["exposed_ms_per_step"] == pytest.approx(2.0)
+        assert rep["exposed_fraction"] == pytest.approx(0.2)
+        assert rep["overlap_headroom_pct"] == pytest.approx(20.0)
+        table = coll.communication_report_table()
+        assert "reduce_scatter" in table and "overlap headroom" in table
+        monitor.stat_reset()
+
+    def test_exposed_sums_only_the_noted_step_exchange(self):
+        """A one-shot broadcast (or the int8 probe's comparison
+        reduce_scatter) must not be billed as per-step exposed cost
+        once the ZeRO probe has noted the live exchange pair."""
+        from paddle_tpu.distributed import collective as coll
+        monitor.stat_reset()
+        coll.observe_collective_time("reduce_scatter", 2.0)
+        coll.observe_collective_time("all_gather", 3.0)
+        coll.observe_collective_time("broadcast", 50.0)   # init one-shot
+        coll.note_step_exchange(("reduce_scatter", "all_gather"))
+        try:
+            rep = coll.communication_report()
+            assert rep["exposed_ms_per_step"] == pytest.approx(5.0)
+            # nothing noted (eager-only world): every timed kind counts
+            coll.note_step_exchange(None)
+            rep = coll.communication_report()
+            assert rep["exposed_ms_per_step"] == pytest.approx(55.0)
+        finally:
+            coll.note_step_exchange(None)
+            monitor.stat_reset()
+
+    def test_timing_flag_disables_sampling(self):
+        from paddle_tpu.distributed import collective as coll
+        from paddle_tpu.framework.flags import set_flags
+        with coll._timing_lock:
+            coll._timing_counts.clear()
+        set_flags({"FLAGS_collective_timing": False})
+        try:
+            assert not coll.timing_sampled("whatever")
+        finally:
+            set_flags({"FLAGS_collective_timing": True})
+        assert coll.timing_sampled("whatever")
+
+
+# ---------------------------------------------------------------------------
+# monitor satellite: prefix filter + lock contract
+# ---------------------------------------------------------------------------
+
+class TestMonitorPrefixFilter:
+    def test_stats_summary_prefix_filters_counters_and_histograms(self):
+        monitor.stat_reset()
+        monitor.stat_add("aaa/counter", 1)
+        monitor.stat_add("bbb/counter", 1)
+        monitor.stat_observe("aaa/hist_ms", 1.0)
+        monitor.stat_observe("bbb/hist_ms", 1.0)
+        out = monitor.stats_summary(prefix="aaa/")
+        assert "aaa/counter" in out and "aaa/hist_ms" in out
+        # the prefix applies to BOTH families (the ISSUE-13 satellite
+        # contract): a bbb histogram leaking through a filtered summary
+        # is exactly the bug class this pins
+        assert "bbb/counter" not in out and "bbb/hist_ms" not in out
+        monitor.stat_reset()
+
+    def test_histogram_samples_accessor(self):
+        monitor.stat_reset()
+        for v in (1.0, 2.0, 3.0):
+            monitor.stat_observe("acc/hist_ms", v)
+        assert monitor.histogram_samples("acc/hist_ms") == [1.0, 2.0, 3.0]
+        assert monitor.histogram_samples("missing") == []
+        monitor.stat_reset()
+
+    def test_lock_contract_documented_once(self):
+        doc = monitor.__doc__
+        assert "THREADING CONTRACT" in doc
+
+
+# ---------------------------------------------------------------------------
+# registry thread safety (writers from several threads)
+# ---------------------------------------------------------------------------
+
+class TestThreading:
+    def test_concurrent_writers(self):
+        r = _reg()
+        errs = []
+
+        def work(i):
+            try:
+                for k in range(200):
+                    r.inc("hits_total", 1, worker=str(i))
+                    r.observe("lat_ms", float(k % 7), worker=str(i))
+            except Exception as e:                       # noqa: BLE001
+                errs.append(e)
+        ts = [threading.Thread(target=work, args=(i,)) for i in range(4)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        assert not errs
+        total = sum(s["value"] for s in
+                    r.snapshot()["counters"]["hits_total"])
+        assert total == 800
+        assert r.merged_histogram("lat_ms").count == 800

@@ -199,3 +199,37 @@ def test_asarray_rule():
         "    return cb\n")
     out = lint_source("t.py", src, "ops/foo_ops.py")
     assert [(f.rule, f.line) for f in out] == [("asarray-on-traced", 5)]
+
+
+def test_metric_naming_rule():
+    """ISSUE-13: literal metric names at monitor/registry write sites
+    are snake_case paths with units in the suffix — each violation
+    class fires, each idiom in use stays green."""
+    # seeded violations
+    for src in (
+        'stat_observe("serving/TTFT-Time", 1.0)\n',      # case + dash
+        'stat_add("cache size", 3)\n',                   # space
+        'stat_observe("op_decode_time", 3)\n',           # unitless time
+        'stat_observe("hapi/step_latency", 3)\n',
+        'stat_add("pool_gb", 3)\n',                      # scaled size
+        'metrics.inc("servingRequests")\n',              # camelCase
+        '_metrics.set_gauge("Queue_Depth", 1)\n',
+    ):
+        out = lint_source("t.py", src, "serving/engine.py")
+        assert [f.rule for f in out] == ["metric-naming"], (src, out)
+    # the repo's live idioms stay green
+    for src in (
+        'stat_observe("serving/ttft_ms", 1.0)\n',
+        'stat_observe(f"op_time_ms/{name}", t)\n',       # literal head
+        'stat_add(f"collective_bytes/{kind}", n)\n',
+        'stat_add("serving/tokens_per_sec", 3)\n',       # a rate, not secs
+        'stat_observe("memory/bytes_in_use", 3)\n',
+        'x.observe("Whatever Name", 1)\n',   # not a metrics alias
+        'stat_observe(name, t)\n',           # fully dynamic: out of scope
+    ):
+        out = [f for f in lint_source("t.py", src, "serving/engine.py")
+               if f.rule == "metric-naming"]
+        assert out == [], (src, out)
+    # suppression honored
+    sup = 'stat_observe("op_decode_time", 3)  # lint: ok\n'
+    assert lint_source("t.py", sup, "serving/engine.py") == []
