@@ -949,6 +949,117 @@ def bench_spec():
     return out
 
 
+def bench_mp():
+    """Single-device vs mp=2 tensor-parallel paged serving
+    (``--bench-mp``): the ISSUE-15 scale-out, measured.
+
+    The same greedy workload runs through the fused paged engine twice
+    — once single-device, once with ``GenerationEngine(mesh=)`` over a
+    2-way model-parallel mesh (head-sharded block pool, shard_map'd
+    ragged decode, one psum per step). Token parity between the two
+    engines is a HARD FAIL — a sharded path that changes greedy output
+    is a bug, not a number — and so is a per-device KV ledger that
+    isn't exactly 1/mp of the single-device bytes. Reports
+    decode-step wall-ms for both legs plus the per-device block bytes;
+    lands in the BENCH artifact so ``--history`` gates the shard
+    figures from round 1. Needs >= 2 devices — on CPU run under
+    XLA_FLAGS=--xla_force_host_platform_device_count=2."""
+    import numpy as np
+    import jax
+    from jax.sharding import Mesh
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining
+    from paddle_tpu.serving import GenerationEngine
+
+    pallas_state = _setup_pallas()
+    mp = 2
+    if len(jax.devices()) < mp:
+        raise RuntimeError(
+            f"bench_mp needs >= {mp} devices (have {len(jax.devices())});"
+            f" on CPU set XLA_FLAGS=--xla_force_host_platform_device_"
+            f"count={mp}")
+    if _smoke() or jax_backend_is_cpu():
+        cfg, slots, prompt, new, reqs = GPTConfig.tiny(), 4, 12, 16, 8
+    else:
+        cfg = GPTConfig.gpt2_small()
+        cfg.hidden_dropout_prob = 0.0
+        cfg.attention_dropout_prob = 0.0
+        slots, prompt, new, reqs = 8, 64, 64, 16
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, cfg.vocab_size, prompt).astype(np.int32)
+               for _ in range(reqs)]
+    max_len = prompt + new + 8
+
+    def run(mesh):
+        # fresh model per leg: sharding device_puts the params in place,
+        # and both legs must start from the same seeded weights
+        paddle.framework.random.seed(0)
+        model = GPTForPretraining(cfg)
+        model.eval()
+        eng = GenerationEngine(
+            model, num_slots=slots, max_len=max_len, kv_layout="paged",
+            block_size=16, attention="fused", mesh=mesh)
+        warm = [eng.submit(p, max_new_tokens=new) for p in prompts]
+        [h.result(timeout=600) for h in warm]
+        warm_snap = eng._sched.recorder.snapshot()
+        warm_last = warm_snap["cycles"][-1]["cycle"] \
+            if warm_snap["cycles"] else 0
+        t0 = time.perf_counter()
+        hs = [eng.submit(p, max_new_tokens=new) for p in prompts]
+        outs = [h.result(timeout=600) for h in hs]
+        wall = time.perf_counter() - t0
+        snap = eng._sched.recorder.snapshot()
+        decode_ms = [c["decode_dispatch_ms"] + c["fetch_ms"]
+                     for c in snap["cycles"]
+                     if c["cycle"] > warm_last
+                     and c.get("decode_dispatch_ms", 0) > 0]
+        stats = eng.stats()
+        eng.close()
+        return {
+            "outs": outs,
+            "decode_step_ms": (round(float(np.median(decode_ms)), 3)
+                               if decode_ms else None),
+            "tokens_per_sec": round(reqs * new / wall, 1),
+            "wall_ms": round(wall * 1e3, 1),
+            "kv_block_bytes_per_device": stats["kv_bytes"]["blocks"],
+        }
+
+    single = run(None)
+    mesh = Mesh(np.array(jax.devices()[:mp]).reshape(mp), ("mp",))
+    sharded = run(mesh)
+    parity = all(np.array_equal(a, b) for a, b in
+                 zip(single.pop("outs"), sharded.pop("outs")))
+    if not parity:
+        raise RuntimeError(
+            "tensor-parallel bench invalid: greedy sharded output "
+            "diverged from the single-device engine")
+    if sharded["kv_block_bytes_per_device"] * mp \
+            != single["kv_block_bytes_per_device"]:
+        raise RuntimeError(
+            f"tensor-parallel bench invalid: per-device KV block bytes "
+            f"{sharded['kv_block_bytes_per_device']} * mp={mp} != "
+            f"single-device {single['kv_block_bytes_per_device']}")
+
+    out = {"metric": "mp_decode_step_ms",
+           "value": sharded["decode_step_ms"], "unit": "ms",
+           "mp": mp, "mp_parity": parity,
+           "single": single, "sharded": sharded,
+           "kv_bytes_per_device_ratio": round(
+               sharded["kv_block_bytes_per_device"]
+               / single["kv_block_bytes_per_device"], 3),
+           "batch_requests": reqs, "prompt_len": prompt,
+           "new_tokens": new, "device_kind": _device_kind(),
+           **pallas_state}
+    if single["decode_step_ms"] and sharded["decode_step_ms"]:
+        # wall multiplier per decode step: on a host-platform CPU mesh
+        # the psum costs more than the halved heads save, so this is a
+        # plumbing figure, not a speedup claim — the speedup story
+        # needs real interconnect
+        out["mp_step_cost_ratio"] = round(
+            sharded["decode_step_ms"] / single["decode_step_ms"], 3)
+    return out
+
+
 def jax_backend_is_cpu():
     import jax
     return jax.default_backend() == "cpu"
@@ -981,7 +1092,8 @@ BENCHES = {"gpt2": bench_gpt2, "resnet50": bench_resnet50,
            "resnet50_pipeline": bench_resnet50_pipeline,
            "eager": bench_eager, "serve": bench_serve,
            "gpt2_decode": bench_gpt2_decode, "attn": bench_attn,
-           "zero": bench_zero, "spec": bench_spec, "probe": bench_probe}
+           "zero": bench_zero, "spec": bench_spec, "mp": bench_mp,
+           "probe": bench_probe}
 
 
 # ---------------------------------------------------------------------------
@@ -1458,14 +1570,16 @@ def _run_child(name: str, timeout: float, force_cpu: bool = False,
         env["PADDLE_BENCH_SMOKE"] = "1"
     if no_pallas:
         env["PADDLE_BENCH_NO_PALLAS"] = "1"
-    if name == "zero":
-        # the ZeRO microbench needs a dp=4 mesh; on CPU that means
-        # forcing host platform devices BEFORE jax initializes (no-op
-        # for real multi-chip backends, which ignore the CPU knob)
+    if name in ("zero", "mp"):
+        # the ZeRO microbench needs a dp=4 mesh and the tensor-parallel
+        # serving microbench an mp=2 one; on CPU that means forcing
+        # host platform devices BEFORE jax initializes (no-op for real
+        # multi-chip backends, which ignore the CPU knob)
         flags = env.get("XLA_FLAGS", "")
         if "xla_force_host_platform_device_count" not in flags:
+            n = 4 if name == "zero" else 2
             env["XLA_FLAGS"] = (
-                flags + " --xla_force_host_platform_device_count=4"
+                flags + f" --xla_force_host_platform_device_count={n}"
             ).strip()
     try:
         proc = subprocess.run(
@@ -1690,6 +1804,13 @@ def main():
         extra = _run_child("spec", timeout=child_timeout())
         if "error" not in extra:
             results["spec"] = extra
+            _emit(results)
+    if remaining() > 90:
+        # single-vs-mp=2 tensor-parallel paged serving (ISSUE 15; token
+        # parity and the 1/mp per-device KV ledger HARD-FAIL inside)
+        extra = _run_child("mp", timeout=child_timeout())
+        if "error" not in extra:
+            results["mp"] = extra
             _emit(results)
     if not _smoke():
         for name in ("gpt2", "bert"):
@@ -2254,6 +2375,59 @@ def dry_run():
 
         zero_canary = _zero_canary()
 
+        # Tensor-parallel serving canary (ISSUE-15): on an mp=2 mesh
+        # the sharded paged engine (head-partitioned block pool +
+        # shard_map'd fused step) must generate greedy output
+        # token-identical to the single-device engine AND bill the
+        # per-device KV block bytes at exactly 1/mp. Skipped —
+        # reported, not failed — when fewer than 2 devices are visible
+        # (the tier-1 conftest forces 8 host devices, so CI always
+        # exercises it).
+        def _mp_canary():
+            import jax
+            from jax.sharding import Mesh
+            from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining
+            from paddle_tpu.serving import GenerationEngine
+            if len(jax.devices()) < 2:
+                return {"skipped": True, "parity": True,
+                        "kv_bytes_per_device_ok": True,
+                        "kv_bytes_per_device": None,
+                        "single_device_kv_bytes": None}
+            mp = 2
+            rng = np.random.RandomState(7)
+            cfg = GPTConfig.tiny()
+            prompts = [rng.randint(1, cfg.vocab_size, 6 + 3 * i)
+                       .astype(np.int32) for i in range(4)]
+
+            def run_leg(mesh):
+                # fresh model per leg: sharding device_puts the params
+                # in place, and both legs must start from the same
+                # seeded weights
+                paddle.framework.random.seed(0)
+                m = GPTForPretraining(cfg)
+                m.eval()
+                eng = GenerationEngine(m, num_slots=2, max_len=48,
+                                       kv_layout="paged", block_size=8,
+                                       attention="fused", mesh=mesh)
+                hs = [eng.submit(p, max_new_tokens=8) for p in prompts]
+                outs = [h.result(timeout=600) for h in hs]
+                blocks = eng.stats()["kv_bytes"]["blocks"]
+                eng.close()
+                return outs, blocks
+
+            s_outs, s_blocks = run_leg(None)
+            mesh = Mesh(np.array(jax.devices()[:mp]).reshape(mp),
+                        ("mp",))
+            m_outs, m_blocks = run_leg(mesh)
+            parity = all(np.array_equal(a, b)
+                         for a, b in zip(s_outs, m_outs))
+            return {"skipped": False, "parity": parity,
+                    "kv_bytes_per_device_ok": m_blocks * mp == s_blocks,
+                    "kv_bytes_per_device": m_blocks,
+                    "single_device_kv_bytes": s_blocks}
+
+        mp_canary = _mp_canary()
+
         # ISSUE-13 telemetry spine: the labeled metrics registry is the
         # surface every scale-out PR reports through, so the dry run
         # proves it end to end — (1) an explicit dp=2 CPU-mesh probe of
@@ -2533,6 +2707,10 @@ def dry_run():
         # ledger's ~1/dp per-replica opt-state bytes
         "zero_parity": zero_canary["parity"],
         "zero_opt_state_sharded": zero_canary["ledger_ok"],
+        # GenerationEngine(mesh=): mp=2 greedy token parity with the
+        # single-device engine + the exact-1/mp per-device KV ledger
+        "mp_parity": mp_canary["parity"],
+        "mp_kv_bytes_per_device": mp_canary["kv_bytes_per_device_ok"],
         # ISSUE-13 telemetry spine: dp=2 collective timing + the
         # exposed-vs-overlapped report live, statusz renders with and
         # without a live engine, the fleet aggregation sums replicas'
@@ -2595,6 +2773,7 @@ def dry_run():
                               monitor.stat_get("hapi/nonfinite_steps"),
                       },
                       "zero": zero_canary,
+                      "mp": mp_canary,
                       "telemetry": {k: telemetry_canary[k] for k in
                                     ("probed_kinds",
                                      "exposed_ms_per_step",
@@ -2641,6 +2820,13 @@ if __name__ == "__main__":
         # child schema): spec-vs-plain decode ms, accept rate,
         # tokens/step, int8 capacity + drift; parity hard-fails
         print("RESULT " + json.dumps(bench_spec()))
+    elif "--bench-mp" in sys.argv[1:]:
+        # standalone single-vs-mp=2 tensor-parallel serving microbench
+        # (same child schema): decode-step ms both legs + per-device KV
+        # bytes; token parity and the 1/mp ledger hard-fail. Needs
+        # >= 2 devices — on CPU run under
+        # XLA_FLAGS=--xla_force_host_platform_device_count=2
+        print("RESULT " + json.dumps(bench_mp()))
     elif "--dry-run" in sys.argv[1:]:
         dry_run()
     else:

@@ -1,10 +1,13 @@
 """Continuous-batching LLM serving: many concurrent clients, one engine.
 
 Trains a small character-level GPT-2 for a few steps (so the decodes are
-legible), optionally shards it tensor-parallel over the visible devices,
-then starts a ``serving.GenerationEngine`` and hammers it with N
-concurrent clients submitting prompts of MIXED lengths and output
-budgets. Each client streams its tokens as they are produced; the demo
+legible), then starts a ``serving.GenerationEngine`` and hammers it with
+N concurrent clients submitting prompts of MIXED lengths and output
+budgets. With ``--mp N`` the whole engine serves TENSOR-PARALLEL
+(``GenerationEngine(mesh=)``): Megatron weight layout, the paged KV
+pool head-partitioned over an N-way mesh, every step a shard_map — so
+each device holds 1/N of the KV bytes (the per-device pool stats line
+at the end shows it; implies ``--paged``). Each client streams its tokens as they are produced; the demo
 prints per-client time-to-first-token and the engine-wide throughput —
 the two serving numbers that matter, straight from the monitor
 histograms the engine maintains (``serving/ttft_ms``,
@@ -94,20 +97,25 @@ def build_model(train_steps=40):
     return model
 
 
-def maybe_shard(model, mp):
-    """Megatron tensor-parallel placement over an mp-way mesh; the
-    engine's jitted steps then run SPMD with no further changes (the
-    params it snapshots are already placed)."""
+def make_mesh(mp):
+    """1-D ``mp``-way device mesh for the TENSOR-PARALLEL engine
+    (``GenerationEngine(mesh=)``): the engine lays the weights out
+    Megatron-style, head-partitions the paged block pool, and runs
+    every serving step as a shard_map over the mesh — each device
+    holds 1/mp of the KV bytes (the scale-up half; EngineFleet is the
+    scale-out half)."""
     if mp <= 1:
-        return
+        return None
     import jax
     from jax.sharding import Mesh
-
-    from paddle_tpu.models.generation import shard_params_megatron
-    devs = np.array(jax.devices()[:mp]).reshape(mp)
-    mesh = Mesh(devs, ("mp",))
-    shard_params_megatron(model, mesh)
-    print(f"sharded tensor-parallel over {mp} device(s)")
+    if mp > len(jax.devices()):
+        raise SystemExit(
+            f"--mp {mp} needs {mp} devices, found {len(jax.devices())} "
+            f"(on CPU: XLA_FLAGS=--xla_force_host_platform_device_count"
+            f"={mp})")
+    mesh = Mesh(np.array(jax.devices()[:mp]).reshape(mp), ("mp",))
+    print(f"serving tensor-parallel over {mp} device(s)")
+    return mesh
 
 
 def main():
@@ -148,13 +156,24 @@ def main():
         args.fused = True
     if args.fused:
         args.paged = True
+    if args.mp > 1:
+        # the tensor-parallel engine serves from the head-sharded
+        # paged pool — dense stripes have no sharded step builders,
+        # and the spec/int8 compositions are not sharded yet
+        args.paged = True
+        if args.spec:
+            ap.error("--mp does not compose with --spec yet (no "
+                     "sharded draft/verify builders)")
+        if args.kv_dtype == "int8":
+            ap.error("--mp does not compose with --kv-dtype int8 yet "
+                     "(block scales have no head-sharded layout)")
     if args.kv_dtype and not args.paged:
         ap.error("--kv-dtype requires --paged/--fused/--spec (quantized "
                  "blocks live in the paged pool)")
 
     paddle.seed(0)
     model = build_model(args.train_steps)
-    maybe_shard(model, args.mp)
+    mesh = make_mesh(args.mp)
 
     if args.paged:
         # min_bucket 16 also bounds the prefix-hit replay: a hit is
@@ -175,7 +194,7 @@ def main():
             attention="fused" if args.fused else "gather",
             kv_dtype=args.kv_dtype,
             spec_draft="auto" if args.spec else None,
-            spec_k=args.spec_k)
+            spec_k=args.spec_k, mesh=mesh)
     else:
         engine = GenerationEngine(model, num_slots=args.slots, max_len=96,
                                   min_bucket=8)
@@ -261,6 +280,11 @@ def main():
               f"({stats['prefix_hits']} hit / "
               f"{stats['prefix_misses']} miss), "
               f"prefill tokens saved {stats['prefill_tokens_saved']}")
+    if stats.get("mp"):
+        print(f"  tensor-parallel: mp={stats['mp']} "
+              f"('{stats['mp_axis']}' axis), per-device KV pool "
+              f"{stats['kv_bytes_per_device'] // 1024} KiB "
+              f"(1/{stats['mp']} of the single-device bytes)")
     if args.fused:
         print(f"  fused: attention={stats['attention']}, "
               f"prefill chunks {stats['prefill_chunks']} "

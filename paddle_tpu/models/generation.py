@@ -28,10 +28,15 @@ import numpy as np
 from ..framework.tensor import Tensor, no_grad_guard
 
 __all__ = ["GenerationConfig", "generate", "save_for_serving",
-           "shard_params_megatron", "build_slot_prefill_fn",
+           "shard_params_megatron", "megatron_param_specs",
+           "build_slot_prefill_fn",
            "build_slot_decode_fn", "build_paged_prefill_fn",
            "build_paged_decode_fn", "build_fused_step_fn",
+           "build_sharded_paged_prefill_fn",
+           "build_sharded_paged_decode_fn",
+           "build_sharded_fused_step_fn",
            "build_draft_prefill_fn", "build_draft_propose_fn",
+           "build_draft_propose_scan_fn",
            "build_spec_verify_fn", "make_draft_model"]
 
 
@@ -58,6 +63,30 @@ def shard_params_megatron(model, mesh, mp_axis="mp"):
         else:
             sh = rep
         p._data = jax.device_put(p._data, sh)
+
+
+def megatron_param_specs(model, mp_axis="mp"):
+    """The flat ``{param_name: PartitionSpec}`` dict matching
+    :func:`shard_params_megatron`'s placement, keyed like
+    ``get_params_tree`` — the params entry of a ``shard_map``'s
+    ``in_specs`` over the tensor-parallel serving steps. Column-parallel
+    weights split their OUTPUT dim, row-parallel weights their INPUT dim
+    (weights are [in, out]); everything else (biases, LayerNorms,
+    embeddings, the tied LM head) is replicated."""
+    from jax.sharding import PartitionSpec as P
+
+    specs = {}
+    for name, p in model.named_parameters():
+        if p._data.ndim == 2 and any(k in name for k in (
+                "q_proj.weight", "k_proj.weight", "v_proj.weight",
+                "mlp_fc.weight")):
+            specs[name] = P(None, mp_axis)
+        elif p._data.ndim == 2 and any(k in name for k in (
+                "out_proj.weight", "mlp_proj.weight")):
+            specs[name] = P(mp_axis, None)
+        else:
+            specs[name] = P()
+    return specs
 
 
 def save_for_serving(model, path, batch, prompt_len, runtime_key=False,
@@ -1165,6 +1194,418 @@ def build_fused_step_fn(model, num_slots, q_rows, table_len, block_size,
 
 
 # ---------------------------------------------------------------------------
+# tensor-parallel serving steps (GenerationEngine(mesh=..., mp_axis="mp")):
+# the per-device Megatron twins of the paged/fused steps above, wrapped in
+# shard_map over a 1-D mp mesh. The block pool is head-partitioned
+# ([L, 2, NB+1, H/mp, bs, Dh] per device); page tables, free lists and the
+# prefix trie stay replicated host-side, so the allocator/COW/preemption
+# logic never sees the mesh. Column-parallel projections slice the
+# replicated bias to their local output columns; row-parallel projections
+# join their partial products with ONE psum per projection (two per layer
+# plus nothing at the LM head — post-psum activations are replicated, and
+# the tied embedding weight is too).
+# ---------------------------------------------------------------------------
+
+
+def _mp_col_linear(lin, h, mp_axis):
+    """Column-parallel Linear: replicated ``h [.., in]`` in, LOCAL
+    ``[.., out/mp]`` out. Inside ``shard_map`` the module's swapped-in
+    weight IS the local column shard; the bias is replicated full-width
+    (``shard_params_megatron`` leaves 1-D params alone), so this
+    device's output columns slice it at ``axis_index * out/mp`` — the
+    module call itself would add a ``[out]`` bias to a ``[.., out/mp]``
+    product and fail."""
+    from jax import lax
+    w = lin.weight._data                        # [in, out/mp] local
+    b = lin.bias._data                          # [out] replicated
+    n = w.shape[1]
+    i = lax.axis_index(mp_axis) * n
+    return h @ w + lax.dynamic_slice(b, (i,), (n,))
+
+
+def _mp_row_linear(lin, h_local, mp_axis):
+    """Row-parallel Linear: LOCAL ``[.., in/mp]`` in, replicated
+    ``[.., out]`` out. The local product is a PARTIAL sum over the
+    input dim; one ``psum`` joins the shards and the replicated bias is
+    added exactly once, post-sum."""
+    from jax import lax
+    return lax.psum(h_local @ lin.weight._data, mp_axis) \
+        + lin.bias._data
+
+
+def _mp_qkv(block, x, mp, mp_axis):
+    """Per-device :meth:`GPTBlock._qkv`: ln_1 on the replicated
+    activations, column-parallel q/k/v projections, heads reshaped to
+    the LOCAL head count (``_split_heads`` reshapes by the global
+    ``num_heads`` attribute, so the split happens manually here).
+    Returns local ``q/k/v [B, L, H/mp, Dh]`` ndarrays."""
+    h = block.ln_1(x)._data
+    attn = block.attn
+    hl = attn.num_heads // mp
+    dh = attn.head_dim
+
+    def proj(lin):
+        y = _mp_col_linear(lin, h, mp_axis)
+        return y.reshape(y.shape[0], y.shape[1], hl, dh)
+
+    return proj(attn.q_proj), proj(attn.k_proj), proj(attn.v_proj)
+
+
+def _mp_tail(block, x, a_local, mp_axis):
+    """Per-device :meth:`GPTBlock._tail`: merge the LOCAL heads,
+    row-parallel out-proj (the psum joins the head shards' attention
+    outputs), residual, then the column/row-parallel MLP with its own
+    psum — the Megatron two-collectives-per-layer count. ``a_local`` is
+    a ``[B, L, H/mp, Dh]`` ndarray; returns the replicated Tensor."""
+    from ..nn import functional as F
+    a = a_local.reshape(a_local.shape[0], a_local.shape[1], -1)
+    attn_out = _mp_row_linear(block.attn.out_proj, a, mp_axis)
+    x = x + block.dropout(Tensor(attn_out, stop_gradient=True))
+    h = block.ln_2(x)._data
+    g = F.gelu(Tensor(_mp_col_linear(block.mlp_fc, h, mp_axis),
+                      stop_gradient=True), approximate=True)
+    m = _mp_row_linear(block.mlp_proj, g._data, mp_axis)
+    return x + block.dropout(Tensor(m, stop_gradient=True))
+
+
+def _mp_fused_tower(gpt, x, pool, write_block, write_off, blk_seq,
+                    seq_qstart, seq_pos0, tables, lo, kv_len, mp,
+                    mp_axis):
+    """Per-device fused ragged tower: each device scatters its OWN
+    heads' K/V into its pool shard and launches the ragged Pallas
+    kernel over its local head range — the kernel's grid is already
+    per-head, so the per-shard call is the UNMODIFIED kernel on an
+    ``[H/mp, ...]`` slice with the replicated scalar-prefetch metadata.
+    Returns ``(ln_f(x), pool)``."""
+    import jax.numpy as jnp
+
+    from ..ops.ragged_paged_attention import ragged_paged_attention
+
+    for li, block in enumerate(gpt.blocks):
+        q, k, v = _mp_qkv(block, x, mp, mp_axis)
+        pool = pool.at[li, 0, write_block, :, write_off, :].set(
+            k[0].astype(pool.dtype))
+        pool = pool.at[li, 1, write_block, :, write_off, :].set(
+            v[0].astype(pool.dtype))
+        qh = jnp.transpose(q, (0, 2, 1, 3))[0]       # [H/mp, Q, Dh]
+        a = ragged_paged_attention(
+            qh, pool, li, blk_seq, seq_qstart, seq_pos0, tables, lo,
+            kv_len)
+        a = jnp.transpose(a[None], (0, 2, 1, 3))     # [1, Q, H/mp, Dh]
+        x = _mp_tail(block, x, a, mp_axis)
+    return gpt.ln_f(x), pool
+
+
+def _mp_pool_spec(mp_axis):
+    """The head-partitioned PartitionSpec of the paged block pool
+    ``[L, 2, NB+1, H, bs, Dh]`` — axis 3 (heads) over ``mp_axis``."""
+    from jax.sharding import PartitionSpec as P
+    return P(None, None, None, mp_axis, None, None)
+
+
+def _mp_mesh_check(gpt, mesh, mp_axis):
+    """Validate the serving mesh and return its mp degree. The serving
+    shard_maps are manual over EVERY mesh axis, so a 1-D mesh is
+    required (dp replication belongs to EngineFleet, one engine per
+    replica)."""
+    if mp_axis not in mesh.axis_names:
+        raise ValueError(
+            f"mp_axis {mp_axis!r} not in mesh axes {mesh.axis_names}")
+    if len(mesh.axis_names) != 1:
+        raise ValueError(
+            f"serving mesh must be 1-D over {mp_axis!r}, got axes "
+            f"{mesh.axis_names} (replicate with EngineFleet instead)")
+    mp = int(mesh.shape[mp_axis])
+    H = gpt.cfg.num_attention_heads
+    if H % mp:
+        raise ValueError(
+            f"num_attention_heads {H} not divisible by mesh "
+            f"{mp_axis}={mp}")
+    return mp
+
+
+def build_sharded_paged_prefill_fn(model, bucket_len, block_size, mesh,
+                                   mp_axis="mp", top_k=0, top_p=1.0,
+                                   probe=None):
+    """Tensor-parallel :func:`build_paged_prefill_fn` (non-quantized):
+    the SAME ``fn(params, buffers, pool, ids, key_valid, table, plen,
+    sample, temperature, key) -> (pool, first_token, key)`` signature,
+    with the body wrapped in ``shard_map`` over the 1-D ``mp`` mesh.
+    ``pool`` is the head-partitioned global array; each device writes
+    its own heads' K/V blocks and attends over its local heads, the
+    row-parallel projections psum per layer, and the first-token pick
+    runs on replicated logits (identical on every device). Donation of
+    the global pool flows through the shard_map boundary unchanged."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from ..framework import trace_probe as _probe
+    from ..nn import functional as F
+    from ..nn.layer.layers import functional_state
+
+    gpt = model.gpt if hasattr(model, "gpt") else model
+    Lb, bs = int(bucket_len), int(block_size)
+    if Lb < 1:
+        raise ValueError(f"bucket_len must be >= 1, got {Lb}")
+    if bs < 1 or Lb % bs:
+        raise ValueError(
+            f"bucket_len {Lb} must be a positive multiple of "
+            f"block_size {bs}")
+    if Lb > gpt.cfg.max_position_embeddings:
+        raise ValueError(
+            f"bucket_len {Lb} exceeds max_position_embeddings="
+            f"{gpt.cfg.max_position_embeddings}")
+    Tp = Lb // bs
+    mp = _mp_mesh_check(gpt, mesh, mp_axis)
+    H = gpt.cfg.num_attention_heads
+    Hl = H // mp
+    Dh = gpt.cfg.hidden_size // H
+    top_k = min(int(top_k), gpt.cfg.vocab_size)
+
+    def body(params, buffers, pool, ids, key_valid, table, plen, sample,
+             temperature, key):
+        with functional_state(model, params, buffers):
+            with no_grad_guard():
+                pos_ids = Tensor(jnp.maximum(
+                    jnp.cumsum(key_valid.astype(jnp.int32), axis=1) - 1,
+                    0))
+                x = gpt.wte(Tensor(ids, stop_gradient=True)) \
+                    + gpt.wpe(pos_ids)
+                mask = Tensor(key_valid[:, None, None, :])
+                new_pool = pool
+                for li, block in enumerate(gpt.blocks):
+                    q, k, v = _mp_qkv(block, x, mp, mp_axis)
+                    # the single-device prefill attends over the CACHE
+                    # (pool-dtype values); cast before attention so the
+                    # sharded engine sees bit-identical K/V
+                    kc = k.astype(new_pool.dtype)
+                    vc = v.astype(new_pool.dtype)
+                    kb = jnp.transpose(kc[0].reshape(Tp, bs, Hl, Dh),
+                                       (0, 2, 1, 3))
+                    vb = jnp.transpose(vc[0].reshape(Tp, bs, Hl, Dh),
+                                       (0, 2, 1, 3))
+                    new_pool = new_pool.at[li, 0, table].set(kb)
+                    new_pool = new_pool.at[li, 1, table].set(vb)
+                    a = F.scaled_dot_product_attention(
+                        Tensor(q, stop_gradient=True),
+                        Tensor(kc, stop_gradient=True),
+                        Tensor(vc, stop_gradient=True),
+                        attn_mask=mask, is_causal=True)
+                    x = _mp_tail(block, x, a._data, mp_axis)
+                x = gpt.ln_f(x)
+                z = jnp.int32(0)
+                p = jnp.asarray(plen, jnp.int32).reshape(())
+                last = lax.dynamic_slice(
+                    x._data, (z, p - 1, z), (1, 1, x._data.shape[-1]))
+                logits = gpt.logits(Tensor(last))._data[:, 0].astype(
+                    jnp.float32)
+                key, sub = jax.random.split(key)
+                greedy = _pick_token(logits, sub, False, top_k, top_p,
+                                     1.0)
+                sampled = _pick_token(logits, sub, True, top_k, top_p,
+                                      temperature)
+                first = jnp.where(sample, sampled, greedy)
+        return new_pool, first, key
+
+    rep = P()
+    sm = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(megatron_param_specs(model, mp_axis), rep,
+                  _mp_pool_spec(mp_axis)) + (rep,) * 7,
+        out_specs=(_mp_pool_spec(mp_axis), rep, rep), check_vma=False)
+
+    def fn(params, buffers, pool, ids, key_valid, table, plen, sample,
+           temperature, key):
+        if probe is not None:  # runs at trace time only (jit caches)
+            probe.record(_probe.sig_of([pool, ids, key_valid, table]),
+                         {"bucket": Lb, "table": Tp, "mp": mp})
+        return sm(params, buffers, pool, ids, key_valid, table, plen,
+                  sample, temperature, key)
+
+    return fn
+
+
+def build_sharded_paged_decode_fn(model, num_slots, table_len,
+                                  block_size, mesh, mp_axis="mp",
+                                  top_k=0, top_p=1.0, probe=None,
+                                  debug_logits=False):
+    """Tensor-parallel :func:`build_paged_decode_fn` (non-quantized):
+    the gather-based paged-attention oracle under ``shard_map``. Each
+    device scatters its heads' K/V through the replicated page table
+    into its pool shard, gathers ITS OWN virtual cache window, runs
+    SDPA over the local heads, and the row-parallel tail psums — the
+    sampled token is computed from replicated logits, identical on
+    every device. Same signature/donation contract as the single-device
+    builder."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ..framework import trace_probe as _probe
+    from ..nn import functional as F
+    from ..nn.layer.layers import functional_state
+
+    gpt = model.gpt if hasattr(model, "gpt") else model
+    S, T, bs = int(num_slots), int(table_len), int(block_size)
+    if S < 1:
+        raise ValueError(f"num_slots must be >= 1, got {S}")
+    if T < 1:
+        raise ValueError(f"table_len must be >= 1, got {T}")
+    mp = _mp_mesh_check(gpt, mesh, mp_axis)
+    H = gpt.cfg.num_attention_heads
+    Hl = H // mp
+    Dh = gpt.cfg.hidden_size // H
+    top_k = min(int(top_k), gpt.cfg.vocab_size)
+
+    def body(params, buffers, pool, tokens, pos, lo, tables,
+             sample_mask, temperature, key):
+        with functional_state(model, params, buffers):
+            with no_grad_guard():
+                logical = (pos - lo)[:, None]
+                x = gpt.wte(Tensor(tokens[:, None], stop_gradient=True)) \
+                    + gpt.wpe(Tensor(logical))
+                r = jnp.arange(T * bs)
+                key_valid = (r[None, :] >= lo[:, None]) \
+                    & (r[None, :] <= pos[:, None])
+                mask = Tensor(key_valid[:, None, None, :])
+                sl = jnp.arange(S)
+                wb = tables[sl, pos // bs]
+                off = pos % bs
+                new_pool = pool
+                for li, block in enumerate(gpt.blocks):
+                    q, k, v = _mp_qkv(block, x, mp, mp_axis)
+                    kh = k[:, 0].astype(new_pool.dtype)  # [S, H/mp, Dh]
+                    vh = v[:, 0].astype(new_pool.dtype)
+                    new_pool = new_pool.at[li, 0, wb, :, off, :].set(kh)
+                    new_pool = new_pool.at[li, 1, wb, :, off, :].set(vh)
+                    kg = new_pool[li, 0][tables]
+                    vg = new_pool[li, 1][tables]
+                    kf = jnp.transpose(kg, (0, 1, 3, 2, 4)).reshape(
+                        S, T * bs, Hl, Dh)
+                    vf = jnp.transpose(vg, (0, 1, 3, 2, 4)).reshape(
+                        S, T * bs, Hl, Dh)
+                    a = F.scaled_dot_product_attention(
+                        Tensor(q, stop_gradient=True),
+                        Tensor(kf, stop_gradient=True),
+                        Tensor(vf, stop_gradient=True), attn_mask=mask)
+                    x = _mp_tail(block, x, a._data, mp_axis)
+                x = gpt.ln_f(x)
+                logits = gpt.logits(x)._data[:, 0].astype(jnp.float32)
+                key, sub = jax.random.split(key)
+                greedy = _pick_token(logits, sub, False, top_k, top_p,
+                                     1.0)
+                sampled = _pick_token(logits, sub, True, top_k, top_p,
+                                      temperature[:, None])
+                nxt = jnp.where(sample_mask, sampled, greedy)
+                nxt = _append_nonfinite_flag(nxt, logits)
+        extra = (logits,) if debug_logits else ()
+        return (new_pool, nxt) + extra + (key,)
+
+    rep = P()
+    extra_specs = (rep,) if debug_logits else ()
+    sm = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(megatron_param_specs(model, mp_axis), rep,
+                  _mp_pool_spec(mp_axis)) + (rep,) * 7,
+        out_specs=(_mp_pool_spec(mp_axis), rep) + extra_specs + (rep,),
+        check_vma=False)
+
+    def fn(params, buffers, pool, tokens, pos, lo, tables, sample_mask,
+           temperature, key):
+        if probe is not None:  # runs at trace time only (jit caches)
+            probe.record(_probe.sig_of([pool, tokens, pos, lo, tables,
+                                        temperature]),
+                         {"slots": S, "table": T, "mp": mp})
+        return sm(params, buffers, pool, tokens, pos, lo, tables,
+                  sample_mask, temperature, key)
+
+    return fn
+
+
+def build_sharded_fused_step_fn(model, num_slots, q_rows, table_len,
+                                block_size, mesh, mp_axis="mp", top_k=0,
+                                top_p=1.0, probe=None):
+    """Tensor-parallel :func:`build_fused_step_fn` (non-quantized): THE
+    fused ragged serving step under ``shard_map`` over the 1-D ``mp``
+    mesh. Each device launches the ragged Pallas kernel on its own
+    heads against its own pool shard (the kernel's per-head grid makes
+    the per-shard call the unmodified kernel); the row-parallel
+    projections contribute the only collectives — one psum per
+    out-proj/MLP-out joining attention outputs before the replicated
+    LM head feeds :func:`_pick_token`, so the picked token is identical
+    on every device. Signature, bucket discipline and the
+    ``donate_argnums`` contract on the (now head-partitioned GLOBAL)
+    pool are unchanged from the single-device builder — the donated
+    pool stays donated through the shard_map boundary."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ..framework import trace_probe as _probe
+    from ..nn.layer.layers import functional_state
+    from ..ops.ragged_paged_attention import BLOCK_Q
+
+    gpt = model.gpt if hasattr(model, "gpt") else model
+    S, Q, T, bs = (int(num_slots), int(q_rows), int(table_len),
+                   int(block_size))
+    if S < 1:
+        raise ValueError(f"num_slots must be >= 1, got {S}")
+    if Q < BLOCK_Q or Q % BLOCK_Q:
+        raise ValueError(
+            f"q_rows must be a positive multiple of {BLOCK_Q}, got {Q}")
+    if T < 1:
+        raise ValueError(f"table_len must be >= 1, got {T}")
+    mp = _mp_mesh_check(gpt, mesh, mp_axis)
+    top_k = min(int(top_k), gpt.cfg.vocab_size)
+
+    def body(params, buffers, pool, token_ids, qpos, write_block,
+             write_off, blk_seq, seq_qstart, seq_pos0, tables, lo,
+             kv_len, last_row, sample_mask, temperature, key):
+        with functional_state(model, params, buffers):
+            with no_grad_guard():
+                x = gpt.wte(Tensor(token_ids[None, :],
+                                   stop_gradient=True)) \
+                    + gpt.wpe(Tensor(qpos[None, :]))
+                x, new_pool = _mp_fused_tower(
+                    gpt, x, pool, write_block, write_off, blk_seq,
+                    seq_qstart, seq_pos0, tables, lo, kv_len, mp,
+                    mp_axis)
+                last = x._data[0, last_row]             # [S, E]
+                logits = gpt.logits(
+                    Tensor(last[:, None, :]))._data[:, 0].astype(
+                        jnp.float32)
+                key, sub = jax.random.split(key)
+                greedy = _pick_token(logits, sub, False, top_k, top_p,
+                                     1.0)
+                sampled = _pick_token(logits, sub, True, top_k, top_p,
+                                      temperature[:, None])
+                nxt = jnp.where(sample_mask, sampled, greedy)
+                nxt = _append_nonfinite_flag(nxt, logits)
+        return new_pool, nxt, key
+
+    rep = P()
+    sm = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(megatron_param_specs(model, mp_axis), rep,
+                  _mp_pool_spec(mp_axis)) + (rep,) * 14,
+        out_specs=(_mp_pool_spec(mp_axis), rep, rep), check_vma=False)
+
+    def fn(params, buffers, pool, token_ids, qpos, write_block,
+           write_off, blk_seq, seq_qstart, seq_pos0, tables, lo, kv_len,
+           last_row, sample_mask, temperature, key):
+        if probe is not None:  # runs at trace time only (jit caches)
+            probe.record(_probe.sig_of([pool, token_ids, tables]),
+                         {"q": Q, "table": T, "mp": mp})
+        return sm(params, buffers, pool, token_ids, qpos, write_block,
+                  write_off, blk_seq, seq_qstart, seq_pos0, tables, lo,
+                  kv_len, last_row, sample_mask, temperature, key)
+
+    return fn
+
+
+# ---------------------------------------------------------------------------
 # speculative decoding (the draft-propose / fused-verify pair consumed by
 # GenerationEngine(spec_draft=..., spec_k=...) — see serving/engine.py)
 # ---------------------------------------------------------------------------
@@ -1418,6 +1859,105 @@ def build_draft_propose_fn(model, num_slots, max_len, top_k=0, top_p=1.0,
                 key, sub = jax.random.split(key)
                 prop = _categorical_probs(sub, probs)
         return new_pool, prop, probs, key
+
+    return fn
+
+
+def build_draft_propose_scan_fn(model, num_slots, max_len, spec_k,
+                                top_k=0, top_p=1.0, probe=None):
+    """The WHOLE draft proposal loop as one compiled program:
+    ``lax.scan`` over :func:`build_draft_propose_fn`'s step body —
+    ``spec_k`` sequential small launches per decode cycle become ONE
+    dispatch, with the step's key-split/draw order preserved exactly so
+    greedy proposals (and the sampled key chain) are token-identical to
+    the unrolled loop.
+
+    Returns ``fn(params, buffers, pool, feed_tok, pos, lo, sample_mask,
+    temperature, key) -> (pool, proposals [S, spec_k],
+    probs [S, spec_k, V], key)``:
+
+    * ``feed_tok [S]`` int32 — each slot's last accepted token (the
+      loop's step-0 feed); later steps feed the previous step's
+      device-side proposal through the scan carry;
+    * step ``j`` writes at position ``min(pos + j, max_len - 1)`` — the
+      same host-side clamp the unrolled loop applied, now in-trace;
+    * the caller jits with ``donate_argnums`` on ``pool``.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ..framework import trace_probe as _probe
+    from ..nn import functional as F
+    from ..nn.layer.layers import functional_state
+
+    gpt = model.gpt if hasattr(model, "gpt") else model
+    S = int(num_slots)
+    L = int(max_len)
+    K = int(spec_k)
+    if S < 1:
+        raise ValueError(f"num_slots must be >= 1, got {S}")
+    if K < 1:
+        raise ValueError(f"spec_k must be >= 1, got {K}")
+    if L > gpt.cfg.max_position_embeddings:
+        raise ValueError(
+            f"max_len {L} exceeds max_position_embeddings="
+            f"{gpt.cfg.max_position_embeddings}")
+    top_k = min(int(top_k), gpt.cfg.vocab_size)
+
+    def fn(params, buffers, pool, feed_tok, pos, lo, sample_mask,
+           temperature, key):
+        if probe is not None:  # runs at trace time only (jit caches)
+            probe.record(_probe.sig_of([pool, feed_tok, pos, lo,
+                                        temperature]),
+                         {"slots": S, "k": K})
+        with functional_state(model, params, buffers):
+            with no_grad_guard():
+                r = jnp.arange(L)
+                sl = jnp.arange(S)
+
+                def step(carry, j):
+                    new_pool, feed, key = carry
+                    pj = jnp.minimum(pos + j, L - 1)
+                    logical = (pj - lo)[:, None]
+                    x = gpt.wte(Tensor(feed[:, None],
+                                       stop_gradient=True)) \
+                        + gpt.wpe(Tensor(logical))
+                    key_valid = (r[None, :] >= lo[:, None]) \
+                        & (r[None, :] <= pj[:, None])
+                    mask = Tensor(key_valid[:, None, None, :])
+                    for li, block in enumerate(gpt.blocks):
+                        q, k, v = block._qkv(x)
+                        kh = k._data[:, 0].astype(new_pool.dtype)
+                        vh = v._data[:, 0].astype(new_pool.dtype)
+                        new_pool = new_pool.at[
+                            li, 0, sl, :, pj, :].set(kh)
+                        new_pool = new_pool.at[
+                            li, 1, sl, :, pj, :].set(vh)
+                        k_full = Tensor(
+                            jnp.swapaxes(new_pool[li, 0], 1, 2),
+                            stop_gradient=True)
+                        v_full = Tensor(
+                            jnp.swapaxes(new_pool[li, 1], 1, 2),
+                            stop_gradient=True)
+                        a = F.scaled_dot_product_attention(
+                            q, k_full, v_full, attn_mask=mask)
+                        x = block._tail(x, a)
+                    x = gpt.ln_f(x)
+                    logits = gpt.logits(x)._data[:, 0].astype(
+                        jnp.float32)
+                    probs = _sample_probs(logits, sample_mask, top_k,
+                                          top_p, temperature)
+                    key, sub = jax.random.split(key)
+                    prop = _categorical_probs(sub, probs)
+                    return (new_pool, prop, key), (prop, probs)
+
+                (new_pool, _, key), (props, probs) = lax.scan(
+                    step,
+                    (pool, jnp.asarray(feed_tok, jnp.int32), key),
+                    jnp.arange(K))
+        return (new_pool, jnp.swapaxes(props, 0, 1),
+                jnp.swapaxes(probs, 0, 1), key)
 
     return fn
 

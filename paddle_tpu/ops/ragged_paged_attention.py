@@ -36,6 +36,15 @@ KV scratch is ``(block_size, Dh)`` with ``block_size >= 8`` required.
 Off-TPU the kernel runs in interpret mode — that is how the tier-1
 parity suite (tests/test_ragged_attention.py) executes the kernel body
 on CPU.
+
+Tensor-parallel use (ISSUE 15): the kernel is head-count agnostic —
+its grid is per-(q block, head), so the sharded serving step
+(``build_sharded_fused_step_fn``) simply launches it inside a
+``shard_map`` with the LOCAL head count ``H/mp`` against each device's
+own pool shard ``[L, 2, blocks, H/mp, bs, Dh]``. No kernel change: the
+page tables and scalar-prefetch metadata are replicated (block indices
+are shard-invariant), the per-head outputs are partial sums of the
+attention projection, and one downstream ``psum`` joins them.
 """
 from __future__ import annotations
 

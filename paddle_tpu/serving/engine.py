@@ -227,7 +227,8 @@ class GenerationEngine:
                  kv_layout: str = "dense", block_size: int = 16,
                  num_blocks: Optional[int] = None,
                  attention: str = "gather", kv_dtype=None,
-                 spec_draft=None, spec_k: int = 4):
+                 spec_draft=None, spec_k: int = 4,
+                 mesh=None, mp_axis: str = "mp"):
         import jax
 
         from ..models.generation import build_slot_decode_fn
@@ -267,6 +268,28 @@ class GenerationEngine:
                 "attention='fused': the k-token verify IS one fused "
                 "ragged launch — each slot's candidate tokens are extra "
                 "ragged rows, exactly like a prefill chunk")
+        if mesh is not None:
+            # tensor-parallel serving (ISSUE 15): the paged pool is a
+            # head-partitioned GSPMD array and every step is a
+            # shard_map over mp_axis — scale-UP, vs EngineFleet's
+            # scale-OUT replicas
+            if kv_layout != "paged":
+                raise ValueError(
+                    "mesh= (tensor-parallel serving) requires "
+                    "kv_layout='paged': the mp shards partition the "
+                    "block pool's head axis; the dense slot pool has "
+                    "no sharded step builders")
+            if kv_dtype is not None:
+                raise ValueError(
+                    "mesh= does not compose with kv_dtype= yet: the "
+                    "quantized block scales would need their own "
+                    "head-sharded layout — serve quantized pools "
+                    "single-device (or per EngineFleet replica)")
+            if spec_draft is not None:
+                raise ValueError(
+                    "mesh= does not compose with spec_draft= yet: the "
+                    "draft tower and verify program have no sharded "
+                    "builders — run speculative engines single-device")
         self._fused = attention == "fused"
         gpt = model.gpt if hasattr(model, "gpt") else model
         cfg = gpt.cfg
@@ -276,6 +299,17 @@ class GenerationEngine:
         self._gpt = gpt
         self._pad = int(pad_token_id)
         self._top_k, self._top_p = int(top_k), float(top_p)
+        self._mesh = mesh
+        self._mp_axis = str(mp_axis)
+        self._mp = 1
+        if mesh is not None:
+            from ..models.generation import (_mp_mesh_check,
+                                             shard_params_megatron)
+            self._mp = _mp_mesh_check(gpt, mesh, self._mp_axis)
+            # lay the weights out Megatron-style BEFORE the snapshot:
+            # the params tree then holds the sharded arrays and the
+            # shard_map'd steps consume their local shards directly
+            shard_params_megatron(model, mesh, mp_axis=self._mp_axis)
         self._params = get_params_tree(model)
         self._buffers = get_buffers_tree(model)
         if dtype is None:
@@ -303,7 +337,7 @@ class GenerationEngine:
                 cfg.num_hidden_layers, num_slots, cfg.num_attention_heads,
                 max_len, head_dim, block_size=block_size,
                 num_blocks=num_blocks, dtype=kv_dtype or dtype,
-                min_bucket=mb)
+                min_bucket=mb, mesh=mesh, mp_axis=mp_axis)
             self._decode_jit = None       # per-table-bucket instead
             self._decode_jits = {}        # table bucket -> jitted step
             self._fused_jits = {}         # (q bucket, table bucket) -> step
@@ -548,11 +582,18 @@ class GenerationEngine:
                 # point of the ~2x-requests-per-budget win, so the
                 # operator view must show where the bytes went
                 "kv_dtype": pool.dtype.name,
+                # block_storage_bytes is PER DEVICE (a sharded pool
+                # divides its head axis over mp shards); on a
+                # single-device pool shards == 1 and this is the total
                 "kv_bytes": {
                     "blocks": pool.block_storage_bytes,
                     "scales": pool.scales_bytes,
                 },
             })
+            if self._mp > 1:
+                s["mp"] = self._mp
+                s["mp_axis"] = self._mp_axis
+                s["kv_bytes_per_device"] = pool.block_storage_bytes
         if self._fused:
             # chunked-prefill observability: lifetime chunk counters
             # plus ring-window chunk token throughput, so the "long
@@ -725,11 +766,17 @@ class GenerationEngine:
     def _prefill_fn(self, bucket: int):
         fn = self._prefill_jits.get(bucket)
         if fn is None:
-            from ..models.generation import (build_paged_prefill_fn,
-                                             build_slot_prefill_fn)
+            from ..models.generation import (
+                build_paged_prefill_fn, build_sharded_paged_prefill_fn,
+                build_slot_prefill_fn)
             probe = _probe.site(f"serving/prefill[{bucket}]#{self._eid}")
             donate = (2,)
-            if self._paged:
+            if self._mesh is not None:
+                built = build_sharded_paged_prefill_fn(
+                    self._model, bucket, self._pool.block_size,
+                    self._mesh, mp_axis=self._mp_axis,
+                    top_k=self._top_k, top_p=self._top_p, probe=probe)
+            elif self._paged:
                 built = build_paged_prefill_fn(
                     self._model, bucket, self._pool.block_size,
                     top_k=self._top_k, top_p=self._top_p, probe=probe,
@@ -750,16 +797,24 @@ class GenerationEngine:
     def _paged_decode_fn(self, table_len: int):
         fn = self._decode_jits.get(table_len)
         if fn is None:
-            from ..models.generation import build_paged_decode_fn
+            from ..models.generation import (build_paged_decode_fn,
+                                             build_sharded_paged_decode_fn)
             probe = _probe.site(f"serving/decode[t{table_len}]#{self._eid}")
+            if self._mesh is not None:
+                built = build_sharded_paged_decode_fn(
+                    self._model, self._pool.num_slots, table_len,
+                    self._pool.block_size, self._mesh,
+                    mp_axis=self._mp_axis, top_k=self._top_k,
+                    top_p=self._top_p, probe=probe)
+            else:
+                built = build_paged_decode_fn(
+                    self._model, self._pool.num_slots, table_len,
+                    self._pool.block_size, top_k=self._top_k,
+                    top_p=self._top_p, probe=probe,
+                    quantized=self._pool.quantized,
+                    qmax=self._pool.qmax or 127.0)
             fn = _registry.aot_site(
-                f"serving/decode[t{table_len}]#{self._eid}",
-                build_paged_decode_fn(self._model, self._pool.num_slots,
-                                      table_len, self._pool.block_size,
-                                      top_k=self._top_k, top_p=self._top_p,
-                                      probe=probe,
-                                      quantized=self._pool.quantized,
-                                      qmax=self._pool.qmax or 127.0),
+                f"serving/decode[t{table_len}]#{self._eid}", built,
                 donate_argnums=(2, 3) if self._pool.quantized else (2,))
             self._decode_jits[table_len] = fn
         return fn
@@ -963,18 +1018,26 @@ class GenerationEngine:
         key = (q_rows, table_len)
         fn = self._fused_jits.get(key)
         if fn is None:
-            from ..models.generation import build_fused_step_fn
+            from ..models.generation import (build_fused_step_fn,
+                                             build_sharded_fused_step_fn)
             probe = _probe.site(
                 f"serving/fused[q{q_rows},t{table_len}]#{self._eid}")
+            if self._mesh is not None:
+                built = build_sharded_fused_step_fn(
+                    self._model, self._pool.num_slots, q_rows,
+                    table_len, self._pool.block_size, self._mesh,
+                    mp_axis=self._mp_axis, top_k=self._top_k,
+                    top_p=self._top_p, probe=probe)
+            else:
+                built = build_fused_step_fn(
+                    self._model, self._pool.num_slots, q_rows,
+                    table_len, self._pool.block_size,
+                    top_k=self._top_k, top_p=self._top_p, probe=probe,
+                    quantized=self._pool.quantized,
+                    qmax=self._pool.qmax or 127.0)
             fn = _registry.aot_site(
                 f"serving/fused[q{q_rows},t{table_len}]#{self._eid}",
-                build_fused_step_fn(self._model, self._pool.num_slots,
-                                    q_rows, table_len,
-                                    self._pool.block_size,
-                                    top_k=self._top_k, top_p=self._top_p,
-                                    probe=probe,
-                                    quantized=self._pool.quantized,
-                                    qmax=self._pool.qmax or 127.0),
+                built,
                 donate_argnums=(2, 3) if self._pool.quantized else (2,))
             self._fused_jits[key] = fn
         return fn
@@ -1025,7 +1088,7 @@ class GenerationEngine:
         self._draft_pool = jnp.zeros(self._draft_shape, pdt)
         self._draft_synced = np.zeros(self._pool.num_slots, bool)
         self._draft_prefill_jits = {}
-        self._draft_step_jit = None
+        self._draft_scan_jits = {}        # kmax -> scanned propose chain
 
     def _reset_draft(self) -> None:
         """Failure-path twin of ``pool.reset_data()``: the draft pool
@@ -1058,19 +1121,27 @@ class GenerationEngine:
             self._draft_prefill_jits[bucket] = fn
         return fn
 
-    def _draft_step_fn(self):
-        if self._draft_step_jit is None:
-            from ..models.generation import build_draft_propose_fn
-            probe = _probe.site(f"serving/spec_draft#{self._eid}")
-            self._draft_step_jit = _registry.aot_site(
-                f"serving/spec_draft#{self._eid}",
-                build_draft_propose_fn(self._draft_model,
-                                       self._pool.num_slots,
-                                       self._draft_max_len,
-                                       top_k=self._top_k,
-                                       top_p=self._top_p, probe=probe),
+    def _draft_scan_fn(self, kmax: int):
+        """ONE program for the whole draft proposal chain: ``lax.scan``
+        over the per-token draft step
+        (``build_draft_propose_scan_fn``), so a speculative cycle costs
+        a single draft dispatch instead of ``kmax`` sequential small
+        launches. One trace per distinct ``kmax`` (at most spec_k of
+        them; in practice two — the full chain and the budget tail)."""
+        fn = self._draft_scan_jits.get(kmax)
+        if fn is None:
+            from ..models.generation import build_draft_propose_scan_fn
+            probe = _probe.site(
+                f"serving/spec_draft[k{kmax}]#{self._eid}")
+            fn = _registry.aot_site(
+                f"serving/spec_draft[k{kmax}]#{self._eid}",
+                build_draft_propose_scan_fn(
+                    self._draft_model, self._pool.num_slots,
+                    self._draft_max_len, kmax, top_k=self._top_k,
+                    top_p=self._top_p, probe=probe),
                 donate_argnums=(2,))
-        return self._draft_step_jit
+            self._draft_scan_jits[kmax] = fn
+        return fn
 
     def _spec_step_fn(self, q_rows: int, table_len: int):
         key = (q_rows, table_len)
@@ -1153,29 +1224,22 @@ class GenerationEngine:
             feed0[slot] = slot_requests[slot].last_token
             pos_d[slot] = pool.slot_pos(slot)
         lo_d = np.zeros(S, np.int32)
-        step_d = self._draft_step_fn()
-        prop = feed0
-        props, probs = [], []
-        # only as many draft launches as the cycle's LARGEST candidate
-        # count needs (every slot's n_spec = min(spec_k, remaining) —
-        # a batch tail one token from its budget would otherwise pay
-        # spec_k full draft passes for one verified candidate); the
-        # verify signature stays [S, K], zero-padded past kmax
+        # only as many scanned draft steps as the cycle's LARGEST
+        # candidate count needs (every slot's n_spec = min(spec_k,
+        # remaining) — a batch tail one token from its budget would
+        # otherwise pay spec_k full draft passes for one verified
+        # candidate); the verify signature stays [S, K], zero-padded
+        # past kmax. The whole chain is ONE lax.scan program: what
+        # used to be kmax sequential small launches is a single
+        # dispatch per cycle (the flight recorder's
+        # spec_draft_dispatches proves it)
         kmax = max(spec.values())
-        for j in range(kmax):
-            # clamp keeps junk steps of non-speculating slots (and the
-            # n_spec < kmax tail) inside the dense pool's row bounds; a
-            # clamped write only touches a row that a real feed will
-            # rewrite before any mask can reach it
-            pj = np.minimum(pos_d + j, self._draft_max_len - 1)
-            self._draft_pool, prop, pr, self._key = step_d(
+        self._draft_pool, d_dev, q_dev, self._key = \
+            self._draft_scan_fn(kmax)(
                 self._draft_params, self._draft_buffers,
-                self._draft_pool, prop, pj, lo_d, sample_mask, temps,
-                self._key)
-            props.append(prop)
-            probs.append(pr)
-        d_dev = jnp.stack(props, axis=1)        # [S, kmax] device-side
-        q_dev = jnp.stack(probs, axis=1)        # [S, kmax, V]
+                self._draft_pool, feed0, pos_d, lo_d, sample_mask,
+                temps, self._key)
+        self._sched.note_spec_dispatches(1)
         if kmax < K:
             d_dev = jnp.pad(d_dev, ((0, 0), (0, K - kmax)))
             q_dev = jnp.pad(q_dev, ((0, 0), (0, K - kmax), (0, 0)))

@@ -11,13 +11,24 @@ reservoirs into mergeable bucketed histograms
 the fleet percentile exactly to bin width; averaging per-replica p95s
 gives a number that is simply wrong under skewed load).
 
-Dispatch here is deliberately the null policy — round-robin with
-spill-over on backpressure (a replica raising ``QueueFullError`` or a
-capacity error passes the request to the next; only when every replica
-refuses does the error propagate). The load-aware and affinity
-policies land on top of :meth:`stats`'s per-replica gauges in the
-router PR; nothing in this class assumes more than ``submit``/
-``stats``/``close``.
+Dispatch defaults to the null policy — round-robin with spill-over on
+backpressure (a replica raising ``QueueFullError`` or a capacity error
+passes the request to the next; only when every replica refuses does
+the error propagate). Two opt-in policies land on top of the same
+spill machinery (``route=``):
+
+* ``"load"`` — rank replicas by MOST FREE BLOCKS from the per-replica
+  health gauges (free slots as the dense fallback), unhealthy last,
+  round-robin rotation breaking ties so equal replicas still share
+  admissions;
+* ``"affinity"`` — the prompt's block-aligned prefix (the exact unit
+  the prefix-cache trie keys on) hashes to a PIN: the first admission
+  chooses by load and pins, every later prompt sharing that prefix
+  lands on the same replica — whose trie already holds the blocks — so
+  a hot system prompt stays a prefix-cache HIT instead of being
+  re-prefilled once per replica. Prompts shorter than one block, and
+  any pinned replica that refuses, fall back to load order (spill is
+  never sacrificed to affinity).
 
 A POISONED replica (scheduler thread dead, stats() raising) must not
 take the fleet's observability down with it: per-replica collection is
@@ -35,7 +46,9 @@ from __future__ import annotations
 import itertools
 import threading
 import weakref
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
 
 from ..framework import metrics as _metrics
 from ..framework.metrics import HistValue
@@ -66,11 +79,30 @@ _section_registered = False
 class EngineFleet:
     """Wrap N engines; aggregate their stats; spill submissions."""
 
-    def __init__(self, engines: Sequence[Any], name: Optional[str] = None):
+    #: dispatch policies (see module docstring); "rr" is the default
+    ROUTES = ("rr", "load", "affinity")
+
+    def __init__(self, engines: Sequence[Any], name: Optional[str] = None,
+                 *, route: str = "rr",
+                 affinity_block: Optional[int] = None):
         if not engines:
             raise ValueError("EngineFleet needs at least one engine")
+        if route not in self.ROUTES:
+            raise ValueError(
+                f"route must be one of {self.ROUTES}, got {route!r}")
+        if affinity_block is not None and int(affinity_block) < 1:
+            raise ValueError(
+                f"affinity_block must be >= 1, got {affinity_block}")
         self._engines = list(engines)
         self._name = name or f"fleet{next(_fleet_seq)}"
+        self._route = route
+        # affinity prefix granularity: explicit, else the replicas' own
+        # paged block_size (read lazily from stats), else one min-bucket
+        self._affinity_block = (int(affinity_block)
+                                if affinity_block is not None else None)
+        # prefix-hash -> replica index (host dict, lock-guarded); the
+        # pin is advisory — spill always wins over affinity
+        self._pins: Dict[int, int] = {}
         self._rr = itertools.cycle(range(len(self._engines)))
         self._lock = threading.Lock()
         self._closed = False
@@ -86,24 +118,91 @@ class EngineFleet:
         _metrics.register_collector(f"serving_fleet/{self._name}",
                                     _collect)
 
-    # -- dispatch (null policy) --------------------------------------------
-    def submit(self, prompt_ids, max_new_tokens: int = 32, **kwargs):
-        """Round-robin submit with spill-over: starting at the next
-        replica in rotation, offer the request to each in turn; a
-        replica refusing with backpressure/capacity (QueueFullError,
-        PoolCapacityError, a closed engine) passes it on. When every
-        replica refuses, the LAST error propagates. Returns the
-        accepted replica's handle (``handle.trace`` etc. unchanged)."""
-        if self._closed:
-            raise RuntimeError("EngineFleet is closed")
+    # -- dispatch ----------------------------------------------------------
+    def _rotation(self) -> List[int]:
+        """Round-robin visit order: the rotation start advances once
+        per submit, so equal replicas share admissions."""
         with self._lock:
             start = next(self._rr)
         n = len(self._engines)
+        return [(start + i) % n for i in range(n)]
+
+    def _load_order(self) -> List[int]:
+        """Rotation order re-ranked by load: healthy replicas first,
+        MOST free blocks first (free slots as the dense tie-breaker /
+        fallback), the round-robin rotation breaking exact ties — a
+        stable sort over the rotated list, so equally-loaded replicas
+        still take turns."""
+        reps = {r["replica"]: r for r in self._replica_stats()}
+
+        def rank(i):
+            r = reps[i]
+            if not r["healthy"]:
+                return (1, 0, 0)
+            blocks = r.get("num_blocks"), r.get("kv_blocks_in_use")
+            free_b = (blocks[0] - blocks[1]
+                      if None not in blocks else -1)
+            slots = r.get("num_slots"), r.get("slots_in_use")
+            free_s = (slots[0] - slots[1]
+                      if None not in slots else -1)
+            return (0, -free_b, -free_s)
+        return sorted(self._rotation(), key=rank)
+
+    def _prefix_pin_key(self, prompt_ids) -> Optional[int]:
+        """Affinity key: hash of the prompt's BLOCK-ALIGNED prefix —
+        the exact unit the paged prefix-cache trie keys on, so two
+        prompts share a pin iff they could share cached blocks. None
+        when the prompt doesn't cover one full block (nothing cacheable
+        to be affine to)."""
+        ids = np.asarray(prompt_ids, np.int32).reshape(-1)
+        bs = self._affinity_block
+        if bs is None:
+            for r in self._replica_stats():
+                if r["healthy"] and r.get("block_size"):
+                    bs = int(r["block_size"])
+                    break
+            else:
+                bs = 16
+            self._affinity_block = bs
+        m = (ids.size // bs) * bs
+        if m < bs:
+            return None
+        return hash(tuple(int(t) for t in ids[:m]))
+
+    def _submit_order(self, prompt_ids) -> Tuple[List[int], Optional[int]]:
+        """(replica visit order, affinity key to pin on success)."""
+        if self._route == "rr":
+            return self._rotation(), None
+        order = self._load_order()
+        if self._route == "load":
+            return order, None
+        key = self._prefix_pin_key(prompt_ids)
+        if key is None:
+            return order, None
+        with self._lock:
+            pinned = self._pins.get(key)
+        if pinned is not None and pinned in order:
+            order.remove(pinned)
+            order.insert(0, pinned)
+        return order, key
+
+    def submit(self, prompt_ids, max_new_tokens: int = 32, **kwargs):
+        """Routed submit with spill-over: replicas are visited in the
+        active policy's order (round-robin rotation, load rank, or
+        pinned-replica-first — see the class docstring); a replica
+        refusing with backpressure/capacity (QueueFullError,
+        PoolCapacityError, a closed engine) passes the request on.
+        When every replica refuses, the LAST error propagates. Returns
+        the accepted replica's handle (``handle.trace`` etc.
+        unchanged)."""
+        if self._closed:
+            raise RuntimeError("EngineFleet is closed")
+        order, key = self._submit_order(prompt_ids)
         last_err: Optional[BaseException] = None
-        for i in range(n):
-            eng = self._engines[(start + i) % n]
+        for i in order:
+            eng = self._engines[i]
             try:
-                return eng.submit(prompt_ids, max_new_tokens, **kwargs)
+                handle = eng.submit(prompt_ids, max_new_tokens, **kwargs)
             except (QueueFullError, PoolCapacityError,
                     PoolExhaustedError) as e:
                 last_err = e        # backpressure/capacity: try the next
@@ -113,6 +212,14 @@ class EngineFleet:
                 raise               # a malformed request fails everywhere
             except Exception as e:                       # noqa: BLE001
                 last_err = e        # closed/poisoned: try the next
+            else:
+                if key is not None:
+                    # pin follows the ACCEPTING replica: a spilled-over
+                    # hot prefix warms its new home's cache, so later
+                    # requests chase the blocks, not the original pin
+                    with self._lock:
+                        self._pins[key] = i
+                return handle
         assert last_err is not None
         raise last_err
 
@@ -195,6 +302,7 @@ class EngineFleet:
         healthy = [r for r in reps if r["healthy"]]
         agg: Dict[str, Any] = {
             "fleet": self._name,
+            "route": self._route,
             "replicas_total": len(reps),
             "replicas_healthy": len(healthy),
         }

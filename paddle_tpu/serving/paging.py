@@ -135,7 +135,7 @@ class PagedKVPool(SlotPoolBase):
     def __init__(self, num_layers: int, num_slots: int, num_heads: int,
                  max_len: int, head_dim: int, *, block_size: int = 16,
                  num_blocks: Optional[int] = None, dtype="float32",
-                 min_bucket: int = 8):
+                 min_bucket: int = 8, mesh=None, mp_axis: str = "mp"):
         import jax.numpy as jnp
 
         if num_slots < 1:
@@ -175,6 +175,22 @@ class PagedKVPool(SlotPoolBase):
         self.shape = (self.num_layers, 2, self.num_blocks + 1,
                       self.num_heads, self.block_size, self.head_dim)
         self.dtype = jnp.dtype(dtype)
+        # tensor-parallel pool: the block array is head-partitioned over
+        # a 1-D mp mesh ([.., H/mp, ..] per device) while every host
+        # structure below — page tables, free list, refcounts, prefix
+        # trie — stays replicated host-side, untouched by the mesh
+        self.mesh = mesh
+        self.mp_axis = str(mp_axis)
+        self.shards = 1 if mesh is None else int(mesh.shape[self.mp_axis])
+        if mesh is not None:
+            if self.num_heads % self.shards:
+                raise ValueError(
+                    f"num_heads={self.num_heads} not divisible by mesh "
+                    f"{self.mp_axis}={self.shards}")
+            if jnp.dtype(dtype).name in self._QUANT_QMAX:
+                raise ValueError(
+                    f"quantized KV blocks (dtype={dtype}) are not "
+                    f"supported on a tensor-parallel pool yet")
         # quantized block storage: per-block max-abs scales live in a
         # parallel [L, 2, num_blocks + 1, H] f32 array riding every
         # donated step beside the pool (gather steps multiply after the
@@ -187,7 +203,7 @@ class PagedKVPool(SlotPoolBase):
                              self.num_heads)
         self.scales = (jnp.zeros(self.scales_shape, jnp.float32)
                        if self.quantized else None)
-        self.data = jnp.zeros(self.shape, self.dtype)
+        self.data = self._alloc_data()
         # min-heap: deterministic lowest-id allocation at O(log n) —
         # unlike the base slot list (num_slots entries), num_blocks is
         # production-large and a min()+remove() scan per block would
@@ -208,6 +224,20 @@ class PagedKVPool(SlotPoolBase):
         self.tokens_saved = 0
         self.evictions = 0
 
+    def _alloc_data(self):
+        """Fresh zeroed block array — head-partitioned over the mesh's
+        ``mp`` axis when this is a tensor-parallel pool (each device
+        holds ``[L, 2, NB+1, H/mp, bs, Dh]``), a plain single-device
+        array otherwise."""
+        import jax
+        import jax.numpy as jnp
+        if self.mesh is None:
+            return jnp.zeros(self.shape, self.dtype)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        sh = NamedSharding(
+            self.mesh, P(None, None, None, self.mp_axis, None, None))
+        return jax.device_put(jnp.zeros(self.shape, self.dtype), sh)
+
     # -- request slots (decode batch axis: SlotPoolBase) -------------------
     def _slot_freed(self, st: _PagedSlot) -> None:
         """free() teardown: unref every block in the slot's page table.
@@ -227,7 +257,7 @@ class PagedKVPool(SlotPoolBase):
         if self._slots:
             raise RuntimeError(
                 "reset_data with live slots: fail and free them first")
-        self.data = jnp.zeros(self.shape, self.dtype)
+        self.data = self._alloc_data()
         if self.quantized:
             self.scales = jnp.zeros(self.scales_shape, jnp.float32)
         self._trie.clear()
@@ -264,8 +294,12 @@ class PagedKVPool(SlotPoolBase):
 
     @property
     def block_storage_bytes(self) -> int:
-        """Device bytes of the quantized-or-not block array alone."""
-        return int(np.prod(self.shape)) * self.dtype.itemsize
+        """PER-DEVICE bytes of the quantized-or-not block array alone —
+        a tensor-parallel pool holds ``1/mp`` of the heads on each
+        device, so the ledger (and every byte figure derived here)
+        bills what ONE chip actually stores."""
+        return int(np.prod(self.shape)) * self.dtype.itemsize \
+            // self.shards
 
     @property
     def scales_bytes(self) -> int:

@@ -490,6 +490,16 @@ class Scheduler:
             self._rec["decode_flops"] = \
                 self._rec.get("decode_flops", 0.0) + float(flops)
 
+    def note_spec_dispatches(self, n: int) -> None:
+        """Count the draft-proposal programs dispatched THIS cycle into
+        the live cycle record (called by the engine's spec step,
+        scheduler thread). The scanned proposal chain lands exactly 1
+        here where the unrolled loop dispatched spec_k launches — the
+        flight-recorder evidence for the one-dispatch-per-cycle win."""
+        if self._rec is not None:
+            self._rec["spec_draft_dispatches"] = \
+                self._rec.get("spec_draft_dispatches", 0) + int(n)
+
     def _fail_inflight(self, error: BaseException) -> None:
         for slot in list(self._slots):
             req = self._slots.pop(slot)

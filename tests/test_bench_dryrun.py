@@ -178,3 +178,15 @@ def test_dry_run_emits_metrics_summary():
     zc = out["zero"]
     assert zc["skipped"] is False, zc
     assert zc["opt_bytes"] < zc["replicated_opt_bytes"] / 2, zc
+
+    # ISSUE-15 tensor-parallel serving canary: on the mp=2 mesh (never
+    # skipped here — the conftest's 8 forced host devices reach the
+    # subprocess via env) the sharded paged engine generated greedy
+    # output token-identical to the single-device engine, and the
+    # per-device KV block bytes on the ledger are exactly 1/mp of the
+    # single-device pool
+    assert out["checks"]["mp_parity"] is True, out
+    assert out["checks"]["mp_kv_bytes_per_device"] is True, out
+    mc = out["mp"]
+    assert mc["skipped"] is False, mc
+    assert mc["kv_bytes_per_device"] * 2 == mc["single_device_kv_bytes"], mc

@@ -203,6 +203,131 @@ class TestDispatch:
             EngineFleet([])
 
 
+class TestRoutedDispatch:
+    """ISSUE-15 router upgrade: load-aware and prefix-affinity
+    dispatch behind the ``route=`` flag, round-robin untouched as the
+    default (every TestDispatch case above runs the default)."""
+
+    def test_bad_route_rejected(self):
+        with pytest.raises(ValueError):
+            EngineFleet([_StubEngine()], route="best-effort")
+
+    def test_default_is_round_robin(self):
+        f = EngineFleet([_StubEngine()])
+        assert f.stats()["route"] == "rr"
+        f.close()
+
+    def test_load_route_prefers_most_free_blocks(self):
+        # replica 1 has 7 of 8 blocks free vs replica 0's 2 of 8 —
+        # every admission must land on replica 1 (stub stats are
+        # static, so the imbalance never corrects)
+        crowded = _StubEngine(blocks=(6, 8))
+        free = _StubEngine(blocks=(1, 8))
+        f = EngineFleet([crowded, free], route="load")
+        for _ in range(4):
+            f.submit([1, 2, 3])
+        assert len(free.submitted) == 4
+        assert len(crowded.submitted) == 0
+        f.close()
+
+    def test_load_route_falls_back_to_free_slots(self):
+        # dense replicas (no block gauges): free SLOTS decide
+        busy = _StubEngine(slots=(4, 4))
+        idle = _StubEngine(slots=(0, 4))
+        f = EngineFleet([busy, idle], route="load")
+        for _ in range(3):
+            f.submit([1, 2])
+        assert len(idle.submitted) == 3 and len(busy.submitted) == 0
+        f.close()
+
+    def test_load_route_ties_rotate(self):
+        # equal load: the round-robin rotation must still share
+        # admissions (the stable-sort tie-break)
+        e1, e2 = _StubEngine(blocks=(2, 8)), _StubEngine(blocks=(2, 8))
+        f = EngineFleet([e1, e2], route="load")
+        for _ in range(4):
+            f.submit([1, 2, 3])
+        assert len(e1.submitted) == 2 and len(e2.submitted) == 2
+        f.close()
+
+    def test_load_route_unhealthy_ranks_last(self):
+        dead = _StubEngine(fail_stats=True, blocks=(0, 8))
+        alive = _StubEngine(blocks=(7, 8))       # nearly full but alive
+        f = EngineFleet([dead, alive], route="load")
+        f.submit([1, 2])
+        assert len(alive.submitted) == 1 and len(dead.submitted) == 0
+        f.close()
+
+    def test_affinity_pins_block_aligned_prefix(self):
+        # stub block_size is 8: prompts sharing the same 8-token
+        # aligned prefix must all land on ONE replica, even though
+        # round-robin would alternate them
+        e1, e2 = _StubEngine(blocks=(2, 8)), _StubEngine(blocks=(2, 8))
+        f = EngineFleet([e1, e2], route="affinity")
+        sys_prompt = list(range(1, 9))           # one full block
+        for tail in ([10], [11, 12], [13], [14, 15, 16]):
+            f.submit(sys_prompt + tail)
+        counts = sorted([len(e1.submitted), len(e2.submitted)])
+        assert counts == [0, 4], counts
+        f.close()
+
+    def test_affinity_distinct_prefixes_spread_by_load(self):
+        # two different hot prefixes: the first pin goes to the freest
+        # replica, whose load gauge (static stubs aside) would keep
+        # attracting — but a DIFFERENT prefix consults its own pin, so
+        # the mapping is per-prefix, not global
+        e1, e2 = _StubEngine(blocks=(2, 8)), _StubEngine(blocks=(2, 8))
+        f = EngineFleet([e1, e2], route="affinity")
+        a = list(range(1, 9))
+        b = list(range(20, 28))
+        for _ in range(2):
+            f.submit(a + [50])
+            f.submit(b + [60])
+        # each prefix sticks to exactly one replica across repeats
+        a_rep = [e for e in (e1, e2)
+                 if any(arr[0] == 1 for arr in e.submitted)]
+        b_rep = [e for e in (e1, e2)
+                 if any(arr[0] == 20 for arr in e.submitted)]
+        assert len(a_rep) == 1 and len(b_rep) == 1
+        f.close()
+
+    def test_affinity_short_prompt_falls_back(self):
+        # a prompt under one block has no cacheable prefix: routed by
+        # load, and NO pin is recorded for it
+        e1, e2 = _StubEngine(blocks=(6, 8)), _StubEngine(blocks=(1, 8))
+        f = EngineFleet([e1, e2], route="affinity")
+        f.submit([1, 2, 3])                      # 3 < block_size 8
+        assert len(e2.submitted) == 1            # load picked the freer
+        assert f._pins == {}
+        f.close()
+
+    def test_affinity_spills_and_repins_on_refusal(self):
+        # the pinned replica starts refusing: the request must still be
+        # served (spill wins over affinity) and the pin must FOLLOW the
+        # accepting replica, where the cache is now warming
+        e1, e2 = _StubEngine(blocks=(1, 8)), _StubEngine(blocks=(2, 8))
+        f = EngineFleet([e1, e2], route="affinity")
+        p = list(range(1, 9))
+        f.submit(p)                              # pins the freer: e1
+        assert len(e1.submitted) == 1
+        e1._refuse = QueueFullError("full")
+        f.submit(p)                              # spill to e2, re-pin
+        assert len(e2.submitted) == 1
+        e1._refuse = None
+        f.submit(p)                              # stays on e2
+        assert len(e2.submitted) == 2 and len(e1.submitted) == 1
+        f.close()
+
+    def test_affinity_explicit_block_override(self):
+        e1, e2 = _StubEngine(), _StubEngine()    # dense: no block_size
+        f = EngineFleet([e1, e2], route="affinity", affinity_block=4)
+        for _ in range(3):
+            f.submit([1, 2, 3, 4, 5])
+        counts = sorted([len(e1.submitted), len(e2.submitted)])
+        assert counts == [0, 3], counts
+        f.close()
+
+
 # ---------------------------------------------------------------------------
 # the real thing: two engines over one shared model (the concurrent-
 # compile storm the AotSite trace lock exists for), token parity, and

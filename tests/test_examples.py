@@ -60,6 +60,25 @@ def test_serve_gpt2_example_paged(tmp_path):
     assert "prefix hit ratio" in out         # stats() paged section
 
 
+def test_serve_gpt2_example_mp(tmp_path):
+    """--mp 2 routes through the TENSOR-PARALLEL engine
+    (GenerationEngine(mesh=)), not just sharded per-request
+    generation: the end-of-run report must carry the per-device pool
+    stats line with 1/mp of the KV bytes on each device."""
+    out = _run([os.path.join(REPO, "examples", "serve_gpt2.py"),
+                "--clients", "6", "--slots", "4", "--train-steps", "20",
+                "--mp", "2"],
+               tmp_path, timeout=600,
+               extra_env={"XLA_FLAGS":
+                          "--xla_force_host_platform_device_count=8"})
+    assert "served 6 requests" in out
+    assert "serving tensor-parallel over 2 device(s)" in out
+    assert "tensor-parallel: mp=2" in out
+    assert "per-device KV pool" in out
+    assert "1/2 of the single-device bytes" in out
+    assert "prefix hit ratio" in out         # --mp implies --paged
+
+
 def test_serve_gpt2_example_spec_int8(tmp_path):
     """--spec + --kv-dtype int8: speculative decoding over quantized
     KV blocks, with the accept-rate / tokens-per-cycle / block-capacity

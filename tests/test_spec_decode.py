@@ -163,6 +163,33 @@ class TestGreedyParity:
         assert stats["spec_tokens_per_cycle"] > 1.0
         assert stats["spec_accepted"] == stats["spec_proposed"] > 0
 
+    def test_draft_chain_is_one_dispatch_per_cycle(self, served_model,
+                                                   weak_draft):
+        """The draft proposal loop is FUSED into one ``lax.scan``
+        program (ISSUE-15 satellite): every spec cycle in the flight
+        recorder carries exactly ONE draft dispatch where the unrolled
+        loop launched spec_k of them — and the fused chain still
+        matches ``generate`` token-for-token through a weak draft's
+        real rejections."""
+        rng = np.random.RandomState(12)
+        prompts = [_prompt(rng, n) for n in (4, 8, 13)]
+        refs = [generate(served_model, p[None, :],
+                         max_new_tokens=10).numpy()[0] for p in prompts]
+        eng = GenerationEngine(
+            served_model, num_slots=4, max_len=48, kv_layout="paged",
+            block_size=8, attention="fused", spec_draft=weak_draft,
+            spec_k=4, prefill_budget=16)
+        hs = [eng.submit(p, max_new_tokens=10) for p in prompts]
+        outs = [h.result(timeout=600) for h in hs]
+        cycles = eng.flight_recorder.snapshot()["cycles"]
+        eng.close()
+        for ref, out in zip(refs, outs):
+            np.testing.assert_array_equal(out, ref)
+        disp = [c["spec_draft_dispatches"] for c in cycles
+                if "spec_draft_dispatches" in c]
+        assert disp, "no spec draft dispatches recorded"
+        assert all(d == 1 for d in disp), disp
+
     def test_spec_with_int8_blocks(self, served_model):
         """The two tentpole halves compose: speculative verify over a
         QUANTIZED pool (block_size 32 — the int8 kernel tile floor)
