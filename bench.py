@@ -1191,9 +1191,20 @@ def _serve_load_engine(kind, model, schedule, slo_ms, num_slots=8):
     per-engine stats()/flight-recorder view and the zero-retrace check
     (every serving trace-probe site of THIS engine compiled exactly
     once — a retrace storm under load is the bug class the pow2 bucket
-    discipline exists to prevent)."""
+    discipline exists to prevent).
+
+    The run also exercises the SLO plane end to end over the WIRE: an
+    SLOTracker observes every retired trace, an OpsServer serves the
+    registry on an ephemeral port, and the attainment recomputed from
+    the HTTP-scraped histogram buckets must bracket the in-process
+    value within one bucket of resolution (the acceptance gate)."""
+    import urllib.request
+
     from paddle_tpu.framework import trace_probe
-    from paddle_tpu.serving import GenerationEngine
+    from paddle_tpu.framework.metrics import parse_prometheus
+    from paddle_tpu.serving import (GenerationEngine, OpsServer,
+                                    SLOTracker)
+    from paddle_tpu.serving.slo import attainment_from_buckets
 
     import numpy as np
 
@@ -1211,9 +1222,42 @@ def _serve_load_engine(kind, model, schedule, slo_ms, num_slots=8):
     for plen, mnew in warm:
         eng.submit(np.full(plen, 1, np.int32),
                    max_new_tokens=mnew).result(timeout=600)
+    # SLO plane attached AFTER warm-up, so the objectives score only
+    # the measured traffic (warm TTFTs contain XLA compile time)
+    obj_name = f"ttft_{kind}"
+    slo = SLOTracker(name=f"serve_load_{kind}")
+    slo.add_objective(obj_name, metric="ttft_ms", target_ms=slo_ms,
+                      goal=0.95)
+    replica = slo.attach_engine(eng)
+    srv = OpsServer(target=eng, slo=slo).start()
     summary, _ = _run_serve_load(eng, schedule, slo_ms)
+    # scrape over real HTTP while the engine is live, then close the
+    # equivalence loop: exact in-process attainment must lie inside the
+    # bucket-resolution bracket recomputed from the scraped histogram
+    text = urllib.request.urlopen(
+        srv.url + "/metrics", timeout=60).read().decode()
+    healthz_ok = urllib.request.urlopen(
+        srv.url + "/healthz", timeout=60).getcode() == 200
+    parsed = parse_prometheus(text)
+    pairs = []
+    for (name, labels), v in parsed["samples"].items():
+        lab = dict(labels)
+        if name == "slo_latency_ms_bucket" \
+                and lab.get("objective") == obj_name:
+            le = lab.get("le", "")
+            pairs.append((float("inf") if le == "+Inf" else float(le),
+                          v))
+    att_lo, att_hi = attainment_from_buckets(pairs, slo_ms)
+    slo_rep = slo.report()["objectives"][obj_name]
+    att = slo_rep["attainment"]
+    scrape_equiv = (att is not None and att_lo is not None
+                    and att_lo - 1e-9 <= att <= att_hi + 1e-9)
+    goodput_http = parsed["samples"].get(
+        ("goodput_rps", (("engine", replica),)))
     stats = eng.stats()
     recorder = eng.dump_flight_recorder()
+    srv.close()
+    slo.close()
     eng.close()
     sites = {k: v for k, v in trace_probe.snapshot().items()
              if k.startswith("serving/")
@@ -1234,6 +1278,24 @@ def _serve_load_engine(kind, model, schedule, slo_ms, num_slots=8):
     # republished here because its reservoirs also hold the warm-up
     # requests (whose TTFT contains XLA compile time)
     summary["flight_recorder_cycles"] = recorder["cycles_recorded"]
+    # the HTTP-measured SLO surface: attainment recomputed from scraped
+    # buckets (upper edge of the bracket) + the scraped goodput gauge —
+    # these land in the artifact so --history gates the WIRE path, not
+    # just the in-process arithmetic
+    summary["slo_attainment_http"] = \
+        round(att_hi, 4) if att_hi is not None else None
+    summary["goodput_rps_http"] = \
+        round(goodput_http, 2) if goodput_http is not None else None
+    summary["slo"] = {
+        "objective": obj_name,
+        "attainment": att,
+        "attainment_http_bracket": [att_lo, att_hi],
+        "scrape_equiv": scrape_equiv,
+        "healthz_ok": healthz_ok,
+        "burn_rate": slo_rep["burn_rate"],
+        "observed": slo_rep["total"],
+        "violations": stats.get("slo_violations"),
+    }
     if kind == "paged":
         summary["prefix_hits"] = stats["prefix_hits"]
         summary["prefix_hit_ratio"] = round(stats["prefix_hit_ratio"], 4)
@@ -1300,6 +1362,7 @@ def serve_load():
     print(json.dumps(out), flush=True)
     ok = all(e["completed"] + e["shed"] == e["requests"]
              and e["failed"] == 0 and e["zero_decode_retraces"]
+             and e["slo"]["scrape_equiv"] and e["slo"]["healthz_ok"]
              for e in out["engines"].values())
     sys.exit(0 if ok else 1)
 
@@ -1401,7 +1464,9 @@ def _flatten_bench_doc(doc):
             if not isinstance(e, dict):
                 continue
             for key, unit in (("goodput_rps", "req/s"),
-                              ("slo_attainment", "ratio")):
+                              ("slo_attainment", "ratio"),
+                              ("goodput_rps_http", "req/s"),
+                              ("slo_attainment_http", "ratio")):
                 if isinstance(e.get(key), (int, float)):
                     out[f"{kind}.{key}"] = {
                         "value": float(e[key]), "unit": unit,
@@ -2190,9 +2255,14 @@ def dry_run():
         # the serving/tpot_ms histogram is live, the flight recorder's
         # rings are non-empty and the engine's decode never retraced.
         def _serve_load_canary():
+            import urllib.error
+            import urllib.request
+
             from paddle_tpu.framework import trace_probe
+            from paddle_tpu.framework.metrics import parse_prometheus
             from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining
-            from paddle_tpu.serving import GenerationEngine
+            from paddle_tpu.serving import (GenerationEngine, OpsServer,
+                                            SLOTracker)
 
             paddle.framework.random.seed(0)
             cfg = GPTConfig.tiny()
@@ -2203,13 +2273,43 @@ def dry_run():
                                       system=system, vocab=cfg.vocab_size)
             eng = GenerationEngine(m, num_slots=4, max_len=64,
                                    min_bucket=8)
+            # ops-surface canary (PR 16): the SLO tracker observes the
+            # canary traffic, the zero-dependency HTTP server boots on
+            # an ephemeral port and serves a live scrape + health
+            slo = SLOTracker(name="dryrun_slo")
+            slo.add_objective("ttft_canary", metric="ttft_ms",
+                              target_ms=60_000.0, goal=0.95)
+            slo.attach_engine(eng)
+            srv = OpsServer(target=eng, slo=slo).start()
             # CPU-scale SLO: the canary asserts the measurement works,
             # not that an untuned CPU backend meets a production SLO
             summary, handles = _run_serve_load(eng, schedule,
                                                slo_ms=60_000.0)
+            prom_text = urllib.request.urlopen(
+                srv.url + "/metrics", timeout=30).read().decode()
+            prom_samples = parse_prometheus(prom_text)["samples"]
+            slo_live = any(n == "slo_attainment"
+                           for n, _labels in prom_samples)
+            healthz_live = urllib.request.urlopen(
+                srv.url + "/healthz", timeout=30).status == 200
+            tracez = json.loads(urllib.request.urlopen(
+                srv.url + "/tracez", timeout=30).read().decode())
+            tail = next(iter(tracez["engines"].values()))
+            tracez_ok = (len(tail["recent"]) == len(schedule)
+                         and tracez["slo"]["objectives"]
+                         ["ttft_canary"]["total"] == len(schedule))
             recorder = eng.dump_flight_recorder()
             stats = eng.stats()
             eng.close()
+            # a closed engine flips /healthz to 503 while the server
+            # itself (and /statusz) stays up
+            try:
+                urllib.request.urlopen(srv.url + "/healthz", timeout=30)
+                healthz_flips = False
+            except urllib.error.HTTPError as e:
+                healthz_flips = e.code == 503
+            srv.close()
+            slo.close()
             sites = {k: v for k, v in trace_probe.snapshot().items()
                      if k.startswith("serving/")
                      and k.endswith(f"#{eng._eid}")}
@@ -2237,6 +2337,13 @@ def dry_run():
                 "zero_retraces": bool(sites) and all(
                     s["traces"] == 1 and not s["causes"]
                     for s in sites.values()),
+                # PR-16 ops surface: live scrape over HTTP carried the
+                # SLO series, health answered 200 then flipped 503 on
+                # close, tracez served the tail-sampled traces
+                "ops_scrape": len(prom_samples) > 0 and slo_live,
+                "ops_healthz": healthz_live and healthz_flips,
+                "ops_tracez": tracez_ok,
+                "ops_goodput": (stats.get("goodput_rps") or 0) > 0,
             }
 
         serve_load_canary = _serve_load_canary()
@@ -2666,6 +2773,15 @@ def dry_run():
         "serve_load_flight_recorder":
             serve_load_canary["flight_recorder_nonempty"],
         "serve_load_zero_retraces": serve_load_canary["zero_retraces"],
+        # PR-16 SLO plane: the ops HTTP server booted on an ephemeral
+        # port and served a live Prometheus scrape carrying the SLO
+        # series, /healthz answered 200 live and flipped 503 once the
+        # engine closed, /tracez served the tail-sampled traces + SLO
+        # report, and the engine published SLO-gated goodput
+        "ops_server_scrape": serve_load_canary["ops_scrape"],
+        "ops_server_healthz": serve_load_canary["ops_healthz"],
+        "ops_server_tracez": serve_load_canary["ops_tracez"],
+        "ops_server_goodput": serve_load_canary["ops_goodput"],
         # ISSUE-7 compute/memory observability: every owned jit site
         # registered its compile (compile/ms histogram + compile/count
         # counter live), the train step's cost analysis produced

@@ -25,6 +25,15 @@ Python cannot enforce (≙ the reference's tools/codestyle custom checks
   form) and ``.numpy()`` anywhere in the package are a per-step device
   stall. The single argued exception is the windowed token fetch
   (``serving/scheduler.py _fetch``), which carries the suppression.
+* ``ops-handler-sync`` — the ops HTTP surface (``serving/opsserver.py``)
+  and the SLO plane (``serving/slo.py``) are scrape-only BY CONTRACT:
+  handlers serve collector samples, host rings and host counters, and
+  must never touch the device or block on the scheduler. On top of the
+  ``serving-host-sync`` walk (which already covers both files as part
+  of the package), this rule bans ANY ``jax.*``/``jnp.*`` call and the
+  scheduler-blocking reads ``.result()``/``.item()`` there — a scrape
+  that blocks on a stuck scheduler turns the monitoring plane into a
+  second victim of the outage it exists to observe.
 * ``memory-stats-hot-path`` — ``memory_stats()`` polling (a PjRt query
   per call) stays OFF the scheduler hot path: inside ``serving/`` the
   memory timeline is fed by host-only ``profiler.memory.mark()``
@@ -306,6 +315,9 @@ def lint_source(path: str, source: str, relpath: str) -> List[LintFinding]:
     # the serving PACKAGE only — inference/serving.py (the gather-and-run
     # batcher) blocks its callers by design and is not in scope
     in_serving = rel.startswith("serving/")
+    # the scrape-only ops surface: HTTP handlers + the SLO plane
+    in_ops_surface = rel.endswith("serving/opsserver.py") \
+        or rel.endswith("serving/slo.py")
     # Pallas kernels live in ops/ — BlockSpec tiling is checked there
     in_ops = rel.startswith("ops/")
     # the numerics audit module: host-pure over numpy BY CONTRACT
@@ -354,6 +366,30 @@ def lint_source(path: str, source: str, relpath: str) -> List[LintFinding]:
                     f"batching decode loop must stay async — route "
                     f"device reads through the single windowed fetch "
                     f"(serving/scheduler.py _fetch)"))
+        # rule: ops-handler-sync (the scrape-only ops surface: no
+        # device work, no scheduler-blocking reads — a monitoring
+        # plane that blocks on what it monitors goes down with it)
+        if in_ops_surface and isinstance(node, ast.Call):
+            f = node.func
+            bad = None
+            if isinstance(f, ast.Attribute) \
+                    and isinstance(f.value, ast.Name) \
+                    and f.value.id in ("jax", "jnp"):
+                bad = f"{f.value.id}.{f.attr}"
+            elif isinstance(f, ast.Attribute) \
+                    and f.attr in ("result", "item", "block_until_ready",
+                                   "numpy", "device_get"):
+                bad = f".{f.attr}()"
+            elif isinstance(f, ast.Name) and f.id == "device_get":
+                bad = "device_get"
+            if bad and not _suppressed(lines, node.lineno):
+                findings.append(LintFinding(
+                    "ops-handler-sync", path, node.lineno,
+                    f"{bad} on the ops HTTP surface: handlers are "
+                    f"scrape-only — no device fetches, no "
+                    f"block_until_ready, no scheduler-blocking "
+                    f"result()/item(); serve collector samples and "
+                    f"host rings instead"))
         # rule: numerics-host-sync (the numerics audit module never
         # syncs — fetches belong to fit's flush window)
         if in_numerics and isinstance(node, ast.Call):

@@ -152,6 +152,12 @@ define_flag("FLAGS_hapi_prefetch", True,
             "Route Model.fit/evaluate input through io.device_prefetch "
             "(background H2D overlapping compute); the escape hatch for "
             "iterables that must not be read ahead of consumption")
+define_flag("FLAGS_flight_dump_dir", "",
+            "Directory for serving FlightRecorder.auto_dump postmortem "
+            "files (created on first dump). Empty falls back to the "
+            "system tempdir — ops point this at persistent storage so a "
+            "3am poisoned-cycle dump survives the node. Env-seeded: "
+            "FLAGS_flight_dump_dir=/var/log/paddle")
 define_flag("FLAGS_cudnn_deterministic", False, "Parity flag")
 define_flag("FLAGS_embedding_deterministic", False, "Parity flag")
 define_flag("FLAGS_conv_workspace_size_limit", 512, "Parity flag (MB)")

@@ -607,10 +607,26 @@ class MetricsRegistry:
 # the text-format parser (round-trip tests + fleet gates)
 # ---------------------------------------------------------------------------
 
+# the labels group must tolerate '}' INSIDE a quoted label value
+# ({v="a}b"}), so it matches quoted strings as units instead of
+# stopping at the first closing brace
 _SAMPLE_RE = re.compile(
     r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
-    r"(?:\{(?P<labels>[^}]*)\})?\s+(?P<value>\S+)\s*$")
+    r"(?:\{(?P<labels>(?:[^\"}]|\"(?:[^\"\\]|\\.)*\")*)\})?"
+    r"\s+(?P<value>\S+)\s*$")
 _LABEL_PAIR_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+_UNESCAPE_RE = re.compile(r"\\(.)")
+_UNESCAPE_MAP = {"\\": "\\", '"': '"', "n": "\n"}
+
+
+def _unescape_label(v: str) -> str:
+    """Single left-to-right pass over escape sequences. Sequential
+    ``str.replace`` chains are order-sensitive and wrong: the value
+    backslash+'n' (two chars) exports as ``\\\\n`` (three chars), which
+    a ``.replace("\\\\n", newline)`` pass would corrupt into
+    backslash+newline instead of restoring backslash+'n'."""
+    return _UNESCAPE_RE.sub(
+        lambda m: _UNESCAPE_MAP.get(m.group(1), "\\" + m.group(1)), v)
 
 
 def parse_prometheus(text: str) -> Dict[str, Any]:
@@ -637,9 +653,7 @@ def parse_prometheus(text: str) -> Dict[str, Any]:
             raise ValueError(f"unparseable exposition line: {line!r}")
         labels = []
         for k, v in _LABEL_PAIR_RE.findall(m.group("labels") or ""):
-            v = v.replace('\\"', '"').replace("\\n", "\n") \
-                 .replace("\\\\", "\\")
-            labels.append((k, v))
+            labels.append((k, _unescape_label(v)))
         raw = m.group("value")
         if raw == "+Inf":
             val = math.inf

@@ -55,8 +55,11 @@ LRU eviction), :mod:`.scheduler` (admission queue, backpressure,
 prefill-budget policy, block-pressure preemption, the decode loop),
 :mod:`.tracing` (per-request lifecycle traces + chrome-trace lanes),
 :mod:`.flight_recorder` (bounded postmortem rings + per-engine latency
-reservoirs), :mod:`.engine` (the thread-safe user surface +
-monitor/profiler/analysis wiring).
+reservoirs + tail-sampled traces), :mod:`.engine` (the thread-safe
+user surface + monitor/profiler/analysis wiring), :mod:`.slo` (SLO
+objectives, multi-window burn rates, per-replica goodput),
+:mod:`.opsserver` (the zero-dependency HTTP ops surface: /metrics,
+/statusz, /varz, /healthz, /readyz, /tracez, /timeline).
 """
 from __future__ import annotations
 
@@ -64,14 +67,18 @@ from .engine import GenerationEngine  # noqa: F401
 from .fleet import EngineFleet  # noqa: F401
 from .flight_recorder import FlightRecorder  # noqa: F401
 from .kv_pool import KVCachePool  # noqa: F401
+from .opsserver import OpsServer  # noqa: F401
 from .paging import (BlockError, PagedKVPool,  # noqa: F401
                      PoolCapacityError, PoolExhaustedError)
 from .scheduler import (DeadlineExceeded, GenerationRequest,  # noqa: F401
                         QueueFullError, RequestCancelled, Scheduler)
+from .slo import SLOObjective, SLOTracker  # noqa: F401
+from .slo import attainment_from_buckets  # noqa: F401
 from .tracing import RequestTrace  # noqa: F401
 
 __all__ = ["GenerationEngine", "EngineFleet", "KVCachePool",
            "PagedKVPool", "GenerationRequest", "Scheduler",
            "QueueFullError", "DeadlineExceeded", "RequestCancelled",
            "PoolCapacityError", "PoolExhaustedError", "BlockError",
-           "RequestTrace", "FlightRecorder"]
+           "RequestTrace", "FlightRecorder", "OpsServer",
+           "SLOTracker", "SLOObjective", "attainment_from_buckets"]

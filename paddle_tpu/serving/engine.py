@@ -556,6 +556,14 @@ class GenerationEngine:
         # process, so two engines (or back-to-back tests) would
         # contaminate each other's figures there
         s.update(self._sched.recorder.latency_summary())
+        # SLO plane: once a tracker (or caller) armed a tail SLO on the
+        # recorder, the per-replica goodput rate is part of the
+        # operator snapshot — the fleet sums it, the autoscaler reads it
+        rec = self._sched.recorder
+        if rec.tail_slo_ms is not None:
+            g = rec.goodput()
+            s["goodput_rps"] = g["goodput_rps"]
+            s["slo_violations"] = rec.slo_violations
         s.update(self._compute_stats())
         # KV memory, from the HBM ledger (profiler/memory.py — the pool
         # publishes capacity + in-use bytes there on every alloc/free)

@@ -84,7 +84,8 @@ class EngineFleet:
 
     def __init__(self, engines: Sequence[Any], name: Optional[str] = None,
                  *, route: str = "rr",
-                 affinity_block: Optional[int] = None):
+                 affinity_block: Optional[int] = None,
+                 slo: Optional[Any] = None):
         if not engines:
             raise ValueError("EngineFleet needs at least one engine")
         if route not in self.ROUTES:
@@ -106,6 +107,11 @@ class EngineFleet:
         self._rr = itertools.cycle(range(len(self._engines)))
         self._lock = threading.Lock()
         self._closed = False
+        # SLO plane (serving/slo.py): an attached tracker hooks every
+        # replica's flight recorder and its report rides stats()
+        self._slo = None
+        if slo is not None:
+            self.attach_slo(slo)
         _LIVE_FLEETS.add(self)
         _register_fleet_telemetry()
         # scrape-time collector: per-replica gauges under the fleet
@@ -117,6 +123,17 @@ class EngineFleet:
             return f._metric_samples() if f is not None else ()
         _metrics.register_collector(f"serving_fleet/{self._name}",
                                     _collect)
+
+    def attach_slo(self, tracker) -> None:
+        """Attach an :class:`~.slo.SLOTracker`: every replica's retired
+        traces feed its objectives (replica keys = fleet indices) and
+        ``stats()`` gains the ``slo`` report + per-replica goodput."""
+        tracker.attach_fleet(self)
+        self._slo = tracker
+
+    @property
+    def slo(self):
+        return self._slo
 
     # -- dispatch ----------------------------------------------------------
     def _rotation(self) -> List[int]:
@@ -325,6 +342,18 @@ class EngineFleet:
             agg["spec_accept_rate"] = \
                 agg.get("spec_accepted", 0) / agg["spec_proposed"]
         agg.update(self._pooled_latency())
+        # SLO plane: exact attainment + burn rates + per-replica
+        # goodput, fault-isolated like everything else on this surface
+        goodput: Dict[str, float] = {}
+        if self._slo is not None:
+            try:
+                rep = self._slo.report()
+                agg["slo"] = rep
+                goodput = rep.get("goodput_rps") or {}
+                if goodput:
+                    agg["goodput_rps"] = float(sum(goodput.values()))
+            except Exception as e:                       # noqa: BLE001
+                agg["slo"] = {"error": repr(e)}
         # per-replica view: identity + the load/health gauges a router
         # dispatches on, straight from each replica's own stats
         agg["replicas"] = [{
@@ -343,6 +372,7 @@ class EngineFleet:
             and r.get("kv_blocks_in_use") is not None else None,
             "kv_bytes_in_use": r.get("kv_bytes_in_use"),
             "prefix_hit_ratio": r.get("prefix_hit_ratio"),
+            "goodput_rps": goodput.get(str(r["replica"])),
         } for r in reps]
         return agg
 
@@ -389,6 +419,17 @@ def _fleet_section() -> str:
         if ttft:
             head += f", ttft p50 {ttft['p50']:.1f} ms"
         lines.append(head)
+        slo = s.get("slo") or {}
+        for oname, o in sorted((slo.get("objectives") or {}).items()):
+            att = o.get("attainment")
+            burns = o.get("burn_rate") or {}
+            burn_txt = " ".join(f"burn[{w}]={b:.2f}"
+                                for w, b in sorted(burns.items()))
+            lines.append(
+                f"  slo {oname}: {o['metric']} <= {o['target_ms']:g}ms "
+                f"goal {o['goal']:.2%} attainment "
+                + (f"{att:.2%}" if att is not None else "n/a")
+                + (f" {burn_txt}" if burn_txt else ""))
         for r in s["replicas"]:
             mark = "ok " if r["healthy"] else "DOWN"
             lines.append(

@@ -21,23 +21,38 @@ It also aggregates per-engine latency samples: every retired
 engines in one process (or back-to-back tests) never contaminate each
 other the way the process-global monitor histograms do.
 
+**Tail sampling** (the SLO plane's postmortem half): averages hide the
+outliers that blow an SLO, so past the normal rings the recorder keeps
+FULL trace snapshots for three populations — the slowest-N requests by
+TTFT, every request that violated the armed SLO
+(:meth:`set_tail_slo`), and a short recent-trace ring for context.
+``tail_traces()`` serves them to ``/tracez``. Retire hooks
+(:meth:`add_retire_hook`) let the :class:`~.slo.SLOTracker` observe
+every retired trace without the scheduler knowing it exists, and a
+bounded retire-stamp ring powers the windowed :meth:`goodput` rate the
+elastic-fleet signals consume.
+
 ``engine.dump_flight_recorder()`` snapshots everything on demand; the
 scheduler's step-failure path calls :meth:`auto_dump` so a poisoned
-cycle leaves a postmortem file behind even when nobody was watching.
+cycle leaves a postmortem file behind even when nobody was watching
+(``FLAGS_flight_dump_dir`` points those dumps at persistent storage).
 
 Threading: written by the scheduler thread, read by any (stats / dump)
 — every method takes the one small lock; writes are per-cycle, not
-per-token, so contention is negligible.
+per-token, so contention is negligible. Retire hooks run on the
+scheduler thread OUTSIDE the recorder lock (a hook may read this
+recorder back).
 """
 from __future__ import annotations
 
+import heapq
 import json
 import os
 import tempfile
 import threading
 import time
 from collections import deque
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 from ..framework.monitor import _percentile
 
@@ -49,7 +64,8 @@ class FlightRecorder:
     per-engine TTFT/TPOT sample reservoirs."""
 
     def __init__(self, max_cycles: int = 256, max_events: int = 2048,
-                 max_samples: int = 4096):
+                 max_samples: int = 4096, tail_keep: int = 8,
+                 recent_traces: int = 16):
         if max_cycles < 1 or max_events < 1:
             raise ValueError("flight recorder rings must hold >= 1 entry")
         self._lock = threading.Lock()
@@ -62,6 +78,37 @@ class FlightRecorder:
         self.retired = 0
         self.last_dump_path: Optional[str] = None
         self.dumps = 0
+        # tail sampling: slowest-N (min-heap keyed by TTFT so the heap
+        # root is the cheapest entry to evict), SLO violations, and a
+        # recent ring for context; all hold JSON trace snapshots, not
+        # live RequestTrace objects, so /tracez serialization is safe
+        # off the scheduler thread
+        self._tail_keep = max(1, int(tail_keep))
+        self._tail_slow: List[tuple] = []           # (ttft, seq, snapshot)
+        self._tail_seq = 0
+        self._tail_violations: deque = deque(maxlen=self._tail_keep * 4)
+        self._recent: deque = deque(maxlen=int(recent_traces))
+        self.tail_slo_ms: Optional[float] = None
+        self.slo_violations = 0                     # monotonic
+        # (t_retired, ttft_ms) stamps for windowed goodput; bounded
+        self._retire_stamps: deque = deque(maxlen=int(max_samples))
+        self._retire_hooks: List[Callable[[Any], None]] = []
+
+    # -- SLO plane wiring ---------------------------------------------------
+    def set_tail_slo(self, slo_ms: Optional[float]) -> None:
+        """Arm (or disarm with None) the TTFT SLO that decides which
+        retiring traces are tail-sampled as violations and which count
+        as "good" in :meth:`goodput`."""
+        with self._lock:
+            self.tail_slo_ms = float(slo_ms) if slo_ms is not None else None
+
+    def add_retire_hook(self, fn: Callable[[Any], None]) -> None:
+        """``fn(trace)`` runs on the scheduler thread after every
+        retire, outside the recorder lock; a raising hook is dropped
+        from that call only (the scheduler must never die for an
+        observer)."""
+        with self._lock:
+            self._retire_hooks.append(fn)
 
     # -- writers (scheduler thread) ----------------------------------------
     def record_cycle(self, rec: Dict[str, Any]) -> None:
@@ -82,14 +129,43 @@ class FlightRecorder:
 
     def retire(self, trace) -> None:
         """A request finished: bank its derived latencies so stats()
-        percentiles come from this engine's own traffic."""
+        percentiles come from this engine's own traffic, tail-sample
+        the trace, and fan out to the registered retire hooks."""
         ttft, tpot = trace.ttft_ms, trace.tpot_ms
+        now = time.perf_counter()
+        snap = None
+        try:
+            snap = trace.snapshot()
+        except Exception:                                # noqa: BLE001
+            pass        # a malformed trace must not kill the scheduler
         with self._lock:
             self.retired += 1
             if ttft is not None:
                 self._ttft.append(ttft)
             if tpot is not None:
                 self._tpot.append(tpot)
+            self._retire_stamps.append((now, ttft))
+            if snap is not None:
+                self._recent.append(snap)
+                violated = (self.tail_slo_ms is not None
+                            and ttft is not None
+                            and ttft > self.tail_slo_ms)
+                if violated:
+                    self.slo_violations += 1
+                    self._tail_violations.append(
+                        dict(snap, tail="slo_violation"))
+                if ttft is not None:
+                    self._tail_seq += 1
+                    heapq.heappush(self._tail_slow,
+                                   (ttft, self._tail_seq, snap))
+                    if len(self._tail_slow) > self._tail_keep:
+                        heapq.heappop(self._tail_slow)   # evict fastest
+            hooks = list(self._retire_hooks)
+        for fn in hooks:
+            try:
+                fn(trace)
+            except Exception:                            # noqa: BLE001
+                pass
 
     # -- readers -----------------------------------------------------------
     def latency_samples(self) -> Dict[str, List[float]]:
@@ -118,6 +194,49 @@ class FlightRecorder:
                     "p99": _percentile(s, 0.99)}
 
         return {"ttft_ms": pct(ttft), "tpot_ms": pct(tpot)}
+
+    def tail_traces(self) -> Dict[str, Any]:
+        """The tail-sampled populations for ``/tracez``: slowest-N by
+        TTFT (slowest first), SLO-violating traces, and the recent ring
+        — full JSON trace snapshots, outliers the percentiles hide."""
+        with self._lock:
+            slowest = [dict(s, tail="slowest")
+                       for _, _, s in sorted(self._tail_slow,
+                                             key=lambda e: -e[0])]
+            violations = [dict(v) for v in self._tail_violations]
+            recent = [dict(r) for r in self._recent]
+            return {"tail_slo_ms": self.tail_slo_ms,
+                    "slo_violations_total": self.slo_violations,
+                    "slowest": slowest,
+                    "slo_violations": violations,
+                    "recent": recent}
+
+    def goodput(self, window_s: float = 60.0,
+                slo_ms: Optional[float] = None) -> Dict[str, Any]:
+        """SLO-meeting completions per second over the trailing window:
+        a retired request counts as good when its TTFT met the SLO
+        (armed via :meth:`set_tail_slo` or passed here). The divisor is
+        the window, clipped to the observed span when the engine is
+        younger than the window — a 10s-old engine must not report a
+        60x-diluted rate."""
+        now = time.perf_counter()
+        with self._lock:
+            stamps = list(self._retire_stamps)
+            slo = slo_ms if slo_ms is not None else self.tail_slo_ms
+        in_window = [(t, v) for t, v in stamps if now - t <= window_s]
+        if not in_window:
+            return {"window_s": window_s, "total": 0, "good": 0,
+                    "goodput_rps": 0.0}
+        total = len(in_window)
+        good = sum(1 for _, v in in_window
+                   if v is not None and (slo is None or v <= slo))
+        # fully covered window: oldest surviving stamp predates it
+        if stamps[0][0] <= now - window_s:
+            span = window_s
+        else:
+            span = max(1e-3, now - in_window[0][0])
+        return {"window_s": window_s, "total": total, "good": good,
+                "goodput_rps": good / span}
 
     def cycle_throughput(self) -> Dict[str, float]:
         """Decode throughput over the cycle ring: cycles recorded in the
@@ -166,6 +285,10 @@ class FlightRecorder:
                 "requests_retired": self.retired,
                 "ring_capacity": {"cycles": self._cycles.maxlen,
                                   "events": self._events.maxlen},
+                "tail": {"slo_ms": self.tail_slo_ms,
+                         "slowest": len(self._tail_slow),
+                         "slo_violations": self.slo_violations,
+                         "recent": len(self._recent)},
             }
 
     # -- dumps -------------------------------------------------------------
@@ -175,6 +298,7 @@ class FlightRecorder:
         ``path`` as JSON when given. Returns the document."""
         doc = self.snapshot()
         doc["latency"] = self.latency_summary()
+        doc["tail_traces"] = self.tail_traces()
         if extra:
             doc.update(extra)
         if path:
@@ -193,12 +317,27 @@ class FlightRecorder:
         two poisoned cycles in quick succession are exactly the case a
         postmortem exists for, and without the suffix the second dump
         OVERWRITES the first — the origin cycle's evidence — at the
-        pid+recorder path."""
+        pid+recorder path.
+
+        The directory honors ``FLAGS_flight_dump_dir`` (env var wins
+        over the flag registry so ops can redirect a running deployment
+        without code) and is created on demand; empty falls back to the
+        system tempdir."""
         try:
+            d = os.environ.get("FLAGS_flight_dump_dir", "").strip()
+            if not d:
+                try:
+                    from ..framework import flags as _flags
+                    d = str(_flags.flag_value(
+                        "FLAGS_flight_dump_dir") or "").strip()
+                except Exception:                        # noqa: BLE001
+                    d = ""
+            d = d or tempfile.gettempdir()
+            os.makedirs(d, exist_ok=True)
             with self._lock:
                 seq = self.dumps
             path = os.path.join(
-                tempfile.gettempdir(),
+                d,
                 f"paddle_serving_flight_{os.getpid()}_{id(self):x}"
                 f"_{seq:04d}.json")
             self.dump(path, extra={"reason": reason,

@@ -1,0 +1,269 @@
+"""Zero-dependency ops HTTP server: the wire end of the telemetry spine.
+
+PR 13 made every telemetry island scrapeable in-process; this module
+puts that surface on a socket — stdlib ``http.server`` only (the
+container bakes in no web framework, and an ops endpoint that needs one
+is an ops endpoint that is down when pip is), threaded, bound to an
+ephemeral localhost port by default:
+
+======================  ==================================================
+``GET /metrics``        Prometheus text exposition v0.0.4
+                        (``MetricsRegistry.to_prometheus``)
+``GET /varz``           the JSON registry snapshot
+                        (``MetricsRegistry.snapshot``)
+``GET /statusz``        the human ops console (``metrics.statusz()``)
+``GET /healthz``        200 when the target is fully healthy, 503 with a
+                        JSON body naming the poisoned replicas otherwise
+``GET /readyz``         200 while the target can accept work (>= 1
+                        healthy replica, not closed) — a degraded fleet
+                        is unhealthy but still ready
+``GET /tracez``         recent + tail-sampled request traces per replica
+                        (``FlightRecorder.tail_traces``) + the SLO report
+``GET /timeline``       the merged chrome-trace document
+                        (``profiler.timeline.unified_trace_doc``)
+======================  ==================================================
+
+Attach it to a :class:`~.engine.GenerationEngine`, an
+:class:`~.fleet.EngineFleet`, or nothing (process-level metrics only)::
+
+    srv = OpsServer(target=fleet, slo=tracker).start()
+    print(srv.url)          # http://127.0.0.1:<ephemeral>
+    ...
+    srv.close()
+
+Handler contract (the ``ops-handler-sync`` self-lint rule enforces the
+letter of it): handlers NEVER touch the device and never block on the
+scheduler — everything they serve comes from scrape-time collectors,
+host rings and host counters. A handler exception returns a 500 body;
+it must not kill the serving thread (an ops surface that dies with the
+thing it observes is useless at exactly 3am). Request logging is
+silenced — a 5s Prometheus scrape interval must not spam stderr.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional, Tuple
+
+from ..framework import metrics as _metrics
+
+__all__ = ["OpsServer"]
+
+
+class _OpsHandler(BaseHTTPRequestHandler):
+    server_version = "paddle-ops/1"
+
+    def log_message(self, *args):                        # noqa: D102
+        pass
+
+    def _send(self, code: int, ctype: str, body) -> None:
+        data = body if isinstance(body, bytes) else str(body).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _send_json(self, code: int, doc: Any) -> None:
+        self._send(code, "application/json",
+                   json.dumps(doc, default=repr))
+
+    def do_GET(self) -> None:                            # noqa: N802
+        ops = self.server.ops                            # type: ignore
+        path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        try:
+            if path == "/metrics":
+                self._send(200,
+                           "text/plain; version=0.0.4; charset=utf-8",
+                           ops.registry.to_prometheus())
+            elif path == "/varz":
+                self._send_json(200, ops.registry.snapshot())
+            elif path == "/statusz":
+                self._send(200, "text/plain; charset=utf-8",
+                           ops.registry.statusz())
+            elif path == "/healthz":
+                ok, doc = ops.health()
+                self._send_json(200 if ok else 503, doc)
+            elif path == "/readyz":
+                ok, doc = ops.ready()
+                self._send_json(200 if ok else 503, doc)
+            elif path == "/tracez":
+                self._send_json(200, ops.tracez())
+            elif path == "/timeline":
+                from ..profiler.timeline import unified_trace_doc
+                self._send_json(200, unified_trace_doc())
+            elif path == "/":
+                self._send_json(200, {"endpoints": sorted(
+                    ("/metrics", "/varz", "/statusz", "/healthz",
+                     "/readyz", "/tracez", "/timeline"))})
+            else:
+                self._send_json(404, {"error": f"no such endpoint "
+                                      f"{path!r}", "see": "/"})
+        except Exception as e:                           # noqa: BLE001
+            # a broken section answers 500; the serving thread lives on
+            try:
+                self._send_json(500, {"error": repr(e), "path": path})
+            except Exception:                            # noqa: BLE001
+                pass
+
+
+class OpsServer:
+    """One process, one ops surface: a threaded stdlib HTTP server over
+    the metrics registry, optionally bound to an engine or fleet for
+    health/traces.
+
+    ``target`` may be a ``GenerationEngine``, an ``EngineFleet`` or
+    ``None``; ``slo`` an :class:`~.slo.SLOTracker` whose report rides
+    ``/tracez``. ``port=0`` binds an ephemeral port (read it back from
+    ``srv.port`` / ``srv.url``)."""
+
+    def __init__(self, target: Optional[Any] = None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 registry: Optional[_metrics.MetricsRegistry] = None,
+                 slo: Optional[Any] = None):
+        self._target = target
+        self._slo = slo
+        self._registry = registry if registry is not None \
+            else _metrics.registry()
+        self._host = host
+        self._port = int(port)
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> "OpsServer":
+        if self._httpd is not None:
+            return self
+        httpd = ThreadingHTTPServer((self._host, self._port),
+                                    _OpsHandler)
+        httpd.daemon_threads = True
+        httpd.ops = self                                 # type: ignore
+        self._httpd = httpd
+        self._thread = threading.Thread(
+            target=httpd.serve_forever, daemon=True,
+            name=f"paddle-ops-server:{httpd.server_address[1]}")
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        httpd, self._httpd = self._httpd, None
+        thread, self._thread = self._thread, None
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
+        if thread is not None:
+            thread.join(timeout=5)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # -- addresses ----------------------------------------------------------
+    @property
+    def port(self) -> Optional[int]:
+        if self._httpd is None:
+            return None
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> Optional[str]:
+        if self._httpd is None:
+            return None
+        return f"http://{self._host}:{self.port}"
+
+    @property
+    def registry(self) -> _metrics.MetricsRegistry:
+        return self._registry
+
+    # -- target introspection (host-only, fault-isolated) -------------------
+    def _target_stats(self) -> Tuple[Optional[dict], Optional[str]]:
+        t = self._target
+        if t is None:
+            return None, None
+        try:
+            return dict(t.stats()), None
+        except Exception as e:                           # noqa: BLE001
+            return None, repr(e)
+
+    def health(self) -> Tuple[bool, Dict[str, Any]]:
+        """Full health: every replica up, target not closed. A fleet
+        with ANY poisoned replica answers 503 here (and 200 on
+        ``/readyz`` while at least one replica still serves)."""
+        t = self._target
+        if t is None:
+            return True, {"ok": True, "target": None}
+        if getattr(t, "_closed", False):
+            return False, {"ok": False, "reason": "target closed"}
+        s, err = self._target_stats()
+        if s is None:
+            return False, {"ok": False, "reason": err}
+        if "replicas_total" in s:
+            unhealthy = [r["replica"] for r in s.get("replicas", ())
+                         if not r.get("healthy")]
+            ok = s["replicas_healthy"] == s["replicas_total"] \
+                and not unhealthy
+            return ok, {"ok": ok,
+                        "replicas_healthy": s["replicas_healthy"],
+                        "replicas_total": s["replicas_total"],
+                        "unhealthy": unhealthy}
+        return True, {"ok": True,
+                      "queue_depth": s.get("queue_depth"),
+                      "active_requests": s.get("active_requests")}
+
+    def ready(self) -> Tuple[bool, Dict[str, Any]]:
+        """Readiness: can the target still accept a submit? A degraded
+        fleet (1 of 2 replicas poisoned) is NOT healthy but IS ready."""
+        t = self._target
+        if t is None:
+            return True, {"ready": True, "target": None}
+        if getattr(t, "_closed", False):
+            return False, {"ready": False, "reason": "target closed"}
+        s, err = self._target_stats()
+        if s is None:
+            return False, {"ready": False, "reason": err}
+        if "replicas_total" in s:
+            ok = s["replicas_healthy"] >= 1
+            return ok, {"ready": ok,
+                        "replicas_healthy": s["replicas_healthy"],
+                        "replicas_total": s["replicas_total"]}
+        return True, {"ready": True}
+
+    def _recorders(self) -> Dict[str, Any]:
+        """Replica-keyed flight recorders (fault-isolated)."""
+        t = self._target
+        if t is None:
+            return {}
+        if hasattr(t, "replicas"):
+            out = {}
+            for i, eng in enumerate(t.replicas):
+                try:
+                    out[str(i)] = eng.flight_recorder
+                except Exception:                        # noqa: BLE001
+                    continue
+            return out
+        rec = getattr(t, "flight_recorder", None)
+        return {"0": rec} if rec is not None else {}
+
+    def tracez(self) -> Dict[str, Any]:
+        """The /tracez document: per-replica tail-sampled + recent
+        traces, plus the SLO report when a tracker is attached."""
+        engines: Dict[str, Any] = {}
+        for key, rec in self._recorders().items():
+            try:
+                engines[key] = rec.tail_traces()
+            except Exception as e:                       # noqa: BLE001
+                engines[key] = {"error": repr(e)}
+        doc: Dict[str, Any] = {"engines": engines}
+        if self._slo is not None:
+            try:
+                doc["slo"] = self._slo.report()
+            except Exception as e:                       # noqa: BLE001
+                doc["slo"] = {"error": repr(e)}
+        return doc
+
+    def __repr__(self):
+        return f"<OpsServer url={self.url} target={self._target!r}>"

@@ -131,6 +131,20 @@ class RequestTrace:
         out.sort(key=lambda e: e["t_ms"])
         return out
 
+    def snapshot(self) -> Dict[str, Any]:
+        """Self-contained JSON dict of the whole trace — the unit the
+        flight recorder tail-samples and ``/tracez`` serves. Derived
+        latencies are materialized here so a retained snapshot stays
+        meaningful after the live trace object is gone."""
+        return {"request": self.request_id,
+                "completed": self.completed,
+                "ttft_ms": self.ttft_ms,
+                "tpot_ms": self.tpot_ms,
+                "tokens": len(self.token_times),
+                "preempts": self.count("preempt"),
+                "prefix_hits": self.count("prefix_hit"),
+                "timeline": self.timeline()}
+
     # -- chrome-trace export -----------------------------------------------
     def export_spans(self) -> None:
         """Emit this (finished) request as a chrome-trace lane into the

@@ -127,6 +127,16 @@ def test_dry_run_emits_metrics_summary():
     assert "serving/tpot_ms" in res.stderr
     assert "serving/cycle_ms" in res.stderr
     assert "serving/batch_occupancy" in res.stderr
+    # PR-16 SLO plane / ops surface: the zero-dependency ops HTTP
+    # server booted on an ephemeral port during the serve-load canary,
+    # a live GET /metrics parsed back non-empty WITH the slo_attainment
+    # series, /healthz answered 200 while serving and flipped to 503
+    # after engine close, /tracez carried the tail-sampled traces and
+    # the SLO report, and stats() published SLO-gated goodput
+    assert out["checks"]["ops_server_scrape"] is True, out
+    assert out["checks"]["ops_server_healthz"] is True, out
+    assert out["checks"]["ops_server_tracez"] is True, out
+    assert out["checks"]["ops_server_goodput"] is True, out
     # ISSUE-7 compute/memory observability: every owned jit site
     # registered its compile cost (compile/ms + compile/count live), the
     # train step's XLA cost analysis produced hapi/flops_per_sec and —

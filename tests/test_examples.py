@@ -95,6 +95,24 @@ def test_serve_gpt2_example_spec_int8(tmp_path):
     assert "same budget at fp32" in out
 
 
+def test_ops_surface_example(tmp_path):
+    """The PR-16 ops quickstart: the SLO series come back over real
+    HTTP, health answers 200 live and 503 once the engine closes, and
+    tracez carries the tail-sampled traces + burn rates + goodput."""
+    out = _run([os.path.join(REPO, "examples", "ops_surface.py")],
+               tmp_path, timeout=600)
+    assert "ops server live at http://127.0.0.1:" in out
+    assert "served 6 requests" in out
+    assert "slo_attainment: live" in out
+    assert "slo_burn_rate: live" in out
+    assert "goodput_rps: live" in out
+    assert "slo_latency_ms_bucket: live" in out
+    assert "healthz: 200 ok" in out
+    assert "tracez: 6 recent traces" in out
+    assert "attainment 100.00%" in out
+    assert "healthz after close: 503" in out
+
+
 def test_generate_text_example(tmp_path):
     out = _run([os.path.join(REPO, "examples", "generate_text.py")],
                tmp_path, timeout=600)

@@ -3,6 +3,7 @@ gauges / mergeable histograms), Prometheus round-trip, the monitor
 bridge, collectors, the statusz ops console, collective device timing
 and the communication report, and the monitor prefix-filter contract.
 """
+import itertools
 import math
 import threading
 
@@ -203,6 +204,32 @@ class TestPrometheusRoundTrip:
                 if n == "g_value"]
         assert keys and dict(keys[0])["path"] == 'a"b\\c'
         assert dict(keys[0])["note"] == "two\nlines"
+
+    def test_hostile_label_values_round_trip_exhaustively(self):
+        # property-style sweep: EVERY combination (up to length 3, plus
+        # the known-degenerate longer shapes) over the worst alphabet —
+        # backslash, quote, newline, closing brace, plain char. Catches
+        # both escaping-order bugs (backslash+'n' exported as \\n must
+        # NOT parse back as backslash+newline) and the sample regex
+        # stopping at a '}' inside a quoted value.
+        alphabet = ["\\", '"', "\n", "}", "a"]
+        values = {""}
+        for n in (1, 2, 3):
+            values |= {"".join(c) for c in
+                       itertools.product(alphabet, repeat=n)}
+        values |= {"\\n", "\\\\n", '\\"}', "}{", 'a}b"c\\d\ne',
+                   "\\" * 5, '"' * 4 + "\\"}
+        values.discard("")        # empty string: one label-less series
+        r = _reg()
+        want = {}
+        for i, v in enumerate(sorted(values)):
+            r.set_gauge("hostile_gauge", float(i), v=v)
+            want[v] = float(i)
+        parsed = M.parse_prometheus(r.to_prometheus())
+        got = {dict(labels)["v"]: val
+               for (n, labels), val in parsed["samples"].items()
+               if n == "hostile_gauge"}
+        assert got == want
 
     def test_collector_samples_in_export(self):
         r = _reg()

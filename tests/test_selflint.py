@@ -76,6 +76,36 @@ def test_serving_host_sync_rule():
     assert [f.line for f in out] == [4, 5]
 
 
+def test_ops_handler_sync_rule():
+    # the scrape-only ops surface: ANY jax/jnp call and the scheduler-
+    # blocking reads are banned in serving/opsserver.py + serving/slo.py
+    src = ("import jax\n"
+           "import jax.numpy as jnp\n"
+           "def handler(h, x):\n"
+           "    a = jnp.asarray(x)\n"              # flagged: jnp call
+           "    b = h.result()\n"                  # flagged: blocks sched
+           "    return a, b\n")
+    out = lint_source("t.py", src, "serving/opsserver.py")
+    assert [f.rule for f in out] == ["ops-handler-sync"] * 2
+    assert [f.line for f in out] == [4, 5]
+    out = lint_source("t.py", src, "serving/slo.py")
+    assert [f.rule for f in out] == ["ops-handler-sync"] * 2
+    # a device fetch in these files trips BOTH walks: the package-wide
+    # serving-host-sync rule and this one (the contracts compose)
+    fetch = "import jax\ndef h(x):\n    return jax.device_get(x)\n"
+    rules = sorted(f.rule for f in
+                   lint_source("t.py", fetch, "serving/opsserver.py"))
+    assert rules == ["ops-handler-sync", "serving-host-sync"]
+    # elsewhere in serving/ the result() read is the legitimate caller
+    # surface (engine.submit().result()) and stays unflagged
+    ok = "def wait(h):\n    return h.result()\n"
+    assert lint_source("t.py", ok, "serving/engine.py") == []
+    # suppression honored like every other rule
+    sup = src.replace("h.result()", "h.result()  # lint: ok")
+    out = lint_source("t.py", sup, "serving/opsserver.py")
+    assert [f.line for f in out] == [4]
+
+
 def test_memory_stats_hot_path_rule():
     # polling device memory stats inside the serving package is a PjRt
     # query on the scheduler hot path — both the method and bare-name
