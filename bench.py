@@ -2629,6 +2629,86 @@ def dry_run():
 
         telemetry_canary = _telemetry_canary()
 
+        # ISSUE-18 static planner canary: (1) the donation-aware
+        # liveness estimate must BRACKET XLA's own memory_analysis
+        # (within liveness.CROSSCHECK_RTOL) on every program this dry
+        # run actually compiled and both figures exist for — the tiny-
+        # GPT train step is compiled here explicitly so the check
+        # covers a real fused train step, and the serving canaries
+        # above already compiled every decode/fused/spec bucket; (2) a
+        # doctored too-small HBM budget must make engine construction
+        # raise PlanError naming the fattest program point with
+        # compile/count UNCHANGED (fit-before-compile: the plan is a
+        # make_jaxpr trace, never an XLA compile); (3) a generous
+        # budget constructs fine with a fitting plan attached.
+        def _planner_canary():
+            import paddle_tpu.nn.functional as F
+            from paddle_tpu.analysis import liveness
+            from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining
+            from paddle_tpu.serving import GenerationEngine, PlanError
+
+            paddle.framework.random.seed(0)
+            cfg = GPTConfig.tiny()
+            gpt = GPTForPretraining(cfg)
+            gm = paddle.Model(gpt)
+            gm.prepare(
+                paddle.optimizer.AdamW(learning_rate=1e-4,
+                                       parameters=gpt.parameters()),
+                lambda logits, lbl: F.cross_entropy(
+                    logits.reshape([-1, cfg.vocab_size]),
+                    lbl.reshape([-1])))
+            ids = rng.randint(0, cfg.vocab_size, (2, 16)).astype(np.int32)
+            gm.train_batch([ids], [ids.astype(np.int64)])
+
+            crosschecks = {}
+            for site, rec in program_registry.snapshot().items():
+                cc = liveness.crosscheck(
+                    rec.get("static_peak_bytes"), rec.get("argument_bytes"),
+                    rec.get("output_bytes"), rec.get("temp_bytes"))
+                if cc is not None:
+                    crosschecks[site] = cc
+            train_sites = [s for s in crosschecks
+                           if "train_step" in s]
+            serving_sites = [s for s in crosschecks
+                             if s.startswith("serving/")]
+
+            c0 = monitor.stat_get("compile/count")
+            m2 = GPTForPretraining(cfg)
+            m2.eval()
+            gate = {"raised": False, "peak_point": None, "plan": None}
+            try:
+                GenerationEngine(m2, num_slots=4, max_len=48,
+                                 min_bucket=8, kv_layout="paged",
+                                 block_size=8,
+                                 hbm_budget_bytes=64 * 1024)
+            except PlanError as e:
+                gate = {"raised": True,
+                        "peak_point": (e.plan.get("peak_point") or {})
+                        .get("primitive"),
+                        "plan": {k: e.plan[k] for k in
+                                 ("static_peak_bytes", "pool_bytes",
+                                  "budget_bytes", "fits")}}
+            gate_extra_compiles = monitor.stat_get("compile/count") - c0
+
+            eng = GenerationEngine(m2, num_slots=4, max_len=48,
+                                   min_bucket=8, kv_layout="paged",
+                                   block_size=8,
+                                   hbm_budget_bytes=1 << 33)
+            generous_plan = eng._plan
+            eng.close()
+            return {
+                "crosschecks": crosschecks,
+                "crosscheck_ok": bool(crosschecks) and all(
+                    c["ok"] for c in crosschecks.values()),
+                "train_step_checked": bool(train_sites),
+                "serving_checked": bool(serving_sites),
+                "gate": gate,
+                "gate_extra_compiles": gate_extra_compiles,
+                "generous_fits": (generous_plan or {}).get("fits") is True,
+            }
+
+        planner_canary = _planner_canary()
+
     # ISSUE-7: the bench regression gate, exercised the way the driver
     # would use it — a seeded artifact vs a doctored copy with a 20%
     # throughput loss and a 40% latency blowup must exit nonzero
@@ -2839,6 +2919,21 @@ def dry_run():
         "telemetry_prometheus_roundtrip":
             telemetry_canary["prometheus_ok"],
         "telemetry_sampler_ring": telemetry_canary["ring_ok"],
+        # ISSUE-18 static memory planner: the liveness estimate
+        # brackets XLA's memory_analysis on EVERY compiled program
+        # where both figures exist (incl. a real train step and the
+        # serving buckets), the doctored 64 KiB budget fails engine
+        # construction with a PlanError naming the fattest program
+        # point and ZERO new compiles, and a generous budget attaches
+        # a fitting plan
+        "planner_crosscheck": planner_canary["crosscheck_ok"]
+        and planner_canary["train_step_checked"]
+        and planner_canary["serving_checked"],
+        "planner_gate_raises": planner_canary["gate"]["raised"]
+        and planner_canary["gate"]["peak_point"] is not None,
+        "planner_gate_zero_compiles":
+            planner_canary["gate_extra_compiles"] == 0,
+        "planner_generous_fits": planner_canary["generous_fits"],
     }
     print(monitor.stats_summary(), file=sys.stderr)
     for f in lint_findings:
@@ -2852,6 +2947,12 @@ def dry_run():
         print(paged_report.table(), file=sys.stderr)
     if not fused_canary["report"].ok():
         print(fused_canary["report"].table(), file=sys.stderr)
+    if not planner_canary["crosscheck_ok"]:
+        for site, cc in planner_canary["crosschecks"].items():
+            print(f"PLANNER {'ok ' if cc['ok'] else 'FAIL'} {site}: "
+                  f"static {cc['static_peak_bytes']:,} B vs XLA "
+                  f"{cc['xla_bytes']:,} B (ratio {cc['ratio']:.2f}, "
+                  f"rtol {cc['rtol']})", file=sys.stderr)
     ok = all(checks.values())
     print(json.dumps({"metric": "dry_run", "ok": ok,
                       "counters": len(counters),
@@ -2890,6 +2991,16 @@ def dry_run():
                       },
                       "zero": zero_canary,
                       "mp": mp_canary,
+                      "planner": {
+                          "n_crosschecked":
+                              len(planner_canary["crosschecks"]),
+                          "ratios": {
+                              s: round(c["ratio"], 3) for s, c in
+                              planner_canary["crosschecks"].items()},
+                          "gate": planner_canary["gate"],
+                          "gate_extra_compiles":
+                              planner_canary["gate_extra_compiles"],
+                      },
                       "telemetry": {k: telemetry_canary[k] for k in
                                     ("probed_kinds",
                                      "exposed_ms_per_step",

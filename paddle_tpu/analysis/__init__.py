@@ -21,6 +21,14 @@ collective-pairing a reduce-scatter whose axis/dimension/tiling has no
                    loop left open or permuted)
 recompile-churn    why retraces fired (shape/dtype/static-arg/frozen-set),
                    from the ``dispatch/retrace_cause`` trace probe
+static-memory      donation-aware liveness scan (:mod:`.liveness`):
+                   ``static_peak_bytes`` + the fattest program point,
+                   before any compile
+donation-miss      large invars that die early but are not donated, with
+                   the peak-bytes reduction donating would buy
+sharding-consistency  inside shard_map: collective axes must exist on the
+                   mesh, reduce_scatter/all_gather pairing must close,
+                   large fully-replicated operands priced per device
 =================  ========================================================
 
 Three integration surfaces: ``Model.fit(..., analyze='warn'|'error')``
@@ -35,11 +43,15 @@ from __future__ import annotations
 from .core import (AnalysisContext, AnalysisError, Finding, Report,  # noqa
                    all_passes, analyze, iter_eqns, register_pass)
 from . import passes as _passes  # noqa: F401  (registers the built-ins)
+from . import liveness  # noqa: F401
+from .liveness import (LivenessReport, callable_liveness,  # noqa: F401
+                       jaxpr_liveness)
 from .selflint import lint_repo, lint_source  # noqa: F401
 
 __all__ = ["analyze", "analyze_model", "apply_mode", "Finding", "Report",
            "AnalysisError", "AnalysisContext", "register_pass",
-           "all_passes", "lint_repo", "lint_source"]
+           "all_passes", "lint_repo", "lint_source", "liveness",
+           "LivenessReport", "callable_liveness", "jaxpr_liveness"]
 
 
 def flag_mode() -> str:

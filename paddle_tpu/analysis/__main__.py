@@ -10,14 +10,25 @@ Targets:
   — loaded and analyzed from its saved input specs.
 
 Options: ``--input dtype:d0,d1,...`` (repeatable), ``--donate 0,1``,
-``--passes a,b``, ``--selflint`` (lint paddle_tpu's own source instead).
-Exit status: 0 clean / findings below error, 1 error-severity findings
-(or any self-lint finding) — usable as a CI gate.
+``--passes a,b``, ``--selflint`` (lint paddle_tpu's own source instead),
+``--budget BYTES`` (fit-before-compile gate: fail when the target's
+donation-aware ``static_peak_bytes`` exceeds the budget, naming the
+fattest program point), ``--json`` (machine-readable findings on stdout
+— one object with ``target``/``ok``/``static_peak_bytes``/``budget`` and
+per-finding ``pass``/``severity``/``message``/``source``/``primitive``/
+``data`` bytes fields — the CI-consumable form).
+
+Exit-code contract (stable, CI-facing): **0** clean — no error-severity
+findings and the static peak fits any ``--budget``; **1** error-severity
+findings, any self-lint finding, or static peak over ``--budget``;
+**2** usage errors (argparse). ``--json`` never changes the exit code,
+only the output format.
 """
 from __future__ import annotations
 
 import argparse
 import importlib
+import json
 import os
 import sys
 
@@ -70,6 +81,33 @@ def _resolve(target: str):
     return jit.load(prefix), None, prefix
 
 
+def _report_peak_bytes(report):
+    """static_peak_bytes from the report's static-memory finding, or
+    None when the trace failed (never a fake number)."""
+    for f in report.findings:
+        if f.pass_id == "static-memory" and f.data:
+            return f.data.get("static_peak_bytes")
+    return None
+
+
+def _report_json(report, budget, fits) -> str:
+    return json.dumps({
+        "target": report.target,
+        "ok": report.ok() and fits is not False,
+        "n_eqns": report.n_eqns,
+        "passes_run": report.passes_run,
+        "static_peak_bytes": _report_peak_bytes(report),
+        "budget_bytes": budget,
+        "fits_budget": fits,
+        "findings": [{
+            "pass": f.pass_id, "severity": f.severity,
+            "message": f.message, "source": f.source,
+            "primitive": f.primitive, "fix_hint": f.fix_hint,
+            "data": f.data,
+        } for f in report.findings],
+    })
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m paddle_tpu.analysis",
@@ -83,6 +121,12 @@ def main(argv=None) -> int:
                     help="comma-separated donated argnums")
     ap.add_argument("--passes", default="",
                     help="comma-separated pass ids (default: all)")
+    ap.add_argument("--budget", type=int, default=None, metavar="BYTES",
+                    help="HBM budget: exit 1 when the target's static "
+                         "peak bytes (donation-aware liveness) exceed it")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable findings JSON on stdout "
+                         "(exit codes unchanged)")
     ap.add_argument("--selflint", action="store_true",
                     help="run the AST self-lint over paddle_tpu/ instead")
     args = ap.parse_args(argv)
@@ -90,9 +134,14 @@ def main(argv=None) -> int:
     if args.selflint:
         from .selflint import lint_repo
         findings = lint_repo()
-        for f in findings:
-            print(f)
-        print(f"self-lint: {len(findings)} finding(s)")
+        if args.json:
+            print(json.dumps({"selflint": [
+                {"rule": f.rule, "path": f.path, "line": f.line,
+                 "message": f.message} for f in findings]}))
+        else:
+            for f in findings:
+                print(f)
+            print(f"self-lint: {len(findings)} finding(s)")
         return 1 if findings else 0
 
     if not args.target:
@@ -107,8 +156,26 @@ def main(argv=None) -> int:
     passes = [p for p in args.passes.split(",") if p] or None
     report = analyze(fn, *fn_args, donate_argnums=donate, passes=passes,
                      name=name)
-    print(report.table())
-    return 0 if report.ok() else 1
+
+    peak = _report_peak_bytes(report)
+    fits = None
+    if args.budget is not None:
+        # the fit-before-compile gate: an untraceable target (peak is
+        # None) cannot certify fit, so it fails the gate honestly
+        fits = peak is not None and peak <= args.budget
+
+    if args.json:
+        print(_report_json(report, args.budget, fits))
+    else:
+        print(report.table())
+        if fits is False:
+            print(f"budget: static peak "
+                  f"{'unknown (trace failed)' if peak is None else f'{peak:,} B'} "
+                  f"exceeds --budget {args.budget:,} B")
+        elif fits:
+            print(f"budget: static peak {peak:,} B fits "
+                  f"--budget {args.budget:,} B")
+    return 0 if (report.ok() and fits is not False) else 1
 
 
 if __name__ == "__main__":

@@ -52,6 +52,8 @@ class Finding:
     source: Optional[str] = None      # "file:line (fn)" eqn provenance
     primitive: Optional[str] = None   # offending jaxpr primitive, if any
     fix_hint: Optional[str] = None
+    data: Optional[dict] = None       # machine-readable payload (bytes
+                                      # figures etc.) for --json consumers
 
     def __post_init__(self):
         if self.severity not in SEVERITIES:

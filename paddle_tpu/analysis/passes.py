@@ -1,4 +1,4 @@
-"""The five built-in analysis passes.
+"""The built-in analysis passes.
 
 Each is a function ``(ctx: AnalysisContext) -> list[Finding]`` registered
 under its pass id (≙ REGISTER_PASS in the reference's
@@ -31,7 +31,8 @@ from .core import (AnalysisContext, Finding, eqn_source, is_structural_zero,
 
 __all__ = ["host_sync_pass", "donation_safety_pass", "dead_grad_pass",
            "dtype_hygiene_pass", "recompile_churn_pass",
-           "collective_pairing_pass"]
+           "collective_pairing_pass", "static_memory_pass",
+           "donation_miss_pass", "sharding_consistency_pass"]
 
 
 # ---------------------------------------------------------------------------
@@ -106,9 +107,9 @@ def donation_safety_pass(ctx: AnalysisContext) -> List[Finding]:
     invar with NO matching output aval — the rebind target does not
     exist, so the state the caller holds after the call is a deleted
     handle (error); (b) one donated invar feeding MORE outputs than
-    exist buffers to alias (double-alias, error); (c) a donated invar
-    the computation never reads — donation frees it, but passing it at
-    all is dead weight (warning)."""
+    exist buffers to alias (double-alias, error). The old boolean
+    dead-donation warning moved to the byte-aware ``donation-miss``
+    pass (ISSUE 18), which prices every donation decision."""
     out: List[Finding] = []
     closed, mask = ctx.closed_jaxpr, ctx.donated_invars
     if closed is None or not mask or not any(mask):
@@ -121,11 +122,6 @@ def donation_safety_pass(ctx: AnalysisContext) -> List[Finding]:
     out_avals = Counter(_aval_key(v) for v in jaxpr.outvars
                         if not hasattr(v, "val"))
     outvar_counts = Counter(id(v) for v in jaxpr.outvars)
-    used = set()
-    for eqn in jaxpr.eqns:
-        for v in eqn.invars:
-            if not hasattr(v, "val"):
-                used.add(id(v))
 
     for i, v in enumerate(donated):
         key = _aval_key(v)
@@ -149,13 +145,6 @@ def donation_safety_pass(ctx: AnalysisContext) -> List[Finding]:
             fix_hint=("return the updated value for every donated arg "
                       "(params/opt_state/buffers in a train step) or "
                       "drop it from donate_argnums")))
-    for i, v in enumerate(donated):
-        if id(v) not in used and outvar_counts.get(id(v), 0) == 0:
-            out.append(Finding(
-                pass_id="donation-safety", severity="warning",
-                message=(f"donated input #{i} is never read by the "
-                         f"computation (dead donation)"),
-                fix_hint="stop passing (and donating) the unused value"))
     return out
 
 
@@ -480,4 +469,219 @@ def recompile_churn_pass(ctx: AnalysisContext) -> List[Finding]:
             message=(f"{total} retrace(s) across {len(sites)} trace "
                      f"site(s) since the last analysis: {detail}"),
             fix_hint=None))
+    return out
+
+# ---------------------------------------------------------------------------
+# 7. static-memory (ISSUE 18)
+# ---------------------------------------------------------------------------
+
+@register_pass("static-memory")
+def static_memory_pass(ctx: AnalysisContext) -> List[Finding]:
+    """Donation-aware liveness scan (analysis/liveness.py): one info
+    finding carrying ``static_peak_bytes`` and the fattest program
+    point. Always info — the BUDGET verdict belongs to the callers
+    (``GenerationEngine(hbm_budget_bytes=)``, ``--budget``), which hold
+    the device context this pass does not."""
+    if ctx.closed_jaxpr is None:
+        return []
+    from . import liveness
+    rep = liveness.jaxpr_liveness(ctx.closed_jaxpr, ctx.donated_invars,
+                                  top_k=3)
+    pk = rep.peak
+    return [Finding(
+        pass_id="static-memory", severity="info",
+        message=(f"static peak {rep.static_peak_bytes:,} B live "
+                 f"(args {rep.arg_bytes:,} B, {rep.donated_bytes:,} B "
+                 f"donated; fattest point: "
+                 f"{pk.primitive if pk else 'n/a'} at "
+                 f"{(pk.source if pk else None) or 'unknown source'})"),
+        source=pk.source if pk else None,
+        primitive=pk.primitive if pk else None,
+        data=rep.as_dict())]
+
+
+# ---------------------------------------------------------------------------
+# 8. donation-miss (ISSUE 18; supersedes the boolean dead-donation check)
+# ---------------------------------------------------------------------------
+
+@register_pass("donation-miss")
+def donation_miss_pass(ctx: AnalysisContext) -> List[Finding]:
+    """Donation decisions priced in bytes.
+
+    (a) A large invar (>= liveness.DONATION_MISS_MIN_BYTES) that dies
+    before the program ends but is NOT donated: warning carrying the
+    ``static_peak_bytes`` reduction donating it would buy — computed by
+    an honest liveness re-scan, not a heuristic, so an invar whose
+    lifetime spans the peak anyway is never flagged. (b) A donated
+    invar the program never reads (the old donation-safety boolean
+    dead-donation warning, now here with its bytes)."""
+    if ctx.closed_jaxpr is None:
+        return []
+    from . import liveness
+    out: List[Finding] = []
+    for m in liveness.donation_misses(ctx.closed_jaxpr,
+                                      ctx.donated_invars):
+        if m["kind"] == "dead":
+            out.append(Finding(
+                pass_id="donation-miss", severity="warning",
+                message=(f"donated input #{m['argnum']} "
+                         f"({m['bytes']:,} B) is never read by the "
+                         f"computation (dead donation)"),
+                fix_hint="stop passing (and donating) the unused value",
+                data=m))
+        else:
+            out.append(Finding(
+                pass_id="donation-miss", severity="warning",
+                message=(f"input #{m['argnum']} ({m['bytes']:,} B) dies "
+                         f"before the program ends but is not donated — "
+                         f"donating it would cut static peak memory by "
+                         f"{m['saving_bytes']:,} B"),
+                source=m["last_use_source"],
+                fix_hint=(f"add argnum {m['argnum']} to donate_argnums "
+                          f"(the caller must not reuse the buffer after "
+                          f"the call)"),
+                data=m))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# 9. sharding-consistency (ISSUE 18)
+# ---------------------------------------------------------------------------
+
+# an array entering a shard_map fully replicated below this size is a
+# rounding error per device; above it, the per-device copy is worth a
+# finding (embedding tables, block pools).
+SHARDING_REPLICATED_MIN_BYTES = 1 << 20
+
+
+def _mesh_axis_sizes(mesh) -> dict:
+    try:
+        return dict(mesh.shape)
+    except Exception:
+        try:
+            return {a: int(s) for a, s in
+                    zip(mesh.axis_names, mesh.devices.shape)}
+        except Exception:
+            return {}
+
+
+def _collective_axes(eqn):
+    name = eqn.primitive.name
+    if name == "psum":
+        return _axis_key(eqn.params.get("axes", ()))
+    if name in ("reduce_scatter", "all_gather", "all_to_all",
+                "ppermute", "pmax", "pmin"):
+        return _axis_key(eqn.params.get("axis_name", ()))
+    return None
+
+
+@register_pass("sharding-consistency")
+def sharding_consistency_pass(ctx: AnalysisContext) -> List[Finding]:
+    """Static checks inside shard_map regions (the dp x mp composition
+    bug class from "Automatic Cross-Replica Sharding"):
+
+    * every collective's axis name must exist on the shard_map's mesh
+      (error — an axis the mesh does not carry reduces over nothing);
+    * a reduce_scatter inside the body must be closed by an all_gather
+      over the SAME (axis, dimension, tiled) triple — the PR-10
+      collective-pairing structure, scoped to the sharded region where
+      the mesh context makes the message precise (error);
+    * an array >= SHARDING_REPLICATED_MIN_BYTES entering the shard_map
+      with a fully-replicated spec (empty in_names) costs its FULL
+      bytes on EVERY device — warning with the per-device cost and the
+      saving the largest mesh axis would buy."""
+    if ctx.closed_jaxpr is None:
+        return []
+    from .liveness import aval_bytes
+    out: List[Finding] = []
+    for eqn in iter_eqns(ctx.closed_jaxpr):
+        if eqn.primitive.name != "shard_map":
+            continue
+        mesh = eqn.params.get("mesh")
+        axis_sizes = _mesh_axis_sizes(mesh)
+        src = eqn_source(eqn)
+        body = eqn.params.get("jaxpr")
+        if body is None:
+            continue
+        if hasattr(body, "jaxpr"):
+            body = body.jaxpr
+
+        # (1) + (2): the body's collectives, in program order
+        rs, ag = [], []
+        for pos, e in enumerate(iter_eqns(body)):
+            axes = _collective_axes(e)
+            if axes is None:
+                continue
+            unknown = [a for a in axes
+                       if isinstance(a, str) and a not in axis_sizes]
+            if unknown:
+                out.append(Finding(
+                    pass_id="sharding-consistency", severity="error",
+                    message=(f"{e.primitive.name} over axis "
+                             f"{unknown[0]!r} inside shard_map, but the "
+                             f"mesh only carries "
+                             f"{sorted(axis_sizes) or 'no axes'}"),
+                    source=eqn_source(e) or src,
+                    primitive=e.primitive.name,
+                    fix_hint="name a mesh axis (Mesh(..., axis_names=))"))
+            if e.primitive.name == "reduce_scatter":
+                rs.append((pos, e))
+            elif e.primitive.name == "all_gather":
+                ag.append((pos, e))
+
+        def _ag_key(g):
+            return (_axis_key(g.params.get("axis_name")),
+                    int(g.params.get("all_gather_dimension", 0)),
+                    bool(g.params.get("tiled", False)))
+
+        unconsumed = list(ag)
+        for rs_pos, e in rs:
+            key = (_axis_key(e.params.get("axis_name")),
+                   int(e.params.get("scatter_dimension", 0)),
+                   bool(e.params.get("tiled", False)))
+            match = next((i for i, (p, g) in enumerate(unconsumed)
+                          if p > rs_pos and _ag_key(g) == key), None)
+            if match is not None:
+                unconsumed.pop(match)
+                continue
+            later = [_ag_key(g) for p, g in unconsumed if p > rs_pos]
+            have = ", ".join(
+                f"axis={k[0]} dim={k[1]} tiled={k[2]}" for k in later) \
+                or "none"
+            out.append(Finding(
+                pass_id="sharding-consistency", severity="error",
+                message=(f"reduce_scatter over axis {key[0]} (dim="
+                         f"{key[1]}, tiled={key[2]}) inside shard_map "
+                         f"on mesh {axis_sizes} is not closed by a "
+                         f"matching all_gather (later gathers: {have}) "
+                         f"— the PR-10 pairing contract, scoped to the "
+                         f"sharded region"),
+                source=eqn_source(e) or src,
+                primitive="reduce_scatter",
+                fix_hint=("all_gather over the same axis/dimension/"
+                          "tiling before leaving the shard_map body")))
+
+        # (3): large fully-replicated operands
+        in_names = eqn.params.get("in_names") or ()
+        for k, (v, names) in enumerate(zip(eqn.invars, in_names)):
+            if names:                      # partitioned on some axis
+                continue
+            b = aval_bytes(getattr(v, "aval", None))
+            if b < SHARDING_REPLICATED_MIN_BYTES:
+                continue
+            biggest = max(axis_sizes.values()) if axis_sizes else 1
+            out.append(Finding(
+                pass_id="sharding-consistency", severity="warning",
+                message=(f"operand #{k} ({b:,} B) enters shard_map "
+                         f"fully replicated: {b:,} B resident on EVERY "
+                         f"device of mesh {axis_sizes} — sharding its "
+                         f"largest dim over the biggest axis would cut "
+                         f"the per-device cost to ~{b // biggest:,} B"),
+                source=src, primitive="shard_map",
+                fix_hint=("give the operand a PartitionSpec over a mesh "
+                          "axis (in_specs=P('mp', ...)), or keep small/"
+                          "genuinely-shared state replicated on "
+                          "purpose"),
+                data={"argnum": k, "bytes": b,
+                      "per_device_sharded_bytes": b // biggest}))
     return out

@@ -71,6 +71,12 @@ Python cannot enforce (≙ the reference's tools/codestyle custom checks
   landing in a ``*_ms`` panel misreads by 1000x and a CamelCase name
   breaks every PromQL regex written against the snake_case rest.
 
+* ``analysis-no-device`` — the static planner (``paddle_tpu/analysis/``)
+  answers "will it fit?" BEFORE any compile, from jaxpr avals alone
+  (ISSUE 18): ``jax.jit``, ``.compile()`` (``re.compile`` exempt),
+  ``device_put`` and ``block_until_ready`` are banned in the package —
+  an admission gate that compiles has already paid the cost it gates.
+
 Suppress a finding with a trailing ``# lint: ok`` comment on the line
 (used only where a human has argued the exception in an adjacent
 comment). Run: ``python -m paddle_tpu.analysis --selflint`` or the
@@ -322,8 +328,38 @@ def lint_source(path: str, source: str, relpath: str) -> List[LintFinding]:
     in_ops = rel.startswith("ops/")
     # the numerics audit module: host-pure over numpy BY CONTRACT
     in_numerics = rel.endswith("profiler/numerics.py")
+    # the static planner: aval arithmetic only, never compile/device work
+    in_analysis = rel.startswith("analysis/")
 
     for node in ast.walk(tree):
+        # rule: analysis-no-device (the planner's fit-BEFORE-compile
+        # contract: paddle_tpu/analysis/ answers memory questions from
+        # jaxprs alone, so nothing in the package may trigger a compile
+        # or touch the device)
+        if in_analysis and isinstance(node, ast.Call):
+            f = node.func
+            banned = None
+            if isinstance(f, ast.Attribute):
+                recv = f.value
+                recv_name = recv.id if isinstance(recv, ast.Name) else None
+                if f.attr == "jit" and recv_name == "jax":
+                    banned = "jax.jit"
+                elif f.attr == "device_put":
+                    banned = "device_put"
+                elif f.attr == "block_until_ready":
+                    banned = ".block_until_ready()"
+                elif f.attr == "compile" and recv_name != "re":
+                    banned = ".compile()"
+            elif isinstance(f, ast.Name) and f.id == "device_put":
+                banned = "device_put"
+            if banned and not _suppressed(lines, node.lineno):
+                findings.append(LintFinding(
+                    "analysis-no-device", path, node.lineno,
+                    f"{banned} inside paddle_tpu/analysis/: the static "
+                    f"planner answers fit-BEFORE-compile from jaxpr "
+                    f"avals alone — compiling or touching the device "
+                    f"here would make the admission gate pay the cost "
+                    f"it exists to avoid"))
         # rule: pallas-block-tiling (Mosaic (8, 128) block-shape law)
         if in_ops and isinstance(node, ast.Call):
             dims = _blockspec_literal_dims(node)

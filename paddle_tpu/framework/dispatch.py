@@ -204,6 +204,18 @@ def _first_call_probe(name, key, built, jitted=True):
     callable, leaving zero steady-state overhead."""
     def traced(*arrays):
         if _fn_cache.get(key) is not built:
+            if any(isinstance(a, jax.core.Tracer) for a in arrays):
+                # abstract first call (make_jaxpr / an outer trace, e.g.
+                # the fit-before-compile planner): the wrapper inlines
+                # into the outer jaxpr without XLA compiling anything —
+                # keep compile/count untouched and the probe armed for
+                # the first CONCRETE call, where the compile cost lands.
+                # It is still a jit-cache miss, so an armed profiler
+                # session sees it as a "cache" span under its own name
+                if _prof._active:
+                    with _prof.record(f"jit_trace/{name}", "cache"):
+                        return built(*arrays)
+                return built(*arrays)
             _fn_cache[key] = built
             t0 = time.perf_counter()
             if _prof._active:

@@ -80,7 +80,8 @@ class ProgramRecord:
 
     __slots__ = ("site", "compiles", "compile_ms_total", "last_compile_ms",
                  "eqns", "flops", "bytes_accessed", "temp_bytes",
-                 "argument_bytes", "output_bytes", "generated_code_bytes")
+                 "argument_bytes", "output_bytes", "generated_code_bytes",
+                 "static_peak_bytes")
 
     def __init__(self, site: str):
         self.site = site
@@ -94,6 +95,10 @@ class ProgramRecord:
         self.argument_bytes: Optional[int] = None
         self.output_bytes: Optional[int] = None
         self.generated_code_bytes: Optional[int] = None
+        # ISSUE 18: the donation-aware jaxpr liveness estimate, recorded
+        # at trace time NEXT TO the XLA memory figures so the dry-run
+        # can cross-check the static planner against the backend
+        self.static_peak_bytes: Optional[int] = None
 
     def as_dict(self) -> dict:
         return {k: getattr(self, k) for k in self.__slots__}
@@ -130,7 +135,7 @@ def note_compile(site: str, wall_ms: float, eqns: Optional[int] = None,
         if analysis:
             for k in ("flops", "bytes_accessed", "temp_bytes",
                       "argument_bytes", "output_bytes",
-                      "generated_code_bytes"):
+                      "generated_code_bytes", "static_peak_bytes"):
                 if analysis.get(k) is not None:
                     setattr(rec, k, analysis[k])
         registered = _records.get(site) is rec
@@ -205,6 +210,20 @@ def analyze_compiled(compiled) -> dict:
     return out
 
 
+def static_peak_of_trace(closed_jaxpr, donated_mask=None) -> Optional[int]:
+    """Donation-aware liveness peak of an already-traced program
+    (analysis/liveness.py), or ``None`` when the scan cannot run —
+    same honesty contract as :func:`analyze_compiled`: never a fake
+    number. Host arithmetic over avals; no compile, no device."""
+    try:
+        from ..analysis.liveness import jaxpr_liveness
+        return int(jaxpr_liveness(closed_jaxpr,
+                                  donated_mask).static_peak_bytes)
+    except Exception as e:                               # noqa: BLE001
+        logger.debug("static liveness unavailable: %r", e)
+        return None
+
+
 def analyze_callable(fn, *example_args, static_argnums=(),
                      site: Optional[str] = None) -> Optional[dict]:
     """Trace+compile ``fn`` on ``example_args`` and return its program
@@ -220,9 +239,11 @@ def analyze_callable(fn, *example_args, static_argnums=(),
             jax.jit(fn, static_argnums=static_argnums)
         t0 = time.perf_counter()
         eqns = None
+        static_peak = None
         try:
             traced = jitted.trace(*example_args)
             eqns = len(traced.jaxpr.jaxpr.eqns)
+            static_peak = static_peak_of_trace(traced.jaxpr)
             compiled = traced.lower().compile()
         except AttributeError:
             # older jax without .trace(): lower directly, skip eqn count
@@ -233,6 +254,7 @@ def analyze_callable(fn, *example_args, static_argnums=(),
         return None
     analysis = analyze_compiled(compiled)
     analysis["eqns"] = eqns
+    analysis["static_peak_bytes"] = static_peak
     if site is not None:
         note_compile(site, wall_ms, eqns=eqns, analysis=analysis)
     return analysis
@@ -315,6 +337,7 @@ class AotSite:
         import jax
         self.site = name
         self.static_argnums = tuple(int(i) for i in static_argnums)
+        self.donate_argnums = tuple(int(i) for i in donate_argnums)
         self.jitted = jax.jit(fn, static_argnums=self.static_argnums or
                               None, donate_argnums=donate_argnums)
         self.record = _record(name)
@@ -387,6 +410,25 @@ class AotSite:
         self.last_dispatch_flops = self._flops_by_key.get(key)
         return compiled(*self._dynamic(args))
 
+    def _donated_mask(self, args):
+        """Donation mask over the traced program's flat invars: the
+        jitted fn's dynamic args in order, each arg's leaves marked by
+        whether its ORIGINAL argnum (static args counted, per jax.jit
+        semantics) is donated."""
+        if not self.donate_argnums:
+            return None
+        import jax
+        try:
+            mask = []
+            for i, a in enumerate(args):
+                if i in self.static_argnums:
+                    continue
+                n = len(jax.tree_util.tree_leaves(a))
+                mask.extend([i in self.donate_argnums] * n)
+            return mask
+        except Exception:                                # noqa: BLE001
+            return None
+
     def _compile(self, key, args):
         t0 = time.perf_counter()
         try:
@@ -410,6 +452,8 @@ class AotSite:
             return None
         wall_ms = (time.perf_counter() - t0) * 1e3
         analysis = analyze_compiled(compiled)
+        analysis["static_peak_bytes"] = static_peak_of_trace(
+            traced.jaxpr, self._donated_mask(args))
         note_compile(self.site, wall_ms, eqns=eqns, analysis=analysis)
         if len(self._compiled) >= self._MAX_SIGNATURES:
             oldest = next(iter(self._compiled))

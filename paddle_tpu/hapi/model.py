@@ -973,7 +973,14 @@ class Model:
         """fit()'s pre-flight: lint the built train step on the first
         batch. 'warn' logs the findings table; 'error' additionally
         raises AnalysisError on error-severity findings. Analyzer
-        crashes (not findings) never kill training."""
+        crashes (not findings) never kill training.
+
+        Also reports the step's donation-aware ``static_peak_bytes``
+        (the static-memory pass figure, ISSUE 18) — one log line before
+        any compile, plus the ``analysis/train_step_peak_bytes`` gauge —
+        so an over-HBM train step is visible from the plan, not from an
+        XLA OOM minutes later. Donation misses surface through the same
+        findings table (donation-miss pass warnings)."""
         from .. import analysis
         try:
             report = analysis.analyze_model(self, inputs, labels)
@@ -983,6 +990,18 @@ class Model:
                           f"({type(e).__name__}: {e}); continuing fit",
                           RuntimeWarning)
             return None
+        for f in report.findings:
+            if f.pass_id == "static-memory" and f.data:
+                peak = f.data.get("static_peak_bytes")
+                if peak is not None:
+                    import sys
+                    from ..framework.monitor import stat_observe
+                    stat_observe("analysis/train_step_peak_bytes", peak)
+                    print(f"[analysis] train step static peak: "
+                          f"{peak:,} B ({peak / (1 << 20):.1f} MiB, "
+                          f"donation-aware; pre-compile estimate)",
+                          file=sys.stderr)
+                break
         return analysis.apply_mode(report, mode, "the train step")
 
     def _build_eval_step(self):

@@ -63,7 +63,7 @@ objectives, multi-window burn rates, per-replica goodput),
 """
 from __future__ import annotations
 
-from .engine import GenerationEngine  # noqa: F401
+from .engine import GenerationEngine, PlanError  # noqa: F401
 from .fleet import EngineFleet  # noqa: F401
 from .flight_recorder import FlightRecorder  # noqa: F401
 from .kv_pool import KVCachePool  # noqa: F401
@@ -76,7 +76,7 @@ from .slo import SLOObjective, SLOTracker  # noqa: F401
 from .slo import attainment_from_buckets  # noqa: F401
 from .tracing import RequestTrace  # noqa: F401
 
-__all__ = ["GenerationEngine", "EngineFleet", "KVCachePool",
+__all__ = ["GenerationEngine", "PlanError", "EngineFleet", "KVCachePool",
            "PagedKVPool", "GenerationRequest", "Scheduler",
            "QueueFullError", "DeadlineExceeded", "RequestCancelled",
            "PoolCapacityError", "PoolExhaustedError", "BlockError",

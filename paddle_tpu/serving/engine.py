@@ -68,10 +68,41 @@ from .kv_pool import KVCachePool
 from .paging import PagedKVPool, PoolCapacityError
 from .scheduler import (GenerationRequest, Scheduler, _fetch)
 
-__all__ = ["GenerationEngine"]
+__all__ = ["GenerationEngine", "PlanError"]
 
 _engine_seq = 0
 _engine_seq_lock = threading.Lock()
+
+
+class PlanError(RuntimeError):
+    """The static HBM plan says this replica will not fit (ISSUE 18).
+
+    Raised at ``GenerationEngine(hbm_budget_bytes=...)`` construction —
+    BEFORE any compile — when the donation-aware liveness estimate of
+    the LARGEST decode-path bucket plus the pool+scales ledger bytes
+    exceeds the budget. Carries the full plan dict as ``.plan``
+    (``static_peak_bytes``, ``pool_bytes``, ``budget_bytes``,
+    ``peak_point``)."""
+
+    def __init__(self, message: str, plan: dict):
+        super().__init__(message)
+        self.plan = plan
+
+
+def _device_memory_limit() -> Optional[int]:
+    """Per-device HBM limit when the backend reports one, else None
+    (CPU reports nothing — no fake numbers, no default gate there).
+    Construction-time admission query, not scheduler-cycle polling —
+    the memory-stats-hot-path rule's argued exception."""
+    import jax
+    try:
+        stats = jax.devices()[0].memory_stats()  # lint: ok
+    except Exception:                            # noqa: BLE001
+        return None
+    if not stats:
+        return None
+    limit = stats.get("bytes_limit") or stats.get("bytes_reservable_limit")
+    return int(limit) if limit else None
 
 
 def _next_engine_id() -> int:
@@ -228,7 +259,8 @@ class GenerationEngine:
                  num_blocks: Optional[int] = None,
                  attention: str = "gather", kv_dtype=None,
                  spec_draft=None, spec_k: int = 4,
-                 mesh=None, mp_axis: str = "mp"):
+                 mesh=None, mp_axis: str = "mp",
+                 hbm_budget_bytes: Optional[int] = None):
         import jax
 
         from ..models.generation import build_slot_decode_fn
@@ -369,6 +401,18 @@ class GenerationEngine:
             if self._spec_k < 1:
                 raise ValueError(f"spec_k must be >= 1, got {spec_k}")
             self._init_draft(spec_draft, max_len)
+        # fit-BEFORE-compile admission (ISSUE 18): statically plan the
+        # LARGEST decode-path bucket + pool/scales ledger bytes against
+        # the HBM budget (explicit, else the device limit when the
+        # backend reports one — CPU reports none) and raise PlanError
+        # naming the fattest program point before any compile. The plan
+        # is a make_jaxpr trace of the RAW step builder — no AotSite,
+        # no probe, no registry record, zero compiles.
+        self._hbm_budget_bytes = int(hbm_budget_bytes) \
+            if hbm_budget_bytes is not None else _device_memory_limit()
+        self._plan = None
+        if self._hbm_budget_bytes is not None:
+            self._plan = self.plan_replica(self._hbm_budget_bytes)
         # per-engine compute accounting (scheduler-thread writes, host
         # ints): FLOPs of the decode programs actually DISPATCHED — a
         # paged engine runs different table-bucket programs with very
@@ -769,6 +813,160 @@ class GenerationEngine:
             np.zeros(S, np.int32), np.zeros(S, bool),
             np.ones(S, np.float32), self._key,
             passes=passes, name=f"serving.decode[{S} slots]")
+
+    def plan_replica(self, hbm_budget_bytes: Optional[int] = None,
+                     top_k: int = 4) -> dict:
+        """Static fit-before-compile HBM plan of this replica's worst
+        case (ISSUE 18): donation-aware liveness
+        (``analysis/liveness.py``) over the LARGEST decode-path bucket
+        this engine can dispatch — the spec-verify / fused step at the
+        full-slot q bucket and max table bucket, the gather decode at
+        the max table bucket, or THE dense decode step — with the
+        pool+scales ledger bytes attributed PER DEVICE (a head-sharded
+        pool's global-shape operand is swapped for its per-device
+        ``capacity_bytes``). Trace-only: the RAW step builder goes
+        through ``jax.make_jaxpr`` with no AotSite, no probe and no
+        registry record, so ``compile/count`` does not move — proven by
+        the bench.py dry-run canary. Raises :class:`PlanError` naming
+        the fattest program point when ``hbm_budget_bytes`` (or the
+        construction-time budget) is exceeded; the same call is the
+        elastic scale-out path's dry admission check."""
+        from ..analysis import liveness
+
+        budget = int(hbm_budget_bytes) if hbm_budget_bytes is not None \
+            else self._hbm_budget_bytes
+        S = self._pool.num_slots
+        params, buffers = self._params, self._buffers
+        pool = self._pool
+        scales = ()
+        if self._paged and pool.quantized:
+            scales = (pool.scales,)
+
+        if self._fused:
+            from ..ops.ragged_paged_attention import BLOCK_Q
+            T = pool.max_table_len
+            if self._spec:
+                from ..models.generation import build_spec_verify_fn
+                K = self._spec_k
+                # each speculating slot contributes k+1 ragged rows,
+                # padded to whole q blocks
+                blocks_per_slot = -(-(K + 1) // BLOCK_Q)
+                Q = self._q_bucket(S * blocks_per_slot * BLOCK_Q)
+                V = self._gpt.cfg.vocab_size
+                fn = build_spec_verify_fn(
+                    self._model, S, Q, K, T, pool.block_size,
+                    top_k=self._top_k, top_p=self._top_p,
+                    quantized=pool.quantized, qmax=pool.qmax or 127.0)
+                args = (params, buffers, pool.data, *scales,
+                        np.zeros(Q, np.int32), np.zeros(Q, np.int32),
+                        np.zeros(Q, np.int32), np.zeros(Q, np.int32),
+                        np.zeros(Q // BLOCK_Q, np.int32),
+                        np.zeros(S, np.int32), np.zeros(S, np.int32),
+                        np.zeros((S, T), np.int32), np.zeros(S, np.int32),
+                        np.zeros(S, np.int32), np.zeros(S, np.int32),
+                        np.zeros(S, np.int32), np.zeros((S, K), np.int32),
+                        np.zeros((S, K, V), np.float32),
+                        np.zeros(S, bool), np.ones(S, np.float32),
+                        self._key)
+                flavor, site = "spec", f"spec_verify[q{Q},t{T}]"
+            else:
+                Q = self._q_bucket(S * BLOCK_Q)
+                if self._mesh is not None:
+                    from ..models.generation import \
+                        build_sharded_fused_step_fn
+                    fn = build_sharded_fused_step_fn(
+                        self._model, S, Q, T, pool.block_size,
+                        self._mesh, mp_axis=self._mp_axis,
+                        top_k=self._top_k, top_p=self._top_p)
+                else:
+                    from ..models.generation import build_fused_step_fn
+                    fn = build_fused_step_fn(
+                        self._model, S, Q, T, pool.block_size,
+                        top_k=self._top_k, top_p=self._top_p,
+                        quantized=pool.quantized, qmax=pool.qmax or 127.0)
+                args = (params, buffers, pool.data, *scales,
+                        np.zeros(Q, np.int32), np.zeros(Q, np.int32),
+                        np.zeros(Q, np.int32), np.zeros(Q, np.int32),
+                        np.zeros(Q // BLOCK_Q, np.int32),
+                        np.zeros(S, np.int32), np.zeros(S, np.int32),
+                        np.zeros((S, T), np.int32), np.zeros(S, np.int32),
+                        np.zeros(S, np.int32), np.zeros(S, np.int32),
+                        np.zeros(S, bool), np.ones(S, np.float32),
+                        self._key)
+                flavor, site = "fused", f"fused_step[q{Q},t{T}]"
+            donate = (2, 3) if pool.quantized else (2,)
+        elif self._paged:
+            T = pool.max_table_len
+            Q = None
+            if self._mesh is not None:
+                from ..models.generation import \
+                    build_sharded_paged_decode_fn
+                fn = build_sharded_paged_decode_fn(
+                    self._model, S, T, pool.block_size, self._mesh,
+                    mp_axis=self._mp_axis, top_k=self._top_k,
+                    top_p=self._top_p)
+            else:
+                from ..models.generation import build_paged_decode_fn
+                fn = build_paged_decode_fn(
+                    self._model, S, T, pool.block_size,
+                    top_k=self._top_k, top_p=self._top_p,
+                    quantized=pool.quantized, qmax=pool.qmax or 127.0)
+            args = (params, buffers, pool.data, *scales,
+                    np.zeros(S, np.int32), np.zeros(S, np.int32),
+                    np.zeros(S, np.int32), np.zeros((S, T), np.int32),
+                    np.zeros(S, bool), np.ones(S, np.float32), self._key)
+            donate = (2, 3) if pool.quantized else (2,)
+            flavor, site = "paged", f"paged_decode[t{T}]"
+        else:
+            T = Q = None
+            fn = self._decode_jit       # tracer-transparent AotSite
+            args = (params, buffers, pool.data,
+                    np.zeros(S, np.int32), np.zeros(S, np.int32),
+                    np.zeros(S, np.int32), np.zeros(S, bool),
+                    np.ones(S, np.float32), self._key)
+            donate = (2,)
+            flavor, site = "dense", "decode"
+
+        rep = liveness.callable_liveness(fn, *args, donate_argnums=donate,
+                                         top_k=top_k)
+
+        # per-device pool attribution: the step's operand carries the
+        # pool at its GLOBAL shape; a head-sharded engine holds only
+        # capacity_bytes of it per device (paging.py's ledger figure)
+        def _nbytes(a):
+            return int(np.prod(a.shape)) * np.dtype(a.dtype).itemsize
+
+        operand_pool = _nbytes(pool.data) + sum(_nbytes(s) for s in scales)
+        per_device_pool = pool.capacity_bytes if self._paged \
+            else operand_pool
+        total = rep.static_peak_bytes - operand_pool + per_device_pool
+
+        pk = rep.peak
+        plan = {
+            "site": f"serving.{site}[{S} slots]#{self._eid}",
+            "flavor": flavor, "q_bucket": Q, "table_bucket": T,
+            "step_peak_bytes": int(rep.static_peak_bytes),
+            "pool_bytes": int(per_device_pool),
+            "static_peak_bytes": int(total),
+            "budget_bytes": budget,
+            "fits": None if budget is None else bool(total <= budget),
+            "headroom_bytes": None if budget is None
+            else int(budget - total),
+            "peak_point": pk.as_dict() if pk else None,
+            "timeline": [p.as_dict() for p in rep.timeline],
+        }
+        if plan["fits"] is False:
+            raise PlanError(
+                f"replica does not fit: static peak {total:,} B "
+                f"(largest {flavor} bucket"
+                f"{f' q{Q}' if Q else ''}{f' t{T}' if T else ''} + "
+                f"pool ledger {per_device_pool:,} B) exceeds "
+                f"hbm_budget_bytes={budget:,} — fattest program point: "
+                f"{pk.primitive if pk else 'n/a'} with "
+                f"{pk.live_bytes:,} B live at "
+                f"{(pk.source if pk else None) or 'unknown source'}",
+                plan)
+        return plan
 
     # -- device side (called from the scheduler thread only) ---------------
     def _prefill_fn(self, bucket: int):

@@ -699,3 +699,302 @@ def test_examples_entry_points_clean():
         assert r.ok(), r.table()
     finally:
         paddle.disable_static()
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 18: liveness core + static-memory / donation-miss /
+# sharding-consistency passes + the --budget / --json CLI surface
+# ---------------------------------------------------------------------------
+
+def test_liveness_known_byte_math():
+    """Hand-checkable program: two pinned 4 KiB args, a 4 KiB
+    intermediate and a 4 KiB product live together at the mul — the
+    peak is exactly 16 KiB, blamed on the mul."""
+    import jax.numpy as jnp
+    from paddle_tpu.analysis import liveness
+
+    def f(a, b):
+        c = a + b
+        return (c * 2.0).sum()
+
+    rep = liveness.callable_liveness(f, jnp.ones((32, 32), jnp.float32),
+                                     jnp.ones((32, 32), jnp.float32))
+    assert rep.arg_bytes == 2 * 4096
+    assert rep.static_peak_bytes == 4 * 4096
+    assert rep.peak.primitive == "mul"
+    assert rep.timeline[0].live_bytes == rep.static_peak_bytes
+    d = rep.as_dict()
+    assert d["static_peak_bytes"] == rep.static_peak_bytes
+    assert d["peak"]["primitive"] == "mul"
+
+
+def test_liveness_donation_frees_after_last_use():
+    """A donated 2 MiB state must stop being charged past its last
+    use: the donated trace peaks one full buffer lower."""
+    import jax.numpy as jnp
+    from paddle_tpu.analysis import liveness
+
+    def step(s, x):
+        s2 = s + x.sum()
+        return s2 * 2.0          # s is dead here; s2 and out live
+
+    big = jnp.ones((512, 1024), jnp.float32)          # 2 MiB
+    x = jnp.ones((4,), jnp.float32)
+    big_bytes = big.size * big.dtype.itemsize
+    r0 = liveness.callable_liveness(step, big, x)
+    r1 = liveness.callable_liveness(step, big, x, donate_argnums=(0,))
+    # the peak moves to a different eqn once s is freed, so the saving
+    # is one full buffer give or take the scalar sum
+    assert big_bytes - 64 <= r0.static_peak_bytes - r1.static_peak_bytes \
+        <= big_bytes
+    assert r1.donated_bytes == big_bytes
+
+
+def test_liveness_crosscheck_contract():
+    from paddle_tpu.analysis import liveness
+
+    # backend silent -> None, never a fake verdict
+    assert liveness.crosscheck(100, 10, 10, None) is None
+    assert liveness.crosscheck(None, 10, 10, 10) is None
+    cc = liveness.crosscheck(100, 50, 25, 25)
+    assert cc["ok"] and cc["ratio"] == 1.0 and cc["xla_bytes"] == 100
+    assert not liveness.crosscheck(100, 1, 1, 1)["ok"]
+
+
+def test_static_memory_pass_reports_peak():
+    import jax.numpy as jnp
+
+    def f(a):
+        return (a * 2.0).sum()
+
+    r = analysis.analyze(f, jnp.ones((64, 64), jnp.float32))
+    infos = _findings(r, "static-memory")
+    assert len(infos) == 1 and infos[0].severity == "info"
+    assert infos[0].data["static_peak_bytes"] > 0
+    assert "static peak" in infos[0].message
+    assert "fattest point" in infos[0].message
+    assert r.ok()                     # info never fails the bill
+
+
+def test_donation_miss_catches_undonated_dying_state():
+    import jax.numpy as jnp
+
+    def step(s, x):
+        s2 = s + x.sum()
+        return s2 * 2.0
+
+    big = jnp.ones((512, 1024), jnp.float32)          # 2 MiB, dies early
+    x = jnp.ones((4,), jnp.float32)
+    r = analysis.analyze(step, big, x)
+    warns = _findings(r, "donation-miss", "warning")
+    assert len(warns) == 1, r.table()
+    assert warns[0].data["argnum"] == 0
+    assert warns[0].data["saving_bytes"] > 0
+    assert "not donated" in warns[0].message
+    assert "donate_argnums" in warns[0].fix_hint
+    # donated: the miss disappears
+    r2 = analysis.analyze(step, big, x, donate_argnums=(0,))
+    assert not _findings(r2, "donation-miss"), r2.table()
+
+
+def test_donation_miss_prices_dead_donation():
+    """The old donation-safety boolean dead-donation warning now lives
+    here, priced in bytes."""
+    import jax.numpy as jnp
+
+    def step(dead, x):
+        return x * 2.0            # donated input never read
+
+    big = jnp.ones((512, 1024), jnp.float32)
+    r = analysis.analyze(step, big, jnp.ones((8,), jnp.float32),
+                         donate_argnums=(0,))
+    warns = _findings(r, "donation-miss", "warning")
+    assert warns and "never read" in warns[0].message
+    assert warns[0].data["kind"] == "dead"
+    assert warns[0].data["bytes"] == big.size * big.dtype.itemsize
+    # small invars below the floor stay unflagged both ways
+    r2 = analysis.analyze(step, jnp.ones((8,), jnp.float32),
+                          jnp.ones((8,), jnp.float32))
+    assert not _findings(r2, "donation-miss")
+
+
+def test_donation_miss_silent_when_lifetime_spans_peak():
+    """An invar that stays live to the end (it IS an output) cannot be
+    freed by donation — the honest re-scan must not flag it."""
+    import jax.numpy as jnp
+
+    def step(s, x):
+        return s + x              # s's aval is the output's aval
+
+    big = jnp.ones((512, 1024), jnp.float32)
+    r = analysis.analyze(step, big, big)
+    misses = [f for f in _findings(r, "donation-miss")
+              if f.data and f.data.get("kind") == "miss"
+              and f.data.get("saving_bytes", 0) <= 0]
+    assert not misses, r.table()
+
+
+def test_sharding_consistency_flags_large_replicated_operand():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    mesh = _dp_mesh()
+    table = jnp.ones((512, 1024), jnp.float32)        # 2 MiB replicated
+
+    def body(x, t):
+        return x + t.sum()
+
+    fn = jax.shard_map(body, mesh=mesh, in_specs=(P("dp"), P()),
+                       out_specs=P("dp"), check_vma=False)
+    r = analysis.analyze(fn, jnp.ones((8,), jnp.float32), table)
+    warns = _findings(r, "sharding-consistency", "warning")
+    assert len(warns) == 1, r.table()
+    assert warns[0].data["bytes"] == 2 * 1024 * 1024
+    assert warns[0].data["per_device_sharded_bytes"] \
+        == warns[0].data["bytes"] // 4
+    assert "fully replicated" in warns[0].message
+    # sharding the table silences it
+    fn2 = jax.shard_map(body, mesh=mesh, in_specs=(P("dp"), P("dp")),
+                        out_specs=P("dp"), check_vma=False)
+    r2 = analysis.analyze(fn2, jnp.ones((8,), jnp.float32), table)
+    assert not _findings(r2, "sharding-consistency"), r2.table()
+
+
+def test_sharding_consistency_scoped_rs_ag_pairing():
+    """The PR-10 rs/ag pairing contract enforced INSIDE the shard_map
+    body: a scatter closed on the wrong dimension is an error naming
+    the mesh; the properly-paired body is clean."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    mesh = _dp_mesh()
+
+    def bad(x):
+        s = jax.lax.psum_scatter(x, "dp", scatter_dimension=0,
+                                 tiled=True)
+        return jax.lax.all_gather(s, "dp", axis=1, tiled=True)
+
+    fn = jax.shard_map(bad, mesh=mesh, in_specs=P(), out_specs=P(),
+                       check_vma=False)
+    r = analysis.analyze(fn, jnp.ones((8, 2), jnp.float32))
+    errs = _findings(r, "sharding-consistency", "error")
+    assert errs and "PR-10 pairing contract" in errs[0].message
+    assert errs[0].primitive == "reduce_scatter"
+
+    def good(x):
+        s = jax.lax.psum_scatter(x, "dp", scatter_dimension=0,
+                                 tiled=True)
+        return jax.lax.all_gather(s * 2.0, "dp", axis=0, tiled=True)
+
+    fn2 = jax.shard_map(good, mesh=mesh, in_specs=P(), out_specs=P(),
+                        check_vma=False)
+    r2 = analysis.analyze(fn2, jnp.ones((8, 2), jnp.float32))
+    assert not _findings(r2, "sharding-consistency", "error"), r2.table()
+
+
+def test_spec_verify_bucket_analyzes_clean():
+    """Satellite: the clean-bill contract extended to the speculative
+    verify program (largest built (q, table) bucket)."""
+    from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining
+    from paddle_tpu.ops.ragged_paged_attention import BLOCK_Q
+    from paddle_tpu.serving import GenerationEngine
+
+    paddle.framework.random.seed(0)
+    model = GPTForPretraining(GPTConfig.tiny())
+    model.eval()
+    eng = GenerationEngine(model, num_slots=4, max_len=64,
+                           kv_layout="paged", block_size=8,
+                           attention="fused", spec_draft=model, spec_k=3)
+    try:
+        eng._spec_step_fn(BLOCK_Q, 2)     # seed one verify bucket
+        r = eng.analyze()
+        assert "spec_verify" in r.target
+        assert r.ok(), r.table()
+        assert _findings(r, "static-memory")
+    finally:
+        eng.close()
+
+
+def test_sharded_fused_step_analyzes_clean():
+    """Satellite: the clean-bill contract extended to the mesh=
+    sharded fused step — the sharding-consistency pass included (the
+    head-sharded pool must NOT be flagged as replicated)."""
+    import jax
+    from jax.sharding import Mesh
+    from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining
+    from paddle_tpu.serving import GenerationEngine
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 devices")
+    mesh = Mesh(np.array(jax.devices()[:2]), ("mp",))
+    paddle.framework.random.seed(0)
+    model = GPTForPretraining(GPTConfig.tiny())
+    model.eval()
+    eng = GenerationEngine(model, num_slots=4, max_len=64,
+                           kv_layout="paged", block_size=8,
+                           attention="fused", mesh=mesh)
+    try:
+        r = eng.analyze()
+        assert "fused_step" in r.target
+        assert r.ok(), r.table()
+    finally:
+        eng.close()
+
+
+def test_aot_site_records_static_peak():
+    """Every AotSite compile records the donation-aware liveness figure
+    NEXT TO the XLA memory figures, and the two bracket each other
+    within the documented tolerance."""
+    import jax.numpy as jnp
+    from paddle_tpu.analysis import liveness
+    from paddle_tpu.framework import program_registry
+
+    site = program_registry.aot_site(
+        "test/static_peak_site",
+        lambda s, x: (s + x, (s * x).sum()),
+        donate_argnums=(0,))
+    site(jnp.ones((64, 64), jnp.float32), jnp.ones((64, 64), jnp.float32))
+    rec = program_registry.get("test/static_peak_site")
+    assert rec.static_peak_bytes is not None and rec.static_peak_bytes > 0
+    cc = liveness.crosscheck(rec.static_peak_bytes, rec.argument_bytes,
+                             rec.output_bytes, rec.temp_bytes)
+    if cc is not None:                # CPU reports; other backends may not
+        assert cc["ok"], cc
+
+
+def test_cli_json_and_budget_gate():
+    """Satellites: --json machine-readable findings and the --budget
+    fit-before-compile gate's documented exit-code contract."""
+    import json as _json
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="",
+               PYTHONPATH=REPO)
+    base = [sys.executable, "-m", "paddle_tpu.analysis",
+            "__graft_entry__:entry"]
+    res = subprocess.run(base + ["--json"], env=env, cwd=REPO,
+                         capture_output=True, text=True, timeout=300)
+    assert res.returncode == 0, res.stderr[-1500:]
+    doc = _json.loads(res.stdout)
+    assert doc["ok"] is True
+    assert doc["static_peak_bytes"] > 0
+    assert doc["budget_bytes"] is None and doc["fits_budget"] is None
+    assert any(f["pass"] == "static-memory" and f["data"]
+               for f in doc["findings"])
+
+    # over budget: exit 1, --json unchanged in shape, fits_budget False
+    res = subprocess.run(base + ["--json", "--budget", "1"], env=env,
+                         cwd=REPO, capture_output=True, text=True,
+                         timeout=300)
+    assert res.returncode == 1, res.stdout
+    doc = _json.loads(res.stdout)
+    assert doc["fits_budget"] is False and doc["ok"] is False
+    assert doc["budget_bytes"] == 1
+
+    # generous budget: exit 0 with the human-readable verdict
+    res = subprocess.run(base + ["--budget", str(1 << 40)], env=env,
+                         cwd=REPO, capture_output=True, text=True,
+                         timeout=300)
+    assert res.returncode == 0, res.stdout
+    assert "fits" in res.stdout

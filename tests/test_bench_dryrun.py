@@ -200,3 +200,23 @@ def test_dry_run_emits_metrics_summary():
     mc = out["mp"]
     assert mc["skipped"] is False, mc
     assert mc["kv_bytes_per_device"] * 2 == mc["single_device_kv_bytes"], mc
+
+    # ISSUE-18 static memory planner: the donation-aware liveness
+    # estimate bracketed XLA's memory_analysis on EVERY program the dry
+    # run compiled where both figures exist (a real GPT train step and
+    # the serving buckets among them), the doctored 64 KiB budget made
+    # engine construction raise PlanError naming the fattest program
+    # point with compile/count UNCHANGED (fit-before-compile), and the
+    # generous budget attached a fitting plan
+    assert out["checks"]["planner_crosscheck"] is True, out
+    assert out["checks"]["planner_gate_raises"] is True, out
+    assert out["checks"]["planner_gate_zero_compiles"] is True, out
+    assert out["checks"]["planner_generous_fits"] is True, out
+    pl = out["planner"]
+    assert pl["n_crosschecked"] >= 10, pl
+    assert any("train_step" in s for s in pl["ratios"]), pl
+    assert any(s.startswith("serving/") for s in pl["ratios"]), pl
+    assert pl["gate"]["raised"] is True, pl
+    assert pl["gate"]["peak_point"], pl
+    assert pl["gate"]["plan"]["fits"] is False, pl
+    assert pl["gate_extra_compiles"] == 0, pl

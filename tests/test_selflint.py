@@ -263,3 +263,31 @@ def test_metric_naming_rule():
     # suppression honored
     sup = 'stat_observe("op_decode_time", 3)  # lint: ok\n'
     assert lint_source("t.py", sup, "serving/engine.py") == []
+
+
+def test_analysis_no_device_rule():
+    """ISSUE 18: paddle_tpu/analysis must stay a pure TRACE-level
+    layer — the fit-before-compile planner's zero-compile guarantee
+    rests on no device/compile API ever creeping into it."""
+    src = ("import jax\n"
+           "def plan(fn, x):\n"
+           "    jitted = jax.jit(fn)\n"
+           "    exe = jitted.lower(x).compile()\n"
+           "    y = jax.device_put(x)\n"
+           "    return y.block_until_ready()\n")
+    out = lint_source("t.py", src, "analysis/liveness.py")
+    assert [f.rule for f in out] == ["analysis-no-device"] * 4
+    assert [f.line for f in out] == [3, 4, 5, 6]
+    # the same calls OUTSIDE analysis/ are someone else's business
+    # (other rules may flag them for their own reasons, this one not)
+    other = lint_source("t.py", src, "framework/program_registry.py")
+    assert not [f for f in other if f.rule == "analysis-no-device"]
+    # re.compile is text processing, not XLA
+    ok = "import re\nPAT = re.compile(r'x+')\n"
+    assert lint_source("t.py", ok, "analysis/core.py") == []
+    # suppression with justification is honored, line by line
+    sup = src.replace("jax.device_put(x)",
+                      "jax.device_put(x)  # lint: ok")
+    out = lint_source("t.py", sup, "analysis/liveness.py")
+    assert 5 not in [f.line for f in out]
+    assert [f.line for f in out] == [3, 4, 6]
