@@ -1304,6 +1304,198 @@ def _serve_load_engine(kind, model, schedule, slo_ms, num_slots=8):
     return summary
 
 
+def _serve_load_http(model, schedule, slo_ms, num_slots=8):
+    """``--serve-load --http``: the front-door leg — the SAME seeded
+    interactive schedule, but every request rides REAL sockets through
+    ``FrontDoor`` (OpenAI-style /v1/completions, SSE streaming), twice:
+
+    * **baseline** — the interactive tenant alone; wire-side TTFT is
+      the stamp of the FIRST SSE chunk arriving at the client;
+    * **flood** — the same schedule again while closed-loop batch
+      tenants hammer the batch lane and an over-budget tenant draws
+      429s off its token bucket.
+
+    The gates: greedy tokens over HTTP byte-identical to an in-process
+    submit, interactive SLO attainment under flood within tolerance of
+    the no-flood baseline with batch throughput > 0 (the weighted-fair
+    admission claim, measured at the socket), per-tenant 429 shed
+    counted in the artifact, and zero decode retraces."""
+    import threading
+    import urllib.error
+    import urllib.request
+
+    import numpy as np
+
+    from paddle_tpu.framework import trace_probe
+    from paddle_tpu.framework.monitor import _percentile
+    from paddle_tpu.serving import FrontDoor, GenerationEngine
+
+    eng = GenerationEngine(model, num_slots=num_slots, max_len=64,
+                           min_bucket=8, kv_layout="paged", block_size=8)
+    # warm every bucket the schedule can touch before the clock starts
+    # (same discipline as the in-process legs)
+    for plen, mnew in ((4, 2), (12, 2), (28, 2), (40, 14)):
+        eng.submit(np.full(plen, 1, np.int32),
+                   max_new_tokens=mnew).result(timeout=600)
+    # no global rate limit — only the deliberately starved tenant sheds
+    door = FrontDoor(eng, tenant_limits={"starved": (10.0, 40.0)})
+    srv = door.start()
+    base = srv.url
+
+    def post(doc, tenant, timeout=600):
+        req = urllib.request.Request(
+            base + "/v1/completions", data=json.dumps(doc).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-Tenant": tenant})
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as r:
+                return r.status, json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    def stream_request(doc, tenant, out, timeout=600):
+        """POST stream=true; record wire TTFT (first SSE chunk) and the
+        token ids — the client-side view of the lane."""
+        req = urllib.request.Request(
+            base + "/v1/completions",
+            data=json.dumps(dict(doc, stream=True)).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-Tenant": tenant})
+        t0 = time.perf_counter()
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as r:
+                t_first, toks, fin = None, [], None
+                for line in r:
+                    if not line.startswith(b"data: "):
+                        continue
+                    payload = line[len(b"data: "):].strip()
+                    if payload == b"[DONE]":
+                        break
+                    if t_first is None:
+                        t_first = time.perf_counter()
+                    chunk = json.loads(payload)["choices"][0]
+                    if chunk["token_id"] is not None:
+                        toks.append(chunk["token_id"])
+                    fin = fin or chunk["finish_reason"]
+            out.append({"ttft_ms": None if t_first is None
+                        else (t_first - t0) * 1e3,
+                        "tokens": toks, "finish": fin})
+        except Exception as e:                           # noqa: BLE001
+            out.append({"error": repr(e)})
+
+    def run_phase(flood: bool):
+        """Drive the interactive schedule open-loop over the wire;
+        with ``flood``, closed-loop batch clients run concurrently."""
+        results, threads = [], []
+        stop = threading.Event()
+        batch_done = [0]
+
+        def batch_client():
+            rng = np.random.RandomState(99)
+            while not stop.is_set():
+                st, _doc = post(
+                    {"prompt": [int(t) for t in
+                                rng.randint(1, 200, 12)],
+                     "max_tokens": 12, "lane": "batch"}, "bulk-corp")
+                if st == 200:
+                    batch_done[0] += 1
+
+        floods = []
+        if flood:
+            floods = [threading.Thread(target=batch_client, daemon=True)
+                      for _ in range(3)]
+            for t in floods:
+                t.start()
+        t_start = time.perf_counter()
+        for off, ids, max_new in schedule:
+            delay = t_start + off - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            th = threading.Thread(
+                target=stream_request,
+                args=({"prompt": [int(t) for t in ids],
+                       "max_tokens": max_new, "lane": "interactive"},
+                      "alice", results), daemon=True)
+            th.start()
+            threads.append(th)
+        for th in threads:
+            th.join(timeout=600)
+        stop.set()
+        for t in floods:
+            t.join(timeout=600)
+        wall = time.perf_counter() - t_start
+        ok = [r for r in results if "error" not in r
+              and r["ttft_ms"] is not None]
+        ttft = sorted(r["ttft_ms"] for r in ok)
+        good = sum(1 for r in ok if r["ttft_ms"] <= slo_ms)
+        return {"completed": len(ok), "failed": len(results) - len(ok),
+                "wall_sec": round(wall, 3),
+                "ttft_ms": {"p50": round(_percentile(ttft, 0.5), 2),
+                            "p95": round(_percentile(ttft, 0.95), 2),
+                            "count": len(ttft)} if ttft else None,
+                "slo_attainment": round(good / max(1, len(schedule)), 4),
+                "goodput_rps": round(good / wall, 2),
+                "batch_completed": batch_done[0]}
+
+    baseline = run_phase(flood=False)
+    flood = run_phase(flood=True)
+
+    # per-tenant 429 shed: the starved tenant's bucket admits ~1 of
+    # these 40-token requests, the rest draw 429 + Retry-After
+    shed_429 = 0
+    retry_after_ok = True
+    for _ in range(6):
+        st, doc = post({"prompt": [7] * 20, "max_tokens": 20},
+                       "starved")
+        if st == 429:
+            shed_429 += 1
+            retry_after_ok = retry_after_ok and \
+                doc["error"].get("retry_after_s", 0) > 0
+
+    # greedy parity, quiesced: the wire answer IS the in-process answer
+    parity = True
+    for _off, ids, max_new in schedule[:3]:
+        st, doc = post({"prompt": [int(t) for t in ids],
+                        "max_tokens": max_new}, "alice")
+        h = eng.submit(ids, max_new_tokens=max_new, tenant="alice")
+        inproc = [int(t) for t in h.stream()]
+        parity = parity and st == 200 \
+            and doc["choices"][0]["token_ids"] == inproc
+
+    stats = eng.stats()
+    door_stats = door.stats()
+    srv.close()
+    door.close()
+    eng.close()
+    sites = {k: v for k, v in trace_probe.snapshot().items()
+             if k.startswith("serving/")
+             and k.endswith(f"#{eng._eid}")}
+    tol = 0.15                       # shared-box attainment jitter
+    return {
+        "requests": len(schedule),
+        "completed": flood["completed"],
+        "failed": flood["failed"] + baseline["failed"],
+        "shed": shed_429,            # artifact-shape parity with legs
+        "shed_429_per_tenant": door_stats["shed"],
+        "retry_after_present": retry_after_ok,
+        "slo_ms": slo_ms,
+        "baseline": baseline,
+        "flood": flood,
+        "ttft_ms": flood["ttft_ms"],
+        "slo_attainment": flood["slo_attainment"],
+        "goodput_rps": flood["goodput_rps"],
+        "batch_completed": flood["batch_completed"],
+        "wdrr_holds": flood["slo_attainment"]
+        >= baseline["slo_attainment"] - tol
+        and flood["batch_completed"] > 0,
+        "parity": parity,
+        "zero_decode_retraces": bool(sites) and all(
+            s["traces"] == 1 and not s["causes"] for s in sites.values()),
+        "tenants": stats.get("tenants"),
+        "frontdoor": door_stats,
+    }
+
+
 def serve_load():
     """``bench.py --serve-load``: the serving SLO load harness
     (OPEN-loop — arrivals follow the seeded clock, never the responses,
@@ -1316,13 +1508,26 @@ def serve_load():
     preemption/eviction/prefix-hit rates, zero-retrace check — into
     ``BENCH_serve_load.json``. This is the measurement every future
     serving claim ("paged admits more", "spec decode is faster")
-    reports against; ROADMAP "Production front door + load harness"."""
+    reports against; ROADMAP "Production front door + load harness".
+
+    ``--http`` reroutes the same seeded schedule through the
+    :class:`~paddle_tpu.serving.FrontDoor` over REAL sockets instead —
+    interactive SSE clients racing a batch-lane flood and a
+    rate-limited tenant drawing 429s — and gates on greedy wire/
+    in-process token parity, flood-proof interactive attainment
+    (weighted-fair admission), per-tenant shed counts and zero decode
+    retraces."""
     import argparse
 
     import numpy as np
 
     ap = argparse.ArgumentParser()
     ap.add_argument("--serve-load", action="store_true")
+    ap.add_argument("--http", action="store_true",
+                    help="drive the schedule through the HTTP front "
+                         "door over real sockets (mixed-tenant: "
+                         "interactive SSE clients vs a batch-lane "
+                         "flood vs a rate-limited 429 tenant)")
     ap.add_argument("--rate", type=float, default=32.0,
                     help="mean arrival rate, requests/sec")
     ap.add_argument("--requests", type=int, default=48)
@@ -1353,6 +1558,20 @@ def serve_load():
         out["device_kind"] = _device_kind()
     except Exception:                                  # noqa: BLE001
         out["device_kind"] = "unknown"
+    if args.http:
+        # the front-door leg subsumes the wire path: the whole seeded
+        # schedule goes through real sockets, mixed-tenant
+        out["engines"]["http"] = _serve_load_http(
+            model, schedule, args.slo_ms, num_slots=args.slots)
+        out["value"] = out["engines"]["http"]["goodput_rps"]
+        h = out["engines"]["http"]
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=2)
+        print(json.dumps(out), flush=True)
+        ok = (h["parity"] and h["wdrr_holds"] and h["shed"] > 0
+              and h["retry_after_present"] and h["completed"] > 0
+              and h["zero_decode_retraces"])
+        sys.exit(0 if ok else 1)
     for kind in ("dense", "paged"):
         out["engines"][kind] = _serve_load_engine(
             kind, model, schedule, args.slo_ms, num_slots=args.slots)
@@ -1938,7 +2157,11 @@ def dry_run():
     injected-inf fit in ``warn`` mode trips the NaN/Inf sentinel at the
     exact step within one flush window, dumps a round-tripping anomaly
     postmortem JSON, and keeps ``hapi/host_sync`` at the PR-2 windowed
-    budget. Prints the stats summary to stderr and ONE JSON line to
+    budget. PR-19 addition: the HTTP front door on an ephemeral port —
+    non-streamed /v1/completions byte-identical to an in-process greedy
+    submit, exact SSE framing, a 429 off the per-tenant token bucket
+    with Retry-After, and a malformed body answered 400 without
+    killing the server thread. Prints the stats summary to stderr and ONE JSON line to
     stdout; exits nonzero when any assertion fails, so CI catches an
     instrumentation or fast-path regression before it costs a real
     benchmark round."""
@@ -2347,6 +2570,91 @@ def dry_run():
             }
 
         serve_load_canary = _serve_load_canary()
+
+        # front-door canary (PR 19): the OpenAI-style /v1/completions
+        # surface on an ephemeral port — one non-streamed request whose
+        # wire tokens match an in-process submit exactly (greedy
+        # parity), one SSE stream with correct framing (per-token data:
+        # chunks, a finish_reason chunk, the [DONE] sentinel), one
+        # rate-limited tenant drawing a 429 with Retry-After, and a
+        # malformed body answered 400 with the server thread surviving
+        # to serve the next request.
+        def _frontdoor_canary():
+            import urllib.error
+            import urllib.request
+
+            from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining
+            from paddle_tpu.serving import FrontDoor, GenerationEngine
+
+            paddle.framework.random.seed(0)
+            m = GPTForPretraining(GPTConfig.tiny())
+            m.eval()
+            eng = GenerationEngine(m, num_slots=2, max_len=32,
+                                   min_bucket=8)
+            door = FrontDoor(eng, tenant_limits={"starved": (5.0, 12.0)})
+            srv = door.start()
+
+            def post(doc, tenant="canary", raw=None):
+                req = urllib.request.Request(
+                    srv.url + "/v1/completions",
+                    data=raw if raw is not None
+                    else json.dumps(doc).encode(),
+                    headers={"Content-Type": "application/json",
+                             "X-Tenant": tenant})
+                try:
+                    with urllib.request.urlopen(req, timeout=120) as r:
+                        return r.status, json.loads(r.read())
+                except urllib.error.HTTPError as e:
+                    return e.code, json.loads(e.read())
+
+            prompt = [3, 1, 4, 1, 5]
+            st, doc = post({"prompt": prompt, "max_tokens": 6})
+            inproc = [int(t) for t in
+                      eng.submit(prompt, max_new_tokens=6).stream()]
+            roundtrip = (st == 200
+                         and doc["choices"][0]["token_ids"] == inproc
+                         and doc["usage"]["completion_tokens"] == 6)
+
+            req = urllib.request.Request(
+                srv.url + "/v1/completions",
+                data=json.dumps({"prompt": prompt, "max_tokens": 4,
+                                 "stream": True}).encode(),
+                headers={"Content-Type": "application/json",
+                         "X-Tenant": "canary"})
+            with urllib.request.urlopen(req, timeout=120) as r:
+                ctype = r.headers["Content-Type"]
+                frames = [f[len("data: "):] for f in
+                          r.read().decode().strip().split("\n\n")]
+            toks = [json.loads(f)["choices"][0]["token_id"]
+                    for f in frames[:-2]]
+            final = json.loads(frames[-2])["choices"][0]
+            sse_ok = (ctype == "text/event-stream"
+                      and frames[-1] == "[DONE]"
+                      and toks == inproc[:4]
+                      and final["finish_reason"] == "length")
+
+            st1, _ = post({"prompt": [7] * 6, "max_tokens": 6},
+                          tenant="starved")   # drains the 12-token burst
+            st2, doc2 = post({"prompt": [7] * 6, "max_tokens": 6},
+                             tenant="starved")
+            shed_ok = (st1 == 200 and st2 == 429
+                       and doc2["error"]["type"] == "rate_limit_exceeded"
+                       and doc2["error"]["retry_after_s"] > 0)
+
+            st3, doc3 = post(None, raw=b"{not json")
+            st4, _doc4 = post({"prompt": prompt, "max_tokens": 2})
+            survives = (st3 == 400
+                        and doc3["error"]["type"]
+                        == "invalid_request_error"
+                        and st4 == 200)
+            door_stats = door.stats()
+            door.close()
+            eng.close()
+            return {"roundtrip": roundtrip, "sse": sse_ok,
+                    "shed_429": shed_ok, "survives_malformed": survives,
+                    "stats": door_stats}
+
+        frontdoor_canary = _frontdoor_canary()
 
         # numerics canary (ISSUE 10): the training numerics health layer
         # end to end — a clean fit with numerics='record' leaves
@@ -2862,6 +3170,17 @@ def dry_run():
         "ops_server_healthz": serve_load_canary["ops_healthz"],
         "ops_server_tracez": serve_load_canary["ops_tracez"],
         "ops_server_goodput": serve_load_canary["ops_goodput"],
+        # PR-19 HTTP front door: the non-streamed wire answer is
+        # byte-identical to the in-process greedy submit, the SSE frame
+        # sequence is well-formed and token-exact, the rate-limited
+        # tenant draws a 429 with an honest Retry-After, and a
+        # malformed body gets a 400 while the server thread survives to
+        # answer the next request
+        "frontdoor_roundtrip": frontdoor_canary["roundtrip"],
+        "frontdoor_sse_stream": frontdoor_canary["sse"],
+        "frontdoor_429_shed": frontdoor_canary["shed_429"],
+        "frontdoor_survives_malformed":
+            frontdoor_canary["survives_malformed"],
         # ISSUE-7 compute/memory observability: every owned jit site
         # registered its compile (compile/ms histogram + compile/count
         # counter live), the train step's cost analysis produced
@@ -2980,6 +3299,7 @@ def dry_run():
                                ("accept_rate", "tokens_per_cycle",
                                 "int8_token_agreement")},
                       "serve_load": serve_load_canary["summary"],
+                      "frontdoor": frontdoor_canary["stats"],
                       "numerics": {
                           "inject_step": numerics_canary["inject_step"],
                           "anomaly_step":

@@ -25,8 +25,9 @@ Python cannot enforce (≙ the reference's tools/codestyle custom checks
   form) and ``.numpy()`` anywhere in the package are a per-step device
   stall. The single argued exception is the windowed token fetch
   (``serving/scheduler.py _fetch``), which carries the suppression.
-* ``ops-handler-sync`` — the ops HTTP surface (``serving/opsserver.py``)
-  and the SLO plane (``serving/slo.py``) are scrape-only BY CONTRACT:
+* ``ops-handler-sync`` — the ops HTTP surface (``serving/opsserver.py``),
+  the SLO plane (``serving/slo.py``) and the inference front door
+  (``serving/frontdoor.py``) are scrape-only BY CONTRACT:
   handlers serve collector samples, host rings and host counters, and
   must never touch the device or block on the scheduler. On top of the
   ``serving-host-sync`` walk (which already covers both files as part
@@ -323,7 +324,8 @@ def lint_source(path: str, source: str, relpath: str) -> List[LintFinding]:
     in_serving = rel.startswith("serving/")
     # the scrape-only ops surface: HTTP handlers + the SLO plane
     in_ops_surface = rel.endswith("serving/opsserver.py") \
-        or rel.endswith("serving/slo.py")
+        or rel.endswith("serving/slo.py") \
+        or rel.endswith("serving/frontdoor.py")
     # Pallas kernels live in ops/ — BlockSpec tiling is checked there
     in_ops = rel.startswith("ops/")
     # the numerics audit module: host-pure over numpy BY CONTRACT

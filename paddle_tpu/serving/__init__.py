@@ -59,13 +59,18 @@ reservoirs + tail-sampled traces), :mod:`.engine` (the thread-safe
 user surface + monitor/profiler/analysis wiring), :mod:`.slo` (SLO
 objectives, multi-window burn rates, per-replica goodput),
 :mod:`.opsserver` (the zero-dependency HTTP ops surface: /metrics,
-/statusz, /varz, /healthz, /readyz, /tracez, /timeline).
+/statusz, /varz, /healthz, /readyz, /tracez, /timeline — a pluggable
+route table), :mod:`.frontdoor` (the OpenAI-style ``/v1/completions``
+inference front door: SSE streaming, per-tenant token-bucket admission,
+weighted-fair interactive/batch lanes riding the scheduler's
+(lane, tenant) deficit-round-robin).
 """
 from __future__ import annotations
 
 from .engine import GenerationEngine, PlanError  # noqa: F401
 from .fleet import EngineFleet  # noqa: F401
 from .flight_recorder import FlightRecorder  # noqa: F401
+from .frontdoor import FrontDoor, TokenBucket  # noqa: F401
 from .kv_pool import KVCachePool  # noqa: F401
 from .opsserver import OpsServer  # noqa: F401
 from .paging import (BlockError, PagedKVPool,  # noqa: F401
@@ -81,4 +86,5 @@ __all__ = ["GenerationEngine", "PlanError", "EngineFleet", "KVCachePool",
            "QueueFullError", "DeadlineExceeded", "RequestCancelled",
            "PoolCapacityError", "PoolExhaustedError", "BlockError",
            "RequestTrace", "FlightRecorder", "OpsServer",
+           "FrontDoor", "TokenBucket",
            "SLOTracker", "SLOObjective", "attainment_from_buckets"]

@@ -207,6 +207,14 @@ def _register_engine_telemetry(engine: "GenerationEngine") -> None:
         if s.get("decode_tokens_per_sec") is not None:
             out.append(("gauge", "serving_decode_tokens_per_sec",
                         labels, s["decode_tokens_per_sec"]))
+        # per-tenant goodput labels (front-door multi-tenancy): one
+        # {engine, tenant} series per tenant seen by this engine
+        for tenant, ts in (s.get("tenants") or {}).items():
+            tl = dict(labels, tenant=str(tenant))
+            out.append(("counter", "serving_tenant_retired", tl,
+                        ts["retired"]))
+            out.append(("gauge", "serving_tenant_goodput_rps", tl,
+                        ts["goodput_rps"]))
         return out
     _metrics.register_collector(f"serving_engine/{engine._eid}", _collect)
 
@@ -260,7 +268,8 @@ class GenerationEngine:
                  attention: str = "gather", kv_dtype=None,
                  spec_draft=None, spec_k: int = 4,
                  mesh=None, mp_axis: str = "mp",
-                 hbm_budget_bytes: Optional[int] = None):
+                 hbm_budget_bytes: Optional[int] = None,
+                 lane_weights: Optional[dict] = None):
         import jax
 
         from ..models.generation import build_slot_decode_fn
@@ -426,7 +435,7 @@ class GenerationEngine:
             do_copy=self._run_copy if self._paged else None,
             do_chunked_step=self._run_fused_step if self._fused else None,
             do_spec_step=self._run_spec_step if self._spec else None,
-            spec_k=self._spec_k)
+            spec_k=self._spec_k, lane_weights=lane_weights)
         # telemetry spine wiring (ISSUE 13): the engine joins the
         # statusz console and publishes its stats() island through the
         # labeled metrics registry ({engine=<id>} gauges/counters)
@@ -437,7 +446,9 @@ class GenerationEngine:
                do_sample: bool = False, temperature: float = 1.0,
                top_k: Optional[int] = None, top_p: Optional[float] = None,
                eos_token_id: Optional[int] = None,
-               timeout: Optional[float] = None) -> GenerationRequest:
+               timeout: Optional[float] = None,
+               tenant: str = "default",
+               lane: str = "interactive") -> GenerationRequest:
         """Enqueue one generation; returns its handle immediately.
 
         The handle streams tokens as they are produced
@@ -454,7 +465,15 @@ class GenerationEngine:
         key at construction, so a differing per-request value here is
         rejected with :class:`ValueError` instead of silently retracing
         the decode step per sampling mix (the retrace-storm bug class
-        the ``dispatch/retrace_cause`` counters exist to expose)."""
+        the ``dispatch/retrace_cause`` counters exist to expose).
+
+        ``tenant``/``lane`` tag the request's weighted-fair admission
+        class (the HTTP front door sets them from the wire identity):
+        the scheduler deficit-round-robins admission over the queued
+        (lane, tenant) classes with per-lane weights
+        (``GenerationEngine(lane_weights=...)``, default interactive 4
+        : batch 1), so a batch flood cannot starve interactive TTFT.
+        Untagged traffic all shares one class — plain FCFS."""
         if self._closed:
             raise RuntimeError("GenerationEngine is closed")
         if top_k is not None and int(top_k) != self._top_k:
@@ -518,7 +537,8 @@ class GenerationEngine:
         req = GenerationRequest(
             ids, max_new_tokens, do_sample=do_sample,
             temperature=temperature, eos_token_id=eos_token_id,
-            pad_token_id=self._pad, timeout=timeout)
+            pad_token_id=self._pad, timeout=timeout,
+            tenant=tenant, lane=lane)
         handle = self._sched.submit(req)   # QueueFullError propagates
         stat_add("serving/requests")       # counts ACCEPTED requests
         return handle
@@ -608,6 +628,12 @@ class GenerationEngine:
             g = rec.goodput()
             s["goodput_rps"] = g["goodput_rps"]
             s["slo_violations"] = rec.slo_violations
+        # per-tenant goodput split (front-door multi-tenancy): which
+        # tenant's traffic is meeting the SLO, labeled per tenant in
+        # the scraped serving_tenant_* series via the collector below
+        tenants = rec.tenant_summary()
+        if tenants:
+            s["tenants"] = tenants
         s.update(self._compute_stats())
         # KV memory, from the HBM ledger (profiler/memory.py — the pool
         # publishes capacity + in-use bytes there on every alloc/free)

@@ -341,6 +341,18 @@ class EngineFleet:
         if agg.get("spec_proposed"):
             agg["spec_accept_rate"] = \
                 agg.get("spec_accepted", 0) / agg["spec_proposed"]
+        # per-tenant goodput split summed across healthy replicas (the
+        # front door's multi-tenancy plane — a tenant's traffic may be
+        # routed anywhere, so only the fleet sum is the tenant's truth)
+        tenants: Dict[str, Dict[str, Any]] = {}
+        for r in healthy:
+            for t, ts in (r.get("tenants") or {}).items():
+                row = tenants.setdefault(
+                    t, {"retired": 0, "goodput_rps": 0.0})
+                row["retired"] += ts.get("retired", 0)
+                row["goodput_rps"] += ts.get("goodput_rps", 0.0)
+        if tenants:
+            agg["tenants"] = tenants
         agg.update(self._pooled_latency())
         # SLO plane: exact attainment + burn rates + per-replica
         # goodput, fault-isolated like everything else on this surface

@@ -92,6 +92,14 @@ class FlightRecorder:
         self.slo_violations = 0                     # monotonic
         # (t_retired, ttft_ms) stamps for windowed goodput; bounded
         self._retire_stamps: deque = deque(maxlen=int(max_samples))
+        # per-tenant accounting (the front door's multi-tenancy plane):
+        # lifetime retire counters plus a bounded per-tenant stamp ring
+        # so goodput splits by tenant label without a second pass over
+        # the traces. Keyed by trace.tenant; untagged traffic lands
+        # under "default".
+        self._tenant_counts: Dict[str, int] = {}
+        self._tenant_stamps: Dict[str, deque] = {}
+        self._tenant_ring = max(256, int(max_samples) // 4)
         self._retire_hooks: List[Callable[[Any], None]] = []
 
     # -- SLO plane wiring ---------------------------------------------------
@@ -138,6 +146,7 @@ class FlightRecorder:
             snap = trace.snapshot()
         except Exception:                                # noqa: BLE001
             pass        # a malformed trace must not kill the scheduler
+        tenant = getattr(trace, "tenant", None) or "default"
         with self._lock:
             self.retired += 1
             if ttft is not None:
@@ -145,6 +154,13 @@ class FlightRecorder:
             if tpot is not None:
                 self._tpot.append(tpot)
             self._retire_stamps.append((now, ttft))
+            self._tenant_counts[tenant] = \
+                self._tenant_counts.get(tenant, 0) + 1
+            ring = self._tenant_stamps.get(tenant)
+            if ring is None:
+                ring = self._tenant_stamps[tenant] = deque(
+                    maxlen=self._tenant_ring)
+            ring.append((now, ttft))
             if snap is not None:
                 self._recent.append(snap)
                 violated = (self.tail_slo_ms is not None
@@ -237,6 +253,40 @@ class FlightRecorder:
             span = max(1e-3, now - in_window[0][0])
         return {"window_s": window_s, "total": total, "good": good,
                 "goodput_rps": good / span}
+
+    def tenant_summary(self, window_s: float = 60.0) -> Dict[str, Any]:
+        """Per-tenant retire/goodput split over the trailing window —
+        the numbers behind the ``serving_tenant_*`` labeled metrics and
+        the front door's per-tenant view. A retired request counts as
+        good under the same armed tail SLO :meth:`goodput` uses; with
+        no SLO armed every completed-with-a-TTFT request is good.
+        Empty until the first retire (no phantom "default" row)."""
+        now = time.perf_counter()
+        with self._lock:
+            slo = self.tail_slo_ms
+            tenants = {t: (self._tenant_counts.get(t, 0), list(ring))
+                       for t, ring in self._tenant_stamps.items()}
+        out: Dict[str, Any] = {}
+        for tenant, (retired, stamps) in sorted(tenants.items()):
+            in_window = [(t, v) for t, v in stamps if now - t <= window_s]
+            good = sum(1 for _, v in in_window
+                       if v is not None and (slo is None or v <= slo))
+            if stamps and stamps[0][0] <= now - window_s:
+                span = window_s
+            elif in_window:
+                span = max(1e-3, now - in_window[0][0])
+            else:
+                span = window_s
+            ttfts = sorted(v for _, v in in_window if v is not None)
+            out[tenant] = {
+                "retired": retired,
+                "window_total": len(in_window),
+                "window_good": good,
+                "goodput_rps": good / span,
+                "ttft_p50_ms": _percentile(ttfts, 0.5) if ttfts else None,
+                "ttft_p95_ms": _percentile(ttfts, 0.95) if ttfts else None,
+            }
+        return out
 
     def cycle_throughput(self) -> Dict[str, float]:
         """Decode throughput over the cycle ring: cycles recorded in the

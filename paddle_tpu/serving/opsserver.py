@@ -31,6 +31,11 @@ Attach it to a :class:`~.engine.GenerationEngine`, an
     ...
     srv.close()
 
+Routing is a pluggable table: built-ins register through the same
+``add_route(method, path, handler)`` seam extensions use, so the
+inference front door (:mod:`.frontdoor`) mounts ``POST
+/v1/completions`` beside ``/metrics`` in one process on one port.
+
 Handler contract (the ``ops-handler-sync`` self-lint rule enforces the
 letter of it): handlers NEVER touch the device and never block on the
 scheduler — everything they serve comes from scrape-time collectors,
@@ -69,43 +74,31 @@ class _OpsHandler(BaseHTTPRequestHandler):
         self._send(code, "application/json",
                    json.dumps(doc, default=repr))
 
-    def do_GET(self) -> None:                            # noqa: N802
+    def _dispatch(self, method: str) -> None:
+        """Route one request through the server's handler table. An
+        unknown (method, path) answers the canonical 404; a raising
+        handler answers 500 — the serving thread lives on either way."""
         ops = self.server.ops                            # type: ignore
         path = self.path.split("?", 1)[0].rstrip("/") or "/"
         try:
-            if path == "/metrics":
-                self._send(200,
-                           "text/plain; version=0.0.4; charset=utf-8",
-                           ops.registry.to_prometheus())
-            elif path == "/varz":
-                self._send_json(200, ops.registry.snapshot())
-            elif path == "/statusz":
-                self._send(200, "text/plain; charset=utf-8",
-                           ops.registry.statusz())
-            elif path == "/healthz":
-                ok, doc = ops.health()
-                self._send_json(200 if ok else 503, doc)
-            elif path == "/readyz":
-                ok, doc = ops.ready()
-                self._send_json(200 if ok else 503, doc)
-            elif path == "/tracez":
-                self._send_json(200, ops.tracez())
-            elif path == "/timeline":
-                from ..profiler.timeline import unified_trace_doc
-                self._send_json(200, unified_trace_doc())
-            elif path == "/":
-                self._send_json(200, {"endpoints": sorted(
-                    ("/metrics", "/varz", "/statusz", "/healthz",
-                     "/readyz", "/tracez", "/timeline"))})
-            else:
+            handler = ops.route(method, path)
+            if handler is None:
                 self._send_json(404, {"error": f"no such endpoint "
                                       f"{path!r}", "see": "/"})
+                return
+            handler(self)
         except Exception as e:                           # noqa: BLE001
             # a broken section answers 500; the serving thread lives on
             try:
                 self._send_json(500, {"error": repr(e), "path": path})
             except Exception:                            # noqa: BLE001
                 pass
+
+    def do_GET(self) -> None:                            # noqa: N802
+        self._dispatch("GET")
+
+    def do_POST(self) -> None:                           # noqa: N802
+        self._dispatch("POST")
 
 
 class OpsServer:
@@ -130,6 +123,76 @@ class OpsServer:
         self._port = int(port)
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        # the route table: (METHOD, path) -> handler(request_handler).
+        # Built-ins register through the same seam extensions use
+        # (add_route) — the inference front door mounts POST
+        # /v1/completions here so /metrics and the completions API
+        # share one process and one port.
+        self._routes: Dict[Tuple[str, str], Any] = {}
+        self._register_builtin_routes()
+
+    # -- route table --------------------------------------------------------
+    def add_route(self, method: str, path: str, handler) -> None:
+        """Mount ``handler(request_handler)`` at (``method``, ``path``).
+
+        The handler receives the live ``BaseHTTPRequestHandler`` and
+        answers via ``_send``/``_send_json`` (POST bodies via
+        ``request_handler.rfile`` + the Content-Length header). Route
+        handlers inherit the ops-surface contract (the
+        ``ops-handler-sync`` self-lint rule): never touch the device,
+        never block on the scheduler loop — engine HANDLES (submit /
+        stream) are the only legal way in. Registering an existing
+        (method, path) replaces it; unknown paths keep answering the
+        canonical 404."""
+        path = path.split("?", 1)[0].rstrip("/") or "/"
+        self._routes[(method.upper(), path)] = handler
+
+    def route(self, method: str, path: str) -> Optional[Any]:
+        """The handler mounted at (``method``, ``path``), or None."""
+        return self._routes.get((method.upper(), path))
+
+    def endpoints(self) -> list:
+        """Sorted unique route paths (the ``/`` index body)."""
+        return sorted({p for _, p in self._routes if p != "/"})
+
+    def _register_builtin_routes(self) -> None:
+        def _metrics_h(h):
+            h._send(200, "text/plain; version=0.0.4; charset=utf-8",
+                    self.registry.to_prometheus())
+
+        def _varz(h):
+            h._send_json(200, self.registry.snapshot())
+
+        def _statusz(h):
+            h._send(200, "text/plain; charset=utf-8",
+                    self.registry.statusz())
+
+        def _healthz(h):
+            ok, doc = self.health()
+            h._send_json(200 if ok else 503, doc)
+
+        def _readyz(h):
+            ok, doc = self.ready()
+            h._send_json(200 if ok else 503, doc)
+
+        def _tracez(h):
+            h._send_json(200, self.tracez())
+
+        def _timeline(h):
+            from ..profiler.timeline import unified_trace_doc
+            h._send_json(200, unified_trace_doc())
+
+        def _index(h):
+            h._send_json(200, {"endpoints": self.endpoints()})
+
+        self.add_route("GET", "/metrics", _metrics_h)
+        self.add_route("GET", "/varz", _varz)
+        self.add_route("GET", "/statusz", _statusz)
+        self.add_route("GET", "/healthz", _healthz)
+        self.add_route("GET", "/readyz", _readyz)
+        self.add_route("GET", "/tracez", _tracez)
+        self.add_route("GET", "/timeline", _timeline)
+        self.add_route("GET", "/", _index)
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> "OpsServer":

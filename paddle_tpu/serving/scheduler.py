@@ -4,8 +4,14 @@ The loop at the heart of ``GenerationEngine``. Unlike the gather-and-run
 ``inference.BatchingEngine`` (whole batch enters and leaves together),
 membership of the in-flight batch changes EVERY step:
 
-* **admit** — pop FCFS from the bounded admission queue into free pool
-  slots, one prefill per admitted request, under a PREFILL BUDGET
+* **admit** — pop from the bounded admission queue into free pool
+  slots under WEIGHTED-FAIR scheduling: queued requests are classed by
+  (lane, tenant) and served by weighted deficit-round-robin (priority
+  lanes — ``interactive`` outweighs ``batch`` 4:1 by default, so a
+  batch prompt flood cannot starve interactive TTFT while idle
+  capacity still flows to batch; one queued class degenerates to the
+  old FCFS exactly); one prefill per admitted request, under a
+  PREFILL BUDGET
   (tokens per cycle): a burst of long prompts may not starve the slots
   already decoding — when the budget is spent the remaining queue waits
   one decode step (counted as ``serving/preempt``);
@@ -56,7 +62,7 @@ import itertools
 import queue
 import threading
 import time
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -72,12 +78,35 @@ __all__ = ["QueueFullError", "DeadlineExceeded", "RequestCancelled",
 
 
 class QueueFullError(RuntimeError):
-    """The admission queue is at capacity — shed load and retry later."""
+    """The admission queue is at capacity — shed load and retry later.
+
+    Carries the scheduler's shed metadata, stamped AT RAISE TIME, so a
+    wire layer can answer with an honest ``Retry-After`` instead of a
+    guess: ``queue_depth`` (entries queued when the submit was refused)
+    and ``est_wait_s`` (depth x the EWMA inter-admission interval;
+    ``None`` until the scheduler has admitted at least two requests)."""
+
+    def __init__(self, message: str = "", *,
+                 queue_depth: Optional[int] = None,
+                 est_wait_s: Optional[float] = None):
+        super().__init__(message)
+        self.queue_depth = queue_depth
+        self.est_wait_s = est_wait_s
 
 
 class DeadlineExceeded(TimeoutError):
     """The request's deadline passed before it finished (it may have
-    produced some tokens first — they were streamed)."""
+    produced some tokens first — they were streamed). Like
+    :class:`QueueFullError` it carries ``queue_depth``/``est_wait_s``
+    stamped at raise time — a client whose deadline died in the queue
+    learns how deep the queue was and what a retry would likely wait."""
+
+    def __init__(self, message: str = "", *,
+                 queue_depth: Optional[int] = None,
+                 est_wait_s: Optional[float] = None):
+        super().__init__(message)
+        self.queue_depth = queue_depth
+        self.est_wait_s = est_wait_s
 
 
 class RequestCancelled(RuntimeError):
@@ -110,7 +139,8 @@ class GenerationRequest:
     def __init__(self, prompt: np.ndarray, max_new_tokens: int, *,
                  do_sample: bool = False, temperature: float = 1.0,
                  eos_token_id: Optional[int] = None, pad_token_id: int = 0,
-                 timeout: Optional[float] = None):
+                 timeout: Optional[float] = None,
+                 tenant: str = "default", lane: str = "interactive"):
         self.id = next(self._ids)
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.max_new_tokens = int(max_new_tokens)
@@ -119,6 +149,12 @@ class GenerationRequest:
         self.eos_token_id = None if eos_token_id is None \
             else int(eos_token_id)
         self.pad_token_id = int(pad_token_id)
+        # multi-tenancy identity: the (lane, tenant) pair is the
+        # weighted-fair admission class — untagged traffic all lands in
+        # one class, which degenerates to the old FCFS order exactly
+        self.tenant = str(tenant)
+        self.lane = str(lane)
+        self._preempted = False     # replay victims outrank the queue
         self.submitted_at = time.perf_counter()
         self.deadline = None if timeout is None \
             else self.submitted_at + float(timeout)
@@ -141,7 +177,8 @@ class GenerationRequest:
         self._last_token_at: Optional[float] = None
         # lifecycle trace (host stamps; the scheduler marks events, the
         # caller reads derived TTFT/TPOT after result() returns)
-        self.trace = RequestTrace(self.id, t_submit=self.submitted_at)
+        self.trace = RequestTrace(self.id, t_submit=self.submitted_at,
+                                  tenant=self.tenant, lane=self.lane)
         self._recorder: Optional[FlightRecorder] = None   # set at submit
         # caller-side plumbing
         self._q: "queue.Queue" = queue.Queue()
@@ -268,7 +305,8 @@ class Scheduler:
                  do_chunked_step: Optional[Callable] = None,
                  do_spec_step: Optional[Callable] = None,
                  spec_k: int = 0,
-                 recorder: Optional[FlightRecorder] = None):
+                 recorder: Optional[FlightRecorder] = None,
+                 lane_weights: Optional[Dict[str, float]] = None):
         if max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {max_queue}")
         self._pool = pool
@@ -331,6 +369,30 @@ class Scheduler:
             raise ValueError(
                 f"prefill_budget must be >= 1, got {self._prefill_budget}")
         self._queue: List[GenerationRequest] = []
+        # weighted deficit-round-robin admission (the priority lanes):
+        # each queued (lane, tenant) pair is a fairness class; every
+        # rotation credits a class `quantum x lane weight` prefill
+        # tokens of deficit and the class at the rotation head admits
+        # while its deficit covers its head-of-line request's feed
+        # cost. With ONE class queued the selector short-circuits to
+        # plain FCFS — the legacy single-tenant order, byte for byte.
+        # Deficits are capped so an idle class cannot bank unbounded
+        # credit and then monopolize admission for whole seconds.
+        self._lane_weights: Dict[str, float] = {
+            "interactive": 4.0, "batch": 1.0}
+        if lane_weights:
+            for lane, w in lane_weights.items():
+                if float(w) <= 0:
+                    raise ValueError(
+                        f"lane weight must be > 0, got {lane}={w}")
+                self._lane_weights[str(lane)] = float(w)
+        self._wdrr_quantum = 32.0            # deficit tokens per weight
+        self._deficit: Dict[Tuple[str, str], float] = {}
+        self._rr: List[Tuple[str, str]] = []  # class rotation order
+        # inter-admission EWMA: the honest-Retry-After estimate carried
+        # by QueueFullError/DeadlineExceeded (est_wait ~ depth x this)
+        self._admit_stamp: Optional[float] = None
+        self._admit_interval_s: Optional[float] = None
         self._slots: Dict[int, GenerationRequest] = {}
         self._cond = threading.Condition()
         self._closing = False
@@ -339,6 +401,15 @@ class Scheduler:
         self._thread.start()
 
     # -- producer side -----------------------------------------------------
+    def _est_wait_s(self, depth: int) -> Optional[float]:
+        """Estimated queue wait for ``depth`` entries: depth x the EWMA
+        inter-admission interval (None before two admissions — an
+        estimate with no evidence behind it is a lie, not a hint).
+        Callers hold ``self._cond`` or tolerate a stale read."""
+        if self._admit_interval_s is None:
+            return None
+        return depth * self._admit_interval_s
+
     def submit(self, req: GenerationRequest) -> GenerationRequest:
         _prof.set_thread_name(
             f"submitter ({threading.current_thread().name})")
@@ -347,14 +418,18 @@ class Scheduler:
                 raise RuntimeError("GenerationEngine is closed")
             if len(self._queue) >= self._max_queue:
                 stat_add("serving/queue_full")
+                depth = len(self._queue)
                 raise QueueFullError(
                     f"admission queue is full ({self._max_queue} "
-                    f"requests); retry after in-flight work drains")
+                    f"requests); retry after in-flight work drains",
+                    queue_depth=depth,
+                    est_wait_s=self._est_wait_s(depth))
             req._recorder = self.recorder
             # recorded before notify so the event ring can never show
             # this request admitted ahead of its own submit
-            self.recorder.record_event(req.id, "submit",
-                                       t=req.submitted_at)
+            self.recorder.record_event(
+                req.id, "submit", t=req.submitted_at,
+                meta={"tenant": req.tenant, "lane": req.lane})
             self._queue.append(req)
             stat_observe("serving/queue_depth", len(self._queue))
             self._cond.notify_all()
@@ -528,17 +603,71 @@ class Scheduler:
                         f"request {r.id} cancelled while queued"))
                 elif r.expired(now):
                     stat_add("serving/deadline_exceeded")
+                    depth = len(self._queue)
                     r._finish(DeadlineExceeded(
                         f"request {r.id} exceeded its deadline while "
-                        f"queued"))
+                        f"queued",
+                        queue_depth=depth,
+                        est_wait_s=self._est_wait_s(depth)))
                 else:
                     live.append(r)
             if len(live) != len(self._queue):
                 self._queue[:] = live
                 stat_observe("serving/queue_depth", len(live))
 
-    # admission: FCFS with a prefill budget (the loop sweeps the queue
-    # under its own span/timer right before calling this)
+    def _select_next(self) -> int:
+        """Index into ``self._queue`` of the next admission candidate —
+        weighted deficit-round-robin over the queued (lane, tenant)
+        classes (caller holds ``self._cond``).
+
+        Preempted replay victims outrank everything (they predate every
+        queued arrival and their history is hot). A single queued class
+        short-circuits to its FCFS head — identical to the old bare
+        FCFS, so untagged traffic and idle-capacity batch flow are
+        untouched. With several classes, each rotation credits the
+        rotation head ``quantum x lane weight`` tokens of deficit and a
+        class admits while its deficit covers its head request's feed
+        cost — an interactive lane at weight 4 admits ~4x the token
+        rate of a batch flood, and the flood still drains whenever
+        interactive has nothing queued (work-conserving)."""
+        q = self._queue
+        for i, r in enumerate(q):
+            if r._preempted:
+                return i
+        heads: Dict[Tuple[str, str], int] = {}
+        for i, r in enumerate(q):
+            key = (r.lane, r.tenant)
+            if key not in heads:
+                heads[key] = i
+        if len(heads) == 1:
+            return next(iter(heads.values()))
+        # keep the rotation stable across calls; retire dead classes
+        self._rr = [k for k in self._rr if k in heads]
+        for k in heads:
+            if k not in self._rr:
+                self._rr.append(k)
+                self._deficit.setdefault(k, 0.0)
+        # the deficit cap must exceed any admissible feed cost (feeds
+        # are bounded by pool.max_len at submit time) or a fat head
+        # could starve its own class forever
+        cap = max(2.0 * self._pool.max_len, 8.0 * self._wdrr_quantum)
+        for _ in range(10_000):
+            k = self._rr[0]
+            head = q[heads[k]]
+            cost = float(max(1, len(head.prompt) + len(head.tokens)))
+            if self._deficit.get(k, 0.0) >= cost:
+                self._deficit[k] -= cost
+                return heads[k]
+            w = self._lane_weights.get(k[0], 1.0)
+            self._deficit[k] = min(
+                self._deficit.get(k, 0.0) + self._wdrr_quantum * w, cap)
+            self._rr.append(self._rr.pop(0))
+        return heads[self._rr[0]]     # unreachable: cap >= any cost
+
+    # admission: weighted-fair over (lane, tenant) classes — FCFS
+    # within a class and when only one class is queued — under a
+    # prefill budget (the loop sweeps the queue under its own
+    # span/timer right before calling this)
     def _admit(self) -> None:
         decode_waiting = bool(self._slots)
         budget = self._prefill_budget
@@ -546,20 +675,24 @@ class Scheduler:
             with self._cond:
                 if not self._queue:
                     return
-                req = self._queue[0]
+                idx = self._select_next()
+                req = self._queue[idx]
                 # re-check the head: cancel/expiry may race the sweep
                 if req.cancelled:
-                    self._queue.pop(0)
+                    self._queue.pop(idx)
                     stat_add("serving/cancelled")
                     req._finish(RequestCancelled(
                         f"request {req.id} cancelled while queued"))
                     continue
                 if req.expired():
-                    self._queue.pop(0)
+                    self._queue.pop(idx)
                     stat_add("serving/deadline_exceeded")
+                    depth = len(self._queue)
                     req._finish(DeadlineExceeded(
                         f"request {req.id} exceeded its deadline while "
-                        f"queued"))
+                        f"queued",
+                        queue_depth=depth,
+                        est_wait_s=self._est_wait_s(depth)))
                     continue
                 # paged re-admission (preemption) replays the request's
                 # own generated tokens, so the "prompt" being fed is the
@@ -584,7 +717,16 @@ class Scheduler:
                 slot = self._pool.alloc()
                 if slot is None:
                     return              # pool full: decode will retire
-                self._queue.pop(0)
+                self._queue.pop(idx)
+                req._preempted = False
+                # admission-rate EWMA: the evidence behind est_wait_s
+                now = time.perf_counter()
+                if self._admit_stamp is not None:
+                    dt = now - self._admit_stamp
+                    self._admit_interval_s = dt \
+                        if self._admit_interval_s is None \
+                        else 0.8 * self._admit_interval_s + 0.2 * dt
+                self._admit_stamp = now
                 stat_observe("serving/queue_depth", len(self._queue))
             try:
                 prefilled = self._prefill(req, slot, bucket)
@@ -612,8 +754,15 @@ class Scheduler:
                  bucket: int) -> bool:
         """Admit ``req`` into ``slot``. Returns whether a prefill
         program actually ran (False = paged prefix-cache hit)."""
+        # admission wait: submit -> this admission (a re-admission after
+        # preemption restarts nothing — the client has been waiting the
+        # whole time, so the wall clock since submit IS the lane wait)
+        wait_ms = (time.perf_counter() - req.submitted_at) * 1e3
+        stat_observe("serving/lane_wait_ms", wait_ms)
         self._event(req, "admitted", slot=slot, bucket=bucket,
-                    feed=len(req.prompt) + len(req.tokens))
+                    feed=len(req.prompt) + len(req.tokens),
+                    tenant=req.tenant, lane=req.lane,
+                    wait_ms=round(wait_ms, 3))
         if self._rec is not None:
             self._rec["admitted"].append(req.id)
         req.trace.mark("prefill_start", bucket=bucket)
@@ -691,6 +840,7 @@ class Scheduler:
         self._pool.free(slot)
         req.replay = []                  # rebuilt at re-admission
         req.pending_feed = []            # ditto (fused chunked feed)
+        req._preempted = True            # outranks WDRR selection
         self.preempts += 1
         self._event(req, "preempt", emitted=req.emitted)
         if self._rec is not None:
@@ -819,7 +969,9 @@ class Scheduler:
                 stat_add("serving/deadline_exceeded")
                 self._retire(slot, DeadlineExceeded(
                     f"request {req.id} exceeded its deadline after "
-                    f"{req.emitted} token(s)"))
+                    f"{req.emitted} token(s)",
+                    queue_depth=len(self._queue),
+                    est_wait_s=self._est_wait_s(len(self._queue))))
                 continue
             if req.replay:
                 # paged prefix-hit / re-admission: this cycle fed one
@@ -968,7 +1120,9 @@ class Scheduler:
                 stat_add("serving/deadline_exceeded")
                 self._retire(slot, DeadlineExceeded(
                     f"request {req.id} exceeded its deadline after "
-                    f"{req.emitted} token(s)"))
+                    f"{req.emitted} token(s)",
+                    queue_depth=len(self._queue),
+                    est_wait_s=self._est_wait_s(len(self._queue))))
                 continue
             if feeding:
                 if req.pending_feed:

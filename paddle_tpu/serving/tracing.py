@@ -55,10 +55,17 @@ class RequestTrace:
     returns — the terminal mark happens-before ``_done`` is set.
     """
 
-    __slots__ = ("request_id", "events", "token_times")
+    __slots__ = ("request_id", "events", "token_times", "tenant", "lane")
 
-    def __init__(self, request_id: int, t_submit: Optional[float] = None):
+    def __init__(self, request_id: int, t_submit: Optional[float] = None,
+                 tenant: Optional[str] = None, lane: Optional[str] = None):
         self.request_id = int(request_id)
+        # multi-tenancy identity (the front door's admission class):
+        # carried on the trace so tail samples, /tracez and the per-
+        # tenant goodput accounting can attribute a retired request
+        # without the live GenerationRequest object
+        self.tenant = tenant
+        self.lane = lane
         self.events: List[Tuple[str, float, Optional[dict]]] = [
             ("submit", t_submit if t_submit is not None
              else time.perf_counter(), None)]
@@ -115,6 +122,15 @@ class RequestTrace:
             / (len(self.token_times) - 1)
 
     @property
+    def admission_wait_ms(self) -> Optional[float]:
+        """Submit → first admission: the queueing share of TTFT (the
+        lane-wait the weighted-fair admission exists to bound)."""
+        t_adm = self.t("admitted")
+        if t_adm is None:
+            return None
+        return (t_adm - self.submitted_at) * 1e3
+
+    @property
     def decode_intervals_ms(self) -> List[float]:
         tt = self.token_times
         return [(b - a) * 1e3 for a, b in zip(tt, tt[1:])]
@@ -137,6 +153,8 @@ class RequestTrace:
         latencies are materialized here so a retained snapshot stays
         meaningful after the live trace object is gone."""
         return {"request": self.request_id,
+                **({"tenant": self.tenant} if self.tenant else {}),
+                **({"lane": self.lane} if self.lane else {}),
                 "completed": self.completed,
                 "ttft_ms": self.ttft_ms,
                 "tpot_ms": self.tpot_ms,

@@ -137,6 +137,19 @@ def test_dry_run_emits_metrics_summary():
     assert out["checks"]["ops_server_healthz"] is True, out
     assert out["checks"]["ops_server_tracez"] is True, out
     assert out["checks"]["ops_server_goodput"] is True, out
+    # PR-19 HTTP front door: an ephemeral-port /v1/completions canary
+    # round-tripped a non-streamed completion byte-identical to the
+    # in-process stream (usage included), streamed one request over SSE
+    # ending in [DONE], drew a per-tenant 429 with retry_after_s from
+    # the token bucket, and survived a malformed-JSON body (400) with
+    # the server thread still answering afterwards
+    assert out["checks"]["frontdoor_roundtrip"] is True, out
+    assert out["checks"]["frontdoor_sse_stream"] is True, out
+    assert out["checks"]["frontdoor_429_shed"] is True, out
+    assert out["checks"]["frontdoor_survives_malformed"] is True, out
+    fd = out["frontdoor"]
+    assert fd["served"] >= 2, fd
+    assert fd["shed"].get("starved", 0) >= 1, fd
     # ISSUE-7 compute/memory observability: every owned jit site
     # registered its compile cost (compile/ms + compile/count live), the
     # train step's XLA cost analysis produced hapi/flops_per_sec and —

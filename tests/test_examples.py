@@ -113,6 +113,25 @@ def test_ops_surface_example(tmp_path):
     assert "healthz after close: 503" in out
 
 
+def test_serve_http_example(tmp_path):
+    """The PR-19 front-door quickstart: mixed-tenant traffic over real
+    sockets — SSE-streamed interactive lane beside non-streamed batch
+    lane on one port, the rate-limited tenant shed with 429s, and the
+    per-tenant TTFT / goodput split in the end-of-run report."""
+    out = _run([os.path.join(REPO, "examples", "serve_http.py"),
+                "--interactive", "4", "--batch", "4"],
+               tmp_path, timeout=600)
+    assert "front door live at http://127.0.0.1:" in out
+    assert "POST /v1/completions beside GET /metrics" in out
+    assert "served 4 interactive (SSE) + 4 batch requests over HTTP" in out
+    assert "tenant 'starved': 3 requests shed with 429" in out
+    assert "Retry-After" in out
+    assert "wire ttft[alice]" in out
+    assert "wire ttft[bulk-corp]" in out
+    assert "engine tenants[alice]" in out
+    assert "shed per tenant {'starved': 3}" in out
+
+
 def test_generate_text_example(tmp_path):
     out = _run([os.path.join(REPO, "examples", "generate_text.py")],
                tmp_path, timeout=600)
