@@ -185,12 +185,19 @@ class MemoryTracker:
         """Ledger vs device: how much of ``bytes_in_use`` do the
         registered owners explain? ``device_bytes_in_use`` is ``None``
         where the backend doesn't report (CPU) — then only the ledger
-        side is meaningful."""
+        side is meaningful. Ledger keys under the ``host/`` prefix
+        (the serving host-DRAM spill tier) are accounted SEPARATELY as
+        ``host_ledger_bytes`` — host DRAM must never inflate the
+        device-side explained ratio."""
         stats = self._stats_fn() or {}
         in_use = stats.get("bytes_in_use")
-        led = self.ledger_total()
+        with self._lock:
+            host = sum(v for k, v in self._ledger.items()
+                       if k.startswith("host/"))
+            led = sum(self._ledger.values()) - host
         out: Dict[str, Any] = {
             "ledger_bytes": led,
+            "host_ledger_bytes": host,
             "device_bytes_in_use": None if in_use is None else int(in_use),
             "unexplained_bytes": None,
             "explained_ratio": None,

@@ -63,7 +63,12 @@ objectives, multi-window burn rates, per-replica goodput),
 route table), :mod:`.frontdoor` (the OpenAI-style ``/v1/completions``
 inference front door: SSE streaming, per-tenant token-bucket admission,
 weighted-fair interactive/batch lanes riding the scheduler's
-(lane, tenant) deficit-round-robin).
+(lane, tenant) deficit-round-robin), :mod:`.host_tier` (the
+hierarchical KV cache: ``GenerationEngine(host_tier_bytes=...)`` spills
+LRU-evicted prefix blocks to a bounded host-DRAM
+:class:`~.host_tier.HostBlockPool` on a background spiller thread and
+promotes them back through double-buffered async H2D copies the
+scheduler overlaps with decode — the prefix cache outgrows HBM).
 """
 from __future__ import annotations
 
@@ -71,6 +76,8 @@ from .engine import GenerationEngine, PlanError  # noqa: F401
 from .fleet import EngineFleet  # noqa: F401
 from .flight_recorder import FlightRecorder  # noqa: F401
 from .frontdoor import FrontDoor, TokenBucket  # noqa: F401
+from .host_tier import (HostBlockPool, HostTierError,  # noqa: F401
+                        HostTierFullError, PromotionTicket)
 from .kv_pool import KVCachePool  # noqa: F401
 from .opsserver import OpsServer  # noqa: F401
 from .paging import (BlockError, PagedKVPool,  # noqa: F401
@@ -87,4 +94,6 @@ __all__ = ["GenerationEngine", "PlanError", "EngineFleet", "KVCachePool",
            "PoolCapacityError", "PoolExhaustedError", "BlockError",
            "RequestTrace", "FlightRecorder", "OpsServer",
            "FrontDoor", "TokenBucket",
+           "HostBlockPool", "HostTierError", "HostTierFullError",
+           "PromotionTicket",
            "SLOTracker", "SLOObjective", "attainment_from_buckets"]

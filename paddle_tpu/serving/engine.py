@@ -141,6 +141,10 @@ def _engine_section() -> str:
                          f"{s['num_blocks']}")
             if "prefix_hit_ratio" in s:
                 head += f" prefix_hit={s['prefix_hit_ratio']:.2f}"
+            if "host_tier" in s:
+                ht = s["host_tier"]
+                head += (f" host_tier={ht['blocks']}/"
+                         f"{ht['capacity_blocks']}")
             if "spec_accept_rate" in s:
                 head += f" spec_accept={s['spec_accept_rate']:.2f}"
             if s.get("serving_mfu") is not None:
@@ -204,6 +208,19 @@ def _register_engine_telemetry(engine: "GenerationEngine") -> None:
                         s["kv_blocks_in_use"]))
             out.append(("gauge", "serving_prefix_hit_ratio", labels,
                         s["prefix_hit_ratio"]))
+            # tiered hit split: one {engine, tier} counter series per
+            # tier so dashboards can stack hbm/host/miss admissions
+            for tier, n in (s.get("tier_hits") or {}).items():
+                out.append(("counter", "serving_tier_hit",
+                            dict(labels, tier=str(tier)), n))
+        ht = s.get("host_tier")
+        if ht is not None:
+            out.append(("gauge", "serving_host_tier_bytes_in_use",
+                        labels, ht["bytes_in_use"]))
+            out.append(("counter", "serving_host_tier_demoted", labels,
+                        ht["demoted_blocks"]))
+            out.append(("counter", "serving_host_tier_promoted", labels,
+                        ht["promoted_blocks"]))
         if s.get("decode_tokens_per_sec") is not None:
             out.append(("gauge", "serving_decode_tokens_per_sec",
                         labels, s["decode_tokens_per_sec"]))
@@ -269,7 +286,8 @@ class GenerationEngine:
                  spec_draft=None, spec_k: int = 4,
                  mesh=None, mp_axis: str = "mp",
                  hbm_budget_bytes: Optional[int] = None,
-                 lane_weights: Optional[dict] = None):
+                 lane_weights: Optional[dict] = None,
+                 host_tier_bytes: Optional[int] = None):
         import jax
 
         from ..models.generation import build_slot_decode_fn
@@ -309,6 +327,20 @@ class GenerationEngine:
                 "attention='fused': the k-token verify IS one fused "
                 "ragged launch — each slot's candidate tokens are extra "
                 "ragged rows, exactly like a prefill chunk")
+        if host_tier_bytes is not None:
+            if kv_layout != "paged":
+                raise ValueError(
+                    "host_tier_bytes (hierarchical KV cache) requires "
+                    "kv_layout='paged': the host tier stores demoted "
+                    "prefix-cache BLOCKS; the dense slot pool has no "
+                    "block granularity to demote")
+            if mesh is not None:
+                raise ValueError(
+                    "host_tier_bytes does not compose with mesh= yet: "
+                    "demotion/promotion copies would need per-shard "
+                    "gathers against the head-partitioned pool — run "
+                    "tiered engines single-device (or per EngineFleet "
+                    "replica)")
         if mesh is not None:
             # tensor-parallel serving (ISSUE 15): the paged pool is a
             # head-partitioned GSPMD array and every step is a
@@ -399,6 +431,20 @@ class GenerationEngine:
                                      top_k=self._top_k, top_p=self._top_p,
                                      probe=self._decode_probe),
                 donate_argnums=(2,))
+        # hierarchical KV cache (ISSUE 20): a bounded host-DRAM block
+        # store behind the device prefix cache — LRU-evicted
+        # refcount-0 blocks demote instead of dying, and a hit on a
+        # demoted prefix promotes it back via async H2D copies the
+        # scheduler overlaps with decode. Host DRAM, so hbm_budget
+        # planning never bills it.
+        self._host_tier = None
+        if host_tier_bytes is not None:
+            from .host_tier import HostBlockPool
+            self._host_tier = HostBlockPool(
+                int(host_tier_bytes), self._pool.host_block_nbytes,
+                scale_nbytes=self._pool.host_scale_nbytes,
+                name=f"serving/host_tier#{self._eid}")
+            self._pool.attach_host_tier(self._host_tier)
         self._closed = False
         self._close_lock = threading.Lock()
         # speculative decoding (fused engines only): a small draft
@@ -558,6 +604,12 @@ class GenerationEngine:
                 return
             self._closed = True
         self._sched.close(cancel_pending=cancel_pending)
+        # host tier after the scheduler: no more tier_tick/promotions
+        # can be dispatched, so close() only has queued work to drain
+        # (the spiller finishes in-flight demotions, then both worker
+        # threads join)
+        if self._host_tier is not None:
+            self._host_tier.close()
         # a closed engine's pool is no longer an accounted HBM owner
         self._pool.drop_ledger()
         # ...nor a scraped metrics source or statusz row
@@ -644,6 +696,13 @@ class GenerationEngine:
             f"{pool.ledger_key}/in_use", pool.bytes_in_use)
         if self._paged:
             hits, misses = pool.prefix_hits, pool.prefix_misses
+            # tiered hit split (MIGRATION.md "prefix-hit split"): the
+            # aggregate prefix_hit_ratio stays for dashboards; the
+            # split keys say WHICH tier served each admission — hbm
+            # (device trie), host (served through a promotion), miss.
+            # Present tier or no tier (host is just 0 untiered).
+            th = pool.tier_hits
+            denom = max(1, th["hbm"] + th["host"] + th["miss"])
             s.update({
                 "block_size": pool.block_size,
                 "num_blocks": pool.num_blocks,
@@ -653,6 +712,10 @@ class GenerationEngine:
                 "prefix_hits": hits,
                 "prefix_misses": misses,
                 "prefix_hit_ratio": hits / max(1, hits + misses),
+                "tier_hits": dict(th),
+                "prefix_hit_hbm": th["hbm"] / denom,
+                "prefix_hit_host": th["host"] / denom,
+                "prefix_miss": th["miss"] / denom,
                 "prefill_tokens_saved": pool.tokens_saved,
                 "prefix_evictions": pool.evictions,
                 # tiered KV bytes: block storage vs the scale side-array
@@ -668,6 +731,12 @@ class GenerationEngine:
                     "scales": pool.scales_bytes,
                 },
             })
+            if self._host_tier is not None:
+                # hierarchical tier snapshot: host capacity/occupancy,
+                # demotion/promotion volumes, and the end-to-end
+                # promotion latency (ticket creation -> adoption) —
+                # the "did the second tier pay for itself" numbers
+                s["host_tier"] = self._host_tier.stats()
             if self._mp > 1:
                 s["mp"] = self._mp
                 s["mp_axis"] = self._mp_axis
@@ -981,6 +1050,11 @@ class GenerationEngine:
             "peak_point": pk.as_dict() if pk else None,
             "timeline": [p.as_dict() for p in rep.timeline],
         }
+        if self._host_tier is not None:
+            # informational only: host DRAM, deliberately NOT added to
+            # static_peak_bytes — the HBM fit check must never bill
+            # the spill tier against the device budget
+            plan["host_tier_bytes"] = self._host_tier.capacity_bytes
         if plan["fits"] is False:
             raise PlanError(
                 f"replica does not fit: static peak {total:,} B "
@@ -1092,6 +1166,10 @@ class GenerationEngine:
             cached = []                   # tail too long: prefill wins
         if cached:
             pool.admit_cached(slot, cached)
+            # tier split: a hit served through a just-landed promotion
+            # is a HOST-tier hit; a plain trie hit never left HBM
+            pool.note_tier_hit(
+                "host" if req._tier_promoted else "hbm")
             m = len(cached) * pool.block_size
             pool.set_slot(slot, pos=m, lo=0)
             req.last_token = int(feed[m])
@@ -1099,6 +1177,7 @@ class GenerationEngine:
             req.trace.mark("prefix_hit", tokens_saved=m,
                            replay=len(req.replay))
             return None
+        pool.note_tier_hit("miss")
         blocks = pool.admit_fresh(slot, feed.size)
         table = np.zeros(bucket // pool.block_size, np.int32)
         table[:len(blocks)] = blocks      # padding -> the scratch block
@@ -1140,12 +1219,15 @@ class GenerationEngine:
         cached = pool.match_prefix(feed)
         if cached:
             pool.admit_cached(slot, cached)
+            pool.note_tier_hit(
+                "host" if req._tier_promoted else "hbm")
             m = len(cached) * pool.block_size
             pool.set_slot(slot, pos=m, lo=0)
             req.pending_feed = [int(t) for t in feed[m:]]
             req.trace.mark("prefix_hit", tokens_saved=m,
                            pending=len(req.pending_feed))
         else:
+            pool.note_tier_hit("miss")
             pool.admit_fresh(slot, feed.size)
             # position 0 is where the first pending token's K/V land
             pool.set_slot(slot, pos=0, lo=0)

@@ -338,6 +338,21 @@ class EngineFleet:
         if hits is not None:
             agg["prefix_hit_ratio"] = \
                 hits / max(1, hits + agg.get("prefix_misses", 0))
+        # tiered hit split summed across healthy replicas, re-derived
+        # as fleet-level ratios (MIGRATION.md "prefix-hit split" — the
+        # aggregate prefix_hit_ratio above stays for dashboards)
+        th = {"hbm": 0, "host": 0, "miss": 0}
+        tiered = False
+        for r in healthy:
+            for k, v in (r.get("tier_hits") or {}).items():
+                th[k] = th.get(k, 0) + v
+                tiered = True
+        if tiered:
+            denom = max(1, sum(th.values()))
+            agg["tier_hits"] = th
+            agg["prefix_hit_hbm"] = th["hbm"] / denom
+            agg["prefix_hit_host"] = th["host"] / denom
+            agg["prefix_miss"] = th["miss"] / denom
         if agg.get("spec_proposed"):
             agg["spec_accept_rate"] = \
                 agg.get("spec_accepted", 0) / agg["spec_proposed"]

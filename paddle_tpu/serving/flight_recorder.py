@@ -315,6 +315,14 @@ class FlightRecorder:
                                 for c in self._cycles)
             spec_proposed = sum(c.get("spec_proposed", 0)
                                 for c in self._cycles)
+            # hierarchical-KV promotion accounting (ISSUE 20): cycles
+            # spent with a waiter skipped for an in-flight H2D copy,
+            # and blocks adopted back — the ring-window evidence that
+            # promotions overlap decode instead of stalling it
+            promo_waits = sum(c.get("promo_waits", 0)
+                              for c in self._cycles)
+            promoted_blocks = sum(c.get("promoted_blocks", 0)
+                                  for c in self._cycles)
         return {"cycles": cycles, "emitted": emitted, "cycle_secs": secs,
                 "decode_cycles": decode_cycles,
                 "decode_flops": decode_flops,
@@ -322,7 +330,9 @@ class FlightRecorder:
                 "prefill_chunks": prefill_chunks,
                 "spec_emitted": spec_emitted, "spec_slots": spec_slots,
                 "spec_accepted": spec_accepted,
-                "spec_proposed": spec_proposed}
+                "spec_proposed": spec_proposed,
+                "promo_waits": promo_waits,
+                "promoted_blocks": promoted_blocks}
 
     def snapshot(self) -> Dict[str, Any]:
         """JSON-serializable copy of both rings + the counters."""

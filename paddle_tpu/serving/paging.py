@@ -223,6 +223,15 @@ class PagedKVPool(SlotPoolBase):
         self.prefix_misses = 0
         self.tokens_saved = 0
         self.evictions = 0
+        # hierarchical host-DRAM tier (host_tier.py), attached by the
+        # engine when host_tier_bytes= is set: keys that just went
+        # refcount-0 wait in _tier_pending until the scheduler's
+        # once-per-cycle tier_tick() dispatches ONE batched demotion
+        # gather for all of them (write-back, off the hot path)
+        self.host_tier = None
+        self._tier_pending: set = set()
+        self.tier_hits = {"hbm": 0, "host": 0, "miss": 0}
+        self.tier_degraded = 0
 
     def _alloc_data(self):
         """Fresh zeroed block array — head-partitioned over the mesh's
@@ -265,6 +274,10 @@ class PagedKVPool(SlotPoolBase):
         self._lru.clear()
         self._ref.clear()
         self._free = list(range(1, self.num_blocks + 1))
+        # pending demotions point at the old (possibly deleted) device
+        # array — drop them; already-DEMOTED host copies stay valid
+        # (content is a pure function of the prefix key)
+        self._tier_pending.clear()
         self._observe()
 
     # (per-slot position tracking and the pow2 capacity buckets are the
@@ -391,6 +404,10 @@ class PagedKVPool(SlotPoolBase):
                 # released but cached: joins the LRU (most-recent end),
                 # reusable by a later prefix hit until evicted
                 self._lru[key] = self._trie[key]
+                if self.host_tier is not None:
+                    # write-back candidate: demoted at the next
+                    # tier_tick() if still evictable then
+                    self._tier_pending.add(key)
             else:
                 heapq.heappush(self._free, b)
 
@@ -423,6 +440,180 @@ class PagedKVPool(SlotPoolBase):
             parent.children.discard(key)
         for child in list(node.children):
             self._drop_node(child)
+
+    # -- hierarchical host tier (host_tier.py) -----------------------------
+    @property
+    def host_block_nbytes(self) -> int:
+        """HOST bytes of one demoted block — FULL heads (a
+        tensor-parallel pool's demotion gathers the global value, so
+        the host entry is shard-agnostic), no scratch, no sharding
+        divisor."""
+        return (self.num_layers * 2 * self.num_heads * self.block_size
+                * self.head_dim * self.dtype.itemsize)
+
+    @property
+    def host_scale_nbytes(self) -> int:
+        """Host bytes of one block's per-block scale row (0 for float
+        pools)."""
+        return self.num_layers * 2 * self.num_heads * 4 \
+            if self.quantized else 0
+
+    def attach_host_tier(self, tier) -> None:
+        """Bind a :class:`~.host_tier.HostBlockPool` as the spill
+        target for LRU-evicted refcount-0 blocks (engine ctor,
+        ``host_tier_bytes=``). Eagerly compiles the tier's batched
+        gather/scatter for every pow2 width it can ever use — a
+        first-use compile would otherwise stall the scheduler thread
+        (and every decode slot with it) for ~100ms mid-serving."""
+        self.host_tier = tier
+        m = 1
+        while True:
+            ids = np.zeros(m, np.int32)
+            blk = self.data[:, :, ids]                 # demote gather
+            self.data = self.data.at[:, :, ids].set(blk)   # adopt
+            if self.quantized:
+                sca = self.scales[:, :, ids]
+                self.scales = self.scales.at[:, :, ids].set(sca)
+            if m >= self.num_blocks:
+                break
+            m *= 2
+
+    def tier_tick(self) -> None:
+        """Once-per-cycle demotion pump (scheduler thread, start of
+        cycle): batch every key that went refcount-0 since the last
+        tick and is STILL evictable into ONE lazy device gather, and
+        hand it to the tier's spiller thread. The gather
+        ``data[:, :, ids]`` is an independent non-donated array whose
+        value is captured before any later donated step can delete the
+        pool storage, so the spiller's blocking copy never races XLA
+        donation. Dispatch-only — no device sync on this thread."""
+        tier = self.host_tier
+        if tier is None or not self._tier_pending:
+            return
+        pending, self._tier_pending = self._tier_pending, set()
+        keys = [k for k in pending if k in self._lru and not tier.has(k)]
+        if not keys:
+            return
+        # pow2-pad the gather width (repeat the last id — the spiller
+        # only reads the first len(keys) lanes): an eager gather
+        # compiles once per distinct index length, and a per-batch
+        # shape would put a fresh ~100ms XLA compile on the scheduler
+        # thread every few cycles. Same bucket discipline as prefill.
+        raw = [self._trie[k].block for k in keys]
+        m = 1 << (len(raw) - 1).bit_length()
+        ids = np.asarray(raw + [raw[-1]] * (m - len(raw)), np.int32)
+        blk = self.data[:, :, ids]        # lazy batched gather
+        sca = self.scales[:, :, ids] if self.quantized else None
+        tier.spill(keys, blk, sca)
+
+    def tier_match(self, tokens) -> Tuple[List[Tuple[int, ...]], int]:
+        """Continue :meth:`match_prefix`'s walk into the HOST tier:
+        the chain of demoted full blocks that extends the device-cached
+        prefix of ``tokens`` (same proper-prefix cap). Returns
+        ``(host_keys, covered_tokens)`` where ``covered_tokens`` counts
+        the device+host contiguous coverage — the scheduler's
+        promotion gate mirrors the engine's uncovered-tail heuristic
+        with it. Read-only."""
+        tier = self.host_tier
+        if tier is None:
+            return [], 0
+        toks = tuple(int(t) for t in tokens)
+        bs = self.block_size
+        host_keys: List[Tuple[int, ...]] = []
+        covered = 0
+        for i in range(1, (len(toks) - 1) // bs + 1):
+            key = toks[:i * bs]
+            if key in self._trie:
+                covered = i * bs
+                continue
+            if tier.has(key):
+                host_keys.append(key)
+                covered = i * bs
+            else:
+                break
+        return host_keys, covered
+
+    def adopt_promotion(self, ticket) -> bool:
+        """Land a staged promotion (scheduler thread, the cycle the
+        ticket's H2D copy completed): allocate device blocks, scatter
+        the staged batch into them (lazy ``.at[].set`` — no new trace
+        site, no sync), and republish each key as a refcount-0 cached
+        trie node, exactly as if the blocks had never been evicted.
+        The content-canonical invariant makes every overlap safe: keys
+        republished on the device while the copy staged are simply
+        skipped (identical bytes), and exhaustion degrades to adopting
+        the chain PREFIX that fits — or to a plain miss — never to an
+        error on the serving path."""
+        tier = self.host_tier
+        if tier is None or ticket is None:
+            return False
+        if ticket.adopted:
+            return True
+        if ticket.failed or not ticket.staged_keys:
+            tier.ticket_done(ticket)
+            return False
+        keep = [i for i, k in enumerate(ticket.staged_keys)
+                if k not in self._trie]
+        if not keep:
+            # the whole chain was republished on the device while the
+            # copy staged — identical bytes by the content-canonical
+            # invariant, nothing to land
+            ticket.adopted = True
+            tier.ticket_done(ticket)
+            return True
+        ids: List[int] = []
+        try:
+            for _ in keep:
+                ids.append(self._alloc_block())
+        except PoolExhaustedError:
+            pass                          # adopt the prefix that fits
+        keep = keep[:len(ids)]
+        if not keep:
+            self.tier_degraded += 1
+            stat_add("serving/tier_degraded")
+            tier.ticket_done(ticket)
+            return False
+        # uniform pow2-wide gather + scatter, whatever subset of the
+        # chain is being landed: the staged batch is already pow2-padded
+        # (promoter side), and padding BOTH index vectors by repeating
+        # their last entry keeps every adoption on one compiled shape
+        # per bucket — duplicate scatter lanes write identical bytes,
+        # so the result is unchanged. Without this, each distinct chain
+        # length would eagerly compile a fresh gather/scatter pair on
+        # the scheduler thread, stalling decode for ~100ms a pop.
+        m = int(ticket.staged.shape[2])
+        sel = np.asarray(keep + [keep[-1]] * (m - len(keep)), np.int32)
+        idx = np.asarray(ids + [ids[-1]] * (m - len(ids)), np.int32)
+        blk = ticket.staged[:, :, sel]
+        sca = ticket.staged_scales
+        self.data = self.data.at[:, :, idx].set(blk)
+        if self.quantized and sca is not None:
+            # adopted blocks carry their ORIGINAL per-block scales —
+            # overwrite the zeros _alloc_block just staged
+            self.scales = self.scales.at[:, :, idx].set(sca[:, :, sel])
+        for k_i, b in zip(keep, ids):
+            key = ticket.staged_keys[k_i]
+            self._ref[b] = 0              # cache-resident, unreferenced
+            node = _TrieNode(key, b)
+            self._trie[key] = node
+            self._block_key[b] = key
+            parent = self._trie.get(key[:-self.block_size])
+            if parent is not None:
+                parent.children.add(key)
+            self._lru[key] = node         # evictable until admitted
+        ticket.adopted = True
+        tier.note_promoted(ticket, len(keep))
+        tier.ticket_done(ticket)
+        self._observe()
+        return True
+
+    def note_tier_hit(self, kind: str) -> None:
+        """Classify one admission for the tiered hit split: ``hbm``
+        (device trie hit), ``host`` (hit served through a promotion),
+        or ``miss``. Counted by the engine on every paged admission so
+        the split keys exist tier or no tier."""
+        self.tier_hits[kind] = self.tier_hits.get(kind, 0) + 1
+        stat_add(f"serving/tier_hit_{kind}")
 
     # -- admission: prefix matching + table setup --------------------------
     def match_prefix(self, tokens) -> List[int]:

@@ -155,6 +155,12 @@ class GenerationRequest:
         self.tenant = str(tenant)
         self.lane = str(lane)
         self._preempted = False     # replay victims outrank the queue
+        # hierarchical-KV promotion state (paged engines with a host
+        # tier): the in-flight PromotionTicket this request waits on,
+        # and whether its admission was served through a promotion
+        # (engine classifies the hit as tier=host)
+        self._promo_ticket = None
+        self._tier_promoted = False
         self.submitted_at = time.perf_counter()
         self.deadline = None if timeout is None \
             else self.submitted_at + float(timeout)
@@ -480,6 +486,7 @@ class Scheduler:
                 "decode_dispatch_ms": 0.0, "fetch_ms": 0.0,
                 "admitted": [], "retired": [], "emitted": 0,
                 "preempts": 0, "active": 0, "occupancy": 0.0,
+                "promo_waits": 0, "promoted_blocks": 0,
             }
             failed = None
             try:
@@ -489,12 +496,31 @@ class Scheduler:
                     with _prof.record("serving/sweep", "serving"):
                         self._sweep_queue()
                     rec["sweep_ms"] = (time.perf_counter() - t) * 1e3
+                    if self._paged and \
+                            getattr(self._pool, "host_tier", None) \
+                            is not None:
+                        # demotion pump: blocks freed by LAST cycle's
+                        # retirements spill before THIS cycle's
+                        # admissions can evict them (dispatch-only)
+                        self._pool.tier_tick()
+                        # promotion prefetch: start/land H2D copies
+                        # for the queue FRONT while the decode slots
+                        # are still busy (the pending-feed overlap)
+                        self._prefetch_promotions()
                     t = time.perf_counter()
                     with _prof.record("serving/admit", "serving"):
                         self._admit()
                     rec["admit_ms"] = (time.perf_counter() - t) * 1e3
                     if self._slots:
                         self._decode_cycle()
+                    elif rec["promo_waits"]:
+                        # nothing decoding and the only queued work is
+                        # waiting on in-flight promotions: nap on the
+                        # tier's progress beacon (host Event, ~2ms)
+                        # instead of hot-spinning the admit loop. With
+                        # decode slots active this branch never runs —
+                        # decode cycles never block on a promotion.
+                        self._pool.host_tier.wait_progress(0.002)
             except Exception as e:                      # noqa: BLE001
                 # a step failure (OOM, bad artifact) poisons the affected
                 # requests, never the loop: fail everything in flight and
@@ -598,10 +624,12 @@ class Scheduler:
             live = []
             for r in self._queue:
                 if r.cancelled:
+                    self._drop_ticket(r)
                     stat_add("serving/cancelled")
                     r._finish(RequestCancelled(
                         f"request {r.id} cancelled while queued"))
                 elif r.expired(now):
+                    self._drop_ticket(r)
                     stat_add("serving/deadline_exceeded")
                     depth = len(self._queue)
                     r._finish(DeadlineExceeded(
@@ -615,10 +643,12 @@ class Scheduler:
                 self._queue[:] = live
                 stat_observe("serving/queue_depth", len(live))
 
-    def _select_next(self) -> int:
+    def _select_next(self, skip=frozenset()) -> int:
         """Index into ``self._queue`` of the next admission candidate —
         weighted deficit-round-robin over the queued (lane, tenant)
-        classes (caller holds ``self._cond``).
+        classes (caller holds ``self._cond``). Request ids in ``skip``
+        (promotion-waiters this cycle) are invisible to the rotation;
+        returns -1 when nothing else is queued.
 
         Preempted replay victims outrank everything (they predate every
         queued arrival and their history is hot). A single queued class
@@ -632,13 +662,17 @@ class Scheduler:
         interactive has nothing queued (work-conserving)."""
         q = self._queue
         for i, r in enumerate(q):
-            if r._preempted:
+            if r._preempted and r.id not in skip:
                 return i
         heads: Dict[Tuple[str, str], int] = {}
         for i, r in enumerate(q):
+            if r.id in skip:
+                continue
             key = (r.lane, r.tenant)
             if key not in heads:
                 heads[key] = i
+        if not heads:
+            return -1
         if len(heads) == 1:
             return next(iter(heads.values()))
         # keep the rotation stable across calls; retire dead classes
@@ -664,6 +698,79 @@ class Scheduler:
             self._rr.append(self._rr.pop(0))
         return heads[self._rr[0]]     # unreachable: cap >= any cost
 
+    def _drop_ticket(self, req: GenerationRequest) -> None:
+        """Release a dead waiter's promotion ticket so the tier's
+        registry (and the staged device buffers it pins) don't outlive
+        the request. A ticket shared by a coalesced waiter survives —
+        ``ticket_done`` only unregisters; adoption by the other waiter
+        still works."""
+        tk = req._promo_ticket
+        if tk is None:
+            return
+        req._promo_ticket = None
+        tier = getattr(self._pool, "host_tier", None)
+        if tier is not None:
+            tier.ticket_done(tk)
+
+    def _prefetch_promotions(self) -> None:
+        """Overlap promotion with decode (scheduler thread, right
+        after the demotion pump): drive the promotion state machine
+        for the FRONT of the queue while every decode slot is still
+        busy, so a host-resident chain is requested BEFORE a slot
+        frees up. Without this the ticket would only be requested
+        when the waiter reaches admission with capacity in hand; a
+        competing fresh request would steal that slot during the
+        copy's one-or-two-cycle flight and the waiter would sit out
+        a whole generation. Adoption is deliberately NOT driven here
+        (``adopt=False``): republishing staged blocks before the
+        waiter can take references would leave them refcount-0 in a
+        pressured pool, where the very next fresh admission evicts
+        them again — the ticket pins the staged copy instead, and
+        the admission path adopts and refs in one step. Bounded to
+        the promoter's double-buffer depth — everything here is host
+        bookkeeping plus dispatch-only device calls."""
+        with self._cond:
+            head = [r for r in self._queue if not r.cancelled][:2]
+            for req in head:
+                self._promotion_state(req, adopt=False)
+
+    def _promotion_state(self, req: GenerationRequest,
+                         adopt: bool = True) -> str:
+        """Drive ``req``'s host-tier promotion state machine (caller
+        holds ``_cond``; scheduler thread). Returns ``"go"`` — admit
+        now (no host-resident prefix, the engine would decline the hit
+        anyway, the tier degraded to a plain miss, or the staged blocks
+        were just adopted) — or ``"wait"`` — an H2D copy is in flight,
+        skip this request until it lands."""
+        pool = self._pool
+        tk = req._promo_ticket
+        if tk is not None:
+            if not tk.ready.is_set():
+                return "wait"
+            if not adopt:
+                return "go"     # staged; admission adopts + refs
+            req._promo_ticket = None
+            if pool.adopt_promotion(tk):
+                req._tier_promoted = True
+                if self._rec is not None:
+                    self._rec["promoted_blocks"] += len(tk.staged_keys)
+            return "go"                  # failed ticket = plain miss
+        feed = req.prompt if not req.tokens else np.concatenate(
+            [req.prompt, np.asarray(req.tokens, np.int32)])
+        host_keys, covered = pool.tier_match(feed)
+        if not host_keys:
+            return "go"
+        if not self._chunked and feed.size - covered > pool.min_bucket:
+            # mirror the engine's hit heuristic: with an uncovered tail
+            # past one min_bucket the engine prefills fresh regardless,
+            # so waiting on a promotion would only add latency
+            return "go"
+        tk = pool.host_tier.request_promotion(host_keys)
+        if tk is None:
+            return "go"                  # tier degraded to a plain miss
+        req._promo_ticket = tk
+        return "wait"
+
     # admission: weighted-fair over (lane, tenant) classes — FCFS
     # within a class and when only one class is queued — under a
     # prefill budget (the loop sweeps the queue under its own
@@ -671,21 +778,48 @@ class Scheduler:
     def _admit(self) -> None:
         decode_waiting = bool(self._slots)
         budget = self._prefill_budget
+        skip: set = set()       # promotion-waiters sit out this cycle
         while True:
             with self._cond:
                 if not self._queue:
                     return
-                idx = self._select_next()
+                # a promotion whose H2D copy has LANDED admits ahead
+                # of the fair rotation: landing it is a block adoption
+                # plus a short replay — no prefill program runs — so
+                # the jump costs the queue almost nothing, while
+                # making the waiter sit through one more fresh
+                # bucket-64 prefill would hand back most of the
+                # latency the tier just saved
+                idx = -1
+                for i, r in enumerate(self._queue):
+                    tk = r._promo_ticket
+                    if r.id in skip:
+                        continue
+                    # _tier_promoted with no ticket = the chain was
+                    # adopted on an earlier pass that then bounced off
+                    # a capacity gate: its blocks sit refcount-0 and
+                    # evictable, so admit it before any fresh prefill
+                    # can steal them back
+                    if (tk is not None and tk.ready.is_set()) \
+                            or (tk is None and r._tier_promoted):
+                        idx = i
+                        break
+                if idx < 0:
+                    idx = self._select_next(skip)
+                if idx < 0:
+                    return      # only promotion-waiters left queued
                 req = self._queue[idx]
                 # re-check the head: cancel/expiry may race the sweep
                 if req.cancelled:
                     self._queue.pop(idx)
+                    self._drop_ticket(req)
                     stat_add("serving/cancelled")
                     req._finish(RequestCancelled(
                         f"request {req.id} cancelled while queued"))
                     continue
                 if req.expired():
                     self._queue.pop(idx)
+                    self._drop_ticket(req)
                     stat_add("serving/deadline_exceeded")
                     depth = len(self._queue)
                     req._finish(DeadlineExceeded(
@@ -693,6 +827,32 @@ class Scheduler:
                         f"queued",
                         queue_depth=depth,
                         est_wait_s=self._est_wait_s(depth)))
+                    continue
+                # hierarchical KV: a request whose prefix continues in
+                # the HOST tier is treated like a pending feed — start
+                # (or poll) its async H2D promotion and admit the cycle
+                # the blocks land. Meanwhile the rotation moves on to
+                # other queued work, so a copy in flight never blocks a
+                # decode cycle or a promotion-free admission.
+                if self._paged and \
+                        getattr(self._pool, "host_tier", None) is not None \
+                        and self._promotion_state(req) == "wait":
+                    if self._rec is not None:
+                        self._rec["promo_waits"] += 1
+                    tk = req._promo_ticket
+                    if tk is not None and \
+                            time.perf_counter() - tk.created_at < 0.05:
+                        # hold the admission line while the copy is
+                        # YOUNG: it lands within a cycle or two, and
+                        # letting a later-arriving prefill overtake now
+                        # would occupy the stream for exactly the time
+                        # the hit was about to save (decode slots keep
+                        # running — only fresh admissions wait). The
+                        # age bound keeps a wedged promoter from
+                        # starving the queue: past it, the rotation
+                        # resumes overtaking as before.
+                        return
+                    skip.add(req.id)
                     continue
                 # paged re-admission (preemption) replays the request's
                 # own generated tokens, so the "prompt" being fed is the
@@ -705,7 +865,11 @@ class Scheduler:
                     # keeps its FCFS place; submit-time capacity checks
                     # guarantee it fits an idle pool, so no deadlock)
                     return
-                if not self._chunked and decode_waiting and budget < bucket:
+                if not self._chunked and decode_waiting and budget < bucket \
+                        and not req._tier_promoted:
+                    # (an adopted promotion is a guaranteed prefix hit:
+                    # no prefill program will run, so the budget gate
+                    # that throttles prefill latency does not apply)
                     # budget spent: decode the active slots first; the
                     # queue keeps its place (FCFS) and is retried next
                     # cycle. This is the anti-starvation preemption.
@@ -841,6 +1005,7 @@ class Scheduler:
         req.replay = []                  # rebuilt at re-admission
         req.pending_feed = []            # ditto (fused chunked feed)
         req._preempted = True            # outranks WDRR selection
+        req._tier_promoted = False       # re-classified at re-admission
         self.preempts += 1
         self._event(req, "preempt", emitted=req.emitted)
         if self._rec is not None:

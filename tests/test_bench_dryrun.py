@@ -214,6 +214,24 @@ def test_dry_run_emits_metrics_summary():
     assert mc["skipped"] is False, mc
     assert mc["kv_bytes_per_device"] * 2 == mc["single_device_kv_bytes"], mc
 
+    # ISSUE-20 hierarchical KV cache: the tiered canary demoted warm
+    # prefix blocks to the host pool under device-pool pressure, a
+    # later request with the same preamble hit the HOST tier (prefix
+    # blocks promoted back over async H2D, bit-identical — greedy
+    # token parity with an untiered engine holds), the promotion
+    # counters are live, and the aggregate serving/prefix_hit split
+    # into hbm/host/miss sums to one
+    assert out["checks"]["tiered_host_hit"] is True, out
+    assert out["checks"]["tiered_promotion_live"] is True, out
+    assert out["checks"]["tiered_parity"] is True, out
+    td = out["tiered"]
+    assert td["host_hits"] > 0, td
+    assert td["demoted"] > 0 and td["promoted"] > 0, td
+    split = td["hit_split"]
+    assert abs(sum(split.values()) - 1.0) < 1e-9, split
+    assert split["prefix_hit_host"] > 0, split
+    assert "serving/tier_hit_host" in res.stderr
+
     # ISSUE-18 static memory planner: the donation-aware liveness
     # estimate bracketed XLA's memory_analysis on EVERY program the dry
     # run compiled where both figures exist (a real GPT train step and

@@ -502,3 +502,48 @@ class TestShedMetadata:
                           lambda sr: np.full(pool.num_slots, 2, np.int32))
         assert set(LANES) == set(sched._lane_weights)
         sched.close()
+
+
+# ---------------------------------------------------------------------------
+# fleet mount (PR 20): the door serves a real multi-replica fleet
+# ---------------------------------------------------------------------------
+
+class TestFleetFrontDoor:
+    def test_door_over_two_replica_fleet_aggregates_tenants(self):
+        """The submit contract is duck-typed, so an EngineFleet mounts
+        behind the door unchanged: requests route round-robin across
+        two REAL tiny-GPT replicas, and per-tenant retired counts are
+        only true as the fleet-level sum."""
+        import paddle_tpu as paddle
+        from paddle_tpu.models import GPTConfig, GPTForPretraining
+        from paddle_tpu.serving import EngineFleet, GenerationEngine
+
+        paddle.seed(3)
+        model = GPTForPretraining(GPTConfig.tiny())
+        model.eval()
+        engines = [GenerationEngine(model, num_slots=2, max_len=32,
+                                    min_bucket=8) for _ in range(2)]
+        fleet = EngineFleet(engines, name="door-fleet")
+        d = FrontDoor(fleet)
+        srv = d.start()
+        try:
+            url = srv.url + "/v1/completions"
+            for i, tenant in enumerate(("acme", "acme", "zoo", "acme")):
+                st, doc, _ = _post(
+                    url, {"prompt": [3 + i, 4, 5], "max_tokens": 3},
+                    headers={"X-Tenant": tenant})
+                assert st == 200
+                assert len(doc["choices"][0]["token_ids"]) == 3
+            s = fleet.stats()
+            assert s["replicas_healthy"] == 2
+            assert s["requests_retired"] == 4
+            # round-robin actually spread the work over both replicas
+            assert all(e.stats()["requests_retired"] >= 1
+                       for e in engines)
+            # the per-tenant truth only exists as the fleet-level sum
+            tens = s["tenants"]
+            assert tens["acme"]["retired"] == 3
+            assert tens["zoo"]["retired"] == 1
+        finally:
+            d.close()
+            fleet.close()

@@ -291,3 +291,25 @@ def test_analysis_no_device_rule():
     out = lint_source("t.py", sup, "analysis/liveness.py")
     assert 5 not in [f.line for f in out]
     assert [f.line for f in out] == [3, 4, 6]
+
+
+def test_host_tier_promoter_covered_by_construction():
+    """PR 20 seeded check: the host tier lives in serving/, so a stray
+    blocking fetch in the PROMOTER body (the H2D path that must stay
+    async) is caught by serving-host-sync by construction — and the one
+    sanctioned copy, the spiller's batched demotion fetch, is exactly
+    the suppressed form host_tier.py ships."""
+    src = ("import jax\n"
+           "import numpy as np\n"
+           "def _promote_loop(self, tk, entries):\n"
+           "    staged = jax.device_put(np.stack(entries, axis=2))\n"
+           "    return jax.device_get(staged)\n")      # flagged: sync H2D
+    out = lint_source("t.py", src, "serving/host_tier.py")
+    assert [f.rule for f in out] == ["serving-host-sync"]
+    assert out[0].line == 5
+    # the sanctioned spiller copy is the suppressed form
+    ok = ("import jax\n"
+          "import numpy as np\n"
+          "def _fetch(self, dev):\n"
+          "    return np.asarray(jax.device_get(dev))  # lint: ok\n")
+    assert lint_source("t.py", ok, "serving/host_tier.py") == []
